@@ -1,0 +1,52 @@
+"""Test fixture: an 8-device CPU mesh in one process.
+
+The reference's fixture was "mpirun -np N on localhost *is* the test rig"
+(SURVEY.md §5).  Ours is JAX's forced host device count: 8 simulated CPU
+devices give a real multi-device mesh — real shardings, real collectives,
+real two-level (2x4) topology — in a single pytest process.
+"""
+
+import os
+
+# XLA_FLAGS is read at backend-init time, so setting it here still works even
+# though the environment's sitecustomize imported jax at interpreter startup.
+# JAX_PLATFORMS however was already consumed at that import (it may point at
+# the real TPU platform), so the platform is forced via jax.config instead.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+import pytest  # noqa: E402
+
+import torchmpi_tpu as mpi  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _check_devices():
+    assert jax.device_count() == 8, (
+        f"expected 8 simulated CPU devices, got {jax.device_count()}"
+    )
+    yield
+
+
+@pytest.fixture()
+def flat_runtime():
+    """World mesh 1x8 (single slice): the reference's single-node case."""
+    mpi.stop()
+    mesh = mpi.init(mpi.Config(dcn_size=1))
+    yield mesh
+    mpi.stop()
+
+
+@pytest.fixture()
+def hier_runtime():
+    """World mesh 2x4 (two emulated slices): the reference's multi-node case."""
+    mpi.stop()
+    mesh = mpi.init(mpi.Config(dcn_size=2))
+    yield mesh
+    mpi.stop()

@@ -1,0 +1,292 @@
+"""Collective correctness sweep.
+
+Rebuild of the reference's ``test/collectives*.lua`` strategy (SURVEY.md §5):
+sweep op x dtype x size (incl. non-power-of-two and sizes straddling the
+chunking cutover) x {sync,async} x {flat,hierarchical}.  Oracle: fill each
+rank's tensor as f(rank) and compare against the closed-form numpy reduction —
+no mocks; the 8-device mesh is the fixture.
+"""
+
+import numpy as np
+import pytest
+
+import torchmpi_tpu as mpi
+from torchmpi_tpu import collectives
+
+N = 8
+SIZES = [1, 7, 128, 1000, 4096]  # non-pow2 + straddling shapes
+DTYPES = [np.float32, np.int32]
+
+
+def rank_data(size, dtype, n=N):
+    # f(rank): distinct per rank, exact in float32.
+    base = np.arange(size, dtype=dtype) % 13
+    return np.stack([(base + r).astype(dtype) for r in range(n)])
+
+
+# ---------------------------------------------------------------------------
+# Flat mesh sweep (xla backend)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("size", SIZES)
+@pytest.mark.parametrize("dtype", DTYPES)
+def test_allreduce_sum(flat_runtime, size, dtype):
+    x = rank_data(size, dtype)
+    out = np.asarray(mpi.allreduce(x))
+    expect = x.sum(axis=0)
+    for r in range(N):
+        np.testing.assert_allclose(out[r], expect)
+
+
+@pytest.mark.parametrize("op,npf", [("max", np.max), ("min", np.min)])
+def test_allreduce_maxmin(flat_runtime, op, npf):
+    x = rank_data(100, np.float32)
+    out = np.asarray(mpi.allreduce(x, op=op))
+    expect = npf(x, axis=0)
+    for r in range(N):
+        np.testing.assert_allclose(out[r], expect)
+
+
+def test_allreduce_mean(flat_runtime):
+    x = rank_data(64, np.float32)
+    out = np.asarray(mpi.allreduce(x, op="mean"))
+    np.testing.assert_allclose(out[0], x.mean(axis=0), rtol=1e-6)
+
+
+@pytest.mark.parametrize("root", [0, 3, 7])
+def test_broadcast(flat_runtime, root):
+    x = rank_data(33, np.float32)
+    out = np.asarray(mpi.broadcast(x, root=root))
+    for r in range(N):
+        np.testing.assert_allclose(out[r], x[root])
+
+
+@pytest.mark.parametrize("root", [0, 5])
+def test_reduce(flat_runtime, root):
+    x = rank_data(50, np.float32)
+    out = np.asarray(mpi.reduce(x, root=root))
+    np.testing.assert_allclose(out[root], x.sum(axis=0))
+    for r in range(N):
+        if r != root:
+            np.testing.assert_allclose(out[r], x[r])  # untouched, like MPI_Reduce
+
+
+def test_allgather(flat_runtime):
+    x = rank_data(17, np.float32)
+    out = np.asarray(mpi.allgather(x))
+    assert out.shape == (N, N, 17)
+    for r in range(N):
+        np.testing.assert_allclose(out[r], x)
+
+
+def test_reduce_scatter(flat_runtime):
+    x = rank_data(64, np.float32)
+    out = np.asarray(mpi.reduce_scatter(x))
+    expect = x.sum(axis=0).reshape(N, -1)
+    for r in range(N):
+        np.testing.assert_allclose(out[r], expect[r])
+
+
+@pytest.mark.parametrize("src,dst", [(0, 1), (2, 7), (6, 3)])
+def test_sendreceive(flat_runtime, src, dst):
+    x = rank_data(21, np.float32)
+    out = np.asarray(mpi.sendreceive(x, src=src, dst=dst))
+    np.testing.assert_allclose(out[dst], x[src])
+    for r in range(N):
+        if r != dst:
+            np.testing.assert_allclose(out[r], x[r])
+
+
+def test_alltoall(flat_runtime):
+    x = rank_data(N * 3, np.float32)  # each rank: 8 blocks of 3
+    out = np.asarray(mpi.alltoall(x))
+    blocks = x.reshape(N, N, 3)
+    expect = np.transpose(blocks, (1, 0, 2)).reshape(N, N * 3)
+    np.testing.assert_allclose(out, expect)
+
+
+def test_multidim_tensor(flat_runtime):
+    x = np.stack([np.full((4, 5, 3), float(r + 1), np.float32)
+                  for r in range(N)])
+    out = np.asarray(mpi.allreduce(x))
+    np.testing.assert_allclose(out[0], np.full((4, 5, 3), 36.0))
+
+
+def test_pytree(flat_runtime):
+    tree = {"a": rank_data(16, np.float32),
+            "b": [rank_data(9, np.float32)]}
+    out = mpi.allreduce(tree)
+    np.testing.assert_allclose(np.asarray(out["a"])[0],
+                               tree["a"].sum(axis=0))
+    np.testing.assert_allclose(np.asarray(out["b"][0])[3],
+                               tree["b"][0].sum(axis=0))
+
+
+def test_wrong_leading_axis(flat_runtime):
+    with pytest.raises(ValueError):
+        mpi.allreduce(np.zeros((3, 4), np.float32))
+
+
+# ---------------------------------------------------------------------------
+# Async (reference: mpi.async.* + syncHandle; SURVEY §4.4)
+# ---------------------------------------------------------------------------
+
+
+def test_async_allreduce(flat_runtime):
+    x = rank_data(256, np.float32)
+    h = mpi.async_.allreduce(x)
+    assert isinstance(h, mpi.AsyncHandle)
+    out = np.asarray(mpi.sync_handle(h))
+    np.testing.assert_allclose(out[0], x.sum(axis=0))
+    assert h.done
+
+
+def test_async_ordering_same_tensor(flat_runtime):
+    # Two async collectives chained on the same data must respect order
+    # (the reference's §4.4 correctness subtlety; JAX data deps enforce it).
+    x = rank_data(64, np.float32)
+    h1 = mpi.async_.allreduce(x)
+    h2 = mpi.async_.allreduce(h1.wait())
+    out = np.asarray(mpi.sync_handle(h2))
+    np.testing.assert_allclose(out[0], x.sum(axis=0) * N)
+
+
+def test_async_many_inflight(flat_runtime):
+    xs = [rank_data(128, np.float32) + i for i in range(6)]
+    handles = [mpi.async_.allreduce(x) for x in xs]
+    for x, h in zip(xs, handles):
+        np.testing.assert_allclose(np.asarray(h.wait())[0], x.sum(axis=0))
+
+
+# ---------------------------------------------------------------------------
+# Hierarchical backend on the 2x4 mesh (reference: custom hierarchical path)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("size", [1, 7, 128, 1000])
+def test_hier_allreduce_matches_flat(hier_runtime, size):
+    x = rank_data(size, np.float32)
+    flat = np.asarray(mpi.allreduce(x, backend="xla"))
+    hier = np.asarray(mpi.allreduce(x, backend="hierarchical"))
+    np.testing.assert_allclose(hier, flat, rtol=1e-6)
+
+
+@pytest.mark.parametrize("op", ["max", "min", "mean"])
+def test_hier_allreduce_ops(hier_runtime, op):
+    x = rank_data(96, np.float32)
+    flat = np.asarray(mpi.allreduce(x, op=op, backend="xla"))
+    hier = np.asarray(mpi.allreduce(x, op=op, backend="hierarchical"))
+    np.testing.assert_allclose(hier, flat, rtol=1e-6)
+
+
+@pytest.mark.parametrize("root", [0, 3, 5])
+def test_hier_broadcast(hier_runtime, root):
+    x = rank_data(40, np.float32)
+    out = np.asarray(mpi.broadcast(x, root=root, backend="hierarchical"))
+    for r in range(N):
+        np.testing.assert_allclose(out[r], x[root])
+
+
+@pytest.mark.parametrize("root", [0, 6])
+def test_hier_reduce(hier_runtime, root):
+    x = rank_data(40, np.float32)
+    out = np.asarray(mpi.reduce(x, root=root, backend="hierarchical"))
+    np.testing.assert_allclose(out[root], x.sum(axis=0))
+
+
+def test_hier_allgather(hier_runtime):
+    x = rank_data(12, np.float32)
+    out = np.asarray(mpi.allgather(x, backend="hierarchical"))
+    for r in range(N):
+        np.testing.assert_allclose(out[r], x)
+
+
+def test_hierarchical_config_default(hier_runtime):
+    # config.hierarchical=True routes allreduce through the 2-level path.
+    mpi.set_config(hierarchical=True, backend="hierarchical",
+                   custom_min_bytes=0)
+    x = rank_data(200, np.float32)
+    out = np.asarray(mpi.allreduce(x))
+    np.testing.assert_allclose(out[0], x.sum(axis=0), rtol=1e-6)
+
+
+def test_size_cutover_falls_back(hier_runtime):
+    # Below custom_min_bytes the selector must fall back to the stock path
+    # (the reference's size cutover constants).
+    mpi.set_config(backend="hierarchical", custom_min_bytes=1 << 20)
+    x = rank_data(8, np.float32)  # tiny
+    out = np.asarray(mpi.allreduce(x))
+    np.testing.assert_allclose(out[0], x.sum(axis=0))
+
+
+def test_hier_on_flat_mesh_falls_back(flat_runtime):
+    # 1x8 mesh: hierarchical degenerates; selector silently uses xla, like
+    # the reference when NCCL was compiled out.
+    x = rank_data(64, np.float32)
+    out = np.asarray(mpi.allreduce(x, backend="hierarchical"))
+    np.testing.assert_allclose(out[0], x.sum(axis=0))
+
+
+# ---------------------------------------------------------------------------
+# Selector introspection (reference: mpi.collectiveAvailability)
+# ---------------------------------------------------------------------------
+
+
+def test_selector_availability():
+    avail = mpi.selector.available()
+    assert "xla" in avail["allreduce"]
+    assert "hierarchical" in avail["allreduce"]
+    assert "xla" in avail["sendreceive"]
+
+
+def test_selector_unknown_op():
+    with pytest.raises(KeyError):
+        mpi.selector.select("nope", "xla")
+
+
+# ---------------------------------------------------------------------------
+# Regressions from review: cache invalidation on backend switch; op guard.
+# ---------------------------------------------------------------------------
+
+
+def test_backend_switch_after_compile(hier_runtime):
+    # Compiling the xla path must not pin later calls after set_config
+    # switches the backend (cache key includes the resolved impl).
+    x = rank_data(1000, np.float32)
+    out1 = np.asarray(mpi.allreduce(x))  # xla default
+    mpi.set_config(backend="hierarchical", custom_min_bytes=0)
+    before = len(collectives._jit_cache)
+    out2 = np.asarray(mpi.allreduce(x))  # must resolve hierarchical impl
+    assert len(collectives._jit_cache) == before + 1
+    np.testing.assert_allclose(out1, out2, rtol=1e-6)
+
+
+def test_hier_unsupported_op_raises(hier_runtime):
+    x = rank_data(1000, np.float32)
+    with pytest.raises(KeyError):
+        mpi.allreduce(x, op="prod", backend="hierarchical")
+
+
+def test_explicit_backend_bypasses_cutover(hier_runtime):
+    # Per-call backend="hierarchical" must run the 2-level path even for
+    # tiny tensors (the cutover only governs the config-driven default).
+    mpi.set_config(custom_min_bytes=1 << 30)
+    x = rank_data(4, np.float32)
+    impl = collectives._pick("allreduce", x[0], "hierarchical",
+                             mpi.world_mesh().axis_names,
+                             mesh=mpi.world_mesh())
+    from torchmpi_tpu.parallel.hierarchical import hier_allreduce
+    assert impl is hier_allreduce
+    out = np.asarray(mpi.allreduce(x, backend="hierarchical"))
+    np.testing.assert_allclose(out[0], x.sum(axis=0))
+
+
+def test_init_does_not_mutate_user_config():
+    mpi.stop()
+    cfg = mpi.Config(dcn_size=1)
+    mpi.init(cfg, hierarchical=True)
+    mpi.set_config(chunk_bytes=1)
+    assert cfg.hierarchical is False
+    assert cfg.chunk_bytes != 1
+    mpi.stop()

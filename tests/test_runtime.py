@@ -1,0 +1,86 @@
+"""Runtime/communicator tests (reference analog: implicit in every
+mpirun-launched test script; SURVEY.md §5)."""
+
+import numpy as np
+import pytest
+
+import torchmpi_tpu as mpi
+
+
+def test_init_idempotent(flat_runtime):
+    m2 = mpi.init()
+    assert m2 is mpi.world_mesh()
+    assert mpi.is_initialized()
+
+
+def test_rank_size_single_process(flat_runtime):
+    assert mpi.rank() == 0
+    assert mpi.size() == 1
+    assert mpi.device_count() == 8
+    assert mpi.local_device_count() == 8
+
+
+def test_world_mesh_axes(flat_runtime):
+    mesh = mpi.world_mesh()
+    assert mesh.axis_names == (mpi.DCN_AXIS, mpi.ICI_AXIS)
+    assert mesh.devices.shape == (1, 8)
+
+
+def test_hier_mesh_shape(hier_runtime):
+    assert mpi.world_mesh().devices.shape == (2, 4)
+
+
+def test_bad_mesh_shape():
+    mpi.stop()
+    with pytest.raises(ValueError):
+        mpi.init(mpi.Config(dcn_size=3))  # 3 does not divide 8
+    mpi.stop()
+
+
+def test_barrier(flat_runtime):
+    mpi.barrier()  # must not raise or deadlock single-process
+
+
+def test_communicator_stack(flat_runtime):
+    world = mpi.world_mesh()
+    devs = list(world.devices.flat)
+    sub = mpi.push_communicator("first_half", devices=devs[:4])
+    assert mpi.current_mesh() is sub
+    assert sub.devices.size == 4
+    mpi.pop_communicator()
+    assert mpi.current_mesh() is world
+    # Cached re-push by key (reference cached communicators per split string).
+    again = mpi.push_communicator("first_half")
+    assert again is sub
+    mpi.pop_communicator()
+    with pytest.raises(RuntimeError):
+        mpi.pop_communicator()  # cannot pop world
+
+
+def test_communicator_context_and_shape(flat_runtime):
+    with mpi.communicator("grid", shape={"a": 2, "b": 4}) as m:
+        assert m.axis_names == ("a", "b")
+        assert m.devices.shape == (2, 4)
+    assert mpi.current_mesh() is mpi.world_mesh()
+
+
+def test_set_config(flat_runtime):
+    mpi.set_config(hierarchical=True, chunk_bytes=123)
+    assert mpi.config().hierarchical
+    assert mpi.config().chunk_bytes == 123
+    with pytest.raises(ValueError):
+        mpi.set_config(nope=1)
+
+
+def test_collective_on_sub_communicator(flat_runtime):
+    devs = list(mpi.world_mesh().devices.flat)
+    with mpi.communicator("half", devices=devs[:4]):
+        x = np.arange(4, dtype=np.float32).reshape(4, 1)
+        out = np.asarray(mpi.allreduce(x))
+        np.testing.assert_allclose(out, np.full((4, 1), 6.0))
+
+
+def test_require_init():
+    mpi.stop()
+    with pytest.raises(RuntimeError):
+        mpi.current_mesh()

@@ -1,0 +1,74 @@
+"""torchmpi_tpu — a TPU-native distributed-communication library with the
+capabilities of facebookarchive/TorchMPI, rebuilt idiomatically on JAX/XLA.
+
+TorchMPI was a communication library plus two thin integration layers (``nn``
+grad sync and an async parameter server), not a trainer (SURVEY.md §1).  This
+package keeps that shape:
+
+    import torchmpi_tpu as mpi
+    mpi.init()                         # mpi.start()
+    mpi.rank(), mpi.size()             # process rank/size
+    mpi.allreduce(x)                   # mpi.allreduceTensor
+    h = mpi.async_.allreduce(x)        # mpi.async.allreduceTensor
+    mpi.sync_handle(h)                 # mpi.syncHandle
+    mpi.nn.synchronize_gradients(...)  # torchmpi.nn.synchronizeGradients
+    mpi.parameterserver.init(...)      # torchmpi.parameterserver
+    mpi.stop()
+
+(``nn`` and ``parameterserver`` are imported lazily below if present; they
+land as separate modules in this package.)
+
+Reference citations throughout are reconstructed (the reference mount was
+empty during the survey — SURVEY.md §0) and cited at file-path granularity
+with confidence tags.
+"""
+
+from .config import Config
+from .runtime import (
+    init,
+    stop,
+    is_initialized,
+    rank,
+    size,
+    local_rank,
+    device_count,
+    local_device_count,
+    barrier,
+    world_mesh,
+    current_mesh,
+    push_communicator,
+    pop_communicator,
+    communicator,
+    set_config,
+    config,
+    DCN_AXIS,
+    ICI_AXIS,
+    WORLD_AXES,
+)
+from . import collectives
+from . import selector
+from . import parallel
+from .collectives import (
+    allreduce,
+    broadcast,
+    reduce,
+    allgather,
+    reduce_scatter,
+    sendreceive,
+    alltoall,
+    async_,
+    sync_handle,
+    AsyncHandle,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Config", "init", "stop", "is_initialized", "rank", "size", "local_rank",
+    "device_count", "local_device_count", "barrier", "world_mesh",
+    "current_mesh", "push_communicator", "pop_communicator", "communicator",
+    "set_config", "config", "DCN_AXIS", "ICI_AXIS", "WORLD_AXES",
+    "collectives", "selector", "parallel", "allreduce", "broadcast", "reduce",
+    "allgather", "reduce_scatter", "sendreceive", "alltoall", "async_",
+    "sync_handle", "AsyncHandle", "__version__",
+]

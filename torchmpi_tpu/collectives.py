@@ -1,0 +1,396 @@
+"""Synchronous and asynchronous collectives on JAX arrays and pytrees.
+
+Rebuild of the reference's collective engine + Lua API surface (SURVEY.md §3
+C3/C5/C7/C9, reconstructed — reference mount empty, SURVEY.md §0):
+``allreduceTensor / broadcastTensor / reduceTensor / allgatherTensor /
+sendreceiveTensor`` plus the ``mpi.async.*`` variants and ``mpi.syncHandle``.
+
+Two usage modes:
+
+1. **In-axis mode** — functions named ``*_in_axis`` are used *inside* user
+   ``shard_map``/``jit`` code and take JAX axis names.  This is the TPU-native
+   hot path: the collective compiles into the surrounding step (the analog of
+   the reference's C functions called from the training loop).
+
+2. **Eager rank-major mode** — functions named like the reference
+   (``allreduce(x)``) take an array whose leading axis is the "rank" axis
+   (length = device count of the current communicator mesh).  Slice ``i`` is
+   rank ``i``'s tensor; the result has the same leading axis holding each
+   rank's output buffer.  This mirrors TorchMPI's per-rank tensor semantics
+   exactly and is what the correctness tests sweep (SURVEY.md §5).
+
+Async: XLA dispatch is already asynchronous — an eager collective returns as
+soon as the computation is enqueued.  ``async_*`` therefore returns an
+:class:`AsyncHandle` immediately; ``sync_handle`` blocks (the analog of the
+reference's thread-pool handles + ``torchmpi_sync_handle``).  Ordering of two
+async collectives touching the same buffer is preserved by JAX data
+dependencies (the reference had to enforce this manually across streams —
+SURVEY.md §4.4).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from . import runtime, selector
+
+AxisNames = Union[str, Tuple[str, ...]]
+
+_REDUCERS = {
+    "sum": lax.psum,
+    "mean": lax.pmean,
+    "max": lax.pmax,
+    "min": lax.pmin,
+}
+
+
+def _axes_tuple(axis_names: AxisNames) -> Tuple[str, ...]:
+    return (axis_names,) if isinstance(axis_names, str) else tuple(axis_names)
+
+
+# ---------------------------------------------------------------------------
+# Stock XLA implementations (the reference's "mpi"/"nccl" analog: SURVEY C3).
+# Each takes per-device values + axis names; must be traceable under jit.
+# ---------------------------------------------------------------------------
+
+
+def _xla_allreduce(x, axis_names, *, op="sum"):
+    return _REDUCERS[op](x, _axes_tuple(axis_names))
+
+
+def _xla_broadcast(x, axis_names, *, root=0):
+    axes = _axes_tuple(axis_names)
+    r = lax.axis_index(axes)
+    masked = jnp.where(r == root, x, jnp.zeros_like(x))
+    return lax.psum(masked, axes)
+
+
+def _xla_reduce(x, axis_names, *, root=0, op="sum"):
+    axes = _axes_tuple(axis_names)
+    s = _REDUCERS[op](x, axes)
+    r = lax.axis_index(axes)
+    # Non-root ranks keep their input, as the reference's MPI_Reduce left
+    # non-root buffers untouched.
+    return jnp.where(r == root, s, x)
+
+
+def _xla_allgather(x, axis_names):
+    return lax.all_gather(x, _axes_tuple(axis_names), axis=0, tiled=False)
+
+
+def _xla_reduce_scatter(x, axis_names, *, op="sum"):
+    assert op == "sum", "reduce_scatter supports sum"
+    return lax.psum_scatter(x, _axes_tuple(axis_names), scatter_dimension=0,
+                            tiled=True)
+
+
+def _xla_sendreceive(x, axis_names, *, src=0, dst=1):
+    axes = _axes_tuple(axis_names)
+    recv = lax.ppermute(x, axes, perm=[(src, dst)])
+    r = lax.axis_index(axes)
+    return jnp.where(r == dst, recv, x)
+
+
+def _xla_alltoall(x, axis_names, *, split_axis=0, concat_axis=0):
+    return lax.all_to_all(x, _axes_tuple(axis_names), split_axis=split_axis,
+                          concat_axis=concat_axis, tiled=True)
+
+
+for _op, _fn in [
+    ("allreduce", _xla_allreduce),
+    ("broadcast", _xla_broadcast),
+    ("reduce", _xla_reduce),
+    ("allgather", _xla_allgather),
+    ("reduce_scatter", _xla_reduce_scatter),
+    ("sendreceive", _xla_sendreceive),
+    ("alltoall", _xla_alltoall),
+]:
+    selector.register(_op, "xla", _fn)
+
+
+# ---------------------------------------------------------------------------
+# In-axis public API: selector-routed, usable inside shard_map/jit.
+# ---------------------------------------------------------------------------
+
+
+def _pick(op_name: str, x, backend: Optional[str], axes: Tuple[str, ...],
+          mesh: Optional[Mesh] = None):
+    explicit = backend is not None
+    if runtime.is_initialized():
+        cfg = runtime.config()
+        backend = backend or (
+            "hierarchical" if cfg.hierarchical else cfg.backend)
+        custom_min = cfg.custom_min_bytes
+    else:
+        backend = backend or "xla"
+        custom_min = 0
+    # Hierarchical staging only helps when the outer axis really spans more
+    # than one slice; use the actual mesh extent, not the axis-name count.
+    n_dcn = 1
+    if len(axes) > 1:
+        m = mesh
+        if m is None and runtime.is_initialized():
+            m = runtime.current_mesh()
+        n_dcn = int(m.shape[axes[0]]) if (m is not None
+                                          and axes[0] in m.shape) else 2
+    return selector.select(
+        op_name,
+        backend,
+        nbytes=selector.nbytes_of(x),
+        custom_min_bytes=custom_min,
+        n_dcn=n_dcn,
+        explicit=explicit,
+    )
+
+
+def allreduce_in_axis(x, axis_names: AxisNames, *, op: str = "sum",
+                      backend: Optional[str] = None):
+    """Allreduce across mesh axes; for use inside shard_map (hot path)."""
+    axes = _axes_tuple(axis_names)
+    return jax.tree.map(lambda v: _pick("allreduce", v, backend, axes)(
+        v, axes, op=op), x)
+
+
+def broadcast_in_axis(x, axis_names: AxisNames, *, root: int = 0,
+                      backend: Optional[str] = None):
+    axes = _axes_tuple(axis_names)
+    return jax.tree.map(lambda v: _pick("broadcast", v, backend, axes)(
+        v, axes, root=root), x)
+
+
+def reduce_in_axis(x, axis_names: AxisNames, *, root: int = 0, op: str = "sum",
+                   backend: Optional[str] = None):
+    axes = _axes_tuple(axis_names)
+    return jax.tree.map(lambda v: _pick("reduce", v, backend, axes)(
+        v, axes, root=root, op=op), x)
+
+
+def allgather_in_axis(x, axis_names: AxisNames, *,
+                      backend: Optional[str] = None):
+    axes = _axes_tuple(axis_names)
+    return jax.tree.map(lambda v: _pick("allgather", v, backend, axes)(
+        v, axes), x)
+
+
+def reduce_scatter_in_axis(x, axis_names: AxisNames, *, op: str = "sum",
+                           backend: Optional[str] = None):
+    axes = _axes_tuple(axis_names)
+    return jax.tree.map(lambda v: _pick("reduce_scatter", v, backend, axes)(
+        v, axes, op=op), x)
+
+
+def sendreceive_in_axis(x, axis_names: AxisNames, *, src: int, dst: int,
+                        backend: Optional[str] = None):
+    axes = _axes_tuple(axis_names)
+    return jax.tree.map(lambda v: _pick("sendreceive", v, backend, axes)(
+        v, axes, src=src, dst=dst), x)
+
+
+def alltoall_in_axis(x, axis_names: AxisNames, *, split_axis: int = 0,
+                     concat_axis: int = 0, backend: Optional[str] = None):
+    axes = _axes_tuple(axis_names)
+    return jax.tree.map(lambda v: _pick("alltoall", v, backend, axes)(
+        v, axes, split_axis=split_axis, concat_axis=concat_axis), x)
+
+
+# ---------------------------------------------------------------------------
+# Eager rank-major mode (TorchMPI tensor semantics; tests + micro-bench).
+# Compiled executables are cached per (op, mesh, backend, shape, dtype,
+# params) — the analog of the reference's resource cache (SURVEY §8.4.5).
+# ---------------------------------------------------------------------------
+
+_jit_cache: Dict[Any, Any] = {}
+
+
+def clear_cache() -> None:
+    _jit_cache.clear()
+
+
+def _mesh_and_n(mesh: Optional[Mesh]) -> Tuple[Mesh, int]:
+    m = mesh if mesh is not None else runtime.current_mesh()
+    return m, int(m.devices.size)
+
+
+def _eager_collective(op_name: str, x, *, mesh: Optional[Mesh] = None,
+                      backend: Optional[str] = None, **params):
+    m, n = _mesh_and_n(mesh)
+    x = jnp.asarray(x)
+    if x.ndim < 1 or x.shape[0] != n:
+        raise ValueError(
+            f"{op_name}: leading (rank) axis must have length {n} "
+            f"(the current communicator size); got shape {x.shape}"
+        )
+    axes = m.axis_names
+    # Resolve the implementation *before* the cache lookup: the key must
+    # include the resolved impl, or runtime set_config() backend switches
+    # would silently reuse a stale executable.
+    impl = _pick(op_name, x[0], backend, axes, mesh=m)
+    key = (op_name, m, impl, x.shape, x.dtype.name,
+           tuple(sorted(params.items())))
+    fn = _jit_cache.get(key)
+    if fn is None:
+
+        def body(xs):
+            y = impl(xs[0], axes, **params)
+            return y[None]
+
+        lead = P(axes)
+        out_spec = lead
+        in_spec = lead
+
+        shmapped = shard_map(body, mesh=m, in_specs=(in_spec,),
+                             out_specs=out_spec)
+        fn = jax.jit(shmapped)
+        _jit_cache[key] = fn
+    sharding = NamedSharding(m, P(m.axis_names))
+    x = jax.device_put(x, sharding)
+    return fn(x)
+
+
+def allreduce(x, *, op: str = "sum", mesh: Optional[Mesh] = None,
+              backend: Optional[str] = None):
+    """Reference: ``mpi.allreduceTensor``.  ``x[i]`` is rank i's tensor; every
+    slice of the result equals the reduction over ranks.  Works on pytrees."""
+    return jax.tree.map(
+        lambda v: _eager_collective("allreduce", v, mesh=mesh, backend=backend,
+                                    op=op), x)
+
+
+def broadcast(x, *, root: int = 0, mesh: Optional[Mesh] = None,
+              backend: Optional[str] = None):
+    """Reference: ``mpi.broadcastTensor(root, t)``."""
+    return jax.tree.map(
+        lambda v: _eager_collective("broadcast", v, mesh=mesh, backend=backend,
+                                    root=root), x)
+
+
+def reduce(x, *, root: int = 0, op: str = "sum", mesh: Optional[Mesh] = None,
+           backend: Optional[str] = None):
+    """Reference: ``mpi.reduceTensor(root, t)``; non-root slices unchanged."""
+    return jax.tree.map(
+        lambda v: _eager_collective("reduce", v, mesh=mesh, backend=backend,
+                                    root=root, op=op), x)
+
+
+def allgather(x, *, mesh: Optional[Mesh] = None,
+              backend: Optional[str] = None):
+    """Reference: ``mpi.allgatherTensor``.  Result slice i is the stack of all
+    ranks' tensors: shape ``[n_ranks, n_ranks, ...]``."""
+    return jax.tree.map(
+        lambda v: _eager_collective("allgather", v, mesh=mesh,
+                                    backend=backend), x)
+
+
+def reduce_scatter(x, *, mesh: Optional[Mesh] = None,
+                   backend: Optional[str] = None):
+    """Rank i's slice of the result is shard i of the summed tensor (the
+    building block of the hierarchical allreduce)."""
+    return jax.tree.map(
+        lambda v: _eager_collective("reduce_scatter", v, mesh=mesh,
+                                    backend=backend), x)
+
+
+def sendreceive(x, *, src: int, dst: int, mesh: Optional[Mesh] = None,
+                backend: Optional[str] = None):
+    """Reference: ``mpi.sendreceiveTensor``: rank ``dst`` receives rank
+    ``src``'s tensor; everyone else keeps theirs."""
+    return jax.tree.map(
+        lambda v: _eager_collective("sendreceive", v, mesh=mesh,
+                                    backend=backend, src=src, dst=dst), x)
+
+
+def alltoall(x, *, mesh: Optional[Mesh] = None, backend: Optional[str] = None):
+    """All-to-all over the rank axis (not in the reference's public Lua API
+    but present in MPI; needed later for sequence parallelism)."""
+    return jax.tree.map(
+        lambda v: _eager_collective("alltoall", v, mesh=mesh, backend=backend,
+                                    split_axis=0, concat_axis=0), x)
+
+
+# ---------------------------------------------------------------------------
+# Async facade (reference: mpi.async.* + syncHandle; SURVEY C7 / §4.4).
+# ---------------------------------------------------------------------------
+
+
+class AsyncHandle:
+    """Opaque handle for an in-flight collective.
+
+    JAX has already enqueued the computation; ``wait()`` blocks until device
+    buffers are ready and returns them.  Mirrors the reference's future
+    indices returned by ``torchmpi_async_*``.
+    """
+
+    __slots__ = ("_value", "_done")
+
+    def __init__(self, value):
+        self._value = value
+        self._done = False
+
+    def wait(self):
+        if not self._done:
+            jax.block_until_ready(self._value)
+            self._done = True
+        return self._value
+
+    @property
+    def done(self) -> bool:
+        if self._done:
+            return True
+        try:
+            ready = all(
+                leaf.is_ready() if hasattr(leaf, "is_ready") else True
+                for leaf in jax.tree.leaves(self._value)
+            )
+        except Exception:
+            ready = False
+        if ready:
+            self._done = True
+        return self._done
+
+
+def sync_handle(handle: AsyncHandle):
+    """Reference: ``mpi.syncHandle(h)``."""
+    return handle.wait()
+
+
+class _AsyncNamespace:
+    """``collectives.async_.allreduce(x)`` -> AsyncHandle (reference:
+    ``mpi.async.allreduceTensor``)."""
+
+    @staticmethod
+    def allreduce(x, **kw) -> AsyncHandle:
+        return AsyncHandle(allreduce(x, **kw))
+
+    @staticmethod
+    def broadcast(x, **kw) -> AsyncHandle:
+        return AsyncHandle(broadcast(x, **kw))
+
+    @staticmethod
+    def reduce(x, **kw) -> AsyncHandle:
+        return AsyncHandle(reduce(x, **kw))
+
+    @staticmethod
+    def allgather(x, **kw) -> AsyncHandle:
+        return AsyncHandle(allgather(x, **kw))
+
+    @staticmethod
+    def reduce_scatter(x, **kw) -> AsyncHandle:
+        return AsyncHandle(reduce_scatter(x, **kw))
+
+    @staticmethod
+    def sendreceive(x, **kw) -> AsyncHandle:
+        return AsyncHandle(sendreceive(x, **kw))
+
+    @staticmethod
+    def alltoall(x, **kw) -> AsyncHandle:
+        return AsyncHandle(alltoall(x, **kw))
+
+
+async_ = _AsyncNamespace()

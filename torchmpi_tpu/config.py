@@ -1,0 +1,123 @@
+"""Runtime configuration for torchmpi_tpu.
+
+The reference exposed three knob mechanisms (SURVEY.md §6.6, reconstructed from
+facebookarchive/TorchMPI — reference mount empty, see SURVEY.md §0): arguments to
+``mpi.start``, C-level setters (``torchmpi_set_{flat,hierarchical}_collectives``,
+``torchmpi_set_{staged,direct}_collectives``, chunk-size setters), and the Lua
+``collectiveSelector`` table.  Here all of that collapses into one dataclass plus
+environment-variable overrides, while keeping the reference's key property that
+implementations are *runtime-switchable* (benchmarks compare them).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+
+def _env_bool(name: str, default: bool) -> bool:
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v.strip().lower() in ("1", "true", "yes", "on")
+
+
+def _env_int(name: str, default: int) -> int:
+    v = os.environ.get(name)
+    return int(v) if v is not None else default
+
+
+def _env_str(name: str, default: str) -> str:
+    return os.environ.get(name, default)
+
+
+@dataclasses.dataclass
+class Config:
+    """All runtime knobs.
+
+    Attributes mirror the reference's setters:
+
+    - ``hierarchical``  <-> torchmpi_set_{flat,hierarchical}_collectives
+    - ``backend``       <-> mpi.collectiveSelector ({mpi,nccl,gloo,p2p} ->
+                            {"xla","hierarchical","pallas"})
+    - ``chunk_bytes``   <-> torchmpi_set_*_buffer_size / chunk setters; used by the
+                            chunked Pallas ring collective and PS staging.
+    """
+
+    # --- topology -----------------------------------------------------------
+    # Number of devices along the inner (ICI, intra-slice) mesh axis.  None =
+    # auto: local device count for a single process; all devices for one slice.
+    ici_size: Optional[int] = None
+    # Number of slices / outer (DCN) axis.  None = auto (process count // hosts
+    # per slice, or 1).
+    dcn_size: Optional[int] = None
+    # Use GPU/TPU devices if available (mirrors mpi.start(withCuda)).
+    use_accelerator: bool = True
+
+    # --- collective implementation selection -------------------------------
+    # Default backend for collectives: "xla" (stock, = reference's mpi/nccl
+    # path), "hierarchical" (2-level ICI+DCN, = reference's custom
+    # hierarchical path), "pallas" (chunked ring kernels, = reference's custom
+    # chunked/pipelined path).
+    backend: str = "xla"
+    # Flat vs hierarchical collectives (reference: torchmpi_set_flat/
+    # hierarchical_collectives).  When True, allreduce over a 2-level mesh is
+    # staged: reduce_scatter(ici) -> allreduce(dcn) -> all_gather(ici).
+    hierarchical: bool = False
+    # Chunk size in bytes for chunked/pipelined custom collectives.
+    chunk_bytes: int = 4 * 1024 * 1024
+    # Tensors smaller than this stay on the stock path even when a custom
+    # backend is selected (the reference had size cutover constants).
+    custom_min_bytes: int = 64 * 1024
+
+    # --- gradient synchronization ------------------------------------------
+    # Number of buckets for bucketed/overlapped gradient allreduce.
+    gradsync_buckets: int = 1
+    # Average (pmean) instead of sum (psum) in synchronize_gradients.
+    gradsync_average: bool = True
+
+    # --- parameter server ---------------------------------------------------
+    ps_port: int = 52312
+    ps_host: str = "127.0.0.1"
+    ps_num_threads: int = 2
+
+    # --- distributed bring-up ----------------------------------------------
+    coordinator_address: Optional[str] = None
+    num_processes: Optional[int] = None
+    process_id: Optional[int] = None
+
+    @staticmethod
+    def from_env(**overrides) -> "Config":
+        """Build a Config from ``TORCHMPI_TPU_*`` environment variables.
+
+        Env overrides (reference analog: FFI setters callable at any time):
+          TORCHMPI_TPU_BACKEND, TORCHMPI_TPU_HIERARCHICAL,
+          TORCHMPI_TPU_CHUNK_BYTES, TORCHMPI_TPU_GRADSYNC_BUCKETS,
+          TORCHMPI_TPU_PS_PORT, TORCHMPI_TPU_ICI_SIZE, TORCHMPI_TPU_DCN_SIZE
+        """
+        cfg = Config(
+            backend=_env_str("TORCHMPI_TPU_BACKEND", "xla"),
+            hierarchical=_env_bool("TORCHMPI_TPU_HIERARCHICAL", False),
+            chunk_bytes=_env_int("TORCHMPI_TPU_CHUNK_BYTES", 4 * 1024 * 1024),
+            custom_min_bytes=_env_int("TORCHMPI_TPU_CUSTOM_MIN_BYTES", 64 * 1024),
+            gradsync_buckets=_env_int("TORCHMPI_TPU_GRADSYNC_BUCKETS", 1),
+            gradsync_average=_env_bool("TORCHMPI_TPU_GRADSYNC_AVERAGE", True),
+            ps_port=_env_int("TORCHMPI_TPU_PS_PORT", 52312),
+            ps_host=_env_str("TORCHMPI_TPU_PS_HOST", "127.0.0.1"),
+            ps_num_threads=_env_int("TORCHMPI_TPU_PS_THREADS", 2),
+        )
+        ici = os.environ.get("TORCHMPI_TPU_ICI_SIZE")
+        if ici is not None:
+            cfg.ici_size = int(ici)
+        dcn = os.environ.get("TORCHMPI_TPU_DCN_SIZE")
+        if dcn is not None:
+            cfg.dcn_size = int(dcn)
+        for k, v in overrides.items():
+            if not hasattr(cfg, k):
+                raise ValueError(f"unknown config field {k!r}")
+            setattr(cfg, k, v)
+        return cfg
+
+    def replace(self, **kw) -> "Config":
+        return dataclasses.replace(self, **kw)

@@ -1,0 +1,4 @@
+"""Parallelism strategies: hierarchical collectives, gradient sync, parameter
+server.  See SURVEY.md §3.3 for the strategy inventory this mirrors."""
+
+from . import hierarchical  # noqa: F401  (registers the "hierarchical" backend)

@@ -1,0 +1,115 @@
+"""Hierarchical (two-level) collectives: ICI intra-slice + DCN inter-slice.
+
+Rebuild of the reference's custom hierarchical algorithms (SURVEY.md §3 C4,
+§4.2, reconstructed — reference mount empty): intra-node reduce (shm/CUDA-IPC)
+-> inter-node allreduce (MPI) -> intra-node broadcast, chunk-pipelined.  The
+TPU mapping (SURVEY.md §6.8): intra-node -> the ``ici`` mesh axis, inter-node
+-> the ``dcn`` mesh axis.
+
+The bandwidth-optimal staging on TPU is:
+
+    reduce_scatter over ICI  ->  allreduce over DCN (on 1/ici_n of the data)
+    ->  all_gather over ICI
+
+which sends only ``1/ici_n`` of the tensor over the slow DCN links per chip —
+the same reason the reference reduced intra-node first.  XLA overlaps the
+per-shard DCN transfer with ICI work where it can, playing the role of the
+reference's hand-rolled chunk pipelining.
+
+These functions register with the selector as backend ``"hierarchical"`` and
+expect exactly two mesh axes ``(outer/dcn, inner/ici)``.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .. import selector
+
+_REDUCERS = {"sum": lax.psum, "mean": lax.pmean, "max": lax.pmax,
+             "min": lax.pmin}
+
+
+def _check_axes(axis_names) -> Tuple[str, str]:
+    axes = (axis_names,) if isinstance(axis_names, str) else tuple(axis_names)
+    if len(axes) != 2:
+        raise ValueError(
+            f"hierarchical collectives need (outer, inner) axes, got {axes}"
+        )
+    return axes[0], axes[1]
+
+
+def _global_rank(outer: str, inner: str):
+    return lax.axis_index(outer) * lax.axis_size(inner) + lax.axis_index(inner)
+
+
+def hier_allreduce(x, axis_names, *, op: str = "sum"):
+    """reduce_scatter(ici) -> allreduce(dcn) -> all_gather(ici)."""
+    outer, inner = _check_axes(axis_names)
+    if op in ("max", "min"):
+        f = _REDUCERS[op]
+        return f(f(x, inner), outer)
+    if op not in ("sum", "mean"):
+        raise KeyError(f"hierarchical allreduce does not support op {op!r}")
+    n_inner = lax.axis_size(inner)
+    shape = x.shape
+    flat = x.reshape(-1)
+    pad = (-flat.shape[0]) % n_inner
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    # Stage 1: each ICI neighbor ends with its 1/n_inner shard of the ICI sum.
+    shard = lax.psum_scatter(flat, inner, scatter_dimension=0, tiled=True)
+    # Stage 2: allreduce the small shard across slices over DCN.
+    shard = lax.psum(shard, outer)
+    # Stage 3: regather the full tensor over ICI.
+    full = lax.all_gather(shard, inner, axis=0, tiled=True)
+    if pad:
+        full = full[: full.shape[0] - pad]
+    out = full.reshape(shape)
+    if op == "mean":
+        out = out / (lax.axis_size(outer) * n_inner)
+    return out
+
+
+def hier_broadcast(x, axis_names, *, root: int = 0):
+    """Two-stage broadcast: DCN stage then ICI stage (the reverse order of the
+    reference's reduce, same tree)."""
+    outer, inner = _check_axes(axis_names)
+    n_inner = lax.axis_size(inner)
+    root_outer, root_inner = root // n_inner, root % n_inner
+    # Stage 1 (DCN): along each ICI position, take the value from slice
+    # root_outer.
+    masked = jnp.where(lax.axis_index(outer) == root_outer, x,
+                       jnp.zeros_like(x))
+    x = lax.psum(masked, outer)
+    # Stage 2 (ICI): within every slice, take position root_inner's value.
+    masked = jnp.where(lax.axis_index(inner) == root_inner, x,
+                       jnp.zeros_like(x))
+    return lax.psum(masked, inner)
+
+
+def hier_reduce(x, axis_names, *, root: int = 0, op: str = "sum"):
+    outer, inner = _check_axes(axis_names)
+    f = _REDUCERS[op]
+    total = f(f(x, inner), outer)
+    r = _global_rank(outer, inner)
+    return jnp.where(r == root, total, x)
+
+
+def hier_allgather(x, axis_names):
+    """all_gather(ici) then all_gather(dcn); global rank order is
+    dcn-major * ici, matching the world mesh layout."""
+    outer, inner = _check_axes(axis_names)
+    inner_g = lax.all_gather(x, inner, axis=0, tiled=False)
+    both = lax.all_gather(inner_g, outer, axis=0, tiled=False)
+    return both.reshape((-1,) + x.shape)
+
+
+selector.register("allreduce", "hierarchical", hier_allreduce)
+selector.register("broadcast", "hierarchical", hier_broadcast)
+selector.register("reduce", "hierarchical", hier_reduce)
+selector.register("allgather", "hierarchical", hier_allgather)

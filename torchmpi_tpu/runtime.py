@@ -1,0 +1,307 @@
+"""Process/runtime management and the communicator (mesh) stack.
+
+TPU-native rebuild of the reference's C1 runtime (``lib/torch_mpi.cpp``,
+reconstructed — reference mount empty, SURVEY.md §0/§3) and C2 resource manager
+(``lib/resources.cpp``): ``mpi.start/stop/rank/size/barrier`` plus the
+communicator tree (world / intra-node / inter-node / user splits).
+
+Mapping to TPU (SURVEY.md §6.8):
+
+- ``MPI_Init`` under mpirun        -> ``jax.distributed.initialize`` from slice
+                                      metadata (or single-process).
+- intra-node communicator (shm/IPC/NCCL) -> the ``ici`` mesh axis (intra-slice
+                                      interconnect; XLA collectives ride it).
+- inter-node communicator (MPI)    -> the ``dcn`` mesh axis (inter-slice).
+- ``push_communicator(key)`` splits -> named sub-``Mesh`` stack, cached by key.
+
+Nothing above this module touches raw device lists — the same invariant the
+reference kept for raw ``MPI_Comm`` (SURVEY.md §2 L1).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from .config import Config
+
+# Canonical axis names for the two-level communicator tree.
+DCN_AXIS = "dcn"  # outer: inter-slice / inter-node (reference: interComm)
+ICI_AXIS = "ici"  # inner: intra-slice interconnect (reference: intraComm)
+WORLD_AXES = (DCN_AXIS, ICI_AXIS)
+
+
+class _State:
+    """Module-level singleton, the analog of the reference's global C state."""
+
+    def __init__(self) -> None:
+        self.lock = threading.Lock()
+        self.initialized = False
+        self.config: Config = Config()
+        self.devices: List[jax.Device] = []
+        # Stack of (name, Mesh); bottom is always ("world", world_mesh).
+        self.mesh_stack: List[Tuple[str, Mesh]] = []
+        # Cache of user split meshes keyed by name (reference: communicator
+        # cache keyed by the push string).
+        self.mesh_cache: Dict[str, Mesh] = {}
+        self.distributed_initialized = False
+
+
+_state = _State()
+
+
+def _build_world_mesh(cfg: Config, devices: Sequence[jax.Device]) -> Mesh:
+    """Build the 2-level (dcn, ici) world mesh.
+
+    Auto shape: ``dcn`` = number of processes when it divides the device count
+    (each process' local devices share fast interconnect — the analog of the
+    reference splitting MPI_COMM_WORLD by hostname), else 1; ``ici`` = rest.
+    ``cfg.ici_size``/``cfg.dcn_size`` override (used by tests to emulate a
+    multi-slice topology on a flat 8-device CPU mesh).
+    """
+    n = len(devices)
+    dcn = cfg.dcn_size
+    ici = cfg.ici_size
+    if dcn is None and ici is None:
+        nproc = jax.process_count()
+        dcn = nproc if nproc > 1 and n % nproc == 0 else 1
+        ici = n // dcn
+    elif dcn is None:
+        assert ici is not None
+        if n % ici != 0:
+            raise ValueError(f"ici_size={ici} does not divide device count {n}")
+        dcn = n // ici
+    elif ici is None:
+        if n % dcn != 0:
+            raise ValueError(f"dcn_size={dcn} does not divide device count {n}")
+        ici = n // dcn
+    if dcn * ici != n:
+        raise ValueError(
+            f"mesh shape dcn={dcn} x ici={ici} != device count {n}"
+        )
+    dev_array = np.asarray(devices).reshape(dcn, ici)
+    return Mesh(dev_array, WORLD_AXES)
+
+
+def init(config: Optional[Config] = None, **overrides) -> Mesh:
+    """Start the runtime (reference: ``mpi.start(withCuda)`` -> torchmpi_start).
+
+    Idempotent.  Returns the world mesh.  Unlike the reference there is no
+    mpirun: on a multi-host TPU slice, ``jax.distributed.initialize`` picks up
+    topology from the TPU metadata environment; single-process (tests, one
+    chip) needs no bring-up at all.
+    """
+    with _state.lock:
+        if _state.initialized:
+            return _state.mesh_stack[0][1]
+        # Copy so later set_config() calls never mutate the caller's object.
+        cfg = Config.from_env() if config is None else dataclasses.replace(config)
+        for k, v in overrides.items():
+            if not hasattr(cfg, k):
+                raise ValueError(f"unknown config field {k!r}")
+            setattr(cfg, k, v)
+
+        # Multi-process bring-up (reference: MPI_Init_thread under mpirun).
+        if cfg.coordinator_address is not None and not _state.distributed_initialized:
+            jax.distributed.initialize(
+                coordinator_address=cfg.coordinator_address,
+                num_processes=cfg.num_processes,
+                process_id=cfg.process_id,
+            )
+            _state.distributed_initialized = True
+
+        _state.config = cfg
+        _state.devices = list(jax.devices())
+        world = _build_world_mesh(cfg, _state.devices)
+        _state.mesh_stack = [("world", world)]
+        _state.mesh_cache = {"world": world}
+        _state.initialized = True
+        return world
+
+
+def stop() -> None:
+    """Tear down (reference: ``mpi.stop`` -> torchmpi_stop -> MPI_Finalize)."""
+    with _state.lock:
+        _state.initialized = False
+        _state.mesh_stack = []
+        _state.mesh_cache = {}
+    from . import collectives
+
+    collectives.clear_cache()
+
+
+def is_initialized() -> bool:
+    return _state.initialized
+
+
+def _require_init() -> None:
+    if not _state.initialized:
+        raise RuntimeError(
+            "torchmpi_tpu runtime not initialized; call torchmpi_tpu.init() first "
+            "(the reference raised the same way when mpi.start() was skipped)"
+        )
+
+
+def config() -> Config:
+    return _state.config
+
+
+def set_config(**kw) -> None:
+    """Runtime-switch knobs (reference: the torchmpi_set_* FFI setters)."""
+    _require_init()
+    for k, v in kw.items():
+        if not hasattr(_state.config, k):
+            raise ValueError(f"unknown config field {k!r}")
+        setattr(_state.config, k, v)
+
+
+# --- rank/size family -------------------------------------------------------
+# TorchMPI's rank was a per-*process* concept (one process per GPU).  Under
+# JAX SPMD one process drives many devices, so both granularities are exposed:
+# process-level (data loading, logging, PS clients) and device-level (inside
+# shard_map, via jax.lax.axis_index).
+
+
+def rank() -> int:
+    """Process rank (reference: ``mpi.rank()``)."""
+    return jax.process_index()
+
+
+def size() -> int:
+    """Process count (reference: ``mpi.size()``)."""
+    return jax.process_count()
+
+
+def local_rank() -> int:
+    """Rank within the host.  The reference used localRank % numDevices for
+    GPU binding; JAX binds devices per process itself, so this is
+    informational."""
+    return 0 if jax.process_count() == 1 else jax.process_index() % max(
+        1, jax.process_count() // max(1, _num_hosts())
+    )
+
+
+def _num_hosts() -> int:
+    try:
+        hosts = {d.host_id if hasattr(d, "host_id") else d.process_index
+                 for d in jax.devices()}
+        return max(1, len(hosts))
+    except Exception:
+        return 1
+
+
+def device_count() -> int:
+    """Total device (chip) count across all processes."""
+    _require_init()
+    return len(_state.devices)
+
+
+def local_device_count() -> int:
+    return jax.local_device_count()
+
+
+def barrier(name: str = "torchmpi_tpu_barrier") -> None:
+    """Global barrier (reference: ``mpi.barrier()`` -> MPI_Barrier).
+
+    Implemented as a tiny fully-replicated psum across every device — the
+    devices *are* the processes' gang, so completion implies every process
+    reached the barrier.
+    """
+    _require_init()
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(name)
+    else:
+        jax.block_until_ready(jax.device_put(np.zeros(())))
+
+
+# --- communicator (mesh) stack ---------------------------------------------
+
+
+def world_mesh() -> Mesh:
+    _require_init()
+    return _state.mesh_stack[0][1]
+
+
+def current_mesh() -> Mesh:
+    """Innermost pushed communicator (reference: the active communicator the
+    collectives resolved against)."""
+    _require_init()
+    return _state.mesh_stack[-1][1]
+
+
+def current_mesh_name() -> str:
+    _require_init()
+    return _state.mesh_stack[-1][0]
+
+
+def push_communicator(
+    key: str,
+    *,
+    devices: Optional[Sequence[jax.Device]] = None,
+    shape: Optional[Dict[str, int]] = None,
+) -> Mesh:
+    """Push a named communicator scope (reference: user-defined communicator
+    splits keyed by a string, SURVEY.md §1 cap.6).
+
+    - ``devices``: explicit subset (1-D mesh named ``ici``) or, with ``shape``,
+      reshaped into the given named axes.
+    - ``shape``: dict axis-name -> size over the *current* mesh's devices
+      (or over ``devices`` when given).
+    - Neither: re-push of a cached mesh under ``key`` (must exist).
+
+    Meshes are cached by key, like the reference cached communicators per
+    split string.
+    """
+    _require_init()
+    with _state.lock:
+        if devices is None and shape is None:
+            if key not in _state.mesh_cache:
+                raise KeyError(f"no cached communicator {key!r}")
+            mesh = _state.mesh_cache[key]
+        else:
+            devs = list(devices) if devices is not None else list(
+                _state.mesh_stack[-1][1].devices.flat
+            )
+            if shape is None:
+                mesh = Mesh(np.asarray(devs), (ICI_AXIS,))
+            else:
+                axes = tuple(shape.keys())
+                sizes = tuple(shape.values())
+                if int(np.prod(sizes)) != len(devs):
+                    raise ValueError(
+                        f"shape {shape} does not cover {len(devs)} devices"
+                    )
+                mesh = Mesh(np.asarray(devs).reshape(sizes), axes)
+            _state.mesh_cache[key] = mesh
+        _state.mesh_stack.append((key, mesh))
+        return mesh
+
+
+def pop_communicator() -> None:
+    _require_init()
+    with _state.lock:
+        if len(_state.mesh_stack) <= 1:
+            raise RuntimeError("cannot pop the world communicator")
+        _state.mesh_stack.pop()
+
+
+class communicator:
+    """Context manager: ``with runtime.communicator("half", shape={...}):``"""
+
+    def __init__(self, key: str, **kw) -> None:
+        self._key = key
+        self._kw = kw
+
+    def __enter__(self) -> Mesh:
+        return push_communicator(self._key, **self._kw)
+
+    def __exit__(self, *exc) -> None:
+        pop_communicator()
+        return None

@@ -1,0 +1,85 @@
+"""Collective implementation selector.
+
+Rebuild of the reference's ``mpi.collectiveSelector`` (SURVEY.md §3 C9,
+reconstructed — reference mount empty): a runtime-switchable table that picked
+an implementation per (cpu|gpu) x (singlenode|multinode) among
+{mpi, nccl, gloo, p2p/custom}.  On TPU the discriminators become the mesh
+topology and tensor size, and the implementations become:
+
+- ``"xla"``          stock XLA collectives over the whole mesh (the mpi/nccl
+                     analog; XLA's allreduce is the tuned vendor path).
+- ``"hierarchical"`` explicit two-level staging: reduce_scatter over ICI ->
+                     allreduce over DCN -> all_gather over ICI (the analog of
+                     the reference's custom hierarchical intra-node reduce ->
+                     inter-node allreduce -> intra-node broadcast).
+- ``"pallas"``       hand-written chunked ring kernels over ICI remote DMA
+                     (the analog of the reference's custom chunked/pipelined
+                     MPI_Isend/Irecv rings).
+
+Backends self-register; lookup is by name with size-cutover logic mirroring the
+reference's "small tensors stay on the stock path" constants.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+# op name -> backend name -> implementation fn.  Implementation signature is
+# op-specific; see collectives.py _IN_AXIS_OPS.
+_REGISTRY: Dict[str, Dict[str, Callable]] = {}
+
+
+def register(op: str, backend: str, fn: Callable) -> None:
+    _REGISTRY.setdefault(op, {})[backend] = fn
+
+
+def available(op: Optional[str] = None) -> Dict:
+    """Introspection (reference: ``mpi.collectiveAvailability``)."""
+    if op is not None:
+        return dict(_REGISTRY.get(op, {}))
+    return {k: sorted(v.keys()) for k, v in _REGISTRY.items()}
+
+
+def select(
+    op: str,
+    backend: str,
+    *,
+    nbytes: Optional[int] = None,
+    custom_min_bytes: int = 0,
+    n_dcn: int = 1,
+    explicit: bool = False,
+) -> Callable:
+    """Pick the implementation for ``op``.
+
+    Falls back to ``"xla"`` when the requested backend has no implementation
+    for this op, when the tensor is below the custom-path size cutover, or
+    when a hierarchical backend is requested on a flat (n_dcn == 1) mesh —
+    the same graceful degradation the reference's selector performed when
+    NCCL/Gloo were compiled out.  ``explicit=True`` (a per-call backend
+    request, as opposed to the config default) bypasses the size cutover but
+    still degrades on topology/availability.
+    """
+    impls = _REGISTRY.get(op)
+    if not impls:
+        raise KeyError(f"no implementations registered for collective {op!r}")
+    name = backend
+    if name != "xla":
+        if (not explicit and nbytes is not None
+                and nbytes < custom_min_bytes):
+            name = "xla"
+        elif name == "hierarchical" and n_dcn <= 1:
+            name = "xla"
+        elif name not in impls:
+            name = "xla"
+    if name not in impls:
+        raise KeyError(
+            f"collective {op!r} has no {name!r} implementation "
+            f"(available: {sorted(impls)})"
+        )
+    return impls[name]
+
+
+def nbytes_of(x) -> int:
+    return int(np.prod(x.shape)) * x.dtype.itemsize if hasattr(x, "shape") else 0
