@@ -1,0 +1,89 @@
+"""Shared plumbing for the example scripts.
+
+The reference kept training loops in the examples, not the library
+(TorchMPI was "a communication library, not a trainer" — SURVEY.md §1); this
+module is the examples' shared boilerplate, not part of torchmpi_tpu.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def parse_args(description: str, **extra):
+    p = argparse.ArgumentParser(description=description)
+    p.add_argument("--devices", type=int, default=0,
+                   help="force N simulated CPU devices (0 = use real devices)")
+    p.add_argument("--dcn", type=int, default=None,
+                   help="outer (inter-slice) mesh axis size")
+    p.add_argument("--steps", type=int, default=100)
+    p.add_argument("--batch-size", type=int, default=256)
+    p.add_argument("--lr", type=float, default=0.01)
+    p.add_argument("--momentum", type=float, default=0.9)
+    p.add_argument("--backend", type=str, default=None,
+                   choices=[None, "xla", "hierarchical", "pallas"])
+    p.add_argument("--buckets", type=int, default=None,
+                   help="gradient allreduce buckets (overlap)")
+    p.add_argument("--seed", type=int, default=0)
+    for name, kw in extra.items():
+        p.add_argument(f"--{name.replace('_', '-')}", **kw)
+    args = p.parse_args()
+    if args.devices:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args.devices}"
+        ).strip()
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    return args
+
+
+def make_train_tools(model, sample_input, lr, momentum, seed=0):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    params = model.init(jax.random.PRNGKey(seed), jnp.zeros(sample_input))
+    tx = optax.sgd(lr, momentum=momentum)
+    opt_state = tx.init(params)
+
+    def local_loss(p, images, labels):
+        logits = model.apply(p, images)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels).mean()
+
+    return params, tx, opt_state, local_loss
+
+
+def evaluate(model, params, images, labels, batch=512):
+    import jax.numpy as jnp
+    import numpy as np
+
+    correct = 0
+    for i in range(0, len(images), batch):
+        logits = model.apply(params, jnp.asarray(images[i:i + batch]))
+        correct += int((np.argmax(np.asarray(logits), axis=1)
+                        == labels[i:i + batch]).sum())
+    return correct / len(images)
+
+
+class StepTimer:
+    def __init__(self):
+        self.t0 = None
+        self.steps = 0
+
+    def start(self):
+        self.t0 = time.time()
+
+    def tick(self):
+        self.steps += 1
+
+    def rate(self, batch_size):
+        dt = time.time() - self.t0
+        return self.steps * batch_size / dt if dt > 0 else float("inf")

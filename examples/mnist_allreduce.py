@@ -1,0 +1,69 @@
+"""MNIST LeNet, synchronous data-parallel SGD via gradient allreduce.
+
+Reference analog: ``examples/mnist_allreduce.lua`` [HIGH] (reconstructed —
+reference mount empty, SURVEY.md §0/§3 C15): the "add 4 lines to go
+distributed" pitch.  The four lines here: ``mpi.init()``,
+``synchronize_parameters``, ``synchronize_gradients`` in the step, and
+``mpi.stop()``.
+
+Run on 8 simulated devices:
+  ``python examples/mnist_allreduce.py --devices 8 --steps 100``
+Hierarchical 2-level allreduce over an emulated 2-slice topology:
+  ``python examples/mnist_allreduce.py --devices 8 --dcn 2 --backend hierarchical``
+"""
+
+import common
+
+
+def main():
+    args = common.parse_args(__doc__)
+    import jax
+    import optax
+
+    import torchmpi_tpu as mpi
+    from torchmpi_tpu.models import LeNet
+    from torchmpi_tpu.utils import data as dutil
+
+    mpi.init(mpi.Config(dcn_size=args.dcn))
+    if args.backend:
+        mpi.set_config(backend=args.backend, custom_min_bytes=0)
+    if args.buckets:
+        mpi.set_config(gradsync_buckets=args.buckets)
+    mesh = mpi.world_mesh()
+    print(f"mesh {dict(zip(mesh.axis_names, mesh.devices.shape))} "
+          f"rank {mpi.rank()}/{mpi.size()}")
+
+    model = LeNet()
+    params, tx, opt_state, local_loss = common.make_train_tools(
+        model, (1, 28, 28, 1), args.lr, args.momentum, args.seed)
+
+    def step(params, opt_state, images, labels):
+        loss, grads = jax.value_and_grad(local_loss)(params, images, labels)
+        grads = mpi.nn.synchronize_gradients(grads, backend=args.backend)
+        loss = mpi.collectives.allreduce_in_axis(loss, mesh.axis_names,
+                                                 op="mean")
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    dp_step = mpi.nn.data_parallel_step(step, batch_argnums=(2, 3))
+    params = mpi.nn.synchronize_parameters(params)
+    opt_state = mpi.nn.synchronize_parameters(opt_state)
+
+    X, Y = dutil.synthetic_mnist(4096, seed=args.seed)
+    timer = common.StepTimer()
+    timer.start()
+    for i, (xb, yb) in enumerate(
+            dutil.batches(X, Y, args.batch_size, steps=args.steps,
+                          seed=args.seed)):
+        params, opt_state, loss = dp_step(params, opt_state, xb, yb)
+        timer.tick()
+        if i % 20 == 0 or i == args.steps - 1:
+            print(f"step {i:4d}  loss {float(loss):.4f}")
+    acc = common.evaluate(model, params, X[:1024], Y[:1024])
+    print(f"final accuracy {acc:.3f}  ({timer.rate(args.batch_size):.0f} img/s)")
+    mpi.stop()
+    assert acc > 0.9, "data-parallel MNIST did not converge"
+
+
+if __name__ == "__main__":
+    main()

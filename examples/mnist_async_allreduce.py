@@ -1,0 +1,60 @@
+"""MNIST LeNet, data-parallel SGD with overlapped (bucketed) gradient sync.
+
+Reference analog: ``examples/mnist_allreduce_async.lua`` [MED] (reconstructed
+— reference mount empty, SURVEY.md §0/§4.3): per-layer async allreduce hooks
+fired during backward, synced before the optimizer step.  On TPU the overlap
+is expressed as K bucketed collectives inside one jit — XLA's scheduler
+overlaps bucket transfers with remaining computation (SURVEY §8.4.3).
+
+Run: ``python examples/mnist_async_allreduce.py --devices 8 --buckets 4``
+"""
+
+import common
+
+
+def main():
+    args = common.parse_args(__doc__)
+    if args.buckets is None:
+        args.buckets = 4
+    import jax
+    import optax
+
+    import torchmpi_tpu as mpi
+    from torchmpi_tpu.models import LeNet
+    from torchmpi_tpu.utils import data as dutil
+
+    mpi.init(mpi.Config(dcn_size=args.dcn, gradsync_buckets=args.buckets))
+    mesh = mpi.world_mesh()
+    model = LeNet()
+    params, tx, opt_state, local_loss = common.make_train_tools(
+        model, (1, 28, 28, 1), args.lr, args.momentum, args.seed)
+
+    def step(params, opt_state, images, labels):
+        loss, grads = jax.value_and_grad(local_loss)(params, images, labels)
+        # n_buckets comes from config; each bucket is an independent
+        # collective XLA may overlap (the async-hooks analog).
+        grads = mpi.nn.synchronize_gradients(grads)
+        loss = mpi.collectives.allreduce_in_axis(loss, mesh.axis_names,
+                                                 op="mean")
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    dp_step = mpi.nn.data_parallel_step(step, batch_argnums=(2, 3))
+    params = mpi.nn.synchronize_parameters(params)
+    opt_state = mpi.nn.synchronize_parameters(opt_state)
+
+    X, Y = dutil.synthetic_mnist(4096, seed=args.seed)
+    for i, (xb, yb) in enumerate(
+            dutil.batches(X, Y, args.batch_size, steps=args.steps,
+                          seed=args.seed)):
+        params, opt_state, loss = dp_step(params, opt_state, xb, yb)
+        if i % 20 == 0 or i == args.steps - 1:
+            print(f"step {i:4d}  loss {float(loss):.4f}")
+    acc = common.evaluate(model, params, X[:1024], Y[:1024])
+    print(f"final accuracy {acc:.3f}")
+    mpi.stop()
+    assert acc > 0.9, "bucketed data-parallel MNIST did not converge"
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,49 @@
+"""MNIST LeNet, single-device sequential baseline.
+
+Reference analog: ``examples/mnist_sequential.lua`` [HIGH] (reconstructed —
+reference mount empty, SURVEY.md §0/§3 C15): the non-distributed control run
+the distributed variants are compared against.
+
+Run: ``python examples/mnist_sequential.py --steps 100``
+"""
+
+import common
+
+
+def main():
+    args = common.parse_args(__doc__)
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from torchmpi_tpu.models import LeNet
+    from torchmpi_tpu.utils import data as dutil
+
+    model = LeNet()
+    params, tx, opt_state, local_loss = common.make_train_tools(
+        model, (1, 28, 28, 1), args.lr, args.momentum, args.seed)
+
+    @jax.jit
+    def step(params, opt_state, images, labels):
+        loss, grads = jax.value_and_grad(local_loss)(params, images, labels)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    X, Y = dutil.synthetic_mnist(4096, seed=args.seed)
+    timer = common.StepTimer()
+    timer.start()
+    for i, (xb, yb) in enumerate(
+            dutil.batches(X, Y, args.batch_size, steps=args.steps,
+                          seed=args.seed)):
+        params, opt_state, loss = step(params, opt_state,
+                                       jnp.asarray(xb), jnp.asarray(yb))
+        timer.tick()
+        if i % 20 == 0 or i == args.steps - 1:
+            print(f"step {i:4d}  loss {float(loss):.4f}")
+    acc = common.evaluate(model, params, X[:1024], Y[:1024])
+    print(f"final accuracy {acc:.3f}  ({timer.rate(args.batch_size):.0f} img/s)")
+    assert acc > 0.9, "sequential MNIST did not converge"
+
+
+if __name__ == "__main__":
+    main()

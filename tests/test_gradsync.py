@@ -1,0 +1,164 @@
+"""Gradient-sync tests (reference analog: test/nn*.lua + MNIST convergence
+smoke, SURVEY.md §5).
+
+Key correctness property (reference §4.3): a data-parallel step over N
+devices with gradient averaging must match a single-device step on the full
+batch — the sum-of-shard-gradients IS the full-batch gradient.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import torchmpi_tpu as mpi
+from torchmpi_tpu.models import LeNet
+from torchmpi_tpu.parallel import gradsync
+from torchmpi_tpu.utils import data as dutil
+
+
+def _tools(lr=0.01, momentum=0.9, seed=0):
+    model = LeNet()
+    params = model.init(jax.random.PRNGKey(seed), jnp.zeros((1, 28, 28, 1)))
+    tx = optax.sgd(lr, momentum=momentum)
+    opt_state = tx.init(params)
+
+    def local_loss(p, images, labels):
+        logits = model.apply(p, images)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels).mean()
+
+    return model, params, tx, opt_state, local_loss
+
+
+def _dp_step_fn(tx, local_loss, mesh, backend=None, n_buckets=None):
+    def step(params, opt_state, images, labels):
+        loss, grads = jax.value_and_grad(local_loss)(params, images, labels)
+        grads = gradsync.synchronize_gradients(grads, backend=backend,
+                                               n_buckets=n_buckets)
+        loss = mpi.collectives.allreduce_in_axis(loss, mesh.axis_names,
+                                                 op="mean")
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    return step
+
+
+def test_synchronize_parameters_replicates(flat_runtime):
+    _, params, _, _, _ = _tools()
+    rep = gradsync.synchronize_parameters(params)
+    leaf = jax.tree.leaves(rep)[0]
+    assert leaf.sharding.is_fully_replicated
+
+
+def test_dp_step_matches_single_device(flat_runtime):
+    """8-device DP step == single-device full-batch step, numerically."""
+    mesh = mpi.world_mesh()
+    model, params, tx, opt_state, local_loss = _tools()
+    X, Y = dutil.synthetic_mnist(256, seed=1)
+    xb, yb = X[:64], Y[:64]
+
+    # single-device full batch
+    loss1, grads1 = jax.value_and_grad(local_loss)(
+        params, jnp.asarray(xb), jnp.asarray(yb))
+    up1, _ = tx.update(grads1, opt_state, params)
+    p1 = optax.apply_updates(params, up1)
+
+    # 8-device DP
+    dp = gradsync.data_parallel_step(
+        _dp_step_fn(tx, local_loss, mesh), batch_argnums=(2, 3),
+        donate_argnums=())
+    p2, _, loss2 = dp(gradsync.synchronize_parameters(params),
+                      gradsync.synchronize_parameters(opt_state), xb, yb)
+
+    np.testing.assert_allclose(float(loss1), float(loss2), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-6)
+
+
+def test_bucketed_matches_unbucketed(flat_runtime):
+    mesh = mpi.world_mesh()
+    model, params, tx, opt_state, local_loss = _tools()
+    X, Y = dutil.synthetic_mnist(64, seed=2)
+
+    outs = []
+    for n_buckets in (1, 4):
+        dp = gradsync.data_parallel_step(
+            _dp_step_fn(tx, local_loss, mesh, n_buckets=n_buckets),
+            batch_argnums=(2, 3), donate_argnums=())
+        p, _, _ = dp(gradsync.synchronize_parameters(params),
+                     gradsync.synchronize_parameters(opt_state), X, Y)
+        outs.append(p)
+    for a, b in zip(jax.tree.leaves(outs[0]), jax.tree.leaves(outs[1])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5,
+                                   atol=1e-7)
+
+
+def test_bucket_count_exceeding_params(flat_runtime):
+    # More buckets than elements must clamp, not crash.
+    mesh = mpi.world_mesh()
+
+    def body(g):
+        return gradsync.synchronize_gradients(g, mesh.axis_names, op="sum",
+                                              n_buckets=64)
+
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    fn = jax.jit(shard_map(body, mesh=mesh,
+                           in_specs=P(mesh.axis_names),
+                           out_specs=P()))
+    res = fn(np.arange(8, dtype=np.float32).reshape(8, 1))
+    np.testing.assert_allclose(np.asarray(res), [[28.0]])
+
+
+def test_sum_vs_mean_op(flat_runtime):
+    mpi.set_config(gradsync_average=False)  # reference default: sum
+    mesh = mpi.world_mesh()
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    fn = jax.jit(shard_map(
+        lambda g: gradsync.synchronize_gradients(g, mesh.axis_names),
+        mesh=mesh, in_specs=P(mesh.axis_names), out_specs=P()))
+    res = fn(np.ones((8, 2), np.float32))
+    np.testing.assert_allclose(np.asarray(res), [[8.0, 8.0]])
+
+
+def test_hierarchical_gradsync(hier_runtime):
+    """Gradient sync routed through the 2-level backend converges the same."""
+    mesh = mpi.world_mesh()
+    model, params, tx, opt_state, local_loss = _tools()
+    X, Y = dutil.synthetic_mnist(64, seed=3)
+    outs = []
+    for backend in ("xla", "hierarchical"):
+        dp = gradsync.data_parallel_step(
+            _dp_step_fn(tx, local_loss, mesh, backend=backend),
+            batch_argnums=(2, 3), donate_argnums=())
+        p, _, _ = dp(gradsync.synchronize_parameters(params),
+                     gradsync.synchronize_parameters(opt_state), X, Y)
+        outs.append(p)
+    for a, b in zip(jax.tree.leaves(outs[0]), jax.tree.leaves(outs[1])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5,
+                                   atol=1e-7)
+
+
+@pytest.mark.slow
+def test_mnist_convergence_smoke(flat_runtime):
+    """Config-1 milestone: LeNet DP on the 8-device mesh learns (SURVEY §8.3)."""
+    mesh = mpi.world_mesh()
+    model, params, tx, opt_state, local_loss = _tools()
+    dp = gradsync.data_parallel_step(_dp_step_fn(tx, local_loss, mesh),
+                                     batch_argnums=(2, 3))
+    params = gradsync.synchronize_parameters(params)
+    opt_state = gradsync.synchronize_parameters(opt_state)
+    X, Y = dutil.synthetic_mnist(2048)
+    first = None
+    for xb, yb in dutil.batches(X, Y, 256, steps=60):
+        params, opt_state, loss = dp(params, opt_state, xb, yb)
+        if first is None:
+            first = float(loss)
+    last = float(loss)
+    assert last < 0.25 * first, f"no convergence: {first} -> {last}"

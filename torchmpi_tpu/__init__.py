@@ -48,6 +48,7 @@ from .runtime import (
 from . import collectives
 from . import selector
 from . import parallel
+from . import nn
 from .collectives import (
     allreduce,
     broadcast,

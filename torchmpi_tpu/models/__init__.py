@@ -1,0 +1,5 @@
+"""Model zoo for the reference's workloads (SURVEY.md §8.1): LeNet (MNIST),
+ResNet-20 (CIFAR-10), ResNet-50 (ImageNet), AlexNet (Downpour).  Implemented
+in flax.linen, bfloat16-friendly, static shapes — MXU-ready."""
+
+from .lenet import LeNet  # noqa: F401

@@ -1,0 +1,34 @@
+"""LeNet-5-style convnet for MNIST.
+
+The reference's MNIST examples trained a small convnet of this family
+(``examples/mnist*.lua``, SURVEY.md §3 C15 [HIGH] — reconstructed, reference
+mount empty).  Shapes are NHWC and channel counts padded toward TPU-friendly
+multiples where it is free to do so.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class LeNet(nn.Module):
+    """conv(32) -> pool -> conv(64) -> pool -> dense(256) -> dense(classes)."""
+
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):  # x: [B, 28, 28, 1]
+        x = x.astype(self.dtype)
+        x = nn.Conv(32, (5, 5), padding="SAME", dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(64, (5, 5), padding="SAME", dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(256, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return x

@@ -1,0 +1,21 @@
+"""``torchmpi_tpu.nn`` — the ``torchmpi.nn`` integration surface.
+
+Thin facade over :mod:`torchmpi_tpu.parallel.gradsync` keeping the reference's
+module layout (``torchmpi/nn.lua``, SURVEY.md §3 C10): users who knew
+``mpinn.synchronizeParameters`` / ``mpinn.synchronizeGradients`` find the same
+verbs here; the TPU-native step builder lives alongside.
+"""
+
+from .parallel.gradsync import (  # noqa: F401
+    synchronize_parameters,
+    resynchronize_parameters_in_axis,
+    synchronize_gradients,
+    data_parallel_step,
+)
+
+__all__ = [
+    "synchronize_parameters",
+    "resynchronize_parameters_in_axis",
+    "synchronize_gradients",
+    "data_parallel_step",
+]

@@ -1,0 +1,214 @@
+"""Gradient/parameter synchronization for data-parallel SGD.
+
+Rebuild of ``torchmpi.nn`` (SURVEY.md §3 C10, §4.3, reconstructed — reference
+mount empty): ``synchronizeParameters(net)`` broadcast the parameters from
+rank 0 at init; ``synchronizeGradients(net)`` allreduced gradParams after each
+backward; an async variant overlapped per-layer allreduces with backprop.
+
+TPU-native mapping:
+
+- *Parameter sync* is a sharding statement: replicating the pytree over the
+  mesh (``NamedSharding(mesh, P())``) makes every device hold rank-0's copy —
+  the broadcast happens in the transfer.  An explicit in-axis broadcast is
+  also provided for divergent-state repair (the reference's re-sync use case).
+- *Gradient sync* is selector-routed ``allreduce_in_axis`` inside the jitted
+  train step, so the hierarchical / custom backends apply to the hot path.
+- *The async per-layer overlap* becomes **bucketing**: gradients are flattened
+  into K buckets, each allreduced separately inside jit — XLA's latency-hiding
+  scheduler overlaps bucket k's collective with bucket k+1's computation,
+  playing the role of the reference's per-module hooks firing during backward.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .. import collectives, runtime
+
+PyTree = Any
+AxisNames = Union[str, Tuple[str, ...]]
+
+
+def _default_mesh(mesh: Optional[Mesh]) -> Mesh:
+    return mesh if mesh is not None else runtime.current_mesh()
+
+
+def _all_axes(mesh: Mesh) -> Tuple[str, ...]:
+    return tuple(mesh.axis_names)
+
+
+# ---------------------------------------------------------------------------
+# Parameter synchronization (reference: mpinn.synchronizeParameters)
+# ---------------------------------------------------------------------------
+
+
+def synchronize_parameters(params: PyTree, *, mesh: Optional[Mesh] = None) -> PyTree:
+    """Replicate a parameter pytree across every device of the mesh.
+
+    The reference broadcast ``net:parameters()`` from rank 0; here the
+    replicating ``device_put`` *is* that broadcast (source: the controller's
+    copy).  Returns the same values, now resident and replicated on the mesh.
+    """
+    m = _default_mesh(mesh)
+    repl = NamedSharding(m, P())
+    return jax.tree.map(lambda a: jax.device_put(a, repl), params)
+
+
+def resynchronize_parameters_in_axis(params: PyTree, axis_names: AxisNames,
+                                     *, root: int = 0,
+                                     backend: Optional[str] = None) -> PyTree:
+    """In-axis broadcast of params from ``root`` — for use inside shard_map
+    when per-device state may have diverged (async PS training, debugging)."""
+    return collectives.broadcast_in_axis(params, axis_names, root=root,
+                                         backend=backend)
+
+
+# ---------------------------------------------------------------------------
+# Gradient synchronization (reference: mpinn.synchronizeGradients)
+# ---------------------------------------------------------------------------
+
+
+def _bucketed_allreduce(grads: PyTree, axes: Tuple[str, ...], *, op: str,
+                        n_buckets: int, backend: Optional[str]) -> PyTree:
+    """Flatten -> concat -> K buckets -> one allreduce each -> unflatten.
+
+    The analog of the reference's async per-layer hooks (SURVEY §4.3): K
+    independent collectives inside one jit give XLA the freedom to overlap
+    them with surrounding compute.
+    """
+    leaves, treedef = jax.tree.flatten(grads)
+    if not leaves:
+        return grads
+    shapes = [l.shape for l in leaves]
+    sizes = [int(np.prod(s)) for s in shapes]
+    dtype = jnp.result_type(*[l.dtype for l in leaves])
+    flat = jnp.concatenate([l.astype(dtype).reshape(-1) for l in leaves])
+    total = flat.shape[0]
+    n_buckets = max(1, min(n_buckets, total))
+    bounds = np.linspace(0, total, n_buckets + 1).astype(int)
+    out_parts = []
+    for i in range(n_buckets):
+        part = flat[bounds[i]:bounds[i + 1]]
+        out_parts.append(collectives.allreduce_in_axis(
+            part, axes, op=op, backend=backend))
+    flat_out = jnp.concatenate(out_parts) if n_buckets > 1 else out_parts[0]
+    outs = []
+    off = 0
+    for s, sz, l in zip(shapes, sizes, leaves):
+        outs.append(flat_out[off:off + sz].reshape(s).astype(l.dtype))
+        off += sz
+    return jax.tree.unflatten(treedef, outs)
+
+
+def synchronize_gradients(grads: PyTree, axis_names: Optional[AxisNames] = None,
+                          *, op: Optional[str] = None,
+                          n_buckets: Optional[int] = None,
+                          backend: Optional[str] = None) -> PyTree:
+    """Allreduce a gradient pytree across the data-parallel axes.
+
+    For use inside a shard_map'd/jitted train step (the hot path).  Defaults:
+    axes = every axis of the current world mesh; ``op`` = mean when
+    ``config.gradsync_average`` (the reference allreduce-summed then divided
+    by ``mpi.size()``); ``n_buckets`` from config.
+    """
+    if axis_names is None:
+        axis_names = _all_axes(runtime.current_mesh())
+    axes = (axis_names,) if isinstance(axis_names, str) else tuple(axis_names)
+    cfg = runtime.config() if runtime.is_initialized() else None
+    if op is None:
+        op = "mean" if (cfg is None or cfg.gradsync_average) else "sum"
+    if n_buckets is None:
+        n_buckets = cfg.gradsync_buckets if cfg is not None else 1
+    if n_buckets <= 1:
+        return collectives.allreduce_in_axis(grads, axes, op=op,
+                                             backend=backend)
+    return _bucketed_allreduce(grads, axes, op=op, n_buckets=n_buckets,
+                               backend=backend)
+
+
+# ---------------------------------------------------------------------------
+# Data-parallel step builder: the end-to-end TorchMPI recipe
+# (broadcast params once; each step: local grads -> allreduce -> sgd)
+# ---------------------------------------------------------------------------
+
+
+def data_parallel_step(
+    step_fn: Callable,
+    *,
+    mesh: Optional[Mesh] = None,
+    batch_argnums: Sequence[int] = (2,),
+    donate_argnums: Sequence[int] = (0, 1),
+    max_inflight: Optional[int] = None,
+    check_vma: bool = False,
+) -> Callable:
+    """Wrap ``step_fn(params, opt_state, batch, ...)`` into a jitted SPMD step.
+
+    ``step_fn`` is written from one device's perspective on its local batch
+    shard and must call :func:`synchronize_gradients` on its grads — exactly
+    the reference's training-loop shape (SURVEY §4.3) with the allreduce
+    inside the compiled step.  Params/opt_state are replicated; arguments
+    listed in ``batch_argnums`` are sharded on their leading axis over all
+    mesh axes.
+
+    ``max_inflight`` bounds the number of dispatched-but-unfinished steps.
+    XLA's CPU backend runs each simulated device's collective on a shared
+    thread pool; an unbounded async queue can starve a collective rendezvous
+    of its participant threads and abort the process, so the CPU default is a
+    conservative 2 (double buffering).  On real TPU the default is 16 — deep
+    enough to hide dispatch latency, bounded enough to cap device-memory
+    pressure from donated buffers.
+    """
+    m = _default_mesh(mesh)
+    axes = _all_axes(m)
+    repl = P()
+    shard = P(axes)
+    if max_inflight is None:
+        platform = list(m.devices.flat)[0].platform
+        max_inflight = 2 if platform == "cpu" else 16
+
+    def spec_for(i):
+        return shard if i in set(batch_argnums) else repl
+
+    def wrapped(*args):
+        in_specs = tuple(spec_for(i) for i in range(len(args)))
+        # check_vma stays False by default: under JAX's VMA type system,
+        # differentiating replicated params against sharded batches makes
+        # autodiff insert its own psum (the broadcast's transpose), so
+        # gradients arrive pre-summed and an explicit synchronize_gradients
+        # would be skipped/miscounted.  This library's contract is the
+        # reference's: gradients are per-device until the user syncs them.
+        # The cost: a step_fn that forgets synchronize_gradients returns
+        # device 0's un-synced values silently — which is also exactly what
+        # the reference did if you forgot synchronizeGradients.
+        fn = shard_map(step_fn, mesh=m, in_specs=in_specs,
+                       out_specs=repl, check_vma=check_vma)
+        out = fn(*args)
+        # Completion token: depends on the step's outputs, never returned to
+        # the caller, hence never donated back in — always safe to block on.
+        leaves = jax.tree.leaves(out)
+        token = (jnp.ravel(leaves[0])[0].astype(jnp.float32)
+                 if leaves else jnp.float32(0))
+        return out, token
+
+    jitted = jax.jit(wrapped, donate_argnums=tuple(donate_argnums))
+
+    from collections import deque
+
+    window: deque = deque()
+
+    def throttled(*args):
+        # Throttle *before* dispatch so donated inputs are still live.
+        while len(window) >= max_inflight:
+            jax.block_until_ready(window.popleft())
+        out, token = jitted(*args)
+        window.append(token)
+        return out
+
+    throttled.jitted = jitted  # escape hatch for benchmarking raw dispatch
+    return throttled

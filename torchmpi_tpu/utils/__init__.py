@@ -1,0 +1,1 @@
+"""Utilities: synthetic datasets, metrics, checkpointing, tracing."""
