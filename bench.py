@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""Headline benchmark: ResNet-50 data-parallel training throughput.
+
+Measures img/s/chip for the full data-parallel train step (forward, backward,
+selector-routed gradient allreduce, BatchNorm cross-replica stats sync, SGD
+update) on every visible device — the single-chip number is the denominator
+of BASELINE.md's scaling-efficiency target, and on a multi-chip slice the
+same script measures the scaled throughput directly.
+
+Prints ONE JSON line on stdout:
+  {"metric": ..., "value": N, "unit": "img/s/chip", "vs_baseline": N}
+
+``vs_baseline`` is measured/1.0 because the upstream repo published no
+benchmark tables (BASELINE.json "published": {}); see BASELINE.md.
+
+Platform notes (important for honest numbers):
+- data is device-resident (host->device on this relay platform is ~470 MB/s
+  and would dominate);
+- timing fences use a device->host readback, because block_until_ready can
+  return early on relay-tunneled platforms.
+"""
+
+import json
+import sys
+import time
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import torchmpi_tpu as mpi
+    from torchmpi_tpu.models import ResNet50
+    from torchmpi_tpu.utils.metrics import fence
+
+    BATCH_PER_CHIP = 64
+    IMAGE = 224
+    STEPS = 20
+    WARMUP = 3
+
+    mesh = mpi.init()
+    n_dev = mpi.device_count()
+    batch = BATCH_PER_CHIP * n_dev
+    log(f"devices={n_dev} mesh={dict(zip(mesh.axis_names, mesh.devices.shape))} "
+        f"global_batch={batch}")
+
+    model = ResNet50(dtype=jnp.bfloat16)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, IMAGE, IMAGE, 3)), train=False)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+    tx = optax.sgd(0.1, momentum=0.9)
+    opt_state = tx.init(params)
+
+    dp_step = mpi.recipes.make_bn_dp_train_step(model, tx, mesh=mesh)
+    params, opt_state, batch_stats = mpi.recipes.replicate_bn_state(
+        params, opt_state, batch_stats, mesh=mesh)
+
+    # Device-resident synthetic batch, sharded over the mesh.
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    shard = NamedSharding(mesh, P(mesh.axis_names))
+    images = jax.device_put(
+        np.random.RandomState(0).rand(batch, IMAGE, IMAGE, 3)
+        .astype(np.float32), shard)
+    labels = jax.device_put(
+        np.random.RandomState(1).randint(0, 1000, size=batch)
+        .astype(np.int32), shard)
+
+    log("compiling + warmup...")
+    t0 = time.time()
+    for _ in range(WARMUP):
+        params, opt_state, batch_stats, loss = dp_step(
+            params, opt_state, batch_stats, images, labels)
+    fence(loss)
+    log(f"warmup done in {time.time()-t0:.1f}s; timing {STEPS} steps...")
+
+    t0 = time.time()
+    for _ in range(STEPS):
+        params, opt_state, batch_stats, loss = dp_step(
+            params, opt_state, batch_stats, images, labels)
+    fence(loss)
+    dt = time.time() - t0
+
+    img_s = STEPS * batch / dt
+    img_s_chip = img_s / n_dev
+    log(f"step time {dt/STEPS*1000:.1f} ms, total {img_s:.1f} img/s, "
+        f"loss {float(loss):.3f}")
+    print(json.dumps({
+        "metric": "resnet50_dp_train_throughput",
+        "value": round(img_s_chip, 1),
+        "unit": "img/s/chip",
+        "vs_baseline": 1.0,
+        "extra": {"devices": n_dev, "global_batch": batch,
+                  "step_ms": round(dt / STEPS * 1000, 2),
+                  "dtype": "bfloat16", "image": IMAGE},
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,472 @@
+// torchmpi_tpu parameter-server host transport.
+//
+// TPU-native rebuild of the reference's C7 async engine + C8 parameter-server
+// shards (lib/parameterserver.cpp/.h [MED], SURVEY.md §3 — reconstructed,
+// reference mount empty).  The reference ran server threads over
+// MPI_THREAD_MULTIPLE point-to-point; on a TPU pod the asynchronous traffic
+// is host-side over DCN, so the transport is TCP sockets driven by native
+// threads, entirely outside the SPMD/XLA world (async PS is fundamentally
+// incompatible with gang-scheduled collectives — SURVEY.md §8.2.5).
+//
+// Exposed as a C ABI for ctypes (no pybind11 in this environment).
+//
+// Server: owns a float32 shard; a listener thread accepts connections and
+// spawns one handler thread per client (clients = ranks, i.e. few).  Ops
+// apply under a shard mutex.
+//
+// Client: one socket per connection; async send/receive run on a small
+// thread pool with per-connection serialization; futures are integer ids
+// (the reference's opaque handles + torchmpi_sync_handle).
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <functional>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// ----------------------------------------------------------------- protocol
+enum Op : uint8_t {
+  OP_SEND = 1,      // payload in; rule applied to shard
+  OP_RECEIVE = 2,   // payload out
+  OP_SHUTDOWN = 3,  // close this connection
+  OP_PING = 4,
+};
+
+enum Rule : uint32_t {
+  RULE_COPY = 0,     // shard[i]  = p[i]
+  RULE_ADD = 1,      // shard[i] += p[i]
+  RULE_ZERO = 2,     // shard[i]  = 0        (payload ignored but present)
+  RULE_AXPY = 3,     // shard[i] += alpha * p[i]
+  RULE_ELASTIC = 4,  // delta = alpha*(p[i]-shard[i]); shard += delta;
+                     // response payload = delta (EASGD symmetric update)
+};
+
+struct __attribute__((packed)) Header {
+  uint8_t op;
+  uint32_t rule;
+  float alpha;
+  uint64_t offset;  // float index into the shard
+  uint64_t count;   // number of floats
+};
+
+bool read_exact(int fd, void* buf, size_t n) {
+  auto* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::read(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_exact(int fd, const void* buf, size_t n) {
+  auto* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::write(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+// ------------------------------------------------------------------- server
+struct Server {
+  std::vector<float> shard;
+  std::mutex shard_mu;
+  int listen_fd = -1;
+  int port = 0;
+  std::thread accept_thread;
+  std::vector<std::thread> handlers;
+  std::vector<int> handler_fds;  // guarded by handlers_mu
+  std::mutex handlers_mu;
+  std::atomic<bool> stopping{false};
+  std::atomic<uint64_t> ops_served{0};
+
+  ~Server() { stop(); }
+
+  bool start(uint64_t size, int want_port) {
+    shard.assign(size, 0.0f);
+    listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd < 0) return false;
+    int one = 1;
+    ::setsockopt(listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+    addr.sin_port = htons(static_cast<uint16_t>(want_port));
+    if (::bind(listen_fd, reinterpret_cast<sockaddr*>(&addr),
+               sizeof(addr)) != 0)
+      return false;
+    socklen_t len = sizeof(addr);
+    ::getsockname(listen_fd, reinterpret_cast<sockaddr*>(&addr), &len);
+    port = ntohs(addr.sin_port);
+    if (::listen(listen_fd, 64) != 0) return false;
+    accept_thread = std::thread([this] { accept_loop(); });
+    return true;
+  }
+
+  void accept_loop() {
+    while (!stopping.load()) {
+      int fd = ::accept(listen_fd, nullptr, nullptr);
+      if (fd < 0) break;
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      std::lock_guard<std::mutex> g(handlers_mu);
+      handler_fds.push_back(fd);
+      handlers.emplace_back([this, fd] { handle(fd); });
+    }
+  }
+
+  void handle(int fd) {
+    std::vector<float> buf;
+    Header h{};
+    while (!stopping.load() && read_exact(fd, &h, sizeof(h))) {
+      if (h.op == OP_SHUTDOWN) break;
+      if (h.op == OP_PING) {
+        uint8_t ok = 1;
+        if (!write_exact(fd, &ok, 1)) break;
+        continue;
+      }
+      if (h.offset + h.count > shard.size()) break;  // malformed; drop client
+      if (h.op == OP_SEND) {
+        buf.resize(h.count);
+        if (!read_exact(fd, buf.data(), h.count * sizeof(float))) break;
+        {
+          std::lock_guard<std::mutex> g(shard_mu);
+          float* s = shard.data() + h.offset;
+          switch (h.rule) {
+            case RULE_COPY:
+              std::memcpy(s, buf.data(), h.count * sizeof(float));
+              break;
+            case RULE_ADD:
+              for (uint64_t i = 0; i < h.count; ++i) s[i] += buf[i];
+              break;
+            case RULE_ZERO:
+              std::memset(s, 0, h.count * sizeof(float));
+              break;
+            case RULE_AXPY:
+              for (uint64_t i = 0; i < h.count; ++i) s[i] += h.alpha * buf[i];
+              break;
+            case RULE_ELASTIC:
+              for (uint64_t i = 0; i < h.count; ++i) {
+                float delta = h.alpha * (buf[i] - s[i]);
+                s[i] += delta;
+                buf[i] = delta;  // reply with deltas
+              }
+              break;
+            default:
+              break;
+          }
+        }
+        uint8_t ok = 1;
+        if (!write_exact(fd, &ok, 1)) break;
+        if (h.rule == RULE_ELASTIC &&
+            !write_exact(fd, buf.data(), h.count * sizeof(float)))
+          break;
+        ops_served.fetch_add(1);
+      } else if (h.op == OP_RECEIVE) {
+        buf.resize(h.count);
+        {
+          std::lock_guard<std::mutex> g(shard_mu);
+          std::memcpy(buf.data(), shard.data() + h.offset,
+                      h.count * sizeof(float));
+        }
+        uint8_t ok = 1;
+        if (!write_exact(fd, &ok, 1)) break;
+        if (!write_exact(fd, buf.data(), h.count * sizeof(float))) break;
+        ops_served.fetch_add(1);
+      } else {
+        break;
+      }
+    }
+    ::close(fd);
+  }
+
+  void stop() {
+    if (stopping.exchange(true)) return;
+    if (listen_fd >= 0) {
+      ::shutdown(listen_fd, SHUT_RDWR);
+      ::close(listen_fd);
+    }
+    if (accept_thread.joinable()) accept_thread.join();
+    std::lock_guard<std::mutex> g(handlers_mu);
+    // Wake handler threads blocked in read() on idle client connections —
+    // without this, join() below deadlocks on any connected-but-quiet
+    // client (close() alone does not interrupt a blocked read).
+    for (int fd : handler_fds) ::shutdown(fd, SHUT_RDWR);
+    for (auto& t : handlers)
+      if (t.joinable()) t.join();
+    handlers.clear();
+    handler_fds.clear();
+  }
+};
+
+// ------------------------------------------------------------------- client
+struct Future {
+  std::mutex mu;
+  std::condition_variable cv;
+  bool done = false;
+  int status = 0;  // 1 ok, <0 error
+};
+
+struct Client {
+  int fd = -1;
+  // Per-connection op serialization: ops on one connection execute in
+  // submission order (the reference's async-ordering guarantee, SURVEY §4.4).
+  std::mutex io_mu;
+  std::thread worker;
+  std::deque<std::function<void()>> queue;
+  std::mutex q_mu;
+  std::condition_variable q_cv;
+  std::atomic<bool> stopping{false};
+
+  ~Client() { stop(); }
+
+  bool connect_to(const char* host, int port) {
+    fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return false;
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) return false;
+    if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0)
+      return false;
+    int one = 1;
+    ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    worker = std::thread([this] { run(); });
+    return true;
+  }
+
+  void run() {
+    for (;;) {
+      std::function<void()> job;
+      {
+        std::unique_lock<std::mutex> lk(q_mu);
+        q_cv.wait(lk, [this] { return stopping.load() || !queue.empty(); });
+        if (stopping.load() && queue.empty()) return;
+        job = std::move(queue.front());
+        queue.pop_front();
+      }
+      job();
+    }
+  }
+
+  void enqueue(std::function<void()> job) {
+    {
+      std::lock_guard<std::mutex> g(q_mu);
+      queue.push_back(std::move(job));
+    }
+    q_cv.notify_one();
+  }
+
+  void stop() {
+    if (stopping.exchange(true)) return;
+    q_cv.notify_all();
+    if (worker.joinable()) worker.join();
+    if (fd >= 0) {
+      Header h{};
+      h.op = OP_SHUTDOWN;
+      write_exact(fd, &h, sizeof(h));
+      ::close(fd);
+      fd = -1;
+    }
+  }
+};
+
+// ------------------------------------------------------------------ registry
+std::mutex g_mu;
+std::map<int64_t, std::unique_ptr<Server>> g_servers;
+std::map<int64_t, std::unique_ptr<Client>> g_clients;
+std::map<int64_t, std::shared_ptr<Future>> g_futures;
+int64_t g_next_id = 1;
+
+std::shared_ptr<Future> new_future(int64_t* id_out) {
+  auto f = std::make_shared<Future>();
+  std::lock_guard<std::mutex> g(g_mu);
+  *id_out = g_next_id++;
+  g_futures[*id_out] = f;
+  return f;
+}
+
+void complete(const std::shared_ptr<Future>& f, int status) {
+  std::lock_guard<std::mutex> g(f->mu);
+  f->status = status;
+  f->done = true;
+  f->cv.notify_all();
+}
+
+}  // namespace
+
+extern "C" {
+
+// ---- server ----
+int64_t tm_ps_server_create(uint64_t shard_floats, int port) {
+  auto s = std::make_unique<Server>();
+  if (!s->start(shard_floats, port)) return -1;
+  std::lock_guard<std::mutex> g(g_mu);
+  int64_t id = g_next_id++;
+  g_servers[id] = std::move(s);
+  return id;
+}
+
+int tm_ps_server_port(int64_t sid) {
+  std::lock_guard<std::mutex> g(g_mu);
+  auto it = g_servers.find(sid);
+  return it == g_servers.end() ? -1 : it->second->port;
+}
+
+uint64_t tm_ps_server_ops(int64_t sid) {
+  std::lock_guard<std::mutex> g(g_mu);
+  auto it = g_servers.find(sid);
+  return it == g_servers.end() ? 0 : it->second->ops_served.load();
+}
+
+void tm_ps_server_destroy(int64_t sid) {
+  std::unique_ptr<Server> s;
+  {
+    std::lock_guard<std::mutex> g(g_mu);
+    auto it = g_servers.find(sid);
+    if (it == g_servers.end()) return;
+    s = std::move(it->second);
+    g_servers.erase(it);
+  }
+  s->stop();
+}
+
+// ---- client ----
+int64_t tm_ps_client_connect(const char* host, int port) {
+  auto c = std::make_unique<Client>();
+  if (!c->connect_to(host, port)) return -1;
+  std::lock_guard<std::mutex> g(g_mu);
+  int64_t id = g_next_id++;
+  g_clients[id] = std::move(c);
+  return id;
+}
+
+void tm_ps_client_destroy(int64_t cid) {
+  std::unique_ptr<Client> c;
+  {
+    std::lock_guard<std::mutex> g(g_mu);
+    auto it = g_clients.find(cid);
+    if (it == g_clients.end()) return;
+    c = std::move(it->second);
+    g_clients.erase(it);
+  }
+  c->stop();
+}
+
+// Async SEND.  data is copied internally before returning, so the caller's
+// buffer may be reused immediately.  For RULE_ELASTIC, `inout` receives the
+// server's delta response and must stay alive until the future completes.
+int64_t tm_ps_send(int64_t cid, uint32_t rule, float alpha, uint64_t offset,
+                   const float* data, float* inout, uint64_t count) {
+  Client* c;
+  {
+    std::lock_guard<std::mutex> g(g_mu);
+    auto it = g_clients.find(cid);
+    if (it == g_clients.end()) return -1;
+    c = it->second.get();
+  }
+  int64_t fid;
+  auto fut = new_future(&fid);
+  auto payload = std::make_shared<std::vector<float>>(data, data + count);
+  c->enqueue([c, fut, rule, alpha, offset, payload, inout, count] {
+    Header h{};
+    h.op = OP_SEND;
+    h.rule = rule;
+    h.alpha = alpha;
+    h.offset = offset;
+    h.count = count;
+    std::lock_guard<std::mutex> g(c->io_mu);
+    bool ok = write_exact(c->fd, &h, sizeof(h)) &&
+              write_exact(c->fd, payload->data(), count * sizeof(float));
+    uint8_t st = 0;
+    ok = ok && read_exact(c->fd, &st, 1) && st == 1;
+    if (ok && rule == RULE_ELASTIC)
+      ok = read_exact(c->fd, inout, count * sizeof(float));
+    complete(fut, ok ? 1 : -1);
+  });
+  return fid;
+}
+
+// Async RECEIVE into `out` (must stay alive until the future completes).
+int64_t tm_ps_receive(int64_t cid, uint64_t offset, float* out,
+                      uint64_t count) {
+  Client* c;
+  {
+    std::lock_guard<std::mutex> g(g_mu);
+    auto it = g_clients.find(cid);
+    if (it == g_clients.end()) return -1;
+    c = it->second.get();
+  }
+  int64_t fid;
+  auto fut = new_future(&fid);
+  c->enqueue([c, fut, offset, out, count] {
+    Header h{};
+    h.op = OP_RECEIVE;
+    h.offset = offset;
+    h.count = count;
+    std::lock_guard<std::mutex> g(c->io_mu);
+    bool ok = write_exact(c->fd, &h, sizeof(h));
+    uint8_t st = 0;
+    ok = ok && read_exact(c->fd, &st, 1) && st == 1;
+    ok = ok && read_exact(c->fd, out, count * sizeof(float));
+    complete(fut, ok ? 1 : -1);
+  });
+  return fid;
+}
+
+// Blocking wait; returns status (1 ok, <0 error) and frees the future.
+int tm_ps_wait(int64_t fid) {
+  std::shared_ptr<Future> f;
+  {
+    std::lock_guard<std::mutex> g(g_mu);
+    auto it = g_futures.find(fid);
+    if (it == g_futures.end()) return -2;
+    f = it->second;
+    g_futures.erase(it);
+  }
+  std::unique_lock<std::mutex> lk(f->mu);
+  f->cv.wait(lk, [&] { return f->done; });
+  return f->status;
+}
+
+// Drop interest in a future without waiting (fire-and-forget sends).  The
+// in-flight job holds its own shared_ptr, so completion stays safe; this
+// just prevents unbounded growth of the registry for never-waited handles.
+void tm_ps_forget(int64_t fid) {
+  std::lock_guard<std::mutex> g(g_mu);
+  g_futures.erase(fid);
+}
+
+// Non-blocking poll: 1 done, 0 pending, -2 unknown.  Does not free.
+int tm_ps_test(int64_t fid) {
+  std::shared_ptr<Future> f;
+  {
+    std::lock_guard<std::mutex> g(g_mu);
+    auto it = g_futures.find(fid);
+    if (it == g_futures.end()) return -2;
+    f = it->second;
+  }
+  std::lock_guard<std::mutex> lk(f->mu);
+  return f->done ? 1 : 0;
+}
+
+}  // extern "C"

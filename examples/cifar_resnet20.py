@@ -1,0 +1,72 @@
+"""CIFAR-10 ResNet-20, synchronous data-parallel SGD (BASELINE config 2).
+
+Reference analog: the fb.resnet.torch CIFAR recipe driven through
+``torchmpi.nn`` gradient allreduce (SURVEY.md §8.1, reconstructed — reference
+mount empty).  Demonstrates the full stateful-model path: BatchNorm running
+statistics live in a separate collection and are cross-replica averaged with
+the same selector-routed collectives as the gradients.
+
+Run: ``python examples/cifar_resnet20.py --devices 8 --steps 60``
+"""
+
+import common
+
+
+def main():
+    args = common.parse_args(__doc__, defaults={"lr": 0.2, "steps": 60,
+                                                "batch_size": 128})
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import torchmpi_tpu as mpi
+    from torchmpi_tpu.models import ResNet20
+    from torchmpi_tpu.utils import data as dutil
+
+    mpi.init(mpi.Config(dcn_size=args.dcn))
+    if args.backend:
+        mpi.set_config(backend=args.backend, custom_min_bytes=0)
+    mesh = mpi.world_mesh()
+    model = ResNet20()
+
+    variables = model.init(jax.random.PRNGKey(args.seed),
+                           jnp.zeros((1, 32, 32, 3)), train=False)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+    tx = optax.sgd(args.lr, momentum=args.momentum)
+    opt_state = tx.init(params)
+
+    # Canonical DP recipe: grad allreduce + BatchNorm running-stats average
+    # on the same selector-routed collective path + metric reduction.
+    dp_step = mpi.recipes.make_bn_dp_train_step(model, tx, mesh=mesh,
+                                                backend=args.backend,
+                                                n_buckets=args.buckets)
+    params, opt_state, batch_stats = mpi.recipes.replicate_bn_state(
+        params, opt_state, batch_stats, mesh=mesh)
+
+    X, Y = dutil.synthetic_cifar(4096, seed=args.seed)
+    timer = common.StepTimer()
+    timer.start()
+    for i, (xb, yb) in enumerate(
+            dutil.batches(X, Y, args.batch_size, steps=args.steps,
+                          seed=args.seed)):
+        params, opt_state, batch_stats, loss = dp_step(
+            params, opt_state, batch_stats, xb, yb)
+        timer.tick()
+        if i % 10 == 0 or i == args.steps - 1:
+            print(f"step {i:4d}  loss {float(loss):.4f}")
+
+    def eval_logits(xb):
+        return model.apply({"params": params, "batch_stats": batch_stats},
+                           jnp.asarray(xb), train=False)
+
+    import numpy as np
+
+    logits = eval_logits(X[:1024])
+    acc = float((np.argmax(np.asarray(logits), 1) == Y[:1024]).mean())
+    print(f"final accuracy {acc:.3f}  ({timer.rate(args.batch_size):.0f} img/s)")
+    mpi.stop()
+    assert acc > 0.85, "CIFAR ResNet-20 did not converge"
+
+
+if __name__ == "__main__":
+    main()

@@ -15,7 +15,7 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def parse_args(description: str, **extra):
+def parse_args(description: str, defaults: dict = None, **extra):
     p = argparse.ArgumentParser(description=description)
     p.add_argument("--devices", type=int, default=0,
                    help="force N simulated CPU devices (0 = use real devices)")
@@ -32,6 +32,8 @@ def parse_args(description: str, **extra):
     p.add_argument("--seed", type=int, default=0)
     for name, kw in extra.items():
         p.add_argument(f"--{name.replace('_', '-')}", **kw)
+    if defaults:
+        p.set_defaults(**defaults)
     args = p.parse_args()
     if args.devices:
         os.environ["XLA_FLAGS"] = (
@@ -71,6 +73,39 @@ def evaluate(model, params, images, labels, batch=512):
         correct += int((np.argmax(np.asarray(logits), axis=1)
                         == labels[i:i + batch]).sum())
     return correct / len(images)
+
+
+def run_workers(worker_fn, n_workers: int) -> int:
+    """Run PS worker threads, clamped to the device count, propagating any
+    worker exception to the caller (a silently-dead worker otherwise makes
+    convergence failures undiagnosable)."""
+    import threading
+    import traceback
+
+    import jax
+
+    n = min(n_workers, len(jax.devices()))
+    if n < n_workers:
+        print(f"[common] clamping workers {n_workers} -> {n} "
+              f"(device count)")
+    errors = []
+
+    def wrap(i):
+        try:
+            worker_fn(i)
+        except Exception as e:  # noqa: BLE001 — reported to main thread
+            traceback.print_exc()
+            errors.append((i, e))
+
+    threads = [threading.Thread(target=wrap, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errors:
+        raise RuntimeError(
+            f"{len(errors)} worker(s) failed; first: {errors[0][1]!r}")
+    return n
 
 
 class StepTimer:

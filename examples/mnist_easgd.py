@@ -1,0 +1,78 @@
+"""MNIST LeNet, asynchronous EASGD via the parameter server's elastic rule.
+
+Reference analog: ``examples/mnist_easgd.lua`` [HIGH] (reconstructed —
+reference mount empty, SURVEY.md §3 C15, §4.5): each worker runs *local* SGD
+and every ``tau`` steps performs an elastic exchange with the center
+variable: ``delta = alpha * (x_i - center)``; the server moves the center by
+``+delta`` (RULE_ELASTIC) and the worker moves itself by ``-delta`` — the
+symmetric elastic averaging of Zhang et al., exactly the update the
+reference implemented server-side.
+
+Run: ``python examples/mnist_easgd.py --devices 8 --workers 4``
+"""
+
+import common
+
+
+def main():
+    args = common.parse_args(
+        __doc__,
+        workers=dict(type=int, default=4),
+        tau=dict(type=int, default=4),
+        alpha=dict(type=float, default=0.3),
+        shards=dict(type=int, default=2),
+        defaults={"steps": 120, "batch_size": 64, "lr": 0.02},
+    )
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import torchmpi_tpu as mpi
+    from torchmpi_tpu.models import LeNet
+    from torchmpi_tpu.utils import data as dutil
+
+    mpi.init()
+    model = LeNet()
+    params0 = model.init(jax.random.PRNGKey(args.seed),
+                         jnp.zeros((1, 28, 28, 1)))
+    ps = mpi.parameterserver.init(params0, num_shards=args.shards)
+
+    def local_loss(p, images, labels):
+        logits = model.apply(p, images)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels).mean()
+
+    grad_fn = jax.jit(jax.value_and_grad(local_loss))
+    devices = jax.devices()[: args.workers]
+    X, Y = dutil.synthetic_mnist(4096, seed=args.seed)
+
+    def worker(widx):
+        dev = devices[widx]
+        with jax.default_device(dev):
+            params = jax.tree.map(jnp.asarray, params0)
+            for step, (xb, yb) in enumerate(dutil.batches(
+                    X, Y, args.batch_size, steps=args.steps,
+                    seed=args.seed + widx + 1)):
+                _, grads = grad_fn(params, jnp.asarray(xb), jnp.asarray(yb))
+                params = jax.tree.map(lambda p, g: p - args.lr * g,
+                                      params, grads)
+                if step % args.tau == args.tau - 1:
+                    delta = ps.send(params, rule="elastic",
+                                    alpha=args.alpha).wait()
+                    params = jax.tree.map(
+                        lambda p, d: p - jnp.asarray(d), params, delta)
+
+    common.run_workers(worker, args.workers)
+
+    center = jax.tree.map(jnp.asarray, ps.receive().wait())
+    acc = common.evaluate(model, center, X[:1024], Y[:1024])
+    print(f"PS ops served: {ps.ops_served()}")
+    print(f"final accuracy (center) {acc:.3f}")
+    ps.shutdown()
+    mpi.stop()
+    assert acc > 0.9, "EASGD MNIST did not converge"
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,113 @@
+"""Model-zoo tests: shapes, dtypes, and a ResNet-20 DP convergence smoke
+(reference analog: examples-as-integration-tests, SURVEY.md §5)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import torchmpi_tpu as mpi
+from torchmpi_tpu.models import AlexNet, LeNet, ResNet20, ResNet50
+from torchmpi_tpu.parallel import gradsync
+from torchmpi_tpu.utils import data as dutil
+
+
+def test_lenet_shapes():
+    model = LeNet()
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((2, 28, 28, 1)))
+    out = model.apply(params, jnp.zeros((3, 28, 28, 1)))
+    assert out.shape == (3, 10)
+    assert out.dtype == jnp.float32
+
+
+def test_resnet20_shapes():
+    model = ResNet20()
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((2, 32, 32, 3)),
+                           train=False)
+    assert "batch_stats" in variables
+    out = model.apply(variables, jnp.zeros((4, 32, 32, 3)), train=False)
+    assert out.shape == (4, 10)
+    n_params = sum(int(np.prod(p.shape))
+                   for p in jax.tree.leaves(variables["params"]))
+    # ResNet-20 is ~0.27M params; catch gross architecture mistakes.
+    assert 0.2e6 < n_params < 0.4e6, n_params
+
+
+def test_resnet50_shapes_small_input():
+    model = ResNet50(num_classes=100)
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 64, 64, 3)),
+                           train=False)
+    out = model.apply(variables, jnp.zeros((2, 64, 64, 3)), train=False)
+    assert out.shape == (2, 100)
+    n_params = sum(int(np.prod(p.shape))
+                   for p in jax.tree.leaves(variables["params"]))
+    # ResNet-50 is ~25.5M params (with 100-class head ~23.9M).
+    assert 20e6 < n_params < 30e6, n_params
+
+
+def test_resnet50_bf16_params_stay_f32():
+    model = ResNet50(num_classes=10, dtype=jnp.bfloat16)
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)),
+                           train=False)
+    # params/stats in f32 (master copies), compute in bf16, logits f32.
+    assert all(p.dtype == jnp.float32
+               for p in jax.tree.leaves(variables["params"]))
+    out = model.apply(variables, jnp.zeros((2, 32, 32, 3)), train=False)
+    assert out.dtype == jnp.float32
+
+
+def test_alexnet_shapes():
+    model = AlexNet(num_classes=50)
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 224, 224, 3)),
+                           train=False)
+    out = model.apply(variables, jnp.zeros((2, 224, 224, 3)), train=False)
+    assert out.shape == (2, 50)
+
+
+@pytest.mark.slow
+def test_resnet20_dp_convergence(flat_runtime):
+    """Config-2 milestone: ResNet-20 DP with BatchNorm sync learns."""
+    mesh = mpi.world_mesh()
+    model = ResNet20()
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)),
+                           train=False)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+    tx = optax.sgd(0.2, momentum=0.9)
+    opt_state = tx.init(params)
+
+    def step(params, opt_state, batch_stats, images, labels):
+        def loss_fn(p):
+            logits, updated = model.apply(
+                {"params": p, "batch_stats": batch_stats}, images,
+                train=True, mutable=["batch_stats"])
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits, labels).mean()
+            return loss, updated["batch_stats"]
+
+        (loss, new_stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        grads = gradsync.synchronize_gradients(grads)
+        new_stats = mpi.collectives.allreduce_in_axis(
+            new_stats, mesh.axis_names, op="mean")
+        loss = mpi.collectives.allreduce_in_axis(loss, mesh.axis_names,
+                                                 op="mean")
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return (optax.apply_updates(params, updates), opt_state, new_stats,
+                loss)
+
+    dp = gradsync.data_parallel_step(step, batch_argnums=(3, 4),
+                                     donate_argnums=(0, 1, 2))
+    params = gradsync.synchronize_parameters(params)
+    opt_state = gradsync.synchronize_parameters(opt_state)
+    batch_stats = gradsync.synchronize_parameters(batch_stats)
+
+    X, Y = dutil.synthetic_cifar(1024, seed=0)
+    first = None
+    for xb, yb in dutil.batches(X, Y, 128, steps=30):
+        params, opt_state, batch_stats, loss = dp(params, opt_state,
+                                                  batch_stats, xb, yb)
+        if first is None:
+            first = float(loss)
+    last = float(loss)
+    assert last < 0.5 * first, f"no convergence: {first} -> {last}"

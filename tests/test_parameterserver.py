@@ -1,0 +1,151 @@
+"""Parameter-server tests (reference analog: test/parameterserver*.lua,
+SURVEY.md §5 [LOW]): rule semantics (k clients send 'add' -> shard equals
+sum), receive round-trips, prefetch pattern, EASGD elastic rule."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from torchmpi_tpu import parameterserver as psmod
+from torchmpi_tpu.parallel.ps import PSClient, ShardedParameterServer
+from torchmpi_tpu.utils import tree as tree_util
+
+
+def tree_of(x):
+    return {"w": np.full((4, 3), x, np.float32),
+            "b": [np.full((5,), x * 2, np.float32)]}
+
+
+def test_flatten_roundtrip():
+    t = tree_of(1.5)
+    flat, spec = tree_util.flatten_f32(t)
+    assert flat.shape == (17,)
+    back = tree_util.unflatten_f32(spec, flat)
+    np.testing.assert_allclose(back["w"], t["w"])
+    np.testing.assert_allclose(back["b"][0], t["b"][0])
+
+
+def test_init_copy_and_receive():
+    ps = psmod.init(tree_of(3.0), num_shards=2)
+    try:
+        got = ps.receive().wait()
+        np.testing.assert_allclose(got["w"], 3.0)
+        np.testing.assert_allclose(got["b"][0], 6.0)
+    finally:
+        ps.shutdown()
+
+
+def test_add_rule_accumulates():
+    ps = psmod.init(tree_of(0.0), num_shards=3)
+    try:
+        for _ in range(4):
+            ps.send(tree_of(1.0), rule="add").wait()
+        got = ps.receive().wait()
+        np.testing.assert_allclose(got["w"], 4.0)
+        np.testing.assert_allclose(got["b"][0], 8.0)
+    finally:
+        ps.shutdown()
+
+
+def test_zero_and_copy_rules():
+    ps = psmod.init(tree_of(5.0), num_shards=2)
+    try:
+        ps.send(tree_of(0.0), rule="zero").wait()
+        np.testing.assert_allclose(ps.receive().wait()["w"], 0.0)
+        ps.send(tree_of(7.0), rule="copy").wait()
+        np.testing.assert_allclose(ps.receive().wait()["b"][0], 14.0)
+    finally:
+        ps.shutdown()
+
+
+def test_axpy_rule():
+    ps = psmod.init(tree_of(1.0), num_shards=1)
+    try:
+        ps.send(tree_of(2.0), rule="axpy", alpha=0.5).wait()
+        got = ps.receive().wait()
+        np.testing.assert_allclose(got["w"], 1.0 + 0.5 * 2.0)
+    finally:
+        ps.shutdown()
+
+
+def test_elastic_rule_symmetric():
+    # EASGD: server center c, client x.  delta = a*(x-c); c += delta;
+    # client applies x -= delta.  After the exchange both moved toward each
+    # other by the same amount.
+    ps = psmod.init(tree_of(0.0), num_shards=2)
+    try:
+        x = tree_of(1.0)
+        h = ps.send(x, rule="elastic", alpha=0.25)
+        delta = h.wait()
+        np.testing.assert_allclose(delta["w"], 0.25)  # 0.25*(1-0)
+        center = ps.receive().wait()
+        np.testing.assert_allclose(center["w"], 0.25)
+        new_x = x["w"] - delta["w"]
+        np.testing.assert_allclose(new_x, 0.75)
+    finally:
+        ps.shutdown()
+
+
+def test_async_prefetch_pattern():
+    # SURVEY §4.5: issue receive (prefetch), compute, then sync.
+    ps = psmod.init(tree_of(2.0), num_shards=2)
+    try:
+        h = ps.receive()
+        _ = np.ones((64, 64)) @ np.ones((64, 64))  # "compute"
+        got = h.wait()
+        np.testing.assert_allclose(got["w"], 2.0)
+        assert h.done
+    finally:
+        ps.shutdown()
+
+
+def test_concurrent_clients_add():
+    # k clients send 'add' concurrently -> shard equals the sum (the
+    # reference's rule-correctness test under real concurrency).
+    template = tree_of(0.0)
+    flat, spec = tree_util.flatten_f32(template)
+    servers = ShardedParameterServer(spec.total, num_shards=2)
+    k, iters = 4, 8
+    try:
+        def worker():
+            c = PSClient(template, servers.ports, servers.shard_bounds)
+            for _ in range(iters):
+                c.send(tree_of(1.0), rule="add").wait()
+            c.shutdown()
+
+        threads = [threading.Thread(target=worker) for _ in range(k)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        reader = PSClient(template, servers.ports, servers.shard_bounds)
+        got = reader.receive().wait()
+        np.testing.assert_allclose(got["w"], k * iters)
+        reader.shutdown()
+        assert servers.ops_served() >= k * iters
+    finally:
+        servers.shutdown()
+
+
+def test_send_ordering_same_client():
+    # Ops on one client connection execute in submission order (SURVEY §4.4
+    # async-ordering guarantee): copy(9) then add(1) must give 10, never 9.
+    ps = psmod.init(tree_of(0.0), num_shards=1)
+    try:
+        h1 = ps.send(tree_of(9.0), rule="copy")
+        h2 = ps.send(tree_of(1.0), rule="add")
+        h1.wait()
+        h2.wait()
+        np.testing.assert_allclose(ps.receive().wait()["w"], 10.0)
+    finally:
+        ps.shutdown()
+
+
+def test_wrong_size_rejected():
+    ps = psmod.init(tree_of(0.0), num_shards=1)
+    try:
+        with pytest.raises(ValueError):
+            ps.send({"w": np.zeros((2, 2), np.float32)})
+    finally:
+        ps.shutdown()

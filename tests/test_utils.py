@@ -1,0 +1,75 @@
+"""Checkpoint/metrics/tracing utility tests (SURVEY.md §6 subsystems)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmpi_tpu.utils import checkpoint, metrics, tracing
+
+
+def tree():
+    return {"layer": {"w": np.arange(12, dtype=np.float32).reshape(3, 4),
+                      "b": np.ones((4,), np.float32)},
+            "scale": np.float32(2.5) * np.ones((), np.float32)}
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    t = tree()
+    path = checkpoint.save(str(tmp_path), t, step=3)
+    assert os.path.exists(path)
+    template = jax.tree.map(np.zeros_like, t)
+    back = checkpoint.restore(str(tmp_path), template)
+    np.testing.assert_allclose(back["layer"]["w"], t["layer"]["w"])
+    np.testing.assert_allclose(back["scale"], t["scale"])
+
+
+def test_checkpoint_latest_step(tmp_path):
+    t = tree()
+    checkpoint.save(str(tmp_path), t, step=1)
+    checkpoint.save(str(tmp_path), t, step=10)
+    assert checkpoint.latest_step(str(tmp_path)) == 10
+    back = checkpoint.restore(str(tmp_path), t)  # picks 10
+    assert back is not None
+
+
+def test_checkpoint_missing(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        checkpoint.restore(str(tmp_path / "nope"), tree())
+
+
+def test_fence_and_timer():
+    x = jnp.ones((8, 8))
+    timer = metrics.Timer()
+    timer.start(fence_on=x)
+    y = x @ x
+    timer.tick()
+    dt = timer.stop(fence_on=y)
+    assert dt >= 0 and timer.steps == 1
+
+
+def test_metrics_logger(tmp_path):
+    log = metrics.MetricsLogger(str(tmp_path / "m.jsonl"))
+    log.log(step=1, img_s=123.0)
+    log.log(step=2, img_s=125.0)
+    assert len(log.records) == 2
+    lines = (tmp_path / "m.jsonl").read_text().strip().split("\n")
+    assert len(lines) == 2 and '"img_s": 123.0' in lines[0]
+
+
+def test_bus_bandwidth_formula():
+    # 8 devices, 1 GB reduced in 1 s: algbw 1 GB/s, busbw = 2*7/8.
+    bw = metrics.allreduce_bus_bandwidth(int(1e9), 8, 1.0)
+    assert abs(bw - 2 * 7 / 8) < 1e-9
+    assert metrics.allreduce_bus_bandwidth(100, 1, 1.0) == 0.0
+
+
+def test_annotate_inside_jit():
+    @jax.jit
+    def f(x):
+        with tracing.annotate("torchmpi_tpu.test_span"):
+            return x * 2
+
+    np.testing.assert_allclose(np.asarray(f(jnp.ones(3))), 2.0)

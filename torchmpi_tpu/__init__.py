@@ -48,7 +48,10 @@ from .runtime import (
 from . import collectives
 from . import selector
 from . import parallel
+from . import ops
 from . import nn
+from . import parameterserver
+from . import recipes
 from .collectives import (
     allreduce,
     broadcast,

@@ -245,8 +245,11 @@ def _eager_collective(op_name: str, x, *, mesh: Optional[Mesh] = None,
         out_spec = lead
         in_spec = lead
 
+        # check_vma=False: the rank-major eager mode states its shardings
+        # fully explicitly, and custom (pallas) backends cannot express vma
+        # through pallas_call uniformly.
         shmapped = shard_map(body, mesh=m, in_specs=(in_spec,),
-                             out_specs=out_spec)
+                             out_specs=out_spec, check_vma=False)
         fn = jax.jit(shmapped)
         _jit_cache[key] = fn
     sharding = NamedSharding(m, P(m.axis_names))

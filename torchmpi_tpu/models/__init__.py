@@ -3,3 +3,12 @@ ResNet-20 (CIFAR-10), ResNet-50 (ImageNet), AlexNet (Downpour).  Implemented
 in flax.linen, bfloat16-friendly, static shapes — MXU-ready."""
 
 from .lenet import LeNet  # noqa: F401
+from .alexnet import AlexNet  # noqa: F401
+from .resnet import (  # noqa: F401
+    ResNet,
+    ResNet18,
+    ResNet20,
+    ResNet50,
+    BasicBlock,
+    BottleneckBlock,
+)

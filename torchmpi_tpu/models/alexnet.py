@@ -1,0 +1,45 @@
+"""AlexNet — the reference's async Downpour-SGD workload (SURVEY.md §8.1
+config 4, reconstructed — reference mount empty).
+
+TPU-first notes: NHWC, SAME padding, channel counts kept as upstream AlexNet
+(the MXU tiles 64/128-multiples best; AlexNet's 96/256/384 channels are close
+enough that XLA pads without measurable waste at these sizes).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class AlexNet(nn.Module):
+    num_classes: int = 1000
+    dtype: jnp.dtype = jnp.float32
+    dropout: float = 0.5
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):  # x: [B, 224, 224, 3]
+        x = x.astype(self.dtype)
+        x = nn.Conv(96, (11, 11), (4, 4), padding="SAME",
+                    dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = nn.Conv(256, (5, 5), padding="SAME", dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = nn.Conv(384, (3, 3), padding="SAME", dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.Conv(384, (3, 3), padding="SAME", dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.Conv(256, (3, 3), padding="SAME", dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(4096, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        x = nn.Dense(4096, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return x

@@ -1,0 +1,132 @@
+"""ResNet family: CIFAR ResNet-20 and ImageNet ResNet-50.
+
+Reference workloads (SURVEY.md §8.1, reconstructed — reference mount empty):
+the reference integrated with ``fb.resnet.torch`` for CIFAR/ImageNet
+data-parallel training [HIGH].  This is a TPU-first reimplementation of the
+same model family, not a port: NHWC layouts, bfloat16 compute with float32
+params/statistics (MXU-friendly), BatchNorm running statistics kept in a
+separate ``batch_stats`` collection so the data-parallel step can
+cross-replica average them.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class BasicBlock(nn.Module):
+    """3x3 + 3x3 residual block (ResNet-18/20/34 style)."""
+
+    filters: int
+    conv: ModuleDef
+    norm: ModuleDef
+    act: Callable
+    strides: Tuple[int, int] = (1, 1)
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (3, 3), self.strides)(x)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters, (3, 3))(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters, (1, 1), self.strides,
+                                 name="conv_proj")(residual)
+            residual = self.norm(name="norm_proj")(residual)
+        return self.act(residual + y)
+
+
+class BottleneckBlock(nn.Module):
+    """1x1 -> 3x3 -> 1x1 bottleneck (ResNet-50/101/152 style)."""
+
+    filters: int
+    conv: ModuleDef
+    norm: ModuleDef
+    act: Callable
+    strides: Tuple[int, int] = (1, 1)
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1))(x)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters, (3, 3), self.strides)(y)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters * 4, (1, 1))(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters * 4, (1, 1), self.strides,
+                                 name="conv_proj")(residual)
+            residual = self.norm(name="norm_proj")(residual)
+        return self.act(residual + y)
+
+
+class ResNet(nn.Module):
+    """Generic ResNet over NHWC inputs.
+
+    ``stem``: "imagenet" (7x7/2 conv + 3x3/2 maxpool) or "cifar" (3x3 conv).
+    """
+
+    stage_sizes: Sequence[int]
+    block_cls: ModuleDef
+    num_classes: int
+    num_filters: int = 64
+    stem: str = "imagenet"
+    dtype: jnp.dtype = jnp.float32
+    act: Callable = nn.relu
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype,
+                       padding="SAME")
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=self.dtype)
+        x = x.astype(self.dtype)
+        if self.stem == "imagenet":
+            x = conv(self.num_filters, (7, 7), (2, 2), name="conv_init")(x)
+            x = norm(name="bn_init")(x)
+            x = self.act(x)
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        else:
+            x = conv(self.num_filters, (3, 3), name="conv_init")(x)
+            x = norm(name="bn_init")(x)
+            x = self.act(x)
+        for i, block_count in enumerate(self.stage_sizes):
+            for j in range(block_count):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = self.block_cls(
+                    filters=self.num_filters * 2 ** i,
+                    conv=conv, norm=norm, act=self.act, strides=strides,
+                )(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return x
+
+
+def ResNet20(num_classes: int = 10, dtype=jnp.float32) -> ResNet:
+    """CIFAR ResNet-20: 3 stages x 3 basic blocks, 16 base filters."""
+    return ResNet(stage_sizes=[3, 3, 3], block_cls=BasicBlock,
+                  num_classes=num_classes, num_filters=16, stem="cifar",
+                  dtype=dtype)
+
+
+def ResNet18(num_classes: int = 1000, dtype=jnp.float32) -> ResNet:
+    return ResNet(stage_sizes=[2, 2, 2, 2], block_cls=BasicBlock,
+                  num_classes=num_classes, dtype=dtype)
+
+
+def ResNet50(num_classes: int = 1000, dtype=jnp.float32) -> ResNet:
+    """ImageNet ResNet-50: [3, 4, 6, 3] bottlenecks — the headline workload
+    (BASELINE.md: >=90% scaling efficiency on v5e-64)."""
+    return ResNet(stage_sizes=[3, 4, 6, 3], block_cls=BottleneckBlock,
+                  num_classes=num_classes, dtype=dtype)

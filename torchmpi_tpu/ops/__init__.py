@@ -1,0 +1,10 @@
+"""Pallas TPU kernels: custom collectives over ICI remote DMA.
+
+The analog of the reference's hand-tuned chunked/pipelined collective
+algorithms (SURVEY.md §3 C4: ring/tree over MPI_Isend/Irecv + CUDA IPC).  On
+TPU the point-to-point transport is inter-chip RDMA issued from Pallas
+kernels; the ring algorithm is the same one the reference pipelined over
+MPI p2p.
+"""
+
+from . import ring  # noqa: F401  (registers the "pallas" backend)

@@ -1,0 +1,213 @@
+"""Pallas ring allreduce over ICI inter-chip RDMA.
+
+The TPU-native analog of the reference's custom chunked/pipelined allreduce
+(SURVEY.md §3 C4, §4.2 — reconstructed, reference mount empty): where the
+reference pipelined MPI_Isend/Irecv rings over chunks with CUDA-IPC intra-node
+legs, this kernel drives the ICI links directly with async remote DMA and
+double-buffered chunk slots.
+
+Algorithm: classic bandwidth-optimal ring — (n-1) reduce-scatter steps then
+(n-1) all-gather steps, each device moving one chunk of ``1/n`` of the tensor
+per step, so total bytes-on-wire per device = ``2 (n-1)/n * size`` (the same
+bound XLA's allreduce targets; the point of this kernel, as of the
+reference's, is a *tunable, inspectable* implementation to benchmark against
+the stock one, and a scaffold for fusing compute into collective steps).
+
+Flow-control protocol per step (slot = step % 2):
+
+  1. wait ``ack[slot]`` (skipped for the first two steps): the right
+     neighbor has consumed this slot from the previous round, so the remote
+     buffer is free — prevents the slot-reuse race in the naive pattern.
+  2. RDMA my send-chunk into the right neighbor's ``comm[slot]``;
+     ``wait()`` covers both my outgoing send and my incoming chunk
+     (symmetric SPMD: every device runs the same step).
+  3. combine/copy received chunk; signal ``ack[slot]`` to the left neighbor.
+
+Registered with the selector as backend ``"pallas"`` for allreduce.  Tested
+in Pallas TPU interpret mode on the CPU mesh (with ``detect_races=True`` —
+the race-detection story, SURVEY.md §6.2) and runnable on real ICI unchanged.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .. import selector
+
+# Chunk granularity: one (8, 128) f32 tile row group.  Chunks are laid out
+# [rows, 128]; rows must be a multiple of 8 for clean VMEM tiling.
+_LANES = 128
+_SUBLANES = 8
+_TILE = _LANES * _SUBLANES
+
+# Interpret-mode toggle for tests (real TPU when False).
+_INTERPRET: Optional[pltpu.InterpretParams] = None
+
+
+def set_interpret(params: Optional[pltpu.InterpretParams]) -> None:
+    """Enable TPU interpret mode (CPU simulation; supports detect_races)."""
+    global _INTERPRET
+    _INTERPRET = params
+
+
+def _ring_allreduce_kernel(x_ref, o_ref, comm_ref, send_sem, recv_sem,
+                           ack_sem, *, n: int, axis: str,
+                           mesh_axes: Tuple[str, ...]):
+    """Per-device kernel.  x/o: [n, rows, 128]; comm: [2, rows, 128]."""
+    my = lax.axis_index(axis)
+    right = lax.rem(my + 1, n)
+    left = lax.rem(my + n - 1, n)
+
+    def coords(idx):
+        # Flat logical device id of the ring neighbor: other mesh axes keep
+        # our own position, the ring axis takes `idx` (row-major over the
+        # mesh axis order, which is how LOGICAL ids are assigned).
+        lid = jnp.int32(0)
+        for a in mesh_axes:
+            pos = idx if a == axis else lax.axis_index(a)
+            lid = lid * lax.axis_size(a) + pos
+        return lid
+
+    # Neighbor barrier: both neighbors are inside the kernel before any RDMA.
+    bsem = pltpu.get_barrier_semaphore()
+    pltpu.semaphore_signal(bsem, inc=1, device_id=coords(left),
+                           device_id_type=pltpu.DeviceIdType.LOGICAL)
+    pltpu.semaphore_signal(bsem, inc=1, device_id=coords(right),
+                           device_id_type=pltpu.DeviceIdType.LOGICAL)
+    pltpu.semaphore_wait(bsem, 2)
+
+    o_ref[...] = x_ref[...]
+
+    total_steps = 2 * (n - 1)
+    for s in range(total_steps):  # n is static: fully unrolled
+        slot = s % 2
+        reduce_phase = s < n - 1
+        if reduce_phase:
+            send_idx = lax.rem(my + n - s, n) if s else my
+            recv_idx = lax.rem(my + 2 * n - s - 1, n)
+        else:
+            t = s - (n - 1)
+            send_idx = lax.rem(my + 1 + n - t, n)
+            recv_idx = lax.rem(my + n - t, n)
+
+        if s >= 2:
+            # Right neighbor must have freed this slot.
+            pltpu.semaphore_wait(ack_sem, 1)
+
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=o_ref.at[send_idx],
+            dst_ref=comm_ref.at[slot],
+            send_sem=send_sem.at[slot],
+            recv_sem=recv_sem.at[slot],
+            device_id=coords(right),
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        rdma.start()
+        rdma.wait()
+
+        if reduce_phase:
+            o_ref[recv_idx] = o_ref[recv_idx] + comm_ref[slot]
+        else:
+            o_ref[recv_idx] = comm_ref[slot]
+
+        # Tell the left neighbor its copy of this slot is consumed.
+        pltpu.semaphore_signal(ack_sem, inc=1, device_id=coords(left),
+                               device_id_type=pltpu.DeviceIdType.LOGICAL)
+
+    # Drain outstanding acks so the kernel exits with clean semaphore state:
+    # our last two sends were acked by nobody yet... they were: every step
+    # sent an ack, but the final two acks from the right neighbor target
+    # slots we never rewrite.  Consume them to leave the semaphore at zero.
+    pltpu.semaphore_wait(ack_sem, 2)
+
+
+def _ring_allreduce_padded(flat, n: int, axis: str,
+                           mesh_axes: Tuple[str, ...]):
+    """flat: [n * rows * 128] on each device, already padded."""
+    per = flat.shape[0] // n
+    rows = per // _LANES
+    x = flat.reshape(n, rows, _LANES)
+    kernel = functools.partial(_ring_allreduce_kernel, n=n, axis=axis,
+                               mesh_axes=mesh_axes)
+    try:
+        vma = jax.typeof(x).vma  # propagate under check_vma tracing
+    except Exception:
+        vma = None
+    out_sds = (jax.ShapeDtypeStruct(x.shape, x.dtype, vma=vma)
+               if vma else jax.ShapeDtypeStruct(x.shape, x.dtype))
+    out = pl.pallas_call(
+        kernel,
+        out_shape=out_sds,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((2, rows, _LANES), x.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.REGULAR,
+        ],
+        compiler_params=pltpu.CompilerParams(collective_id=7),
+        interpret=(_INTERPRET if _INTERPRET is not None else False),
+    )(x)
+    return out.reshape(-1)
+
+
+def ring_allreduce(x, axis_names, *, op: str = "sum"):
+    """Selector-registered entry: allreduce over the *last* axis in
+    ``axis_names`` with the ring kernel; any leading axes (e.g. ``dcn``) are
+    reduced with a stock psum afterwards (hierarchical composition).
+    """
+    if op not in ("sum", "mean"):
+        raise KeyError(f"pallas ring allreduce does not support op {op!r}")
+    axes = (axis_names,) if isinstance(axis_names, str) else tuple(axis_names)
+    ring_axis = axes[-1]
+    outer_axes = axes[:-1]
+    n = lax.axis_size(ring_axis)
+
+    # Logical device ids need the coordinates over ALL mesh axes of the
+    # enclosing shard_map, not just the ring axis.  The tracing axis
+    # environment lists exactly those, in mesh order (verified against the
+    # executing mesh, unlike the global runtime mesh which may differ when a
+    # caller passes an explicit mesh to the eager API).
+    try:
+        from jax._src.core import get_axis_env
+
+        mesh_axes = tuple(get_axis_env().axis_names())
+    except Exception:
+        mesh_axes = axes
+    if not all(a in mesh_axes for a in axes):
+        mesh_axes = axes
+
+    if n == 1:
+        out = x
+    else:
+        shape, dtype = x.shape, x.dtype
+        flat = x.reshape(-1)
+        if dtype not in (jnp.float32, jnp.bfloat16, jnp.int32):
+            flat = flat.astype(jnp.float32)
+        pad = (-flat.shape[0]) % (n * _TILE)
+        if pad:
+            flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+        reduced = _ring_allreduce_padded(flat, n, ring_axis, mesh_axes)
+        if pad:
+            reduced = reduced[:reduced.shape[0] - pad]
+        out = reduced.reshape(shape).astype(dtype)
+    for a in outer_axes:
+        out = lax.psum(out, a)
+    if op == "mean":
+        total = n
+        for a in outer_axes:
+            total *= lax.axis_size(a)
+        out = out / total
+    return out
+
+
+selector.register("allreduce", "pallas", ring_allreduce)

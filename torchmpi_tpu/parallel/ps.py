@@ -1,0 +1,315 @@
+"""Asynchronous parameter server: Python client/orchestration over the C++
+host transport (csrc/ps.cpp).
+
+Rebuild of the reference's C8 parameter-server shards + C11 Lua client
+(``lib/parameterserver.cpp``, ``torchmpi/parameterserver.lua`` [MED],
+SURVEY.md §3/§4.5 — reconstructed, reference mount empty):
+
+- a flat parameter vector is sharded across server instances (the reference
+  sharded across ranks; here each host runs servers as native threads and
+  clients reach them over TCP/DCN);
+- clients ``send(tree, rule)`` / ``receive()`` asynchronously and wait on
+  opaque handles (the prefetch pattern in §4.5);
+- server-side update rules: ``copy``/``add``/``zero``/``axpy`` plus the
+  EASGD ``elastic`` rule (server returns the elastic delta so client and
+  center move symmetrically).
+
+This lives deliberately outside SPMD: async PS traffic cannot ride
+gang-scheduled XLA collectives (SURVEY.md §8.2.5); device arrays are staged
+host-side (numpy) exactly as the reference staged GPU tensors through pinned
+buffers.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..utils import tree as tree_util
+
+PyTree = Any
+
+RULES = {"copy": 0, "add": 1, "zero": 2, "axpy": 3, "elastic": 4}
+
+_LIB_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def _load_lib() -> ctypes.CDLL:
+    """Load (building if necessary) the host-transport shared library."""
+    global _LIB
+    with _LIB_LOCK:
+        if _LIB is not None:
+            return _LIB
+        root = _repo_root()
+        so = os.path.join(root, "build", "libtorchmpi_ps.so")
+        src = os.path.join(root, "csrc", "ps.cpp")
+        stale = (not os.path.exists(so)
+                 or (os.path.exists(src)
+                     and os.path.getmtime(src) > os.path.getmtime(so)))
+        if stale:
+            subprocess.run(["make", "-C", os.path.join(root, "csrc")],
+                           check=True, capture_output=True)
+        lib = ctypes.CDLL(so)
+        lib.tm_ps_server_create.restype = ctypes.c_int64
+        lib.tm_ps_server_create.argtypes = [ctypes.c_uint64, ctypes.c_int]
+        lib.tm_ps_server_port.restype = ctypes.c_int
+        lib.tm_ps_server_port.argtypes = [ctypes.c_int64]
+        lib.tm_ps_server_ops.restype = ctypes.c_uint64
+        lib.tm_ps_server_ops.argtypes = [ctypes.c_int64]
+        lib.tm_ps_server_destroy.restype = None
+        lib.tm_ps_server_destroy.argtypes = [ctypes.c_int64]
+        lib.tm_ps_client_connect.restype = ctypes.c_int64
+        lib.tm_ps_client_connect.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.tm_ps_client_destroy.restype = None
+        lib.tm_ps_client_destroy.argtypes = [ctypes.c_int64]
+        lib.tm_ps_send.restype = ctypes.c_int64
+        lib.tm_ps_send.argtypes = [
+            ctypes.c_int64, ctypes.c_uint32, ctypes.c_float, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+            ctypes.c_uint64]
+        lib.tm_ps_receive.restype = ctypes.c_int64
+        lib.tm_ps_receive.argtypes = [
+            ctypes.c_int64, ctypes.c_uint64, ctypes.POINTER(ctypes.c_float),
+            ctypes.c_uint64]
+        lib.tm_ps_wait.restype = ctypes.c_int
+        lib.tm_ps_wait.argtypes = [ctypes.c_int64]
+        lib.tm_ps_test.restype = ctypes.c_int
+        lib.tm_ps_test.argtypes = [ctypes.c_int64]
+        lib.tm_ps_forget.restype = None
+        lib.tm_ps_forget.argtypes = [ctypes.c_int64]
+        _LIB = lib
+        return lib
+
+
+def _fptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+class PSHandle:
+    """Opaque async handle (reference: parameterserver.syncHandle target).
+
+    Holds references to the numpy buffers the native side writes into, so
+    they stay alive until ``wait()``.
+    """
+
+    def __init__(self, lib, future_ids: List[int],
+                 buffers: List[np.ndarray], result_fn=None):
+        self._lib = lib
+        self._pending = list(future_ids)  # not yet waited/freed
+        self._buffers = buffers  # keep-alive
+        self._result_fn = result_fn
+        self._done = False
+        self._failed = False
+        self._result = None
+
+    def wait(self):
+        if self._failed:
+            raise RuntimeError("parameter-server op already failed")
+        if not self._done:
+            while self._pending:
+                fid = self._pending[0]
+                status = self._lib.tm_ps_wait(fid)  # frees the future
+                self._pending.pop(0)
+                if status != 1:
+                    self._failed = True
+                    for rest in self._pending:
+                        self._lib.tm_ps_forget(rest)
+                    self._pending = []
+                    raise RuntimeError(f"parameter-server op failed "
+                                       f"(status {status})")
+            self._done = True
+            self._result = (self._result_fn() if self._result_fn is not None
+                            else None)
+        return self._result
+
+    @property
+    def done(self) -> bool:
+        if self._done or self._failed:
+            return True
+        return all(self._lib.tm_ps_test(fid) == 1 for fid in self._pending)
+
+    def __del__(self):
+        # Fire-and-forget handles (async pushes never waited on) must not
+        # leak future registry entries in the native layer.  Handles whose
+        # ops write back into Python-owned buffers (receive / elastic —
+        # marked by result_fn) must instead be drained: forgetting them
+        # would free numpy memory the native thread still writes.
+        try:
+            pending = getattr(self, "_pending", [])
+            if self._result_fn is not None:
+                for fid in pending:
+                    self._lib.tm_ps_wait(fid)
+            else:
+                for fid in pending:
+                    self._lib.tm_ps_forget(fid)
+        except Exception:
+            pass
+
+
+class ShardedParameterServer:
+    """Server-side: owns `num_shards` shard servers as native threads.
+
+    The reference co-located one shard per rank; on TPU hosts run
+    ``init_servers`` once per host (one process), and every worker connects
+    with :class:`PSClient`.
+    """
+
+    def __init__(self, total_floats: int, num_shards: int = 1,
+                 base_port: int = 0):
+        self._lib = _load_lib()
+        self.total = int(total_floats)
+        self.num_shards = num_shards
+        bounds = np.linspace(0, self.total, num_shards + 1).astype(np.int64)
+        self.shard_bounds: List[Tuple[int, int]] = [
+            (int(bounds[i]), int(bounds[i + 1])) for i in range(num_shards)]
+        self.server_ids: List[int] = []
+        self.ports: List[int] = []
+        for i, (lo, hi) in enumerate(self.shard_bounds):
+            port = 0 if base_port == 0 else base_port + i
+            sid = self._lib.tm_ps_server_create(hi - lo, port)
+            if sid < 0:
+                raise RuntimeError("failed to start PS shard server")
+            self.server_ids.append(sid)
+            self.ports.append(self._lib.tm_ps_server_port(sid))
+
+    def ops_served(self) -> int:
+        return sum(self._lib.tm_ps_server_ops(s) for s in self.server_ids)
+
+    def shutdown(self) -> None:
+        for sid in self.server_ids:
+            self._lib.tm_ps_server_destroy(sid)
+        self.server_ids = []
+
+    def __del__(self):  # best effort
+        try:
+            self.shutdown()
+        except Exception:
+            pass
+
+
+class PSClient:
+    """Client-side: async send/receive against the shard servers."""
+
+    def __init__(self, template: PyTree,
+                 ports: Sequence[int],
+                 shard_bounds: Sequence[Tuple[int, int]],
+                 host: str = "127.0.0.1"):
+        self._lib = _load_lib()
+        flat, self.spec = tree_util.flatten_f32(template)
+        self.total = self.spec.total
+        self.shard_bounds = list(shard_bounds)
+        self.client_ids: List[int] = []
+        for port in ports:
+            cid = self._lib.tm_ps_client_connect(host.encode(), int(port))
+            if cid < 0:
+                raise RuntimeError(f"failed to connect to PS at "
+                                   f"{host}:{port}")
+            self.client_ids.append(cid)
+
+    def _per_shard(self, flat: np.ndarray):
+        for cid, (lo, hi) in zip(self.client_ids, self.shard_bounds):
+            yield cid, lo, hi, flat[lo:hi]
+
+    def send(self, tree: PyTree, rule: str = "add",
+             alpha: float = 1.0) -> PSHandle:
+        """Async push (reference: ``ps.send(handle, grads, rule)``).
+
+        For ``rule="elastic"`` the handle's ``wait()`` returns the elastic
+        delta pytree (subtract it from the local params — EASGD)."""
+        rid = RULES[rule]
+        flat, _ = tree_util.flatten_f32(tree)
+        if flat.shape[0] != self.total:
+            raise ValueError(f"tree has {flat.shape[0]} floats, PS holds "
+                             f"{self.total}")
+        fids, bufs = [], []
+        inout_full = (np.zeros_like(flat) if rule == "elastic" else None)
+        for cid, lo, hi, seg in self._per_shard(flat):
+            seg = np.ascontiguousarray(seg, np.float32)
+            inout = (inout_full[lo:hi] if inout_full is not None
+                     else np.zeros((0,), np.float32))
+            if inout_full is not None and not inout.flags.c_contiguous:
+                inout = np.ascontiguousarray(inout)
+            fid = self._lib.tm_ps_send(cid, rid, float(alpha), 0, _fptr(seg),
+                                       _fptr(inout), hi - lo)
+            if fid < 0:
+                raise RuntimeError("ps send failed to enqueue")
+            fids.append(fid)
+            bufs.extend([seg, inout])
+        result_fn = None
+        if rule == "elastic":
+            result_fn = lambda: tree_util.unflatten_f32(self.spec, inout_full)
+        return PSHandle(self._lib, fids, bufs, result_fn)
+
+    def receive(self) -> PSHandle:
+        """Async pull of the full parameter vector (prefetch pattern);
+        ``wait()`` returns the pytree."""
+        out = np.zeros((self.total,), np.float32)
+        fids, bufs = [], []
+        for cid, lo, hi, _ in self._per_shard(out):
+            seg = out[lo:hi]
+            if not seg.flags.c_contiguous:
+                seg = np.ascontiguousarray(seg)
+            fid = self._lib.tm_ps_receive(cid, 0, _fptr(seg), hi - lo)
+            if fid < 0:
+                raise RuntimeError("ps receive failed to enqueue")
+            fids.append(fid)
+            bufs.append(seg)
+        return PSHandle(self._lib, fids, bufs,
+                        lambda: tree_util.unflatten_f32(self.spec, out))
+
+    def shutdown(self) -> None:
+        for cid in self.client_ids:
+            self._lib.tm_ps_client_destroy(cid)
+        self.client_ids = []
+
+    def __del__(self):
+        try:
+            self.shutdown()
+        except Exception:
+            pass
+
+
+class ParameterServer:
+    """Single-process convenience: servers + one client, the shape the
+    reference exposed via ``parameterserver.init(flatParams)``."""
+
+    def __init__(self, template: PyTree, num_shards: int = 2,
+                 host: str = "127.0.0.1", base_port: int = 0,
+                 init: str = "copy"):
+        flat, spec = tree_util.flatten_f32(template)
+        self.servers = ShardedParameterServer(spec.total, num_shards,
+                                              base_port)
+        self.client = PSClient(template, self.servers.ports,
+                               self.servers.shard_bounds, host)
+        if init == "copy":
+            self.client.send(template, rule="copy").wait()
+
+    def send(self, tree: PyTree, rule: str = "add",
+             alpha: float = 1.0) -> PSHandle:
+        return self.client.send(tree, rule, alpha)
+
+    def receive(self) -> PSHandle:
+        return self.client.receive()
+
+    def ops_served(self) -> int:
+        return self.servers.ops_served()
+
+    def shutdown(self) -> None:
+        self.client.shutdown()
+        self.servers.shutdown()
+
+
+def sync_handle(h: PSHandle):
+    """Reference: ``parameterserver.syncHandle(h)``."""
+    return h.wait()

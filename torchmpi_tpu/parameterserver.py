@@ -1,0 +1,19 @@
+"""``torchmpi_tpu.parameterserver`` — the ``torchmpi.parameterserver``
+integration surface (SURVEY.md §3 C11, reconstructed — reference mount
+empty).  Thin facade over :mod:`torchmpi_tpu.parallel.ps` keeping the
+reference's module layout and verbs (init/send/receive/syncHandle)."""
+
+from .parallel.ps import (  # noqa: F401
+    RULES,
+    PSHandle,
+    PSClient,
+    ShardedParameterServer,
+    ParameterServer,
+    sync_handle,
+)
+
+
+def init(template, num_shards: int = 2, **kw) -> ParameterServer:
+    """Reference: ``parameterserver.init(flatParams)`` — starts shard servers
+    and connects a client, seeding shards with ``template``'s values."""
+    return ParameterServer(template, num_shards=num_shards, **kw)

@@ -1,0 +1,73 @@
+"""Reusable training-step recipes.
+
+TorchMPI was "a communication library plus two thin integration layers", not
+a trainer (SURVEY.md §1) — this module keeps that boundary: it contains no
+training loop, just the canonical composition of the library's own pieces
+(``nn.synchronize_gradients`` + BatchNorm-stats sync + metric reduction
+inside a ``data_parallel_step``), so the examples, benchmark, and driver
+entry points share one definition of the data-parallel step instead of four
+copies.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import optax
+
+from . import collectives, nn, runtime
+
+
+def make_bn_dp_train_step(
+    model: Any,
+    tx: optax.GradientTransformation,
+    *,
+    mesh=None,
+    backend: Optional[str] = None,
+    n_buckets: Optional[int] = None,
+    donate: bool = True,
+) -> Callable:
+    """Build the canonical data-parallel SGD step for a flax model carrying a
+    ``batch_stats`` (BatchNorm) collection.
+
+    Returned callable: ``dp_step(params, opt_state, batch_stats, images,
+    labels) -> (params, opt_state, batch_stats, loss)`` — gradients
+    allreduced through the selector-routed backend, BatchNorm running stats
+    cross-replica averaged on the same path, loss reduced for logging.
+    """
+    m = mesh if mesh is not None else runtime.current_mesh()
+    axes = tuple(m.axis_names)
+
+    def step(params, opt_state, batch_stats, images, labels):
+        def loss_fn(p):
+            logits, updated = model.apply(
+                {"params": p, "batch_stats": batch_stats}, images,
+                train=True, mutable=["batch_stats"])
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits, labels).mean()
+            return loss, updated["batch_stats"]
+
+        (loss, new_stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        grads = nn.synchronize_gradients(grads, axes, backend=backend,
+                                         n_buckets=n_buckets)
+        new_stats = collectives.allreduce_in_axis(new_stats, axes, op="mean",
+                                                  backend=backend)
+        loss = collectives.allreduce_in_axis(loss, axes, op="mean")
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return (optax.apply_updates(params, updates), opt_state, new_stats,
+                loss)
+
+    return nn.data_parallel_step(
+        step, mesh=m, batch_argnums=(3, 4),
+        donate_argnums=(0, 1, 2) if donate else ())
+
+
+def replicate_bn_state(params, opt_state, batch_stats, *, mesh=None
+                       ) -> Tuple[Any, Any, Any]:
+    """Replicate (params, opt_state, batch_stats) across the mesh — the
+    synchronizeParameters step of the recipe."""
+    return (nn.synchronize_parameters(params, mesh=mesh),
+            nn.synchronize_parameters(opt_state, mesh=mesh),
+            nn.synchronize_parameters(batch_stats, mesh=mesh))

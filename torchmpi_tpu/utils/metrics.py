@@ -1,0 +1,71 @@
+"""Structured metrics & timing (SURVEY.md §6.5: the reference had print-only
+observability; the BASELINE metrics demand per-step structure).
+
+Platform note: on relay-tunneled TPU platforms ``block_until_ready`` can
+return before real device execution completes, so :func:`fence` synchronizes
+with a one-element device->host readback — the only reliable fence observed
+on this environment (and harmless elsewhere).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+
+
+def fence(x) -> None:
+    """Hard synchronization: force a readback of one element of ``x``."""
+    leaf = jax.tree.leaves(x)[0]
+    np.asarray(jax.device_get(leaf.ravel()[0] if leaf.ndim else leaf))
+
+
+class Timer:
+    """Wall-clock step timer with warmup and fenced boundaries."""
+
+    def __init__(self):
+        self._t0: Optional[float] = None
+        self.steps = 0
+
+    def start(self, fence_on=None):
+        if fence_on is not None:
+            fence(fence_on)
+        self._t0 = time.time()
+        self.steps = 0
+
+    def tick(self):
+        self.steps += 1
+
+    def stop(self, fence_on=None) -> float:
+        if fence_on is not None:
+            fence(fence_on)
+        assert self._t0 is not None
+        return time.time() - self._t0
+
+
+class MetricsLogger:
+    """Per-step metrics as JSONL (img/s/chip, step time, achieved GB/s)."""
+
+    def __init__(self, path: Optional[str] = None):
+        self.path = path
+        self.records: List[Dict[str, Any]] = []
+
+    def log(self, **kw) -> None:
+        rec = {"t": time.time(), **kw}
+        self.records.append(rec)
+        if self.path:
+            with open(self.path, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+
+
+def allreduce_bus_bandwidth(nbytes: int, n_devices: int,
+                            seconds: float) -> float:
+    """Effective bus bandwidth GB/s, the reference's benchmark metric:
+    algbw = size/time; busbw = algbw * 2(n-1)/n (ring lower bound)."""
+    if seconds <= 0 or n_devices <= 1:
+        return 0.0
+    algbw = nbytes / seconds
+    return algbw * 2 * (n_devices - 1) / n_devices / 1e9

@@ -1,0 +1,38 @@
+"""Tracing/profiling hooks (SURVEY.md §6.1).
+
+The reference had nothing built-in (external MPI profilers only); here each
+collective / train step can be annotated so ``jax.profiler`` traces show
+named spans, and a whole-program trace dumps perfetto-compatible files.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Iterator, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Named scope visible in XLA/profiler traces (works inside jit)."""
+    with jax.named_scope(name):
+        yield
+
+
+@contextlib.contextmanager
+def trace(log_dir: str = "/tmp/torchmpi_tpu_trace",
+          create_perfetto_link: bool = False) -> Iterator[str]:
+    """Capture a profiler trace around a code region.
+
+    View with tensorboard or ui.perfetto.dev (the trace.json.gz under
+    ``<log_dir>/plugins/profile/...``).
+    """
+    os.makedirs(log_dir, exist_ok=True)
+    jax.profiler.start_trace(log_dir,
+                             create_perfetto_link=create_perfetto_link)
+    try:
+        yield log_dir
+    finally:
+        jax.profiler.stop_trace()

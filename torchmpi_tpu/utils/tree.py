@@ -1,0 +1,42 @@
+"""Pytree <-> flat float32 vector utilities (the PS operates on flat shards,
+as the reference's parameterserver did on flattened parameter tensors)."""
+
+from __future__ import annotations
+
+from typing import Any, List, Tuple
+
+import jax
+import numpy as np
+
+PyTree = Any
+
+
+class TreeSpec:
+    def __init__(self, treedef, shapes: List[Tuple[int, ...]],
+                 dtypes: List[np.dtype]):
+        self.treedef = treedef
+        self.shapes = shapes
+        self.dtypes = dtypes
+        self.sizes = [int(np.prod(s)) for s in shapes]
+        self.total = int(sum(self.sizes))
+
+
+def flatten_f32(tree: PyTree) -> Tuple[np.ndarray, TreeSpec]:
+    """Flatten a pytree of arrays into one float32 numpy vector."""
+    leaves, treedef = jax.tree.flatten(tree)
+    arrs = [np.asarray(l) for l in leaves]
+    spec = TreeSpec(treedef, [a.shape for a in arrs],
+                    [a.dtype for a in arrs])
+    if not arrs:
+        return np.zeros((0,), np.float32), spec
+    flat = np.concatenate([a.astype(np.float32).reshape(-1) for a in arrs])
+    return np.ascontiguousarray(flat, np.float32), spec
+
+
+def unflatten_f32(spec: TreeSpec, flat: np.ndarray) -> PyTree:
+    out = []
+    off = 0
+    for shape, size, dtype in zip(spec.shapes, spec.sizes, spec.dtypes):
+        out.append(flat[off:off + size].reshape(shape).astype(dtype))
+        off += size
+    return jax.tree.unflatten(spec.treedef, out)
