@@ -1,0 +1,96 @@
+"""Collective micro-benchmark: allreduce bandwidth sweep across backends.
+
+Reference analog: ``benchmarks/*.lua`` (SURVEY.md §3 C14, reconstructed —
+reference mount empty): sweep message sizes, report effective bus bandwidth
+(``algbw * 2(n-1)/n``), compare implementations — the reference compared
+stock MPI vs NCCL vs its custom chunked algorithms; here we compare
+``xla`` vs ``hierarchical`` vs ``pallas``.
+
+The BASELINE target is this sweep measured from 8 to 256 chips on a real
+pod; on the simulated CPU mesh the numbers exercise the same code paths and
+validate relative behavior, and on any real multi-chip slice this script
+measures the real thing unchanged.
+
+Run: ``python benchmarks/collectives_bench.py --devices 8 [--dcn 2]``
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--devices", type=int, default=0,
+                   help="force N simulated CPU devices")
+    p.add_argument("--dcn", type=int, default=None)
+    p.add_argument("--sizes", type=str,
+                   default="65536,1048576,16777216,67108864",
+                   help="comma-separated tensor bytes")
+    p.add_argument("--iters", type=int, default=10)
+    p.add_argument("--backends", type=str, default="xla,hierarchical,pallas")
+    p.add_argument("--json", action="store_true",
+                   help="emit one JSON line per measurement")
+    args = p.parse_args()
+    if args.devices:
+        from torchmpi_tpu.utils.simulation import force_cpu_devices
+
+        force_cpu_devices(args.devices)
+    import jax
+    import numpy as np
+
+    import torchmpi_tpu as mpi
+    from torchmpi_tpu.ops import ring
+    from torchmpi_tpu.utils.metrics import allreduce_bus_bandwidth, fence
+
+    mesh = mpi.init(mpi.Config(dcn_size=args.dcn, custom_min_bytes=0))
+    n = mpi.device_count()
+    is_cpu = list(mesh.devices.flat)[0].platform == "cpu"
+    if is_cpu:
+        from jax.experimental.pallas import tpu as pltpu
+
+        ring.set_interpret(pltpu.InterpretParams())
+    print(f"# mesh {dict(zip(mesh.axis_names, mesh.devices.shape))} "
+          f"({'cpu-sim' if is_cpu else 'tpu'})", file=sys.stderr)
+
+    backends = args.backends.split(",")
+    sizes = [int(s) for s in args.sizes.split(",")]
+    for nbytes in sizes:
+        floats_per_rank = nbytes // 4
+        x = np.random.RandomState(0).rand(n, floats_per_rank).astype(
+            np.float32)
+        for backend in backends:
+            if backend == "hierarchical" and mesh.shape[mpi.DCN_AXIS] <= 1:
+                continue
+            if backend == "pallas" and is_cpu and nbytes > 1 << 20:
+                continue  # interpreter too slow for big tensors
+            try:
+                out = mpi.allreduce(x, backend=backend)  # compile
+                fence(out)
+                t0 = time.time()
+                for _ in range(args.iters):
+                    out = mpi.allreduce(x, backend=backend)
+                fence(out)
+                dt = (time.time() - t0) / args.iters
+            except Exception as e:  # noqa: BLE001 — report and continue
+                print(f"{backend:13s} {nbytes:>12d} B  FAILED: {e}",
+                      file=sys.stderr)
+                continue
+            busbw = allreduce_bus_bandwidth(nbytes, n, dt)
+            line = {"op": "allreduce", "backend": backend, "bytes": nbytes,
+                    "devices": n, "ms": round(dt * 1e3, 3),
+                    "busbw_GBs": round(busbw, 3)}
+            if args.json:
+                print(json.dumps(line))
+            else:
+                print(f"{backend:13s} {nbytes:>12d} B  {dt*1e3:8.2f} ms  "
+                      f"busbw {busbw:8.3f} GB/s")
+    mpi.stop()
+
+
+if __name__ == "__main__":
+    main()

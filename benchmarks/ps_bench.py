@@ -1,0 +1,59 @@
+"""Parameter-server throughput micro-benchmark.
+
+Reference analog: the PS half of ``benchmarks/`` (SURVEY.md §3 C14):
+send/receive round-trip latency and sustained one-way throughput against the
+native shard servers, vs payload size and shard count.
+
+Run: ``python benchmarks/ps_bench.py``
+"""
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--sizes", type=str, default="65536,1048576,16777216")
+    p.add_argument("--shards", type=int, default=4)
+    p.add_argument("--iters", type=int, default=20)
+    args = p.parse_args()
+
+    from torchmpi_tpu.parallel.ps import ParameterServer
+
+    for nbytes in (int(s) for s in args.sizes.split(",")):
+        tree = {"p": np.zeros(nbytes // 4, np.float32)}
+        ps = ParameterServer(tree, num_shards=args.shards)
+        try:
+            payload = {"p": np.ones(nbytes // 4, np.float32)}
+            ps.send(payload, rule="add").wait()  # warm
+            t0 = time.time()
+            for _ in range(args.iters):
+                ps.send(payload, rule="add").wait()
+            send_dt = (time.time() - t0) / args.iters
+            ps.receive().wait()
+            t0 = time.time()
+            for _ in range(args.iters):
+                ps.receive().wait()
+            recv_dt = (time.time() - t0) / args.iters
+            # pipelined (async, wait at end) — the prefetch pattern's win
+            t0 = time.time()
+            hs = [ps.send(payload, rule="add") for _ in range(args.iters)]
+            for h in hs:
+                h.wait()
+            pipe_dt = (time.time() - t0) / args.iters
+            print(f"{nbytes:>12d} B x{args.shards} shards  "
+                  f"send {nbytes/send_dt/1e9:6.2f} GB/s  "
+                  f"recv {nbytes/recv_dt/1e9:6.2f} GB/s  "
+                  f"pipelined-send {nbytes/pipe_dt/1e9:6.2f} GB/s")
+        finally:
+            ps.shutdown()
+
+
+if __name__ == "__main__":
+    main()

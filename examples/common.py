@@ -36,13 +36,9 @@ def parse_args(description: str, defaults: dict = None, **extra):
         p.set_defaults(**defaults)
     args = p.parse_args()
     if args.devices:
-        os.environ["XLA_FLAGS"] = (
-            os.environ.get("XLA_FLAGS", "")
-            + f" --xla_force_host_platform_device_count={args.devices}"
-        ).strip()
-        import jax
+        from torchmpi_tpu.utils.simulation import force_cpu_devices
 
-        jax.config.update("jax_platforms", "cpu")
+        force_cpu_devices(args.devices)
     return args
 
 

@@ -1,0 +1,65 @@
+"""Worker process for the multi-process DCN test (launched by
+test_multiprocess.py; underscore prefix keeps pytest from collecting it).
+
+Each process drives torchmpi_tpu exactly as one host of a multi-host TPU
+pod would: distributed bring-up, auto 2-level mesh (dcn = processes), eager
+and in-axis collectives, barrier, gradient sync.
+"""
+
+import os
+import sys
+
+pid = int(sys.argv[1])
+nproc = int(sys.argv[2])
+port = sys.argv[3]
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np  # noqa: E402
+
+import torchmpi_tpu as mpi  # noqa: E402
+
+mesh = mpi.init(mpi.Config(
+    coordinator_address=f"127.0.0.1:{port}",
+    num_processes=nproc,
+    process_id=pid,
+))
+
+# Reference behavior: after start(), rank/size reflect the launch topology.
+assert mpi.rank() == pid, (mpi.rank(), pid)
+assert mpi.size() == nproc
+n = mpi.device_count()
+assert n == 2 * nproc
+# Auto mesh: dcn = process count (the inter-host axis), ici = local devices.
+assert mesh.shape[mpi.DCN_AXIS] == nproc, mesh.shape
+print(f"CHECK rank={mpi.rank()} mesh={dict(mesh.shape)}", flush=True)
+
+mpi.barrier()
+
+# Eager rank-major allreduce across both processes' devices.  Each process
+# reads back only its addressable rows (mpi.collectives.to_local).
+x = np.stack([np.full(5, float(r), np.float32) for r in range(n)])
+local, idx = mpi.collectives.to_local(mpi.allreduce(x))
+expect = x.sum(axis=0)
+assert idx == [2 * pid, 2 * pid + 1], idx
+np.testing.assert_allclose(local[0], expect)
+print(f"CHECK rank={pid} eager-allreduce ok", flush=True)
+
+# Hierarchical backend crossing the process (dcn) boundary.
+local, _ = mpi.collectives.to_local(mpi.allreduce(x, backend="hierarchical"))
+np.testing.assert_allclose(local[0], expect, rtol=1e-6)
+print(f"CHECK rank={pid} hierarchical ok", flush=True)
+
+# broadcast from a rank owned by the other process (rank 1 lives on proc 0).
+local, _ = mpi.collectives.to_local(mpi.broadcast(x, root=1))
+np.testing.assert_allclose(local[0], x[1])
+print(f"CHECK rank={pid} broadcast ok", flush=True)
+
+mpi.barrier()
+mpi.stop()
+print(f"CHECK rank={pid} done", flush=True)

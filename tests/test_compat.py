@@ -1,0 +1,51 @@
+"""The TorchMPI-naming compat surface maps 1:1 onto the native API."""
+
+import numpy as np
+import pytest
+
+import torchmpi_tpu
+import torchmpi_tpu.compat as mpi
+
+
+@pytest.fixture()
+def started():
+    torchmpi_tpu.stop()
+    mpi.start(dcn_size=2)
+    yield
+    mpi.stop()
+
+
+def test_start_rank_size(started):
+    assert mpi.rank() == 0
+    assert mpi.size() == 1
+    mpi.barrier()
+
+
+def test_tensor_verbs(started):
+    x = np.stack([np.full(6, float(r), np.float32) for r in range(8)])
+    np.testing.assert_allclose(np.asarray(mpi.allreduceTensor(x))[0],
+                               x.sum(axis=0))
+    np.testing.assert_allclose(np.asarray(mpi.broadcastTensor(x, root=2))[5],
+                               x[2])
+    h = mpi.async_.allreduceTensor(x)
+    np.testing.assert_allclose(np.asarray(mpi.syncHandle(h))[0],
+                               x.sum(axis=0))
+
+
+def test_knob_setters(started):
+    mpi.set_hierarchical_collectives()
+    assert torchmpi_tpu.config().hierarchical
+    mpi.set_flat_collectives()
+    assert not torchmpi_tpu.config().hierarchical
+    mpi.set_chunk_size(1234)
+    assert torchmpi_tpu.config().chunk_bytes == 1234
+    mpi.collectiveSelector("pallas")
+    assert torchmpi_tpu.config().backend == "pallas"
+    avail = mpi.collectiveAvailability()
+    assert "pallas" in avail["allreduce"]
+
+
+def test_nn_namespace(started):
+    params = {"w": np.ones((3, 3), np.float32)}
+    rep = mpi.nn.synchronizeParameters(params)
+    assert rep["w"].sharding.is_fully_replicated

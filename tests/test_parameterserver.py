@@ -149,3 +149,49 @@ def test_wrong_size_rejected():
             ps.send({"w": np.zeros((2, 2), np.float32)})
     finally:
         ps.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Fault injection (SURVEY §6.3: async PS failure is survivable, unlike SPMD;
+# the host layer is where failure detection hooks live).
+# ---------------------------------------------------------------------------
+
+
+def test_server_shutdown_fails_client_ops():
+    ps = psmod.init(tree_of(1.0), num_shards=2)
+    try:
+        ps.servers.shutdown()  # inject: kill all shard servers mid-session
+        h = ps.send(tree_of(1.0), rule="add")
+        with pytest.raises(RuntimeError):
+            h.wait()
+        # A failed handle stays failed and reports done (terminal state).
+        assert h.done
+        with pytest.raises(RuntimeError):
+            h.wait()
+    finally:
+        ps.client.shutdown()
+
+
+def test_connect_refused():
+    template = tree_of(0.0)
+    with pytest.raises(RuntimeError):
+        PSClient(template, ports=[1], shard_bounds=[(0, 17)])
+
+
+def test_partial_shard_failure():
+    # Kill ONE of two shard servers: ops touching it fail, the registry
+    # entries for the surviving shard are drained without deadlock.
+    template = tree_of(0.0)
+    flat, spec = tree_util.flatten_f32(template)
+    servers = ShardedParameterServer(spec.total, num_shards=2)
+    client = PSClient(template, servers.ports, servers.shard_bounds)
+    try:
+        client.send(template, rule="copy").wait()  # healthy
+        servers._lib.tm_ps_server_destroy(servers.server_ids[1])
+        servers.server_ids = servers.server_ids[:1]
+        h = client.send(tree_of(2.0), rule="copy")
+        with pytest.raises(RuntimeError):
+            h.wait()
+    finally:
+        client.shutdown()
+        servers.shutdown()

@@ -253,7 +253,20 @@ def _eager_collective(op_name: str, x, *, mesh: Optional[Mesh] = None,
         fn = jax.jit(shmapped)
         _jit_cache[key] = fn
     sharding = NamedSharding(m, P(m.axis_names))
-    x = jax.device_put(x, sharding)
+    if jax.process_count() > 1:
+        # Multi-host: device_put of a host array onto a global sharding is
+        # not allowed; every process passes the identical full rank-major
+        # array (SPMD-consistent, TorchMPI's per-rank tensors stacked), and
+        # each process contributes its addressable shards.
+        flat_devices = list(m.devices.flat)
+        shards = []
+        for i, d in enumerate(flat_devices):
+            if d.process_index == jax.process_index():
+                shards.append(jax.device_put(x[i:i + 1], d))
+        x = jax.make_array_from_single_device_arrays(x.shape, sharding,
+                                                     shards)
+    else:
+        x = jax.device_put(x, sharding)
     return fn(x)
 
 
@@ -320,6 +333,20 @@ def alltoall(x, *, mesh: Optional[Mesh] = None, backend: Optional[str] = None):
 # ---------------------------------------------------------------------------
 # Async facade (reference: mpi.async.* + syncHandle; SURVEY C7 / §4.4).
 # ---------------------------------------------------------------------------
+
+
+def to_local(x):
+    """Gather this process's addressable slices of an eager-mode result.
+
+    Multi-host: a rank-major result spans all hosts' devices; each process
+    reads back only its local rows (the reference's per-rank output tensor).
+    Returns ``[local_ranks, ...]`` stacked in global rank order, with
+    ``.indices`` attached via a second return value.
+    """
+    shards = sorted(x.addressable_shards, key=lambda s: s.index[0].start or 0)
+    rows = [np.asarray(s.data) for s in shards]
+    idx = [s.index[0].start or 0 for s in shards]
+    return np.concatenate(rows, axis=0), idx
 
 
 class AsyncHandle:

@@ -12,3 +12,4 @@ from .resnet import (  # noqa: F401
     BasicBlock,
     BottleneckBlock,
 )
+from .transformer import TransformerLM  # noqa: F401

@@ -1,0 +1,103 @@
+"""Decoder-only Transformer LM with pluggable sequence-parallel attention.
+
+Not in the reference (pre-transformer library — SURVEY.md §6.7); this is the
+long-context model family the TPU rebuild adds, wired to the
+sequence-parallel attention strategies in ``parallel/sequence.py``:
+
+- ``attn_impl="local"``   — ordinary full attention (single device / no SP)
+- ``attn_impl="ring"``    — blockwise ring attention over ``seq_axis``
+- ``attn_impl="ulysses"`` — all-to-all head-scatter attention over ``seq_axis``
+
+With ``seq_axis`` set, the model is meant to run inside ``shard_map`` with
+the sequence dimension sharded over that mesh axis; everything except
+attention is position-local, so only the attention call communicates.
+bfloat16-friendly: set ``dtype=jnp.bfloat16`` for MXU-width matmuls with
+float32 parameters and softmax statistics.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..parallel import sequence as seqlib
+
+
+class SPAttention(nn.Module):
+    num_heads: int
+    head_dim: int
+    attn_impl: str = "local"
+    seq_axis: Optional[str] = None
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):  # x: [B, T_local, E]
+        B, T, E = x.shape
+        H, D = self.num_heads, self.head_dim
+        qkv = nn.DenseGeneral((3, H, D), axis=-1, dtype=self.dtype,
+                              name="qkv")(x)
+        q, k, v = (qkv[:, :, 0].astype(jnp.float32),
+                   qkv[:, :, 1].astype(jnp.float32),
+                   qkv[:, :, 2].astype(jnp.float32))
+        if self.attn_impl == "local":
+            o = seqlib.reference_attention(q, k, v, causal=True)
+        elif self.attn_impl == "ring":
+            o = seqlib.ring_attention(q, k, v, self.seq_axis, causal=True)
+        elif self.attn_impl == "ulysses":
+            o = seqlib.ulysses_attention(q, k, v, self.seq_axis, causal=True)
+        else:
+            raise ValueError(f"unknown attn_impl {self.attn_impl!r}")
+        o = o.astype(self.dtype).reshape(B, T, H * D)
+        return nn.Dense(E, dtype=self.dtype, name="out")(o)
+
+
+class Block(nn.Module):
+    num_heads: int
+    head_dim: int
+    mlp_ratio: int = 4
+    attn_impl: str = "local"
+    seq_axis: Optional[str] = None
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        E = x.shape[-1]
+        h = nn.LayerNorm(dtype=jnp.float32)(x)
+        x = x + SPAttention(self.num_heads, self.head_dim, self.attn_impl,
+                            self.seq_axis, self.dtype)(h)
+        h = nn.LayerNorm(dtype=jnp.float32)(x)
+        h = nn.Dense(E * self.mlp_ratio, dtype=self.dtype)(h)
+        h = nn.gelu(h)
+        return x + nn.Dense(E, dtype=self.dtype)(h)
+
+
+class TransformerLM(nn.Module):
+    """Causal LM.  With ``seq_axis``, position embeddings use each shard's
+    global offset, supplied as ``pos_offset`` (device-local sequence start)."""
+
+    vocab: int = 256
+    embed: int = 128
+    depth: int = 2
+    num_heads: int = 8
+    head_dim: int = 16
+    max_len: int = 4096
+    attn_impl: str = "local"
+    seq_axis: Optional[str] = None
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, tokens, pos_offset=0):  # tokens: [B, T_local] int32
+        B, T = tokens.shape
+        x = nn.Embed(self.vocab, self.embed, dtype=self.dtype)(tokens)
+        pos = pos_offset + jnp.arange(T)
+        pe = nn.Embed(self.max_len, self.embed, dtype=self.dtype,
+                      name="pos_embed")(pos)
+        x = x + pe[None]
+        for _ in range(self.depth):
+            x = Block(self.num_heads, self.head_dim,
+                      attn_impl=self.attn_impl, seq_axis=self.seq_axis,
+                      dtype=self.dtype)(x)
+        x = nn.LayerNorm(dtype=jnp.float32)(x)
+        return nn.Dense(self.vocab, dtype=jnp.float32)(x)

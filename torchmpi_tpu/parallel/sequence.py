@@ -1,0 +1,159 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+The reference predates transformers and has NO sequence parallelism
+(SURVEY.md §3.3/§6.7 record the gap explicitly); its mesh abstraction was
+required not to preclude one.  This module is the forward-looking extension
+the TPU rebuild adds on top of the same communicator tree: long-context
+attention where the sequence dimension is sharded over a mesh axis.
+
+Two standard strategies, both built on this library's collectives:
+
+- :func:`ulysses_attention` — all-to-all: swap the sequence shard for a
+  head shard before attention and back after, so every device computes full
+  attention for a subset of heads.  Two ``all_to_all`` ops per call; needs
+  ``num_heads % axis_size == 0``.
+
+- :func:`ring_attention` — blockwise: queries stay put while key/value
+  blocks rotate around the ring via ``ppermute``, combined with a running
+  (online-softmax / flash-style) accumulator, so the full sequence never
+  materializes on any device.  Communication overlaps with the per-block
+  matmuls under XLA's scheduler; memory is O(seq/n) per device.
+
+Both are written for use inside ``shard_map`` over a mesh axis (typically a
+dedicated ``seq`` axis or the ``ici`` axis), matching the in-axis collective
+API style of the rest of the library.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _attn_block(q, k, v, scale, mask):
+    """One q-block x kv-block partial attention with explicit max/denom.
+
+    q: [B, Tq, H, D]; k/v: [B, Tk, H, D]; mask: [Tq, Tk] bool or None.
+    Returns (numerator [B, Tq, H, D], block max [B, H, Tq],
+    block denom [B, H, Tq]).
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if mask is not None:
+        s = jnp.where(mask[None, None, :, :], s, -jnp.inf)
+    m = jnp.max(s, axis=-1)  # [B, H, Tq]
+    # exp(-inf - -inf) guard: fully-masked rows produce m=-inf; make the
+    # exponent finite so p=0 rather than nan.
+    safe_m = jnp.where(jnp.isfinite(m), m, 0.0)
+    p = jnp.exp(s - safe_m[..., None])
+    p = jnp.where(jnp.isfinite(s), p, 0.0)
+    l = jnp.sum(p, axis=-1)  # [B, H, Tq]
+    o = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    return o, m, l
+
+
+def ring_attention(q, k, v, axis_name: str, *, causal: bool = False,
+                   scale: Optional[float] = None):
+    """Blockwise ring attention over a sequence-sharded axis.
+
+    Shapes (per device): q, k, v — ``[batch, seq_local, heads, head_dim]``,
+    the global sequence being ``axis_size * seq_local`` in mesh-rank order.
+    Returns the local block of the attention output, same shape as ``q``.
+
+    Communication: ``axis_size - 1`` ppermute rotations of (k, v) — each
+    device sends/receives ``2 * seq_local * heads * head_dim`` elements per
+    step, the ring-bandwidth-optimal schedule.  Numerics: one online-softmax
+    accumulation across blocks (flash-attention style), exact up to float
+    associativity.
+    """
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    B, Tq, H, D = q.shape
+    if scale is None:
+        scale = 1.0 / (D ** 0.5)
+
+    q_pos = my * Tq + jnp.arange(Tq)  # global query positions
+
+    m_run = jnp.full((B, H, Tq), -jnp.inf, q.dtype)
+    l_run = jnp.zeros((B, H, Tq), q.dtype)
+    o_run = jnp.zeros_like(q)
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def mask_for(kv_owner):
+        if not causal:
+            return None
+        k_pos = kv_owner * k.shape[1] + jnp.arange(k.shape[1])
+        return q_pos[:, None] >= k_pos[None, :]
+
+    for step in range(n):  # n is static: unrolled
+        kv_owner = lax.rem(my - step + n, n)
+        o_b, m_b, l_b = _attn_block(q, k, v, scale, mask_for(kv_owner))
+        m_new = jnp.maximum(m_run, m_b)
+        safe_new = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        c_run = jnp.where(jnp.isfinite(m_run), jnp.exp(m_run - safe_new), 0.0)
+        c_b = jnp.where(jnp.isfinite(m_b), jnp.exp(m_b - safe_new), 0.0)
+        l_run = l_run * c_run + l_b * c_b
+        o_run = (o_run * c_run.transpose(0, 2, 1)[..., None]
+                 + o_b * c_b.transpose(0, 2, 1)[..., None])
+        m_run = m_new
+        if step != n - 1:
+            k = lax.ppermute(k, axis_name, perm)
+            v = lax.ppermute(v, axis_name, perm)
+
+    denom = jnp.where(l_run > 0, l_run, 1.0).transpose(0, 2, 1)[..., None]
+    return o_run / denom
+
+
+def ulysses_attention(q, k, v, axis_name: str, *, causal: bool = False,
+                      scale: Optional[float] = None):
+    """All-to-all (DeepSpeed-Ulysses style) sequence-parallel attention.
+
+    Shapes (per device): ``[batch, seq_local, heads, head_dim]`` with
+    ``heads % axis_size == 0``.  Two ``all_to_all`` ops swap the sequence
+    shard for a head shard and back; in between every device runs ordinary
+    full-sequence attention on its head subset (XLA's tuned path, MXU
+    friendly).
+    """
+    n = lax.axis_size(axis_name)
+    B, Tl, H, D = q.shape
+    if H % n != 0:
+        raise ValueError(f"heads {H} not divisible by axis size {n}")
+    if scale is None:
+        scale = 1.0 / (D ** 0.5)
+
+    def seq_to_heads(x):
+        # [B, Tl, H, D] -> [B, T, H/n, D]
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def heads_to_seq(x):
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    qg, kg, vg = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    T = qg.shape[1]
+    mask = None
+    if causal:
+        pos = jnp.arange(T)
+        mask = pos[:, None] >= pos[None, :]
+    o, m, l = _attn_block(qg, kg, vg, scale, mask)
+    denom = jnp.where(l > 0, l, 1.0).transpose(0, 2, 1)[..., None]
+    return heads_to_seq(o / denom)
+
+
+def reference_attention(q, k, v, *, causal: bool = False,
+                        scale: Optional[float] = None):
+    """Single-device full attention (the oracle for the parallel variants)."""
+    B, T, H, D = q.shape
+    if scale is None:
+        scale = 1.0 / (D ** 0.5)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        pos = jnp.arange(T)
+        s = jnp.where((pos[:, None] >= pos[None, :])[None, None], s,
+                      -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)

@@ -1,0 +1,29 @@
+"""Simulated-mesh bootstrap: force N CPU devices in one process.
+
+The rebuild's analog of "mpirun -np N on localhost is the fixture"
+(SURVEY.md §5).  Shared by the test conftest, examples, and benchmarks so
+the platform-forcing quirks live in exactly one place:
+
+- ``XLA_FLAGS`` is read at backend-init time, so appending the forced host
+  device count here works even if jax was already imported;
+- ``JAX_PLATFORMS`` may have been consumed at import (e.g. a sitecustomize
+  pinning a real TPU platform), so the platform is forced via ``jax.config``
+  instead of the environment.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def force_cpu_devices(n: int) -> None:
+    """Make this process see ``n`` simulated CPU devices.  Must run before
+    the first JAX backend use (not merely before import)."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
