@@ -162,3 +162,25 @@ def test_mnist_convergence_smoke(flat_runtime):
             first = float(loss)
     last = float(loss)
     assert last < 0.25 * first, f"no convergence: {first} -> {last}"
+
+
+def test_bf16_compression_close_to_exact(flat_runtime):
+    mesh = mpi.world_mesh()
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    g = np.random.RandomState(0).randn(8, 1024).astype(np.float32)
+
+    def body(compress):
+        def f(x):
+            return gradsync.synchronize_gradients(
+                x, mesh.axis_names, op="mean", compress=compress)
+        return jax.jit(shard_map(f, mesh=mesh, in_specs=P(mesh.axis_names),
+                                 out_specs=P(), check_vma=False))(g)
+
+    exact = np.asarray(body(None))
+    comp = np.asarray(body("bf16"))
+    assert comp.dtype == np.float32  # cast back after the wire
+    np.testing.assert_allclose(comp, exact, rtol=0.05, atol=5e-3)
+    with pytest.raises(ValueError):
+        body("int3")

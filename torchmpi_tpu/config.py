@@ -76,6 +76,8 @@ class Config:
     gradsync_buckets: int = 1
     # Average (pmean) instead of sum (psum) in synchronize_gradients.
     gradsync_average: bool = True
+    # Optional on-the-wire gradient compression: None or "bf16".
+    gradsync_compress: Optional[str] = None
 
     # --- parameter server ---------------------------------------------------
     ps_port: int = 52312
@@ -103,6 +105,8 @@ class Config:
             custom_min_bytes=_env_int("TORCHMPI_TPU_CUSTOM_MIN_BYTES", 64 * 1024),
             gradsync_buckets=_env_int("TORCHMPI_TPU_GRADSYNC_BUCKETS", 1),
             gradsync_average=_env_bool("TORCHMPI_TPU_GRADSYNC_AVERAGE", True),
+            gradsync_compress=(
+                os.environ.get("TORCHMPI_TPU_GRADSYNC_COMPRESS") or None),
             ps_port=_env_int("TORCHMPI_TPU_PS_PORT", 52312),
             ps_host=_env_str("TORCHMPI_TPU_PS_HOST", "127.0.0.1"),
             ps_num_threads=_env_int("TORCHMPI_TPU_PS_THREADS", 2),

@@ -129,6 +129,104 @@ def _ring_allreduce_kernel(x_ref, o_ref, comm_ref, send_sem, recv_sem,
     pltpu.semaphore_wait(ack_sem, 2)
 
 
+def _ring_reduce_scatter_kernel(x_ref, o_ref, acc_ref, comm_ref, send_sem,
+                                recv_sem, ack_sem, *, n: int, axis: str,
+                                mesh_axes: Tuple[str, ...]):
+    """RS phase only.  x: [n, rows, 128]; o: [rows, 128] (the chunk this
+    device ends up owning, chunk index (my+1) % n to match the allreduce
+    kernel's ownership, adjusted below to chunk ``my`` for standalone use)."""
+    my = lax.axis_index(axis)
+    right = lax.rem(my + 1, n)
+    left = lax.rem(my + n - 1, n)
+
+    def coords(idx):
+        lid = jnp.int32(0)
+        for a in mesh_axes:
+            pos = idx if a == axis else lax.axis_index(a)
+            lid = lid * lax.axis_size(a) + pos
+        return lid
+
+    bsem = pltpu.get_barrier_semaphore()
+    pltpu.semaphore_signal(bsem, inc=1, device_id=coords(left),
+                           device_id_type=pltpu.DeviceIdType.LOGICAL)
+    pltpu.semaphore_signal(bsem, inc=1, device_id=coords(right),
+                           device_id_type=pltpu.DeviceIdType.LOGICAL)
+    pltpu.semaphore_wait(bsem, 2)
+
+    acc_ref[...] = x_ref[...]
+    steps = n - 1
+    for s in range(steps):
+        slot = s % 2
+        # Shifted schedule so the final accumulated chunk is ``my`` itself:
+        # at step s send chunk (my - s - 1) mod n, receive (my - s - 2)+1...
+        # equivalently the classic schedule offset by one.
+        send_idx = lax.rem(my + 2 * n - s - 1, n)
+        recv_idx = lax.rem(my + 2 * n - s - 2, n)
+        if s >= 2:
+            pltpu.semaphore_wait(ack_sem, 1)
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=acc_ref.at[send_idx],
+            dst_ref=comm_ref.at[slot],
+            send_sem=send_sem.at[slot],
+            recv_sem=recv_sem.at[slot],
+            device_id=coords(right),
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        rdma.start()
+        rdma.wait()
+        acc_ref[recv_idx] = acc_ref[recv_idx] + comm_ref[slot]
+        pltpu.semaphore_signal(ack_sem, inc=1, device_id=coords(left),
+                               device_id_type=pltpu.DeviceIdType.LOGICAL)
+    pltpu.semaphore_wait(ack_sem, min(2, steps))
+    o_ref[...] = acc_ref[my]
+
+
+def _ring_all_gather_kernel(x_ref, o_ref, comm_ref, send_sem, recv_sem,
+                            ack_sem, *, n: int, axis: str,
+                            mesh_axes: Tuple[str, ...]):
+    """AG only.  x: [rows, 128] (local chunk); o: [n, rows, 128]."""
+    my = lax.axis_index(axis)
+    right = lax.rem(my + 1, n)
+    left = lax.rem(my + n - 1, n)
+
+    def coords(idx):
+        lid = jnp.int32(0)
+        for a in mesh_axes:
+            pos = idx if a == axis else lax.axis_index(a)
+            lid = lid * lax.axis_size(a) + pos
+        return lid
+
+    bsem = pltpu.get_barrier_semaphore()
+    pltpu.semaphore_signal(bsem, inc=1, device_id=coords(left),
+                           device_id_type=pltpu.DeviceIdType.LOGICAL)
+    pltpu.semaphore_signal(bsem, inc=1, device_id=coords(right),
+                           device_id_type=pltpu.DeviceIdType.LOGICAL)
+    pltpu.semaphore_wait(bsem, 2)
+
+    o_ref[my] = x_ref[...]
+    steps = n - 1
+    for t in range(steps):
+        slot = t % 2
+        send_idx = lax.rem(my + n - t, n)
+        recv_idx = lax.rem(my + n - t - 1, n)
+        if t >= 2:
+            pltpu.semaphore_wait(ack_sem, 1)
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=o_ref.at[send_idx],
+            dst_ref=comm_ref.at[slot],
+            send_sem=send_sem.at[slot],
+            recv_sem=recv_sem.at[slot],
+            device_id=coords(right),
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        rdma.start()
+        rdma.wait()
+        o_ref[recv_idx] = comm_ref[slot]
+        pltpu.semaphore_signal(ack_sem, inc=1, device_id=coords(left),
+                               device_id_type=pltpu.DeviceIdType.LOGICAL)
+    pltpu.semaphore_wait(ack_sem, min(2, steps))
+
+
 def _ring_allreduce_padded(flat, n: int, axis: str,
                            mesh_axes: Tuple[str, ...]):
     """flat: [n * rows * 128] on each device, already padded."""
@@ -137,15 +235,9 @@ def _ring_allreduce_padded(flat, n: int, axis: str,
     x = flat.reshape(n, rows, _LANES)
     kernel = functools.partial(_ring_allreduce_kernel, n=n, axis=axis,
                                mesh_axes=mesh_axes)
-    try:
-        vma = jax.typeof(x).vma  # propagate under check_vma tracing
-    except Exception:
-        vma = None
-    out_sds = (jax.ShapeDtypeStruct(x.shape, x.dtype, vma=vma)
-               if vma else jax.ShapeDtypeStruct(x.shape, x.dtype))
     out = pl.pallas_call(
         kernel,
-        out_shape=out_sds,
+        out_shape=_out_sds(x.shape, x),
         in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
         scratch_shapes=[
@@ -173,18 +265,8 @@ def ring_allreduce(x, axis_names, *, op: str = "sum"):
     n = lax.axis_size(ring_axis)
 
     # Logical device ids need the coordinates over ALL mesh axes of the
-    # enclosing shard_map, not just the ring axis.  The tracing axis
-    # environment lists exactly those, in mesh order (verified against the
-    # executing mesh, unlike the global runtime mesh which may differ when a
-    # caller passes an explicit mesh to the eager API).
-    try:
-        from jax._src.core import get_axis_env
-
-        mesh_axes = tuple(get_axis_env().axis_names())
-    except Exception:
-        mesh_axes = axes
-    if not all(a in mesh_axes for a in axes):
-        mesh_axes = axes
+    # enclosing shard_map, not just the ring axis; see _mesh_axes_for.
+    mesh_axes = _mesh_axes_for(axes)
 
     if n == 1:
         out = x
@@ -211,3 +293,135 @@ def ring_allreduce(x, axis_names, *, op: str = "sum"):
 
 
 selector.register("allreduce", "pallas", ring_allreduce)
+
+
+def _mesh_axes_for(axes: Tuple[str, ...]) -> Tuple[str, ...]:
+    try:
+        from jax._src.core import get_axis_env
+
+        mesh_axes = tuple(get_axis_env().axis_names())
+    except Exception:
+        mesh_axes = axes
+    if not all(a in mesh_axes for a in axes):
+        mesh_axes = axes
+    return mesh_axes
+
+
+def _out_sds(shape, x):
+    try:
+        vma = jax.typeof(x).vma
+    except Exception:
+        vma = None
+    return (jax.ShapeDtypeStruct(shape, x.dtype, vma=vma)
+            if vma else jax.ShapeDtypeStruct(shape, x.dtype))
+
+
+def ring_reduce_scatter(x, axis_names, *, op: str = "sum"):
+    """Ring reduce-scatter over the last axis of ``axis_names``, with the
+    same tiled semantics as the stock backend (``lax.psum_scatter`` with
+    ``scatter_dimension=0, tiled=True``): input ``[k, ...]`` with ``k``
+    divisible by the group size yields output ``[k/group, ...]`` — whole
+    leading-dim rows, so selector fallback between backends never changes
+    the output shape.
+
+    Composition order for multi-axis groups: the outer (dcn) axes are
+    psum_scatter'd with the stock path FIRST, then the remaining slice is
+    ring-scattered over ICI — combined-rank order is outer-major, so device
+    (d, i) ends with global slice ``d*n + i``."""
+    if op != "sum":
+        raise KeyError(f"pallas ring reduce_scatter supports sum, not {op!r}")
+    axes = (axis_names,) if isinstance(axis_names, str) else tuple(axis_names)
+    ring_axis = axes[-1]
+    outer_axes = axes[:-1]
+    n = lax.axis_size(ring_axis)
+    mesh_axes = _mesh_axes_for(axes)
+    total = n
+    for a in outer_axes:
+        total *= lax.axis_size(a)
+    if x.shape[0] % total != 0:
+        raise ValueError(
+            f"reduce_scatter needs leading dim divisible by group size: "
+            f"{x.shape[0]} % {total}")
+    out_shape = (x.shape[0] // total,) + x.shape[1:]
+    for a in outer_axes:
+        x = x.reshape((-1,) + x.shape[1:])
+        x = lax.psum_scatter(x, a, scatter_dimension=0, tiled=True)
+    flat = x.reshape(-1)
+    L = flat.shape[0]
+    per = L // n
+    pad = (-per) % _TILE
+    chunks = flat.reshape(n, per)
+    if pad:
+        chunks = jnp.concatenate(
+            [chunks, jnp.zeros((n, pad), flat.dtype)], axis=1)
+    rows = (per + pad) // _LANES
+    xin = chunks.reshape(n, rows, _LANES)
+    if n == 1:
+        out = xin[0]
+    else:
+        kernel = functools.partial(_ring_reduce_scatter_kernel, n=n,
+                                   axis=ring_axis, mesh_axes=mesh_axes)
+        out = pl.pallas_call(
+            kernel,
+            out_shape=_out_sds((rows, _LANES), xin),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            scratch_shapes=[
+                pltpu.VMEM((n, rows, _LANES), xin.dtype),
+                pltpu.VMEM((2, rows, _LANES), xin.dtype),
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.REGULAR,
+            ],
+            compiler_params=pltpu.CompilerParams(collective_id=8),
+            interpret=(_INTERPRET if _INTERPRET is not None else False),
+        )(xin)
+    return out.reshape(-1)[:per].reshape(out_shape)
+
+
+def ring_all_gather(x, axis_names):
+    """Ring all-gather over the last axis; output stacks ring members on a
+    new leading axis (matching ``lax.all_gather(axis=0, tiled=False)``),
+    then outer axes are gathered with the stock path and flattened so the
+    leading axis is the full (row-major) rank order."""
+    axes = (axis_names,) if isinstance(axis_names, str) else tuple(axis_names)
+    ring_axis = axes[-1]
+    outer_axes = axes[:-1]
+    n = lax.axis_size(ring_axis)
+    mesh_axes = _mesh_axes_for(axes)
+    shape = x.shape
+    flat = x.reshape(-1)
+    L = flat.shape[0]
+    pad = (-L) % _TILE
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    rows = flat.shape[0] // _LANES
+    xin = flat.reshape(rows, _LANES)
+    if n == 1:
+        gathered = xin[None]
+    else:
+        kernel = functools.partial(_ring_all_gather_kernel, n=n,
+                                   axis=ring_axis, mesh_axes=mesh_axes)
+        gathered = pl.pallas_call(
+            kernel,
+            out_shape=_out_sds((n, rows, _LANES), xin),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            scratch_shapes=[
+                pltpu.VMEM((2, rows, _LANES), xin.dtype),
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.REGULAR,
+            ],
+            compiler_params=pltpu.CompilerParams(collective_id=9),
+            interpret=(_INTERPRET if _INTERPRET is not None else False),
+        )(xin)
+    out = gathered.reshape(n, -1)[:, :L].reshape((n,) + shape)
+    for a in reversed(outer_axes):
+        out = lax.all_gather(out, a, axis=0, tiled=False)
+        out = out.reshape((-1,) + shape)
+    return out
+
+
+selector.register("reduce_scatter", "pallas", ring_reduce_scatter)
+selector.register("allgather", "pallas", ring_all_gather)
