@@ -293,7 +293,7 @@ struct Client {
 // ------------------------------------------------------------------ registry
 std::mutex g_mu;
 std::map<int64_t, std::unique_ptr<Server>> g_servers;
-std::map<int64_t, std::unique_ptr<Client>> g_clients;
+std::map<int64_t, std::shared_ptr<Client>> g_clients;
 std::map<int64_t, std::shared_ptr<Future>> g_futures;
 int64_t g_next_id = 1;
 
@@ -352,7 +352,7 @@ void tm_ps_server_destroy(int64_t sid) {
 
 // ---- client ----
 int64_t tm_ps_client_connect(const char* host, int port) {
-  auto c = std::make_unique<Client>();
+  auto c = std::make_shared<Client>();
   if (!c->connect_to(host, port)) return -1;
   std::lock_guard<std::mutex> g(g_mu);
   int64_t id = g_next_id++;
@@ -361,7 +361,7 @@ int64_t tm_ps_client_connect(const char* host, int port) {
 }
 
 void tm_ps_client_destroy(int64_t cid) {
-  std::unique_ptr<Client> c;
+  std::shared_ptr<Client> c;
   {
     std::lock_guard<std::mutex> g(g_mu);
     auto it = g_clients.find(cid);
@@ -377,16 +377,20 @@ void tm_ps_client_destroy(int64_t cid) {
 // server's delta response and must stay alive until the future completes.
 int64_t tm_ps_send(int64_t cid, uint32_t rule, float alpha, uint64_t offset,
                    const float* data, float* inout, uint64_t count) {
-  Client* c;
+  // Hold shared ownership across enqueue: a concurrent
+  // tm_ps_client_destroy must not free the Client under us (ping runs from
+  // monitoring threads by design).
+  std::shared_ptr<Client> c;
   {
     std::lock_guard<std::mutex> g(g_mu);
     auto it = g_clients.find(cid);
     if (it == g_clients.end()) return -1;
-    c = it->second.get();
+    c = it->second;
   }
   int64_t fid;
   auto fut = new_future(&fid);
   auto payload = std::make_shared<std::vector<float>>(data, data + count);
+  // The job captures the shared_ptr: the Client outlives its queue entries.
   c->enqueue([c, fut, rule, alpha, offset, payload, inout, count] {
     Header h{};
     h.op = OP_SEND;
@@ -409,12 +413,15 @@ int64_t tm_ps_send(int64_t cid, uint32_t rule, float alpha, uint64_t offset,
 // Async RECEIVE into `out` (must stay alive until the future completes).
 int64_t tm_ps_receive(int64_t cid, uint64_t offset, float* out,
                       uint64_t count) {
-  Client* c;
+  // Hold shared ownership across enqueue: a concurrent
+  // tm_ps_client_destroy must not free the Client under us (ping runs from
+  // monitoring threads by design).
+  std::shared_ptr<Client> c;
   {
     std::lock_guard<std::mutex> g(g_mu);
     auto it = g_clients.find(cid);
     if (it == g_clients.end()) return -1;
-    c = it->second.get();
+    c = it->second;
   }
   int64_t fid;
   auto fut = new_future(&fid);
@@ -428,6 +435,34 @@ int64_t tm_ps_receive(int64_t cid, uint64_t offset, float* out,
     uint8_t st = 0;
     ok = ok && read_exact(c->fd, &st, 1) && st == 1;
     ok = ok && read_exact(c->fd, out, count * sizeof(float));
+    complete(fut, ok ? 1 : -1);
+  });
+  return fid;
+}
+
+// Async liveness probe (OP_PING round-trip on the connection's queue) —
+// the failure-detection hook the SPMD side cannot have (a dead peer there
+// kills the gang); here a dead shard is detected and reported.
+int64_t tm_ps_ping(int64_t cid) {
+  // Hold shared ownership across enqueue: a concurrent
+  // tm_ps_client_destroy must not free the Client under us (ping runs from
+  // monitoring threads by design).
+  std::shared_ptr<Client> c;
+  {
+    std::lock_guard<std::mutex> g(g_mu);
+    auto it = g_clients.find(cid);
+    if (it == g_clients.end()) return -1;
+    c = it->second;
+  }
+  int64_t fid;
+  auto fut = new_future(&fid);
+  c->enqueue([c, fut] {
+    Header h{};
+    h.op = OP_PING;
+    std::lock_guard<std::mutex> g(c->io_mu);
+    uint8_t st = 0;
+    bool ok = write_exact(c->fd, &h, sizeof(h)) &&
+              read_exact(c->fd, &st, 1) && st == 1;
     complete(fut, ok ? 1 : -1);
   });
   return fid;

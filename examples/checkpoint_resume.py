@@ -1,0 +1,105 @@
+"""Checkpoint/restart recovery — the failure-handling story.
+
+Reference behavior (SURVEY.md §6.3/§6.4, reconstructed — reference mount
+empty): an MPI rank failure aborted the whole job; the library shipped no
+checkpointing, so recovery meant restarting from whatever the user saved.
+The rebuild keeps the same gang-scheduled failure model for the SPMD side
+(a slice fails as a unit) and makes the checkpoint-restart loop a
+first-class, tested path: periodic sharded checkpoints, then resume from
+the latest one after a (simulated) crash, with the loss curve continuing
+where it left off.
+
+Run: ``python examples/checkpoint_resume.py --devices 8``
+"""
+
+import os
+import shutil
+import tempfile
+
+import common
+
+
+def main():
+    args = common.parse_args(__doc__,
+                             defaults={"steps": 40, "batch_size": 128})
+    import jax
+    import numpy as np
+
+    import torchmpi_tpu as mpi
+    from torchmpi_tpu.models import LeNet
+    from torchmpi_tpu.utils import checkpoint, data as dutil
+
+    ckpt_dir = tempfile.mkdtemp(prefix="tm_ckpt_")
+    try:
+        import jax.numpy as jnp
+        import optax
+
+        mpi.init(mpi.Config(dcn_size=args.dcn))
+        mesh = mpi.world_mesh()
+        model = LeNet()
+        params, tx, opt_state, local_loss = common.make_train_tools(
+            model, (1, 28, 28, 1), args.lr, args.momentum, args.seed)
+
+        def step(params, opt_state, images, labels):
+            loss, grads = jax.value_and_grad(local_loss)(params, images,
+                                                         labels)
+            grads = mpi.nn.synchronize_gradients(grads)
+            loss = mpi.collectives.allreduce_in_axis(loss, mesh.axis_names,
+                                                     op="mean")
+            updates, opt_state = tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        dp_step = mpi.nn.data_parallel_step(step, batch_argnums=(2, 3),
+                                            donate_argnums=())
+        params = mpi.nn.synchronize_parameters(params)
+        opt_state = mpi.nn.synchronize_parameters(opt_state)
+        X, Y = dutil.synthetic_mnist(2048, seed=args.seed)
+
+        # --- phase 1: train, checkpointing every 10 steps, "crash" midway
+        crash_at = args.steps // 2
+        # Step-0 checkpoint up front so recovery works however early the
+        # crash lands relative to the periodic save interval.
+        checkpoint.save(ckpt_dir, {"params": params, "opt": opt_state,
+                                   "step": np.int64(0)}, step=0)
+        losses = []
+        for i, (xb, yb) in enumerate(dutil.batches(
+                X, Y, args.batch_size, steps=crash_at, seed=args.seed)):
+            params, opt_state, loss = dp_step(params, opt_state, xb, yb)
+            losses.append(float(loss))
+            if i % 10 == 9:
+                checkpoint.save(ckpt_dir, {"params": params,
+                                           "opt": opt_state,
+                                           "step": np.int64(i + 1)}, step=i + 1)
+        print(f"phase 1: step {crash_at} loss {losses[-1]:.4f}; "
+              f"latest ckpt step {checkpoint.latest_step(ckpt_dir)}")
+        pre_crash = losses[-1]
+        del params, opt_state  # the crash
+
+        # --- phase 2: fresh process state, resume from latest checkpoint
+        params2, tx, opt_state2, _ = common.make_train_tools(
+            model, (1, 28, 28, 1), args.lr, args.momentum, args.seed)
+        template = {"params": params2, "opt": tx.init(params2),
+                    "step": np.int64(0)}
+        restored = checkpoint.restore(ckpt_dir, template)
+        resume_step = int(restored["step"])
+        params = mpi.nn.synchronize_parameters(restored["params"])
+        opt_state = mpi.nn.synchronize_parameters(restored["opt"])
+        print(f"phase 2: resumed from step {resume_step}")
+        # continue on the same data stream position
+        stream = dutil.batches(X, Y, args.batch_size, steps=args.steps,
+                               seed=args.seed)
+        for i, (xb, yb) in enumerate(stream):
+            if i < resume_step:
+                continue  # replay the stream to the resume point
+            params, opt_state, loss = dp_step(params, opt_state, xb, yb)
+            losses.append(float(loss))
+        final = float(loss)
+        print(f"final loss {final:.4f} (pre-crash {pre_crash:.4f})")
+        mpi.stop()
+        assert final < pre_crash, "resume did not continue improving"
+    finally:
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -195,3 +195,28 @@ def test_partial_shard_failure():
     finally:
         client.shutdown()
         servers.shutdown()
+
+
+def test_ping_health_check():
+    ps = psmod.init(tree_of(0.0), num_shards=3)
+    try:
+        assert ps.healthy()
+        assert ps.client.ping() == [True, True, True]
+        # kill one shard: its ping fails, others stay healthy
+        ps.servers._lib.tm_ps_server_destroy(ps.servers.server_ids[1])
+        ps.servers.server_ids = (ps.servers.server_ids[:1]
+                                 + ps.servers.server_ids[2:])
+        alive = ps.client.ping()
+        assert alive[1] is False and alive[0] and alive[2]
+        assert not ps.healthy()
+    finally:
+        ps.shutdown()
+
+
+def test_send_after_shutdown_raises():
+    ps = psmod.init(tree_of(0.0), num_shards=2)
+    ps.shutdown()
+    with pytest.raises(RuntimeError):
+        ps.send(tree_of(1.0))
+    with pytest.raises(RuntimeError):
+        ps.receive()

@@ -1,0 +1,116 @@
+"""Tensor-parallel layer tests: sharded MLP == dense MLP (the §6.7
+"mesh must not preclude a model axis" guarantee, exercised for real)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import torchmpi_tpu as mpi
+from torchmpi_tpu.parallel import tensor as tp
+
+
+def _weights(d_in=32, d_hidden=64, d_out=16, seed=0):
+    rng = np.random.RandomState(seed)
+    w1 = rng.randn(d_in, d_hidden).astype(np.float32) * 0.3
+    w2 = rng.randn(d_hidden, d_out).astype(np.float32) * 0.3
+    x = rng.randn(4, d_in).astype(np.float32)
+    return x, w1, w2
+
+
+def test_tp_mlp_matches_dense(flat_runtime):
+    mesh = mpi.world_mesh()
+    x, w1, w2 = _weights()
+    expect = np.tanh(x @ w1) @ w2
+
+    def body(x, w1_local, w2_local):
+        return tp.tp_mlp(x, w1_local, w2_local, ("dcn", "ici"))
+
+    # w1 column-sharded, w2 row-sharded over the combined 8-way axis.
+    out = jax.jit(shard_map(
+        body, mesh=mesh,
+        in_specs=(P(), P(None, ("dcn", "ici")), P(("dcn", "ici"), None)),
+        out_specs=P(), check_vma=False))(
+        x,
+        jax.device_put(w1, NamedSharding(mesh, P(None, ("dcn", "ici")))),
+        jax.device_put(w2, NamedSharding(mesh, P(("dcn", "ici"), None))))
+    np.testing.assert_allclose(np.asarray(out), expect, rtol=2e-5, atol=2e-5)
+
+
+def test_tp_composes_with_dp(hier_runtime):
+    # model axis = ici, data axis = dcn: per-dcn-group batch shard runs a
+    # TP MLP over ici; results must equal the dense computation per shard.
+    mesh = mpi.world_mesh()
+    x, w1, w2 = _weights()
+    expect = np.tanh(x @ w1) @ w2
+
+    def body(xb, w1_local, w2_local):
+        return tp.tp_mlp(xb, w1_local, w2_local, "ici")
+
+    out = jax.jit(shard_map(
+        body, mesh=mesh,
+        in_specs=(P("dcn"), P(None, "ici"), P("ici", None)),
+        out_specs=P("dcn"), check_vma=False))(
+        x,
+        jax.device_put(w1, NamedSharding(mesh, P(None, "ici"))),
+        jax.device_put(w2, NamedSharding(mesh, P("ici", None))))
+    np.testing.assert_allclose(np.asarray(out), expect, rtol=2e-5, atol=2e-5)
+
+
+def test_tp_grad_matches_dense(flat_runtime):
+    mesh = mpi.world_mesh()
+    x, w1, w2 = _weights()
+
+    def dense_loss(w1, w2):
+        return jnp.sum((jnp.tanh(x @ w1) @ w2) ** 2)
+
+    g1_ref, g2_ref = jax.grad(dense_loss, argnums=(0, 1))(w1, w2)
+
+    def body(x, w1_local, w2_local):
+        def loss(w1l, w2l):
+            return jnp.sum(tp.tp_mlp(x, w1l, w2l, ("dcn", "ici")) ** 2)
+
+        return jax.grad(loss, argnums=(0, 1))(w1_local, w2_local)
+
+    g1, g2 = jax.jit(shard_map(
+        body, mesh=mesh,
+        in_specs=(P(), P(None, ("dcn", "ici")), P(("dcn", "ici"), None)),
+        out_specs=(P(None, ("dcn", "ici")), P(("dcn", "ici"), None)),
+        check_vma=False))(
+        x,
+        jax.device_put(w1, NamedSharding(mesh, P(None, ("dcn", "ici")))),
+        jax.device_put(w2, NamedSharding(mesh, P(("dcn", "ici"), None))))
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g1_ref),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(g2), np.asarray(g2_ref),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_tp_input_grad_matches_dense(flat_runtime):
+    # The f operator: input gradients need an allreduce in backward.
+    mesh = mpi.world_mesh()
+    x, w1, w2 = _weights()
+
+    def dense_loss(x):
+        return jnp.sum((jnp.tanh(x @ w1) @ w2) ** 2)
+
+    gx_ref = jax.grad(dense_loss)(x)
+
+    def body(x, w1_local, w2_local):
+        def loss(xv):
+            return jnp.sum(tp.tp_mlp(xv, w1_local, w2_local,
+                                     ("dcn", "ici")) ** 2)
+
+        return jax.grad(loss)(x)
+
+    gx = jax.jit(shard_map(
+        body, mesh=mesh,
+        in_specs=(P(), P(None, ("dcn", "ici")), P(("dcn", "ici"), None)),
+        out_specs=P(), check_vma=False))(
+        x,
+        jax.device_put(w1, NamedSharding(mesh, P(None, ("dcn", "ici")))),
+        jax.device_put(w2, NamedSharding(mesh, P(("dcn", "ici"), None))))
+    np.testing.assert_allclose(np.asarray(gx), np.asarray(gx_ref),
+                               rtol=1e-4, atol=1e-5)

@@ -58,10 +58,11 @@ def set_interpret(params: Optional[pltpu.InterpretParams]) -> None:
     _INTERPRET = params
 
 
-def _ring_allreduce_kernel(x_ref, o_ref, comm_ref, send_sem, recv_sem,
-                           ack_sem, *, n: int, axis: str,
-                           mesh_axes: Tuple[str, ...]):
-    """Per-device kernel.  x/o: [n, rows, 128]; comm: [2, rows, 128]."""
+
+def _neighbor_setup(axis: str, mesh_axes, n: int):
+    """Shared kernel preamble: ring neighbors, logical-id mapping, and the
+    neighbor barrier (both neighbors inside the kernel before any RDMA).
+    The subtlest part of these kernels lives in exactly one place."""
     my = lax.axis_index(axis)
     right = lax.rem(my + 1, n)
     left = lax.rem(my + n - 1, n)
@@ -76,13 +77,20 @@ def _ring_allreduce_kernel(x_ref, o_ref, comm_ref, send_sem, recv_sem,
             lid = lid * lax.axis_size(a) + pos
         return lid
 
-    # Neighbor barrier: both neighbors are inside the kernel before any RDMA.
     bsem = pltpu.get_barrier_semaphore()
     pltpu.semaphore_signal(bsem, inc=1, device_id=coords(left),
                            device_id_type=pltpu.DeviceIdType.LOGICAL)
     pltpu.semaphore_signal(bsem, inc=1, device_id=coords(right),
                            device_id_type=pltpu.DeviceIdType.LOGICAL)
     pltpu.semaphore_wait(bsem, 2)
+    return my, left, right, coords
+
+
+def _ring_allreduce_kernel(x_ref, o_ref, comm_ref, send_sem, recv_sem,
+                           ack_sem, *, n: int, axis: str,
+                           mesh_axes: Tuple[str, ...]):
+    """Per-device kernel.  x/o: [n, rows, 128]; comm: [2, rows, 128]."""
+    my, left, right, coords = _neighbor_setup(axis, mesh_axes, n)
 
     o_ref[...] = x_ref[...]
 
@@ -132,26 +140,10 @@ def _ring_allreduce_kernel(x_ref, o_ref, comm_ref, send_sem, recv_sem,
 def _ring_reduce_scatter_kernel(x_ref, o_ref, acc_ref, comm_ref, send_sem,
                                 recv_sem, ack_sem, *, n: int, axis: str,
                                 mesh_axes: Tuple[str, ...]):
-    """RS phase only.  x: [n, rows, 128]; o: [rows, 128] (the chunk this
-    device ends up owning, chunk index (my+1) % n to match the allreduce
-    kernel's ownership, adjusted below to chunk ``my`` for standalone use)."""
-    my = lax.axis_index(axis)
-    right = lax.rem(my + 1, n)
-    left = lax.rem(my + n - 1, n)
-
-    def coords(idx):
-        lid = jnp.int32(0)
-        for a in mesh_axes:
-            pos = idx if a == axis else lax.axis_index(a)
-            lid = lid * lax.axis_size(a) + pos
-        return lid
-
-    bsem = pltpu.get_barrier_semaphore()
-    pltpu.semaphore_signal(bsem, inc=1, device_id=coords(left),
-                           device_id_type=pltpu.DeviceIdType.LOGICAL)
-    pltpu.semaphore_signal(bsem, inc=1, device_id=coords(right),
-                           device_id_type=pltpu.DeviceIdType.LOGICAL)
-    pltpu.semaphore_wait(bsem, 2)
+    """RS phase only.  x: [n, rows, 128]; o: [rows, 128] — the fully-reduced
+    chunk ``my`` (the schedule is the classic ring shifted by one so each
+    device finishes owning its own chunk index)."""
+    my, left, right, coords = _neighbor_setup(axis, mesh_axes, n)
 
     acc_ref[...] = x_ref[...]
     steps = n - 1
@@ -185,23 +177,7 @@ def _ring_all_gather_kernel(x_ref, o_ref, comm_ref, send_sem, recv_sem,
                             ack_sem, *, n: int, axis: str,
                             mesh_axes: Tuple[str, ...]):
     """AG only.  x: [rows, 128] (local chunk); o: [n, rows, 128]."""
-    my = lax.axis_index(axis)
-    right = lax.rem(my + 1, n)
-    left = lax.rem(my + n - 1, n)
-
-    def coords(idx):
-        lid = jnp.int32(0)
-        for a in mesh_axes:
-            pos = idx if a == axis else lax.axis_index(a)
-            lid = lid * lax.axis_size(a) + pos
-        return lid
-
-    bsem = pltpu.get_barrier_semaphore()
-    pltpu.semaphore_signal(bsem, inc=1, device_id=coords(left),
-                           device_id_type=pltpu.DeviceIdType.LOGICAL)
-    pltpu.semaphore_signal(bsem, inc=1, device_id=coords(right),
-                           device_id_type=pltpu.DeviceIdType.LOGICAL)
-    pltpu.semaphore_wait(bsem, 2)
+    my, left, right, coords = _neighbor_setup(axis, mesh_axes, n)
 
     o_ref[my] = x_ref[...]
     steps = n - 1

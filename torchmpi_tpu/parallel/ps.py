@@ -88,6 +88,8 @@ def _load_lib() -> ctypes.CDLL:
         lib.tm_ps_test.argtypes = [ctypes.c_int64]
         lib.tm_ps_forget.restype = None
         lib.tm_ps_forget.argtypes = [ctypes.c_int64]
+        lib.tm_ps_ping.restype = ctypes.c_int64
+        lib.tm_ps_ping.argtypes = [ctypes.c_int64]
         _LIB = lib
         return lib
 
@@ -218,6 +220,8 @@ class PSClient:
             self.client_ids.append(cid)
 
     def _per_shard(self, flat: np.ndarray):
+        if not self.client_ids:
+            raise RuntimeError("PS client is shut down")
         for cid, (lo, hi) in zip(self.client_ids, self.shard_bounds):
             yield cid, lo, hi, flat[lo:hi]
 
@@ -268,6 +272,22 @@ class PSClient:
         return PSHandle(self._lib, fids, bufs,
                         lambda: tree_util.unflatten_f32(self.spec, out))
 
+    def ping(self) -> List[bool]:
+        """Liveness of each shard server (failure detection, SURVEY §6.3):
+        OP_PING round-trips on every connection; False = shard unreachable."""
+        if not self.client_ids:
+            raise RuntimeError("PS client is shut down")
+        handles = [PSHandle(self._lib, [self._lib.tm_ps_ping(cid)], [])
+                   for cid in self.client_ids]
+        alive = []
+        for h in handles:
+            try:
+                h.wait()
+                alive.append(True)
+            except RuntimeError:
+                alive.append(False)
+        return alive
+
     def shutdown(self) -> None:
         for cid in self.client_ids:
             self._lib.tm_ps_client_destroy(cid)
@@ -304,6 +324,10 @@ class ParameterServer:
 
     def ops_served(self) -> int:
         return self.servers.ops_served()
+
+    def healthy(self) -> bool:
+        """All shard servers reachable (see PSClient.ping)."""
+        return all(self.client.ping())
 
     def shutdown(self) -> None:
         self.client.shutdown()

@@ -1,0 +1,113 @@
+"""Tensor (model) parallelism building blocks.
+
+The reference implements data parallelism only (SURVEY.md §3.3 — TP/PP are
+explicitly out of its scope), but requires the communicator design not to
+preclude additional mesh axes (§6.7).  This module exercises that guarantee
+with the two canonical TP layers (Megatron-style), built on the same in-axis
+collectives as everything else:
+
+- :func:`column_parallel_dense` — weight sharded on the OUTPUT feature dim;
+  no communication forward (each device computes its feature slice), psum in
+  backward (handled by autodiff's transpose of the replicated input).
+- :func:`row_parallel_dense` — weight sharded on the INPUT feature dim;
+  forward ends with a psum over the axis (the classic f/g pair).
+
+A column-parallel layer followed by a row-parallel layer (the transformer
+MLP pattern) costs exactly one allreduce forward and one backward.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .. import collectives
+
+
+# The Megatron f/g conjugate pair.  JAX's native transpose of psum is psum,
+# which double-counts when inputs/cotangents are replicated across the model
+# axis; these custom VJPs pin the intended semantics:
+#   g: forward allreduce, backward identity   (end of a row-parallel layer)
+#   f: forward identity,  backward allreduce  (entry of a column-parallel
+#                                              layer, for exact input grads)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def g_allreduce(x, axis_name, backend=None):
+    return collectives.allreduce_in_axis(x, axis_name, op="sum",
+                                         backend=backend)
+
+
+def _g_fwd(x, axis_name, backend):
+    return g_allreduce(x, axis_name, backend), None
+
+
+def _g_bwd(axis_name, backend, _, cot):
+    return (cot,)
+
+
+g_allreduce.defvjp(_g_fwd, _g_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def f_identity(x, axis_name, backend=None):
+    return x
+
+
+def _f_fwd(x, axis_name, backend):
+    return x, None
+
+
+def _f_bwd(axis_name, backend, _, cot):
+    return (collectives.allreduce_in_axis(cot, axis_name, op="sum",
+                                          backend=backend),)
+
+
+f_identity.defvjp(_f_fwd, _f_bwd)
+
+
+def column_parallel_dense(x, w_local, axis_name: str,
+                          b_local: Optional[jnp.ndarray] = None):
+    """x: [..., d_in] replicated over ``axis_name``; w_local: [d_in,
+    d_out/n] this device's column block.  Returns [..., d_out/n] — the local
+    slice of the activations (gather only if you must materialize)."""
+    y = f_identity(x, axis_name) @ w_local
+    if b_local is not None:
+        y = y + b_local
+    return y
+
+
+def row_parallel_dense(x_local, w_local, axis_name: str,
+                       b: Optional[jnp.ndarray] = None,
+                       backend: Optional[str] = None):
+    """x_local: [..., d_in/n] (e.g. the output of a column-parallel layer);
+    w_local: [d_in/n, d_out] this device's row block.  The partial products
+    are summed over the axis — the one collective of the f/g pair."""
+    part = x_local @ w_local
+    y = g_allreduce(part, axis_name, backend)
+    if b is not None:
+        y = y + b
+    return y
+
+
+def tp_mlp(x, w1_local, w2_local, axis_name: str, act=jnp.tanh,
+           backend: Optional[str] = None):
+    """Column -> activation -> row: the Megatron MLP block, one allreduce."""
+    h = act(column_parallel_dense(x, w1_local, axis_name))
+    return row_parallel_dense(h, w2_local, axis_name, backend=backend)
+
+
+def shard_columns(w, axis_name: str, n: int, index):
+    """Static helper: slice a full [d_in, d_out] weight into this device's
+    column block (used at setup time, outside jit, via numpy)."""
+    cols = w.shape[1] // n
+    return w[:, index * cols:(index + 1) * cols]
+
+
+def shard_rows(w, axis_name: str, n: int, index):
+    rows = w.shape[0] // n
+    return w[index * rows:(index + 1) * rows, :]
