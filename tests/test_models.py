@@ -111,3 +111,28 @@ def test_resnet20_dp_convergence(flat_runtime):
             first = float(loss)
     last = float(loss)
     assert last < 0.5 * first, f"no convergence: {first} -> {last}"
+
+
+def test_recipes_remat_matches(flat_runtime):
+    # remat=True must be numerically identical (same math, recomputed).
+    mesh = mpi.world_mesh()
+    model = ResNet20()
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)),
+                           train=False)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+    tx = optax.sgd(0.1, momentum=0.9)
+    opt_state = tx.init(params)
+    X, Y = dutil.synthetic_cifar(64, seed=5)
+    outs = []
+    for remat in (False, True):
+        from torchmpi_tpu import recipes
+        dp = recipes.make_bn_dp_train_step(model, tx, mesh=mesh,
+                                           remat=remat, donate=False)
+        p, o, b = recipes.replicate_bn_state(params, opt_state, batch_stats,
+                                             mesh=mesh)
+        p, o, b, loss = dp(p, o, b, X, Y)
+        outs.append((p, float(loss)))
+    assert abs(outs[0][1] - outs[1][1]) < 1e-6
+    for a, b in zip(jax.tree.leaves(outs[0][0]), jax.tree.leaves(outs[1][0])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5,
+                                   atol=1e-6)

@@ -117,6 +117,12 @@ class Config:
         dcn = os.environ.get("TORCHMPI_TPU_DCN_SIZE")
         if dcn is not None:
             cfg.dcn_size = int(dcn)
+        # Set by `python -m torchmpi_tpu.launch` (the mpirun analog):
+        coord = os.environ.get("TORCHMPI_TPU_COORDINATOR")
+        if coord:
+            cfg.coordinator_address = coord
+            cfg.num_processes = _env_int("TORCHMPI_TPU_NUM_PROCESSES", 1)
+            cfg.process_id = _env_int("TORCHMPI_TPU_PROCESS_ID", 0)
         for k, v in overrides.items():
             if not hasattr(cfg, k):
                 raise ValueError(f"unknown config field {k!r}")

@@ -1,0 +1,85 @@
+"""Process launcher — the reference's ``scripts/`` + mpirun role (SURVEY.md
+§3 C17, reconstructed — reference mount empty).
+
+On a real TPU pod there is nothing to launch: one process per host starts
+via the platform's own tooling and ``init()`` reads the slice metadata.
+What remains useful — and what the reference's mpirun wrappers actually
+provided — is *local multi-process bring-up for development and tests*:
+
+    python -m torchmpi_tpu.launch --nproc 2 --devices-per-proc 2 script.py ...
+
+spawns N processes on this host wired together through ``jax.distributed``
+over a localhost coordinator (CPU devices, gloo collectives), each with
+``TORCHMPI_TPU_PROCESS_ID`` / ``_NUM_PROCESSES`` / ``_COORDINATOR`` set; the
+launched script just calls ``torchmpi_tpu.init()``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import subprocess
+import sys
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m torchmpi_tpu.launch", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("--nproc", type=int, default=2,
+                   help="number of processes (emulated hosts)")
+    p.add_argument("--devices-per-proc", type=int, default=2,
+                   help="simulated CPU devices per process")
+    p.add_argument("script")
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    args = p.parse_args(argv)
+
+    port = _free_port()
+    procs = []
+    for pid in range(args.nproc):
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count="
+              f"{args.devices_per_proc}").strip()
+        env["TORCHMPI_TPU_COORDINATOR"] = f"127.0.0.1:{port}"
+        env["TORCHMPI_TPU_NUM_PROCESSES"] = str(args.nproc)
+        env["TORCHMPI_TPU_PROCESS_ID"] = str(pid)
+        env["TORCHMPI_TPU_LOCAL_CPU"] = "1"
+        procs.append(subprocess.Popen(
+            [sys.executable, args.script] + args.script_args, env=env))
+    # mpirun semantics: first nonzero exit kills the remaining ranks (a
+    # surviving rank would otherwise block forever in a collective whose
+    # peer died).
+    import time
+
+    rc = 0
+    live = list(procs)
+    while live:
+        for p_ in list(live):
+            code = p_.poll()
+            if code is None:
+                continue
+            live.remove(p_)
+            if code != 0 and rc == 0:
+                rc = code
+                for other in live:
+                    other.terminate()
+        time.sleep(0.05)
+    if rc:
+        for p_ in procs:
+            if p_.poll() is None:
+                p_.kill()
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

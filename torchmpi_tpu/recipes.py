@@ -27,6 +27,7 @@ def make_bn_dp_train_step(
     backend: Optional[str] = None,
     n_buckets: Optional[int] = None,
     donate: bool = True,
+    remat: bool = False,
 ) -> Callable:
     """Build the canonical data-parallel SGD step for a flax model carrying a
     ``batch_stats`` (BatchNorm) collection.
@@ -39,11 +40,20 @@ def make_bn_dp_train_step(
     m = mesh if mesh is not None else runtime.current_mesh()
     axes = tuple(m.axis_names)
 
+    def forward(variables, images):
+        return model.apply(variables, images, train=True,
+                           mutable=["batch_stats"])
+
+    if remat:
+        # Rematerialize the forward in backward: trades FLOPs for HBM — the
+        # standard lever when activations, not params, bound the per-chip
+        # batch (SURVEY blueprint's HBM note).
+        forward = jax.checkpoint(forward)
+
     def step(params, opt_state, batch_stats, images, labels):
         def loss_fn(p):
-            logits, updated = model.apply(
-                {"params": p, "batch_stats": batch_stats}, images,
-                train=True, mutable=["batch_stats"])
+            logits, updated = forward(
+                {"params": p, "batch_stats": batch_stats}, images)
             loss = optax.softmax_cross_entropy_with_integer_labels(
                 logits, labels).mean()
             return loss, updated["batch_stats"]

@@ -106,8 +106,29 @@ def init(config: Optional[Config] = None, **overrides) -> Mesh:
                 raise ValueError(f"unknown config field {k!r}")
             setattr(cfg, k, v)
 
+        # Launcher env pickup applies to ANY config (scripts typically pass
+        # an explicit Config; they must still join the launched job rather
+        # than silently running N disconnected single-process copies).
+        import os
+
+        if cfg.coordinator_address is None:
+            coord = os.environ.get("TORCHMPI_TPU_COORDINATOR")
+            if coord:
+                cfg.coordinator_address = coord
+                cfg.num_processes = int(
+                    os.environ.get("TORCHMPI_TPU_NUM_PROCESSES", "1"))
+                cfg.process_id = int(
+                    os.environ.get("TORCHMPI_TPU_PROCESS_ID", "0"))
+
         # Multi-process bring-up (reference: MPI_Init_thread under mpirun).
         if cfg.coordinator_address is not None and not _state.distributed_initialized:
+            if os.environ.get("TORCHMPI_TPU_LOCAL_CPU"):
+                # Launched by `python -m torchmpi_tpu.launch`: emulated
+                # multi-host on CPU devices with gloo cross-process
+                # collectives (the mpirun-on-localhost test rig).
+                jax.config.update("jax_platforms", "cpu")
+                jax.config.update("jax_cpu_collectives_implementation",
+                                  "gloo")
             jax.distributed.initialize(
                 coordinator_address=cfg.coordinator_address,
                 num_processes=cfg.num_processes,
