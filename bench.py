@@ -21,6 +21,8 @@ Platform notes (important for honest numbers):
 """
 
 import json
+import os
+import subprocess
 import sys
 import time
 
@@ -29,7 +31,56 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
+def supervised() -> int:
+    """Run the real benchmark in a child with a hard timeout, so a wedged
+    device runtime (observed: the TPU relay can hang all device ops
+    indefinitely after an earlier client was killed mid-claim) still
+    produces the one-line JSON record instead of silence."""
+    timeout = int(os.environ.get("TORCHMPI_TPU_BENCH_TIMEOUT", "900"))
+    proc = subprocess.Popen([sys.executable, os.path.abspath(__file__),
+                             "--run"],
+                            stdout=subprocess.PIPE, text=True)
+    out = ""
+    try:
+        out, _ = proc.communicate(timeout=timeout)
+        if proc.returncode == 0 and out.strip():
+            print(out.strip().splitlines()[-1])
+            return 0
+        reason = f"bench child exited {proc.returncode}"
+    except subprocess.TimeoutExpired:
+        # SIGTERM first with a grace period: a hard SIGKILL mid-device-claim
+        # is precisely what wedges the relay runtime this wrapper exists to
+        # survive.  Escalate only if the child ignores the request.
+        proc.terminate()
+        try:
+            out, _ = proc.communicate(timeout=30)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            out, _ = proc.communicate()  # reap; drain any partial stdout
+        reason = f"timeout after {timeout}s (device runtime unreachable?)"
+        if out and out.strip():
+            reason += f"; partial output: {out.strip().splitlines()[-1][:200]}"
+    print(json.dumps({
+        "metric": "resnet50_dp_train_throughput",
+        "value": 0.0,
+        "unit": "img/s/chip",
+        "vs_baseline": 0.0,
+        "error": reason,
+    }))
+    return 1
+
+
 def main():
+    # Smoke knobs (CI / wedged-hardware triage): BENCH_CPU forces an
+    # N-device simulated CPU mesh; PRESET=tiny shrinks shapes so the full
+    # path executes in seconds.  Default = real devices, real shapes.
+    cpu_n = int(os.environ.get("TORCHMPI_TPU_BENCH_CPU", "0"))
+    if cpu_n:
+        from torchmpi_tpu.utils.simulation import force_cpu_devices
+
+        force_cpu_devices(cpu_n)
+    tiny = os.environ.get("TORCHMPI_TPU_BENCH_PRESET") == "tiny"
+
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -39,10 +90,10 @@ def main():
     from torchmpi_tpu.models import ResNet50
     from torchmpi_tpu.utils.metrics import fence
 
-    BATCH_PER_CHIP = 64
-    IMAGE = 224
-    STEPS = 20
-    WARMUP = 3
+    BATCH_PER_CHIP = 4 if tiny else 64
+    IMAGE = 64 if tiny else 224
+    STEPS = 3 if tiny else 20
+    WARMUP = 1 if tiny else 3
 
     mesh = mpi.init()
     n_dev = mpi.device_count()
@@ -103,4 +154,10 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    # Under the multi-process launcher the supervisor indirection would
+    # orphan the grandchild holding the collective when the launcher kills
+    # a rank; run directly there (the launcher already supervises).
+    if "--run" in sys.argv or os.environ.get("TORCHMPI_TPU_COORDINATOR"):
+        main()
+    else:
+        raise SystemExit(supervised())
