@@ -1,0 +1,106 @@
+"""Data-parallel scaling-efficiency sweep — the shape of BASELINE.md's
+headline metric (img/s/chip vs single chip, target >=90% at v5e-64).
+
+Runs the same DP train step on growing sub-meshes (1, 2, 4, ... devices)
+with a FIXED per-chip batch (weak scaling, the reference's regime) and
+reports throughput per chip and efficiency vs the single-device run.  On a
+real pod this measures the real thing; on the simulated CPU mesh it
+validates the harness and the collective paths.
+
+Run: ``python benchmarks/scaling_bench.py --devices 8 [--model resnet20]``
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--devices", type=int, default=0)
+    p.add_argument("--model", default="resnet20",
+                   choices=["resnet20", "resnet50"])
+    p.add_argument("--batch-per-chip", type=int, default=16)
+    p.add_argument("--image-size", type=int, default=32)
+    p.add_argument("--steps", type=int, default=5)
+    p.add_argument("--warmup", type=int, default=2)
+    p.add_argument("--backend", default=None)
+    p.add_argument("--json", action="store_true")
+    args = p.parse_args()
+    if args.devices:
+        from torchmpi_tpu.utils.simulation import force_cpu_devices
+
+        force_cpu_devices(args.devices)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    import torchmpi_tpu as mpi
+    from torchmpi_tpu import recipes
+    from torchmpi_tpu.models import ResNet20, ResNet50
+    from torchmpi_tpu.utils.metrics import fence
+
+    mpi.init()
+    all_devices = list(mpi.world_mesh().devices.flat)
+    total = len(all_devices)
+
+    if args.model == "resnet20":
+        model, chans, img = ResNet20(), 3, args.image_size
+    else:
+        model, chans, img = ResNet50(num_classes=100,
+                                     dtype=jnp.bfloat16), 3, args.image_size
+
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, img, img, chans)), train=False)
+    # Host copies: the replicating device_put may alias on-device arrays,
+    # and the train step donates its inputs — donating an alias would
+    # delete this template needed for the next mesh size.
+    variables = jax.tree.map(np.asarray, variables)
+    tx = optax.sgd(0.1, momentum=0.9)
+
+    sizes = [n for n in (1, 2, 4, 8, 16, 32, 64, 128, 256) if n <= total]
+    base_per_chip = None
+    for n in sizes:
+        mesh = Mesh(np.asarray(all_devices[:n]).reshape(1, n),
+                    (mpi.DCN_AXIS, mpi.ICI_AXIS))
+        dp = recipes.make_bn_dp_train_step(model, tx, mesh=mesh,
+                                           backend=args.backend)
+        params, opt_state, batch_stats = recipes.replicate_bn_state(
+            variables["params"], tx.init(variables["params"]),
+            variables["batch_stats"], mesh=mesh)
+        batch = args.batch_per_chip * n
+        shard = NamedSharding(mesh, P((mpi.DCN_AXIS, mpi.ICI_AXIS)))
+        X = jax.device_put(np.random.RandomState(0).rand(
+            batch, img, img, chans).astype(np.float32), shard)
+        Y = jax.device_put(np.random.RandomState(1).randint(
+            0, 10, size=batch).astype(np.int32), shard)
+        for i in range(args.warmup + args.steps):
+            if i == args.warmup:
+                fence(params)
+                t0 = time.time()
+            params, opt_state, batch_stats, loss = dp(params, opt_state,
+                                                      batch_stats, X, Y)
+        fence(loss)
+        dt = time.time() - t0
+        per_chip = args.steps * batch / dt / n
+        if base_per_chip is None:
+            base_per_chip = per_chip
+        eff = per_chip / base_per_chip
+        rec = {"devices": n, "img_s_per_chip": round(per_chip, 2),
+               "efficiency": round(eff, 4),
+               "step_ms": round(dt / args.steps * 1e3, 1)}
+        print(json.dumps(rec) if args.json else
+              f"n={n:4d}  {per_chip:9.2f} img/s/chip  "
+              f"eff {eff*100:6.1f}%  step {rec['step_ms']:8.1f} ms")
+    mpi.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -290,3 +290,35 @@ def test_init_does_not_mutate_user_config():
     assert cfg.hierarchical is False
     assert cfg.chunk_bytes != 1
     mpi.stop()
+
+
+def test_backend_per_op_override(hier_runtime):
+    # Reference parity: the collectiveSelector chose per collective class.
+    mpi.set_config(backend="xla", custom_min_bytes=0,
+                   backend_per_op={"allreduce": "hierarchical"})
+    x = rank_data(64, np.float32)
+    from torchmpi_tpu.parallel.hierarchical import hier_allreduce
+    impl = collectives._pick("allreduce", x[0], None,
+                             mpi.world_mesh().axis_names,
+                             mesh=mpi.world_mesh())
+    assert impl is hier_allreduce
+    # other ops keep the default backend
+    from torchmpi_tpu.collectives import _xla_broadcast
+    impl_b = collectives._pick("broadcast", x[0], None,
+                               mpi.world_mesh().axis_names,
+                               mesh=mpi.world_mesh())
+    assert impl_b is _xla_broadcast
+    out = np.asarray(mpi.allreduce(x))
+    np.testing.assert_allclose(out[0], x.sum(axis=0), rtol=1e-6)
+
+
+def test_backend_per_op_validation_and_isolation(hier_runtime):
+    # Typos fail loudly; the runtime never aliases the caller's dict.
+    with pytest.raises(ValueError):
+        mpi.set_config(backend_per_op={"all_reduce": "hierarchical"})
+    with pytest.raises(ValueError):
+        mpi.set_config(backend_per_op={"allreduce": "nccl"})
+    table = {"allreduce": "hierarchical"}
+    mpi.set_config(backend_per_op=table)
+    table["allreduce"] = "pallas"  # caller mutation must not leak in
+    assert mpi.config().backend_per_op == {"allreduce": "hierarchical"}

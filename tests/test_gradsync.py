@@ -184,3 +184,19 @@ def test_bf16_compression_close_to_exact(flat_runtime):
     np.testing.assert_allclose(comp, exact, rtol=0.05, atol=5e-3)
     with pytest.raises(ValueError):
         body("int3")
+
+
+def test_replicate_does_not_alias_template(flat_runtime):
+    # Donating the replicated copy must never delete the caller's template
+    # (device_put of an on-device array can alias buffers).
+    mesh = mpi.world_mesh()
+    template = jax.device_put(jnp.arange(16.0))  # on-device original
+    rep = gradsync.synchronize_parameters({"w": template})
+    # donate the replicated copy through a jitted identity
+    f = jax.jit(lambda t: jax.tree.map(lambda a: a + 1, t),
+                donate_argnums=(0,))
+    _ = f(rep)
+    # template must still be alive and readable
+    np.testing.assert_allclose(np.asarray(template), np.arange(16.0))
+    rep2 = gradsync.synchronize_parameters({"w": template})
+    np.testing.assert_allclose(np.asarray(rep2["w"]), np.arange(16.0))

@@ -125,6 +125,8 @@ def _pick(op_name: str, x, backend: Optional[str], axes: Tuple[str, ...],
     explicit = backend is not None
     if runtime.is_initialized():
         cfg = runtime.config()
+        if backend is None and cfg.backend_per_op:
+            backend = cfg.backend_per_op.get(op_name)
         backend = backend or (
             "hierarchical" if cfg.hierarchical else cfg.backend)
         custom_min = cfg.custom_min_bytes

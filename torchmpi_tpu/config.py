@@ -61,6 +61,10 @@ class Config:
     # hierarchical path), "pallas" (chunked ring kernels, = reference's custom
     # chunked/pipelined path).
     backend: str = "xla"
+    # Per-op overrides of `backend` (reference: the collectiveSelector table
+    # chose an implementation per collective class).  e.g.
+    # {"allreduce": "pallas", "broadcast": "xla"}.
+    backend_per_op: Optional[dict] = None
     # Flat vs hierarchical collectives (reference: torchmpi_set_flat/
     # hierarchical_collectives).  When True, allreduce over a 2-level mesh is
     # staged: reduce_scatter(ici) -> allreduce(dcn) -> all_gather(ici).

@@ -48,16 +48,35 @@ def _all_axes(mesh: Mesh) -> Tuple[str, ...]:
 # ---------------------------------------------------------------------------
 
 
-def synchronize_parameters(params: PyTree, *, mesh: Optional[Mesh] = None) -> PyTree:
+def synchronize_parameters(params: PyTree, *, mesh: Optional[Mesh] = None,
+                           copy: bool = True) -> PyTree:
     """Replicate a parameter pytree across every device of the mesh.
 
     The reference broadcast ``net:parameters()`` from rank 0; here the
     replicating ``device_put`` *is* that broadcast (source: the controller's
     copy).  Returns the same values, now resident and replicated on the mesh.
+
+    ``copy=True`` (default) breaks buffer aliasing with the input: a
+    device_put of an on-device array can return an aliased buffer, and the
+    usual next step donates the result into a train step — which would
+    silently delete the caller's template.  This is an init-time op; the
+    extra host round-trip is irrelevant.
     """
     m = _default_mesh(mesh)
     repl = NamedSharding(m, P())
-    return jax.tree.map(lambda a: jax.device_put(a, repl), params)
+
+    def put(a):
+        if copy and isinstance(a, jax.Array):
+            if a.is_fully_addressable:
+                a = np.asarray(a)
+            else:
+                # Multi-host global array: host readback is impossible;
+                # a device-side copy (fresh buffers, no donation) breaks
+                # the aliasing just as well.
+                a = jnp.copy(a)
+        return jax.device_put(a, repl)
+
+    return jax.tree.map(put, params)
 
 
 def resynchronize_parameters_in_axis(params: PyTree, axis_names: AxisNames,

@@ -99,8 +99,11 @@ def init(config: Optional[Config] = None, **overrides) -> Mesh:
     with _state.lock:
         if _state.initialized:
             return _state.mesh_stack[0][1]
-        # Copy so later set_config() calls never mutate the caller's object.
+        # Copy so later set_config() calls never mutate the caller's object
+        # (incl. a private copy of the mutable per-op table).
         cfg = Config.from_env() if config is None else dataclasses.replace(config)
+        if cfg.backend_per_op is not None:
+            cfg.backend_per_op = _validate_backend_per_op(cfg.backend_per_op)
         for k, v in overrides.items():
             if not hasattr(cfg, k):
                 raise ValueError(f"unknown config field {k!r}")
@@ -172,12 +175,32 @@ def config() -> Config:
     return _state.config
 
 
+def _validate_backend_per_op(table: Dict[str, str]) -> Dict[str, str]:
+    """Per-op override tables fail loudly on typos (a silently-ignored key
+    would let a user benchmark the wrong implementation)."""
+    from . import selector
+
+    avail = selector.available()
+    for op, backend in table.items():
+        if op not in avail:
+            raise ValueError(
+                f"backend_per_op: unknown collective {op!r} "
+                f"(known: {sorted(avail)})")
+        if backend != "xla" and backend not in avail[op] and backend not in (
+                "hierarchical", "pallas"):
+            raise ValueError(
+                f"backend_per_op[{op!r}]: unknown backend {backend!r}")
+    return dict(table)  # private copy: never alias the caller's dict
+
+
 def set_config(**kw) -> None:
     """Runtime-switch knobs (reference: the torchmpi_set_* FFI setters)."""
     _require_init()
     for k, v in kw.items():
         if not hasattr(_state.config, k):
             raise ValueError(f"unknown config field {k!r}")
+        if k == "backend_per_op" and v is not None:
+            v = _validate_backend_per_op(v)
         setattr(_state.config, k, v)
 
 
