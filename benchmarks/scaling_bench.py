@@ -65,7 +65,9 @@ def main():
     variables = jax.tree.map(np.asarray, variables)
     tx = optax.sgd(0.1, momentum=0.9)
 
-    sizes = [n for n in (1, 2, 4, 8, 16, 32, 64, 128, 256) if n <= total]
+    sizes = [1 << i for i in range(total.bit_length()) if (1 << i) <= total]
+    if sizes[-1] != total:
+        sizes.append(total)  # always measure the full slice
     base_per_chip = None
     for n in sizes:
         mesh = Mesh(np.asarray(all_devices[:n]).reshape(1, n),

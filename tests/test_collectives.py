@@ -322,3 +322,23 @@ def test_backend_per_op_validation_and_isolation(hier_runtime):
     mpi.set_config(backend_per_op=table)
     table["allreduce"] = "pallas"  # caller mutation must not leak in
     assert mpi.config().backend_per_op == {"allreduce": "hierarchical"}
+
+
+def test_backend_per_op_bypasses_cutover_and_validates(hier_runtime):
+    # Per-op entries are deliberate: size cutover must not silently discard
+    # them, and entries for ops without that backend must fail loudly.
+    mpi.set_config(backend_per_op={"allreduce": "pallas"},
+                   custom_min_bytes=1 << 30)
+    x = rank_data(4, np.float32)  # tiny: under any cutover
+    from torchmpi_tpu.ops.ring import ring_allreduce
+    impl = collectives._pick("allreduce", x[0], None,
+                             mpi.world_mesh().axis_names,
+                             mesh=mpi.world_mesh())
+    assert impl is ring_allreduce
+    with pytest.raises(ValueError):
+        mpi.set_config(backend_per_op={"broadcast": "pallas"})  # no impl
+    # init(**overrides) path validates too
+    mpi.stop()
+    with pytest.raises(ValueError):
+        mpi.init(backend_per_op={"all_reduce": "hierarchical"})
+    mpi.stop()

@@ -127,6 +127,10 @@ def _pick(op_name: str, x, backend: Optional[str], axes: Tuple[str, ...],
         cfg = runtime.config()
         if backend is None and cfg.backend_per_op:
             backend = cfg.backend_per_op.get(op_name)
+            # A per-op table entry is a deliberate choice; like a per-call
+            # backend it bypasses the size cutover (topology fallback still
+            # applies).
+            explicit = backend is not None
         backend = backend or (
             "hierarchical" if cfg.hierarchical else cfg.backend)
         custom_min = cfg.custom_min_bytes

@@ -1,0 +1,70 @@
+"""Pipeline (stage) parallelism building block.
+
+Not in the reference (SURVEY.md §3.3: PP explicitly out of its scope; the
+mesh design just must not preclude a stage axis).  This module provides the
+minimal, correct GPipe-style schedule on a mesh axis, mostly as proof that
+the communicator tree composes with a pipeline axis — not a production
+pipeline trainer.
+
+SPMD formulation: every device runs the same ``M + S - 1`` tick loop.  At
+each tick a device receives its predecessor's activation (linear ppermute,
+no wraparound), stage 0 instead injects the next microbatch, every device
+applies its local stage, and the last stage's outputs are collected.  The
+loop is unrolled under jit, so XLA overlaps the ppermute with the next
+tick's compute where profitable, and autodiff differentiates the schedule
+for free (ppermute's transpose is the reverse ppermute — activations flow
+backward through the pipe in reverse stage order, which IS pipeline
+backward).
+
+Bubble fraction is the usual GPipe ``(S-1)/(M+S-1)``; pick ``M >> S``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax.numpy as jnp
+from jax import lax
+
+from .. import collectives
+
+
+def gpipe_apply(stage_fn: Callable, stage_params, microbatches,
+                axis_name: str, *, broadcast_out: bool = True):
+    """Run a linear pipeline over ``axis_name``.
+
+    - ``stage_fn(stage_params, x) -> y``: one stage, same activation shape
+      in and out (use projection stages inside ``stage_fn`` if widths vary;
+      uniform shape keeps the rotating buffer static for XLA).
+    - ``stage_params``: this device's stage (shard a [S, ...] tree over the
+      axis outside).
+    - ``microbatches``: ``[M, mb, ...]`` — the full input, replicated (only
+      stage 0 reads it; replication keeps injection shard-free).
+
+    Returns ``[M, mb, ...]`` outputs — valid on the last stage, broadcast to
+    every device when ``broadcast_out`` (one collective), else zeros off the
+    last stage.
+    """
+    S = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    M = microbatches.shape[0]
+    act_shape = microbatches.shape[1:]
+
+    perm = [(i, i + 1) for i in range(S - 1)]  # linear, no wraparound
+    recv = jnp.zeros(act_shape, microbatches.dtype)
+    zero_in = jnp.zeros(act_shape, microbatches.dtype)
+    outs = []
+    for t in range(M + S - 1):  # static unroll
+        inject = microbatches[t] if t < M else zero_in
+        x = jnp.where(my == 0, inject, recv)
+        h = stage_fn(stage_params, x)
+        if t >= S - 1:
+            # h on the last stage is microbatch (t - S + 1)'s final output.
+            outs.append(jnp.where(my == S - 1, h, jnp.zeros_like(h)))
+        if t != M + S - 2:
+            recv = lax.ppermute(h, axis_name, perm)
+    result = jnp.stack(outs)  # [M, mb, ...]
+    if broadcast_out:
+        result = collectives.broadcast_in_axis(result, axis_name,
+                                               root=S - 1)
+    return result

@@ -107,6 +107,8 @@ def init(config: Optional[Config] = None, **overrides) -> Mesh:
         for k, v in overrides.items():
             if not hasattr(cfg, k):
                 raise ValueError(f"unknown config field {k!r}")
+            if k == "backend_per_op" and v is not None:
+                v = _validate_backend_per_op(v)
             setattr(cfg, k, v)
 
         # Launcher env pickup applies to ANY config (scripts typically pass
@@ -186,10 +188,11 @@ def _validate_backend_per_op(table: Dict[str, str]) -> Dict[str, str]:
             raise ValueError(
                 f"backend_per_op: unknown collective {op!r} "
                 f"(known: {sorted(avail)})")
-        if backend != "xla" and backend not in avail[op] and backend not in (
-                "hierarchical", "pallas"):
+        if backend != "xla" and backend not in avail[op]:
             raise ValueError(
-                f"backend_per_op[{op!r}]: unknown backend {backend!r}")
+                f"backend_per_op[{op!r}]: backend {backend!r} has no "
+                f"implementation for this op (available: "
+                f"{sorted(avail[op])})")
     return dict(table)  # private copy: never alias the caller's dict
 
 
