@@ -1,0 +1,31 @@
+"""The driver's bench contract: `python bench.py` must print exactly one
+JSON line with metric/value/unit/vs_baseline, whatever the hardware does.
+Exercised via the CPU tiny preset (full code path, seconds not minutes)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_bench_emits_one_json_line():
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["TORCHMPI_TPU_BENCH_CPU"] = "4"
+    env["TORCHMPI_TPU_BENCH_PRESET"] = "tiny"
+    env["TORCHMPI_TPU_BENCH_TIMEOUT"] = "420"
+    out = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "bench.py")],
+        capture_output=True, text=True, timeout=480, env=env, cwd=_REPO)
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [l for l in out.stdout.strip().splitlines() if l.strip()]
+    assert len(lines) == 1, out.stdout
+    rec = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline"):
+        assert key in rec, rec
+    assert rec["value"] > 0

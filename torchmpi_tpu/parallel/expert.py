@@ -1,0 +1,96 @@
+"""Expert parallelism: all-to-all Mixture-of-Experts dispatch.
+
+Not in the reference (SURVEY.md §3.3: EP out of its scope, like TP/PP/SP);
+this completes the parallelism-strategy set on the same communicator tree.
+Minimal, correct, capacity-based top-1 MoE:
+
+- every device holds ``experts_per_device`` experts (the expert dimension is
+  sharded over ``axis_name``);
+- tokens are routed by a gating projection, packed into per-expert capacity
+  buffers (static shapes — XLA-friendly; overflow tokens drop, the standard
+  capacity-factor trade), exchanged with ONE ``all_to_all``, processed by
+  the local experts, and returned by the inverse ``all_to_all``;
+- combine scales by the gate probability, so dropped tokens degrade
+  gracefully to zero contribution (residual connections carry them).
+
+The communication pattern (dispatch all-to-all, combine all-to-all) is the
+EP analog of the reference's allreduce: one collective pair per MoE layer.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def top1_dispatch(x, gate_logits, n_experts_global: int, capacity: int):
+    """Pack tokens into per-expert capacity slots (single device's view).
+
+    x: [T, D]; gate_logits: [T, E_global].
+    Returns (buffers [E_global, capacity, D], combine_w [T], expert_of [T],
+    slot_of [T], valid [T]).
+    """
+    T, D = x.shape
+    probs = jax.nn.softmax(gate_logits, axis=-1)
+    expert_of = jnp.argmax(probs, axis=-1)  # [T]
+    gate = jnp.take_along_axis(probs, expert_of[:, None], axis=1)[:, 0]
+    # Position of each token within its expert's queue.
+    onehot = jax.nn.one_hot(expert_of, n_experts_global, dtype=jnp.int32)
+    pos_in_expert = (jnp.cumsum(onehot, axis=0) - 1)  # [T, E]
+    slot_of = jnp.take_along_axis(pos_in_expert, expert_of[:, None],
+                                  axis=1)[:, 0]
+    valid = slot_of < capacity
+    buffers = jnp.zeros((n_experts_global, capacity, D), x.dtype)
+    safe_slot = jnp.where(valid, slot_of, capacity - 1)
+    # scatter-ADD, not set: overflow tokens (clamped to the last slot)
+    # contribute zeros instead of clobbering the slot's real occupant.
+    buffers = buffers.at[expert_of, safe_slot].add(
+        jnp.where(valid[:, None], x, 0.0))
+    return buffers, gate, expert_of, slot_of, valid
+
+
+def moe_layer(x, gate_w, expert_fn: Callable, expert_params,
+              axis_name: str, *, capacity_factor: float = 2.0):
+    """Top-1 expert-parallel MoE layer, for use inside shard_map.
+
+    x: [T, D] this device's tokens; gate_w: [D, E_global] replicated;
+    expert_params: this device's experts, leaves shaped
+    ``[experts_per_device, ...]``; ``expert_fn(params_e, tokens) -> tokens``
+    applies ONE expert.  Returns [T, D].
+    """
+    n_dev = lax.axis_size(axis_name)
+    T, D = x.shape
+    e_local = jax.tree.leaves(expert_params)[0].shape[0]
+    E = n_dev * e_local
+    capacity = max(1, int(capacity_factor * T / E))
+
+    gate_logits = x @ gate_w
+    buffers, gate, expert_of, slot_of, valid = top1_dispatch(
+        x, gate_logits, E, capacity)
+
+    # Dispatch: buffers [E, C, D] with E = n_dev * e_local, expert-major.
+    # tiled all_to_all on axis 0 sends block d (rows d*e_local:(d+1)*e_local)
+    # to device d; the receive concatenates source blocks in order, so
+    # dispatched[s*e_local + j] = source s's buffer for my local expert j.
+    dispatched = lax.all_to_all(buffers, axis_name, split_axis=0,
+                                concat_axis=0, tiled=True)
+    # Per-local-expert queues: [e_local, n_dev * C, D].
+    queues = (dispatched.reshape(n_dev, e_local, capacity, D)
+              .transpose(1, 0, 2, 3).reshape(e_local, n_dev * capacity, D))
+
+    # Apply local experts (vmapped over the expert dim).
+    processed = jax.vmap(expert_fn)(expert_params, queues)
+
+    # Combine: inverse exchange — repack expert-major and all_to_all back,
+    # landing in the original [E, C, D] layout on each source device.
+    packed = (processed.reshape(e_local, n_dev, capacity, D)
+              .transpose(1, 0, 2, 3).reshape(E, capacity, D))
+    returned = lax.all_to_all(packed, axis_name, split_axis=0,
+                              concat_axis=0, tiled=True)
+
+    out = returned[expert_of, jnp.where(valid, slot_of, 0)]
+    out = jnp.where(valid[:, None], out, 0.0) * gate[:, None]
+    return out
