@@ -1,0 +1,73 @@
+"""Worker for the cross-process parameter-server test: rank 0 hosts the
+shard servers (the reference co-located shards on ranks; here one host runs
+the servers and every process' workers reach them over TCP — the DCN
+pattern).  Ranks coordinate through the filesystem (ports file), not the
+SPMD runtime: the PS deliberately lives outside jax.distributed."""
+
+import json
+import os
+import sys
+import time
+
+pid = int(sys.argv[1])
+nproc = int(sys.argv[2])
+ports_file = sys.argv[3]
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np  # noqa: E402
+
+from torchmpi_tpu.parallel.ps import (  # noqa: E402
+    PSClient,
+    ShardedParameterServer,
+)
+from torchmpi_tpu.utils import tree as tree_util  # noqa: E402
+
+template = {"w": np.zeros((64,), np.float32)}
+flat, spec = tree_util.flatten_f32(template)
+
+if pid == 0:
+    servers = ShardedParameterServer(spec.total, num_shards=2)
+    meta = {"ports": servers.ports, "bounds": servers.shard_bounds}
+    with open(ports_file + ".tmp", "w") as f:
+        json.dump(meta, f)
+    os.replace(ports_file + ".tmp", ports_file)
+else:
+    for _ in range(1200):  # rank 0 may be cold-building the C++ extension
+        if os.path.exists(ports_file):
+            break
+        time.sleep(0.05)
+    else:
+        raise TimeoutError("timed out waiting for rank 0's ports file")
+    with open(ports_file) as f:
+        meta = json.load(f)
+
+client = PSClient(template, meta["ports"],
+                  [tuple(b) for b in meta["bounds"]])
+assert client.ping() == [True, True]
+
+# Every process pushes rank+1, 5 times, asynchronously; a done-marker file
+# per rank lets rank 0 wait before checking the accumulated sum.
+handles = [client.send({"w": np.full((64,), float(pid + 1), np.float32)},
+                       rule="add") for _ in range(5)]
+for h in handles:
+    h.wait()
+open(f"{ports_file}.done{pid}", "w").write("1")
+print(f"PSDCN rank={pid} pushed", flush=True)
+
+if pid == 0:
+    for r in range(nproc):
+        for _ in range(1200):
+            if os.path.exists(f"{ports_file}.done{r}"):
+                break
+            time.sleep(0.05)
+        else:
+            raise TimeoutError(f"rank {r} never finished its pushes")
+    got = client.receive().wait()
+    expect = 5.0 * sum(r + 1 for r in range(nproc))
+    assert np.allclose(got["w"], expect), (got["w"][0], expect)
+    print(f"PSDCN rank=0 verified sum {expect}", flush=True)
+    client.shutdown()
+    servers.shutdown()
+else:
+    client.shutdown()
+print(f"PSDCN rank={pid} done", flush=True)

@@ -13,6 +13,37 @@ import pytest
 _WORKER = os.path.join(os.path.dirname(__file__), "_dcn_worker.py")
 
 
+def _run_workers(argv_per_rank, timeout=240):
+    """Spawn one process per rank, capture output, kill all on timeout,
+    assert zero exit codes.  Returns per-rank stdout."""
+    procs = [
+        subprocess.Popen([sys.executable] + argv, stdout=subprocess.PIPE,
+                         stderr=subprocess.STDOUT, text=True,
+                         env=_worker_env())
+        for argv in argv_per_rank
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=timeout)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        raise
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {i} failed:\n{out}"
+    return outs
+
+
+def _worker_env():
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # workers set their own device counts
+    return env
+
+
+
+
 def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
@@ -22,26 +53,24 @@ def _free_port() -> int:
 @pytest.mark.slow
 def test_two_process_world():
     port = _free_port()
-    env = dict(os.environ)
-    env.pop("XLA_FLAGS", None)  # worker sets its own device count
-    procs = [
-        subprocess.Popen(
-            [sys.executable, _WORKER, str(i), "2", str(port)],
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env,
-            text=True)
-        for i in range(2)
-    ]
-    outs = []
-    try:
-        for p in procs:
-            out, _ = p.communicate(timeout=240)
-            outs.append(out)
-    except subprocess.TimeoutExpired:
-        for p in procs:
-            p.kill()
-        raise
-    for i, (p, out) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, f"proc {i} failed:\n{out}"
+    outs = _run_workers([[_WORKER, str(i), "2", str(port)]
+                         for i in range(2)])
+    for i, out in enumerate(outs):
         assert f"CHECK rank={i} done" in out, out
         assert f"CHECK rank={i} eager-allreduce ok" in out, out
         assert f"CHECK rank={i} hierarchical ok" in out, out
+
+
+@pytest.mark.slow
+def test_cross_process_parameter_server(tmp_path):
+    """Async PS over real process boundaries: rank 0 hosts shard servers,
+    three processes push concurrently over TCP, sum verified (SURVEY §4.5's
+    topology, minus MPI)."""
+    worker = os.path.join(os.path.dirname(__file__), "_ps_dcn_worker.py")
+    ports_file = str(tmp_path / "ports.json")
+    nproc = 3
+    outs = _run_workers([[worker, str(i), str(nproc), ports_file]
+                         for i in range(nproc)], timeout=120)
+    for i, out in enumerate(outs):
+        assert f"PSDCN rank={i} done" in out, out
+    assert "verified sum" in outs[0], outs[0]

@@ -1,4 +1,19 @@
-"""Parallelism strategies: hierarchical collectives, gradient sync, parameter
-server.  See SURVEY.md §3.3 for the strategy inventory this mirrors."""
+"""Parallelism strategies on the shared communicator tree.
+
+- data parallel (sync): :mod:`gradsync` (+ the ``nn``/``recipes`` facades)
+- data parallel (async): :mod:`ps` (Downpour/EASGD parameter server)
+- hierarchical 2-level collectives: :mod:`hierarchical`
+- tensor parallel: :mod:`tensor` | pipeline: :mod:`pipeline`
+- sequence/context parallel: :mod:`sequence` | expert: :mod:`expert`
+
+See SURVEY.md §3.3 for which of these existed in the reference (DP only)
+and docs/PARITY.md for the full map.
+"""
 
 from . import hierarchical  # noqa: F401  (registers the "hierarchical" backend)
+from . import gradsync  # noqa: F401
+from . import ps  # noqa: F401
+from . import sequence  # noqa: F401
+from . import tensor  # noqa: F401
+from . import pipeline  # noqa: F401
+from . import expert  # noqa: F401
