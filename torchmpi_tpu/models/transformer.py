@@ -17,19 +17,23 @@ float32 parameters and softmax statistics.
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Optional, Tuple, Union
 
 import flax.linen as nn
 import jax.numpy as jnp
+from jax import lax
 
+from ..parallel import expert as eplib
 from ..parallel import sequence as seqlib
+
+AxisNames = Union[str, Tuple[str, ...]]
 
 
 class SPAttention(nn.Module):
     num_heads: int
     head_dim: int
     attn_impl: str = "local"
-    seq_axis: Optional[str] = None
+    seq_axis: Optional[AxisNames] = None
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
@@ -53,12 +57,70 @@ class SPAttention(nn.Module):
         return nn.Dense(E, dtype=self.dtype, name="out")(o)
 
 
+class MoEMLP(nn.Module):
+    """Expert-parallel MLP: tokens routed over ``expert_axis`` with the
+    all-to-all dispatch of parallel/expert.py.
+
+    Parameter note: expert weights are declared GLOBAL ([n_experts, ...])
+    and each device slices its own block by axis index, so the module works
+    under the replicated-params recipes unchanged.  Compute and
+    communication are true EP (tokens cross devices, each device runs only
+    its experts); parameter MEMORY is not sharded — for memory-scaled EP,
+    shard these params over the expert axis via shard_map in_specs instead.
+
+    The device count comes from the axis itself (static at trace time), so
+    params can never disagree with the dispatch topology.
+    """
+
+    experts_per_device: int
+    mlp_ratio: int = 4
+    expert_axis: Optional[AxisNames] = None
+    capacity_factor: float = 2.0
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):  # x: [B, T, E]
+        B, T, E = x.shape
+        axes = ((self.expert_axis,) if isinstance(self.expert_axis, str)
+                else tuple(self.expert_axis))
+        n_devices = 1
+        for a in axes:
+            n_devices *= lax.axis_size(a)
+        n_experts = self.experts_per_device * n_devices
+        gate_w = self.param("gate", nn.initializers.lecun_normal(),
+                            (E, n_experts), jnp.float32)
+        H = E * self.mlp_ratio
+        w1 = self.param("w1", nn.initializers.lecun_normal(),
+                        (n_experts, E, H), jnp.float32)
+        w2 = self.param("w2", nn.initializers.lecun_normal(),
+                        (n_experts, H, E), jnp.float32)
+        start = lax.axis_index(axes) * self.experts_per_device
+        w1_local = lax.dynamic_slice_in_dim(w1, start,
+                                            self.experts_per_device, 0)
+        w2_local = lax.dynamic_slice_in_dim(w2, start,
+                                            self.experts_per_device, 0)
+
+        def expert_fn(params_e, tokens):
+            a, b = params_e
+            return jnp.tanh(tokens @ a) @ b
+
+        tokens = x.reshape(B * T, E)
+        out = eplib.moe_layer(tokens, gate_w, expert_fn,
+                              (w1_local, w2_local), self.expert_axis,
+                              capacity_factor=self.capacity_factor)
+        return out.reshape(B, T, E).astype(self.dtype)
+
+
 class Block(nn.Module):
     num_heads: int
     head_dim: int
     mlp_ratio: int = 4
     attn_impl: str = "local"
-    seq_axis: Optional[str] = None
+    seq_axis: Optional[AxisNames] = None
+    # When set, the MLP becomes an expert-parallel MoE over this axis.
+    moe_axis: Optional[AxisNames] = None
+    moe_experts_per_device: int = 1
+    moe_capacity_factor: float = 2.0
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
@@ -68,6 +130,11 @@ class Block(nn.Module):
         x = x + SPAttention(self.num_heads, self.head_dim, self.attn_impl,
                             self.seq_axis, self.dtype)(h)
         h = nn.LayerNorm(dtype=jnp.float32)(x)
+        if self.moe_axis is not None:
+            return x + MoEMLP(self.moe_experts_per_device, self.mlp_ratio,
+                              self.moe_axis,
+                              capacity_factor=self.moe_capacity_factor,
+                              dtype=self.dtype)(h)
         h = nn.Dense(E * self.mlp_ratio, dtype=self.dtype)(h)
         h = nn.gelu(h)
         return x + nn.Dense(E, dtype=self.dtype)(h)
@@ -84,7 +151,10 @@ class TransformerLM(nn.Module):
     head_dim: int = 16
     max_len: int = 4096
     attn_impl: str = "local"
-    seq_axis: Optional[str] = None
+    seq_axis: Optional[AxisNames] = None
+    moe_axis: Optional[AxisNames] = None
+    moe_experts_per_device: int = 1
+    moe_capacity_factor: float = 2.0
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
@@ -98,6 +168,9 @@ class TransformerLM(nn.Module):
         for _ in range(self.depth):
             x = Block(self.num_heads, self.head_dim,
                       attn_impl=self.attn_impl, seq_axis=self.seq_axis,
+                      moe_axis=self.moe_axis,
+                      moe_experts_per_device=self.moe_experts_per_device,
+                      moe_capacity_factor=self.moe_capacity_factor,
                       dtype=self.dtype)(x)
         x = nn.LayerNorm(dtype=jnp.float32)(x)
         return nn.Dense(self.vocab, dtype=jnp.float32)(x)
