@@ -74,6 +74,9 @@ class Config:
     # Tensors smaller than this stay on the stock path even when a custom
     # backend is selected (the reference had size cutover constants).
     custom_min_bytes: int = 64 * 1024
+    # Bidirectional pallas ring allreduce: halves rotate in opposite
+    # directions concurrently (2x bandwidth bound on full-duplex ICI).
+    pallas_bidirectional: bool = False
 
     # --- gradient synchronization ------------------------------------------
     # Number of buckets for bucketed/overlapped gradient allreduce.

@@ -59,6 +59,30 @@ def set_interpret(params: Optional[pltpu.InterpretParams]) -> None:
 
 
 
+
+def _step_indices(my, n: int, s: int, sign: int):
+    """Chunk indices for ring step ``s`` (static) in direction ``sign``
+    (+1 clockwise / send-right, -1 counter-clockwise / send-left; the ccw
+    schedule is the cw one under my -> -my, chunk -> -chunk).  Covers both
+    the reduce-scatter phase (s < n-1) and the all-gather phase."""
+    if s < n - 1:
+        send = lax.rem(my - sign * s + 4 * n, n)
+        recv = lax.rem(my - sign * (s + 1) + 4 * n, n)
+    else:
+        t = s - (n - 1)
+        send = lax.rem(my + sign * (1 - t) + 4 * n, n)
+        recv = lax.rem(my - sign * t + 4 * n, n)
+    return send, recv
+
+
+def _pad_and_tile(flat, n: int):
+    """Pad a flat vector to a multiple of n*TILE and tile as [n, rows, 128]."""
+    pad = (-flat.shape[0]) % (n * _TILE)
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    return flat.reshape(n, flat.shape[0] // n // _LANES, _LANES), pad
+
+
 def _neighbor_setup(axis: str, mesh_axes, n: int):
     """Shared kernel preamble: ring neighbors, logical-id mapping, and the
     neighbor barrier (both neighbors inside the kernel before any RDMA).
@@ -86,6 +110,68 @@ def _neighbor_setup(axis: str, mesh_axes, n: int):
     return my, left, right, coords
 
 
+def _ring_allreduce_bidir_kernel(x1_ref, x2_ref, o1_ref, o2_ref,
+                                 comm1_ref, comm2_ref,
+                                 send1, recv1, ack1,
+                                 send2, recv2, ack2,
+                                 *, n: int, axis: str,
+                                 mesh_axes: Tuple[str, ...]):
+    """Bidirectional ring: half 1 rotates clockwise (send right), half 2
+    counter-clockwise (send left) — both directions' DMAs are issued before
+    either is waited on, so a full-duplex interconnect carries both halves
+    concurrently (2x the unidirectional bandwidth bound).
+
+    The schedule is direction-symmetric: in ring-direction space ("next" =
+    right for half 1, left for half 2) both halves run the identical
+    allreduce schedule of ``_ring_allreduce_kernel``.
+    """
+    my, left, right, coords = _neighbor_setup(axis, mesh_axes, n)
+
+    o1_ref[...] = x1_ref[...]
+    o2_ref[...] = x2_ref[...]
+
+    total_steps = 2 * (n - 1)
+    for s in range(total_steps):
+        slot = s % 2
+        reduce_phase = s < n - 1
+        send_idx, recv_idx = _step_indices(my, n, s, +1)
+        send_idx2, recv_idx2 = _step_indices(my, n, s, -1)
+
+        if s >= 2:
+            pltpu.semaphore_wait(ack1, 1)
+            pltpu.semaphore_wait(ack2, 1)
+
+        rdma1 = pltpu.make_async_remote_copy(
+            src_ref=o1_ref.at[send_idx], dst_ref=comm1_ref.at[slot],
+            send_sem=send1.at[slot], recv_sem=recv1.at[slot],
+            device_id=coords(right),
+            device_id_type=pltpu.DeviceIdType.LOGICAL)
+        rdma2 = pltpu.make_async_remote_copy(
+            src_ref=o2_ref.at[send_idx2], dst_ref=comm2_ref.at[slot],
+            send_sem=send2.at[slot], recv_sem=recv2.at[slot],
+            device_id=coords(left),
+            device_id_type=pltpu.DeviceIdType.LOGICAL)
+        rdma1.start()
+        rdma2.start()  # both directions in flight before either wait
+        rdma1.wait()
+        rdma2.wait()
+
+        if reduce_phase:
+            o1_ref[recv_idx] = o1_ref[recv_idx] + comm1_ref[slot]
+            o2_ref[recv_idx2] = o2_ref[recv_idx2] + comm2_ref[slot]
+        else:
+            o1_ref[recv_idx] = comm1_ref[slot]
+            o2_ref[recv_idx2] = comm2_ref[slot]
+
+        pltpu.semaphore_signal(ack1, inc=1, device_id=coords(left),
+                               device_id_type=pltpu.DeviceIdType.LOGICAL)
+        pltpu.semaphore_signal(ack2, inc=1, device_id=coords(right),
+                               device_id_type=pltpu.DeviceIdType.LOGICAL)
+
+    pltpu.semaphore_wait(ack1, 2)
+    pltpu.semaphore_wait(ack2, 2)
+
+
 def _ring_allreduce_kernel(x_ref, o_ref, comm_ref, send_sem, recv_sem,
                            ack_sem, *, n: int, axis: str,
                            mesh_axes: Tuple[str, ...]):
@@ -98,13 +184,7 @@ def _ring_allreduce_kernel(x_ref, o_ref, comm_ref, send_sem, recv_sem,
     for s in range(total_steps):  # n is static: fully unrolled
         slot = s % 2
         reduce_phase = s < n - 1
-        if reduce_phase:
-            send_idx = lax.rem(my + n - s, n) if s else my
-            recv_idx = lax.rem(my + 2 * n - s - 1, n)
-        else:
-            t = s - (n - 1)
-            send_idx = lax.rem(my + 1 + n - t, n)
-            recv_idx = lax.rem(my + n - t, n)
+        send_idx, recv_idx = _step_indices(my, n, s, +1)
 
         if s >= 2:
             # Right neighbor must have freed this slot.
@@ -203,12 +283,10 @@ def _ring_all_gather_kernel(x_ref, o_ref, comm_ref, send_sem, recv_sem,
     pltpu.semaphore_wait(ack_sem, min(2, steps))
 
 
-def _ring_allreduce_padded(flat, n: int, axis: str,
+def _ring_allreduce_padded(x, n: int, axis: str,
                            mesh_axes: Tuple[str, ...]):
-    """flat: [n * rows * 128] on each device, already padded."""
-    per = flat.shape[0] // n
-    rows = per // _LANES
-    x = flat.reshape(n, rows, _LANES)
+    """x: [n, rows, 128] tiled per device (see _pad_and_tile)."""
+    rows = x.shape[1]
     kernel = functools.partial(_ring_allreduce_kernel, n=n, axis=axis,
                                mesh_axes=mesh_axes)
     out = pl.pallas_call(
@@ -228,10 +306,53 @@ def _ring_allreduce_padded(flat, n: int, axis: str,
     return out.reshape(-1)
 
 
+def _ring_allreduce_bidir_padded(flat, n: int, axis: str,
+                                 mesh_axes: Tuple[str, ...]):
+    """flat split in two halves, each padded to n*TILE; both ring in
+    opposite directions concurrently."""
+    half = flat.shape[0] // 2
+    h1, h2 = flat[:half], flat[half:]
+
+    x1, pad1 = _pad_and_tile(h1, n)
+    x2, pad2 = _pad_and_tile(h2, n)
+    kernel = functools.partial(_ring_allreduce_bidir_kernel, n=n, axis=axis,
+                               mesh_axes=mesh_axes)
+    o1, o2 = pl.pallas_call(
+        kernel,
+        out_shape=(_out_sds(x1.shape, x1), _out_sds(x2.shape, x2)),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 2,
+        out_specs=(pl.BlockSpec(memory_space=pltpu.VMEM),
+                   pl.BlockSpec(memory_space=pltpu.VMEM)),
+        scratch_shapes=[
+            pltpu.VMEM((2,) + x1.shape[1:], x1.dtype),
+            pltpu.VMEM((2,) + x2.shape[1:], x2.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.REGULAR,
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.REGULAR,
+        ],
+        compiler_params=pltpu.CompilerParams(collective_id=10),
+        interpret=(_INTERPRET if _INTERPRET is not None else False),
+    )(x1, x2)
+    f1 = o1.reshape(-1)
+    f2 = o2.reshape(-1)
+    if pad1:
+        f1 = f1[:f1.shape[0] - pad1]
+    if pad2:
+        f2 = f2[:f2.shape[0] - pad2]
+    return jnp.concatenate([f1, f2])
+
+
 def ring_allreduce(x, axis_names, *, op: str = "sum"):
     """Selector-registered entry: allreduce over the *last* axis in
     ``axis_names`` with the ring kernel; any leading axes (e.g. ``dcn``) are
     reduced with a stock psum afterwards (hierarchical composition).
+
+    ``config.pallas_bidirectional`` switches to the bidirectional kernel:
+    the tensor splits in half and the halves ring in opposite directions
+    concurrently, doubling the bandwidth bound on full-duplex ICI links.
     """
     if op not in ("sum", "mean"):
         raise KeyError(f"pallas ring allreduce does not support op {op!r}")
@@ -244,6 +365,11 @@ def ring_allreduce(x, axis_names, *, op: str = "sum"):
     # enclosing shard_map, not just the ring axis; see _mesh_axes_for.
     mesh_axes = _mesh_axes_for(axes)
 
+    from .. import runtime
+
+    bidir = (runtime.is_initialized()
+             and getattr(runtime.config(), "pallas_bidirectional", False))
+
     if n == 1:
         out = x
     else:
@@ -251,12 +377,14 @@ def ring_allreduce(x, axis_names, *, op: str = "sum"):
         flat = x.reshape(-1)
         if dtype not in (jnp.float32, jnp.bfloat16, jnp.int32):
             flat = flat.astype(jnp.float32)
-        pad = (-flat.shape[0]) % (n * _TILE)
-        if pad:
-            flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
-        reduced = _ring_allreduce_padded(flat, n, ring_axis, mesh_axes)
-        if pad:
-            reduced = reduced[:reduced.shape[0] - pad]
+        if bidir and flat.shape[0] >= 2 * n * _TILE:
+            reduced = _ring_allreduce_bidir_padded(flat, n, ring_axis,
+                                                   mesh_axes)
+        else:
+            tiled, pad = _pad_and_tile(flat, n)
+            reduced = _ring_allreduce_padded(tiled, n, ring_axis, mesh_axes)
+            if pad:
+                reduced = reduced[:reduced.shape[0] - pad]
         out = reduced.reshape(shape).astype(dtype)
     for a in outer_axes:
         out = lax.psum(out, a)

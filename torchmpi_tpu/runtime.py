@@ -197,7 +197,15 @@ def _validate_backend_per_op(table: Dict[str, str]) -> Dict[str, str]:
 
 
 def set_config(**kw) -> None:
-    """Runtime-switch knobs (reference: the torchmpi_set_* FFI setters)."""
+    """Runtime-switch knobs (reference: the torchmpi_set_* FFI setters).
+
+    Clears the eager-collective executable cache: knobs like
+    ``pallas_bidirectional`` or ``chunk_bytes`` are read at trace time, so a
+    cached executable compiled under the old setting must not be reused (the
+    reference's setters likewise took effect immediately).  In-axis
+    collectives inside a USER's jit are cached by jax itself and keep their
+    traced-time settings until the user retraces.
+    """
     _require_init()
     for k, v in kw.items():
         if not hasattr(_state.config, k):
@@ -205,6 +213,9 @@ def set_config(**kw) -> None:
         if k == "backend_per_op" and v is not None:
             v = _validate_backend_per_op(v)
         setattr(_state.config, k, v)
+    from . import collectives
+
+    collectives.clear_cache()
 
 
 # --- rank/size family -------------------------------------------------------
