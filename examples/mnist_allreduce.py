@@ -10,6 +10,10 @@ Run on 8 simulated devices:
   ``python examples/mnist_allreduce.py --devices 8 --steps 100``
 Hierarchical 2-level allreduce over an emulated 2-slice topology:
   ``python examples/mnist_allreduce.py --devices 8 --dcn 2 --backend hierarchical``
+
+``--backend pallas`` routes gradient sync through the custom ring kernels;
+on simulated CPU meshes those run under the Pallas TPU *interpreter*
+(correctness-speed only — use very few steps; on real ICI they compile).
 """
 
 import common

@@ -31,7 +31,7 @@ the race-detection story, SURVEY.md §6.2) and runnable on real ICI unchanged.
 from __future__ import annotations
 
 import functools
-from typing import Optional, Tuple
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp
@@ -48,14 +48,42 @@ _LANES = 128
 _SUBLANES = 8
 _TILE = _LANES * _SUBLANES
 
-# Interpret-mode toggle for tests (real TPU when False).
-_INTERPRET: Optional[pltpu.InterpretParams] = None
+# Interpret-mode state: None = auto-detect (interpret on CPU meshes, real
+# Mosaic lowering on TPU), False = forced off, InterpretParams = forced on.
+_INTERPRET = None
 
 
-def set_interpret(params: Optional[pltpu.InterpretParams]) -> None:
-    """Enable TPU interpret mode (CPU simulation; supports detect_races)."""
+def set_interpret(params) -> None:
+    """Control Pallas TPU interpret mode.
+
+    ``InterpretParams(...)`` forces the interpreter (CPU simulation;
+    supports ``detect_races``), ``False`` forces real lowering, ``None``
+    restores auto-detection.
+    """
     global _INTERPRET
     _INTERPRET = params
+
+
+def _interpret_mode():
+    """Explicit setting wins; in auto mode, enable the interpreter when the
+    devices actually executing (the runtime mesh when initialized, else the
+    default backend) are CPU — so `--backend pallas` works on simulated
+    meshes even on hosts that also have an accelerator attached."""
+    if _INTERPRET is not None:
+        return _INTERPRET
+    try:
+        from .. import runtime
+
+        if runtime.is_initialized():
+            platform = list(
+                runtime.current_mesh().devices.flat)[0].platform
+        else:
+            platform = jax.default_backend()
+        if platform == "cpu":
+            return pltpu.InterpretParams()
+    except Exception:
+        pass
+    return False
 
 
 
@@ -301,7 +329,7 @@ def _ring_allreduce_padded(x, n: int, axis: str,
             pltpu.SemaphoreType.REGULAR,
         ],
         compiler_params=pltpu.CompilerParams(collective_id=7),
-        interpret=(_INTERPRET if _INTERPRET is not None else False),
+        interpret=_interpret_mode(),
     )(x)
     return out.reshape(-1)
 
@@ -334,7 +362,7 @@ def _ring_allreduce_bidir_padded(flat, n: int, axis: str,
             pltpu.SemaphoreType.REGULAR,
         ],
         compiler_params=pltpu.CompilerParams(collective_id=10),
-        interpret=(_INTERPRET if _INTERPRET is not None else False),
+        interpret=_interpret_mode(),
     )(x1, x2)
     f1 = o1.reshape(-1)
     f2 = o2.reshape(-1)
@@ -478,7 +506,7 @@ def ring_reduce_scatter(x, axis_names, *, op: str = "sum"):
                 pltpu.SemaphoreType.REGULAR,
             ],
             compiler_params=pltpu.CompilerParams(collective_id=8),
-            interpret=(_INTERPRET if _INTERPRET is not None else False),
+            interpret=_interpret_mode(),
         )(xin)
     return out.reshape(-1)[:per].reshape(out_shape)
 
@@ -518,7 +546,7 @@ def ring_all_gather(x, axis_names):
                 pltpu.SemaphoreType.REGULAR,
             ],
             compiler_params=pltpu.CompilerParams(collective_id=9),
-            interpret=(_INTERPRET if _INTERPRET is not None else False),
+            interpret=_interpret_mode(),
         )(xin)
     out = gathered.reshape(n, -1)[:, :L].reshape((n,) + shape)
     for a in reversed(outer_axes):
