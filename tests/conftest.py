@@ -7,20 +7,15 @@ real two-level (2x4) topology — in a single pytest process.
 """
 
 import os
+import sys
 
-# XLA_FLAGS is read at backend-init time, so setting it here still works even
-# though the environment's sitecustomize imported jax at interpreter startup.
-# JAX_PLATFORMS however was already consumed at that import (it may point at
-# the real TPU platform), so the platform is forced via jax.config instead.
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from torchmpi_tpu.utils.simulation import force_cpu_devices  # noqa: E402
+
+force_cpu_devices(8)
 
 import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 import torchmpi_tpu as mpi  # noqa: E402
