@@ -113,6 +113,85 @@ def test_bf16(flat_runtime):
 
 
 # ---------------------------------------------------------------------------
+# Chunked/pipelined schedule (the reference's chunk loop, SURVEY.md §4.2).
+# ---------------------------------------------------------------------------
+
+
+def test_chunk_bytes_changes_schedule():
+    # The knob must demonstrably alter the static schedule: smaller
+    # chunk_bytes => more subchunks per ring chunk (deeper pipeline).
+    nelems = 64 * 1024  # 256 KiB f32
+    plans = {cb: ring._chunk_plan(nelems, 8, np.float32, cb)
+             for cb in (4 * 1024, 16 * 1024, 64 * 1024 * 1024)}
+    assert plans[4 * 1024][1] > plans[16 * 1024][1] > 1
+    assert plans[64 * 1024 * 1024][1] == 1  # fits resident
+    # Coverage: C * sub_elems always covers the per-ring-chunk payload.
+    for sub, c in plans.values():
+        assert c * sub * 8 >= nelems
+
+
+# NOTE on sizes: the interpreter on a SINGLE-CORE host (this container) can
+# deadlock when many device threads block in io_callbacks simultaneously —
+# the per-config outcome is deterministic but the safe boundary is an
+# interleaving artifact, not a protocol property (dev0 was observed
+# completing all iterations while 7 peers sat in _allocate_buffer; see
+# docs/ROUND2_NOTES.md).  Executed chunked tests therefore stay at C=2,
+# K=28, small rows — empirically stable; the >=100 MB bounded-VMEM case is
+# covered compile-side by test_chunked_large_tensor_plan_and_lowering.
+
+
+def test_chunked_allreduce_exact(flat_runtime):
+    # 4 KiB chunk_bytes forces the chunked kernel (C=2) on the 8-ring.
+    mpi.set_config(chunk_bytes=4 * 1024, custom_min_bytes=0)
+    size = 16384
+    sub, C = ring._chunk_plan(size, 8, np.float32, 4 * 1024)
+    assert C == 2, "test must exercise the chunked path"
+    x = rank_data(size)
+    out = _run(x, mpi.world_mesh())
+    expect = x.sum(axis=0)
+    for r in range(8):
+        np.testing.assert_array_equal(out[r], expect)
+
+
+def test_chunked_race_detector(flat_runtime):
+    # The pipelined issue order (next RDMA in flight during reduce+writeback)
+    # must be clean under the interpreter's race detector.
+    ring.set_interpret(pltpu.InterpretParams(detect_races=True))
+    mpi.set_config(chunk_bytes=4 * 1024, custom_min_bytes=0)
+    x = rank_data(16384)
+    out = _run(x, mpi.world_mesh())
+    np.testing.assert_array_equal(out[0], x.sum(axis=0))
+
+
+def test_chunked_interpreter_iteration_cap():
+    # Under the interpreter the plan is coarsened so 2*(n-1)*C stays within
+    # _INTERPRET_MAX_ITERS (single-core-host deadlock guard); real lowering
+    # keeps the full pipeline depth.  Checked at the plan level because the
+    # coarsened configs themselves sit in the interpreter's unstable region
+    # on this 1-core host (see NOTE above).
+    nelems = 26 * 1024 * 1024  # 104 MiB f32
+    full = ring._effective_plan(nelems, 8, np.float32, 4 * 1024 * 1024,
+                                interpreted=False)
+    capped = ring._effective_plan(nelems, 8, np.float32, 4 * 1024 * 1024,
+                                  interpreted=True)
+    assert full[1] == 4  # ~3.25 MiB ring chunks stream in 4 subchunks
+    assert 2 * 7 * capped[1] <= ring._INTERPRET_MAX_ITERS
+    assert capped[1] >= 2  # still chunked, just shallower
+    # Both plans cover the payload and stay VMEM-bounded (4 slots).
+    for sub, c in (full, capped):
+        assert c * sub * 8 >= nelems
+    assert 4 * full[0] * 4 < 32 * 1024 * 1024  # << the 832 MiB resident cost
+
+
+def test_unsupported_dtype_raises(flat_runtime):
+    # Silent downcast would diverge from the xla backend (ADVICE round 1).
+    # float16 survives device_put unchanged (float64 would quietly become
+    # float32 with x64 disabled, never reaching the check).
+    with pytest.raises(TypeError):
+        _run(rank_data(256).astype(np.float16), mpi.world_mesh())
+
+
+# ---------------------------------------------------------------------------
 # Ring reduce-scatter / all-gather kernels (the other custom collectives).
 # ---------------------------------------------------------------------------
 

@@ -69,7 +69,13 @@ class Config:
     # hierarchical_collectives).  When True, allreduce over a 2-level mesh is
     # staged: reduce_scatter(ici) -> allreduce(dcn) -> all_gather(ici).
     hierarchical: bool = False
-    # Chunk size in bytes for chunked/pipelined custom collectives.
+    # Subchunk size in bytes for the chunked/pipelined pallas ring allreduce:
+    # when a tensor's per-ring-chunk payload (size/n) exceeds this, the ring
+    # streams ~chunk_bytes subchunks HBM->VMEM with the next subchunk's RDMA
+    # in flight, keeping VMEM residency at ~4*chunk_bytes (2 comm + 2
+    # accumulate slots) however large the tensor.  Smaller tensors use the
+    # VMEM-resident kernels.  Changing it via set_config invalidates cached
+    # executables, so the new schedule takes effect immediately.
     chunk_bytes: int = 4 * 1024 * 1024
     # Tensors smaller than this stay on the stock path even when a custom
     # backend is selected (the reference had size cutover constants).

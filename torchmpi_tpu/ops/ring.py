@@ -13,6 +13,14 @@ bound XLA's allreduce targets; the point of this kernel, as of the
 reference's, is a *tunable, inspectable* implementation to benchmark against
 the stock one, and a scaffold for fusing compute into collective steps).
 
+Two allreduce schedules exist, selected statically per (shape, chunk_bytes):
+the VMEM-resident kernels below stage the whole tensor in VMEM (fastest when
+it fits); the CHUNKED kernel (``_ring_allreduce_chunked_kernel``) keeps the
+tensor in HBM and streams ``config.chunk_bytes``-sized subchunks through
+double-buffered VMEM slots with the next subchunk's RDMA already in flight —
+the TPU analog of the reference's pipelined chunk loop (SURVEY.md §4.2), and
+the only way a full ResNet-50-sized gradient can ride the custom backend.
+
 Flow-control protocol per step (slot = step % 2):
 
   1. wait ``ack[slot]`` (skipped for the first two steps): the right
@@ -51,6 +59,18 @@ _TILE = _LANES * _SUBLANES
 # Interpret-mode state: None = auto-detect (interpret on CPU meshes, real
 # Mosaic lowering on TPU), False = forced off, InterpretParams = forced on.
 _INTERPRET = None
+
+# Cap on total ring iterations (2*(n-1)*C) under the INTERPRETER only.
+# Above ~45 the interpreter can deadlock on single-core hosts: each device's
+# kernel runs on its own Python thread, but buffer-allocation callbacks block
+# in np.array() on XLA-computed initial values, and with one XLA CPU
+# execution thread a synchronously-blocking semaphore-wait callback starves
+# the executor that would materialize them (observed: dev0 completed all 56
+# iterations while 7 peers sat in _allocate_buffer; faulthandler dump in
+# docs/ROUND2_NOTES.md).  Real Mosaic lowering has no such limit; when the
+# plan exceeds the cap under interpret, subchunks are coarsened (C shrinks,
+# sub_elems grows) — the simulated schedule stays chunked, just shallower.
+_INTERPRET_MAX_ITERS = 28
 
 
 def set_interpret(params) -> None:
@@ -311,6 +331,154 @@ def _ring_all_gather_kernel(x_ref, o_ref, comm_ref, send_sem, recv_sem,
     pltpu.semaphore_wait(ack_sem, min(2, steps))
 
 
+def _chunk_plan(nelems: int, n: int, dtype, chunk_bytes: int):
+    """Static streaming plan for one device's ring schedule.
+
+    Returns ``(sub_elems, C)``: the tensor pads to ``n * C * sub_elems`` and
+    is viewed as ``[n ring chunks, C subchunks, rows, 128]``; each DMA moves
+    one ``sub_elems``-element subchunk (~``chunk_bytes`` bytes, TILE-rounded),
+    so VMEM residency is 4 double-buffered subchunk slots regardless of
+    tensor size.  ``C == 1`` means the whole per-ring-chunk payload fits one
+    subchunk and the VMEM-resident kernel is the better schedule.
+    """
+    ebytes = jnp.dtype(dtype).itemsize
+    sub_elems = max(_TILE, (chunk_bytes // ebytes) // _TILE * _TILE)
+    per = -(-nelems // n)
+    C = max(1, -(-per // sub_elems))
+    if C > 1:
+        # Rebalance so the last subchunk isn't a sliver of padding.
+        sub_elems = -(-per // C)
+        sub_elems = -(-sub_elems // _TILE) * _TILE
+    return sub_elems, C
+
+
+def _effective_plan(nelems: int, n: int, dtype, chunk_bytes: int,
+                    interpreted: bool):
+    """The plan actually executed: under the interpreter the pipeline is
+    coarsened so total iterations 2*(n-1)*C stay within
+    ``_INTERPRET_MAX_ITERS`` (see that constant's comment); real Mosaic
+    lowering always gets the full plan."""
+    sub_elems, C = _chunk_plan(nelems, n, dtype, chunk_bytes)
+    if interpreted and C > 1:
+        max_c = max(1, _INTERPRET_MAX_ITERS // (2 * (n - 1)))
+        if C > max_c:
+            per = -(-nelems // n)
+            C = max_c
+            per_sub = -(-per // C)
+            sub_elems = -(-per_sub // _TILE) * _TILE
+    return sub_elems, C
+
+
+def _ring_allreduce_chunked_kernel(x_ref, o_ref, comm_ref, acc_ref,
+                                   copy_in, copy_out, full_sem,
+                                   send_sem, recv_sem, ack_sem,
+                                   *, n: int, C: int, axis: str,
+                                   mesh_axes: Tuple[str, ...]):
+    """Chunked/pipelined ring allreduce: the analog of the reference's
+    chunk loop (SURVEY.md §4.2 — the performance-critical code upstream).
+
+    x/o live in HBM (``[n, C, rows, 128]``); only two subchunk-sized comm
+    slots and two accumulate slots are VMEM-resident.  Iteration k streams
+    subchunk ``c = k % C`` of ring step ``s = k // C``:
+
+      - the RDMA for iteration k+1 is issued before iteration k's recv is
+        waited on (software pipeline, depth 1), so the next subchunk is on
+        the wire while this one is being reduced and written back — the
+        HBM->VMEM load of the local addend overlaps the RDMA the same way;
+      - subchunks within a step are independent, so the pipeline never
+        crosses a true dependency: step s+1 forwards what step s received,
+        but subchunk (s+1, c)'s RDMA issues C-1 >= 1 iterations after
+        (s, c)'s writeback completed (the kernel requires C > 1; C == 1
+        plans route to the VMEM-resident kernels);
+      - slot reuse is flow-controlled by the same neighbor-ack protocol as
+        the resident kernel (wait one ack per issue from k >= 2).
+    """
+    my, left, right, coords = _neighbor_setup(axis, mesh_axes, n)
+
+    stage = pltpu.make_async_copy(x_ref, o_ref, full_sem)
+    stage.start()
+    stage.wait()
+
+    assert C > 1, "chunked kernel requires a multi-subchunk plan"
+    K = 2 * (n - 1) * C
+
+    def rdma(k):
+        s, c = divmod(k, C)
+        send_idx, _ = _step_indices(my, n, s, +1)
+        return pltpu.make_async_remote_copy(
+            src_ref=o_ref.at[send_idx, c],
+            dst_ref=comm_ref.at[k % 2],
+            send_sem=send_sem.at[k % 2],
+            recv_sem=recv_sem.at[k % 2],
+            device_id=coords(right),
+            device_id_type=pltpu.DeviceIdType.LOGICAL)
+
+    def issue(k):
+        if k >= 2:
+            pltpu.semaphore_wait(ack_sem, 1)
+        rdma(k).start()
+
+    issue(0)
+    for k in range(K):
+        slot = k % 2
+        s, c = divmod(k, C)
+        reduce_phase = s < n - 1
+        _, recv_idx = _step_indices(my, n, s, +1)
+        if k + 1 < K:
+            issue(k + 1)
+        if reduce_phase:
+            load = pltpu.make_async_copy(o_ref.at[recv_idx, c],
+                                         acc_ref.at[slot], copy_in.at[slot])
+            load.start()
+            rdma(k).wait()
+            load.wait()
+            acc_ref[slot] = acc_ref[slot] + comm_ref[slot]
+            src = acc_ref.at[slot]
+        else:
+            rdma(k).wait()
+            src = comm_ref.at[slot]
+        wb = pltpu.make_async_copy(src, o_ref.at[recv_idx, c],
+                                   copy_out.at[slot])
+        wb.start()
+        wb.wait()
+        pltpu.semaphore_signal(ack_sem, inc=1, device_id=coords(left),
+                               device_id_type=pltpu.DeviceIdType.LOGICAL)
+    pltpu.semaphore_wait(ack_sem, min(2, K))
+
+
+def _ring_allreduce_chunked(flat, n: int, axis: str,
+                            mesh_axes: Tuple[str, ...],
+                            sub_elems: int, C: int):
+    """flat: 1-D; pads to [n, C, rows, 128] HBM-resident views."""
+    L = flat.shape[0]
+    padded = n * C * sub_elems
+    if padded > L:
+        flat = jnp.concatenate([flat, jnp.zeros((padded - L,), flat.dtype)])
+    rows = sub_elems // _LANES
+    x = flat.reshape(n, C, rows, _LANES)
+    kernel = functools.partial(_ring_allreduce_chunked_kernel, n=n, C=C,
+                               axis=axis, mesh_axes=mesh_axes)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=_out_sds(x.shape, x),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[
+            pltpu.VMEM((2, rows, _LANES), x.dtype),   # comm slots
+            pltpu.VMEM((2, rows, _LANES), x.dtype),   # accumulate slots
+            pltpu.SemaphoreType.DMA((2,)),            # copy_in
+            pltpu.SemaphoreType.DMA((2,)),            # copy_out
+            pltpu.SemaphoreType.DMA(()),              # full staging copy
+            pltpu.SemaphoreType.DMA((2,)),            # send
+            pltpu.SemaphoreType.DMA((2,)),            # recv
+            pltpu.SemaphoreType.REGULAR,              # ack
+        ],
+        compiler_params=pltpu.CompilerParams(collective_id=11),
+        interpret=_interpret_mode(),
+    )(x)
+    return out.reshape(-1)[:L]
+
+
 def _ring_allreduce_padded(x, n: int, axis: str,
                            mesh_axes: Tuple[str, ...]):
     """x: [n, rows, 128] tiled per device (see _pad_and_tile)."""
@@ -373,14 +541,27 @@ def _ring_allreduce_bidir_padded(flat, n: int, axis: str,
     return jnp.concatenate([f1, f2])
 
 
+_SUPPORTED_DTYPES = (jnp.float32, jnp.bfloat16, jnp.int32)
+
+
 def ring_allreduce(x, axis_names, *, op: str = "sum"):
     """Selector-registered entry: allreduce over the *last* axis in
     ``axis_names`` with the ring kernel; any leading axes (e.g. ``dcn``) are
     reduced with a stock psum afterwards (hierarchical composition).
 
-    ``config.pallas_bidirectional`` switches to the bidirectional kernel:
-    the tensor splits in half and the halves ring in opposite directions
-    concurrently, doubling the bandwidth bound on full-duplex ICI links.
+    Schedule selection (all static, so ``set_config(chunk_bytes=...)``
+    recompiles and genuinely changes the schedule):
+
+    - per-ring-chunk payload > ``config.chunk_bytes``: the chunked/pipelined
+      kernel streams subchunks HBM->VMEM with the next RDMA in flight —
+      VMEM use is bounded by ~4x chunk_bytes however large the tensor;
+    - otherwise ``config.pallas_bidirectional`` and size permitting: the
+      VMEM-resident bidirectional kernel (halves ring in opposite
+      directions, 2x bandwidth bound on full-duplex ICI links);
+    - otherwise: the VMEM-resident unidirectional kernel.
+
+    Supported dtypes: f32, bf16, i32; anything else raises (no silent
+    downcast — a backend swap must never change numerics).
     """
     if op not in ("sum", "mean"):
         raise KeyError(f"pallas ring allreduce does not support op {op!r}")
@@ -395,17 +576,31 @@ def ring_allreduce(x, axis_names, *, op: str = "sum"):
 
     from .. import runtime
 
-    bidir = (runtime.is_initialized()
-             and getattr(runtime.config(), "pallas_bidirectional", False))
+    if runtime.is_initialized():
+        cfg = runtime.config()
+        bidir = getattr(cfg, "pallas_bidirectional", False)
+        chunk_bytes = cfg.chunk_bytes
+    else:
+        from ..config import Config
+
+        bidir = False
+        chunk_bytes = Config().chunk_bytes
 
     if n == 1:
         out = x
     else:
         shape, dtype = x.shape, x.dtype
+        if dtype not in _SUPPORTED_DTYPES:
+            raise TypeError(
+                f"pallas ring allreduce supports f32/bf16/i32, got {dtype} "
+                f"(use the xla backend for other dtypes)")
         flat = x.reshape(-1)
-        if dtype not in (jnp.float32, jnp.bfloat16, jnp.int32):
-            flat = flat.astype(jnp.float32)
-        if bidir and flat.shape[0] >= 2 * n * _TILE:
+        sub_elems, C = _effective_plan(flat.shape[0], n, dtype, chunk_bytes,
+                                       bool(_interpret_mode()))
+        if C > 1:
+            reduced = _ring_allreduce_chunked(flat, n, ring_axis, mesh_axes,
+                                              sub_elems, C)
+        elif bidir and flat.shape[0] >= 2 * n * _TILE:
             reduced = _ring_allreduce_bidir_padded(flat, n, ring_axis,
                                                    mesh_axes)
         else:
