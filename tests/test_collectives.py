@@ -62,6 +62,27 @@ def test_broadcast(flat_runtime, root):
         np.testing.assert_allclose(out[r], x[root])
 
 
+@pytest.mark.parametrize("root", [0, 3, 7])
+@pytest.mark.parametrize("size", [4096, 5000])
+def test_broadcast_chain_path(flat_runtime, root, size):
+    # Above chunk_bytes the broadcast takes the pipelined-chain schedule
+    # (~1x wire instead of masked-psum's ~2x); must be bit-exact with the
+    # small-path result, including non-divisible sizes (padding).
+    mpi.set_config(chunk_bytes=1024)
+    x = rank_data(size, np.float32)
+    out = np.asarray(mpi.broadcast(x, root=root))
+    for r in range(N):
+        np.testing.assert_array_equal(out[r], x[root])
+
+
+def test_broadcast_chain_on_2d_mesh(hier_runtime):
+    mpi.set_config(chunk_bytes=1024)
+    x = rank_data(4096, np.float32)
+    out = np.asarray(mpi.broadcast(x, root=5))
+    for r in range(N):
+        np.testing.assert_array_equal(out[r], x[5])
+
+
 @pytest.mark.parametrize("root", [0, 5])
 def test_reduce(flat_runtime, root):
     x = rank_data(50, np.float32)
@@ -86,6 +107,36 @@ def test_reduce_scatter(flat_runtime):
     expect = x.sum(axis=0).reshape(N, -1)
     for r in range(N):
         np.testing.assert_allclose(out[r], expect[r])
+
+
+@pytest.mark.parametrize("root", [0, 4])
+def test_gather(flat_runtime, root):
+    # MPI_Gather: root's slice is the stack of all ranks' tensors; non-root
+    # slices are zeros (the defined SPMD analog of "untouched").
+    x = rank_data(21, np.float32)
+    out = np.asarray(mpi.gather(x, root=root))
+    assert out.shape == (N, N, 21)
+    np.testing.assert_allclose(out[root], x)
+    for r in range(N):
+        if r != root:
+            np.testing.assert_allclose(out[r], np.zeros_like(x))
+
+
+@pytest.mark.parametrize("root", [0, 6])
+@pytest.mark.parametrize("size", [8, 64, 1000 * 8])
+def test_scatter(flat_runtime, root, size):
+    # MPI_Scatter: rank i receives chunk i of root's tensor.
+    x = rank_data(size, np.float32)
+    out = np.asarray(mpi.scatter(x, root=root))
+    expect = x[root].reshape(N, -1)
+    assert out.shape == (N, size // N)
+    for r in range(N):
+        np.testing.assert_allclose(out[r], expect[r])
+
+
+def test_scatter_indivisible(flat_runtime):
+    with pytest.raises(Exception):
+        mpi.scatter(rank_data(7, np.float32))
 
 
 @pytest.mark.parametrize("src,dst", [(0, 1), (2, 7), (6, 3)])
@@ -193,6 +244,18 @@ def test_hier_reduce(hier_runtime, root):
     x = rank_data(40, np.float32)
     out = np.asarray(mpi.reduce(x, root=root, backend="hierarchical"))
     np.testing.assert_allclose(out[root], x.sum(axis=0))
+
+
+@pytest.mark.parametrize("root", [0, 5])
+def test_hier_gather_scatter(hier_runtime, root):
+    x = rank_data(16, np.float32)
+    g = np.asarray(mpi.gather(x, root=root, backend="hierarchical"))
+    np.testing.assert_allclose(g[root], x)
+    for r in range(N):
+        if r != root:
+            np.testing.assert_allclose(g[r], np.zeros_like(x))
+    s = np.asarray(mpi.scatter(x, root=root, backend="hierarchical"))
+    np.testing.assert_allclose(s.reshape(-1), x[root])
 
 
 def test_hier_allgather(hier_runtime):

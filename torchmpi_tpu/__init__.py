@@ -60,6 +60,8 @@ from .collectives import (
     reduce_scatter,
     sendreceive,
     alltoall,
+    gather,
+    scatter,
     async_,
     sync_handle,
     AsyncHandle,
@@ -73,6 +75,6 @@ __all__ = [
     "current_mesh", "push_communicator", "pop_communicator", "communicator",
     "set_config", "config", "DCN_AXIS", "ICI_AXIS", "WORLD_AXES",
     "collectives", "selector", "parallel", "allreduce", "broadcast", "reduce",
-    "allgather", "reduce_scatter", "sendreceive", "alltoall", "async_",
-    "sync_handle", "AsyncHandle", "__version__",
+    "allgather", "reduce_scatter", "sendreceive", "alltoall", "gather",
+    "scatter", "async_", "sync_handle", "AsyncHandle", "__version__",
 ]

@@ -88,6 +88,8 @@ allreduceTensor = _collectives.allreduce
 broadcastTensor = _collectives.broadcast
 reduceTensor = _collectives.reduce
 allgatherTensor = _collectives.allgather
+gatherTensor = _collectives.gather
+scatterTensor = _collectives.scatter
 sendreceiveTensor = _collectives.sendreceive
 syncHandle = _collectives.sync_handle
 
@@ -96,6 +98,8 @@ async_ = SimpleNamespace(
     broadcastTensor=_collectives.async_.broadcast,
     reduceTensor=_collectives.async_.reduce,
     allgatherTensor=_collectives.async_.allgather,
+    gatherTensor=_collectives.async_.gather,
+    scatterTensor=_collectives.async_.scatter,
     sendreceiveTensor=_collectives.async_.sendreceive,
 )
 

@@ -76,20 +76,14 @@ def hier_allreduce(x, axis_names, *, op: str = "sum"):
 
 
 def hier_broadcast(x, axis_names, *, root: int = 0):
-    """Two-stage broadcast: DCN stage then ICI stage (the reverse order of the
-    reference's reduce, same tree)."""
+    """Broadcast over the (dcn, ici) tree: delegates to the stock broadcast
+    over the combined axes, which streams large tensors down a pipelined
+    ppermute chain (~1x wire) and keeps small ones on single-collective
+    masked-psum (~2x wire but one launch)."""
+    from .. import collectives
+
     outer, inner = _check_axes(axis_names)
-    n_inner = lax.axis_size(inner)
-    root_outer, root_inner = root // n_inner, root % n_inner
-    # Stage 1 (DCN): along each ICI position, take the value from slice
-    # root_outer.
-    masked = jnp.where(lax.axis_index(outer) == root_outer, x,
-                       jnp.zeros_like(x))
-    x = lax.psum(masked, outer)
-    # Stage 2 (ICI): within every slice, take position root_inner's value.
-    masked = jnp.where(lax.axis_index(inner) == root_inner, x,
-                       jnp.zeros_like(x))
-    return lax.psum(masked, inner)
+    return collectives._xla_broadcast(x, (outer, inner), root=root)
 
 
 def hier_reduce(x, axis_names, *, root: int = 0, op: str = "sum"):
@@ -109,7 +103,27 @@ def hier_allgather(x, axis_names):
     return both.reshape((-1,) + x.shape)
 
 
+def hier_gather(x, axis_names, *, root: int = 0):
+    """Gather staged over the tree: ICI gather then DCN gather, masked to
+    root (zeros elsewhere, matching the stock gather's defined semantics)."""
+    outer, inner = _check_axes(axis_names)
+    g = hier_allgather(x, axis_names)
+    r = _global_rank(outer, inner)
+    return jnp.where(r == root, g, jnp.zeros_like(g))
+
+
+def hier_scatter(x, axis_names, *, root: int = 0):
+    """Scatter staged over the tree: DCN+ICI broadcast, then each rank
+    slices its chunk (the stock scatter over the combined axes)."""
+    from .. import collectives
+
+    outer, inner = _check_axes(axis_names)
+    return collectives._xla_scatter(x, (outer, inner), root=root)
+
+
 selector.register("allreduce", "hierarchical", hier_allreduce)
 selector.register("broadcast", "hierarchical", hier_broadcast)
 selector.register("reduce", "hierarchical", hier_reduce)
 selector.register("allgather", "hierarchical", hier_allgather)
+selector.register("gather", "hierarchical", hier_gather)
+selector.register("scatter", "hierarchical", hier_scatter)
