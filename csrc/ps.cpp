@@ -17,6 +17,12 @@
 // Client: one socket per connection; async send/receive run on a small
 // thread pool with per-connection serialization; futures are integer ids
 // (the reference's opaque handles + torchmpi_sync_handle).
+//
+// Trust model: the listener binds loopback only and is UNAUTHENTICATED —
+// any local process can connect and read/overwrite shard contents.  This
+// matches the reference's posture (MPI ranks inside one scheduler-placed
+// job trust each other); do not bind non-loopback interfaces without adding
+// authentication.
 
 #include <arpa/inet.h>
 #include <netinet/in.h>
@@ -25,6 +31,7 @@
 #include <unistd.h>
 
 #include <atomic>
+#include <chrono>
 #include <condition_variable>
 #include <cstdint>
 #include <cstring>
@@ -144,7 +151,10 @@ struct Server {
         if (!write_exact(fd, &ok, 1)) break;
         continue;
       }
-      if (h.offset + h.count > shard.size()) break;  // malformed; drop client
+      // Overflow-safe bounds check: `offset + count` can wrap uint64, so
+      // test count against the remaining space instead (ADVICE round 1).
+      if (h.count > shard.size() || h.offset > shard.size() - h.count)
+        break;  // malformed; drop client
       if (h.op == OP_SEND) {
         buf.resize(h.count);
         if (!read_exact(fd, buf.data(), h.count * sizeof(float))) break;
@@ -228,6 +238,13 @@ struct Future {
 
 struct Client {
   int fd = -1;
+  // Set on the first failed op.  A failure no longer implies a dead TCP
+  // connection (SO_RCVTIMEO can fire while the server is merely slow), and
+  // a late response would desynchronize the request/response stream — the
+  // next op would read the previous op's bytes as its own.  So the first
+  // failure poisons the connection: the socket is shut down and every
+  // subsequent op fails fast.
+  std::atomic<bool> dead{false};
   // Per-connection op serialization: ops on one connection execute in
   // submission order (the reference's async-ordering guarantee, SURVEY §4.4).
   std::mutex io_mu;
@@ -239,7 +256,7 @@ struct Client {
 
   ~Client() { stop(); }
 
-  bool connect_to(const char* host, int port) {
+  bool connect_to(const char* host, int port, int timeout_ms) {
     fd = ::socket(AF_INET, SOCK_STREAM, 0);
     if (fd < 0) return false;
     sockaddr_in addr{};
@@ -250,6 +267,16 @@ struct Client {
       return false;
     int one = 1;
     ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    if (timeout_ms > 0) {
+      // A wedged (alive but unresponsive) server must surface as a failed
+      // future, not a hang: response reads time out, the job completes with
+      // an error, and every tm_ps_wait unblocks (ADVICE round 1).
+      timeval tv{};
+      tv.tv_sec = timeout_ms / 1000;
+      tv.tv_usec = (timeout_ms % 1000) * 1000;
+      ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+      ::setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+    }
     worker = std::thread([this] { run(); });
     return true;
   }
@@ -351,9 +378,11 @@ void tm_ps_server_destroy(int64_t sid) {
 }
 
 // ---- client ----
-int64_t tm_ps_client_connect(const char* host, int port) {
+// timeout_ms > 0 arms SO_RCVTIMEO/SO_SNDTIMEO on the connection; 0 = never
+// time out (the round-1 behavior).
+int64_t tm_ps_client_connect(const char* host, int port, int timeout_ms) {
   auto c = std::make_shared<Client>();
-  if (!c->connect_to(host, port)) return -1;
+  if (!c->connect_to(host, port, timeout_ms)) return -1;
   std::lock_guard<std::mutex> g(g_mu);
   int64_t id = g_next_id++;
   g_clients[id] = std::move(c);
@@ -399,12 +428,14 @@ int64_t tm_ps_send(int64_t cid, uint32_t rule, float alpha, uint64_t offset,
     h.offset = offset;
     h.count = count;
     std::lock_guard<std::mutex> g(c->io_mu);
-    bool ok = write_exact(c->fd, &h, sizeof(h)) &&
+    bool ok = !c->dead.load() &&
+              write_exact(c->fd, &h, sizeof(h)) &&
               write_exact(c->fd, payload->data(), count * sizeof(float));
     uint8_t st = 0;
     ok = ok && read_exact(c->fd, &st, 1) && st == 1;
     if (ok && rule == RULE_ELASTIC)
       ok = read_exact(c->fd, inout, count * sizeof(float));
+    if (!ok && !c->dead.exchange(true)) ::shutdown(c->fd, SHUT_RDWR);
     complete(fut, ok ? 1 : -1);
   });
   return fid;
@@ -431,10 +462,11 @@ int64_t tm_ps_receive(int64_t cid, uint64_t offset, float* out,
     h.offset = offset;
     h.count = count;
     std::lock_guard<std::mutex> g(c->io_mu);
-    bool ok = write_exact(c->fd, &h, sizeof(h));
+    bool ok = !c->dead.load() && write_exact(c->fd, &h, sizeof(h));
     uint8_t st = 0;
     ok = ok && read_exact(c->fd, &st, 1) && st == 1;
     ok = ok && read_exact(c->fd, out, count * sizeof(float));
+    if (!ok && !c->dead.exchange(true)) ::shutdown(c->fd, SHUT_RDWR);
     complete(fut, ok ? 1 : -1);
   });
   return fid;
@@ -461,8 +493,10 @@ int64_t tm_ps_ping(int64_t cid) {
     h.op = OP_PING;
     std::lock_guard<std::mutex> g(c->io_mu);
     uint8_t st = 0;
-    bool ok = write_exact(c->fd, &h, sizeof(h)) &&
+    bool ok = !c->dead.load() &&
+              write_exact(c->fd, &h, sizeof(h)) &&
               read_exact(c->fd, &st, 1) && st == 1;
+    if (!ok && !c->dead.exchange(true)) ::shutdown(c->fd, SHUT_RDWR);
     complete(fut, ok ? 1 : -1);
   });
   return fid;
@@ -481,6 +515,34 @@ int tm_ps_wait(int64_t fid) {
   std::unique_lock<std::mutex> lk(f->mu);
   f->cv.wait(lk, [&] { return f->done; });
   return f->status;
+}
+
+// Timed wait: like tm_ps_wait but returns -3 on timeout WITHOUT freeing the
+// future (the op may still complete; caller decides to retry, wait again, or
+// forget).  Lets destructors and monitors bound their blocking (ADVICE
+// round 1: wait() during GC must not hang the interpreter).
+int tm_ps_wait_for(int64_t fid, int timeout_ms) {
+  std::shared_ptr<Future> f;
+  {
+    std::lock_guard<std::mutex> g(g_mu);
+    auto it = g_futures.find(fid);
+    if (it == g_futures.end()) return -2;
+    f = it->second;
+  }
+  {
+    std::unique_lock<std::mutex> lk(f->mu);
+    if (!f->cv.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                        [&] { return f->done; }))
+      return -3;
+  }
+  int status;
+  {
+    std::lock_guard<std::mutex> lk(f->mu);
+    status = f->status;
+  }
+  std::lock_guard<std::mutex> g(g_mu);
+  g_futures.erase(fid);
+  return status;
 }
 
 // Drop interest in a future without waiting (fire-and-forget sends).  The

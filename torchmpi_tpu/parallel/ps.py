@@ -18,6 +18,9 @@ This lives deliberately outside SPMD: async PS traffic cannot ride
 gang-scheduled XLA collectives (SURVEY.md §8.2.5); device arrays are staged
 host-side (numpy) exactly as the reference staged GPU tensors through pinned
 buffers.
+
+Dtype contract: the wire/shard format is float32; f32/bf16/f16 leaves round
+trip bit-exactly, anything lossy raises (see utils/tree.py).
 """
 
 from __future__ import annotations
@@ -36,8 +39,18 @@ PyTree = Any
 
 RULES = {"copy": 0, "add": 1, "zero": 2, "axpy": 3, "elastic": 4}
 
+# Socket timeout armed on every client connection: a wedged shard server
+# surfaces as a failed future within this bound instead of hanging wait()
+# (ADVICE round 1).  0 disables.
+PS_TIMEOUT_MS = int(os.environ.get("TORCHMPI_TPU_PS_TIMEOUT_MS", "30000"))
+
 _LIB_LOCK = threading.Lock()
 _LIB: Optional[ctypes.CDLL] = None
+
+# Last-resort keep-alive for buffers whose native op never completed within
+# the destructor's bounded wait (should be unreachable with socket timeouts
+# armed): leaking beats a native write into freed numpy memory.
+_ORPHANED_BUFFERS: List[Any] = []
 
 
 def _repo_root() -> str:
@@ -45,8 +58,19 @@ def _repo_root() -> str:
         os.path.abspath(__file__))))
 
 
+def _src_digest(path: str) -> str:
+    import hashlib
+
+    with open(path, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()
+
+
 def _load_lib() -> ctypes.CDLL:
-    """Load (building if necessary) the host-transport shared library."""
+    """Load (building if necessary) the host-transport shared library.
+
+    Staleness is keyed on a content hash of ps.cpp stored next to the
+    binary — mtimes are meaningless after git clone (ADVICE round 1), and
+    build/ is no longer committed."""
     global _LIB
     with _LIB_LOCK:
         if _LIB is not None:
@@ -54,12 +78,23 @@ def _load_lib() -> ctypes.CDLL:
         root = _repo_root()
         so = os.path.join(root, "build", "libtorchmpi_ps.so")
         src = os.path.join(root, "csrc", "ps.cpp")
-        stale = (not os.path.exists(so)
-                 or (os.path.exists(src)
-                     and os.path.getmtime(src) > os.path.getmtime(so)))
-        if stale:
-            subprocess.run(["make", "-C", os.path.join(root, "csrc")],
-                           check=True, capture_output=True)
+        if os.path.exists(src):
+            digest_file = so + ".srchash"
+            digest = _src_digest(src)
+            built = None
+            if os.path.exists(so) and os.path.exists(digest_file):
+                with open(digest_file) as f:
+                    built = f.read().strip()
+            if built != digest:
+                subprocess.run(["make", "-C", os.path.join(root, "csrc")],
+                               check=True, capture_output=True)
+                with open(digest_file, "w") as f:
+                    f.write(digest)
+        elif not os.path.exists(so):
+            raise RuntimeError(
+                f"parameter-server transport unavailable: neither {so} nor "
+                f"{src} exists")
+        # src absent but .so present: prebuilt deployment; load as-is.
         lib = ctypes.CDLL(so)
         lib.tm_ps_server_create.restype = ctypes.c_int64
         lib.tm_ps_server_create.argtypes = [ctypes.c_uint64, ctypes.c_int]
@@ -70,7 +105,8 @@ def _load_lib() -> ctypes.CDLL:
         lib.tm_ps_server_destroy.restype = None
         lib.tm_ps_server_destroy.argtypes = [ctypes.c_int64]
         lib.tm_ps_client_connect.restype = ctypes.c_int64
-        lib.tm_ps_client_connect.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.tm_ps_client_connect.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                             ctypes.c_int]
         lib.tm_ps_client_destroy.restype = None
         lib.tm_ps_client_destroy.argtypes = [ctypes.c_int64]
         lib.tm_ps_send.restype = ctypes.c_int64
@@ -84,6 +120,8 @@ def _load_lib() -> ctypes.CDLL:
             ctypes.c_uint64]
         lib.tm_ps_wait.restype = ctypes.c_int
         lib.tm_ps_wait.argtypes = [ctypes.c_int64]
+        lib.tm_ps_wait_for.restype = ctypes.c_int
+        lib.tm_ps_wait_for.argtypes = [ctypes.c_int64, ctypes.c_int]
         lib.tm_ps_test.restype = ctypes.c_int
         lib.tm_ps_test.argtypes = [ctypes.c_int64]
         lib.tm_ps_forget.restype = None
@@ -125,15 +163,32 @@ class PSHandle:
                 self._pending.pop(0)
                 if status != 1:
                     self._failed = True
-                    for rest in self._pending:
-                        self._lib.tm_ps_forget(rest)
-                    self._pending = []
+                    self._drain_pending()
                     raise RuntimeError(f"parameter-server op failed "
                                        f"(status {status})")
             self._done = True
             self._result = (self._result_fn() if self._result_fn is not None
                             else None)
         return self._result
+
+    def _drain_pending(self):
+        """Retire remaining futures after a failure.  Futures whose native
+        ops write into our numpy buffers (other shards of a receive may
+        still be in flight — shard failures are per-connection) must be
+        drained with a bounded wait; if one is STILL in flight after the
+        budget, its buffers are parked in _ORPHANED_BUFFERS rather than
+        freed under a writing native thread."""
+        budget_ms = 2 * PS_TIMEOUT_MS if PS_TIMEOUT_MS > 0 else 0
+        for rest in self._pending:
+            if self._result_fn is None:
+                self._lib.tm_ps_forget(rest)
+            elif budget_ms > 0:
+                if self._lib.tm_ps_wait_for(rest, budget_ms) == -3:
+                    _ORPHANED_BUFFERS.append(self._buffers)
+                    self._lib.tm_ps_forget(rest)
+            else:
+                self._lib.tm_ps_wait(rest)
+        self._pending = []
 
     @property
     def done(self) -> bool:
@@ -146,15 +201,13 @@ class PSHandle:
         # leak future registry entries in the native layer.  Handles whose
         # ops write back into Python-owned buffers (receive / elastic —
         # marked by result_fn) must instead be drained: forgetting them
-        # would free numpy memory the native thread still writes.
+        # would free numpy memory the native thread still writes.  The
+        # drain is BOUNDED (2x the socket timeout) so GC/interpreter
+        # shutdown can never hang on a wedged server; a timed-out op's
+        # buffers are parked in _ORPHANED_BUFFERS instead of freed.
         try:
-            pending = getattr(self, "_pending", [])
-            if self._result_fn is not None:
-                for fid in pending:
-                    self._lib.tm_ps_wait(fid)
-            else:
-                for fid in pending:
-                    self._lib.tm_ps_forget(fid)
+            if getattr(self, "_pending", None):
+                self._drain_pending()
         except Exception:
             pass
 
@@ -213,7 +266,8 @@ class PSClient:
         self.shard_bounds = list(shard_bounds)
         self.client_ids: List[int] = []
         for port in ports:
-            cid = self._lib.tm_ps_client_connect(host.encode(), int(port))
+            cid = self._lib.tm_ps_client_connect(host.encode(), int(port),
+                                                 PS_TIMEOUT_MS)
             if cid < 0:
                 raise RuntimeError(f"failed to connect to PS at "
                                    f"{host}:{port}")
