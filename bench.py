@@ -140,8 +140,33 @@ def main():
 
     img_s = STEPS * batch / dt
     img_s_chip = img_s / n_dev
+
+    # Achieved TFLOP/s + MFU from XLA's own cost model of the compiled
+    # per-device step (VERDICT round 1: BENCH must judge perf, not just
+    # liveness).  v5e peak is 394 TFLOP/s bf16; override via env for other
+    # chips.  MFU is only meaningful on real accelerator runs.
+    step_flops = 0.0
+    try:
+        # cost_analysis on the LOWERING, not a compiled executable: AOT
+        # compile would not reuse the jit dispatch cache and would pay the
+        # (minutes-long on TPU) step compile a second time just for a flops
+        # number.  The pre-optimization estimate is fine for MFU.
+        ca = dp_step.jitted.lower(params, opt_state, batch_stats, images,
+                                  labels).cost_analysis()
+        step_flops = float(ca.get("flops", 0.0)) if ca else 0.0
+        if not step_flops:
+            log(f"cost_analysis gave no flops (type={type(ca).__name__}, "
+                f"keys={len(ca) if ca else 0})")
+    except Exception as e:  # noqa: BLE001 — cost model is best-effort
+        log(f"cost_analysis unavailable: {e}")
+    tflops_chip = step_flops / (dt / STEPS) / 1e12
+    platform = list(mesh.devices.flat)[0].platform
+    peak = float(os.environ.get("TORCHMPI_TPU_PEAK_TFLOPS", "394"))
+    mfu = round(tflops_chip / peak, 4) if platform == "tpu" else None
+
     log(f"step time {dt/STEPS*1000:.1f} ms, total {img_s:.1f} img/s, "
-        f"loss {float(loss):.3f}")
+        f"loss {float(loss):.3f}, {tflops_chip:.4g} TFLOP/s/chip, "
+        f"MFU {mfu}")
     print(json.dumps({
         "metric": "resnet50_dp_train_throughput",
         "value": round(img_s_chip, 1),
@@ -149,7 +174,10 @@ def main():
         "vs_baseline": 1.0,
         "extra": {"devices": n_dev, "global_batch": batch,
                   "step_ms": round(dt / STEPS * 1000, 2),
-                  "dtype": "bfloat16", "image": IMAGE},
+                  "dtype": "bfloat16", "image": IMAGE,
+                  "tflops_per_chip": round(tflops_chip, 4),
+                  "mfu": mfu, "peak_tflops": peak,
+                  "platform": platform},
     }))
 
 

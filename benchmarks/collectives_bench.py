@@ -1,10 +1,13 @@
-"""Collective micro-benchmark: allreduce bandwidth sweep across backends.
+"""Collective micro-benchmark: allreduce + broadcast sweeps across backends.
 
 Reference analog: ``benchmarks/*.lua`` (SURVEY.md §3 C14, reconstructed —
 reference mount empty): sweep message sizes, report effective bus bandwidth
-(``algbw * 2(n-1)/n``), compare implementations — the reference compared
-stock MPI vs NCCL vs its custom chunked algorithms; here we compare
-``xla`` vs ``hierarchical`` vs ``pallas``.
+(``algbw * 2(n-1)/n`` for allreduce; ``bytes/time`` for broadcast), compare
+implementations — the reference compared stock MPI vs NCCL vs its custom
+chunked algorithms; here we compare ``xla`` vs ``hierarchical`` vs
+``pallas``.  Broadcast is benchmarked next to allreduce because its
+pipelined-chain schedule should reach ~2x the allreduce wire efficiency
+(~size vs ~2*size bytes moved per device; VERDICT round 1 item 6).
 
 The BASELINE target is this sweep measured from 8 to 256 chips on a real
 pod; on the simulated CPU mesh the numbers exercise the same code paths and
@@ -87,8 +90,35 @@ def main():
             if args.json:
                 print(json.dumps(line))
             else:
-                print(f"{backend:13s} {nbytes:>12d} B  {dt*1e3:8.2f} ms  "
-                      f"busbw {busbw:8.3f} GB/s")
+                print(f"{'allreduce':10s} {backend:13s} {nbytes:>12d} B  "
+                      f"{dt*1e3:8.2f} ms  busbw {busbw:8.3f} GB/s")
+
+        # Broadcast next to allreduce: algo bytes = tensor size, so with the
+        # chain schedule bcast busbw should approach 2x the allreduce line.
+        for backend in [b for b in backends if b != "pallas"]:
+            if backend == "hierarchical" and mesh.shape[mpi.DCN_AXIS] <= 1:
+                continue
+            try:
+                out = mpi.broadcast(x, root=0, backend=backend)
+                fence(out)
+                t0 = time.time()
+                for _ in range(args.iters):
+                    out = mpi.broadcast(x, root=0, backend=backend)
+                fence(out)
+                dt = (time.time() - t0) / args.iters
+            except Exception as e:  # noqa: BLE001 — report and continue
+                print(f"broadcast {backend:13s} {nbytes:>12d} B  FAILED: {e}",
+                      file=sys.stderr)
+                continue
+            bw = nbytes / dt / 1e9
+            line = {"op": "broadcast", "backend": backend, "bytes": nbytes,
+                    "devices": n, "ms": round(dt * 1e3, 3),
+                    "busbw_GBs": round(bw, 3)}
+            if args.json:
+                print(json.dumps(line))
+            else:
+                print(f"{'broadcast':10s} {backend:13s} {nbytes:>12d} B  "
+                      f"{dt*1e3:8.2f} ms  busbw {bw:8.3f} GB/s")
     mpi.stop()
 
 

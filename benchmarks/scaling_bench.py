@@ -29,6 +29,10 @@ def main():
     p.add_argument("--steps", type=int, default=5)
     p.add_argument("--warmup", type=int, default=2)
     p.add_argument("--backend", default=None)
+    p.add_argument("--bucket-sweep", default=None,
+                   help="comma-separated gradsync bucket counts to sweep on "
+                        "the full mesh (comm/compute-overlap tuning; the "
+                        "reference tuned its chunk pipeline the same way)")
     p.add_argument("--json", action="store_true")
     args = p.parse_args()
     if args.devices:
@@ -101,6 +105,40 @@ def main():
         print(json.dumps(rec) if args.json else
               f"n={n:4d}  {per_chip:9.2f} img/s/chip  "
               f"eff {eff*100:6.1f}%  step {rec['step_ms']:8.1f} ms")
+
+    # Bucket sweep on the full mesh: more buckets = earlier allreduce
+    # launches during backward (more overlap) but more collective launches;
+    # the optimum is hardware-dependent, measured here, defaulted in config.
+    if args.bucket_sweep:
+        mesh = Mesh(np.asarray(all_devices).reshape(1, total),
+                    (mpi.DCN_AXIS, mpi.ICI_AXIS))
+        batch = args.batch_per_chip * total
+        shard = NamedSharding(mesh, P((mpi.DCN_AXIS, mpi.ICI_AXIS)))
+        X = jax.device_put(np.random.RandomState(0).rand(
+            batch, img, img, chans).astype(np.float32), shard)
+        Y = jax.device_put(np.random.RandomState(1).randint(
+            0, 10, size=batch).astype(np.int32), shard)
+        for nb in [int(b) for b in args.bucket_sweep.split(",")]:
+            dp = recipes.make_bn_dp_train_step(model, tx, mesh=mesh,
+                                               backend=args.backend,
+                                               n_buckets=nb)
+            params, opt_state, batch_stats = recipes.replicate_bn_state(
+                variables["params"], tx.init(variables["params"]),
+                variables["batch_stats"], mesh=mesh)
+            for i in range(args.warmup + args.steps):
+                if i == args.warmup:
+                    fence(params)
+                    t0 = time.time()
+                params, opt_state, batch_stats, loss = dp(
+                    params, opt_state, batch_stats, X, Y)
+            fence(loss)
+            dt = time.time() - t0
+            rec = {"buckets": nb, "devices": total,
+                   "img_s_per_chip": round(args.steps * batch / dt / total, 2),
+                   "step_ms": round(dt / args.steps * 1e3, 1)}
+            print(json.dumps(rec) if args.json else
+                  f"buckets={nb:3d}  {rec['img_s_per_chip']:9.2f} "
+                  f"img/s/chip  step {rec['step_ms']:8.1f} ms")
     mpi.stop()
 
 

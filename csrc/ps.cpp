@@ -25,8 +25,11 @@
 // authentication.
 
 #include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <poll.h>
 #include <sys/socket.h>
 #include <unistd.h>
 
@@ -263,8 +266,30 @@ struct Client {
     addr.sin_family = AF_INET;
     addr.sin_port = htons(static_cast<uint16_t>(port));
     if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) return false;
-    if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0)
+    if (timeout_ms > 0) {
+      // The bounded-failure contract covers the connection phase too: a
+      // listener with a full accept backlog drops SYNs and a blocking
+      // connect() would ride the kernel retry schedule (~2 min) past any
+      // socket timeout.  Non-blocking connect + poll bounds it.
+      int flags = ::fcntl(fd, F_GETFL, 0);
+      ::fcntl(fd, F_SETFL, flags | O_NONBLOCK);
+      int rc = ::connect(fd, reinterpret_cast<sockaddr*>(&addr),
+                         sizeof(addr));
+      if (rc != 0) {
+        if (errno != EINPROGRESS) return false;
+        pollfd pfd{fd, POLLOUT, 0};
+        if (::poll(&pfd, 1, timeout_ms) != 1) return false;
+        int err = 0;
+        socklen_t len = sizeof(err);
+        if (::getsockopt(fd, SOL_SOCKET, SO_ERROR, &err, &len) != 0 ||
+            err != 0)
+          return false;
+      }
+      ::fcntl(fd, F_SETFL, flags);
+    } else if (::connect(fd, reinterpret_cast<sockaddr*>(&addr),
+                         sizeof(addr)) != 0) {
       return false;
+    }
     int one = 1;
     ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
     if (timeout_ms > 0) {

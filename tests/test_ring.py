@@ -306,6 +306,39 @@ def test_bidirectional_on_2d_mesh(hier_runtime):
     np.testing.assert_allclose(out[0], x.sum(axis=0), rtol=1e-6)
 
 
+@pytest.mark.parametrize("size", [16384, 16385])
+def test_bidir_chunked_allreduce(size):
+    # Bidirectional + chunked compose: halves stream in opposite directions
+    # with the chunked schedule.  n=4 ici ring keeps the interpreter in its
+    # stable region (see NOTE above); odd size exercises unequal halves.
+    mpi.stop()
+    mpi.init(mpi.Config(dcn_size=2, custom_min_bytes=0, chunk_bytes=4096,
+                        pallas_bidirectional=True))
+    try:
+        assert ring._effective_plan(size // 2, 4, np.float32, 4096,
+                                    True)[1] > 1
+        x = rank_data(size)
+        out = _run(x, mpi.world_mesh(), axes=("dcn", "ici"))
+        np.testing.assert_allclose(out[0], x.sum(axis=0), rtol=1e-6)
+        for r in range(1, 8):
+            np.testing.assert_array_equal(out[r], out[0])
+    finally:
+        mpi.stop()
+
+
+def test_bidir_chunked_race_detector():
+    ring.set_interpret(pltpu.InterpretParams(detect_races=True))
+    mpi.stop()
+    mpi.init(mpi.Config(dcn_size=2, custom_min_bytes=0, chunk_bytes=4096,
+                        pallas_bidirectional=True))
+    try:
+        x = rank_data(16384)
+        out = _run(x, mpi.world_mesh(), axes=("dcn", "ici"))
+        np.testing.assert_allclose(out[0], x.sum(axis=0), rtol=1e-6)
+    finally:
+        mpi.stop()
+
+
 def test_bidir_flag_flip_recompiles(flat_runtime):
     # set_config must invalidate cached executables so the flag takes
     # effect immediately (the reference's setters were live).

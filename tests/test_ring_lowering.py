@@ -78,6 +78,20 @@ def test_chunked_allreduce_100mb_lowers(flat_runtime):
     _export_for_tpu(body, (8, nelems), mesh)
 
 
+def test_bidir_chunked_allreduce_100mb_lowers(flat_runtime):
+    mpi.set_config(pallas_bidirectional=True, chunk_bytes=4 * 1024 * 1024,
+                   custom_min_bytes=0)
+    mesh = mpi.world_mesh()
+    nelems = 26 * 1024 * 1024
+    assert ring._effective_plan(nelems // 2, 8, np.float32, 4 * 1024 * 1024,
+                                interpreted=False)[1] > 1
+
+    def body(xs):
+        return ring.ring_allreduce(xs[0], mesh.axis_names)[None]
+
+    _export_for_tpu(body, (8, nelems), mesh)
+
+
 def test_reduce_scatter_and_all_gather_lower(flat_runtime):
     mesh = mpi.world_mesh()
 

@@ -117,12 +117,7 @@ def _xla_broadcast(x, axis_names, *, root=0):
     for a in axes:
         n *= lax.axis_size(a)
     nbytes = selector.nbytes_of(x)
-    if runtime.is_initialized():
-        chunk_bytes = runtime.config().chunk_bytes
-    else:
-        from .config import Config
-
-        chunk_bytes = Config().chunk_bytes
+    chunk_bytes = runtime.effective_config().chunk_bytes
     if n > 1 and nbytes >= chunk_bytes:
         k = max(2, min(4 * n, -(-nbytes // chunk_bytes)))
         return _chain_broadcast(x, axes, root=root, n=n, k=k)

@@ -53,6 +53,9 @@ def main(argv=None) -> int:
         env["TORCHMPI_TPU_COORDINATOR"] = f"127.0.0.1:{port}"
         env["TORCHMPI_TPU_NUM_PROCESSES"] = str(args.nproc)
         env["TORCHMPI_TPU_PROCESS_ID"] = str(pid)
+        # All launched processes share this host, so the local rank IS the
+        # process id (consumed by runtime.local_rank()).
+        env["TORCHMPI_TPU_LOCAL_RANK"] = str(pid)
         env["TORCHMPI_TPU_LOCAL_CPU"] = "1"
         procs.append(subprocess.Popen(
             [sys.executable, args.script] + args.script_args, env=env))

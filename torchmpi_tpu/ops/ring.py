@@ -360,7 +360,12 @@ def _effective_plan(nelems: int, n: int, dtype, chunk_bytes: int,
     lowering always gets the full plan."""
     sub_elems, C = _chunk_plan(nelems, n, dtype, chunk_bytes)
     if interpreted and C > 1:
-        max_c = max(1, _INTERPRET_MAX_ITERS // (2 * (n - 1)))
+        # Never coarsen below C=2: a plan that needed chunking must stay
+        # chunked (the resident kernel would stage the whole tensor), even
+        # on rings wide enough (n >= 15) that the iteration cap cannot be
+        # honored — the cap is a best-effort wedge guard, the VMEM bound is
+        # a guarantee.
+        max_c = max(2, _INTERPRET_MAX_ITERS // (2 * (n - 1)))
         if C > max_c:
             per = -(-nelems // n)
             C = max_c
@@ -444,6 +449,146 @@ def _ring_allreduce_chunked_kernel(x_ref, o_ref, comm_ref, acc_ref,
         pltpu.semaphore_signal(ack_sem, inc=1, device_id=coords(left),
                                device_id_type=pltpu.DeviceIdType.LOGICAL)
     pltpu.semaphore_wait(ack_sem, min(2, K))
+
+
+def _ring_allreduce_bidir_chunked_kernel(
+        x1_ref, x2_ref, o1_ref, o2_ref, comm1, comm2, acc1, acc2,
+        copy_in1, copy_in2, copy_out1, copy_out2, full1, full2,
+        send1, recv1, ack1, send2, recv2, ack2,
+        *, n: int, C: int, axis: str, mesh_axes: Tuple[str, ...]):
+    """Bidirectional chunked ring: half 1 streams clockwise (send right),
+    half 2 counter-clockwise — per iteration BOTH directions' next RDMAs
+    are in flight before either current receive is waited on, so a
+    full-duplex interconnect carries both halves concurrently (2x the
+    unidirectional bound) while VMEM stays at ~8 subchunk slots.  Each
+    direction runs exactly the ``_ring_allreduce_chunked_kernel`` schedule
+    (see its docstring for the pipeline/ack reasoning); direction 2 is the
+    same schedule under my -> -my."""
+    assert C > 1, "chunked kernel requires a multi-subchunk plan"
+    my, left, right, coords = _neighbor_setup(axis, mesh_axes, n)
+
+    s1 = pltpu.make_async_copy(x1_ref, o1_ref, full1)
+    s2 = pltpu.make_async_copy(x2_ref, o2_ref, full2)
+    s1.start()
+    s2.start()
+    s1.wait()
+    s2.wait()
+
+    K = 2 * (n - 1) * C
+    refs = ((o1_ref, comm1, acc1, copy_in1, copy_out1, send1, recv1, ack1,
+             +1, right, left),
+            (o2_ref, comm2, acc2, copy_in2, copy_out2, send2, recv2, ack2,
+             -1, left, right))
+
+    def rdma(k, d):
+        o_ref, comm, _acc, _ci, _co, send, recv, _ack, sign, to, _frm = refs[d]
+        s, c = divmod(k, C)
+        send_idx, _ = _step_indices(my, n, s, sign)
+        return pltpu.make_async_remote_copy(
+            src_ref=o_ref.at[send_idx, c], dst_ref=comm.at[k % 2],
+            send_sem=send.at[k % 2], recv_sem=recv.at[k % 2],
+            device_id=coords(to),
+            device_id_type=pltpu.DeviceIdType.LOGICAL)
+
+    def issue(k):
+        if k >= 2:
+            pltpu.semaphore_wait(ack1, 1)
+            pltpu.semaphore_wait(ack2, 1)
+        r1, r2 = rdma(k, 0), rdma(k, 1)
+        r1.start()
+        r2.start()
+
+    issue(0)
+    for k in range(K):
+        slot = k % 2
+        s, c = divmod(k, C)
+        reduce_phase = s < n - 1
+        if k + 1 < K:
+            issue(k + 1)
+        loads = []
+        for d in (0, 1):
+            o_ref, comm, acc, ci, _co, _s, _r, _a, sign, _to, _frm = refs[d]
+            _, recv_idx = _step_indices(my, n, s, sign)
+            if reduce_phase:
+                load = pltpu.make_async_copy(o_ref.at[recv_idx, c],
+                                             acc.at[slot], ci.at[slot])
+                load.start()
+                loads.append(load)
+        rdma(k, 0).wait()
+        rdma(k, 1).wait()
+        for load in loads:
+            load.wait()
+        wbs = []
+        for d in (0, 1):
+            o_ref, comm, acc, _ci, co, _s, _r, _a, sign, _to, _frm = refs[d]
+            _, recv_idx = _step_indices(my, n, s, sign)
+            if reduce_phase:
+                acc[slot] = acc[slot] + comm[slot]
+                src = acc.at[slot]
+            else:
+                src = comm.at[slot]
+            wb = pltpu.make_async_copy(src, o_ref.at[recv_idx, c],
+                                       co.at[slot])
+            wb.start()
+            wbs.append(wb)
+        for wb in wbs:
+            wb.wait()
+        pltpu.semaphore_signal(ack1, inc=1, device_id=coords(left),
+                               device_id_type=pltpu.DeviceIdType.LOGICAL)
+        pltpu.semaphore_signal(ack2, inc=1, device_id=coords(right),
+                               device_id_type=pltpu.DeviceIdType.LOGICAL)
+    pltpu.semaphore_wait(ack1, min(2, K))
+    pltpu.semaphore_wait(ack2, min(2, K))
+
+
+def _ring_allreduce_bidir_chunked(flat, n: int, axis: str,
+                                  mesh_axes: Tuple[str, ...],
+                                  sub_elems: int, C: int):
+    """flat split in two halves, each padded to [n, C, rows, 128]; both
+    stream in opposite directions concurrently."""
+    half = flat.shape[0] // 2
+    h1, h2 = flat[:half], flat[half:]
+    padded = n * C * sub_elems
+    L1, L2 = h1.shape[0], h2.shape[0]
+    if padded > L1:
+        h1 = jnp.concatenate([h1, jnp.zeros((padded - L1,), flat.dtype)])
+    if padded > L2:
+        h2 = jnp.concatenate([h2, jnp.zeros((padded - L2,), flat.dtype)])
+    rows = sub_elems // _LANES
+    x1 = h1.reshape(n, C, rows, _LANES)
+    x2 = h2.reshape(n, C, rows, _LANES)
+    kernel = functools.partial(_ring_allreduce_bidir_chunked_kernel, n=n,
+                               C=C, axis=axis, mesh_axes=mesh_axes)
+    o1, o2 = pl.pallas_call(
+        kernel,
+        out_shape=(_out_sds(x1.shape, x1), _out_sds(x2.shape, x2)),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 2,
+        out_specs=(pl.BlockSpec(memory_space=pl.ANY),
+                   pl.BlockSpec(memory_space=pl.ANY)),
+        scratch_shapes=[
+            pltpu.VMEM((2, rows, _LANES), x1.dtype),   # comm1
+            pltpu.VMEM((2, rows, _LANES), x2.dtype),   # comm2
+            pltpu.VMEM((2, rows, _LANES), x1.dtype),   # acc1
+            pltpu.VMEM((2, rows, _LANES), x2.dtype),   # acc2
+            pltpu.SemaphoreType.DMA((2,)),             # copy_in1
+            pltpu.SemaphoreType.DMA((2,)),             # copy_in2
+            pltpu.SemaphoreType.DMA((2,)),             # copy_out1
+            pltpu.SemaphoreType.DMA((2,)),             # copy_out2
+            pltpu.SemaphoreType.DMA(()),               # full1
+            pltpu.SemaphoreType.DMA(()),               # full2
+            pltpu.SemaphoreType.DMA((2,)),             # send1
+            pltpu.SemaphoreType.DMA((2,)),             # recv1
+            pltpu.SemaphoreType.REGULAR,               # ack1
+            pltpu.SemaphoreType.DMA((2,)),             # send2
+            pltpu.SemaphoreType.DMA((2,)),             # recv2
+            pltpu.SemaphoreType.REGULAR,               # ack2
+        ],
+        compiler_params=pltpu.CompilerParams(collective_id=12),
+        interpret=_interpret_mode(),
+    )(x1, x2)
+    f1 = o1.reshape(-1)[:L1]
+    f2 = o2.reshape(-1)[:L2]
+    return jnp.concatenate([f1, f2])
 
 
 def _ring_allreduce_chunked(flat, n: int, axis: str,
@@ -576,15 +721,9 @@ def ring_allreduce(x, axis_names, *, op: str = "sum"):
 
     from .. import runtime
 
-    if runtime.is_initialized():
-        cfg = runtime.config()
-        bidir = getattr(cfg, "pallas_bidirectional", False)
-        chunk_bytes = cfg.chunk_bytes
-    else:
-        from ..config import Config
-
-        bidir = False
-        chunk_bytes = Config().chunk_bytes
+    cfg = runtime.effective_config()
+    bidir = cfg.pallas_bidirectional
+    chunk_bytes = cfg.chunk_bytes
 
     if n == 1:
         out = x
@@ -595,11 +734,18 @@ def ring_allreduce(x, axis_names, *, op: str = "sum"):
                 f"pallas ring allreduce supports f32/bf16/i32, got {dtype} "
                 f"(use the xla backend for other dtypes)")
         flat = x.reshape(-1)
+        interp = bool(_interpret_mode())
         sub_elems, C = _effective_plan(flat.shape[0], n, dtype, chunk_bytes,
-                                       bool(_interpret_mode()))
+                                       interp)
         if C > 1:
-            reduced = _ring_allreduce_chunked(flat, n, ring_axis, mesh_axes,
-                                              sub_elems, C)
+            half_plan = _effective_plan(-(-flat.shape[0] // 2), n, dtype,
+                                        chunk_bytes, interp)
+            if bidir and half_plan[1] > 1:
+                reduced = _ring_allreduce_bidir_chunked(
+                    flat, n, ring_axis, mesh_axes, *half_plan)
+            else:
+                reduced = _ring_allreduce_chunked(flat, n, ring_axis,
+                                                  mesh_axes, sub_elems, C)
         elif bidir and flat.shape[0] >= 2 * n * _TILE:
             reduced = _ring_allreduce_bidir_padded(flat, n, ring_axis,
                                                    mesh_axes)
@@ -623,10 +769,14 @@ selector.register("allreduce", "pallas", ring_allreduce)
 
 
 def _mesh_axes_for(axes: Tuple[str, ...]) -> Tuple[str, ...]:
+    """All mesh axis names of the enclosing shard_map, in mesh order —
+    logical device ids are row-major over the FULL mesh, so the neighbor
+    computation needs every axis, not just the ring axes.  Uses the public
+    abstract-mesh accessor; falls back to the ring axes when tracing
+    outside any mesh (e.g. direct kernel unit tests)."""
     try:
-        from jax._src.core import get_axis_env
-
-        mesh_axes = tuple(get_axis_env().axis_names())
+        mesh = jax.sharding.get_abstract_mesh()
+        mesh_axes = tuple(mesh.axis_names) if mesh is not None else axes
     except Exception:
         mesh_axes = axes
     if not all(a in mesh_axes for a in axes):

@@ -21,6 +21,7 @@ reference kept for raw ``MPI_Comm`` (SURVEY.md §2 L1).
 from __future__ import annotations
 
 import dataclasses
+import os
 import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -177,6 +178,15 @@ def config() -> Config:
     return _state.config
 
 
+def effective_config() -> Config:
+    """The active Config when the runtime is initialized, else defaults.
+
+    For trace-time knob reads (``chunk_bytes``, ``pallas_bidirectional``)
+    from code that may run outside ``init()`` — direct kernel use, tests —
+    so every consumer resolves knobs identically."""
+    return _state.config if _state.initialized else Config()
+
+
 def _validate_backend_per_op(table: Dict[str, str]) -> Dict[str, str]:
     """Per-op override tables fail loudly on typos (a silently-ignored key
     would let a user benchmark the wrong implementation)."""
@@ -236,21 +246,16 @@ def size() -> int:
 
 
 def local_rank() -> int:
-    """Rank within the host.  The reference used localRank % numDevices for
-    GPU binding; JAX binds devices per process itself, so this is
-    informational."""
-    return 0 if jax.process_count() == 1 else jax.process_index() % max(
-        1, jax.process_count() // max(1, _num_hosts())
-    )
+    """Rank of this process among processes on the same host.
 
-
-def _num_hosts() -> int:
-    try:
-        hosts = {d.host_id if hasattr(d, "host_id") else d.process_index
-                 for d in jax.devices()}
-        return max(1, len(hosts))
-    except Exception:
-        return 1
+    Defined (round 1 returned a plausible guess): the launcher that
+    co-locates processes exports ``TORCHMPI_TPU_LOCAL_RANK`` (our
+    ``launch.py`` does; schedulers can too); absent that, JAX's standard
+    deployment is one process per host, so the local rank is 0.  The
+    reference used localRank % numDevices for GPU binding; JAX binds
+    devices per process itself, so this is informational."""
+    v = os.environ.get("TORCHMPI_TPU_LOCAL_RANK")
+    return int(v) if v is not None else 0
 
 
 def device_count() -> int:
