@@ -256,6 +256,59 @@ def test_ring_rs_on_2d_mesh(hier_runtime):
     np.testing.assert_allclose(pal, flat, rtol=1e-6)
 
 
+def _n4_runtime(chunk_bytes=4096):
+    mpi.stop()
+    return mpi.init(mpi.Config(dcn_size=2, custom_min_bytes=0,
+                               chunk_bytes=chunk_bytes))
+
+
+def test_chunked_reduce_scatter_matches_xla():
+    # per-ring-chunk > chunk_bytes routes RS through the streaming kernel;
+    # n=4 ici ring keeps the interpreter stable (see NOTE above).
+    _n4_runtime()
+    try:
+        size = 4 * 4096
+        # The dcn psum_scatter halves the payload before the ici ring, so
+        # the plan the ring actually sees is for size // 2.
+        assert ring._effective_plan(size // 2, 4, np.float32, 4096,
+                                    True)[1] > 1
+        x = rank_data(size)
+        out = np.asarray(mpi.reduce_scatter(x, backend="pallas"))
+        xla = np.asarray(mpi.reduce_scatter(x, backend="xla"))
+        assert out.shape == xla.shape
+        np.testing.assert_allclose(out, xla, rtol=1e-6)
+    finally:
+        mpi.stop()
+
+
+def test_chunked_all_gather_exact():
+    _n4_runtime()
+    try:
+        size = 4096  # local chunk; L*n plan -> C=4
+        assert ring._effective_plan(size * 4, 4, np.float32, 4096, True)[1] > 1
+        x = rank_data(size)
+        out = np.asarray(mpi.allgather(x, backend="pallas"))
+        assert out.shape == (8, 8, size)
+        for r in range(8):
+            np.testing.assert_allclose(out[r], x)
+    finally:
+        mpi.stop()
+
+
+def test_chunked_rs_ag_race_detector():
+    ring.set_interpret(pltpu.InterpretParams(detect_races=True))
+    _n4_runtime()
+    try:
+        x = rank_data(4 * 4096)
+        out = np.asarray(mpi.reduce_scatter(x, backend="pallas"))
+        np.testing.assert_allclose(
+            out[0], x.sum(0).reshape(8, -1)[0], rtol=1e-6)
+        ag = np.asarray(mpi.allgather(x[:, :4096], backend="pallas"))
+        np.testing.assert_allclose(ag[3], x[:, :4096])
+    finally:
+        mpi.stop()
+
+
 def test_ring_rs_ag_race_detector(flat_runtime):
     # The RS/AG kernels use a shifted schedule and their own ack drain;
     # validate their semaphore protocols under the interpreter race detector

@@ -100,3 +100,18 @@ def test_reduce_scatter_and_all_gather_lower(flat_runtime):
         return ring.ring_all_gather(shard, mesh.axis_names).reshape(-1)[None]
 
     _export_for_tpu(body, (8, 64 * 8), mesh)
+
+
+def test_chunked_rs_ag_100mb_lower(flat_runtime):
+    # The streaming RS/AG kernels at gradient scale, full pipeline depth.
+    mpi.set_config(chunk_bytes=4 * 1024 * 1024, custom_min_bytes=0)
+    mesh = mpi.world_mesh()
+    nelems = 26 * 1024 * 1024  # 104 MiB f32
+    assert ring._effective_plan(nelems, 8, np.float32, 4 * 1024 * 1024,
+                                interpreted=False)[1] > 1
+
+    def body(xs):
+        shard = ring.ring_reduce_scatter(xs[0], mesh.axis_names)
+        return ring.ring_all_gather(shard, mesh.axis_names).reshape(-1)[None]
+
+    _export_for_tpu(body, (8, nelems), mesh)

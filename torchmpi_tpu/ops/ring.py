@@ -131,6 +131,12 @@ def _pad_and_tile(flat, n: int):
     return flat.reshape(n, flat.shape[0] // n // _LANES, _LANES), pad
 
 
+def runtime_chunk_bytes() -> int:
+    from .. import runtime
+
+    return runtime.effective_config().chunk_bytes
+
+
 def _neighbor_setup(axis: str, mesh_axes, n: int):
     """Shared kernel preamble: ring neighbors, logical-id mapping, and the
     neighbor barrier (both neighbors inside the kernel before any RDMA).
@@ -277,11 +283,7 @@ def _ring_reduce_scatter_kernel(x_ref, o_ref, acc_ref, comm_ref, send_sem,
     steps = n - 1
     for s in range(steps):
         slot = s % 2
-        # Shifted schedule so the final accumulated chunk is ``my`` itself:
-        # at step s send chunk (my - s - 1) mod n, receive (my - s - 2)+1...
-        # equivalently the classic schedule offset by one.
-        send_idx = lax.rem(my + 2 * n - s - 1, n)
-        recv_idx = lax.rem(my + 2 * n - s - 2, n)
+        send_idx, recv_idx = _rs_step_indices(my, n, s)
         if s >= 2:
             pltpu.semaphore_wait(ack_sem, 1)
         rdma = pltpu.make_async_remote_copy(
@@ -374,17 +376,25 @@ def _effective_plan(nelems: int, n: int, dtype, chunk_bytes: int,
     return sub_elems, C
 
 
-def _ring_allreduce_chunked_kernel(x_ref, o_ref, comm_ref, acc_ref,
-                                   copy_in, copy_out, full_sem,
-                                   send_sem, recv_sem, ack_sem,
-                                   *, n: int, C: int, axis: str,
-                                   mesh_axes: Tuple[str, ...]):
-    """Chunked/pipelined ring allreduce: the analog of the reference's
-    chunk loop (SURVEY.md §4.2 — the performance-critical code upstream).
+def _rs_step_indices(my, n: int, s: int):
+    """Shifted RS schedule (shared by the resident and chunked RS kernels):
+    offset by one from the classic ring so each device finishes owning its
+    own chunk index."""
+    send_idx = lax.rem(my + 2 * n - s - 1, n)
+    recv_idx = lax.rem(my + 2 * n - s - 2, n)
+    return send_idx, recv_idx
 
-    x/o live in HBM (``[n, C, rows, 128]``); only two subchunk-sized comm
-    slots and two accumulate slots are VMEM-resident.  Iteration k streams
-    subchunk ``c = k % C`` of ring step ``s = k // C``:
+
+def _chunked_pipeline(work_ref, comm, acc, copy_in, copy_out,
+                      send_sem, recv_sem, ack_sem, coords, left, right,
+                      *, C: int, steps: int, step_indices, reduce_at):
+    """Shared pipelined-subchunk driver for the unidirectional chunked ring
+    kernels (allreduce / reduce-scatter / all-gather differ only in step
+    count, index schedule, and whether a step reduces or forwards).
+
+    ``work_ref`` is the HBM working buffer ``[n, C, rows, 128]``; comm/acc
+    are two-slot VMEM scratch.  Iteration k streams subchunk ``c = k % C``
+    of ring step ``s = k // C``:
 
       - the RDMA for iteration k+1 is issued before iteration k's recv is
         waited on (software pipeline, depth 1), so the next subchunk is on
@@ -393,26 +403,23 @@ def _ring_allreduce_chunked_kernel(x_ref, o_ref, comm_ref, acc_ref,
       - subchunks within a step are independent, so the pipeline never
         crosses a true dependency: step s+1 forwards what step s received,
         but subchunk (s+1, c)'s RDMA issues C-1 >= 1 iterations after
-        (s, c)'s writeback completed (the kernel requires C > 1; C == 1
-        plans route to the VMEM-resident kernels);
+        (s, c)'s writeback completed (C > 1 is required; C == 1 plans
+        route to the VMEM-resident kernels);
       - slot reuse is flow-controlled by the same neighbor-ack protocol as
-        the resident kernel (wait one ack per issue from k >= 2).
+        the resident kernels (wait one ack per issue from k >= 2).
+
+    ``step_indices(s) -> (send_idx, recv_idx)``; ``reduce_at(s) -> bool``
+    (static Python values — the loop is fully unrolled).
     """
-    my, left, right, coords = _neighbor_setup(axis, mesh_axes, n)
-
-    stage = pltpu.make_async_copy(x_ref, o_ref, full_sem)
-    stage.start()
-    stage.wait()
-
-    assert C > 1, "chunked kernel requires a multi-subchunk plan"
-    K = 2 * (n - 1) * C
+    assert C > 1, "chunked pipeline requires a multi-subchunk plan"
+    K = steps * C
 
     def rdma(k):
         s, c = divmod(k, C)
-        send_idx, _ = _step_indices(my, n, s, +1)
+        send_idx, _ = step_indices(s)
         return pltpu.make_async_remote_copy(
-            src_ref=o_ref.at[send_idx, c],
-            dst_ref=comm_ref.at[k % 2],
+            src_ref=work_ref.at[send_idx, c],
+            dst_ref=comm.at[k % 2],
             send_sem=send_sem.at[k % 2],
             recv_sem=recv_sem.at[k % 2],
             device_id=coords(right),
@@ -427,28 +434,50 @@ def _ring_allreduce_chunked_kernel(x_ref, o_ref, comm_ref, acc_ref,
     for k in range(K):
         slot = k % 2
         s, c = divmod(k, C)
-        reduce_phase = s < n - 1
-        _, recv_idx = _step_indices(my, n, s, +1)
+        _, recv_idx = step_indices(s)
         if k + 1 < K:
             issue(k + 1)
-        if reduce_phase:
-            load = pltpu.make_async_copy(o_ref.at[recv_idx, c],
-                                         acc_ref.at[slot], copy_in.at[slot])
+        if reduce_at(s):
+            load = pltpu.make_async_copy(work_ref.at[recv_idx, c],
+                                         acc.at[slot], copy_in.at[slot])
             load.start()
             rdma(k).wait()
             load.wait()
-            acc_ref[slot] = acc_ref[slot] + comm_ref[slot]
-            src = acc_ref.at[slot]
+            acc[slot] = acc[slot] + comm[slot]
+            src = acc.at[slot]
         else:
             rdma(k).wait()
-            src = comm_ref.at[slot]
-        wb = pltpu.make_async_copy(src, o_ref.at[recv_idx, c],
+            src = comm.at[slot]
+        wb = pltpu.make_async_copy(src, work_ref.at[recv_idx, c],
                                    copy_out.at[slot])
         wb.start()
         wb.wait()
         pltpu.semaphore_signal(ack_sem, inc=1, device_id=coords(left),
                                device_id_type=pltpu.DeviceIdType.LOGICAL)
     pltpu.semaphore_wait(ack_sem, min(2, K))
+
+
+def _ring_allreduce_chunked_kernel(x_ref, o_ref, comm_ref, acc_ref,
+                                   copy_in, copy_out, full_sem,
+                                   send_sem, recv_sem, ack_sem,
+                                   *, n: int, C: int, axis: str,
+                                   mesh_axes: Tuple[str, ...]):
+    """Chunked/pipelined ring allreduce: the analog of the reference's
+    chunk loop (SURVEY.md §4.2 — the performance-critical code upstream).
+    Reduce-scatter phase (steps 0..n-2) then all-gather phase; see
+    :func:`_chunked_pipeline` for the streaming/flow-control design."""
+    my, left, right, coords = _neighbor_setup(axis, mesh_axes, n)
+
+    stage = pltpu.make_async_copy(x_ref, o_ref, full_sem)
+    stage.start()
+    stage.wait()
+
+    _chunked_pipeline(
+        o_ref, comm_ref, acc_ref, copy_in, copy_out,
+        send_sem, recv_sem, ack_sem, coords, left, right,
+        C=C, steps=2 * (n - 1),
+        step_indices=lambda s: _step_indices(my, n, s, +1),
+        reduce_at=lambda s: s < n - 1)
 
 
 def _ring_allreduce_bidir_chunked_kernel(
@@ -622,6 +651,124 @@ def _ring_allreduce_chunked(flat, n: int, axis: str,
         interpret=_interpret_mode(),
     )(x)
     return out.reshape(-1)[:L]
+
+
+def _ring_reduce_scatter_chunked_kernel(x_ref, o_ref, work_ref, comm, acc,
+                                        copy_in, copy_out, full_sem,
+                                        send_sem, recv_sem, ack_sem,
+                                        *, n: int, C: int, axis: str,
+                                        mesh_axes: Tuple[str, ...]):
+    """Chunked RS phase only: x/work ``[n, C, rows, 128]`` in HBM, o
+    ``[C, rows, 128]`` (the fully-reduced chunk ``my``).  The shared
+    :func:`_chunked_pipeline` with the shifted RS schedule."""
+    my, left, right, coords = _neighbor_setup(axis, mesh_axes, n)
+
+    stage = pltpu.make_async_copy(x_ref, work_ref, full_sem)
+    stage.start()
+    stage.wait()
+
+    _chunked_pipeline(
+        work_ref, comm, acc, copy_in, copy_out,
+        send_sem, recv_sem, ack_sem, coords, left, right,
+        C=C, steps=n - 1,
+        step_indices=lambda s: _rs_step_indices(my, n, s),
+        reduce_at=lambda s: True)
+
+    out = pltpu.make_async_copy(work_ref.at[my], o_ref, full_sem)
+    out.start()
+    out.wait()
+
+
+def _ring_all_gather_chunked_kernel(x_ref, o_ref, comm, copy_out, full_sem,
+                                    send_sem, recv_sem, ack_sem,
+                                    *, n: int, C: int, axis: str,
+                                    mesh_axes: Tuple[str, ...]):
+    """Chunked AG phase only: x ``[C, rows, 128]`` (local chunk), o
+    ``[n, C, rows, 128]`` in HBM.  The shared :func:`_chunked_pipeline`
+    with the classic forward schedule and no reduce (received subchunks
+    DMA straight from the comm slot to their HBM home; the acc/copy_in
+    scratch is never touched, so the resident AG kernel's comm scratch is
+    reused in both roles)."""
+    my, left, right, coords = _neighbor_setup(axis, mesh_axes, n)
+
+    stage = pltpu.make_async_copy(x_ref, o_ref.at[my], full_sem)
+    stage.start()
+    stage.wait()
+
+    # AG steps t = 0..n-2 use the classic schedule: send my - t, receive
+    # my - t - 1 — exactly _step_indices' reduce-phase formula.
+    _chunked_pipeline(
+        o_ref, comm, None, None, copy_out,
+        send_sem, recv_sem, ack_sem, coords, left, right,
+        C=C, steps=n - 1,
+        step_indices=lambda t: _step_indices(my, n, t, +1),
+        reduce_at=lambda t: False)
+
+
+def _ring_reduce_scatter_chunked(xin, n: int, axis: str,
+                                 mesh_axes: Tuple[str, ...],
+                                 sub_elems: int, C: int):
+    """xin: [n, per] per-chunk rows; pads per to C*sub_elems."""
+    per = xin.shape[1]
+    padded = C * sub_elems
+    if padded > per:
+        xin = jnp.concatenate(
+            [xin, jnp.zeros((n, padded - per), xin.dtype)], axis=1)
+    rows = sub_elems // _LANES
+    x = xin.reshape(n, C, rows, _LANES)
+    kernel = functools.partial(_ring_reduce_scatter_chunked_kernel, n=n, C=C,
+                               axis=axis, mesh_axes=mesh_axes)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=_out_sds((C, rows, _LANES), x),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[
+            pltpu.MemorySpace.HBM((n, C, rows, _LANES), x.dtype),  # work
+            pltpu.VMEM((2, rows, _LANES), x.dtype),                # comm
+            pltpu.VMEM((2, rows, _LANES), x.dtype),                # acc
+            pltpu.SemaphoreType.DMA((2,)),                         # copy_in
+            pltpu.SemaphoreType.DMA((2,)),                         # copy_out
+            pltpu.SemaphoreType.DMA(()),                           # full
+            pltpu.SemaphoreType.DMA((2,)),                         # send
+            pltpu.SemaphoreType.DMA((2,)),                         # recv
+            pltpu.SemaphoreType.REGULAR,                           # ack
+        ],
+        compiler_params=pltpu.CompilerParams(collective_id=13),
+        interpret=_interpret_mode(),
+    )(x)
+    return out.reshape(-1)[:per]
+
+
+def _ring_all_gather_chunked(xin, n: int, axis: str,
+                             mesh_axes: Tuple[str, ...],
+                             sub_elems: int, C: int):
+    """xin: [L] local flat chunk; pads to C*sub_elems; returns [n, padded]."""
+    L = xin.shape[0]
+    padded = C * sub_elems
+    if padded > L:
+        xin = jnp.concatenate([xin, jnp.zeros((padded - L,), xin.dtype)])
+    rows = sub_elems // _LANES
+    x = xin.reshape(C, rows, _LANES)
+    kernel = functools.partial(_ring_all_gather_chunked_kernel, n=n, C=C,
+                               axis=axis, mesh_axes=mesh_axes)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=_out_sds((n, C, rows, _LANES), x),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[
+            pltpu.VMEM((2, rows, _LANES), x.dtype),   # comm
+            pltpu.SemaphoreType.DMA((2,)),            # copy_out
+            pltpu.SemaphoreType.DMA(()),              # full
+            pltpu.SemaphoreType.DMA((2,)),            # send
+            pltpu.SemaphoreType.DMA((2,)),            # recv
+            pltpu.SemaphoreType.REGULAR,              # ack
+        ],
+        compiler_params=pltpu.CompilerParams(collective_id=14),
+        interpret=_interpret_mode(),
+    )(x)
+    return out.reshape(n, -1)[:, :L]
 
 
 def _ring_allreduce_padded(x, n: int, axis: str,
@@ -826,33 +973,39 @@ def ring_reduce_scatter(x, axis_names, *, op: str = "sum"):
     flat = x.reshape(-1)
     L = flat.shape[0]
     per = L // n
-    pad = (-per) % _TILE
     chunks = flat.reshape(n, per)
+    if n == 1:
+        return chunks[0].reshape(out_shape)
+    sub_elems, C = _effective_plan(L, n, flat.dtype,
+                                   runtime_chunk_bytes(),
+                                   bool(_interpret_mode()))
+    if C > 1:
+        out = _ring_reduce_scatter_chunked(chunks, n, ring_axis, mesh_axes,
+                                           sub_elems, C)
+        return out.reshape(out_shape)
+    pad = (-per) % _TILE
     if pad:
         chunks = jnp.concatenate(
             [chunks, jnp.zeros((n, pad), flat.dtype)], axis=1)
     rows = (per + pad) // _LANES
     xin = chunks.reshape(n, rows, _LANES)
-    if n == 1:
-        out = xin[0]
-    else:
-        kernel = functools.partial(_ring_reduce_scatter_kernel, n=n,
-                                   axis=ring_axis, mesh_axes=mesh_axes)
-        out = pl.pallas_call(
-            kernel,
-            out_shape=_out_sds((rows, _LANES), xin),
-            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
-            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
-            scratch_shapes=[
-                pltpu.VMEM((n, rows, _LANES), xin.dtype),
-                pltpu.VMEM((2, rows, _LANES), xin.dtype),
-                pltpu.SemaphoreType.DMA((2,)),
-                pltpu.SemaphoreType.DMA((2,)),
-                pltpu.SemaphoreType.REGULAR,
-            ],
-            compiler_params=pltpu.CompilerParams(collective_id=8),
-            interpret=_interpret_mode(),
-        )(xin)
+    kernel = functools.partial(_ring_reduce_scatter_kernel, n=n,
+                               axis=ring_axis, mesh_axes=mesh_axes)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=_out_sds((rows, _LANES), xin),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((n, rows, _LANES), xin.dtype),
+            pltpu.VMEM((2, rows, _LANES), xin.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.REGULAR,
+        ],
+        compiler_params=pltpu.CompilerParams(collective_id=8),
+        interpret=_interpret_mode(),
+    )(xin)
     return out.reshape(-1)[:per].reshape(out_shape)
 
 
@@ -869,6 +1022,17 @@ def ring_all_gather(x, axis_names):
     shape = x.shape
     flat = x.reshape(-1)
     L = flat.shape[0]
+    sub_elems, C = _effective_plan(L * n, n, flat.dtype,
+                                   runtime_chunk_bytes(),
+                                   bool(_interpret_mode()))
+    if n > 1 and C > 1:
+        gathered = _ring_all_gather_chunked(flat, n, ring_axis, mesh_axes,
+                                            sub_elems, C)
+        out = gathered.reshape((n,) + shape)
+        for a in reversed(outer_axes):
+            out = lax.all_gather(out, a, axis=0, tiled=False)
+            out = out.reshape((-1,) + shape)
+        return out
     pad = (-L) % _TILE
     if pad:
         flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
