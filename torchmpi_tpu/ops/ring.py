@@ -39,7 +39,7 @@ the race-detection story, SURVEY.md §6.2) and runnable on real ICI unchanged.
 from __future__ import annotations
 
 import functools
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -355,19 +355,23 @@ def _chunk_plan(nelems: int, n: int, dtype, chunk_bytes: int):
 
 
 def _effective_plan(nelems: int, n: int, dtype, chunk_bytes: int,
-                    interpreted: bool):
+                    interpreted: bool, steps: Optional[int] = None):
     """The plan actually executed: under the interpreter the pipeline is
-    coarsened so total iterations 2*(n-1)*C stay within
+    coarsened so total iterations ``steps * C`` stay within
     ``_INTERPRET_MAX_ITERS`` (see that constant's comment); real Mosaic
-    lowering always gets the full plan."""
+    lowering always gets the full plan.  ``steps`` defaults to the
+    allreduce schedule's ``2*(n-1)``; the RS/AG-only schedules pass their
+    shorter ``n-1`` so their simulated pipelines aren't over-coarsened."""
+    if steps is None:
+        steps = 2 * (n - 1)
     sub_elems, C = _chunk_plan(nelems, n, dtype, chunk_bytes)
     if interpreted and C > 1:
         # Never coarsen below C=2: a plan that needed chunking must stay
         # chunked (the resident kernel would stage the whole tensor), even
-        # on rings wide enough (n >= 15) that the iteration cap cannot be
-        # honored — the cap is a best-effort wedge guard, the VMEM bound is
-        # a guarantee.
-        max_c = max(2, _INTERPRET_MAX_ITERS // (2 * (n - 1)))
+        # on rings wide enough that the iteration cap cannot be honored —
+        # the cap is a best-effort wedge guard, the VMEM bound is a
+        # guarantee.
+        max_c = max(2, _INTERPRET_MAX_ITERS // max(1, steps))
         if C > max_c:
             per = -(-nelems // n)
             C = max_c
@@ -978,7 +982,7 @@ def ring_reduce_scatter(x, axis_names, *, op: str = "sum"):
         return chunks[0].reshape(out_shape)
     sub_elems, C = _effective_plan(L, n, flat.dtype,
                                    runtime_chunk_bytes(),
-                                   bool(_interpret_mode()))
+                                   bool(_interpret_mode()), steps=n - 1)
     if C > 1:
         out = _ring_reduce_scatter_chunked(chunks, n, ring_axis, mesh_axes,
                                            sub_elems, C)
@@ -1024,7 +1028,7 @@ def ring_all_gather(x, axis_names):
     L = flat.shape[0]
     sub_elems, C = _effective_plan(L * n, n, flat.dtype,
                                    runtime_chunk_bytes(),
-                                   bool(_interpret_mode()))
+                                   bool(_interpret_mode()), steps=n - 1)
     if n > 1 and C > 1:
         gathered = _ring_all_gather_chunked(flat, n, ring_axis, mesh_axes,
                                             sub_elems, C)
