@@ -61,8 +61,16 @@ def main():
         image_shape=(args.image_size, args.image_size, 3),
         num_classes=args.num_classes, seed=args.seed)
 
-    it = dutil.batches(X, Y, args.batch_size,
-                       steps=args.steps + args.warmup, seed=args.seed)
+    # Host batches stage onto the mesh from a background thread, so the
+    # (slow on relay hosts) host->device copy of batch N+1 overlaps step N.
+    from jax.sharding import PartitionSpec as P
+
+    from torchmpi_tpu.utils.input_pipeline import prefetch_to_mesh
+
+    it = prefetch_to_mesh(
+        dutil.batches(X, Y, args.batch_size,
+                      steps=args.steps + args.warmup, seed=args.seed),
+        mesh, P(mesh.axis_names), depth=2)
     import time
 
     for i, (xb, yb) in enumerate(it):
