@@ -55,21 +55,31 @@ def main():
         opt_state = mpi.nn.synchronize_parameters(opt_state)
         X, Y = dutil.synthetic_mnist(2048, seed=args.seed)
 
-        # --- phase 1: train, checkpointing every 10 steps, "crash" midway
+        # --- phase 1: train, checkpointing every 10 steps, "crash" midway.
+        # Saves go through the native async executor (csrc/io.cpp): the
+        # device->host snapshot is synchronous, the write+fsync+rename
+        # overlap the following train steps.  The single-thread writer is
+        # FIFO, so at most the handles need a final wait at the crash point.
         crash_at = args.steps // 2
         # Step-0 checkpoint up front so recovery works however early the
         # crash lands relative to the periodic save interval.
-        checkpoint.save(ckpt_dir, {"params": params, "opt": opt_state,
-                                   "step": np.int64(0)}, step=0)
+        pending = checkpoint.save_async(
+            ckpt_dir, {"params": params, "opt": opt_state,
+                       "step": np.int64(0)}, step=0)
         losses = []
         for i, (xb, yb) in enumerate(dutil.batches(
                 X, Y, args.batch_size, steps=crash_at, seed=args.seed)):
             params, opt_state, loss = dp_step(params, opt_state, xb, yb)
             losses.append(float(loss))
             if i % 10 == 9:
-                checkpoint.save(ckpt_dir, {"params": params,
-                                           "opt": opt_state,
-                                           "step": np.int64(i + 1)}, step=i + 1)
+                # Fence the previous save before starting the next: on the
+                # FIFO writer it has almost always landed by now, and the
+                # wait is where a failed write surfaces as an exception.
+                pending.wait(timeout=120.0)
+                pending = checkpoint.save_async(
+                    ckpt_dir, {"params": params, "opt": opt_state,
+                               "step": np.int64(i + 1)}, step=i + 1)
+        pending.wait(timeout=120.0)  # fence in-flight writes before "crash"
         print(f"phase 1: step {crash_at} loss {losses[-1]:.4f}; "
               f"latest ckpt step {checkpoint.latest_step(ckpt_dir)}")
         pre_crash = losses[-1]

@@ -84,8 +84,11 @@ def main():
             return lax.pmean(losses.mean(), mesh.axis_names)
 
         loss, grads = jax.value_and_grad(loss_fn)(vs)
+        # op="sum": the pmean in loss_fn already scaled each shard's grad by
+        # 1/N, so summing yields the cross-device mean (op="mean" here would
+        # divide by N twice).  Same convention as longcontext_lm.py.
         grads = mpi.nn.synchronize_gradients(grads, mesh.axis_names,
-                                             op="mean")
+                                             op="sum")
         updates, opt_state = tx.update(grads, opt_state, vs)
         return optax.apply_updates(vs, updates), opt_state, loss
 

@@ -1,0 +1,126 @@
+"""Native async IO executor (csrc/io.cpp via utils/aio.py) + async
+checkpointing.  Reference analog: the C7 async engine's host thread pool +
+futures (SURVEY.md §3 C7); checkpoint story per SURVEY.md §6.4."""
+
+import os
+
+import numpy as np
+import pytest
+
+from torchmpi_tpu.utils import aio, checkpoint
+
+
+def test_write_roundtrip(tmp_path):
+    p = str(tmp_path / "blob.bin")
+    payload = os.urandom(1 << 20)
+    with aio.AsyncWriter() as w:
+        h = w.submit(p, payload)
+        assert h.wait(timeout=30.0) == p
+        assert w.bytes_written() == len(payload)
+    with open(p, "rb") as f:
+        assert f.read() == payload
+
+
+def test_write_empty_and_bytearray(tmp_path):
+    with aio.AsyncWriter() as w:
+        h1 = w.submit(str(tmp_path / "empty"), b"")
+        ba = bytearray(b"mutable source buffer")
+        h2 = w.submit(str(tmp_path / "ba"), ba)
+        h1.wait(30.0)
+        h2.wait(30.0)
+    assert os.path.getsize(tmp_path / "empty") == 0
+    assert (tmp_path / "ba").read_bytes() == bytes(ba)
+
+
+def test_fifo_last_write_wins(tmp_path):
+    """threads=1 executes in submission order — the ordering contract
+    checkpoint.save_async's npz-before-metadata commit relies on."""
+    p = str(tmp_path / "f")
+    with aio.AsyncWriter(threads=1) as w:
+        handles = [w.submit(p, f"gen {i}".encode()) for i in range(8)]
+        for h in handles:
+            h.wait(30.0)
+    assert (tmp_path / "f").read_bytes() == b"gen 7"
+
+
+def test_failure_surfaces_errno(tmp_path):
+    with aio.AsyncWriter() as w:
+        h = w.submit(str(tmp_path / "no" / "such" / "dir" / "f"), b"x")
+        with pytest.raises(OSError) as ei:
+            h.wait(30.0)
+        assert ei.value.errno == 2  # ENOENT
+
+
+def test_failure_is_sticky(tmp_path):
+    """A failed write must keep failing on re-wait — a retried wait() that
+    'succeeds' would report a checkpoint that does not exist."""
+    with aio.AsyncWriter() as w:
+        h = w.submit(str(tmp_path / "missing" / "f"), b"x")
+        for _ in range(3):
+            with pytest.raises(OSError):
+                h.wait(30.0)
+        assert h.done()
+
+
+def test_no_tmp_litter_and_atomic_name(tmp_path):
+    with aio.AsyncWriter(threads=4) as w:
+        hs = [w.submit(str(tmp_path / f"f{i}"), os.urandom(4096))
+              for i in range(16)]
+        for h in hs:
+            h.wait(30.0)
+    names = set(os.listdir(tmp_path))
+    assert names == {f"f{i}" for i in range(16)}, names  # no .tmp.* residue
+
+
+def test_close_drains_pending_writes(tmp_path):
+    w = aio.AsyncWriter()
+    hs = [w.submit(str(tmp_path / f"d{i}"), os.urandom(1 << 16))
+          for i in range(8)]
+    w.close()  # must drain the queue, not drop it
+    for i in range(8):
+        assert os.path.getsize(tmp_path / f"d{i}") == 1 << 16
+    for h in hs:
+        h.wait(1.0)  # already complete
+
+
+def test_checkpoint_save_async_roundtrip(tmp_path):
+    tree = {"w": np.arange(12, dtype=np.float32).reshape(3, 4),
+            "opt": {"m": np.full((5,), 2.5, np.float32),
+                    "step": np.int32(7)}}
+    h = checkpoint.save_async(str(tmp_path), tree, step=3)
+    path = h.wait(timeout=60.0)
+    assert path.endswith("ckpt_3_p0.npz")
+    assert checkpoint.latest_step(str(tmp_path)) == 3
+    template = {"w": np.zeros((3, 4), np.float32),
+                "opt": {"m": np.zeros((5,), np.float32),
+                        "step": np.int32(0)}}
+    out = checkpoint.restore(str(tmp_path), template)
+    np.testing.assert_array_equal(out["w"], tree["w"])
+    np.testing.assert_array_equal(out["opt"]["m"], tree["opt"]["m"])
+    assert out["opt"]["step"] == 7
+
+
+def test_checkpoint_async_matches_sync(tmp_path):
+    tree = {"a": np.random.RandomState(0).randn(17, 3).astype(np.float32)}
+    checkpoint.save(str(tmp_path / "sync"), tree, step=1)
+    checkpoint.save_async(str(tmp_path / "async"), tree, step=1).wait(60.0)
+    s = np.load(tmp_path / "sync" / "ckpt_1_p0.npz")
+    a = np.load(tmp_path / "async" / "ckpt_1_p0.npz")
+    assert sorted(s.files) == sorted(a.files)
+    for k in s.files:
+        np.testing.assert_array_equal(s[k], a[k])
+
+
+def test_checkpoint_overlapping_saves(tmp_path):
+    """Several steps in flight on the shared FIFO writer; all land."""
+    handles = [
+        checkpoint.save_async(
+            str(tmp_path), {"x": np.full((256,), s, np.float32)}, step=s)
+        for s in range(5)
+    ]
+    for h in handles:
+        h.wait(60.0)
+    assert checkpoint.latest_step(str(tmp_path)) == 4
+    out = checkpoint.restore(str(tmp_path),
+                             {"x": np.zeros((256,), np.float32)}, step=2)
+    np.testing.assert_array_equal(out["x"], np.full((256,), 2, np.float32))

@@ -70,3 +70,21 @@ def test_prefetch_early_close_releases_producer(flat_runtime):
     while threading.active_count() > before and time.time() < deadline:
         time.sleep(0.05)
     assert threading.active_count() <= before, "producer thread leaked"
+
+
+def test_prefetch_dropped_before_first_next_releases_producer(flat_runtime):
+    """A never-started generator skips its finally on GC; the attached
+    finalizer must still stop the producer and drop staged batches."""
+    import gc
+    import threading
+    import time
+
+    mesh = mpi.world_mesh()
+    before = threading.active_count()
+    it = prefetch_to_mesh(_batches(100), mesh, P(mesh.axis_names), depth=1)
+    del it  # dropped without ever calling next()
+    gc.collect()
+    deadline = time.time() + 10
+    while threading.active_count() > before and time.time() < deadline:
+        time.sleep(0.05)
+    assert threading.active_count() <= before, "producer thread leaked"

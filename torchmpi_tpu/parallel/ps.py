@@ -27,12 +27,12 @@ from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
 import threading
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..utils import native
 from ..utils import tree as tree_util
 
 PyTree = Any
@@ -53,83 +53,49 @@ _LIB: Optional[ctypes.CDLL] = None
 _ORPHANED_BUFFERS: List[Any] = []
 
 
-def _repo_root() -> str:
-    return os.path.dirname(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))))
-
-
-def _src_digest(path: str) -> str:
-    import hashlib
-
-    with open(path, "rb") as f:
-        return hashlib.sha256(f.read()).hexdigest()
-
-
-def _load_lib() -> ctypes.CDLL:
-    """Load (building if necessary) the host-transport shared library.
-
-    Staleness is keyed on a content hash of ps.cpp stored next to the
-    binary — mtimes are meaningless after git clone (ADVICE round 1), and
-    build/ is no longer committed."""
-    global _LIB
-    with _LIB_LOCK:
-        if _LIB is not None:
-            return _LIB
-        root = _repo_root()
-        so = os.path.join(root, "build", "libtorchmpi_ps.so")
-        src = os.path.join(root, "csrc", "ps.cpp")
-        if os.path.exists(src):
-            digest_file = so + ".srchash"
-            digest = _src_digest(src)
-            built = None
-            if os.path.exists(so) and os.path.exists(digest_file):
-                with open(digest_file) as f:
-                    built = f.read().strip()
-            if built != digest:
-                subprocess.run(["make", "-C", os.path.join(root, "csrc")],
-                               check=True, capture_output=True)
-                with open(digest_file, "w") as f:
-                    f.write(digest)
-        elif not os.path.exists(so):
-            raise RuntimeError(
-                f"parameter-server transport unavailable: neither {so} nor "
-                f"{src} exists")
-        # src absent but .so present: prebuilt deployment; load as-is.
-        lib = ctypes.CDLL(so)
-        lib.tm_ps_server_create.restype = ctypes.c_int64
-        lib.tm_ps_server_create.argtypes = [ctypes.c_uint64, ctypes.c_int]
-        lib.tm_ps_server_port.restype = ctypes.c_int
-        lib.tm_ps_server_port.argtypes = [ctypes.c_int64]
-        lib.tm_ps_server_ops.restype = ctypes.c_uint64
-        lib.tm_ps_server_ops.argtypes = [ctypes.c_int64]
-        lib.tm_ps_server_destroy.restype = None
-        lib.tm_ps_server_destroy.argtypes = [ctypes.c_int64]
-        lib.tm_ps_client_connect.restype = ctypes.c_int64
-        lib.tm_ps_client_connect.argtypes = [ctypes.c_char_p, ctypes.c_int,
+def _bind(lib: ctypes.CDLL) -> None:
+    lib.tm_ps_server_create.restype = ctypes.c_int64
+    lib.tm_ps_server_create.argtypes = [ctypes.c_uint64, ctypes.c_int]
+    lib.tm_ps_server_port.restype = ctypes.c_int
+    lib.tm_ps_server_port.argtypes = [ctypes.c_int64]
+    lib.tm_ps_server_ops.restype = ctypes.c_uint64
+    lib.tm_ps_server_ops.argtypes = [ctypes.c_int64]
+    lib.tm_ps_server_destroy.restype = None
+    lib.tm_ps_server_destroy.argtypes = [ctypes.c_int64]
+    lib.tm_ps_client_connect.restype = ctypes.c_int64
+    lib.tm_ps_client_connect.argtypes = [ctypes.c_char_p, ctypes.c_int,
                                              ctypes.c_int]
-        lib.tm_ps_client_destroy.restype = None
-        lib.tm_ps_client_destroy.argtypes = [ctypes.c_int64]
-        lib.tm_ps_send.restype = ctypes.c_int64
-        lib.tm_ps_send.argtypes = [
+    lib.tm_ps_client_destroy.restype = None
+    lib.tm_ps_client_destroy.argtypes = [ctypes.c_int64]
+    lib.tm_ps_send.restype = ctypes.c_int64
+    lib.tm_ps_send.argtypes = [
             ctypes.c_int64, ctypes.c_uint32, ctypes.c_float, ctypes.c_uint64,
             ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
             ctypes.c_uint64]
-        lib.tm_ps_receive.restype = ctypes.c_int64
-        lib.tm_ps_receive.argtypes = [
+    lib.tm_ps_receive.restype = ctypes.c_int64
+    lib.tm_ps_receive.argtypes = [
             ctypes.c_int64, ctypes.c_uint64, ctypes.POINTER(ctypes.c_float),
             ctypes.c_uint64]
-        lib.tm_ps_wait.restype = ctypes.c_int
-        lib.tm_ps_wait.argtypes = [ctypes.c_int64]
-        lib.tm_ps_wait_for.restype = ctypes.c_int
-        lib.tm_ps_wait_for.argtypes = [ctypes.c_int64, ctypes.c_int]
-        lib.tm_ps_test.restype = ctypes.c_int
-        lib.tm_ps_test.argtypes = [ctypes.c_int64]
-        lib.tm_ps_forget.restype = None
-        lib.tm_ps_forget.argtypes = [ctypes.c_int64]
-        lib.tm_ps_ping.restype = ctypes.c_int64
-        lib.tm_ps_ping.argtypes = [ctypes.c_int64]
-        _LIB = lib
-        return lib
+    lib.tm_ps_wait.restype = ctypes.c_int
+    lib.tm_ps_wait.argtypes = [ctypes.c_int64]
+    lib.tm_ps_wait_for.restype = ctypes.c_int
+    lib.tm_ps_wait_for.argtypes = [ctypes.c_int64, ctypes.c_int]
+    lib.tm_ps_test.restype = ctypes.c_int
+    lib.tm_ps_test.argtypes = [ctypes.c_int64]
+    lib.tm_ps_forget.restype = None
+    lib.tm_ps_forget.argtypes = [ctypes.c_int64]
+    lib.tm_ps_ping.restype = ctypes.c_int64
+    lib.tm_ps_ping.argtypes = [ctypes.c_int64]
+
+
+def _load_lib() -> ctypes.CDLL:
+    """Load (building if necessary) the host-transport shared library via
+    the shared native loader (hash-keyed staleness; ADVICE round 1)."""
+    global _LIB
+    with _LIB_LOCK:
+        if _LIB is None:
+            _LIB = native.load_native("libtorchmpi_ps.so", "ps.cpp", _bind)
+        return _LIB
 
 
 def _fptr(a: np.ndarray):

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import weakref
 from typing import Any, Iterable, Iterator, Optional
 
 import jax
@@ -92,6 +93,16 @@ def prefetch_to_mesh(batches: Iterable[PyTree], mesh: Mesh,
                           name="torchmpi-prefetch")
     th.start()
 
+    def _abandon():
+        # Release the producer and drop staged device buffers.  Idempotent:
+        # runs from the generator's finally AND from its GC finalizer.
+        stop.set()
+        try:
+            while True:
+                q.get_nowait()
+        except queue.Empty:
+            pass
+
     def consume() -> Iterator[PyTree]:
         try:
             while True:
@@ -102,13 +113,13 @@ def prefetch_to_mesh(batches: Iterable[PyTree], mesh: Mesh,
                     raise item.exc
                 yield item
         finally:
-            # Early close (break / exception / GC of the iterator): release
-            # the producer and drop staged device buffers.
-            stop.set()
-            try:
-                while True:
-                    q.get_nowait()
-            except queue.Empty:
-                pass
+            # Early close (break / exception / GC of the iterator).
+            _abandon()
 
-    return consume()
+    gen = consume()
+    # A never-started generator skips its finally on GC (close() is a no-op
+    # before the first next()), which would leave the producer spinning and
+    # `depth` batches pinned on device forever.  The finalizer covers that
+    # path; it must not reference `gen` itself.
+    weakref.finalize(gen, _abandon)
+    return gen

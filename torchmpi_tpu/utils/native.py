@@ -1,0 +1,62 @@
+"""Shared loader for the native extensions in csrc/ (C ABI via ctypes).
+
+One staleness policy for every extension: the built .so is keyed on a
+content hash of its source stored next to the binary — mtimes are
+meaningless after git clone (ADVICE round 1), and build/ is not committed.
+Builds go through ``make -C csrc``, whose atomic tmp+rename rule keeps
+concurrent lazy builders from ever dlopen'ing a half-written library.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+from typing import Callable, Optional
+
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def _src_digest(path: str) -> str:
+    with open(path, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()
+
+
+def load_native(so_name: str, src_name: str,
+                bind: Optional[Callable[[ctypes.CDLL], None]] = None,
+                ) -> ctypes.CDLL:
+    """Load ``build/<so_name>``, rebuilding from ``csrc/<src_name>`` when
+    its content hash changed; ``bind(lib)`` declares ctypes signatures.
+    Callers hold their own cache + lock — this function is stateless."""
+    root = repo_root()
+    so = os.path.join(root, "build", so_name)
+    src = os.path.join(root, "csrc", src_name)
+    if os.path.exists(src):
+        digest_file = so + ".srchash"
+        digest = _src_digest(src)
+        built = None
+        if os.path.exists(so) and os.path.exists(digest_file):
+            with open(digest_file) as f:
+                built = f.read().strip()
+        if built != digest:
+            try:
+                subprocess.run(["make", "-C", os.path.join(root, "csrc")],
+                               check=True, capture_output=True, text=True)
+            except subprocess.CalledProcessError as e:
+                raise RuntimeError(
+                    f"native build failed for {src_name}:\n{e.stderr}"
+                ) from e
+            with open(digest_file, "w") as f:
+                f.write(digest)
+    elif not os.path.exists(so):
+        raise RuntimeError(
+            f"native extension unavailable: neither {so} nor {src} exists")
+    # src absent but .so present: prebuilt deployment; load as-is.
+    lib = ctypes.CDLL(so)
+    if bind is not None:
+        bind(lib)
+    return lib
