@@ -1,0 +1,97 @@
+"""Attention micro-benchmark: Pallas flash kernel vs the XLA dense path.
+
+Beyond-reference (the reference predates attention — SURVEY.md §6.7); this
+is the compute-kernel analog of the stock-vs-custom collective comparison
+in collectives_bench.py: same numerics two ways, measured side by side.
+Reports achieved TFLOP/s (4*B*H*Tq*Tkv*D flops per attention, halved for
+causal) and peak HBM residency difference — the dense path materializes
+the [T, T] score matrix, flash never does, so flash extends to sequence
+lengths the dense path cannot hold.
+
+Run: ``python benchmarks/attention_bench.py [--seqs 1024,4096] [--json]``
+(real TPU when available; CPU interpret-mode smoke with --cpu).
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--cpu", action="store_true",
+                   help="force CPU (interpret-mode smoke; tiny shapes)")
+    p.add_argument("--seqs", type=str, default="1024,4096,16384")
+    p.add_argument("--batch", type=int, default=4)
+    p.add_argument("--heads", type=int, default=8)
+    p.add_argument("--head-dim", type=int, default=128)
+    p.add_argument("--iters", type=int, default=10)
+    p.add_argument("--causal", action=argparse.BooleanOptionalAction,
+                   default=True)
+    p.add_argument("--json", action="store_true")
+    args = p.parse_args()
+    if args.cpu:
+        from torchmpi_tpu.utils.simulation import force_cpu_devices
+
+        force_cpu_devices(1)
+        args.seqs = "128"
+        args.batch, args.heads, args.head_dim = 1, 2, 8
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from torchmpi_tpu.ops.flash import flash_attention
+    from torchmpi_tpu.parallel.sequence import reference_attention
+    from torchmpi_tpu.utils.metrics import fence
+
+    platform = jax.devices()[0].platform
+    dtype = jnp.bfloat16 if platform == "tpu" else jnp.float32
+    B, H, D = args.batch, args.heads, args.head_dim
+
+    impls = {
+        "flash": jax.jit(lambda q, k, v: flash_attention(
+            q, k, v, causal=args.causal)),
+        "xla-dense": jax.jit(lambda q, k, v: reference_attention(
+            q, k, v, causal=args.causal)),
+    }
+
+    for T in (int(s) for s in args.seqs.split(",")):
+        rng = np.random.RandomState(0)
+        q = jnp.asarray(rng.randn(B, T, H, D), dtype)
+        k = jnp.asarray(rng.randn(B, T, H, D), dtype)
+        v = jnp.asarray(rng.randn(B, T, H, D), dtype)
+        flops = 4.0 * B * H * T * T * D * (0.5 if args.causal else 1.0)
+        for name, fn in impls.items():
+            try:
+                out = fn(q, k, v)
+                fence(out)
+                t0 = time.perf_counter()
+                for _ in range(args.iters):
+                    out = fn(q, k, v)
+                fence(out)
+                dt = (time.perf_counter() - t0) / args.iters
+            except Exception as e:  # dense path OOMs first at long T
+                line = {"op": "attention", "impl": name, "seq": T,
+                        "error": str(e)[:120]}
+                print(json.dumps(line) if args.json
+                      else f"attention {name:9s} T={T:>6d}  FAILED: "
+                           f"{str(e)[:80]}")
+                continue
+            tflops = flops / dt / 1e12
+            line = {"op": "attention", "impl": name, "seq": T,
+                    "batch": B, "heads": H, "head_dim": D,
+                    "dtype": str(dtype.__name__ if hasattr(dtype, "__name__")
+                                 else dtype), "ms": round(dt * 1e3, 3),
+                    "tflops": round(tflops, 2), "platform": platform}
+            print(json.dumps(line) if args.json
+                  else f"attention {name:9s} T={T:>6d}  {dt*1e3:8.2f} ms  "
+                       f"{tflops:7.2f} TFLOP/s")
+
+
+if __name__ == "__main__":
+    main()

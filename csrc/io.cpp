@@ -56,6 +56,12 @@ struct IoFuture {
 };
 
 struct Executor {
+  // Static destruction of the registry (process exit without an explicit
+  // destroy — CPython does not guarantee __del__ runs) must not run
+  // ~std::thread on a joinable worker: that is std::terminate.  The
+  // destructor drains and joins, same as an explicit destroy.
+  ~Executor() { stop(); }
+
   std::vector<std::thread> threads;
   std::deque<std::function<void()>> queue;
   std::mutex mu;

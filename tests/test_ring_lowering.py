@@ -102,6 +102,19 @@ def test_reduce_scatter_and_all_gather_lower(flat_runtime):
     _export_for_tpu(body, (8, 64 * 8), mesh)
 
 
+def test_flash_attention_lowers(flat_runtime):
+    """The flash-attention kernel at production shapes (bf16, D=128,
+    long sequence) must lower to Mosaic."""
+    from torchmpi_tpu.ops.flash import flash_attention
+
+    def fn(q, k, v):
+        return flash_attention(q, k, v, causal=True, interpret=False)
+
+    shp = jax.ShapeDtypeStruct((4, 8192, 8, 128), jnp.bfloat16)
+    exp = jax.export.export(jax.jit(fn), platforms=["tpu"])(shp, shp, shp)
+    assert "tpu_custom_call" in exp.mlir_module()
+
+
 def test_chunked_rs_ag_100mb_lower(flat_runtime):
     # The streaming RS/AG kernels at gradient scale, full pipeline depth.
     mpi.set_config(chunk_bytes=4 * 1024 * 1024, custom_min_bytes=0)

@@ -5,6 +5,8 @@ long-context model family the TPU rebuild adds, wired to the
 sequence-parallel attention strategies in ``parallel/sequence.py``:
 
 - ``attn_impl="local"``   — ordinary full attention (single device / no SP)
+- ``attn_impl="flash"``   — Pallas blocked flash attention (ops/flash.py):
+  same math as local, [T, T] scores never materialize
 - ``attn_impl="ring"``    — blockwise ring attention over ``seq_axis``
 - ``attn_impl="ulysses"`` — all-to-all head-scatter attention over ``seq_axis``
 
@@ -47,6 +49,10 @@ class SPAttention(nn.Module):
                    qkv[:, :, 2].astype(jnp.float32))
         if self.attn_impl == "local":
             o = seqlib.reference_attention(q, k, v, causal=True)
+        elif self.attn_impl == "flash":
+            from ..ops.flash import flash_attention
+
+            o = flash_attention(q, k, v, causal=True)
         elif self.attn_impl == "ring":
             o = seqlib.ring_attention(q, k, v, self.seq_axis, causal=True)
         elif self.attn_impl == "ulysses":

@@ -1,0 +1,166 @@
+"""Pallas TPU flash attention: the hot-op kernel for the compute path.
+
+The reference has no attention anywhere (pre-transformer, SURVEY.md §6.7);
+this kernel serves the beyond-reference long-context stack
+(parallel/sequence.py, models/transformer.py) the TPU-first way: blocked
+online-softmax attention that never materializes the [T, T] score matrix,
+streaming K/V blocks through VMEM while the accumulator lives in VMEM
+scratch across grid steps.  MXU-friendly: both matmuls per block are
+[block_q, D] x [D, block_k] and [block_q, block_k] x [block_k, D] with f32
+accumulation (``preferred_element_type``), bf16-ready inputs.
+
+Why scratch-across-grid works: the TPU grid is executed sequentially with
+the last dimension minor, so the (m, l, acc) scratch carries the running
+softmax state across the k-block dimension for one (batch, head, q-block)
+triple, exactly the flash-attention recurrence.
+
+``q_offset``/``kv_offset`` place the local q and kv blocks at global
+sequence positions, so the same kernel computes the shard-diagonal causal
+block of ring attention (parallel/sequence.py) where q and kv start at
+different global offsets.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Finite stand-in for -inf in masked scores: keeps exp() exactly 0 without
+# producing (-inf) - (-inf) = nan in the running-max rescale.
+_NEG_INF = -1e30
+
+# Lane width: m/l scratch rows are stored broadcast across a full 128-lane
+# vector so every read/write is a full-tile op (same layout the TPU flash
+# kernels in jax use); per-row values are recovered with a lane-reduce.
+_LANES = 128
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+                  scale: float, causal: bool, q_offset: int, kv_offset: int,
+                  block_q: int, block_k: int, kv_len: int):
+    j = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0, 0]  # [block_q, D]
+    k = k_ref[0, 0]  # [block_k, D]
+    v = v_ref[0, 0]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale  # [block_q, block_k]
+
+    i = pl.program_id(2)
+    k_global = kv_offset + j * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    valid = k_global < kv_offset + kv_len  # mask K/V padding rows
+    if causal:
+        q_global = q_offset + i * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        valid = jnp.logical_and(valid, q_global >= k_global)
+    s = jnp.where(valid, s, _NEG_INF)
+
+    m_prev = jnp.max(m_ref[:], axis=1, keepdims=True)  # [block_q, 1]
+    m_cur = jnp.max(s, axis=1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    # Fully-masked-so-far rows have m_new == _NEG_INF; exponentiate against
+    # 0 there so masked scores give p == 0, not exp(-1e30 + 1e30) == 1.
+    m_safe = jnp.where(m_new > 0.5 * _NEG_INF, m_new, 0.0)
+    alpha = jnp.exp(m_prev - m_safe)  # 0 when m_prev is _NEG_INF (init)
+    p = jnp.exp(s - m_safe)  # masked entries: exp(_NEG_INF) == 0
+    l_prev = jnp.max(l_ref[:], axis=1, keepdims=True)
+    l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+    acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+    l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        # Fully-masked rows (l == 0) read as zeros, matching the parallel
+        # variants' convention in parallel/sequence.py.
+        denom = jnp.where(l_new > 0, l_new, 1.0)
+        o_ref[0, 0] = (acc_ref[:] / denom).astype(o_ref.dtype)
+
+
+def flash_attention(q, k, v, *, causal: bool = False,
+                    scale: Optional[float] = None, q_offset: int = 0,
+                    kv_offset: int = 0, block_q: int = 128,
+                    block_k: int = 128, interpret=None):
+    """Blocked flash attention on one device.
+
+    ``q``: [B, T_q, H, D]; ``k``/``v``: [B, T_kv, H, D] (the bqhd layout of
+    parallel/sequence.py).  Returns [B, T_q, H, D] in ``q``'s dtype.
+
+    ``q_offset``/``kv_offset`` are the global positions of ``q[:, 0]`` and
+    ``k[:, 0]`` for causal masking (both 0 for plain self-attention); the
+    offsets let one kernel serve sequence-sharded callers.  Numerics match
+    :func:`parallel.sequence.reference_attention` to dtype tolerance; the
+    [T_q, T_kv] score matrix never exists in memory — VMEM residency is
+    O(block_q * block_k + block_q * D) per (batch, head).
+    """
+    B, Tq, H, D = q.shape
+    Tkv = k.shape[1]
+    if k.shape != (B, Tkv, H, D) or v.shape != k.shape:
+        raise ValueError(f"shape mismatch: q {q.shape} k {k.shape} "
+                         f"v {v.shape}")
+    if scale is None:
+        scale = 1.0 / (D ** 0.5)
+
+    block_q = min(block_q, Tq)
+    block_k = min(block_k, Tkv)
+    pad_q = (-Tq) % block_q
+    pad_k = (-Tkv) % block_k
+    qt = jnp.moveaxis(q, 2, 1)  # [B, H, Tq, D]
+    kt = jnp.moveaxis(k, 2, 1)
+    vt = jnp.moveaxis(v, 2, 1)
+    if pad_q:
+        qt = jnp.pad(qt, ((0, 0), (0, 0), (0, pad_q), (0, 0)))
+    if pad_k:
+        kt = jnp.pad(kt, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+        vt = jnp.pad(vt, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+    nq = qt.shape[2] // block_q
+    nk = kt.shape[2] // block_k
+
+    if interpret is None:
+        from . import ring
+
+        interpret = ring._interpret_mode()
+
+    kernel = functools.partial(
+        _flash_kernel, scale=scale, causal=causal, q_offset=q_offset,
+        kv_offset=kv_offset, block_q=block_q, block_k=block_k, kv_len=Tkv)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
+        grid=(B, H, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D),
+                         lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, h, i, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, h, i, j: (b, h, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, D),
+                               lambda b, h, i, j: (b, h, i, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANES), jnp.float32),  # running max
+            pltpu.VMEM((block_q, _LANES), jnp.float32),  # running denom
+            pltpu.VMEM((block_q, D), jnp.float32),       # output accum
+        ],
+        interpret=interpret,
+    )(qt, kt, vt)
+    if pad_q:
+        out = out[:, :, :Tq]
+    return jnp.moveaxis(out, 1, 2)

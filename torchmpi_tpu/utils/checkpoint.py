@@ -90,10 +90,17 @@ def _writer():
     global _WRITER
     with _WRITER_LOCK:
         if _WRITER is None:
+            import atexit
+
             from . import aio
 
             # One thread: FIFO order commits the npz before its metadata.
             _WRITER = aio.AsyncWriter(threads=1)
+            # Drain + join at interpreter exit: __del__ is not guaranteed
+            # for module globals, and exiting with the native pool's
+            # threads joinable would std::terminate in the .so's static
+            # destructors.  close() is idempotent.
+            atexit.register(_WRITER.close)
         return _WRITER
 
 
