@@ -115,6 +115,41 @@ def test_flash_attention_lowers(flat_runtime):
     assert "tpu_custom_call" in exp.mlir_module()
 
 
+def test_flash_attention_grad_lowers(flat_runtime):
+    """The backward kernels (dq and dkv) lower to Mosaic at production
+    shapes through the custom VJP."""
+    from torchmpi_tpu.ops.flash import flash_attention_grad
+
+    def loss(q, k, v):
+        return flash_attention_grad(q, k, v, causal=True,
+                                    interpret=False).astype(
+            jnp.float32).sum()
+
+    g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+    shp = jax.ShapeDtypeStruct((4, 4096, 8, 128), jnp.bfloat16)
+    exp = jax.export.export(g, platforms=["tpu"])(shp, shp, shp)
+    assert exp.mlir_module().count("tpu_custom_call") >= 3  # fwd + dq + dkv
+
+
+def test_ring_flash_attention_lowers(flat_runtime):
+    """Ring attention with Pallas flash blocks (residual outputs + traced
+    SMEM offsets from lax.axis_index) lowers to Mosaic inside shard_map."""
+    from torchmpi_tpu.parallel import sequence as seq
+
+    mesh = mpi.world_mesh()
+
+    def body(q, k, v):
+        return seq.ring_attention(q, k, v, "ici", causal=True,
+                                  block_impl="flash")
+
+    spec = P(None, ("dcn", "ici"))
+    fn = jax.jit(shard_map(body, mesh=mesh, in_specs=(spec,) * 3,
+                           out_specs=spec, check_vma=False))
+    shp = jax.ShapeDtypeStruct((2, 8 * 2048, 8, 128), jnp.bfloat16)
+    exp = jax.export.export(fn, platforms=["tpu"])(shp, shp, shp)
+    assert "tpu_custom_call" in exp.mlir_module()
+
+
 def test_chunked_rs_ag_100mb_lower(flat_runtime):
     # The streaming RS/AG kernels at gradient scale, full pipeline depth.
     mpi.set_config(chunk_bytes=4 * 1024 * 1024, custom_min_bytes=0)

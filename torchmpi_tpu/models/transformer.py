@@ -8,6 +8,8 @@ sequence-parallel attention strategies in ``parallel/sequence.py``:
 - ``attn_impl="flash"``   — Pallas blocked flash attention (ops/flash.py):
   same math as local, [T, T] scores never materialize
 - ``attn_impl="ring"``    — blockwise ring attention over ``seq_axis``
+- ``attn_impl="ring_flash"`` — ring attention whose per-step local blocks
+  run the Pallas flash kernel (long local shards without [T, T] blocks)
 - ``attn_impl="ulysses"`` — all-to-all head-scatter attention over ``seq_axis``
 
 With ``seq_axis`` set, the model is meant to run inside ``shard_map`` with
@@ -50,11 +52,14 @@ class SPAttention(nn.Module):
         if self.attn_impl == "local":
             o = seqlib.reference_attention(q, k, v, causal=True)
         elif self.attn_impl == "flash":
-            from ..ops.flash import flash_attention
+            from ..ops.flash import flash_attention_grad
 
-            o = flash_attention(q, k, v, causal=True)
+            o = flash_attention_grad(q, k, v, causal=True)
         elif self.attn_impl == "ring":
             o = seqlib.ring_attention(q, k, v, self.seq_axis, causal=True)
+        elif self.attn_impl == "ring_flash":
+            o = seqlib.ring_attention(q, k, v, self.seq_axis, causal=True,
+                                      block_impl="flash")
         elif self.attn_impl == "ulysses":
             o = seqlib.ulysses_attention(q, k, v, self.seq_axis, causal=True)
         else:

@@ -15,9 +15,12 @@ softmax state across the k-block dimension for one (batch, head, q-block)
 triple, exactly the flash-attention recurrence.
 
 ``q_offset``/``kv_offset`` place the local q and kv blocks at global
-sequence positions, so the same kernel computes the shard-diagonal causal
-block of ring attention (parallel/sequence.py) where q and kv start at
-different global offsets.
+sequence positions and may be TRACED scalars (they ride in SMEM), so the
+same kernel computes ring attention's per-step blocks inside ``shard_map``
+where the kv owner — hence its offset — depends on ``lax.axis_index``.
+``return_residuals=True`` returns the un-normalized numerator plus the
+(m, l) softmax statistics, the contract ring attention's cross-block
+combiner needs (parallel/sequence.py).
 """
 
 from __future__ import annotations
@@ -32,23 +35,50 @@ from jax.experimental.pallas import tpu as pltpu
 
 # Finite stand-in for -inf in masked scores: keeps exp() exactly 0 without
 # producing (-inf) - (-inf) = nan in the running-max rescale.
-_NEG_INF = -1e30
+NEG_INF = -1e30
 
-# Lane width: m/l scratch rows are stored broadcast across a full 128-lane
-# vector so every read/write is a full-tile op (same layout the TPU flash
-# kernels in jax use); per-row values are recovered with a lane-reduce.
+# Lane width of the VMEM m/l scratch: rows are stored broadcast across a
+# full 128-lane vector so every read/write is a full-tile op (same layout
+# the TPU flash kernels in jax use); per-row values are recovered with a
+# lane-reduce.
 _LANES = 128
 
+# Lane width of the (optional) m/l residual OUTPUTS: 8 lanes keep the HBM
+# footprint at Tq*8 floats per (batch, head) instead of Tq*128 while still
+# writing full rows of the f32 (8, 128)-tile layout.
+_STAT_LANES = 8
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
-                  scale: float, causal: bool, q_offset: int, kv_offset: int,
-                  block_q: int, block_k: int, kv_len: int):
+
+def _valid_mask(qo_ref, ko_ref, i, j, block_q: int, block_k: int,
+                kv_len: int, causal: bool):
+    """[block_q, block_k] score-validity mask: k-padding rows out, and (for
+    causal) global q position >= global k position.  Forward and backward
+    kernels MUST mask identically — the backward recomputes p against the
+    forward's lse — so all of them call this one helper."""
+    kv_offset = ko_ref[0]
+    k_global = kv_offset + j * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    valid = k_global < kv_offset + kv_len
+    if causal:
+        q_global = qo_ref[0] + i * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        valid = jnp.logical_and(valid, q_global >= k_global)
+    return valid
+
+
+def _flash_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, o_ref, *rest,
+                  scale: float, causal: bool, block_q: int, block_k: int,
+                  kv_len: int, residuals: bool):
+    if residuals:
+        m_out_ref, l_out_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        m_ref, l_ref, acc_ref = rest
     j = pl.program_id(3)
     nk = pl.num_programs(3)
 
     @pl.when(j == 0)
     def _init():
-        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
@@ -60,23 +90,17 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
         preferred_element_type=jnp.float32) * scale  # [block_q, block_k]
 
     i = pl.program_id(2)
-    k_global = kv_offset + j * block_k + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 1)
-    valid = k_global < kv_offset + kv_len  # mask K/V padding rows
-    if causal:
-        q_global = q_offset + i * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0)
-        valid = jnp.logical_and(valid, q_global >= k_global)
-    s = jnp.where(valid, s, _NEG_INF)
+    s = jnp.where(_valid_mask(qo_ref, ko_ref, i, j, block_q, block_k,
+                              kv_len, causal), s, NEG_INF)
 
     m_prev = jnp.max(m_ref[:], axis=1, keepdims=True)  # [block_q, 1]
     m_cur = jnp.max(s, axis=1, keepdims=True)
     m_new = jnp.maximum(m_prev, m_cur)
-    # Fully-masked-so-far rows have m_new == _NEG_INF; exponentiate against
+    # Fully-masked-so-far rows have m_new == NEG_INF; exponentiate against
     # 0 there so masked scores give p == 0, not exp(-1e30 + 1e30) == 1.
-    m_safe = jnp.where(m_new > 0.5 * _NEG_INF, m_new, 0.0)
-    alpha = jnp.exp(m_prev - m_safe)  # 0 when m_prev is _NEG_INF (init)
-    p = jnp.exp(s - m_safe)  # masked entries: exp(_NEG_INF) == 0
+    m_safe = jnp.where(m_new > 0.5 * NEG_INF, m_new, 0.0)
+    alpha = jnp.exp(m_prev - m_safe)  # 0 when m_prev is NEG_INF (init)
+    p = jnp.exp(s - m_safe)  # masked entries: exp(NEG_INF) == 0
     l_prev = jnp.max(l_ref[:], axis=1, keepdims=True)
     l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
     acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
@@ -87,24 +111,128 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
 
     @pl.when(j == nk - 1)
     def _finalize():
-        # Fully-masked rows (l == 0) read as zeros, matching the parallel
-        # variants' convention in parallel/sequence.py.
-        denom = jnp.where(l_new > 0, l_new, 1.0)
-        o_ref[0, 0] = (acc_ref[:] / denom).astype(o_ref.dtype)
+        if residuals:
+            # Numerator + statistics for a cross-block combiner; rows whose
+            # every key was masked carry m == NEG_INF, l == 0, acc == 0.
+            o_ref[0, 0] = acc_ref[:].astype(o_ref.dtype)
+            m_out_ref[0, 0] = jnp.broadcast_to(m_new,
+                                               (block_q, _STAT_LANES))
+            l_out_ref[0, 0] = jnp.broadcast_to(l_new,
+                                               (block_q, _STAT_LANES))
+        else:
+            # Fully-masked rows (l == 0) read as zeros, matching the
+            # parallel variants' convention in parallel/sequence.py.
+            denom = jnp.where(l_new > 0, l_new, 1.0)
+            o_ref[0, 0] = (acc_ref[:] / denom).astype(o_ref.dtype)
+
+
+def _flash_bwd_dq_kernel(qo_ref, ko_ref, q_ref, do_ref, lse_ref, d_ref,
+                         k_ref, v_ref, dq_ref, dq_acc, *, scale: float,
+                         causal: bool, block_q: int, block_k: int,
+                         kv_len: int):
+    """dq = scale * sum_j [p_ij * (dO_i . v_j - D_i)] k_j, p recomputed
+    blockwise from lse.  Grid (B, H, nq, nk): the dq accumulator carries
+    across the (minor) kv-block dimension."""
+    j = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    q = q_ref[0, 0]  # [block_q, D]
+    do = do_ref[0, 0]
+    k = k_ref[0, 0]  # [block_k, D]
+    v = v_ref[0, 0]
+    lse = jnp.max(lse_ref[0, 0], axis=1, keepdims=True)  # [block_q, 1]
+    dvec = jnp.max(d_ref[0, 0], axis=1, keepdims=True)
+
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale
+    i = pl.program_id(2)
+    s = jnp.where(_valid_mask(qo_ref, ko_ref, i, j, block_q, block_k,
+                              kv_len, causal), s, NEG_INF)
+    p = jnp.exp(s - lse)  # masked or fully-masked rows (lse=+1e30) give 0
+
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)  # [block_q, block_k]
+    ds = p * (dp - dvec)
+    dq_acc[:] = dq_acc[:] + scale * jax.lax.dot_general(
+        ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(qo_ref, ko_ref, k_ref, v_ref, q_ref, do_ref,
+                          lse_ref, d_ref, dk_ref, dv_ref, dk_acc, dv_acc, *,
+                          scale: float, causal: bool, block_q: int,
+                          block_k: int, kv_len: int):
+    """dk_j = scale * sum_i ds_ij^T q_i;  dv_j = sum_i p_ij^T dO_i.
+    Grid (B, H, nk, nq): the q-block dimension is minor so the dk/dv
+    accumulators carry across it for one kv block."""
+    i = pl.program_id(3)
+    nq = pl.num_programs(3)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    k = k_ref[0, 0]  # [block_k, D]
+    v = v_ref[0, 0]
+    q = q_ref[0, 0]  # [block_q, D]
+    do = do_ref[0, 0]
+    lse = jnp.max(lse_ref[0, 0], axis=1, keepdims=True)  # [block_q, 1]
+    dvec = jnp.max(d_ref[0, 0], axis=1, keepdims=True)
+
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale
+    j = pl.program_id(2)
+    s = jnp.where(_valid_mask(qo_ref, ko_ref, i, j, block_q, block_k,
+                              kv_len, causal), s, NEG_INF)
+    p = jnp.exp(s - lse)  # [block_q, block_k]
+
+    dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
+        p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    ds = p * (dp - dvec)
+    dk_acc[:] = dk_acc[:] + scale * jax.lax.dot_general(
+        ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(i == nq - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
 
 
 def flash_attention(q, k, v, *, causal: bool = False,
-                    scale: Optional[float] = None, q_offset: int = 0,
-                    kv_offset: int = 0, block_q: int = 128,
-                    block_k: int = 128, interpret=None):
+                    scale: Optional[float] = None, q_offset=0, kv_offset=0,
+                    block_q: int = 128, block_k: int = 128,
+                    return_residuals: bool = False, interpret=None):
     """Blocked flash attention on one device.
 
     ``q``: [B, T_q, H, D]; ``k``/``v``: [B, T_kv, H, D] (the bqhd layout of
-    parallel/sequence.py).  Returns [B, T_q, H, D] in ``q``'s dtype.
+    parallel/sequence.py).  Returns [B, T_q, H, D] in ``q``'s dtype — or,
+    with ``return_residuals=True``, the tuple ``(numerator, m, l)`` with
+    ``numerator`` un-normalized (f32, [B, T_q, H, D]) and ``m``/``l`` the
+    per-row softmax max/denominator shaped [B, H, T_q] (f32), the
+    partial-block contract of ``parallel.sequence._attn_block`` with
+    ``NEG_INF`` in place of -inf.
 
     ``q_offset``/``kv_offset`` are the global positions of ``q[:, 0]`` and
-    ``k[:, 0]`` for causal masking (both 0 for plain self-attention); the
-    offsets let one kernel serve sequence-sharded callers.  Numerics match
+    ``k[:, 0]`` for causal masking (both 0 for plain self-attention); they
+    may be traced int32 scalars, so sequence-sharded callers inside
+    ``shard_map`` can pass axis-index-derived offsets.  Numerics match
     :func:`parallel.sequence.reference_attention` to dtype tolerance; the
     [T_q, T_kv] score matrix never exists in memory — VMEM residency is
     O(block_q * block_k + block_q * D) per (batch, head).
@@ -129,7 +257,8 @@ def flash_attention(q, k, v, *, causal: bool = False,
     if pad_k:
         kt = jnp.pad(kt, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
         vt = jnp.pad(vt, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
-    nq = qt.shape[2] // block_q
+    Tqp = qt.shape[2]
+    nq = Tqp // block_q
     nk = kt.shape[2] // block_k
 
     if interpret is None:
@@ -138,29 +267,216 @@ def flash_attention(q, k, v, *, causal: bool = False,
         interpret = ring._interpret_mode()
 
     kernel = functools.partial(
-        _flash_kernel, scale=scale, causal=causal, q_offset=q_offset,
-        kv_offset=kv_offset, block_q=block_q, block_k=block_k, kv_len=Tkv)
-    out = pl.pallas_call(
+        _flash_kernel, scale=scale, causal=causal, block_q=block_q,
+        block_k=block_k, kv_len=Tkv, residuals=return_residuals)
+    qo = jnp.asarray(q_offset, jnp.int32).reshape(1)
+    ko = jnp.asarray(kv_offset, jnp.int32).reshape(1)
+    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
+    o_spec = pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0))
+    out_shape = [jax.ShapeDtypeStruct(
+        qt.shape, jnp.float32 if return_residuals else q.dtype)]
+    out_specs = [o_spec]
+    if return_residuals:
+        stat = pl.BlockSpec((1, 1, block_q, _STAT_LANES),
+                            lambda b, h, i, j: (b, h, i, 0))
+        out_shape += [jax.ShapeDtypeStruct((B, H, Tqp, _STAT_LANES),
+                                           jnp.float32)] * 2
+        out_specs += [stat, stat]
+    single = not return_residuals
+    result = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
+        out_shape=out_shape[0] if single else tuple(out_shape),
         grid=(B, H, nq, nk),
         in_specs=[
-            pl.BlockSpec((1, 1, block_q, D),
-                         lambda b, h, i, j: (b, h, i, 0)),
+            smem,
+            smem,
+            o_spec,
             pl.BlockSpec((1, 1, block_k, D),
                          lambda b, h, i, j: (b, h, j, 0)),
             pl.BlockSpec((1, 1, block_k, D),
                          lambda b, h, i, j: (b, h, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, block_q, D),
-                               lambda b, h, i, j: (b, h, i, 0)),
+        out_specs=out_specs[0] if single else tuple(out_specs),
         scratch_shapes=[
             pltpu.VMEM((block_q, _LANES), jnp.float32),  # running max
             pltpu.VMEM((block_q, _LANES), jnp.float32),  # running denom
             pltpu.VMEM((block_q, D), jnp.float32),       # output accum
         ],
         interpret=interpret,
-    )(qt, kt, vt)
+    )(qo, ko, qt, kt, vt)
+    out = result if single else result[0]
     if pad_q:
         out = out[:, :, :Tq]
-    return jnp.moveaxis(out, 1, 2)
+    out = jnp.moveaxis(out, 1, 2)
+    if not return_residuals:
+        return out
+    m, l = result[1], result[2]
+    return out, m[:, :, :Tq, 0], l[:, :, :Tq, 0]
+
+
+def lse_from_residuals(m, l):
+    """Log-sum-exp per row from the (m, l) residuals; fully-masked rows
+    (l == 0) get +1e30 so the backward recompute ``exp(s - lse)`` is 0."""
+    return jnp.where(l > 0, m + jnp.log(jnp.maximum(l, 1e-37)), -NEG_INF)
+
+
+def _stat_lanes(x, Tqp):
+    """[B, H, Tq] stats -> [B, H, Tqp, _STAT_LANES] blocks for the bwd
+    kernels; padded q rows get lse=+1e30 (=> p == 0, contributing nothing)."""
+    pad = Tqp - x.shape[2]
+    if pad:
+        x = jnp.pad(x, ((0, 0), (0, 0), (0, pad)),
+                    constant_values=-NEG_INF)
+    return jnp.broadcast_to(x[..., None], (*x.shape, _STAT_LANES))
+
+
+def flash_attention_bwd(q, k, v, do, lse, dvec, *, causal: bool,
+                        scale: float, q_offset=0, kv_offset=0,
+                        block_q: int = 128, block_k: int = 128,
+                        interpret=None):
+    """Gradients (dq, dk, dv) in f32 for one (q-shard, kv-shard) pair.
+
+    The flash-attention backward: softmax probabilities are recomputed
+    blockwise from ``lse`` (never materializing [T_q, T_kv]), with
+    ``dvec[b,h,i] = dO_i . O_i`` supplied by the caller (it is a cheap XLA
+    rowsum).  Serves both the single-device VJP and each step of the ring
+    backward in parallel/sequence.py, where the kv shard (and its offset)
+    rotates.
+    """
+    B, Tq, H, D = q.shape
+    Tkv = k.shape[1]
+    block_q = min(block_q, Tq)
+    block_k = min(block_k, Tkv)
+    pad_q = (-Tq) % block_q
+    pad_k = (-Tkv) % block_k
+    qt = jnp.moveaxis(q, 2, 1)
+    dot_ = jnp.moveaxis(do, 2, 1).astype(jnp.float32)
+    kt = jnp.moveaxis(k, 2, 1)
+    vt = jnp.moveaxis(v, 2, 1)
+    if pad_q:
+        qt = jnp.pad(qt, ((0, 0), (0, 0), (0, pad_q), (0, 0)))
+        dot_ = jnp.pad(dot_, ((0, 0), (0, 0), (0, pad_q), (0, 0)))
+    if pad_k:
+        kt = jnp.pad(kt, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+        vt = jnp.pad(vt, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+    Tqp, Tkvp = qt.shape[2], kt.shape[2]
+    nq, nk = Tqp // block_q, Tkvp // block_k
+    lse_l = _stat_lanes(lse, Tqp)
+    # dvec's padding value is irrelevant (padded rows have p == 0, so
+    # ds == p * (dp - dvec) == 0); _stat_lanes' +1e30 never produces nan.
+    d_l = _stat_lanes(dvec, Tqp)
+
+    if interpret is None:
+        from . import ring
+
+        interpret = ring._interpret_mode()
+
+    qo = jnp.asarray(q_offset, jnp.int32).reshape(1)
+    ko = jnp.asarray(kv_offset, jnp.int32).reshape(1)
+    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
+    qb = pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0))
+    kb = pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h, j, 0))
+    sb = pl.BlockSpec((1, 1, block_q, _STAT_LANES),
+                      lambda b, h, i, j: (b, h, i, 0))
+
+    dq_kernel = functools.partial(
+        _flash_bwd_dq_kernel, scale=scale, causal=causal, block_q=block_q,
+        block_k=block_k, kv_len=Tkv)
+    dq = pl.pallas_call(
+        dq_kernel,
+        out_shape=jax.ShapeDtypeStruct(qt.shape, jnp.float32),
+        grid=(B, H, nq, nk),
+        in_specs=[smem, smem, qb, qb, sb, sb, kb, kb],
+        out_specs=qb,
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        interpret=interpret,
+    )(qo, ko, qt, dot_, lse_l, d_l, kt, vt)
+
+    # dkv grid puts the q-block dimension minor; index maps swap i and j
+    # relative to the dq call (grid = (B, H, nk, nq)).
+    kb2 = pl.BlockSpec((1, 1, block_k, D), lambda b, h, j, i: (b, h, j, 0))
+    qb2 = pl.BlockSpec((1, 1, block_q, D), lambda b, h, j, i: (b, h, i, 0))
+    sb2 = pl.BlockSpec((1, 1, block_q, _STAT_LANES),
+                       lambda b, h, j, i: (b, h, i, 0))
+    dkv_kernel = functools.partial(
+        _flash_bwd_dkv_kernel, scale=scale, causal=causal, block_q=block_q,
+        block_k=block_k, kv_len=Tkv)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        out_shape=(jax.ShapeDtypeStruct(kt.shape, jnp.float32),
+                   jax.ShapeDtypeStruct(kt.shape, jnp.float32)),
+        grid=(B, H, nk, nq),
+        in_specs=[smem, smem, kb2, kb2, qb2, qb2, sb2, sb2],
+        out_specs=(kb2, kb2),
+        scratch_shapes=[pltpu.VMEM((block_k, D), jnp.float32),
+                        pltpu.VMEM((block_k, D), jnp.float32)],
+        interpret=interpret,
+    )(qo, ko, kt, vt, qt, dot_, lse_l, d_l)
+
+    if pad_q:
+        dq = dq[:, :, :Tq]
+    if pad_k:
+        dk = dk[:, :, :Tkv]
+        dv = dv[:, :, :Tkv]
+    return (jnp.moveaxis(dq, 1, 2), jnp.moveaxis(dk, 1, 2),
+            jnp.moveaxis(dv, 1, 2))
+
+
+def _float0_zero(x):
+    import numpy as np
+
+    return np.zeros(jnp.shape(x), jax.dtypes.float0)
+
+
+@functools.lru_cache(maxsize=None)
+def _flash_vjp(causal: bool, scale: float, block_q: int, block_k: int,
+               interp_key):
+    """custom_vjp instance per static config.  ``interp_key`` is the
+    resolved interpret setting (hashable: False or InterpretParams)."""
+
+    kw = dict(causal=causal, scale=scale, block_q=block_q, block_k=block_k,
+              interpret=interp_key)
+
+    @jax.custom_vjp
+    def f(q, k, v, qo, ko):
+        return flash_attention(q, k, v, q_offset=qo, kv_offset=ko, **kw)
+
+    def fwd(q, k, v, qo, ko):
+        num, m, l = flash_attention(q, k, v, q_offset=qo, kv_offset=ko,
+                                    return_residuals=True, **kw)
+        denom = jnp.where(l > 0, l, 1.0)
+        o = (num / jnp.moveaxis(denom, 1, 2)[..., None]).astype(q.dtype)
+        return o, (q, k, v, qo, ko, o, lse_from_residuals(m, l))
+
+    def bwd(res, do):
+        q, k, v, qo, ko, o, lse = res
+        dvec = jnp.einsum("bqhd,bqhd->bhq", do.astype(jnp.float32),
+                          o.astype(jnp.float32))
+        dq, dk, dv = flash_attention_bwd(q, k, v, do, lse, dvec,
+                                         q_offset=qo, kv_offset=ko, **kw)
+        return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
+                _float0_zero(qo), _float0_zero(ko))
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+def flash_attention_grad(q, k, v, *, causal: bool = False,
+                         scale: Optional[float] = None, q_offset=0,
+                         kv_offset=0, block_q: int = 128, block_k: int = 128,
+                         interpret=None):
+    """Differentiable flash attention (custom VJP with Pallas backward
+    kernels).  Same forward semantics as :func:`flash_attention`; gradients
+    flow to q/k/v (offsets are integer-like, zero-cotangent).  Pallas has
+    no autodiff rule, so this wrapper is what training code should call —
+    ``TransformerLM(attn_impl="flash")`` routes here."""
+    D = q.shape[-1]
+    if scale is None:
+        scale = 1.0 / (D ** 0.5)
+    if interpret is None:
+        from . import ring
+
+        interpret = ring._interpret_mode()
+    f = _flash_vjp(causal, float(scale), block_q, block_k, interpret)
+    return f(q, k, v, jnp.asarray(q_offset, jnp.int32),
+             jnp.asarray(kv_offset, jnp.int32))

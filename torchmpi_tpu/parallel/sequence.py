@@ -26,11 +26,29 @@ API style of the rest of the library.
 
 from __future__ import annotations
 
+import functools
 from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+
+def _combine(m_run, l_run, o_run, o_b, m_b, l_b):
+    """One step of the cross-block online-softmax rescale: fold block
+    partials (o_b numerator, m_b max, l_b denom) into the running state.
+    Handles -inf (dense blocks) and finite NEG_INF with l_b == 0 (flash
+    blocks) alike: a fully-masked block's weight times its zero l/o
+    contributes nothing, and exp never sees a positive overflow because
+    m_run <= m_new."""
+    m_new = jnp.maximum(m_run, m_b)
+    safe_new = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    c_run = jnp.where(jnp.isfinite(m_run), jnp.exp(m_run - safe_new), 0.0)
+    c_b = jnp.where(jnp.isfinite(m_b), jnp.exp(m_b - safe_new), 0.0)
+    l_run = l_run * c_run + l_b * c_b
+    o_run = (o_run * c_run.transpose(0, 2, 1)[..., None]
+             + o_b * c_b.transpose(0, 2, 1)[..., None])
+    return m_new, l_run, o_run
 
 
 def _attn_block(q, k, v, scale, mask):
@@ -55,7 +73,8 @@ def _attn_block(q, k, v, scale, mask):
 
 
 def ring_attention(q, k, v, axis_name: str, *, causal: bool = False,
-                   scale: Optional[float] = None):
+                   scale: Optional[float] = None, block_impl: str = "dense",
+                   block_q: int = 128, block_k: int = 128):
     """Blockwise ring attention over a sequence-sharded axis.
 
     Shapes (per device): q, k, v — ``[batch, seq_local, heads, head_dim]``,
@@ -67,7 +86,23 @@ def ring_attention(q, k, v, axis_name: str, *, causal: bool = False,
     step, the ring-bandwidth-optimal schedule.  Numerics: one online-softmax
     accumulation across blocks (flash-attention style), exact up to float
     associativity.
+
+    ``block_impl`` selects the per-step local computation: ``"dense"``
+    (XLA einsum — materializes the [T_local, T_local] score block) or
+    ``"flash"`` (the Pallas kernel of ops/flash.py with residual outputs —
+    VMEM-blocked, so per-device memory stays O(block) even for long local
+    shards; the kv owner's global offset rides into the kernel as a traced
+    SMEM scalar).
     """
+    if block_impl == "flash":
+        if scale is None:
+            scale = 1.0 / (q.shape[-1] ** 0.5)
+        axis_key = (axis_name if isinstance(axis_name, str)
+                    else tuple(axis_name))
+        return _ring_flash_vjp(axis_key, causal, float(scale), block_q,
+                               block_k)(q, k, v)
+    if block_impl != "dense":
+        raise ValueError(f"unknown block_impl {block_impl!r}")
     n = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     B, Tq, H, D = q.shape
@@ -91,20 +126,104 @@ def ring_attention(q, k, v, axis_name: str, *, causal: bool = False,
     for step in range(n):  # n is static: unrolled
         kv_owner = lax.rem(my - step + n, n)
         o_b, m_b, l_b = _attn_block(q, k, v, scale, mask_for(kv_owner))
-        m_new = jnp.maximum(m_run, m_b)
-        safe_new = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
-        c_run = jnp.where(jnp.isfinite(m_run), jnp.exp(m_run - safe_new), 0.0)
-        c_b = jnp.where(jnp.isfinite(m_b), jnp.exp(m_b - safe_new), 0.0)
-        l_run = l_run * c_run + l_b * c_b
-        o_run = (o_run * c_run.transpose(0, 2, 1)[..., None]
-                 + o_b * c_b.transpose(0, 2, 1)[..., None])
-        m_run = m_new
+        m_run, l_run, o_run = _combine(m_run, l_run, o_run, o_b, m_b, l_b)
         if step != n - 1:
             k = lax.ppermute(k, axis_name, perm)
             v = lax.ppermute(v, axis_name, perm)
 
     denom = jnp.where(l_run > 0, l_run, 1.0).transpose(0, 2, 1)[..., None]
     return o_run / denom
+
+
+def _ring_flash_forward(q, k, v, axis_name, causal, scale, block_q,
+                        block_k):
+    """Ring forward with Pallas flash blocks; returns (o, lse) with f32
+    softmax statistics (lse feeds the backward's blockwise recompute)."""
+    from ..ops.flash import flash_attention, lse_from_residuals
+
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+
+    m_run = jnp.full((B, H, Tq), -jnp.inf, jnp.float32)
+    l_run = jnp.zeros((B, H, Tq), jnp.float32)
+    o_run = jnp.zeros(q.shape, jnp.float32)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    for step in range(n):  # n is static: unrolled
+        kv_owner = lax.rem(my - step + n, n)
+        o_b, m_b, l_b = flash_attention(
+            q, k, v, causal=causal, scale=scale, q_offset=my * Tq,
+            kv_offset=kv_owner * Tk, block_q=block_q, block_k=block_k,
+            return_residuals=True)
+        m_run, l_run, o_run = _combine(m_run, l_run, o_run, o_b, m_b, l_b)
+        if step != n - 1:
+            k = lax.ppermute(k, axis_name, perm)
+            v = lax.ppermute(v, axis_name, perm)
+
+    denom = jnp.where(l_run > 0, l_run, 1.0).transpose(0, 2, 1)[..., None]
+    o = (o_run / denom).astype(q.dtype)
+    return o, lse_from_residuals(jnp.where(jnp.isfinite(m_run), m_run, 0.0),
+                                 l_run)
+
+
+@functools.lru_cache(maxsize=None)
+def _ring_flash_vjp(axis_name, causal: bool, scale: float, block_q: int,
+                    block_k: int):
+    """Ring attention as one differentiable unit: Pallas kernels in both
+    directions, with the backward running its own ring — (k, v) and the
+    (dk, dv) accumulators rotate together for a full cycle (n ppermutes, so
+    each shard's gradient visits every q owner and arrives back home).
+    Autodiff cannot derive this (no VJP rule for Pallas kernels, and the
+    communication schedule reverses), hence the custom VJP."""
+
+    @jax.custom_vjp
+    def f(q, k, v):
+        return _ring_flash_forward(q, k, v, axis_name, causal, scale,
+                                   block_q, block_k)[0]
+
+    def fwd(q, k, v):
+        o, lse = _ring_flash_forward(q, k, v, axis_name, causal, scale,
+                                     block_q, block_k)
+        return o, (q, k, v, o, lse)
+
+    def bwd(res, do):
+        from ..ops.flash import flash_attention_bwd
+
+        q, k, v, o, lse = res
+        n = lax.axis_size(axis_name)
+        my = lax.axis_index(axis_name)
+        Tq, Tk = q.shape[1], k.shape[1]
+        dvec = jnp.einsum("bqhd,bqhd->bhq", do.astype(jnp.float32),
+                          o.astype(jnp.float32))
+        dq = jnp.zeros(q.shape, jnp.float32)
+        dk_cur = jnp.zeros(k.shape, jnp.float32)
+        dv_cur = jnp.zeros(v.shape, jnp.float32)
+        k_cur, v_cur = k, v
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        for step in range(n):
+            kv_owner = lax.rem(my - step + n, n)
+            dq_c, dk_c, dv_c = flash_attention_bwd(
+                q, k_cur, v_cur, do, lse, dvec, causal=causal, scale=scale,
+                q_offset=my * Tq, kv_offset=kv_owner * Tk, block_q=block_q,
+                block_k=block_k)
+            dq = dq + dq_c
+            dk_cur = dk_cur + dk_c
+            dv_cur = dv_cur + dv_c
+            # The ACCUMULATORS rotate on every step (n total) so each
+            # shard's gradient visits all q owners and lands back on its
+            # owner; k/v themselves are dead after the last use.
+            dk_cur = lax.ppermute(dk_cur, axis_name, perm)
+            dv_cur = lax.ppermute(dv_cur, axis_name, perm)
+            if step != n - 1:
+                k_cur = lax.ppermute(k_cur, axis_name, perm)
+                v_cur = lax.ppermute(v_cur, axis_name, perm)
+        return (dq.astype(q.dtype), dk_cur.astype(k.dtype),
+                dv_cur.astype(v.dtype))
+
+    f.defvjp(fwd, bwd)
+    return f
 
 
 def ulysses_attention(q, k, v, axis_name: str, *, causal: bool = False,
