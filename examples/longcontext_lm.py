@@ -14,6 +14,12 @@ cross-shard attention path (and the causal mask: positions before the
 needle are excluded).
 
 Run: ``python examples/longcontext_lm.py --devices 8``
+
+``--attn ring_flash`` runs each ring step through the Pallas flash kernel
+(ops/flash.py) with its ring-structured backward — the production path for
+long local shards on real TPU.  On a simulated CPU mesh that kernel runs
+under the Pallas interpreter and is far too slow for this example's
+convergence run; use the default ``ring`` (same math, XLA blocks) there.
 """
 
 import common
@@ -24,7 +30,8 @@ def main():
         __doc__,
         seq_len=dict(type=int, default=256),
         vocab=dict(type=int, default=64),
-        attn=dict(type=str, default="ring", choices=["ring", "ulysses"]),
+        attn=dict(type=str, default="ring",
+                  choices=["ring", "ring_flash", "ulysses"]),
         defaults={"steps": 80, "batch_size": 16, "lr": 3e-3},
     )
     import jax

@@ -131,6 +131,24 @@ def test_flash_attention_grad_lowers(flat_runtime):
     assert exp.mlir_module().count("tpu_custom_call") >= 3  # fwd + dq + dkv
 
 
+def test_fused_xent_lowers(flat_runtime):
+    """Fused linear+cross-entropy fwd and bwd kernels lower to Mosaic at
+    LM-head scale (32k tokens x 32k vocab — a [N, V] logits matrix this
+    kernel exists to avoid would be 4 GiB f32)."""
+    from torchmpi_tpu.ops.xent import fused_linear_cross_entropy
+
+    def loss(x, w, labels):
+        return fused_linear_cross_entropy(x, w, labels,
+                                          interpret=False).mean()
+
+    g = jax.jit(jax.value_and_grad(loss, argnums=(0, 1)))
+    x = jax.ShapeDtypeStruct((32768, 1024), jnp.bfloat16)
+    w = jax.ShapeDtypeStruct((1024, 32768), jnp.bfloat16)
+    lab = jax.ShapeDtypeStruct((32768,), jnp.int32)
+    exp = jax.export.export(g, platforms=["tpu"])(x, w, lab)
+    assert exp.mlir_module().count("tpu_custom_call") >= 3  # fwd + dx + dw
+
+
 def test_ring_flash_attention_lowers(flat_runtime):
     """Ring attention with Pallas flash blocks (residual outputs + traced
     SMEM offsets from lax.axis_index) lowers to Mosaic inside shard_map."""

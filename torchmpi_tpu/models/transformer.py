@@ -169,7 +169,8 @@ class TransformerLM(nn.Module):
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, tokens, pos_offset=0):  # tokens: [B, T_local] int32
+    def __call__(self, tokens, pos_offset=0, return_prehead: bool = False):
+        # tokens: [B, T_local] int32
         B, T = tokens.shape
         x = nn.Embed(self.vocab, self.embed, dtype=self.dtype)(tokens)
         pos = pos_offset + jnp.arange(T)
@@ -184,4 +185,12 @@ class TransformerLM(nn.Module):
                       moe_capacity_factor=self.moe_capacity_factor,
                       dtype=self.dtype)(x)
         x = nn.LayerNorm(dtype=jnp.float32)(x)
-        return nn.Dense(self.vocab, dtype=jnp.float32)(x)
+        # Bias-free explicit unembedding (standard for LMs) so callers can
+        # feed (pre-head activations, head matrix) to the fused
+        # linear+cross-entropy kernel (ops/xent.py) and never materialize
+        # [B*T, vocab] logits.
+        head = self.param("head", nn.initializers.lecun_normal(),
+                          (self.embed, self.vocab), jnp.float32)
+        if return_prehead:
+            return x, head
+        return x @ head

@@ -11,3 +11,4 @@ serving the beyond-reference long-context stack.
 
 from . import ring  # noqa: F401  (registers the "pallas" backend)
 from .flash import flash_attention  # noqa: F401
+from .xent import fused_linear_cross_entropy  # noqa: F401
