@@ -111,6 +111,88 @@ def test_checkpoint_async_matches_sync(tmp_path):
         np.testing.assert_array_equal(s[k], a[k])
 
 
+def test_sharded_checkpoint_roundtrip(flat_runtime, tmp_path):
+    """TP-style sharded arrays round-trip shard-by-shard: each device's
+    block is saved once (replicas deduplicated) and restored onto the same
+    sharding without a global host copy."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import torchmpi_tpu as mpi
+    from torchmpi_tpu.utils import checkpoint
+
+    mesh = mpi.world_mesh()
+    sh_col = NamedSharding(mesh, P(None, ("dcn", "ici")))  # column-sharded
+    sh_rep = NamedSharding(mesh, P())                      # replicated
+    w = jnp.arange(4 * 16, dtype=jnp.float32).reshape(4, 16)
+    b = jnp.arange(8, dtype=jnp.float32)
+    tree = {"w": jax.device_put(w, sh_col), "b": jax.device_put(b, sh_rep),
+            "step": np.int32(5)}
+    checkpoint.save_sharded(str(tmp_path), tree, step=2)
+    assert checkpoint.latest_sharded_step(str(tmp_path)) == 2
+
+    template = {"w": jax.ShapeDtypeStruct((4, 16), jnp.float32,
+                                          sharding=sh_col),
+                "b": jax.ShapeDtypeStruct((8,), jnp.float32,
+                                          sharding=sh_rep),
+                "step": jax.ShapeDtypeStruct((), jnp.int32,
+                                             sharding=sh_rep)}
+    out = checkpoint.restore_sharded(str(tmp_path), template)
+    np.testing.assert_array_equal(np.asarray(out["w"]), np.asarray(w))
+    np.testing.assert_array_equal(np.asarray(out["b"]), np.asarray(b))
+    assert int(out["step"]) == 5
+    assert out["w"].sharding.is_equivalent_to(sh_col, 2)
+
+    # Replicated leaves are saved ONCE, not 8x.
+    data = np.load(tmp_path / "shckpt_2_p0.npz")
+    assert sum(1 for k in data.files if k.startswith("b//")) == 1
+    assert sum(1 for k in data.files if k.startswith("w//")) == 8
+
+
+def test_sharded_latest_step_ignores_torn_pair(flat_runtime, tmp_path):
+    """A crash between the npz and json renames must not surface the torn
+    step: latest_sharded_step only counts complete (npz, json) pairs."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import torchmpi_tpu as mpi
+    from torchmpi_tpu.utils import checkpoint
+
+    mesh = mpi.world_mesh()
+    rep = NamedSharding(mesh, P())
+    tree = {"x": jax.device_put(jnp.ones(8), rep)}
+    checkpoint.save_sharded(str(tmp_path), tree, step=1)
+    checkpoint.save_sharded(str(tmp_path), tree, step=2)
+    os.remove(tmp_path / "shckpt_2_p0.json")  # simulate the crash window
+    assert checkpoint.latest_sharded_step(str(tmp_path)) == 1
+    out = checkpoint.restore_sharded(
+        str(tmp_path), {"x": jax.ShapeDtypeStruct((8,), jnp.float32,
+                                                  sharding=rep)})
+    np.testing.assert_array_equal(np.asarray(out["x"]), np.ones(8))
+
+
+def test_sharded_checkpoint_layout_mismatch_raises(flat_runtime, tmp_path):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import torchmpi_tpu as mpi
+    from torchmpi_tpu.utils import checkpoint
+
+    mesh = mpi.world_mesh()
+    sh_col = NamedSharding(mesh, P(None, ("dcn", "ici")))
+    sh_row = NamedSharding(mesh, P(("dcn", "ici"), None))
+    w = jnp.ones((8, 16), jnp.float32)
+    checkpoint.save_sharded(str(tmp_path),
+                            {"w": jax.device_put(w, sh_col)}, step=0)
+    template = {"w": jax.ShapeDtypeStruct((8, 16), jnp.float32,
+                                          sharding=sh_row)}
+    with pytest.raises(ValueError, match="different sharding layout"):
+        checkpoint.restore_sharded(str(tmp_path), template)
+
+
 def test_checkpoint_overlapping_saves(tmp_path):
     """Several steps in flight on the shared FIFO writer; all land."""
     handles = [

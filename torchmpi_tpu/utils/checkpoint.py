@@ -130,19 +130,160 @@ def save_async(directory: str, tree: PyTree, *, step: int = 0,
     return CheckpointHandle((h_data, h_meta), path)
 
 
-def latest_step(directory: str) -> Optional[int]:
+def _latest(directory: str, prefix: str, *, require_meta: bool) -> \
+        Optional[int]:
     if not os.path.isdir(directory):
         return None
+    suffix = f"_p{jax.process_index()}.npz"
     steps = []
-    proc = jax.process_index()
-    suffix = f"_p{proc}.npz"
     for name in os.listdir(directory):
-        if name.startswith("ckpt_") and name.endswith(suffix):
+        if name.startswith(prefix) and name.endswith(suffix):
             try:
-                steps.append(int(name[len("ckpt_"):-len(suffix)]))
+                step = int(name[len(prefix):-len(suffix)])
             except ValueError:
                 continue
+            # A crash between the npz and json renames must not surface a
+            # step that cannot be restored; only count complete pairs when
+            # the restore path needs the metadata.
+            if require_meta and not os.path.exists(
+                    os.path.join(directory, name[:-4] + ".json")):
+                continue
+            steps.append(step)
     return max(steps) if steps else None
+
+
+def latest_step(directory: str) -> Optional[int]:
+    return _latest(directory, "ckpt_", require_meta=False)
+
+
+def _index_meta(index, shape):
+    """Normalize a shard index (tuple of slices) to [[start, stop], ...]."""
+    out = []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = dim if sl.stop is None else int(sl.stop)
+        out.append([start, stop])
+    return out
+
+
+def save_sharded(directory: str, tree: PyTree, *, step: int = 0,
+                 durable: bool = True, wait: bool = True):
+    """Checkpoint SHARDED arrays: each process writes only its addressable
+    shards (deduplicated — replicated copies save once), with per-leaf
+    global shape/dtype and shard extents in the metadata.
+
+    The replicated-tree :func:`save` gathers every leaf to one host copy;
+    once parameters are genuinely sharded (tensor/expert parallelism, or
+    optimizer state sharded over data), that is wrong twice — it
+    materializes the global array and it duplicates bytes across hosts.
+    Here disk bytes ≈ one copy of the global tree split across processes.
+    Files: ``shckpt_<step>_p<proc>.npz`` + ``.json`` via the native async
+    writer; ``wait=False`` returns the in-flight :class:`CheckpointHandle`.
+    """
+    os.makedirs(directory, exist_ok=True)
+    proc = jax.process_index()
+    arrays = {}
+    meta_leaves = {}
+    for key, leaf in _paths(tree):
+        if isinstance(leaf, jax.Array) and hasattr(leaf,
+                                                   "addressable_shards"):
+            shape, dtype = leaf.shape, str(leaf.dtype)
+            shards_meta = []
+            seen = set()
+            for sh in leaf.addressable_shards:
+                extents = tuple(tuple(e) for e in _index_meta(sh.index,
+                                                              shape))
+                if extents in seen:
+                    continue  # replicated copy of the same shard
+                seen.add(extents)
+                name = f"{key}//{len(shards_meta)}"
+                arrays[name] = np.asarray(sh.data)
+                shards_meta.append({"extents": [list(e) for e in extents],
+                                    "name": name})
+            meta_leaves[key] = {"shape": list(shape), "dtype": dtype,
+                                "shards": shards_meta}
+        else:
+            a = np.asarray(leaf)
+            name = f"{key}//0"
+            arrays[name] = a
+            meta_leaves[key] = {
+                "shape": list(a.shape), "dtype": str(a.dtype),
+                "shards": [{"extents": _index_meta(
+                    tuple(slice(None) for _ in a.shape), a.shape),
+                    "name": name}]}
+    buf = _io.BytesIO()
+    np.savez(buf, **arrays)
+    meta = json.dumps({"step": step, "leaves": meta_leaves})
+    w = _writer()
+    path = os.path.join(directory, f"shckpt_{step}_p{proc}.npz")
+    h_data = w.submit(path, buf.getbuffer(), durable=durable)
+    h_meta = w.submit(
+        os.path.join(directory, f"shckpt_{step}_p{proc}.json"),
+        meta.encode(), durable=durable)
+    handle = CheckpointHandle((h_data, h_meta), path)
+    if wait:
+        handle.wait()
+    return handle
+
+
+def latest_sharded_step(directory: str) -> Optional[int]:
+    return _latest(directory, "shckpt_", require_meta=True)
+
+
+def restore_sharded(directory: str, template: PyTree,
+                    *, step: Optional[int] = None) -> PyTree:
+    """Restore into ``template``'s shardings: every leaf of ``template``
+    must carry a sharding (a sharded ``jax.Array`` or a
+    ``jax.ShapeDtypeStruct`` with ``sharding=``); each addressable device
+    gets its shard matched BY EXTENTS from the local process file, so the
+    restore never builds a global host copy.  Restoring onto a different
+    sharding layout than was saved raises (re-shard via the replicated
+    path, or save with the new layout)."""
+    if step is None:
+        step = latest_sharded_step(directory)
+        if step is None:
+            raise FileNotFoundError(f"no sharded checkpoints in {directory}")
+    proc = jax.process_index()
+    data = np.load(os.path.join(directory,
+                                f"shckpt_{step}_p{proc}.npz"))
+    with open(os.path.join(directory,
+                           f"shckpt_{step}_p{proc}.json")) as f:
+        meta = json.load(f)["leaves"]
+
+    keys = [key for key, _ in _paths(template)]
+    missing = [k for k in keys if k not in meta]
+    if missing:
+        raise KeyError(f"checkpoint missing keys: {missing[:5]}...")
+    leaves_out = []
+    for key, leaf in _paths(template):
+        info = meta[key]
+        shape = tuple(info["shape"])
+        dtype = np.dtype(info["dtype"])
+        by_extents = {
+            tuple(tuple(e) for e in s["extents"]): s["name"]
+            for s in info["shards"]}
+        sharding = getattr(leaf, "sharding", None)
+        if sharding is None:
+            raise ValueError(f"template leaf {key!r} has no sharding")
+        idx_map = sharding.addressable_devices_indices_map(shape)
+        per_device = []
+        loaded = {}  # NpzFile re-extracts per access; read each shard once
+        for dev, index in idx_map.items():
+            extents = tuple(tuple(e) for e in _index_meta(index, shape))
+            name = by_extents.get(extents)
+            if name is None:
+                raise ValueError(
+                    f"{key!r}: no saved shard with extents {extents} — "
+                    f"the checkpoint was saved under a different sharding "
+                    f"layout (have {sorted(by_extents)[:3]}...)")
+            if name not in loaded:
+                # np.asarray, not ascontiguousarray: the latter promotes
+                # 0-d scalars to 1-d, which make_array_... rejects.
+                loaded[name] = np.asarray(data[name], dtype=dtype)
+            per_device.append(jax.device_put(loaded[name], dev))
+        leaves_out.append(jax.make_array_from_single_device_arrays(
+            shape, sharding, per_device))
+    return jax.tree.unflatten(jax.tree.structure(template), leaves_out)
 
 
 def restore(directory: str, template: PyTree,
