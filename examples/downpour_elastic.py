@@ -1,0 +1,156 @@
+"""Elastic Downpour: async-PS training that SURVIVES worker loss.
+
+The reference had no elasticity anywhere — an MPI rank failure aborted the
+whole job (SURVEY.md §6.3: "an MPI rank failure aborts the job; no
+elasticity").  That is unavoidable for gang-scheduled SPMD (this rebuild
+keeps that failure model for the collective path, recovering via
+checkpoint-restart), but asynchronous parameter-server training is exactly
+the place failure IS survivable: no worker ever waits on another, so a
+dead worker just stops contributing gradients.
+
+This example proves it end to end: mid-training, a "failing" worker dies
+(simulated crash — it simply stops, pushing nothing more, holding no
+lock); the survivors keep pushing to the shard servers and the model still
+converges.  A monitor thread detects the loss by watching per-worker
+progress counters go stale — the same heartbeat-style detection the PS
+client's ``ping()`` provides for server liveness, applied to workers.
+
+Run: ``python examples/downpour_elastic.py --devices 8 --workers 4``
+"""
+
+import threading
+import time
+
+import common
+
+
+def main():
+    args = common.parse_args(
+        __doc__,
+        workers=dict(type=int, default=4),
+        fetch_every=dict(type=int, default=5),
+        shards=dict(type=int, default=2),
+        die_at=dict(type=int, default=30,
+                    help="step at which worker 0 crashes"),
+        defaults={"steps": 120, "batch_size": 64, "lr": 0.02},
+    )
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import torchmpi_tpu as mpi
+    from torchmpi_tpu.models import LeNet
+    from torchmpi_tpu.utils import data as dutil
+
+    mpi.init()
+    model = LeNet()
+    params0 = model.init(jax.random.PRNGKey(args.seed),
+                         jnp.zeros((1, 28, 28, 1)))
+    ps = mpi.parameterserver.init(params0, num_shards=args.shards)
+
+    def local_loss(p, images, labels):
+        logits = model.apply(p, images)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels).mean()
+
+    grad_fn = jax.jit(jax.value_and_grad(local_loss))
+    devices = jax.devices()[: args.workers]
+    X, Y = dutil.synthetic_mnist(4096, seed=args.seed)
+    progress = [0] * args.workers  # per-worker step counters (heartbeats)
+    losses = [[] for _ in range(args.workers)]
+
+    class SimulatedCrash(Exception):
+        pass
+
+    def worker(widx):
+        dev = devices[widx]
+        with jax.default_device(dev):
+            params = jax.tree.map(jnp.asarray, params0)
+            fetch_handle = None
+            for step, (xb, yb) in enumerate(dutil.batches(
+                    X, Y, args.batch_size, steps=args.steps,
+                    seed=args.seed + widx + 1)):
+                if widx == 0 and step == args.die_at:
+                    raise SimulatedCrash(f"worker 0 dies at step {step}")
+                loss, grads = grad_fn(params, jnp.asarray(xb),
+                                      jnp.asarray(yb))
+                update = jax.tree.map(lambda g: -args.lr * np.asarray(g),
+                                      grads)
+                ps.send(update, rule="add")
+                params = jax.tree.map(lambda p, u: p + u, params,
+                                      jax.tree.map(jnp.asarray, update))
+                losses[widx].append(float(loss))
+                progress[widx] = step + 1
+                if fetch_handle is not None and fetch_handle.done:
+                    params = jax.tree.map(jnp.asarray, fetch_handle.wait())
+                    fetch_handle = None
+                if step % args.fetch_every == 0 and fetch_handle is None:
+                    fetch_handle = ps.receive()
+
+    # Failure detector: a worker whose counter stops advancing while the
+    # job is still running is declared dead (no gang abort — just noted).
+    dead = set()
+    stop_monitor = threading.Event()
+
+    def monitor():
+        last = list(progress)
+        stale = [0] * args.workers
+        while not stop_monitor.is_set():
+            time.sleep(0.25)
+            for w in range(args.workers):
+                advanced = progress[w] != last[w]
+                if advanced and w in dead:
+                    # A stall (e.g. first-step jit compile) is not a crash;
+                    # progress resurrects the worker.
+                    dead.discard(w)
+                    print(f"monitor: worker {w} recovered at step "
+                          f"{progress[w]}")
+                if w in dead:
+                    continue
+                # Warm-up guard: before the first completed step a worker
+                # is compiling, not dead.
+                if (not advanced and 0 < progress[w] < args.steps):
+                    stale[w] += 1
+                    if stale[w] >= 8:  # ~2s without progress
+                        dead.add(w)
+                        print(f"monitor: worker {w} lost at step "
+                              f"{progress[w]} — continuing without it")
+                else:
+                    stale[w] = 0
+                last[w] = progress[w]
+
+    mon = threading.Thread(target=monitor, daemon=True)
+    mon.start()
+    # run_workers propagates exceptions; the simulated crash must not kill
+    # the job, so worker 0's death is caught and recorded instead.
+    crashed = []
+
+    def guarded(widx):
+        try:
+            worker(widx)
+        except SimulatedCrash as e:
+            crashed.append(str(e))
+
+    common.run_workers(guarded, args.workers)
+    stop_monitor.set()
+    mon.join(timeout=5)
+
+    center = jax.tree.map(jnp.asarray, ps.receive().wait())
+    acc = common.evaluate(model, center, X[:1024], Y[:1024])
+    survivors = [w for w in range(args.workers) if w != 0]
+    print(f"crashed: {crashed}")
+    print(f"detected dead: {sorted(dead)}")
+    print(f"survivor steps: {[progress[w] for w in survivors]}")
+    print(f"final accuracy (PS params) {acc:.3f}")
+    ps.shutdown()
+    mpi.stop()
+    assert crashed, "worker 0 should have crashed"
+    assert 0 in dead, "monitor failed to detect the lost worker"
+    assert all(progress[w] == args.steps for w in survivors), \
+        "survivors did not finish"
+    assert acc > 0.9, "elastic downpour did not converge"
+
+
+if __name__ == "__main__":
+    main()

@@ -150,6 +150,65 @@ def test_sharded_checkpoint_roundtrip(flat_runtime, tmp_path):
     assert sum(1 for k in data.files if k.startswith("w//")) == 8
 
 
+def test_checkpoint_bf16_roundtrips(flat_runtime, tmp_path):
+    """npz stores extension dtypes as raw void; both restore paths must
+    reinterpret them back bit-exactly (bf16 is this repo's training
+    dtype)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import torchmpi_tpu as mpi
+    from torchmpi_tpu.utils import checkpoint
+
+    w = jnp.asarray(np.random.RandomState(0).randn(4, 16),
+                    jnp.bfloat16)
+    # replicated path
+    checkpoint.save(str(tmp_path / "rep"), {"w": w}, step=0)
+    out = checkpoint.restore(str(tmp_path / "rep"),
+                             {"w": jnp.zeros((4, 16), jnp.bfloat16)})
+    assert np.asarray(out["w"]).dtype == jnp.bfloat16
+    np.testing.assert_array_equal(
+        np.asarray(out["w"], np.float32), np.asarray(w, np.float32))
+    # sharded path
+    mesh = mpi.world_mesh()
+    sh = NamedSharding(mesh, P(None, ("dcn", "ici")))
+    checkpoint.save_sharded(str(tmp_path / "sh"),
+                            {"w": jax.device_put(w, sh)}, step=0)
+    out = checkpoint.restore_sharded(
+        str(tmp_path / "sh"),
+        {"w": jax.ShapeDtypeStruct((4, 16), jnp.bfloat16, sharding=sh)})
+    assert out["w"].dtype == jnp.bfloat16
+    np.testing.assert_array_equal(
+        np.asarray(out["w"], np.float32), np.asarray(w, np.float32))
+
+
+def test_checkpoint_template_mismatch_raises(flat_runtime, tmp_path):
+    """Shape or dtype drift between checkpoint and template raises instead
+    of silently returning stale-shaped params."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import torchmpi_tpu as mpi
+    from torchmpi_tpu.utils import checkpoint
+
+    checkpoint.save(str(tmp_path / "rep"),
+                    {"w": np.zeros((8,), np.float32)}, step=0)
+    with pytest.raises(ValueError, match="model changed"):
+        checkpoint.restore(str(tmp_path / "rep"),
+                           {"w": np.zeros((16,), np.float32)})
+    mesh = mpi.world_mesh()
+    rep = NamedSharding(mesh, P())
+    checkpoint.save_sharded(
+        str(tmp_path / "sh"),
+        {"w": jax.device_put(jnp.zeros(8), rep)}, step=0)
+    with pytest.raises(ValueError, match="model changed"):
+        checkpoint.restore_sharded(
+            str(tmp_path / "sh"),
+            {"w": jax.ShapeDtypeStruct((8,), jnp.int32, sharding=rep)})
+
+
 def test_sharded_latest_step_ignores_torn_pair(flat_runtime, tmp_path):
     """A crash between the npz and json renames must not surface the torn
     step: latest_sharded_step only counts complete (npz, json) pairs."""

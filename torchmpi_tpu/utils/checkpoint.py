@@ -156,6 +156,31 @@ def latest_step(directory: str) -> Optional[int]:
     return _latest(directory, "ckpt_", require_meta=False)
 
 
+def _undo_void(arr: np.ndarray, dtype) -> np.ndarray:
+    """npz stores extension dtypes (bfloat16 & friends from ml_dtypes) as
+    raw void ('|V2'); reinterpret back.  A cast would raise ('no cast
+    function') — the bits are already right, only the view is lost."""
+    dtype = np.dtype(dtype)
+    if arr.dtype.kind == "V" and arr.dtype.itemsize == dtype.itemsize:
+        return arr.view(dtype)
+    return arr
+
+
+def _check_template(key: str, stored_shape, stored_dtype, leaf) -> None:
+    """A template whose shape/dtype contradicts the checkpoint must raise,
+    not silently return stale-shaped params (resized vocab, dtype
+    migration) that only explode later at trace time."""
+    t_shape = tuple(np.shape(leaf)) if not hasattr(leaf, "shape") \
+        else tuple(leaf.shape)
+    t_dtype = np.dtype(getattr(leaf, "dtype", np.asarray(leaf).dtype))
+    if tuple(stored_shape) != t_shape or np.dtype(stored_dtype) != t_dtype:
+        raise ValueError(
+            f"{key!r}: checkpoint has {tuple(stored_shape)} "
+            f"{np.dtype(stored_dtype)} but template expects {t_shape} "
+            f"{t_dtype} — the model changed since this checkpoint was "
+            f"saved")
+
+
 def _index_meta(index, shape):
     """Normalize a shard index (tuple of slices) to [[start, stop], ...]."""
     out = []
@@ -230,6 +255,13 @@ def latest_sharded_step(directory: str) -> Optional[int]:
     return _latest(directory, "shckpt_", require_meta=True)
 
 
+def _latest_exists(directory: str, step: int) -> bool:
+    proc = jax.process_index()
+    return (os.path.exists(os.path.join(
+        directory, f"shckpt_{step}_p{proc}.npz")) and os.path.exists(
+        os.path.join(directory, f"shckpt_{step}_p{proc}.json")))
+
+
 def restore_sharded(directory: str, template: PyTree,
                     *, step: Optional[int] = None) -> PyTree:
     """Restore into ``template``'s shardings: every leaf of ``template``
@@ -243,6 +275,21 @@ def restore_sharded(directory: str, template: PyTree,
         step = latest_sharded_step(directory)
         if step is None:
             raise FileNotFoundError(f"no sharded checkpoints in {directory}")
+        if jax.process_count() > 1:
+            # Cross-process agreement: a crash can land step N on some
+            # hosts only; restoring mixed steps would silently stitch a
+            # corrupt global array.  Everyone restores the minimum latest.
+            from jax.experimental import multihost_utils
+
+            agreed = int(multihost_utils.process_allgather(
+                np.asarray(step)).min())
+            if agreed != step and _latest_exists(directory, agreed):
+                step = agreed
+            elif agreed != step:
+                raise FileNotFoundError(
+                    f"processes disagree on the latest complete sharded "
+                    f"step (local {step}, global min {agreed}) and step "
+                    f"{agreed} is missing locally")
     proc = jax.process_index()
     data = np.load(os.path.join(directory,
                                 f"shckpt_{step}_p{proc}.npz"))
@@ -259,6 +306,7 @@ def restore_sharded(directory: str, template: PyTree,
         info = meta[key]
         shape = tuple(info["shape"])
         dtype = np.dtype(info["dtype"])
+        _check_template(key, shape, dtype, leaf)
         by_extents = {
             tuple(tuple(e) for e in s["extents"]): s["name"]
             for s in info["shards"]}
@@ -279,7 +327,7 @@ def restore_sharded(directory: str, template: PyTree,
             if name not in loaded:
                 # np.asarray, not ascontiguousarray: the latter promotes
                 # 0-d scalars to 1-d, which make_array_... rejects.
-                loaded[name] = np.asarray(data[name], dtype=dtype)
+                loaded[name] = np.asarray(_undo_void(data[name], dtype))
             per_device.append(jax.device_put(loaded[name], dev))
         leaves_out.append(jax.make_array_from_single_device_arrays(
             shape, sharding, per_device))
@@ -296,10 +344,15 @@ def restore(directory: str, template: PyTree,
     proc = jax.process_index()
     path = os.path.join(directory, f"ckpt_{step}_p{proc}.npz")
     data = np.load(path)
-    keys = [key for key, _ in _paths(template)]
-    missing = [k for k in keys if k not in data]
+    pairs = _paths(template)
+    missing = [k for k, _ in pairs if k not in data]
     if missing:
         raise KeyError(f"checkpoint missing keys: {missing[:5]}...")
-    leaves = [data[k] for k in keys]
+    leaves = []
+    for key, leaf in pairs:
+        t_dtype = np.dtype(getattr(leaf, "dtype", np.asarray(leaf).dtype))
+        stored = _undo_void(data[key], t_dtype)
+        _check_template(key, stored.shape, stored.dtype, leaf)
+        leaves.append(stored)
     treedef = jax.tree.structure(template)
     return jax.tree.unflatten(treedef, leaves)
