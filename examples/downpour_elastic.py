@@ -55,10 +55,12 @@ def main():
             logits, labels).mean()
 
     grad_fn = jax.jit(jax.value_and_grad(local_loss))
-    devices = jax.devices()[: args.workers]
+    # run_workers clamps to the device count; every per-worker structure
+    # must use the clamped count or the final asserts cover ghosts.
+    n_workers = min(args.workers, len(jax.devices()))
+    devices = jax.devices()[:n_workers]
     X, Y = dutil.synthetic_mnist(4096, seed=args.seed)
-    progress = [0] * args.workers  # per-worker step counters (heartbeats)
-    losses = [[] for _ in range(args.workers)]
+    progress = [0] * n_workers  # per-worker step counters (heartbeats)
 
     class SimulatedCrash(Exception):
         pass
@@ -73,14 +75,13 @@ def main():
                     seed=args.seed + widx + 1)):
                 if widx == 0 and step == args.die_at:
                     raise SimulatedCrash(f"worker 0 dies at step {step}")
-                loss, grads = grad_fn(params, jnp.asarray(xb),
-                                      jnp.asarray(yb))
+                _, grads = grad_fn(params, jnp.asarray(xb),
+                                   jnp.asarray(yb))
                 update = jax.tree.map(lambda g: -args.lr * np.asarray(g),
                                       grads)
                 ps.send(update, rule="add")
                 params = jax.tree.map(lambda p, u: p + u, params,
                                       jax.tree.map(jnp.asarray, update))
-                losses[widx].append(float(loss))
                 progress[widx] = step + 1
                 if fetch_handle is not None and fetch_handle.done:
                     params = jax.tree.map(jnp.asarray, fetch_handle.wait())
@@ -95,10 +96,10 @@ def main():
 
     def monitor():
         last = list(progress)
-        stale = [0] * args.workers
+        stale = [0] * n_workers
         while not stop_monitor.is_set():
             time.sleep(0.25)
-            for w in range(args.workers):
+            for w in range(n_workers):
                 advanced = progress[w] != last[w]
                 if advanced and w in dead:
                     # A stall (e.g. first-step jit compile) is not a crash;
@@ -132,13 +133,13 @@ def main():
         except SimulatedCrash as e:
             crashed.append(str(e))
 
-    common.run_workers(guarded, args.workers)
+    common.run_workers(guarded, n_workers)
     stop_monitor.set()
     mon.join(timeout=5)
 
     center = jax.tree.map(jnp.asarray, ps.receive().wait())
     acc = common.evaluate(model, center, X[:1024], Y[:1024])
-    survivors = [w for w in range(args.workers) if w != 0]
+    survivors = [w for w in range(n_workers) if w != 0]
     print(f"crashed: {crashed}")
     print(f"detected dead: {sorted(dead)}")
     print(f"survivor steps: {[progress[w] for w in survivors]}")

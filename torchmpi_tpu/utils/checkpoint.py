@@ -53,7 +53,11 @@ def save(directory: str, tree: PyTree, *, step: int = 0) -> str:
     path = os.path.join(directory, f"ckpt_{step}_p{proc}.npz")
     arrays = {key: np.asarray(leaf) for key, leaf in _paths(tree)}
     np.savez(path, **arrays)
-    meta = {"step": step, "keys": sorted(arrays.keys())}
+    # dtypes recorded because npz erases extension dtypes (bf16 -> '|V2');
+    # restore() needs the true stored dtype to reinterpret and to make the
+    # template-mismatch check meaningful.
+    meta = {"step": step, "keys": sorted(arrays.keys()),
+            "dtypes": {k: str(a.dtype) for k, a in arrays.items()}}
     with open(os.path.join(directory, f"ckpt_{step}_p{proc}.json"),
               "w") as f:
         json.dump(meta, f)
@@ -121,7 +125,9 @@ def save_async(directory: str, tree: PyTree, *, step: int = 0,
     arrays = {key: np.asarray(leaf) for key, leaf in _paths(tree)}
     buf = _io.BytesIO()
     np.savez(buf, **arrays)
-    meta = json.dumps({"step": step, "keys": sorted(arrays.keys())})
+    meta = json.dumps({"step": step, "keys": sorted(arrays.keys()),
+                       "dtypes": {k: str(a.dtype)
+                                  for k, a in arrays.items()}})
     w = _writer()
     h_data = w.submit(path, buf.getbuffer(), durable=durable)
     h_meta = w.submit(
@@ -272,24 +278,33 @@ def restore_sharded(directory: str, template: PyTree,
     sharding layout than was saved raises (re-shard via the replicated
     path, or save with the new layout)."""
     if step is None:
-        step = latest_sharded_step(directory)
-        if step is None:
-            raise FileNotFoundError(f"no sharded checkpoints in {directory}")
+        local = latest_sharded_step(directory)
         if jax.process_count() > 1:
             # Cross-process agreement: a crash can land step N on some
             # hosts only; restoring mixed steps would silently stitch a
             # corrupt global array.  Everyone restores the minimum latest.
+            # The collective runs UNCONDITIONALLY on every process (with a
+            # no-checkpoint sentinel) — raising before it would leave the
+            # other hosts hanging in the allgather.
             from jax.experimental import multihost_utils
 
             agreed = int(multihost_utils.process_allgather(
-                np.asarray(step)).min())
-            if agreed != step and _latest_exists(directory, agreed):
-                step = agreed
-            elif agreed != step:
+                np.asarray(-1 if local is None else local)).min())
+            if agreed < 0:
+                raise FileNotFoundError(
+                    f"no sharded checkpoints in {directory} on at least "
+                    f"one process (local latest: {local})")
+            if agreed != local and not _latest_exists(directory, agreed):
                 raise FileNotFoundError(
                     f"processes disagree on the latest complete sharded "
-                    f"step (local {step}, global min {agreed}) and step "
+                    f"step (local {local}, global min {agreed}) and step "
                     f"{agreed} is missing locally")
+            step = agreed
+        else:
+            if local is None:
+                raise FileNotFoundError(
+                    f"no sharded checkpoints in {directory}")
+            step = local
     proc = jax.process_index()
     data = np.load(os.path.join(directory,
                                 f"shckpt_{step}_p{proc}.npz"))
@@ -344,6 +359,14 @@ def restore(directory: str, template: PyTree,
     proc = jax.process_index()
     path = os.path.join(directory, f"ckpt_{step}_p{proc}.npz")
     data = np.load(path)
+    # Recorded dtypes (see save): the authority for reinterpreting npz's
+    # void-encoded extension dtypes.  Old checkpoints without the record
+    # fall back to the template dtype for the view.
+    dtypes = {}
+    meta_path = path[:-4] + ".json"
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            dtypes = json.load(f).get("dtypes", {})
     pairs = _paths(template)
     missing = [k for k, _ in pairs if k not in data]
     if missing:
@@ -351,7 +374,8 @@ def restore(directory: str, template: PyTree,
     leaves = []
     for key, leaf in pairs:
         t_dtype = np.dtype(getattr(leaf, "dtype", np.asarray(leaf).dtype))
-        stored = _undo_void(data[key], t_dtype)
+        stored = _undo_void(data[key], np.dtype(dtypes[key])
+                            if key in dtypes else t_dtype)
         _check_template(key, stored.shape, stored.dtype, leaf)
         leaves.append(stored)
     treedef = jax.tree.structure(template)

@@ -1,0 +1,109 @@
+"""Torch-dataset bridge: feed torch ``Dataset``/``DataLoader`` pipelines
+into the mesh prefetcher.
+
+The reference's examples consumed Torch datasets on the host and fed
+tensors to the training loop (SURVEY.md §3 C15 — the Lua examples drove
+``nn`` modules from Torch-side batches); a user migrating from it almost
+certainly owns working torch data code.  This module keeps that code: any
+``torch.utils.data.DataLoader`` (or iterable of tensors / dicts / tuples
+of tensors) becomes an iterator of numpy pytrees, optionally staged
+device-resident with the training sharding via
+:func:`~torchmpi_tpu.utils.input_pipeline.prefetch_to_mesh`.
+
+torch is an optional dependency of exactly this module — the rest of the
+package never imports it.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Iterator, Optional
+
+PyTree = Any
+
+
+def _to_numpy(batch):
+    """Recursively convert torch tensors to numpy (zero-copy for CPU
+    tensors); passes numpy arrays and scalars through."""
+    import torch
+
+    if isinstance(batch, torch.Tensor):
+        t = batch.detach()
+        if t.device.type != "cpu":
+            t = t.cpu()
+        return t.numpy()
+    if isinstance(batch, dict):
+        return {k: _to_numpy(v) for k, v in batch.items()}
+    if isinstance(batch, tuple):
+        out = [_to_numpy(v) for v in batch]
+        # namedtuples (torch's default_collate preserves them) construct
+        # from positional fields, not from one iterable.
+        return (type(batch)(*out) if hasattr(batch, "_fields")
+                else tuple(out))
+    if isinstance(batch, list):
+        return [_to_numpy(v) for v in batch]
+    return batch
+
+
+def as_numpy_batches(loader: Iterable) -> Iterator[PyTree]:
+    """Iterate a torch ``DataLoader`` (or any iterable of tensor pytrees)
+    as numpy pytrees."""
+    for batch in loader:
+        yield _to_numpy(batch)
+
+
+def torch_loader_to_mesh(loader: Iterable, mesh, spec, *, depth: int = 2,
+                         specs: Optional[PyTree] = None,
+                         drop_remainder: bool = True) -> Iterator[PyTree]:
+    """Stage a torch ``DataLoader``'s batches onto ``mesh`` with sharding
+    ``spec`` (per-leaf ``specs`` wins), prefetching ``depth`` batches in
+    the background.
+
+    ``drop_remainder`` skips trailing batches whose leading dimension does
+    not divide the mesh size (a ragged final batch cannot shard; the
+    torch-side fix is ``DataLoader(..., drop_last=True)``).
+
+    Usage::
+
+        loader = torch.utils.data.DataLoader(ds, batch_size=64,
+                                             drop_last=True)
+        for xb, yb in torch_loader_to_mesh(loader, mesh,
+                                           P(("dcn", "ici"))):
+            state = step(state, xb, yb)   # device-resident, sharded
+    """
+    import jax
+    import numpy as np
+
+    from .input_pipeline import prefetch_to_mesh
+
+    def dim0_shards(s):
+        """How many ways the leading dim is split under spec ``s`` — the
+        real divisibility requirement (NOT the total device count: a batch
+        sharded over only the 'ici' axis of a 2x4 mesh needs
+        divisibility by 4, not 8)."""
+        if s is None or len(s) == 0 or s[0] is None:
+            return 1
+        names = (s[0],) if isinstance(s[0], str) else tuple(s[0])
+        n = 1
+        for a in names:
+            n *= mesh.shape[a]
+        return n
+
+    def shardable(batch) -> bool:
+        leaves = jax.tree.leaves(batch)
+        if specs is not None:
+            reqs = jax.tree.leaves(jax.tree.map(
+                lambda _, s: dim0_shards(s), batch, specs,
+                is_leaf=lambda x: x is None))
+        else:
+            reqs = [dim0_shards(spec)] * len(leaves)
+        return all(np.ndim(leaf) == 0 or np.shape(leaf)[0] % req == 0
+                   for leaf, req in zip(leaves, reqs))
+
+    def batches():
+        for batch in as_numpy_batches(loader):
+            if drop_remainder and not shardable(batch):
+                continue
+            yield batch
+
+    return prefetch_to_mesh(batches(), mesh, spec, depth=depth,
+                            specs=specs)
