@@ -1,0 +1,183 @@
+"""Knob autotuner: measure, then recommend a ``Config`` for this platform.
+
+The reference shipped hand-tuned constants (chunk sizes, size cutovers for
+stock-vs-custom collectives — SURVEY.md §6.6's chunk/buffer-size setters);
+this harness derives them empirically instead:
+
+1. allreduce backend per size — sweep xla vs pallas (vs hierarchical on
+   multi-slice meshes) and find the measured ``custom_min_bytes`` cutover;
+2. ``chunk_bytes`` — sweep the streaming-ring subchunk size at a
+   gradient-sized payload;
+3. ``gradsync_buckets`` — sweep bucket counts on the ResNet-20 DP step
+   (reuses scaling_bench's sweep at a single mesh size).
+
+Prints one JSON line per measurement plus a final ``recommend`` line that
+can be applied directly::
+
+    rec = json.loads(last_line)["config"]
+    mpi.init(mpi.Config(**rec))
+
+On the CPU-simulated mesh the absolute numbers are meaningless but the
+harness (and its JSON contract) is identical to what runs on a real slice.
+
+Run: ``python benchmarks/autotune.py [--devices 8] [--quick]``
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _time(fn, iters, fence):
+    out = fn()  # compile
+    fence(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn()
+    fence(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    import functools
+    global print
+    print = functools.partial(print, flush=True)
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--devices", type=int, default=0,
+                   help="force N simulated CPU devices")
+    p.add_argument("--dcn", type=int, default=None)
+    p.add_argument("--iters", type=int, default=5)
+    p.add_argument("--quick", action="store_true",
+                   help="tiny sweep (CI smoke)")
+    args = p.parse_args()
+    if args.devices:
+        from torchmpi_tpu.utils.simulation import force_cpu_devices
+
+        force_cpu_devices(args.devices)
+
+    import numpy as np
+
+    import torchmpi_tpu as mpi
+    from torchmpi_tpu.ops import ring
+    from torchmpi_tpu.utils.metrics import fence
+
+    mesh = mpi.init(mpi.Config(dcn_size=args.dcn, custom_min_bytes=0))
+    n = mpi.device_count()
+    is_cpu = list(mesh.devices.flat)[0].platform == "cpu"
+    if is_cpu:
+        from jax.experimental.pallas import tpu as pltpu
+
+        ring.set_interpret(pltpu.InterpretParams())
+
+    rec = {}
+
+    # -- 1. backend cutover ------------------------------------------------
+    sizes = ([1 << 14, 1 << 17] if args.quick
+             else [1 << 14, 1 << 17, 1 << 20, 1 << 24])
+    cutover = None
+    for nbytes in sizes:
+        x = np.random.RandomState(0).rand(n, nbytes // 4).astype(np.float32)
+        times = {}
+        for backend in ("xla", "pallas"):
+            if backend == "pallas" and is_cpu and nbytes > 1 << 14:
+                continue  # interpreter too slow at size
+            try:
+                mpi.collectives.clear_cache()
+                times[backend] = _time(
+                    lambda b=backend: mpi.allreduce(x, backend=b),
+                    args.iters, fence)
+            except Exception as e:  # noqa: BLE001 — record and continue
+                print(json.dumps({"phase": "backend", "bytes": nbytes,
+                                  "backend": backend,
+                                  "error": str(e)[:120]}))
+                continue
+        print(json.dumps({"phase": "backend", "per_rank_bytes": nbytes,
+                          "ms": {k: round(v * 1e3, 3)
+                                 for k, v in times.items()}}))
+        if ("pallas" in times and "xla" in times
+                and times["pallas"] < times["xla"] and cutover is None):
+            cutover = nbytes
+    if cutover is not None:
+        # The selector consults custom_min_bytes only when the configured
+        # backend is custom, and compares it against TOTAL array bytes
+        # (selector.nbytes_of of the full (n, ...) tensor) — so recommend
+        # the custom backend with the cutover scaled to total bytes; the
+        # cutover then routes smaller tensors back to xla.
+        rec["backend"] = "pallas"
+        rec["custom_min_bytes"] = n * cutover
+    else:
+        rec["backend"] = "xla"
+        rec["custom_min_bytes"] = 1 << 62
+
+    # -- 2. chunk_bytes ----------------------------------------------------
+    if not is_cpu:  # streaming ring needs real lowering to mean anything
+        payload = 1 << 26  # 64 MiB: gradient-scale
+        x = np.random.RandomState(1).rand(n, payload // 4).astype(np.float32)
+        best = (None, float("inf"))
+        for cb in (1 << 20, 1 << 22, 1 << 24):
+            mpi.set_config(chunk_bytes=cb, custom_min_bytes=0)
+            try:
+                dt = _time(lambda: mpi.allreduce(x, backend="pallas"),
+                           args.iters, fence)
+            except Exception as e:  # noqa: BLE001
+                print(json.dumps({"phase": "chunk", "chunk_bytes": cb,
+                                  "error": str(e)[:120]}))
+                continue
+            print(json.dumps({"phase": "chunk", "chunk_bytes": cb,
+                              "ms": round(dt * 1e3, 3)}))
+            if dt < best[1]:
+                best = (cb, dt)
+        if best[0] is not None:
+            rec["chunk_bytes"] = best[0]
+
+    # -- 3. gradsync buckets ----------------------------------------------
+    # Sweep under the configuration phases 1-2 actually recommend, not the
+    # leftovers of their last sweep iteration.
+    mpi.set_config(backend=rec["backend"],
+                   custom_min_bytes=rec["custom_min_bytes"],
+                   **({"chunk_bytes": rec["chunk_bytes"]}
+                      if "chunk_bytes" in rec else {}))
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from torchmpi_tpu.models import ResNet20
+
+    model = ResNet20(num_classes=10)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 32, 32, 3)), train=False)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+    tx = optax.sgd(0.1, momentum=0.9)
+    bsz = (2 if args.quick else 8) * n
+    img = np.random.RandomState(2).rand(bsz, 32, 32, 3).astype(np.float32)
+    lab = np.random.RandomState(3).randint(0, 10, bsz).astype(np.int32)
+    best = (1, float("inf"))
+    for nb in ((1, 4) if args.quick else (1, 2, 4, 8, 16)):
+        mpi.set_config(gradsync_buckets=nb)
+        step = mpi.recipes.make_bn_dp_train_step(model, tx, mesh=mesh,
+                                                 donate=False)
+        p2, o2, b2 = mpi.recipes.replicate_bn_state(
+            params, tx.init(params), batch_stats, mesh=mesh)
+
+        def run(p2=p2, o2=o2, b2=b2, step=step):
+            return step(p2, o2, b2, img, lab)[3]
+
+        dt = _time(run, max(2, args.iters // 2), fence)
+        print(json.dumps({"phase": "buckets", "buckets": nb,
+                          "step_ms": round(dt * 1e3, 3)}))
+        if dt < best[1]:
+            best = (nb, dt)
+    rec["gradsync_buckets"] = best[0]
+
+    print(json.dumps({"recommend": True,
+                      "platform": "cpu-sim" if is_cpu else "tpu",
+                      "devices": n, "config": rec}))
+    mpi.stop()
+
+
+if __name__ == "__main__":
+    main()
