@@ -79,10 +79,14 @@ def main():
     sizes = ([1 << 14, 1 << 17] if args.quick
              else [1 << 14, 1 << 17, 1 << 20, 1 << 24])
     cutover = None
+    last_times = {}
     for nbytes in sizes:
         x = np.random.RandomState(0).rand(n, nbytes // 4).astype(np.float32)
         times = {}
-        for backend in ("xla", "pallas"):
+        backends = ["xla", "pallas"]
+        if mesh.shape.get("dcn", 1) > 1:
+            backends.append("hierarchical")  # the multi-slice 2-level path
+        for backend in backends:
             if backend == "pallas" and is_cpu and nbytes > 1 << 14:
                 continue  # interpreter too slow at size
             try:
@@ -101,14 +105,20 @@ def main():
         if ("pallas" in times and "xla" in times
                 and times["pallas"] < times["xla"] and cutover is None):
             cutover = nbytes
-    if cutover is not None:
-        # The selector consults custom_min_bytes only when the configured
-        # backend is custom, and compares it against TOTAL array bytes
-        # (selector.nbytes_of of the full (n, ...) tensor) — so recommend
-        # the custom backend with the cutover scaled to total bytes; the
-        # cutover then routes smaller tensors back to xla.
+        last_times = times
+    if ("hierarchical" in last_times
+            and last_times["hierarchical"] < min(
+                v for k, v in last_times.items() if k != "hierarchical")):
+        # Two-level wins at gradient scale on this multi-slice mesh.
+        rec["backend"] = "hierarchical"
+        rec["custom_min_bytes"] = 1 << 62
+    elif cutover is not None:
+        # The selector compares custom_min_bytes against PER-RANK bytes:
+        # the eager path picks on x[0] (collectives.py `_pick(op, x[0],..)`)
+        # and the in-axis path picks on the local shard — so the measured
+        # per-rank cutover is exactly the right knob value, unscaled.
         rec["backend"] = "pallas"
-        rec["custom_min_bytes"] = n * cutover
+        rec["custom_min_bytes"] = cutover
     else:
         rec["backend"] = "xla"
         rec["custom_min_bytes"] = 1 << 62
