@@ -106,12 +106,15 @@ def main():
                 and times["pallas"] < times["xla"] and cutover is None):
             cutover = nbytes
         last_times = times
-    if ("hierarchical" in last_times
-            and last_times["hierarchical"] < min(
-                v for k, v in last_times.items() if k != "hierarchical")):
+    others = [v for k, v in last_times.items() if k != "hierarchical"]
+    if ("hierarchical" in last_times and others
+            and last_times["hierarchical"] < min(others)):
         # Two-level wins at gradient scale on this multi-slice mesh.
+        # custom_min_bytes must be 0: the selector applies the cutover to
+        # every non-xla config-default backend, so a huge cutover would
+        # silently route everything back to xla.
         rec["backend"] = "hierarchical"
-        rec["custom_min_bytes"] = 1 << 62
+        rec["custom_min_bytes"] = 0
     elif cutover is not None:
         # The selector compares custom_min_bytes against PER-RANK bytes:
         # the eager path picks on x[0] (collectives.py `_pick(op, x[0],..)`)

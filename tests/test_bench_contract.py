@@ -1,6 +1,8 @@
-"""The driver's bench contract: `python bench.py` must print exactly one
-JSON line with metric/value/unit/vs_baseline, whatever the hardware does.
-Exercised via the CPU tiny preset (full code path, seconds not minutes)."""
+"""The driver's bench contract: `python bench.py` prints one JSON record
+per completed stage, and the LAST stdout line must be a complete
+metric/value/unit/vs_baseline record whatever the hardware does (the
+driver records the last line).  Exercised via the CPU tiny preset (full
+code path, seconds not minutes)."""
 
 import json
 import os
@@ -24,8 +26,12 @@ def test_bench_emits_one_json_line():
         capture_output=True, text=True, timeout=480, env=env, cwd=_REPO)
     assert out.returncode == 0, out.stderr[-2000:]
     lines = [l for l in out.stdout.strip().splitlines() if l.strip()]
-    assert len(lines) == 1, out.stdout
-    rec = json.loads(lines[0])
+    assert lines, out.stdout
+    for line in lines:  # every stdout line is a parseable record
+        json.loads(line)
+    rec = json.loads(lines[-1])
     for key in ("metric", "value", "unit", "vs_baseline"):
         assert key in rec, rec
     assert rec["value"] > 0
+    # the last line must be the headline stage, not the probe
+    assert rec["metric"] == "resnet50_dp_train_throughput", rec
