@@ -11,6 +11,7 @@ sequence-parallel attention strategies in ``parallel/sequence.py``:
 - ``attn_impl="ring_flash"`` — ring attention whose per-step local blocks
   run the Pallas flash kernel (long local shards without [T, T] blocks)
 - ``attn_impl="ulysses"`` — all-to-all head-scatter attention over ``seq_axis``
+- ``attn_impl="ulysses_flash"`` — ulysses with Pallas flash local blocks
 
 With ``seq_axis`` set, the model is meant to run inside ``shard_map`` with
 the sequence dimension sharded over that mesh axis; everything except
@@ -62,6 +63,9 @@ class SPAttention(nn.Module):
                                       block_impl="flash")
         elif self.attn_impl == "ulysses":
             o = seqlib.ulysses_attention(q, k, v, self.seq_axis, causal=True)
+        elif self.attn_impl == "ulysses_flash":
+            o = seqlib.ulysses_attention(q, k, v, self.seq_axis, causal=True,
+                                         block_impl="flash")
         else:
             raise ValueError(f"unknown attn_impl {self.attn_impl!r}")
         o = o.astype(self.dtype).reshape(B, T, H * D)

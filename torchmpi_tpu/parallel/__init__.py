@@ -12,6 +12,7 @@ and docs/PARITY.md for the full map.
 
 from . import hierarchical  # noqa: F401  (registers the "hierarchical" backend)
 from . import gradsync  # noqa: F401
+from . import zero  # noqa: F401
 from . import ps  # noqa: F401
 from . import sequence  # noqa: F401
 from . import tensor  # noqa: F401

@@ -205,9 +205,6 @@ def data_parallel_step(
     axes = _all_axes(m)
     repl = P()
     shard = P(axes)
-    if max_inflight is None:
-        platform = list(m.devices.flat)[0].platform
-        max_inflight = 2 if platform == "cpu" else 16
 
     def spec_for(i):
         return shard if i in set(batch_argnums) else repl
@@ -234,6 +231,19 @@ def data_parallel_step(
         return out, token
 
     jitted = jax.jit(wrapped, donate_argnums=tuple(donate_argnums))
+    return throttle_dispatch(jitted, mesh=m, max_inflight=max_inflight)
+
+
+def throttle_dispatch(jitted: Callable, *, mesh: Optional[Mesh] = None,
+                      max_inflight: Optional[int] = None) -> Callable:
+    """Bound the dispatched-but-unfinished step window of a jitted step that
+    returns ``(out, completion_token)`` — see :func:`data_parallel_step` for
+    why (CPU collective-rendezvous starvation; device-memory pressure from
+    donated buffers).  Returns a callable yielding ``out`` only."""
+    if max_inflight is None:
+        m = _default_mesh(mesh)
+        platform = list(m.devices.flat)[0].platform
+        max_inflight = 2 if platform == "cpu" else 16
 
     from collections import deque
 

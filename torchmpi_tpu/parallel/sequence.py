@@ -227,7 +227,8 @@ def _ring_flash_vjp(axis_name, causal: bool, scale: float, block_q: int,
 
 
 def ulysses_attention(q, k, v, axis_name: str, *, causal: bool = False,
-                      scale: Optional[float] = None):
+                      scale: Optional[float] = None,
+                      block_impl: str = "dense"):
     """All-to-all (DeepSpeed-Ulysses style) sequence-parallel attention.
 
     Shapes (per device): ``[batch, seq_local, heads, head_dim]`` with
@@ -235,6 +236,12 @@ def ulysses_attention(q, k, v, axis_name: str, *, causal: bool = False,
     shard for a head shard and back; in between every device runs ordinary
     full-sequence attention on its head subset (XLA's tuned path, MXU
     friendly).
+
+    ``block_impl="flash"`` computes the local full-sequence attention with
+    the Pallas flash kernel (ops/flash.py) instead of the dense path: the
+    [T, T] score matrix never materializes, so the head-sharded middle
+    section scales to sequence lengths the dense path cannot hold —
+    differentiable end to end (the kernel's custom VJP).
     """
     n = lax.axis_size(axis_name)
     B, Tl, H, D = q.shape
@@ -253,6 +260,13 @@ def ulysses_attention(q, k, v, axis_name: str, *, causal: bool = False,
                               tiled=True)
 
     qg, kg, vg = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    if block_impl == "flash":
+        from ..ops.flash import flash_attention_grad
+
+        return heads_to_seq(
+            flash_attention_grad(qg, kg, vg, causal=causal, scale=scale))
+    if block_impl != "dense":
+        raise ValueError(f"unknown block_impl {block_impl!r}")
     T = qg.shape[1]
     mask = None
     if causal:

@@ -1,0 +1,202 @@
+"""ZeRO-1 data parallelism: shard the optimizer state over the DP axes.
+
+Beyond-reference (TorchMPI is replicated-state DP only — SURVEY.md §3.3),
+but it is the natural TPU-native evolution of the same allreduce step: the
+allreduce decomposes into reduce_scatter + shard-local optimizer update +
+all_gather (numerically identical to replicated DP), and the optimizer
+state then only ever exists for each device's 1/n shard — an n-fold cut of
+the largest replicated memory term after the params themselves.  On a
+(dcn, ici) mesh the reduce_scatter/all_gather legs ride the same
+selector-routed collectives as :func:`gradsync.synchronize_gradients`.
+
+Usage, inside a ``shard_map``-based train step (per-device code)::
+
+    opt_state = zero.init(params, tx, axes, mesh=mesh)   # sharded state
+    ...
+    def step(params, opt_state, batch):
+        grads = jax.grad(loss)(params, batch)
+        params, opt_state = zero.update(params, grads, opt_state, tx, axes)
+        ...
+
+or end-to-end via ``recipes.make_bn_dp_train_step(..., zero=True)``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax, shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .. import collectives, runtime
+
+PyTree = Any
+AxisNames = Union[str, Tuple[str, ...]]
+
+
+def _axes_tuple(axis_names: AxisNames) -> Tuple[str, ...]:
+    return ((axis_names,) if isinstance(axis_names, str)
+            else tuple(axis_names))
+
+
+def _axis_size(axes: Tuple[str, ...]) -> Any:
+    n = 1
+    for a in axes:
+        n *= lax.axis_size(a)
+    return n
+
+
+def _axis_index(axes: Tuple[str, ...]):
+    """Linearized device index over ``axes``, row-major in the given order —
+    the same linearization ``lax.psum_scatter`` uses for tile assignment."""
+    idx = lax.axis_index(axes[0])
+    for a in axes[1:]:
+        idx = idx * lax.axis_size(a) + lax.axis_index(a)
+    return idx
+
+
+class _FlatSpec:
+    """Static flatten metadata (shapes/dtypes/padding) for one pytree."""
+
+    def __init__(self, tree: PyTree, n_shards: int):
+        leaves, self.treedef = jax.tree.flatten(tree)
+        self.shapes = [l.shape for l in leaves]
+        self.dtypes = [l.dtype for l in leaves]
+        self.sizes = [int(np.prod(s)) for s in self.shapes]
+        self.total = int(sum(self.sizes))
+        self.dtype = jnp.result_type(*self.dtypes) if leaves else jnp.float32
+        self.padded = max(n_shards, -(-self.total // n_shards) * n_shards)
+        self.shard = self.padded // n_shards
+
+
+def _flatten(tree: PyTree, spec: _FlatSpec) -> jax.Array:
+    leaves = jax.tree.leaves(tree)
+    flat = jnp.concatenate(
+        [l.astype(spec.dtype).reshape(-1) for l in leaves])
+    return jnp.pad(flat, (0, spec.padded - spec.total))
+
+
+def _unflatten(flat: jax.Array, spec: _FlatSpec) -> PyTree:
+    outs, off = [], 0
+    for shape, size, dtype in zip(spec.shapes, spec.sizes, spec.dtypes):
+        outs.append(flat[off:off + size].reshape(shape).astype(dtype))
+        off += size
+    return jax.tree.unflatten(spec.treedef, outs)
+
+
+def _resolve(axis_names: Optional[AxisNames], mesh: Optional[Mesh]
+             ) -> Tuple[Mesh, Tuple[str, ...], int]:
+    m = mesh if mesh is not None else runtime.current_mesh()
+    axes = _axes_tuple(axis_names) if axis_names is not None \
+        else tuple(m.axis_names)
+    n = int(np.prod([m.shape[a] for a in axes]))
+    return m, axes, n
+
+
+def specs_like(state: PyTree, axis_names: AxisNames) -> PyTree:
+    """PartitionSpec tree matching an existing ZeRO state pytree: the ONE
+    definition of which leaves are sharded — per-parameter leaves
+    (ndim >= 1) ``P(axes)``, scalar leaves (step counts) replicated.
+    ``state`` may hold arrays or tracers (``jnp.ndim`` handles both), so
+    step builders can call this on their traced inputs."""
+    axes = _axes_tuple(axis_names)
+    return jax.tree.map(
+        lambda l: P(axes) if jnp.ndim(l) >= 1 else P(), state)
+
+
+def state_specs(params: PyTree, tx: optax.GradientTransformation,
+                axis_names: Optional[AxisNames] = None, *,
+                mesh: Optional[Mesh] = None) -> PyTree:
+    """PartitionSpec tree for the ZeRO-1 optimizer state: per-parameter
+    leaves (ndim >= 1) sharded ``P(axes)``, scalar leaves (step counts)
+    replicated.  Shared by :func:`init` and step builders that thread the
+    state through their own shard_map."""
+    m, axes, n = _resolve(axis_names, mesh)
+    spec = _FlatSpec(params, n)
+    shard_shape = jax.ShapeDtypeStruct((spec.shard,), spec.dtype)
+    state_shapes = jax.eval_shape(tx.init, shard_shape)
+    return specs_like(state_shapes, axes)
+
+
+def init(params: PyTree, tx: optax.GradientTransformation,
+         axis_names: Optional[AxisNames] = None, *,
+         mesh: Optional[Mesh] = None) -> PyTree:
+    """Build the optimizer state for ZeRO-1: state over each device's flat
+    parameter shard, physically sharded across ``axis_names``.
+
+    Runs its own jitted shard_map (init-time convenience, like
+    ``synchronize_parameters``); the result feeds :func:`update` inside the
+    train step.
+    """
+    m, axes, n = _resolve(axis_names, mesh)
+    spec = _FlatSpec(params, n)
+    specs = state_specs(params, tx, axes, mesh=m)
+
+    def body(params):
+        p_shard = lax.dynamic_slice(
+            _flatten(params, spec), (_axis_index(axes) * spec.shard,),
+            (spec.shard,))
+        return tx.init(p_shard)
+
+    return jax.jit(shard_map(
+        body, mesh=m, in_specs=P(), out_specs=specs,
+        check_vma=False))(params)
+
+
+def update(params: PyTree, grads: PyTree, opt_state: PyTree,
+           tx: optax.GradientTransformation,
+           axis_names: Optional[AxisNames] = None, *,
+           op: Optional[str] = None,
+           backend: Optional[str] = None,
+           compress: Optional[str] = None) -> Tuple[PyTree, PyTree]:
+    """One ZeRO-1 step, for use INSIDE a shard_map'd train step.
+
+    reduce_scatter the flat gradients over ``axis_names`` (selector-routed,
+    same backends as :func:`gradsync.synchronize_gradients`), apply ``tx``
+    on the local parameter/state shard, all_gather the updated shards back
+    to the full replicated parameter pytree.  ``op`` defaults like
+    synchronize_gradients: mean when ``config.gradsync_average``;
+    ``compress="bf16"`` (default from ``config.gradsync_compress``) halves
+    the gradient reduce_scatter's wire bytes exactly like the replicated
+    path — the parameter all_gather stays full precision (it IS the new
+    parameters).
+
+    Returns ``(new_params, new_opt_state)`` — numerically identical to
+    allreduce-then-update replicated DP (test_zero.py proves it against
+    both that and the single-device oracle).
+    """
+    if axis_names is None:
+        axis_names = tuple(runtime.current_mesh().axis_names)
+    axes = _axes_tuple(axis_names)
+    cfg = runtime.config() if runtime.is_initialized() else None
+    if op is None:
+        op = "mean" if (cfg is None or cfg.gradsync_average) else "sum"
+    if op not in ("mean", "sum"):
+        raise ValueError(f"zero.update op must be mean|sum, got {op!r}")
+    if compress is None and cfg is not None:
+        compress = cfg.gradsync_compress
+    if compress not in (None, "none", "bf16"):
+        raise ValueError(f"unknown gradient compression {compress!r}")
+
+    n = _axis_size(axes)
+    spec = _FlatSpec(params, int(n))
+    g_flat = _flatten(grads, spec)
+    if compress == "bf16":
+        g_flat = g_flat.astype(jnp.bfloat16)
+    g_shard = collectives.reduce_scatter_in_axis(g_flat, axes,
+                                                 backend=backend)
+    g_shard = g_shard.astype(spec.dtype)
+    if op == "mean":
+        g_shard = g_shard / n
+    p_shard = lax.dynamic_slice(
+        _flatten(params, spec), (_axis_index(axes) * spec.shard,),
+        (spec.shard,))
+    updates, new_state = tx.update(g_shard, opt_state, p_shard)
+    p_shard = optax.apply_updates(p_shard, updates)
+    p_flat = collectives.allgather_in_axis(p_shard, axes,
+                                           backend=backend).reshape(-1)
+    return _unflatten(p_flat, spec), new_state
