@@ -22,6 +22,8 @@ def main():
         num_classes=dict(type=int, default=1000),
         bf16=dict(action="store_true", help="bfloat16 compute"),
         warmup=dict(type=int, default=3),
+        zero=dict(action="store_true",
+                  help="ZeRO-1: shard optimizer state over the mesh"),
     )
     import jax
     import jax.numpy as jnp
@@ -46,15 +48,23 @@ def main():
         jnp.zeros((1, args.image_size, args.image_size, 3)), train=False)
     params, batch_stats = variables["params"], variables["batch_stats"]
     tx = optax.sgd(args.lr, momentum=args.momentum)
-    opt_state = tx.init(params)
     n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
-    print(f"ResNet-50: {n_params/1e6:.1f}M params, dtype {dtype.__name__}")
+    print(f"ResNet-50: {n_params/1e6:.1f}M params, dtype {dtype.__name__}"
+          + (", ZeRO-1 sharded optimizer" if args.zero else ""))
 
     dp_step = mpi.recipes.make_bn_dp_train_step(model, tx, mesh=mesh,
                                                 backend=args.backend,
-                                                n_buckets=args.buckets)
-    params, opt_state, batch_stats = mpi.recipes.replicate_bn_state(
-        params, opt_state, batch_stats, mesh=mesh)
+                                                n_buckets=args.buckets,
+                                                zero=args.zero)
+    if args.zero:
+        from torchmpi_tpu.parallel import zero as zero_lib
+
+        params = mpi.nn.synchronize_parameters(params, mesh=mesh)
+        batch_stats = mpi.nn.synchronize_parameters(batch_stats, mesh=mesh)
+        opt_state = zero_lib.init(params, tx, mesh=mesh)  # sharded, 1/n mem
+    else:
+        params, opt_state, batch_stats = mpi.recipes.replicate_bn_state(
+            params, tx.init(params), batch_stats, mesh=mesh)
 
     X, Y = dutil.synthetic_image_classification(
         max(512, args.batch_size * 2),

@@ -200,3 +200,39 @@ def test_replicate_does_not_alias_template(flat_runtime):
     np.testing.assert_allclose(np.asarray(template), np.arange(16.0))
     rep2 = gradsync.synchronize_parameters({"w": template})
     np.testing.assert_allclose(np.asarray(rep2["w"]), np.arange(16.0))
+
+
+def test_accumulate_gradients_matches_full_batch(flat_runtime):
+    # Microbatched accumulation == full-batch gradient for a mean loss
+    # (MLP, no batch statistics), and composes with the DP sync.
+    import optax
+    from torchmpi_tpu.parallel.gradsync import accumulate_gradients
+
+    rng = np.random.RandomState(0)
+    params = {"w": jnp.asarray(rng.randn(8, 4), jnp.float32),
+              "b": jnp.asarray(rng.randn(4), jnp.float32)}
+    X = jnp.asarray(rng.randn(16, 8), jnp.float32)
+    Y = jnp.asarray(rng.randint(0, 4, size=16), jnp.int32)
+
+    def loss_fn(p, x, y):
+        logits = x @ p["w"] + p["b"]
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, y).mean()
+
+    full_loss, full_g = jax.value_and_grad(loss_fn)(params, X, Y)
+    acc_loss, acc_g = jax.jit(
+        lambda p, x, y: accumulate_gradients(loss_fn, p, x, y, n_accum=4)
+    )(params, X, Y)
+
+    np.testing.assert_allclose(float(acc_loss), float(full_loss),
+                               rtol=1e-6, atol=1e-6)
+    for a, b in zip(jax.tree.leaves(full_g), jax.tree.leaves(acc_g)):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=1e-5, atol=1e-6)
+
+    with pytest.raises(ValueError, match="divisible"):
+        accumulate_gradients(loss_fn, params, X[:15], Y[:15], n_accum=4)
+
+    # n_accum=1 short-circuits to plain value_and_grad.
+    l1, g1 = accumulate_gradients(loss_fn, params, X, Y, n_accum=1)
+    np.testing.assert_allclose(float(l1), float(full_loss), rtol=1e-6)

@@ -10,6 +10,7 @@ from .parallel.gradsync import (  # noqa: F401
     synchronize_parameters,
     resynchronize_parameters_in_axis,
     synchronize_gradients,
+    accumulate_gradients,
     data_parallel_step,
 )
 
@@ -17,5 +18,6 @@ __all__ = [
     "synchronize_parameters",
     "resynchronize_parameters_in_axis",
     "synchronize_gradients",
+    "accumulate_gradients",
     "data_parallel_step",
 ]

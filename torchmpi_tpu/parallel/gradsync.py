@@ -93,6 +93,43 @@ def resynchronize_parameters_in_axis(params: PyTree, axis_names: AxisNames,
 # ---------------------------------------------------------------------------
 
 
+class FlatSpec:
+    """Static flatten metadata for one pytree: leaf shapes/dtypes, the
+    promoted concat dtype, and zero-padding up to a multiple of
+    ``n_shards`` (1 = no padding).  The single definition shared by the
+    bucketed allreduce here and ZeRO's reduce_scatter sharding
+    (parallel/zero.py)."""
+
+    def __init__(self, tree: PyTree, n_shards: int = 1):
+        leaves, self.treedef = jax.tree.flatten(tree)
+        self.shapes = [l.shape for l in leaves]
+        self.dtypes = [l.dtype for l in leaves]
+        self.sizes = [int(np.prod(s)) for s in self.shapes]
+        self.total = int(sum(self.sizes))
+        self.dtype = jnp.result_type(*self.dtypes) if leaves else jnp.float32
+        self.padded = max(n_shards, -(-self.total // n_shards) * n_shards)
+        self.shard = self.padded // n_shards
+
+
+def flatten_tree(tree: PyTree, spec: FlatSpec) -> jax.Array:
+    """Concat all leaves (promoted to ``spec.dtype``) into one padded flat
+    vector.  The tree must be non-empty (FlatSpec.total > 0)."""
+    leaves = jax.tree.leaves(tree)
+    flat = jnp.concatenate(
+        [l.astype(spec.dtype).reshape(-1) for l in leaves])
+    return jnp.pad(flat, (0, spec.padded - spec.total))
+
+
+def unflatten_tree(flat: jax.Array, spec: FlatSpec) -> PyTree:
+    """Inverse of :func:`flatten_tree`: slice, reshape, and cast each leaf
+    back to its original dtype (padding dropped)."""
+    outs, off = [], 0
+    for shape, size, dtype in zip(spec.shapes, spec.sizes, spec.dtypes):
+        outs.append(flat[off:off + size].reshape(shape).astype(dtype))
+        off += size
+    return jax.tree.unflatten(spec.treedef, outs)
+
+
 def _bucketed_allreduce(grads: PyTree, axes: Tuple[str, ...], *, op: str,
                         n_buckets: int, backend: Optional[str]) -> PyTree:
     """Flatten -> concat -> K buckets -> one allreduce each -> unflatten.
@@ -101,14 +138,11 @@ def _bucketed_allreduce(grads: PyTree, axes: Tuple[str, ...], *, op: str,
     independent collectives inside one jit give XLA the freedom to overlap
     them with surrounding compute.
     """
-    leaves, treedef = jax.tree.flatten(grads)
-    if not leaves:
+    if not jax.tree.leaves(grads):
         return grads
-    shapes = [l.shape for l in leaves]
-    sizes = [int(np.prod(s)) for s in shapes]
-    dtype = jnp.result_type(*[l.dtype for l in leaves])
-    flat = jnp.concatenate([l.astype(dtype).reshape(-1) for l in leaves])
-    total = flat.shape[0]
+    spec = FlatSpec(grads)
+    flat = flatten_tree(grads, spec)
+    total = spec.total
     n_buckets = max(1, min(n_buckets, total))
     bounds = np.linspace(0, total, n_buckets + 1).astype(int)
     out_parts = []
@@ -117,12 +151,7 @@ def _bucketed_allreduce(grads: PyTree, axes: Tuple[str, ...], *, op: str,
         out_parts.append(collectives.allreduce_in_axis(
             part, axes, op=op, backend=backend))
     flat_out = jnp.concatenate(out_parts) if n_buckets > 1 else out_parts[0]
-    outs = []
-    off = 0
-    for s, sz, l in zip(shapes, sizes, leaves):
-        outs.append(flat_out[off:off + sz].reshape(s).astype(l.dtype))
-        off += sz
-    return jax.tree.unflatten(treedef, outs)
+    return unflatten_tree(flat_out, spec)
 
 
 def synchronize_gradients(grads: PyTree, axis_names: Optional[AxisNames] = None,
@@ -167,6 +196,49 @@ def synchronize_gradients(grads: PyTree, axis_names: Optional[AxisNames] = None,
     if orig_dtypes is not None:
         out = jax.tree.map(lambda g, d: g.astype(d), out, orig_dtypes)
     return out
+
+
+def accumulate_gradients(loss_fn: Callable, params: PyTree, *batch: Any,
+                         n_accum: int) -> Tuple[Any, PyTree]:
+    """Microbatched gradient accumulation inside jit: split each batch
+    array's leading axis into ``n_accum`` equal microbatches, run
+    ``loss_fn(params, *microbatch) -> scalar loss`` under ``lax.scan``,
+    and return ``(mean_loss, mean_grads)`` — numerically the full-batch
+    gradient (for batch-size-independent losses like means over examples)
+    at 1/n_accum the activation memory.
+
+    The standard lever when the per-chip batch that keeps the MXU busy
+    does not fit in HBM; composes with :func:`synchronize_gradients` /
+    ``zero.update`` exactly like a plain ``value_and_grad`` result.
+    """
+    if n_accum <= 1:
+        loss, grads = jax.value_and_grad(loss_fn)(params, *batch)
+        return loss, grads
+
+    def split(x):
+        lead = x.shape[0]
+        if lead % n_accum != 0:
+            raise ValueError(
+                f"batch leading axis {lead} not divisible by "
+                f"n_accum={n_accum}")
+        return x.reshape(n_accum, lead // n_accum, *x.shape[1:])
+
+    mbs = tuple(jax.tree.map(split, b) for b in batch)
+    zero_g = jax.tree.map(jnp.zeros_like, params)
+    # Carry dtype from the loss itself (f64 under x64, bf16 losses, ...).
+    mb0 = tuple(jax.tree.map(lambda x: x[0], b) for b in mbs)
+    loss_aval = jax.eval_shape(loss_fn, params, *mb0)
+    init_loss = jnp.zeros(loss_aval.shape, loss_aval.dtype)
+
+    def body(carry, mb):
+        loss_sum, g_sum = carry
+        loss, grads = jax.value_and_grad(loss_fn)(params, *mb)
+        return (loss_sum + loss,
+                jax.tree.map(jnp.add, g_sum, grads)), None
+
+    (loss_sum, g_sum), _ = jax.lax.scan(body, (init_loss, zero_g), mbs)
+    inv = 1.0 / n_accum
+    return loss_sum * inv, jax.tree.map(lambda g: g * inv, g_sum)
 
 
 # ---------------------------------------------------------------------------
@@ -223,15 +295,20 @@ def data_parallel_step(
         fn = shard_map(step_fn, mesh=m, in_specs=in_specs,
                        out_specs=repl, check_vma=check_vma)
         out = fn(*args)
-        # Completion token: depends on the step's outputs, never returned to
-        # the caller, hence never donated back in — always safe to block on.
-        leaves = jax.tree.leaves(out)
-        token = (jnp.ravel(leaves[0])[0].astype(jnp.float32)
-                 if leaves else jnp.float32(0))
-        return out, token
+        return out, completion_token(out)
 
     jitted = jax.jit(wrapped, donate_argnums=tuple(donate_argnums))
     return throttle_dispatch(jitted, mesh=m, max_inflight=max_inflight)
+
+
+def completion_token(out: PyTree):
+    """Scalar derived from a step's outputs — depends on them, is never
+    returned to the caller, hence never donated back in: always safe to
+    block on.  Pair with :func:`throttle_dispatch` (step builders return
+    ``(out, completion_token(out))`` from their jitted body)."""
+    leaves = jax.tree.leaves(out)
+    return (jnp.ravel(leaves[0])[0].astype(jnp.float32)
+            if leaves else jnp.float32(0))
 
 
 def throttle_dispatch(jitted: Callable, *, mesh: Optional[Mesh] = None,

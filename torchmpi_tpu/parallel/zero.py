@@ -59,33 +59,11 @@ def _axis_index(axes: Tuple[str, ...]):
     return idx
 
 
-class _FlatSpec:
-    """Static flatten metadata (shapes/dtypes/padding) for one pytree."""
-
-    def __init__(self, tree: PyTree, n_shards: int):
-        leaves, self.treedef = jax.tree.flatten(tree)
-        self.shapes = [l.shape for l in leaves]
-        self.dtypes = [l.dtype for l in leaves]
-        self.sizes = [int(np.prod(s)) for s in self.shapes]
-        self.total = int(sum(self.sizes))
-        self.dtype = jnp.result_type(*self.dtypes) if leaves else jnp.float32
-        self.padded = max(n_shards, -(-self.total // n_shards) * n_shards)
-        self.shard = self.padded // n_shards
-
-
-def _flatten(tree: PyTree, spec: _FlatSpec) -> jax.Array:
-    leaves = jax.tree.leaves(tree)
-    flat = jnp.concatenate(
-        [l.astype(spec.dtype).reshape(-1) for l in leaves])
-    return jnp.pad(flat, (0, spec.padded - spec.total))
-
-
-def _unflatten(flat: jax.Array, spec: _FlatSpec) -> PyTree:
-    outs, off = [], 0
-    for shape, size, dtype in zip(spec.shapes, spec.sizes, spec.dtypes):
-        outs.append(flat[off:off + size].reshape(shape).astype(dtype))
-        off += size
-    return jax.tree.unflatten(spec.treedef, outs)
+# The flatten/pad/unflatten machinery is shared with the bucketed
+# allreduce — one definition in gradsync.
+from .gradsync import (FlatSpec as _FlatSpec,  # noqa: E402
+                       flatten_tree as _flatten,
+                       unflatten_tree as _unflatten)
 
 
 def _resolve(axis_names: Optional[AxisNames], mesh: Optional[Mesh]

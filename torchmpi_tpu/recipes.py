@@ -106,8 +106,7 @@ def make_bn_dp_train_step(
             in_specs=(P(), sspecs, P(), batch_spec, batch_spec),
             out_specs=(P(), sspecs, P(), P()), check_vma=False)
         out = fn(params, opt_state, batch_stats, images, labels)
-        token = jnp.ravel(out[-1])[0].astype(jnp.float32)
-        return out, token
+        return out, _gradsync.completion_token(out)
 
     jitted = jax.jit(wrapped,
                      donate_argnums=(0, 1, 2) if donate else ())
