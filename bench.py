@@ -7,9 +7,13 @@ update) on every visible device — the single-chip number is the denominator
 of BASELINE.md's scaling-efficiency target, and on a multi-chip slice the
 same script measures the scaled throughput directly.
 
-Prints one JSON record per completed stage on stdout (matmul probe first,
-then the headline ResNet-50 stage); the LAST line is always the best
-completed measurement, which is what the driver records:
+Runs a four-stage resilience ladder, cheapest compile first: A matmul
+probe, B TransformerLM train step, C Pallas flash-attention kernel (real
+TPU only), D the headline ResNet-50 train step (the known >900s remote
+compile on the relay, hence last).  Each completed stage prints one JSON
+record; the supervisor re-emits the HIGHEST-PRIORITY completed record
+(ResNet > transformer > flash > matmul) as the final line — which is what
+the driver records — with every stage's value under ``extra.stages``:
   {"metric": ..., "value": N, "unit": "img/s/chip", "vs_baseline": N}
 
 ``vs_baseline`` is measured/1.0 because the upstream repo published no
@@ -41,12 +45,12 @@ def supervised() -> int:
     serial remote-compile service can queue every later compile behind an
     abandoned large one) still produces a measured JSON record.
 
-    The child prints one JSON line per completed stage (cheap matmul probe
-    first, then the full ResNet-50 step), streamed as they happen; on
-    timeout the LAST completed stage is reported instead of a bare 0.0 —
-    a measured matmul TFLOP/s number beats silence when the big compile
-    never returns (round-2 finding: single ops compiled in seconds while
-    the ResNet-50 init compile exceeded 900s on the relay)."""
+    The child runs the stage ladder (module docstring), streaming one JSON
+    line per completed stage; the final stdout line is the
+    highest-priority completed record, annotated with all stage values —
+    on timeout that means a real measured number instead of a bare 0.0
+    (round-2 finding: single ops compiled in seconds while the ResNet-50
+    compile exceeded 900s on the relay, so cheap stages go first)."""
     timeout = int(os.environ.get("TORCHMPI_TPU_BENCH_TIMEOUT", "900"))
     env = dict(os.environ)
     env["TORCHMPI_TPU_BENCH_STAGED"] = "1"
@@ -109,12 +113,25 @@ def supervised() -> int:
         if reason is None and proc.returncode != 0:
             reason = f"bench child exited {proc.returncode}"
     if forwarded:
+        # Final line = the highest-priority completed stage (the headline
+        # training metric beats kernel/probe micro-benchmarks even though
+        # evidence stages may have printed after it), annotated with every
+        # stage's value and any partial-failure context.
+        priority = ["resnet50_dp_train_throughput",
+                    "transformer_lm_train_throughput",
+                    "flash_attention_tflops",
+                    "matmul_bf16_tflops"]
+        by_metric = {r.get("metric"): r for r in forwarded}
+        best = next((by_metric[m] for m in priority if m in by_metric),
+                    forwarded[-1])
+        rec = dict(best)
+        extra = dict(rec.get("extra") or {})
+        extra["stages"] = {r.get("metric"): r.get("value")
+                           for r in forwarded}
+        rec["extra"] = extra
         if reason is not None:
-            # Re-emit the best-so-far record annotated, so the LAST line
-            # carries the partial-failure context.
-            rec = dict(forwarded[-1])
-            rec["note"] = f"partial: later stages failed ({reason})"
-            print(json.dumps(rec), flush=True)
+            rec["note"] = f"partial: some stages failed ({reason})"
+        print(json.dumps(rec), flush=True)
         return 0
     print(json.dumps({
         "metric": "resnet50_dp_train_throughput",
@@ -190,16 +207,140 @@ def main():
                       "stage": "A (matmul probe; ResNet-50 stage pending)"},
         }), flush=True)
 
-    model = ResNet50(dtype=jnp.bfloat16)
-    # Init on the host CPU backend when one is available: removes the init
-    # graph from the device's remote-compile queue (the train step below is
-    # the one compile that matters).
+    # Host CPU backend for model/optimizer init when available: keeps init
+    # graphs off the device's remote-compile queue (the train steps below
+    # are the compiles that matter).
     init_dev = None
     if platform0 != "cpu":
         try:
             init_dev = jax.local_devices(backend="cpu")[0]
         except RuntimeError:
             pass
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    shard = NamedSharding(mesh, P(mesh.axis_names))
+
+    # Stage B: TransformerLM training throughput — a far lighter compile
+    # than ResNet-50's conv stack, so even a slow serial compile service
+    # usually returns a real MODEL-TRAINING number before the big one.
+    if staged:
+        try:
+            Bt = (2 if tiny else 8) * n_dev
+            T = 64 if tiny else 512
+            from torchmpi_tpu.models import TransformerLM
+
+            lm = TransformerLM(vocab=8192, embed=64 if tiny else 512,
+                               depth=2 if tiny else 4, num_heads=8,
+                               head_dim=8 if tiny else 64, max_len=T,
+                               dtype=jnp.bfloat16)
+            tok = np.random.RandomState(2).randint(
+                0, 8192, size=(Bt, T)).astype(np.int32)
+            with jax.default_device(init_dev):
+                lm_vars = lm.init(jax.random.PRNGKey(1), tok[:1])
+            tx_lm = optax.sgd(0.1)
+
+            def lm_step(v, o, tok):
+                def loss_fn(v):
+                    logits = lm.apply(v, tok).astype(jnp.float32)
+                    return optax.softmax_cross_entropy_with_integer_labels(
+                        logits[:, :-1], tok[:, 1:]).mean()
+
+                loss, g = jax.value_and_grad(loss_fn)(v)
+                g = mpi.nn.synchronize_gradients(g, mesh.axis_names)
+                loss = mpi.collectives.allreduce_in_axis(
+                    loss, mesh.axis_names, op="mean")
+                u, o = tx_lm.update(g, o, v)
+                return optax.apply_updates(v, u), o, loss
+
+            lm_jit = mpi.nn.data_parallel_step(lm_step, mesh=mesh,
+                                               batch_argnums=(2,))
+            with jax.default_device(init_dev):
+                lm_opt = tx_lm.init(lm_vars)
+            lm_vars = mpi.nn.synchronize_parameters(lm_vars, mesh=mesh)
+            lm_opt = mpi.nn.synchronize_parameters(lm_opt, mesh=mesh)
+            tok_d = jax.device_put(tok, shard)
+            log(f"stage B: compiling transformer-LM step "
+                f"(B={Bt}, T={T})...")
+            lm_vars, lm_opt, lm_loss = lm_jit(lm_vars, lm_opt, tok_d)
+            fence(lm_loss)
+            steps_b = 3 if tiny else 20
+            t0 = time.perf_counter()
+            for _ in range(steps_b):
+                lm_vars, lm_opt, lm_loss = lm_jit(lm_vars, lm_opt, tok_d)
+            fence(lm_loss)
+            dt_b = time.perf_counter() - t0
+            tok_s_chip = steps_b * Bt * T / dt_b / n_dev
+            log(f"stage B: {tok_s_chip:.0f} tokens/s/chip, "
+                f"loss {float(lm_loss):.3f}")
+            print(json.dumps({
+                "metric": "transformer_lm_train_throughput",
+                "value": round(tok_s_chip, 1),
+                "unit": "tokens/s/chip",
+                "vs_baseline": 1.0,
+                "extra": {"devices": n_dev, "batch": Bt, "seq": T,
+                          "step_ms": round(dt_b / steps_b * 1000, 2),
+                          "dtype": "bfloat16", "platform": platform0,
+                          "stage": "B (ResNet-50 stage pending)"},
+            }), flush=True)
+            del lm_vars, lm_opt
+        except Exception as e:  # noqa: BLE001 — ladder continues
+            log(f"stage B (transformer) failed: {type(e).__name__}: {e}")
+
+    # Stage C (real TPU only): the Pallas flash-attention kernel executing
+    # on hardware — the round-1 verdict's "never executed outside the
+    # interpreter" evidence gap, measured next to XLA's dense attention.
+    if staged and platform0 == "tpu":
+        try:
+            from torchmpi_tpu.ops.flash import flash_attention
+            from torchmpi_tpu.parallel.sequence import reference_attention
+
+            Bf, Tf, Hf, Df = 4, 4096, 8, 128
+            rngf = np.random.RandomState(3)
+            qkv = [jnp.asarray(rngf.randn(Bf, Tf, Hf, Df), jnp.bfloat16)
+                   for _ in range(3)]
+            fl = jax.jit(lambda q, k, v: flash_attention(q, k, v,
+                                                         causal=True))
+            log("stage C: compiling flash attention kernel...")
+            fence(fl(*qkv))
+            iters_d = 10
+            t0 = time.perf_counter()
+            for _ in range(iters_d):
+                out_d = fl(*qkv)
+            fence(out_d)
+            dt_d = (time.perf_counter() - t0) / iters_d
+            fl_tflops = 4.0 * Bf * Hf * Tf * Tf * Df * 0.5 / dt_d / 1e12
+            dense_ms = None
+            try:
+                dn = jax.jit(lambda q, k, v: reference_attention(
+                    q, k, v, causal=True))
+                fence(dn(*qkv))
+                t0 = time.perf_counter()
+                for _ in range(iters_d):
+                    out_n = dn(*qkv)
+                fence(out_n)
+                dense_ms = round((time.perf_counter() - t0) / iters_d * 1e3,
+                                 3)
+            except Exception as e:  # noqa: BLE001 — dense OOMs first
+                log(f"stage C dense comparison failed: {e}")
+            log(f"stage C: flash {dt_d*1e3:.2f} ms ({fl_tflops:.1f} "
+                f"TFLOP/s) vs xla-dense {dense_ms} ms")
+            print(json.dumps({
+                "metric": "flash_attention_tflops",
+                "value": round(fl_tflops, 1),
+                "unit": "TFLOP/s",
+                "vs_baseline": round(fl_tflops / peak, 4),
+                "extra": {"batch": Bf, "seq": Tf, "heads": Hf,
+                          "head_dim": Df, "causal": True,
+                          "dtype": "bfloat16",
+                          "flash_ms": round(dt_d * 1e3, 3),
+                          "xla_dense_ms": dense_ms,
+                          "platform": platform0},
+            }), flush=True)
+        except Exception as e:  # noqa: BLE001 — evidence stage, optional
+            log(f"stage C (flash) failed: {type(e).__name__}: {e}")
+
+    model = ResNet50(dtype=jnp.bfloat16)
     log(f"init ResNet-50 on {init_dev or 'default device'}...")
     with jax.default_device(init_dev):
         variables = model.init(jax.random.PRNGKey(0),
@@ -213,9 +354,6 @@ def main():
         params, opt_state, batch_stats, mesh=mesh)
 
     # Device-resident synthetic batch, sharded over the mesh.
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
-    shard = NamedSharding(mesh, P(mesh.axis_names))
     images = jax.device_put(
         np.random.RandomState(0).rand(batch, IMAGE, IMAGE, 3)
         .astype(np.float32), shard)
@@ -278,6 +416,7 @@ def main():
                   "mfu": mfu, "peak_tflops": peak,
                   "platform": platform},
     }), flush=True)  # flush before any teardown hang can eat the record
+
 
 
 if __name__ == "__main__":
