@@ -38,6 +38,20 @@ def log(*a):
     print(time.strftime("[%H:%M:%S]"), *a, file=sys.stderr, flush=True)
 
 
+def timed(step, iters, fence):
+    """One warm/compile call, then ``iters`` timed dispatches between
+    fences (device->host readback — see module docstring on why
+    block_until_ready alone is not a fence on this relay platform).
+    Returns seconds per iteration."""
+    out = step()
+    fence(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = step()
+    fence(out)
+    return (time.perf_counter() - t0) / iters
+
+
 def supervised() -> int:
     """Run the real benchmark in a child with a hard timeout, so a wedged
     device runtime (observed: the TPU relay can hang all device ops
@@ -187,14 +201,13 @@ def main():
         x = jnp.ones((N, N), jnp.bfloat16)
         mm = jax.jit(lambda a, b: a @ b)
         log("stage A: compiling matmul probe...")
-        fence(mm(x, x))
-        iters = 3 if tiny else 30
-        t0 = time.perf_counter()
-        y = x
-        for _ in range(iters):
-            y = mm(y, x)
-        fence(y)
-        mm_dt = (time.perf_counter() - t0) / iters
+        chain = {"y": x}  # dependent chain so dispatches cannot overlap away
+
+        def mm_step():
+            chain["y"] = mm(chain["y"], x)
+            return chain["y"]
+
+        mm_dt = timed(mm_step, 3 if tiny else 30, fence)
         mm_tflops = 2.0 * N ** 3 / mm_dt / 1e12
         log(f"stage A: {N}x{N} bf16 matmul {mm_dt*1e6:.0f} us, "
             f"{mm_tflops:.1f} TFLOP/s")
@@ -262,15 +275,17 @@ def main():
             tok_d = jax.device_put(tok, shard)
             log(f"stage B: compiling transformer-LM step "
                 f"(B={Bt}, T={T})...")
-            lm_vars, lm_opt, lm_loss = lm_jit(lm_vars, lm_opt, tok_d)
-            fence(lm_loss)
+            lm_state = {"v": lm_vars, "o": lm_opt}
+
+            def lm_step_once():
+                lm_state["v"], lm_state["o"], loss = lm_jit(
+                    lm_state["v"], lm_state["o"], tok_d)
+                return loss
+
             steps_b = 3 if tiny else 20
-            t0 = time.perf_counter()
-            for _ in range(steps_b):
-                lm_vars, lm_opt, lm_loss = lm_jit(lm_vars, lm_opt, tok_d)
-            fence(lm_loss)
-            dt_b = time.perf_counter() - t0
-            tok_s_chip = steps_b * Bt * T / dt_b / n_dev
+            dt_step = timed(lm_step_once, steps_b, fence)
+            lm_loss = lm_step_once()
+            tok_s_chip = Bt * T / dt_step / n_dev
             log(f"stage B: {tok_s_chip:.0f} tokens/s/chip, "
                 f"loss {float(lm_loss):.3f}")
             print(json.dumps({
@@ -279,11 +294,11 @@ def main():
                 "unit": "tokens/s/chip",
                 "vs_baseline": 1.0,
                 "extra": {"devices": n_dev, "batch": Bt, "seq": T,
-                          "step_ms": round(dt_b / steps_b * 1000, 2),
+                          "step_ms": round(dt_step * 1000, 2),
                           "dtype": "bfloat16", "platform": platform0,
                           "stage": "B (ResNet-50 stage pending)"},
             }), flush=True)
-            del lm_vars, lm_opt
+            del lm_vars, lm_opt, lm_state  # free HBM before later stages
         except Exception as e:  # noqa: BLE001 — ladder continues
             log(f"stage B (transformer) failed: {type(e).__name__}: {e}")
 
@@ -302,25 +317,15 @@ def main():
             fl = jax.jit(lambda q, k, v: flash_attention(q, k, v,
                                                          causal=True))
             log("stage C: compiling flash attention kernel...")
-            fence(fl(*qkv))
             iters_d = 10
-            t0 = time.perf_counter()
-            for _ in range(iters_d):
-                out_d = fl(*qkv)
-            fence(out_d)
-            dt_d = (time.perf_counter() - t0) / iters_d
+            dt_d = timed(lambda: fl(*qkv), iters_d, fence)
             fl_tflops = 4.0 * Bf * Hf * Tf * Tf * Df * 0.5 / dt_d / 1e12
             dense_ms = None
             try:
                 dn = jax.jit(lambda q, k, v: reference_attention(
                     q, k, v, causal=True))
-                fence(dn(*qkv))
-                t0 = time.perf_counter()
-                for _ in range(iters_d):
-                    out_n = dn(*qkv)
-                fence(out_n)
-                dense_ms = round((time.perf_counter() - t0) / iters_d * 1e3,
-                                 3)
+                dense_ms = round(timed(lambda: dn(*qkv), iters_d, fence)
+                                 * 1e3, 3)
             except Exception as e:  # noqa: BLE001 — dense OOMs first
                 log(f"stage C dense comparison failed: {e}")
             log(f"stage C: flash {dt_d*1e3:.2f} ms ({fl_tflops:.1f} "
@@ -337,6 +342,7 @@ def main():
                           "xla_dense_ms": dense_ms,
                           "platform": platform0},
             }), flush=True)
+            del qkv  # ~100 MiB of HBM back before the ResNet stage
         except Exception as e:  # noqa: BLE001 — evidence stage, optional
             log(f"stage C (flash) failed: {type(e).__name__}: {e}")
 

@@ -136,3 +136,18 @@ def test_recipes_remat_matches(flat_runtime):
     for a, b in zip(jax.tree.leaves(outs[0][0]), jax.tree.leaves(outs[1][0])):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5,
                                    atol=1e-6)
+
+
+def test_deep_resnet_variants_shapes():
+    # ResNet-101/152 via eval_shape (no real init — depth makes CPU init
+    # slow); parameter counts match the canonical architectures.
+    from torchmpi_tpu.models import ResNet101, ResNet152
+
+    for ctor, expect_m in ((ResNet101, 44.5), (ResNet152, 60.2)):
+        model = ctor()
+        variables = jax.eval_shape(
+            lambda m=model: m.init(jax.random.PRNGKey(0),
+                                   jnp.zeros((1, 64, 64, 3)), train=False))
+        n = sum(int(np.prod(l.shape))
+                for l in jax.tree.leaves(variables["params"]))
+        assert abs(n / 1e6 - expect_m) < 0.5, (ctor.__name__, n)

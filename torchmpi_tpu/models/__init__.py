@@ -9,6 +9,8 @@ from .resnet import (  # noqa: F401
     ResNet18,
     ResNet20,
     ResNet50,
+    ResNet101,
+    ResNet152,
     BasicBlock,
     BottleneckBlock,
 )

@@ -130,3 +130,16 @@ def ResNet50(num_classes: int = 1000, dtype=jnp.float32) -> ResNet:
     (BASELINE.md: >=90% scaling efficiency on v5e-64)."""
     return ResNet(stage_sizes=[3, 4, 6, 3], block_cls=BottleneckBlock,
                   num_classes=num_classes, dtype=dtype)
+
+
+def ResNet101(num_classes: int = 1000, dtype=jnp.float32) -> ResNet:
+    """ImageNet ResNet-101: [3, 4, 23, 3] bottlenecks (fb.resnet.torch's
+    deeper preset)."""
+    return ResNet(stage_sizes=[3, 4, 23, 3], block_cls=BottleneckBlock,
+                  num_classes=num_classes, dtype=dtype)
+
+
+def ResNet152(num_classes: int = 1000, dtype=jnp.float32) -> ResNet:
+    """ImageNet ResNet-152: [3, 8, 36, 3] bottlenecks."""
+    return ResNet(stage_sizes=[3, 8, 36, 3], block_cls=BottleneckBlock,
+                  num_classes=num_classes, dtype=dtype)
