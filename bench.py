@@ -280,11 +280,12 @@ def main():
             def lm_step_once():
                 lm_state["v"], lm_state["o"], loss = lm_jit(
                     lm_state["v"], lm_state["o"], tok_d)
+                lm_state["loss"] = loss  # from the last executed step
                 return loss
 
             steps_b = 3 if tiny else 20
             dt_step = timed(lm_step_once, steps_b, fence)
-            lm_loss = lm_step_once()
+            lm_loss = lm_state["loss"]
             tok_s_chip = Bt * T / dt_step / n_dev
             log(f"stage B: {tok_s_chip:.0f} tokens/s/chip, "
                 f"loss {float(lm_loss):.3f}")

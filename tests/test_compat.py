@@ -49,3 +49,16 @@ def test_nn_namespace(started):
     params = {"w": np.ones((3, 3), np.float32)}
     rep = mpi.nn.synchronizeParameters(params)
     assert rep["w"].sharding.is_fully_replicated
+
+
+def test_torch_tensor_inputs(started):
+    # A migrating TorchMPI user's tensors ARE torch tensors: the eager
+    # verbs accept CPU torch.Tensor via __array__ (docs/MIGRATION.md) and
+    # return jax arrays.
+    torch = pytest.importorskip("torch")
+    t = torch.stack([torch.full((6,), float(r)) for r in range(8)])
+    out = mpi.allreduceTensor(t)
+    np.testing.assert_allclose(np.asarray(out)[0],
+                               t.sum(dim=0).numpy())
+    out_b = mpi.broadcastTensor(t, root=3)
+    np.testing.assert_allclose(np.asarray(out_b)[0], t[3].numpy())

@@ -47,20 +47,30 @@ def _paths(tree: PyTree):
 def save(directory: str, tree: PyTree, *, step: int = 0) -> str:
     """Write a checkpoint; returns the file path.  Multi-host: every process
     writes ``ckpt_<step>_p<proc>.npz`` (replicated trees: identical files,
-    restore reads the local one)."""
+    restore reads the local one).
+
+    Writes are tmp+atomic-rename (matching the async writer), so a crash
+    mid-save can never surface a truncated npz as the latest step — the
+    invariant the checkpoint-restart driver (utils/restart.py) leans on."""
     os.makedirs(directory, exist_ok=True)
     proc = jax.process_index()
     path = os.path.join(directory, f"ckpt_{step}_p{proc}.npz")
     arrays = {key: np.asarray(leaf) for key, leaf in _paths(tree)}
-    np.savez(path, **arrays)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **arrays)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
     # dtypes recorded because npz erases extension dtypes (bf16 -> '|V2');
     # restore() needs the true stored dtype to reinterpret and to make the
     # template-mismatch check meaningful.
     meta = {"step": step, "keys": sorted(arrays.keys()),
             "dtypes": {k: str(a.dtype) for k, a in arrays.items()}}
-    with open(os.path.join(directory, f"ckpt_{step}_p{proc}.json"),
-              "w") as f:
+    meta_path = os.path.join(directory, f"ckpt_{step}_p{proc}.json")
+    with open(meta_path + ".tmp", "w") as f:
         json.dump(meta, f)
+    os.replace(meta_path + ".tmp", meta_path)
     return path
 
 
@@ -136,10 +146,9 @@ def save_async(directory: str, tree: PyTree, *, step: int = 0,
     return CheckpointHandle((h_data, h_meta), path)
 
 
-def _latest(directory: str, prefix: str, *, require_meta: bool) -> \
-        Optional[int]:
+def _steps(directory: str, prefix: str, *, require_meta: bool) -> list:
     if not os.path.isdir(directory):
-        return None
+        return []
     suffix = f"_p{jax.process_index()}.npz"
     steps = []
     for name in os.listdir(directory):
@@ -155,11 +164,22 @@ def _latest(directory: str, prefix: str, *, require_meta: bool) -> \
                     os.path.join(directory, name[:-4] + ".json")):
                 continue
             steps.append(step)
-    return max(steps) if steps else None
+    return sorted(steps)
+
+
+def _latest(directory: str, prefix: str, *, require_meta: bool) -> \
+        Optional[int]:
+    steps = _steps(directory, prefix, require_meta=require_meta)
+    return steps[-1] if steps else None
 
 
 def latest_step(directory: str) -> Optional[int]:
     return _latest(directory, "ckpt_", require_meta=False)
+
+
+def available_steps(directory: str) -> list:
+    """All restorable steps for this process, ascending."""
+    return _steps(directory, "ckpt_", require_meta=False)
 
 
 def _undo_void(arr: np.ndarray, dtype) -> np.ndarray:

@@ -1,0 +1,91 @@
+"""Checkpoint-restart training driver.
+
+The reference had NO elasticity: an MPI rank failure aborted the job
+(SURVEY.md §6.3).  The rebuild keeps that gang-scheduled model for the SPMD
+side by design — a slice fails as a unit — so recovery is
+checkpoint-restart, and this module makes the restart loop a library
+primitive instead of an ops runbook: run a step function with periodic
+checkpoints, and on a crash restore the latest checkpoint and keep going
+(replaying the few steps since the last save — exact for deterministic
+steps, the SPMD common case).
+
+Complements the PS side's live elasticity (heartbeats + worker loss,
+``examples/downpour_elastic.py``), which is where surviving failure
+WITHOUT a restart is actually possible.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from . import checkpoint
+
+PyTree = Any
+
+
+def run_with_restarts(
+    init_fn: Callable[[], PyTree],
+    step_fn: Callable[[PyTree, int], PyTree],
+    *,
+    steps: int,
+    directory: str,
+    save_every: int = 10,
+    max_restarts: int = 3,
+    on_restart: Optional[Callable[[int, BaseException], None]] = None,
+) -> Tuple[PyTree, Dict[str, int]]:
+    """Run ``steps`` calls of ``step_fn(state, i) -> state`` with
+    checkpoint-restart recovery.
+
+    ``init_fn()`` builds the initial state (and the restore template).  A
+    checkpoint is written every ``save_every`` completed steps and at the
+    end.  If ``step_fn`` raises, the latest checkpoint is restored and
+    training resumes from the step after it — up to ``max_restarts`` times,
+    after which the last exception propagates.  An existing checkpoint in
+    ``directory`` is picked up on entry, so re-running the whole PROCESS
+    after a fatal crash also resumes (process-level restart, the
+    gang-scheduled recovery path).
+
+    Returns ``(final_state, info)`` with ``info = {"restarts": r,
+    "steps_run": n}`` (``steps_run`` counts executed step calls including
+    replays).
+    """
+    if steps < 0:
+        raise ValueError(f"steps must be >= 0, got {steps}")
+    template = init_fn()
+
+    def recover():
+        """Restore the newest restorable checkpoint, walking backwards
+        past unreadable ones (atomic saves make those rare, but an older
+        good step must win over a bad newer file — never a hard stop).
+        Returns (state, next_step)."""
+        for step in reversed(checkpoint.available_steps(directory)):
+            if step <= 0:
+                break
+            try:
+                return checkpoint.restore(directory, template,
+                                          step=step), step
+            except Exception:  # noqa: BLE001 — fall back to older
+                continue
+        return init_fn(), 0
+
+    state, i = recover()
+    restarts = 0
+    steps_run = 0
+    while i < steps:
+        try:
+            state = step_fn(state, i)
+            steps_run += 1
+            i += 1
+            if i % save_every == 0 or i == steps:
+                checkpoint.save(directory, state, step=i)
+        except KeyboardInterrupt:
+            raise
+        except BaseException as e:  # noqa: BLE001 — the restart loop IS
+            # the handler: restore-and-replay or re-raise after budget.
+            restarts += 1
+            if on_restart is not None:
+                on_restart(restarts, e)
+            if restarts > max_restarts:
+                raise
+            state, i = recover()
+    return state, {"restarts": restarts, "steps_run": steps_run}
