@@ -26,6 +26,8 @@ def main():
     p.add_argument("--seq-len", type=int, default=32)
     p.add_argument("--vocab", type=int, default=64)
     p.add_argument("--lr", type=float, default=3e-3)
+    p.add_argument("--top-k", type=int, default=1,
+                   help="experts per token (1=Switch, 2=GShard combine)")
     p.add_argument("--seed", type=int, default=0)
     args = p.parse_args()
     if args.devices:
@@ -54,7 +56,7 @@ def main():
 
     model = TransformerLM(vocab=args.vocab, embed=64, depth=2, num_heads=4,
                           head_dim=16, max_len=T, moe_axis=mpi.ICI_AXIS,
-                          moe_experts_per_device=1)
+                          moe_experts_per_device=1, moe_k=args.top_k)
 
     # Learnable synthetic task: next token = (token * 3 + 1) mod vocab.
     def make_batch(rng):

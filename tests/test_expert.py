@@ -181,3 +181,97 @@ def test_moe_transformer_matches_single_device(flat_runtime):
                               tokens[d:d + 1])
         np.testing.assert_allclose(got[d:d + 1], np.asarray(ref),
                                    rtol=3e-4, atol=3e-4)
+
+
+def _oracle_topk(gate_w, W, X, k, capacity_factor=2.0):
+    """Per-source-device top-k routing oracle: routes fill capacity in
+    token-major, rank-minor order; combine weights renormalized over the
+    selected experts."""
+    n_dev, T_, D_ = X.shape
+    E = W.shape[0]
+    capacity = max(1, int(capacity_factor * T_ * k / E))
+    out = np.zeros_like(X)
+    for d in range(n_dev):
+        probs = np.asarray(jax.nn.softmax(jnp.asarray(X[d] @ gate_w), -1))
+        # match lax.top_k ordering (descending, ties by lower index)
+        topk_e = np.asarray(
+            jax.lax.top_k(jnp.asarray(probs), k)[1])
+        counts = {}
+        for t in range(T_):
+            sel_p = probs[t, topk_e[t]]
+            wsum = max(sel_p.sum(), 1e-9)
+            for j in range(k):
+                e = int(topk_e[t, j])
+                slot = counts.get(e, 0)
+                counts[e] = slot + 1
+                if slot < capacity:
+                    y = np.tanh(X[d, t] @ W[e])
+                    out[d, t] += y * (sel_p[j] / wsum)
+    return out
+
+
+@pytest.mark.parametrize("capacity_factor", [2.0, 0.5])
+def test_moe_top2_matches_oracle(flat_runtime, capacity_factor):
+    mesh = mpi.world_mesh()
+    n_dev = 8
+    gate_w, W, X = _setup(n_dev, seed=3)
+    expect = _oracle_topk(gate_w, W, X, k=2,
+                          capacity_factor=capacity_factor)
+
+    def body(xd, gw, Wl):
+        out = ep.moe_layer(xd[0], gw, _expert_fn, Wl,
+                           ("dcn", "ici"),
+                           capacity_factor=capacity_factor, k=2)
+        return out[None]
+
+    spec_x = P(("dcn", "ici"))
+    got = jax.jit(shard_map(
+        body, mesh=mesh, in_specs=(spec_x, P(), spec_x),
+        out_specs=spec_x, check_vma=False))(
+        jax.device_put(X, NamedSharding(mesh, spec_x)),
+        gate_w,
+        jax.device_put(W, NamedSharding(mesh, spec_x)))
+    np.testing.assert_allclose(np.asarray(got), expect, rtol=2e-5,
+                               atol=2e-5)
+
+
+def test_moe_top2_grad_flows(flat_runtime):
+    mesh = mpi.world_mesh()
+    n_dev = 8
+    gate_w, W, X = _setup(n_dev, seed=4)
+
+    def body(xd, gw, Wl):
+        out = ep.moe_layer(xd[0], gw, _expert_fn, Wl,
+                           ("dcn", "ici"), k=2)
+        from jax import lax as jlax
+        return jlax.pmean(jnp.sum(out ** 2), ("dcn", "ici"))
+
+    spec_x = P(("dcn", "ici"))
+
+    def loss(X, gw, W):
+        return jax.jit(shard_map(
+            body, mesh=mesh, in_specs=(spec_x, P(), spec_x),
+            out_specs=P(), check_vma=False))(X, gw, W)
+
+    g = jax.grad(loss, argnums=(1,))(
+        jax.device_put(X, NamedSharding(mesh, spec_x)), gate_w,
+        jax.device_put(W, NamedSharding(mesh, spec_x)))[0]
+    assert np.isfinite(np.asarray(g)).all()
+    assert float(np.abs(np.asarray(g)).sum()) > 0  # gate receives gradient
+
+
+def test_moe_rejects_k_zero(flat_runtime):
+    mesh = mpi.world_mesh()
+    gate_w, W, X = _setup(8)
+
+    def body(xd, gw, Wl):
+        return ep.moe_layer(xd[0], gw, _expert_fn, Wl, ("dcn", "ici"),
+                            k=0)[None]
+
+    spec_x = P(("dcn", "ici"))
+    with pytest.raises(ValueError, match="k >= 1"):
+        jax.jit(shard_map(
+            body, mesh=mesh, in_specs=(spec_x, P(), spec_x),
+            out_specs=spec_x, check_vma=False))(
+            jax.device_put(X, NamedSharding(mesh, spec_x)), gate_w,
+            jax.device_put(W, NamedSharding(mesh, spec_x)))

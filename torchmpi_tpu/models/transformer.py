@@ -91,6 +91,7 @@ class MoEMLP(nn.Module):
     mlp_ratio: int = 4
     expert_axis: Optional[AxisNames] = None
     capacity_factor: float = 2.0
+    k: int = 1
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
@@ -122,7 +123,8 @@ class MoEMLP(nn.Module):
         tokens = x.reshape(B * T, E)
         out = eplib.moe_layer(tokens, gate_w, expert_fn,
                               (w1_local, w2_local), self.expert_axis,
-                              capacity_factor=self.capacity_factor)
+                              capacity_factor=self.capacity_factor,
+                              k=self.k)
         return out.reshape(B, T, E).astype(self.dtype)
 
 
@@ -136,6 +138,7 @@ class Block(nn.Module):
     moe_axis: Optional[AxisNames] = None
     moe_experts_per_device: int = 1
     moe_capacity_factor: float = 2.0
+    moe_k: int = 1
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
@@ -149,7 +152,7 @@ class Block(nn.Module):
             return x + MoEMLP(self.moe_experts_per_device, self.mlp_ratio,
                               self.moe_axis,
                               capacity_factor=self.moe_capacity_factor,
-                              dtype=self.dtype)(h)
+                              k=self.moe_k, dtype=self.dtype)(h)
         h = nn.Dense(E * self.mlp_ratio, dtype=self.dtype)(h)
         h = nn.gelu(h)
         return x + nn.Dense(E, dtype=self.dtype)(h)
@@ -170,6 +173,7 @@ class TransformerLM(nn.Module):
     moe_axis: Optional[AxisNames] = None
     moe_experts_per_device: int = 1
     moe_capacity_factor: float = 2.0
+    moe_k: int = 1
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
@@ -187,7 +191,7 @@ class TransformerLM(nn.Module):
                       moe_axis=self.moe_axis,
                       moe_experts_per_device=self.moe_experts_per_device,
                       moe_capacity_factor=self.moe_capacity_factor,
-                      dtype=self.dtype)(x)
+                      moe_k=self.moe_k, dtype=self.dtype)(x)
         x = nn.LayerNorm(dtype=jnp.float32)(x)
         # Bias-free explicit unembedding (standard for LMs) so callers can
         # feed (pre-head activations, head matrix) to the fused

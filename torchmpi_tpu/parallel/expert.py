@@ -2,7 +2,8 @@
 
 Not in the reference (SURVEY.md §3.3: EP out of its scope, like TP/PP/SP);
 this completes the parallelism-strategy set on the same communicator tree.
-Minimal, correct, capacity-based top-1 MoE:
+Minimal, correct, capacity-based top-k MoE (k=1 Switch-style combine,
+k>=2 GShard-style renormalized combine):
 
 - every device holds ``experts_per_device`` experts (the expert dimension is
   sharded over ``axis_name``);
@@ -26,50 +27,78 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def top1_dispatch(x, gate_logits, n_experts_global: int, capacity: int):
-    """Pack tokens into per-expert capacity slots (single device's view).
+def topk_dispatch(x, gate_logits, n_experts_global: int, capacity: int,
+                  k: int, *, renormalize: bool = True):
+    """Pack tokens into per-expert capacity slots along their top-k routes.
 
-    x: [T, D]; gate_logits: [T, E_global].
-    Returns (buffers [E_global, capacity, D], combine_w [T], expert_of [T],
-    slot_of [T], valid [T]).
+    x: [T, D]; gate_logits: [T, E_global].  Route r = token ``r // k``'s
+    ``r % k``-th expert choice; slots fill in route order (GShard-style
+    priority: earlier tokens, then higher-ranked choices).  Combine
+    weights: the top-k probabilities renormalized over the selected
+    experts (``renormalize=True``, GShard) or raw (False — at k=1 that is
+    Switch-style scaling by the top-1 probability).
+
+    Returns (buffers [E_global, capacity, D], combine_w [T, k],
+    expert_of [T, k], slot_of [T, k], valid [T, k]).
     """
     T, D = x.shape
     probs = jax.nn.softmax(gate_logits, axis=-1)
-    expert_of = jnp.argmax(probs, axis=-1)  # [T]
-    gate = jnp.take_along_axis(probs, expert_of[:, None], axis=1)[:, 0]
-    # Position of each token within its expert's queue.
-    onehot = jax.nn.one_hot(expert_of, n_experts_global, dtype=jnp.int32)
-    pos_in_expert = (jnp.cumsum(onehot, axis=0) - 1)  # [T, E]
-    slot_of = jnp.take_along_axis(pos_in_expert, expert_of[:, None],
-                                  axis=1)[:, 0]
-    valid = slot_of < capacity
+    topk_p, topk_e = lax.top_k(probs, k)  # [T, k]
+    combine_w = (topk_p / jnp.maximum(
+        topk_p.sum(axis=-1, keepdims=True), 1e-9)
+        if renormalize else topk_p)
+    routes = topk_e.reshape(-1)  # [T*k], token-major, rank-minor
+    onehot = jax.nn.one_hot(routes, n_experts_global, dtype=jnp.int32)
+    pos_in_expert = jnp.cumsum(onehot, axis=0) - 1
+    slot_flat = jnp.take_along_axis(pos_in_expert, routes[:, None],
+                                    axis=1)[:, 0]
+    valid = (slot_flat < capacity).reshape(T, k)
+    slot_of = slot_flat.reshape(T, k)
     buffers = jnp.zeros((n_experts_global, capacity, D), x.dtype)
     safe_slot = jnp.where(valid, slot_of, capacity - 1)
-    # scatter-ADD, not set: overflow tokens (clamped to the last slot)
+    x_routes = jnp.broadcast_to(x[:, None], (T, k, D))
+    # scatter-ADD, not set: overflow routes (clamped to the last slot)
     # contribute zeros instead of clobbering the slot's real occupant.
-    buffers = buffers.at[expert_of, safe_slot].add(
-        jnp.where(valid[:, None], x, 0.0))
-    return buffers, gate, expert_of, slot_of, valid
+    buffers = buffers.at[topk_e, safe_slot].add(
+        jnp.where(valid[..., None], x_routes, 0.0))
+    return buffers, combine_w, topk_e, slot_of, valid
+
+
+def top1_dispatch(x, gate_logits, n_experts_global: int, capacity: int):
+    """Switch-style top-1 specialization of :func:`topk_dispatch` (raw
+    top-1 probability as the combine weight; squeezed [T] shapes)."""
+    buffers, gate, expert_of, slot_of, valid = topk_dispatch(
+        x, gate_logits, n_experts_global, capacity, 1, renormalize=False)
+    return (buffers, gate[:, 0], expert_of[:, 0], slot_of[:, 0],
+            valid[:, 0])
 
 
 def moe_layer(x, gate_w, expert_fn: Callable, expert_params,
-              axis_name: str, *, capacity_factor: float = 2.0):
-    """Top-1 expert-parallel MoE layer, for use inside shard_map.
+              axis_name: str, *, capacity_factor: float = 2.0, k: int = 1):
+    """Top-k expert-parallel MoE layer, for use inside shard_map.
 
     x: [T, D] this device's tokens; gate_w: [D, E_global] replicated;
     expert_params: this device's experts, leaves shaped
     ``[experts_per_device, ...]``; ``expert_fn(params_e, tokens) -> tokens``
     applies ONE expert.  Returns [T, D].
+
+    ``k=1`` keeps Switch-style combine (scale by the raw top-1
+    probability); ``k>=2`` is GShard-style — contributions weighted by the
+    top-k probabilities renormalized over the selected experts.  Capacity
+    scales with k: ``capacity_factor * T * k / E`` slots per expert.
     """
+    if k < 1:
+        raise ValueError(f"moe_layer needs k >= 1 experts per token, "
+                         f"got {k}")
     n_dev = lax.axis_size(axis_name)
     T, D = x.shape
     e_local = jax.tree.leaves(expert_params)[0].shape[0]
     E = n_dev * e_local
-    capacity = max(1, int(capacity_factor * T / E))
+    capacity = max(1, int(capacity_factor * T * k / E))
 
     gate_logits = x @ gate_w
-    buffers, gate, expert_of, slot_of, valid = top1_dispatch(
-        x, gate_logits, E, capacity)
+    buffers, gate, expert_of, slot_of, valid = topk_dispatch(
+        x, gate_logits, E, capacity, k, renormalize=k > 1)
 
     # Dispatch: buffers [E, C, D] with E = n_dev * e_local, expert-major.
     # tiled all_to_all on axis 0 sends block d (rows d*e_local:(d+1)*e_local)
@@ -91,6 +120,7 @@ def moe_layer(x, gate_w, expert_fn: Callable, expert_params,
     returned = lax.all_to_all(packed, axis_name, split_axis=0,
                               concat_axis=0, tiled=True)
 
-    out = returned[expert_of, jnp.where(valid, slot_of, 0)]
-    out = jnp.where(valid[:, None], out, 0.0) * gate[:, None]
-    return out
+    # k routes per token: gather each route's processed row, weight, sum.
+    out_routes = returned[expert_of, jnp.where(valid, slot_of, 0)]  # [T,k,D]
+    out_routes = jnp.where(valid[..., None], out_routes, 0.0)
+    return (out_routes * gate[..., None]).sum(axis=1)
