@@ -1,13 +1,14 @@
 """Expert-parallel MoE language model — beyond-reference demo.
 
 The reference is DP-only (SURVEY.md §3.3); this example drives the
-expert-parallel axis end to end: a TransformerLM whose MLP is a top-1 MoE
-with one expert per device, tokens dispatched to their expert's device via
+expert-parallel axis end to end: a TransformerLM whose MLP is a top-k MoE
+(``--top-k``: 1 = Switch-style combine, 2+ = GShard renormalized) with one
+expert per device, tokens dispatched to their experts' devices via
 all-to-all over ``ici`` and combined back, trained data-parallel over
 ``dcn``.  Convergence is asserted (loss must drop on a learnable synthetic
 next-token task), the examples-as-tests strategy of SURVEY.md §5.
 
-Run: ``python examples/moe_lm.py --devices 8 [--dcn 2]``
+Run: ``python examples/moe_lm.py --devices 8 [--dcn 2] [--top-k 2]``
 """
 
 import argparse

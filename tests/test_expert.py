@@ -275,3 +275,42 @@ def test_moe_rejects_k_zero(flat_runtime):
             out_specs=spec_x, check_vma=False))(
             jax.device_put(X, NamedSharding(mesh, spec_x)), gate_w,
             jax.device_put(W, NamedSharding(mesh, spec_x)))
+
+
+def test_load_balance_loss_invariants():
+    rng = np.random.RandomState(0)
+    E = 8
+    # Uniform router -> exactly 1.0.
+    uniform = jnp.zeros((32, E), jnp.float32)
+    expert_of = jnp.asarray(np.arange(32) % E)
+    np.testing.assert_allclose(
+        float(ep.load_balance_loss(uniform, expert_of, E)), 1.0, rtol=1e-6)
+    # Collapsed routing (all tokens to expert 0, peaked probs) >> balanced.
+    peaked = jnp.asarray(np.where(np.arange(E) == 0, 10.0, 0.0)[None]
+                         .repeat(32, 0).astype(np.float32))
+    collapsed = float(ep.load_balance_loss(
+        peaked, jnp.zeros((32,), jnp.int32), E))
+    assert collapsed > 4.0  # ~E when fully collapsed
+    # [T, k] route shape accepted.
+    two = jnp.asarray(rng.randint(0, E, size=(32, 2)))
+    v = float(ep.load_balance_loss(uniform, two, E))
+    assert np.isfinite(v)
+
+
+def test_moe_layer_return_aux(flat_runtime):
+    mesh = mpi.world_mesh()
+    gate_w, W, X = _setup(8, seed=6)
+
+    def body(xd, gw, Wl):
+        out, aux = ep.moe_layer(xd[0], gw, _expert_fn, Wl, ("dcn", "ici"),
+                                k=2, return_aux=True)
+        return out[None], aux[None]
+
+    spec_x = P(("dcn", "ici"))
+    out, aux = jax.jit(shard_map(
+        body, mesh=mesh, in_specs=(spec_x, P(), spec_x),
+        out_specs=(spec_x, P(("dcn", "ici"))), check_vma=False))(
+        jax.device_put(X, NamedSharding(mesh, spec_x)), gate_w,
+        jax.device_put(W, NamedSharding(mesh, spec_x)))
+    aux = np.asarray(aux)
+    assert aux.shape == (8,) and np.isfinite(aux).all() and (aux > 0).all()

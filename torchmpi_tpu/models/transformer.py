@@ -121,10 +121,17 @@ class MoEMLP(nn.Module):
             return jnp.tanh(tokens @ a) @ b
 
         tokens = x.reshape(B * T, E)
-        out = eplib.moe_layer(tokens, gate_w, expert_fn,
-                              (w1_local, w2_local), self.expert_axis,
-                              capacity_factor=self.capacity_factor,
-                              k=self.k)
+        out, aux = eplib.moe_layer(tokens, gate_w, expert_fn,
+                                   (w1_local, w2_local), self.expert_axis,
+                                   capacity_factor=self.capacity_factor,
+                                   k=self.k, return_aux=True)
+        # Per-device load-balance loss, available to training code via
+        # model.apply(..., mutable=["losses"]) -> aux["losses"]; scale
+        # (typ. 1e-2) and add to the task loss.  Not sown at init so the
+        # init-returned variables stay params-only (training code treats
+        # them wholesale as optimizer state).
+        if not self.is_initializing():
+            self.sow("losses", "moe_load_balance", aux)
         return out.reshape(B, T, E).astype(self.dtype)
 
 

@@ -64,17 +64,28 @@ def topk_dispatch(x, gate_logits, n_experts_global: int, capacity: int,
     return buffers, combine_w, topk_e, slot_of, valid
 
 
-def top1_dispatch(x, gate_logits, n_experts_global: int, capacity: int):
-    """Switch-style top-1 specialization of :func:`topk_dispatch` (raw
-    top-1 probability as the combine weight; squeezed [T] shapes)."""
-    buffers, gate, expert_of, slot_of, valid = topk_dispatch(
-        x, gate_logits, n_experts_global, capacity, 1, renormalize=False)
-    return (buffers, gate[:, 0], expert_of[:, 0], slot_of[:, 0],
-            valid[:, 0])
+def load_balance_loss(gate_logits, expert_of, n_experts: int, *,
+                      probs=None):
+    """Switch-transformer auxiliary load-balancing loss for one device's
+    tokens: ``E * sum_e(f_e * P_e)`` with ``f_e`` the fraction of routes
+    dispatched to expert e and ``P_e`` the mean router probability.
+    Equals 1.0 under perfectly uniform routing; grows as routing
+    collapses.  ``expert_of``: [T] or [T, k] selected experts (from
+    :func:`topk_dispatch`).  Pass ``probs`` if the router softmax is
+    already computed.  Scale (typ. 1e-2) and add to the task loss.
+    """
+    if probs is None:
+        probs = jax.nn.softmax(gate_logits, axis=-1)
+    P = probs.mean(axis=0)  # [E]
+    if expert_of.ndim == 1:
+        expert_of = expert_of[:, None]
+    f = jax.nn.one_hot(expert_of.reshape(-1), n_experts).mean(axis=0)
+    return n_experts * jnp.sum(f * P)
 
 
 def moe_layer(x, gate_w, expert_fn: Callable, expert_params,
-              axis_name: str, *, capacity_factor: float = 2.0, k: int = 1):
+              axis_name: str, *, capacity_factor: float = 2.0, k: int = 1,
+              return_aux: bool = False):
     """Top-k expert-parallel MoE layer, for use inside shard_map.
 
     x: [T, D] this device's tokens; gate_w: [D, E_global] replicated;
@@ -86,6 +97,9 @@ def moe_layer(x, gate_w, expert_fn: Callable, expert_params,
     probability); ``k>=2`` is GShard-style — contributions weighted by the
     top-k probabilities renormalized over the selected experts.  Capacity
     scales with k: ``capacity_factor * T * k / E`` slots per expert.
+    ``return_aux=True`` additionally returns this device's
+    :func:`load_balance_loss` (add it to the task loss, typ. scaled 1e-2,
+    to keep routing from collapsing onto few experts).
     """
     if k < 1:
         raise ValueError(f"moe_layer needs k >= 1 experts per token, "
@@ -123,4 +137,7 @@ def moe_layer(x, gate_w, expert_fn: Callable, expert_params,
     # k routes per token: gather each route's processed row, weight, sum.
     out_routes = returned[expert_of, jnp.where(valid, slot_of, 0)]  # [T,k,D]
     out_routes = jnp.where(valid[..., None], out_routes, 0.0)
-    return (out_routes * gate[..., None]).sum(axis=1)
+    out = (out_routes * gate[..., None]).sum(axis=1)
+    if return_aux:
+        return out, load_balance_loss(gate_logits, expert_of, E)
+    return out
