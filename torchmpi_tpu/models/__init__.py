@@ -15,3 +15,4 @@ from .resnet import (  # noqa: F401
     BottleneckBlock,
 )
 from .transformer import TransformerLM  # noqa: F401
+from .generate import generate  # noqa: F401

@@ -1,0 +1,87 @@
+"""Autoregressive generation with a KV cache (serving path).
+
+Beyond-reference (the reference predates LMs — SURVEY.md §6.7): greedy or
+temperature sampling from a :class:`TransformerLM`, one fused scan over
+prefill + decode.  Each step feeds ONE token through the model in
+``decode=True`` mode, where attention appends to per-layer [B, max_len]
+key/value caches instead of recomputing the whole prefix — O(T) work per
+token instead of O(T²), the standard serving transform.  The whole loop is
+one ``lax.scan`` inside one jit: static shapes, no host round-trips.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@partial(jax.jit, static_argnums=(0, 3))
+def _generate_jit(model, params, prompt, steps, temperature, rng):
+    B, Tp = prompt.shape
+    total = Tp + steps
+
+    # Create the per-layer caches by tracing one decode step shape-only.
+    _, cache_vars = model.apply(
+        {"params": params}, jnp.zeros((B, 1), jnp.int32),
+        mutable=["cache"])
+    cache0 = jax.tree.map(jnp.zeros_like, cache_vars["cache"])
+
+    def step(carry, i):
+        cache, tok_in, rng = carry
+        # tok_in is position i's token: prompt[:, 0] initially, then each
+        # step's next_tok (prompt while inside it, sampled after).
+        logits, updated = model.apply(
+            {"params": params, "cache": cache}, tok_in[:, None],
+            pos_offset=i, mutable=["cache"])
+        logits = logits[:, 0].astype(jnp.float32)  # [B, vocab]
+        rng, sub = jax.random.split(rng)
+        sampled = jnp.where(
+            temperature > 0.0,
+            jax.random.categorical(sub, logits / jnp.maximum(
+                temperature, 1e-6)),
+            jnp.argmax(logits, axis=-1)).astype(prompt.dtype)
+        # The token at position i+1: prompt if still inside it, else the
+        # model's sample.
+        next_tok = jnp.where(i + 1 < Tp, prompt[:, jnp.minimum(i + 1,
+                                                               Tp - 1)],
+                             sampled)
+        return (updated["cache"], next_tok, rng), next_tok
+
+    init = (cache0, prompt[:, 0], rng)
+    _, toks = lax.scan(step, init, jnp.arange(total - 1))
+    return jnp.concatenate([prompt[:, :1], toks.T], axis=1)
+
+
+def generate(model, params, prompt, steps: int, *,
+             temperature: float = 0.0,
+             rng: Optional[jax.Array] = None) -> jax.Array:
+    """Generate ``steps`` tokens after ``prompt`` ([B, T_prompt] int).
+
+    ``model`` must be a TransformerLM-like flax module supporting
+    ``decode=True`` (single-device attention); pass the TRAINING model —
+    this wrapper rebinds it for decoding.  ``temperature=0`` is greedy;
+    otherwise softmax sampling at the given temperature using ``rng``.
+    Returns the full [B, T_prompt + steps] sequence.
+    """
+    if prompt.ndim != 2:
+        raise ValueError(f"prompt must be [batch, time], got "
+                         f"{prompt.shape}")
+    total = prompt.shape[1] + steps
+    if total > model.max_len:
+        raise ValueError(
+            f"prompt + steps = {total} exceeds model.max_len "
+            f"{model.max_len}")
+    if getattr(model, "moe_axis", None) is not None:
+        raise ValueError(
+            "generate() supports dense MLPs only: moe_axis routing needs "
+            "a shard_map mesh axis, which the serving loop does not run "
+            "under — decode with moe_axis=None (dense) weights")
+    dmodel = model.clone(decode=True)
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    return _generate_jit(dmodel, params, jnp.asarray(prompt), steps,
+                         jnp.float32(temperature), rng)

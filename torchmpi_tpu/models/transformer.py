@@ -25,6 +25,7 @@ from __future__ import annotations
 from typing import Optional, Tuple, Union
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -40,6 +41,8 @@ class SPAttention(nn.Module):
     attn_impl: str = "local"
     seq_axis: Optional[AxisNames] = None
     dtype: jnp.dtype = jnp.float32
+    decode: bool = False
+    max_len: int = 0
 
     @nn.compact
     def __call__(self, x):  # x: [B, T_local, E]
@@ -50,7 +53,41 @@ class SPAttention(nn.Module):
         q, k, v = (qkv[:, :, 0].astype(jnp.float32),
                    qkv[:, :, 1].astype(jnp.float32),
                    qkv[:, :, 2].astype(jnp.float32))
-        if self.attn_impl == "local":
+        if self.decode:
+            # Autoregressive KV-cache step: x is the NEW token(s) ([B, 1]
+            # in the steady state); keys/values append into this layer's
+            # [B, max_len] cache and q attends over the filled prefix.
+            # NOT a ring buffer: the caller must keep total decoded length
+            # <= max_len (generate() pre-checks; past it,
+            # dynamic_update_slice clamps and outputs silently corrupt).
+            # Single-device attention only (serving path — the
+            # sequence-parallel impls are for training).
+            if self.attn_impl != "local":
+                raise ValueError(
+                    f"decode=True supports attn_impl='local' only, got "
+                    f"{self.attn_impl!r}")
+            if self.max_len <= 0:
+                raise ValueError("decode=True needs max_len > 0")
+            ck = self.variable("cache", "k", jnp.zeros,
+                               (B, self.max_len, H, D), jnp.float32)
+            cv = self.variable("cache", "v", jnp.zeros,
+                               (B, self.max_len, H, D), jnp.float32)
+            idx = self.variable("cache", "idx",
+                                lambda: jnp.zeros((), jnp.int32))
+            start = idx.value
+            ck.value = lax.dynamic_update_slice(ck.value, k, (0, start, 0, 0))
+            cv.value = lax.dynamic_update_slice(cv.value, v, (0, start, 0, 0))
+            idx.value = start + T
+            # Causal mask over the cache: query t attends to cache
+            # positions <= start + t.
+            q_pos = start + jnp.arange(T)
+            kv_pos = jnp.arange(self.max_len)
+            mask = kv_pos[None, :] <= q_pos[:, None]  # [T, max_len]
+            s = jnp.einsum("bqhd,bkhd->bhqk", q, ck.value) / (D ** 0.5)
+            s = jnp.where(mask[None, None], s, -jnp.inf)
+            p = jax.nn.softmax(s, axis=-1)
+            o = jnp.einsum("bhqk,bkhd->bqhd", p, cv.value)
+        elif self.attn_impl == "local":
             o = seqlib.reference_attention(q, k, v, causal=True)
         elif self.attn_impl == "flash":
             from ..ops.flash import flash_attention_grad
@@ -147,13 +184,16 @@ class Block(nn.Module):
     moe_capacity_factor: float = 2.0
     moe_k: int = 1
     dtype: jnp.dtype = jnp.float32
+    decode: bool = False
+    max_len: int = 0
 
     @nn.compact
     def __call__(self, x):
         E = x.shape[-1]
         h = nn.LayerNorm(dtype=jnp.float32)(x)
         x = x + SPAttention(self.num_heads, self.head_dim, self.attn_impl,
-                            self.seq_axis, self.dtype)(h)
+                            self.seq_axis, self.dtype, decode=self.decode,
+                            max_len=self.max_len)(h)
         h = nn.LayerNorm(dtype=jnp.float32)(x)
         if self.moe_axis is not None:
             return x + MoEMLP(self.moe_experts_per_device, self.mlp_ratio,
@@ -182,6 +222,9 @@ class TransformerLM(nn.Module):
     moe_capacity_factor: float = 2.0
     moe_k: int = 1
     dtype: jnp.dtype = jnp.float32
+    # Autoregressive serving: decode=True switches attention to the KV
+    # cache ("cache" collection; see models/generate.py for the loop).
+    decode: bool = False
 
     @nn.compact
     def __call__(self, tokens, pos_offset=0, return_prehead: bool = False):
@@ -198,7 +241,8 @@ class TransformerLM(nn.Module):
                       moe_axis=self.moe_axis,
                       moe_experts_per_device=self.moe_experts_per_device,
                       moe_capacity_factor=self.moe_capacity_factor,
-                      moe_k=self.moe_k, dtype=self.dtype)(x)
+                      moe_k=self.moe_k, dtype=self.dtype,
+                      decode=self.decode, max_len=self.max_len)(x)
         x = nn.LayerNorm(dtype=jnp.float32)(x)
         # Bias-free explicit unembedding (standard for LMs) so callers can
         # feed (pre-head activations, head matrix) to the fused

@@ -28,7 +28,7 @@ from jax import lax
 
 
 def topk_dispatch(x, gate_logits, n_experts_global: int, capacity: int,
-                  k: int, *, renormalize: bool = True):
+                  k: int, *, renormalize: bool = True, probs=None):
     """Pack tokens into per-expert capacity slots along their top-k routes.
 
     x: [T, D]; gate_logits: [T, E_global].  Route r = token ``r // k``'s
@@ -42,7 +42,8 @@ def topk_dispatch(x, gate_logits, n_experts_global: int, capacity: int,
     expert_of [T, k], slot_of [T, k], valid [T, k]).
     """
     T, D = x.shape
-    probs = jax.nn.softmax(gate_logits, axis=-1)
+    if probs is None:
+        probs = jax.nn.softmax(gate_logits, axis=-1)
     topk_p, topk_e = lax.top_k(probs, k)  # [T, k]
     combine_w = (topk_p / jnp.maximum(
         topk_p.sum(axis=-1, keepdims=True), 1e-9)
@@ -111,8 +112,9 @@ def moe_layer(x, gate_w, expert_fn: Callable, expert_params,
     capacity = max(1, int(capacity_factor * T * k / E))
 
     gate_logits = x @ gate_w
+    probs = jax.nn.softmax(gate_logits, axis=-1)  # shared with the aux loss
     buffers, gate, expert_of, slot_of, valid = topk_dispatch(
-        x, gate_logits, E, capacity, k, renormalize=k > 1)
+        x, gate_logits, E, capacity, k, renormalize=k > 1, probs=probs)
 
     # Dispatch: buffers [E, C, D] with E = n_dev * e_local, expert-major.
     # tiled all_to_all on axis 0 sends block d (rows d*e_local:(d+1)*e_local)
@@ -139,5 +141,6 @@ def moe_layer(x, gate_w, expert_fn: Callable, expert_params,
     out_routes = jnp.where(valid[..., None], out_routes, 0.0)
     out = (out_routes * gate[..., None]).sum(axis=1)
     if return_aux:
-        return out, load_balance_loss(gate_logits, expert_of, E)
+        return out, load_balance_loss(gate_logits, expert_of, E,
+                                      probs=probs)
     return out
