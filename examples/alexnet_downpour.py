@@ -20,7 +20,11 @@ def main():
         shards=dict(type=int, default=4),
         image_size=dict(type=int, default=64),
         num_classes=dict(type=int, default=10),
-        defaults={"steps": 60, "batch_size": 32, "lr": 1e-3},
+        # lr: AlexNet has no normalization layers; Adam above ~1e-3 on this
+        # cold start oscillates in place (loss pinned at ln C) while 3e-4
+        # trains to 100% on the synthetic task — measured, see
+        # docs/ROUND2_NOTES.md.
+        defaults={"steps": 80, "batch_size": 32, "lr": 3e-4},
     )
     import jax
     import jax.numpy as jnp
