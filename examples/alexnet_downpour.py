@@ -66,8 +66,6 @@ def main():
     X, Y = dutil.synthetic_image_classification(
         1024, image_shape=(args.image_size, args.image_size, 3),
         num_classes=args.num_classes, seed=args.seed)
-    final_loss = [None] * args.workers
-
     def worker(widx):
         with jax.default_device(devices[widx]):
             params = jax.tree.map(jnp.asarray, params0)
@@ -76,7 +74,7 @@ def main():
             for step, (xb, yb) in enumerate(dutil.batches(
                     X, Y, args.batch_size, steps=args.steps,
                     seed=args.seed + widx + 1)):
-                updates, opt_state, loss = local_step(
+                updates, opt_state, _ = local_step(
                     params, opt_state, jnp.asarray(xb), jnp.asarray(yb))
                 # Push with the axpy rule scaled 1/K so the center moves by
                 # the *average* of the workers' updates — K workers pushing
@@ -85,7 +83,6 @@ def main():
                 ps.send(jax.tree.map(np.asarray, updates), rule="axpy",
                         alpha=1.0 / n_workers)
                 params = optax.apply_updates(params, updates)
-                final_loss[widx] = float(loss)
                 # Prefetch at step s, adopt at s+1: the push is fully async
                 # but parameter staleness stays bounded at one step — with
                 # unbounded staleness the PS center (sum of all workers'

@@ -1,0 +1,40 @@
+"""Examples ARE the integration tests (SURVEY.md §5) — enforce it in CI:
+run a representative subset end to end at their default, convergence-
+asserting settings.  Each example exits nonzero if its convergence
+assertion fails, so subprocess rc is the whole check.  The full sweep
+(all 13 scripts + variants) is documented in docs/ROUND2_NOTES.md.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(script, *args, timeout=600):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # examples size their own device counts
+    out = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "examples", script), *args],
+        capture_output=True, text=True, timeout=timeout, env=env,
+        cwd=os.path.join(_REPO, "examples"))
+    assert out.returncode == 0, (
+        f"{script} failed:\n{out.stdout[-1500:]}\n{out.stderr[-1500:]}")
+    return out
+
+
+@pytest.mark.slow
+def test_mnist_allreduce_example():
+    # BASELINE config 1-adjacent: the "add 4 lines" data-parallel recipe,
+    # default steps, asserts >= 90% accuracy internally.
+    _run("mnist_allreduce.py", "--devices", "8")
+
+
+@pytest.mark.slow
+def test_moe_lm_top2_example():
+    # Beyond-reference EP path with GShard top-2 combine; asserts the
+    # learnable next-token task converges.
+    _run("moe_lm.py", "--devices", "8", "--top-k", "2")
