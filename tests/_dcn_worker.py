@@ -60,6 +60,43 @@ local, _ = mpi.collectives.to_local(mpi.broadcast(x, root=1))
 np.testing.assert_allclose(local[0], x[1])
 print(f"CHECK rank={pid} broadcast ok", flush=True)
 
+# ZeRO-1 across the process (dcn) boundary: optimizer state sharded over
+# BOTH hosts' devices, one sgd step vs the closed-form oracle.
+import jax.numpy as jnp  # noqa: E402
+import optax  # noqa: E402
+from jax import shard_map  # noqa: E402
+from jax.sharding import PartitionSpec as P  # noqa: E402
+
+from torchmpi_tpu.parallel import zero  # noqa: E402
+
+params = {"w": jnp.arange(6, dtype=jnp.float32) / 10.0}
+tx = optax.sgd(0.5, momentum=0.9)  # real state so sharding is checkable
+state = zero.init(params, tx, mesh=mesh)
+axes = tuple(mesh.axis_names)
+trace = state[0].trace  # momentum over the flat padded param vector
+padded = -(-6 // n) * n
+assert trace.shape == (padded,), trace.shape
+# Physically 1/n per device: this host's shard is the flat-shard size.
+assert trace.addressable_shards[0].data.shape == (padded // n,), \
+    trace.addressable_shards[0].data.shape
+
+
+def zstep(p, s):
+    i = zero._axis_index(axes)
+    g = {"w": (i + 1.0) * jnp.ones_like(p["w"])}
+    return zero.update(p, g, s, tx, axes, op="mean")
+
+
+sspecs = zero.specs_like(state, axes)
+newp, _ = jax.jit(shard_map(
+    zstep, mesh=mesh, in_specs=(P(), sspecs), out_specs=(P(), sspecs),
+    check_vma=False))(params, state)
+gmean = (n + 1) / 2.0  # mean over devices of (idx + 1)
+expect_w = np.arange(6, dtype=np.float32) / 10.0 - 0.5 * gmean
+local_w = np.asarray(newp["w"].addressable_shards[0].data)
+np.testing.assert_allclose(local_w, expect_w, rtol=1e-6)
+print(f"CHECK rank={pid} zero ok", flush=True)
+
 mpi.barrier()
 mpi.stop()
 print(f"CHECK rank={pid} done", flush=True)

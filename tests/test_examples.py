@@ -1,6 +1,7 @@
-"""Examples ARE the integration tests (SURVEY.md §5) — enforce it in CI:
-run a representative subset end to end at their default, convergence-
-asserting settings.  Each example exits nonzero if its convergence
+"""Examples ARE the integration tests (SURVEY.md §5): run a
+representative subset end to end at their default, convergence-asserting
+settings as part of the pytest suite (slow-marked — skipped by
+``-m 'not slow'`` runs).  Each example exits nonzero if its convergence
 assertion fails, so subprocess rc is the whole check.  The full sweep
 (all 13 scripts + variants) is documented in docs/ROUND2_NOTES.md.
 """

@@ -59,6 +59,7 @@ def test_two_process_world():
         assert f"CHECK rank={i} done" in out, out
         assert f"CHECK rank={i} eager-allreduce ok" in out, out
         assert f"CHECK rank={i} hierarchical ok" in out, out
+        assert f"CHECK rank={i} zero ok" in out, out
 
 
 @pytest.mark.slow
