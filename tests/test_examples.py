@@ -39,3 +39,10 @@ def test_moe_lm_top2_example():
     # Beyond-reference EP path with GShard top-2 combine; asserts the
     # learnable next-token task converges.
     _run("moe_lm.py", "--devices", "8", "--top-k", "2")
+
+
+@pytest.mark.slow
+def test_lm_generate_example():
+    # Serving path: train, then KV-cache decode; asserts the generated
+    # continuations follow the learned next-token rule.
+    _run("lm_generate.py", "--devices", "1")
