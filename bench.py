@@ -199,7 +199,10 @@ def main():
     if staged:
         N = 512 if tiny else 4096
         x = jnp.ones((N, N), jnp.bfloat16)
-        mm = jax.jit(lambda a, b: a @ b)
+        # Scale each product by 1/N so chained squarings stay ~1 instead of
+        # overflowing to inf within a few iterations (timing matmuls over
+        # inf operands can mask value-dependent behavior on some backends).
+        mm = jax.jit(lambda a, b: (a @ b) * (1.0 / N))
         log("stage A: compiling matmul probe...")
         chain = {"y": x}  # dependent chain so dispatches cannot overlap away
 

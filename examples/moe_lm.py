@@ -81,10 +81,14 @@ def main():
 
     def step(vs, opt_state, tok):
         def loss_fn(v):
-            logits = model.apply(v, tok)
+            # mutable=["losses"] collects the sown per-layer load-balance
+            # losses; scaled into the task loss so the router is actually
+            # pushed toward uniform expert utilization.
+            logits, sown = model.apply(v, tok, mutable=["losses"])
             losses = optax.softmax_cross_entropy_with_integer_labels(
                 logits[:, :-1], tok[:, 1:])
-            return lax.pmean(losses.mean(), mesh.axis_names)
+            aux = sum(jax.tree.leaves(sown["losses"]))  # one per MoE layer
+            return lax.pmean(losses.mean() + 1e-2 * aux, mesh.axis_names)
 
         loss, grads = jax.value_and_grad(loss_fn)(vs)
         # op="sum": the pmean in loss_fn already scaled each shard's grad by

@@ -184,9 +184,10 @@ def test_moe_transformer_matches_single_device(flat_runtime):
 
 
 def _oracle_topk(gate_w, W, X, k, capacity_factor=2.0):
-    """Per-source-device top-k routing oracle: routes fill capacity in
-    token-major, rank-minor order; combine weights renormalized over the
-    selected experts."""
+    """Per-source-device top-k routing oracle: routes fill capacity
+    RANK-MAJOR (GShard priority — all rank-0 routes claim slots before
+    any rank-1 route); combine weights renormalized over the selected
+    experts."""
     n_dev, T_, D_ = X.shape
     E = W.shape[0]
     capacity = max(1, int(capacity_factor * T_ * k / E))
@@ -197,10 +198,10 @@ def _oracle_topk(gate_w, W, X, k, capacity_factor=2.0):
         topk_e = np.asarray(
             jax.lax.top_k(jnp.asarray(probs), k)[1])
         counts = {}
-        for t in range(T_):
-            sel_p = probs[t, topk_e[t]]
-            wsum = max(sel_p.sum(), 1e-9)
-            for j in range(k):
+        for j in range(k):
+            for t in range(T_):
+                sel_p = probs[t, topk_e[t]]
+                wsum = max(sel_p.sum(), 1e-9)
                 e = int(topk_e[t, j])
                 slot = counts.get(e, 0)
                 counts[e] = slot + 1

@@ -31,11 +31,13 @@ def topk_dispatch(x, gate_logits, n_experts_global: int, capacity: int,
                   k: int, *, renormalize: bool = True, probs=None):
     """Pack tokens into per-expert capacity slots along their top-k routes.
 
-    x: [T, D]; gate_logits: [T, E_global].  Route r = token ``r // k``'s
-    ``r % k``-th expert choice; slots fill in route order (GShard-style
-    priority: earlier tokens, then higher-ranked choices).  Combine
-    weights: the top-k probabilities renormalized over the selected
-    experts (``renormalize=True``, GShard) or raw (False — at k=1 that is
+    x: [T, D]; gate_logits: [T, E_global].  Slots fill RANK-MAJOR
+    (GShard priority): every token's rank-0 choice claims a slot before
+    any token's rank-1 choice does, so under overflow an expert drops
+    tokens' secondary routes first — never a later token's primary route
+    in favor of an earlier token's secondary one.  Combine weights: the
+    top-k probabilities renormalized over the selected experts
+    (``renormalize=True``, GShard) or raw (False — at k=1 that is
     Switch-style scaling by the top-1 probability).
 
     Returns (buffers [E_global, capacity, D], combine_w [T, k],
@@ -48,13 +50,16 @@ def topk_dispatch(x, gate_logits, n_experts_global: int, capacity: int,
     combine_w = (topk_p / jnp.maximum(
         topk_p.sum(axis=-1, keepdims=True), 1e-9)
         if renormalize else topk_p)
-    routes = topk_e.reshape(-1)  # [T*k], token-major, rank-minor
+    # Rank-major route order: [k*T] with all rank-0 routes first, so the
+    # running per-expert cumsum assigns slots to every primary route
+    # before any secondary route competes for one.
+    routes = topk_e.T.reshape(-1)
     onehot = jax.nn.one_hot(routes, n_experts_global, dtype=jnp.int32)
     pos_in_expert = jnp.cumsum(onehot, axis=0) - 1
     slot_flat = jnp.take_along_axis(pos_in_expert, routes[:, None],
                                     axis=1)[:, 0]
-    valid = (slot_flat < capacity).reshape(T, k)
-    slot_of = slot_flat.reshape(T, k)
+    slot_of = slot_flat.reshape(k, T).T  # back to [T, k]
+    valid = slot_of < capacity
     buffers = jnp.zeros((n_experts_global, capacity, D), x.dtype)
     safe_slot = jnp.where(valid, slot_of, capacity - 1)
     x_routes = jnp.broadcast_to(x[:, None], (T, k, D))

@@ -281,6 +281,19 @@ def latest_sharded_step(directory: str) -> Optional[int]:
     return _latest(directory, "shckpt_", require_meta=True)
 
 
+def agree_min_step(local: int) -> int:
+    """Cross-process MIN of an int — the one collective the checkpoint
+    agreement protocols are built from (``restore_sharded`` here and
+    ``restart.recover``).  Runs unconditionally on every process; callers
+    encode "nothing available" as a sentinel (<= 0) and must make every
+    subsequent branch decision from the returned GLOBAL value, never a
+    local one, so no process can raise or fall back alone."""
+    from jax.experimental import multihost_utils
+
+    return int(multihost_utils.process_allgather(
+        np.asarray(int(local))).min())
+
+
 def _latest_exists(directory: str, step: int) -> bool:
     proc = jax.process_index()
     return (os.path.exists(os.path.join(
@@ -306,10 +319,7 @@ def restore_sharded(directory: str, template: PyTree,
             # The collective runs UNCONDITIONALLY on every process (with a
             # no-checkpoint sentinel) — raising before it would leave the
             # other hosts hanging in the allgather.
-            from jax.experimental import multihost_utils
-
-            agreed = int(multihost_utils.process_allgather(
-                np.asarray(-1 if local is None else local)).min())
+            agreed = agree_min_step(-1 if local is None else local)
             if agreed < 0:
                 raise FileNotFoundError(
                     f"no sharded checkpoints in {directory} on at least "

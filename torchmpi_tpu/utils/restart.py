@@ -18,6 +18,8 @@ from __future__ import annotations
 
 from typing import Any, Callable, Dict, Optional, Tuple
 
+import jax
+
 from . import checkpoint
 
 PyTree = Any
@@ -54,19 +56,54 @@ def run_with_restarts(
     template = init_fn()
 
     def recover():
-        """Restore the newest restorable checkpoint, walking backwards
-        past unreadable ones (atomic saves make those rare, but an older
-        good step must win over a bad newer file — never a hard stop).
-        Returns (state, next_step)."""
-        for step in reversed(checkpoint.available_steps(directory)):
-            if step <= 0:
-                break
+        """Restore the newest checkpoint all processes can agree on.
+
+        Single-process: the newest locally-restorable step, walking
+        backwards past unreadable ones (atomic saves make those rare, but
+        an older good step must win over a bad newer file — never a hard
+        stop).
+
+        Multi-host (the gang-scheduled restart path): a crash between
+        per-process ``save()`` calls can land step N on some hosts only,
+        and replicas silently resuming from different steps diverge and
+        desync collectives.  So the hosts run an agreement loop in which
+        EVERY branch decision is a function of globally-allgathered
+        values — no host can raise, restore, or fall back alone:
+        propose the newest local step under the ceiling, agree on the
+        minimum, all try to restore exactly that step, allgather a
+        success flag; any failure anywhere lowers the ceiling for
+        everyone and the loop retries, degrading to a collective fresh
+        start when no common restorable step exists.  Requires all
+        processes to call ``recover()`` together — the gang-failure model
+        this module documents (an SPMD failure fails the slice as a
+        unit); a failure on only a subset of hosts is not survivable by
+        any in-band protocol.  Returns (state, next_step)."""
+        steps_avail = [s for s in checkpoint.available_steps(directory)
+                       if s > 0]
+        if jax.process_count() <= 1:
+            for step in reversed(steps_avail):
+                try:
+                    return checkpoint.restore(directory, template,
+                                              step=step), step
+                except Exception:  # noqa: BLE001 — fall back to older
+                    continue
+            return init_fn(), 0
+        ceiling = None
+        while True:
+            cand = next((s for s in reversed(steps_avail)
+                         if ceiling is None or s <= ceiling), 0)
+            agreed = checkpoint.agree_min_step(cand)
+            if agreed <= 0:
+                return init_fn(), 0  # collectively: nothing in common
+            state, ok = None, 1
             try:
-                return checkpoint.restore(directory, template,
-                                          step=step), step
-            except Exception:  # noqa: BLE001 — fall back to older
-                continue
-        return init_fn(), 0
+                state = checkpoint.restore(directory, template,
+                                           step=agreed)
+            except Exception:  # noqa: BLE001 — resolved collectively
+                ok = 0
+            if checkpoint.agree_min_step(ok):
+                return state, agreed
+            ceiling = agreed - 1  # someone failed: walk back TOGETHER
 
     state, i = recover()
     restarts = 0
