@@ -68,6 +68,13 @@ def supervised() -> int:
     timeout = int(os.environ.get("TORCHMPI_TPU_BENCH_TIMEOUT", "900"))
     env = dict(os.environ)
     env["TORCHMPI_TPU_BENCH_STAGED"] = "1"
+    # Tell the child when the axe falls so it can SKIP the big ResNet-50
+    # compile when the remaining budget can't absorb it, instead of
+    # launching a compile it will abandon — an abandoned compile on the
+    # relay's serial queue wedges the service for every later client
+    # (round-2 postmortem).
+    env.setdefault("TORCHMPI_TPU_BENCH_DEADLINE",
+                   str(time.time() + timeout))
     # Give the child a host CPU backend alongside the device platform so
     # model/optimizer init runs host-side: one big remote compile (the train
     # step) instead of two.  The device platform stays first = default.
@@ -134,6 +141,7 @@ def supervised() -> int:
         priority = ["resnet50_dp_train_throughput",
                     "transformer_lm_train_throughput",
                     "flash_attention_tflops",
+                    "fused_xent_tflops",
                     "matmul_bf16_tflops"]
         by_metric = {r.get("metric"): r for r in forwarded}
         best = next((by_metric[m] for m in priority if m in by_metric),
@@ -175,7 +183,13 @@ def main():
 
     import torchmpi_tpu as mpi
     from torchmpi_tpu.models import ResNet50
+    from torchmpi_tpu.utils import compilecache
     from torchmpi_tpu.utils.metrics import fence
+
+    # One successful compile of any stage becomes a disk artifact every
+    # later run reuses — including the driver's end-of-round capture.
+    cache_dir = compilecache.enable_persistent_cache()
+    log(f"persistent compilation cache at {cache_dir}")
 
     BATCH_PER_CHIP = 4 if tiny else 64
     IMAGE = 64 if tiny else 224
@@ -325,15 +339,28 @@ def main():
             dt_d = timed(lambda: fl(*qkv), iters_d, fence)
             fl_tflops = 4.0 * Bf * Hf * Tf * Tf * Df * 0.5 / dt_d / 1e12
             dense_ms = None
+            oracle_err = None
             try:
                 dn = jax.jit(lambda q, k, v: reference_attention(
                     q, k, v, causal=True))
                 dense_ms = round(timed(lambda: dn(*qkv), iters_d, fence)
                                  * 1e3, 3)
+                # On-device oracle: a Mosaic-lowered kernel can still
+                # miscompute at run time (round-2 verdict's largest
+                # residual correctness risk) — assert, don't just time.
+                err = jnp.max(jnp.abs(fl(*qkv).astype(jnp.float32)
+                                      - dn(*qkv).astype(jnp.float32)))
+                oracle_err = float(err)
+                assert oracle_err < 2e-2, (
+                    f"flash kernel disagrees with XLA dense attention "
+                    f"on {platform0}: max|err|={oracle_err}")
+            except AssertionError:
+                raise
             except Exception as e:  # noqa: BLE001 — dense OOMs first
                 log(f"stage C dense comparison failed: {e}")
             log(f"stage C: flash {dt_d*1e3:.2f} ms ({fl_tflops:.1f} "
-                f"TFLOP/s) vs xla-dense {dense_ms} ms")
+                f"TFLOP/s) vs xla-dense {dense_ms} ms, "
+                f"oracle max|err|={oracle_err}")
             print(json.dumps({
                 "metric": "flash_attention_tflops",
                 "value": round(fl_tflops, 1),
@@ -344,11 +371,91 @@ def main():
                           "dtype": "bfloat16",
                           "flash_ms": round(dt_d * 1e3, 3),
                           "xla_dense_ms": dense_ms,
+                          "oracle_max_err": oracle_err,
                           "platform": platform0},
             }), flush=True)
             del qkv  # ~100 MiB of HBM back before the ResNet stage
         except Exception as e:  # noqa: BLE001 — evidence stage, optional
             log(f"stage C (flash) failed: {type(e).__name__}: {e}")
+
+    # Stage C2 (real TPU only): the fused linear+cross-entropy Pallas
+    # kernel on hardware, asserted against the straightforward XLA
+    # logits-materializing oracle — the other Mosaic kernel with no
+    # hardware-execution evidence.
+    if staged and platform0 == "tpu":
+        try:
+            from torchmpi_tpu.ops.xent import fused_linear_cross_entropy
+
+            Nx, Ex, Vx = 8192, 1024, 32768
+            rngx = np.random.RandomState(5)
+            xx = jnp.asarray(rngx.randn(Nx, Ex) * 0.05, jnp.bfloat16)
+            wx = jnp.asarray(rngx.randn(Ex, Vx) * 0.05, jnp.bfloat16)
+            lx = jnp.asarray(rngx.randint(0, Vx, size=Nx), jnp.int32)
+            fx = jax.jit(lambda x, w, l: fused_linear_cross_entropy(
+                x, w, l))
+            log("stage C2: compiling fused linear+xent kernel...")
+            dt_x = timed(lambda: fx(xx, wx, lx), 10, fence)
+            # matmul flops dominate: 2*N*E*V fwd (fwd-only here).
+            xt_tflops = 2.0 * Nx * Ex * Vx / dt_x / 1e12
+
+            def oracle(x, w, l):
+                logits = (x @ w).astype(jnp.float32)
+                lse = jax.scipy.special.logsumexp(logits, axis=-1)
+                return lse - jnp.take_along_axis(
+                    logits, l[:, None], axis=1)[:, 0]
+
+            ox = jax.jit(oracle)
+            # Elementwise PER-TOKEN comparison: a mean over 8192 tokens
+            # would let per-row errors average out and certify a
+            # miscomputing kernel as hardware-verified.
+            err_x = float(jnp.max(jnp.abs(fx(xx, wx, lx)
+                                          - ox(xx, wx, lx))))
+            assert err_x < 5e-3, (
+                f"fused xent disagrees with XLA oracle on {platform0}: "
+                f"max|err|={err_x}")
+            log(f"stage C2: fused xent {dt_x*1e3:.2f} ms "
+                f"({xt_tflops:.1f} TFLOP/s), oracle max|err|={err_x:.2e}")
+            print(json.dumps({
+                "metric": "fused_xent_tflops",
+                "value": round(xt_tflops, 1),
+                "unit": "TFLOP/s",
+                "vs_baseline": round(xt_tflops / peak, 4),
+                "extra": {"tokens": Nx, "embed": Ex, "vocab": Vx,
+                          "dtype": "bfloat16",
+                          "fused_ms": round(dt_x * 1e3, 3),
+                          "oracle_max_err": err_x,
+                          "platform": platform0},
+            }), flush=True)
+            del xx, wx, lx
+        except Exception as e:  # noqa: BLE001 — evidence stage, optional
+            log(f"stage C2 (fused xent) failed: {type(e).__name__}: {e}")
+
+    # Stage D gate (real TPU only): the ResNet-50 step is the known >900 s
+    # remote compile on the relay.  Launch it only when the remaining
+    # supervised budget can absorb the compile — abandoning a compile on
+    # the relay's serial queue wedges the service for every later client
+    # (round-2 postmortem), so skipping IS the safe failure mode: the
+    # supervisor then reports stage B's real measured training number.  A
+    # prior successful compile against this cache makes the re-compile a
+    # probable cache hit, shrinking the required budget.
+    deadline = float(os.environ.get("TORCHMPI_TPU_BENCH_DEADLINE", "0"))
+    # Marker key carries everything that changes the compiled graph:
+    # platform, per-chip batch, image size, device count.  A marker from
+    # a CPU smoke run or other shapes must never shrink the budget for a
+    # genuinely cold TPU compile.
+    d_key = (f"resnet50_dp_step_{platform0}_b{BATCH_PER_CHIP}"
+             f"x{IMAGE}_n{n_dev}")
+    if staged and platform0 == "tpu" and deadline:
+        cached = compilecache.was_compiled(d_key)
+        need = float(os.environ.get(
+            "TORCHMPI_TPU_BENCH_STAGE_D_BUDGET",
+            "240" if cached else "600"))
+        remaining = deadline - time.time()
+        if remaining < need:
+            log(f"stage D (ResNet-50) SKIPPED: {remaining:.0f}s left < "
+                f"{need:.0f}s compile budget (prior-compile marker: "
+                f"{cached}); final record = best completed stage")
+            return
 
     model = ResNet50(dtype=jnp.bfloat16)
     log(f"init ResNet-50 on {init_dev or 'default device'}...")
@@ -377,6 +484,7 @@ def main():
         params, opt_state, batch_stats, loss = dp_step(
             params, opt_state, batch_stats, images, labels)
     fence(loss)
+    compilecache.mark_compiled(d_key)  # keyed by platform/shape/devices
     log(f"warmup done in {time.time()-t0:.1f}s; timing {STEPS} steps...")
 
     t0 = time.time()

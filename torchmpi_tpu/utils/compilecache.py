@@ -1,0 +1,79 @@
+"""Persistent XLA compilation cache plumbing.
+
+On the relay-tunneled TPU platform this repo benchmarks on, compilation
+is the scarce resource: the compile service is serial, a large graph can
+take >15 minutes, and an abandoned compile wedges the queue for every
+later client (round-2 postmortem, docs/ROUND2_NOTES.md).  JAX's
+persistent compilation cache converts one successful compile into a disk
+artifact every later process reuses, so the expensive compile is paid at
+most once per (graph, jaxlib) — including across the builder's session
+and the driver's end-of-round bench run.
+
+The reference had no analog (compilation is not a phase in its
+MPI/CUDA world); this is TPU-native operational machinery in the same
+spirit as its tuned chunk-size constants: amortize the platform's fixed
+costs.  Enabling is best-effort by design: platforms whose PJRT plugin
+cannot serialize executables just miss the cache (JAX logs and moves
+on); they never fail.
+"""
+
+from __future__ import annotations
+
+import os
+
+DEFAULT_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), ".jax_compile_cache")
+
+_enabled: str | None = None
+
+
+def enable_persistent_cache(directory: str | None = None) -> str:
+    """Point JAX's persistent compilation cache at ``directory`` (default:
+    ``<repo>/.jax_compile_cache``, override via
+    ``TORCHMPI_TPU_COMPILE_CACHE``).  Idempotent; returns the directory.
+
+    Thresholds are set to cache aggressively (min compile time 1 s, no
+    minimum entry size): on the serial remote-compile platform even
+    medium compiles are worth banking.
+    """
+    global _enabled
+    directory = (directory
+                 or os.environ.get("TORCHMPI_TPU_COMPILE_CACHE")
+                 or DEFAULT_DIR)
+    if _enabled == directory:
+        return directory
+    import jax
+
+    os.makedirs(directory, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", directory)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    _enabled = directory
+    return directory
+
+
+def marker_path(name: str, directory: str | None = None) -> str:
+    """Path of a success-marker file: records that the compile named
+    ``name`` once completed against this cache, so later runs can treat
+    re-compiles as probable cache hits when budgeting time (bench.py's
+    stage-D gate).  ``name`` must encode everything that changes the
+    compiled graph (platform, shapes, device count) — a marker from a
+    different configuration would shrink the budget for what is actually
+    a cold compile.  Resolution order matches the cache actually enabled:
+    explicit arg > the directory passed to enable_persistent_cache >
+    env > default."""
+    directory = (directory or _enabled
+                 or os.environ.get("TORCHMPI_TPU_COMPILE_CACHE")
+                 or DEFAULT_DIR)
+    return os.path.join(directory, f"compiled_ok_{name}")
+
+
+def mark_compiled(name: str, directory: str | None = None) -> None:
+    path = marker_path(name, directory)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        f.write("1\n")
+
+
+def was_compiled(name: str, directory: str | None = None) -> bool:
+    return os.path.exists(marker_path(name, directory))
