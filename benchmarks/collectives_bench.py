@@ -119,6 +119,56 @@ def main():
             else:
                 print(f"{'broadcast':10s} {backend:13s} {nbytes:>12d} B  "
                       f"{dt*1e3:8.2f} ms  busbw {bw:8.3f} GB/s")
+
+        # Gather/scatter next to allgather: above the chunk_bytes cutover
+        # the chain schedules move O(size) like the reference's
+        # MPI_Gather/Scatter, so their time should track broadcast of the
+        # same total payload — NOT the allgather row (which moves the
+        # gathered payload to EVERY device).  algo bytes = the total
+        # payload that must cross the root's link.
+        root_ops = [
+            ("gather", lambda b: mpi.gather(x, root=0, backend=b),
+             n * nbytes),
+            ("scatter", lambda b: mpi.scatter(x, root=0, backend=b),
+             nbytes),
+            ("allgather", lambda b: mpi.allgather(x, backend=b),
+             n * nbytes),
+        ]
+        for opname, op_fn, algo_bytes in root_ops:
+            for backend in backends:
+                # gather/scatter have no pallas registration; allgather
+                # DOES (ring_all_gather) and must appear in the
+                # comparison.  Same interpreter size guard as allreduce.
+                if backend == "pallas" and (
+                        opname != "allgather"
+                        or (is_cpu and nbytes > 1 << 20)):
+                    continue
+                if (backend == "hierarchical"
+                        and mesh.shape[mpi.DCN_AXIS] <= 1):
+                    continue
+                if backend == "hierarchical" and opname == "scatter":
+                    continue  # delegates to the stock chain; same row
+                try:
+                    out = op_fn(backend)
+                    fence(out)
+                    t0 = time.time()
+                    for _ in range(args.iters):
+                        out = op_fn(backend)
+                    fence(out)
+                    dt = (time.time() - t0) / args.iters
+                except Exception as e:  # noqa: BLE001 — report, continue
+                    print(f"{opname} {backend:13s} {nbytes:>12d} B  "
+                          f"FAILED: {e}", file=sys.stderr)
+                    continue
+                bw = algo_bytes / dt / 1e9
+                line = {"op": opname, "backend": backend, "bytes": nbytes,
+                        "devices": n, "ms": round(dt * 1e3, 3),
+                        "busbw_GBs": round(bw, 3)}
+                if args.json:
+                    print(json.dumps(line))
+                else:
+                    print(f"{opname:10s} {backend:13s} {nbytes:>12d} B  "
+                          f"{dt*1e3:8.2f} ms  busbw {bw:8.3f} GB/s")
     mpi.stop()
 
 

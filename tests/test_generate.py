@@ -69,3 +69,72 @@ def test_generate_rejects_overflow_and_sp():
     params2 = sp.init(jax.random.PRNGKey(0), jnp.asarray(p2))["params"]
     with pytest.raises(ValueError, match="local"):
         generate(sp, params2, p2, steps=2)
+
+
+def test_generate_parallel_ep_matches_naive(hier_runtime):
+    # Expert-parallel decode (VERDICT r2 next #7): the cached greedy scan
+    # under shard_map — MoE dispatch/combine all-to-all over ici each
+    # step — must produce exactly the tokens of the naive full-recompute
+    # greedy loop on the same sharded model.  capacity_factor is high so
+    # routing never overflows: decode-time capacity (few tokens/step) and
+    # prefill-time capacity (all tokens) then agree exactly.
+    import torchmpi_tpu as mpi
+    from torchmpi_tpu.models import generate_parallel
+    from jax import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = mpi.world_mesh()
+    model = TransformerLM(vocab=29, embed=32, depth=2, num_heads=4,
+                          head_dim=8, max_len=24, moe_axis="ici",
+                          moe_experts_per_device=1, moe_k=2,
+                          moe_capacity_factor=8.0)
+    rng = np.random.RandomState(3)
+    prompt = rng.randint(0, 29, size=(4, 5)).astype(np.int32)
+
+    def init_fn(tok):
+        return model.init(jax.random.PRNGKey(4), tok)["params"]
+
+    params = jax.jit(shard_map(init_fn, mesh=mesh, in_specs=P("dcn"),
+                               out_specs=P(), check_vma=False))(
+        jax.device_put(prompt, NamedSharding(mesh, P("dcn"))))
+
+    got = np.asarray(generate_parallel(model, params, prompt, steps=7,
+                                       mesh=mesh, batch_axis="dcn"))
+
+    # Naive oracle: full-forward greedy on the growing prefix, same mesh.
+    def fwd(params, toks):
+        return model.apply({"params": params}, toks)
+
+    fwd_jit = jax.jit(shard_map(fwd, mesh=mesh, in_specs=(P(), P("dcn")),
+                                out_specs=P("dcn"), check_vma=False))
+    toks = jax.device_put(jnp.asarray(prompt),
+                          NamedSharding(mesh, P("dcn")))
+    for _ in range(7):
+        logits = fwd_jit(params, toks)
+        nxt = jnp.argmax(logits[:, -1].astype(jnp.float32),
+                         axis=-1).astype(toks.dtype)
+        toks = jax.device_put(
+            jnp.concatenate([toks, nxt[:, None]], axis=1),
+            NamedSharding(mesh, P("dcn")))
+    np.testing.assert_array_equal(got, np.asarray(toks))
+
+
+def test_generate_parallel_sampling_shards_differ(hier_runtime):
+    # batch_axis rng folding: sharded batch rows must not sample in
+    # lockstep (identical rows across shards would betray a shared rng).
+    import torchmpi_tpu as mpi
+    from torchmpi_tpu.models import generate_parallel
+
+    mesh = mpi.world_mesh()
+    model = TransformerLM(vocab=31, embed=32, depth=1, num_heads=2,
+                          head_dim=8, max_len=20)
+    prompt = np.zeros((4, 2), np.int32)  # identical rows on purpose
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.asarray(prompt))["params"]
+    out = np.asarray(generate_parallel(
+        model, params, prompt, steps=10, mesh=mesh, batch_axis="dcn",
+        temperature=1.0, rng=jax.random.PRNGKey(11)))
+    assert out.shape == (4, 12)
+    # Rows 0/1 live on dcn shard 0, rows 2/3 on shard 1: folded rngs must
+    # decorrelate the shards.
+    assert not np.array_equal(out[0], out[2])

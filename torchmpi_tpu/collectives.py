@@ -157,22 +157,83 @@ def _xla_alltoall(x, axis_names, *, split_axis=0, concat_axis=0):
                           concat_axis=concat_axis, tiled=True)
 
 
+def _chain_gather(x, axes, *, root: int, n: int):
+    """Convergecast chain gather: every device forwards its buffer one hop
+    toward root each round; after round t root holds the tensor that
+    started at virtual rank t+1.  The bottleneck link (into root) carries
+    (n-1) per-rank tensors ~= 1x the gathered size — the O(size) wire
+    profile of the reference's MPI_Gather — and total traffic is
+    n(n-1)/2 tensor-hops, half the ring allgather's n(n-1) (which then
+    masks an n-times-larger buffer on every device)."""
+    r = lax.axis_index(axes)
+    v = lax.rem(r - root + n, n)
+    perm = [((root + i + 1) % n, (root + i) % n) for i in range(n - 1)]
+    out = jnp.zeros((n,) + x.shape, x.dtype)
+    out = lax.dynamic_update_index_in_dim(
+        out, jnp.where(v == 0, x, jnp.zeros_like(x)), root, 0)
+    buf = x
+    for t in range(n - 1):
+        recv = lax.ppermute(buf, axes, perm=perm)
+        g = (root + t + 1) % n  # static: global rank arriving at root now
+        out = lax.dynamic_update_index_in_dim(
+            out, jnp.where(v == 0, recv, jnp.zeros_like(recv)), g, 0)
+        buf = recv
+    return out
+
+
 def _xla_gather(x, axis_names, *, root=0):
     """MPI_Gather: root's output is the stack ``[group, ...]`` of every
     rank's tensor; non-root outputs are zeros of the same shape (the
     reference left non-root buffers untouched, which SPMD's uniform result
-    shapes cannot express — zeros is the defined analog)."""
+    shapes cannot express — zeros is the defined analog).
+
+    Large tensors (>= ``config.chunk_bytes``) take the convergecast chain
+    (O(size) wire, like the reference's MPI_Gather); small ones keep the
+    one-launch allgather+mask whose single collective wins when latency
+    dominates — the same latency/bandwidth cutover as broadcast."""
     axes = _axes_tuple(axis_names)
+    n = 1
+    for a in axes:
+        n *= lax.axis_size(a)
+    if n > 1 and selector.nbytes_of(x) >= \
+            runtime.effective_config().chunk_bytes:
+        return _chain_gather(x, axes, root=root, n=n)
     g = lax.all_gather(x, axes, axis=0, tiled=False)
     return jnp.where(lax.axis_index(axes) == root, g, jnp.zeros_like(g))
 
 
+def _chain_scatter(x, axes, *, root: int, n: int):
+    """Chain scatter, farthest-destination-first: at round t root injects
+    the chunk for virtual rank n-1-t; each device forwards what it
+    received last round, and — because injection is farthest-first —
+    every device's own chunk is exactly what arrives in the final round.
+    The bottleneck link (out of root) carries (n-1)/n of the payload
+    once ~= 1x, and no device ever materializes more than one chunk —
+    versus broadcast-then-slice, which ships the full n-chunk tensor to
+    every device before slicing 1/n of it."""
+    chunk = x.shape[0] // n
+    chunks = x.reshape((n, chunk) + x.shape[1:])
+    r = lax.axis_index(axes)
+    v = lax.rem(r - root + n, n)
+    perm = [((root + i) % n, (root + i + 1) % n) for i in range(n - 1)]
+    buf = jnp.zeros_like(chunks[0])
+    for t in range(n - 1):
+        g = (root + (n - 1 - t)) % n  # static: dst injected this round
+        send = jnp.where(v == 0, chunks[g], buf)
+        buf = lax.ppermute(send, axes, perm=perm)
+    # Round n-2 delivered every non-root device its own chunk; root keeps
+    # its slice of the input.
+    own = lax.dynamic_index_in_dim(chunks, jnp.asarray(root), 0,
+                                   keepdims=False)
+    return jnp.where(v == 0, own, buf)
+
+
 def _xla_scatter(x, axis_names, *, root=0):
     """MPI_Scatter: ``x`` is rank ``root``'s tensor with leading dim
-    divisible by the group size; rank i receives chunk i.  Implemented as
-    broadcast-then-slice (pipelined-chain broadcast for large tensors),
-    since stock XLA collectives cannot express root-sends-distinct-chunks
-    directly."""
+    divisible by the group size; rank i receives chunk i.  Large tensors
+    (>= ``config.chunk_bytes``) take the chain scatter (O(size) wire,
+    one chunk of memory per device); small ones keep broadcast+slice,
+    whose single masked-psum launch wins when latency dominates."""
     axes = _axes_tuple(axis_names)
     n = 1
     for a in axes:
@@ -182,6 +243,9 @@ def _xla_scatter(x, axis_names, *, root=0):
             f"scatter needs leading dim divisible by group size: "
             f"{x.shape[0]} % {n}")
     chunk = x.shape[0] // n
+    if n > 1 and selector.nbytes_of(x) >= \
+            runtime.effective_config().chunk_bytes:
+        return _chain_scatter(x, axes, root=root, n=n)
     src = _xla_broadcast(x, axes, root=root)
     return lax.dynamic_slice_in_dim(src, lax.axis_index(axes) * chunk,
                                     chunk, axis=0)

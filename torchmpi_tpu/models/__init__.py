@@ -15,4 +15,4 @@ from .resnet import (  # noqa: F401
     BottleneckBlock,
 )
 from .transformer import TransformerLM  # noqa: F401
-from .generate import generate  # noqa: F401
+from .generate import generate, generate_parallel  # noqa: F401

@@ -7,11 +7,20 @@ prefill + decode.  Each step feeds ONE token through the model in
 key/value caches instead of recomputing the whole prefix — O(T) work per
 token instead of O(T²), the standard serving transform.  The whole loop is
 one ``lax.scan`` inside one jit: static shapes, no host round-trips.
+
+Two entry points:
+
+- :func:`generate` — single-device dense decode;
+- :func:`generate_parallel` — the same fused scan run under ``shard_map``
+  over a device mesh, so expert-parallel MoE models decode with their
+  dispatch/combine all-to-all riding the mesh axis exactly as in
+  training (tiny per-step capacity — the decode analog of capacity-based
+  routing), and the batch can shard over a data axis.
 """
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 from typing import Optional
 
 import jax
@@ -19,8 +28,10 @@ import jax.numpy as jnp
 from jax import lax
 
 
-@partial(jax.jit, static_argnums=(0, 3))
-def _generate_jit(model, params, prompt, steps, temperature, rng):
+def _generate_scan(model, params, prompt, steps, temperature, rng):
+    """The fused prefill+decode loop: traceable anywhere a model.apply
+    is — directly under jit (dense path) or inside shard_map (parallel
+    path, where the model's collective ops see the mesh axes)."""
     B, Tp = prompt.shape
     total = Tp + steps
 
@@ -56,6 +67,22 @@ def _generate_jit(model, params, prompt, steps, temperature, rng):
     return jnp.concatenate([prompt[:, :1], toks.T], axis=1)
 
 
+@partial(jax.jit, static_argnums=(0, 3))
+def _generate_jit(model, params, prompt, steps, temperature, rng):
+    return _generate_scan(model, params, prompt, steps, temperature, rng)
+
+
+def _check_prompt(model, prompt, steps):
+    if prompt.ndim != 2:
+        raise ValueError(f"prompt must be [batch, time], got "
+                         f"{prompt.shape}")
+    total = prompt.shape[1] + steps
+    if total > model.max_len:
+        raise ValueError(
+            f"prompt + steps = {total} exceeds model.max_len "
+            f"{model.max_len}")
+
+
 def generate(model, params, prompt, steps: int, *,
              temperature: float = 0.0,
              rng: Optional[jax.Array] = None) -> jax.Array:
@@ -67,21 +94,69 @@ def generate(model, params, prompt, steps: int, *,
     otherwise softmax sampling at the given temperature using ``rng``.
     Returns the full [B, T_prompt + steps] sequence.
     """
-    if prompt.ndim != 2:
-        raise ValueError(f"prompt must be [batch, time], got "
-                         f"{prompt.shape}")
-    total = prompt.shape[1] + steps
-    if total > model.max_len:
-        raise ValueError(
-            f"prompt + steps = {total} exceeds model.max_len "
-            f"{model.max_len}")
+    _check_prompt(model, prompt, steps)
     if getattr(model, "moe_axis", None) is not None:
         raise ValueError(
             "generate() supports dense MLPs only: moe_axis routing needs "
-            "a shard_map mesh axis, which the serving loop does not run "
-            "under — decode with moe_axis=None (dense) weights")
+            "a shard_map mesh axis — use generate_parallel(model, ..., "
+            "mesh=...) to decode an expert-parallel model")
     dmodel = model.clone(decode=True)
     if rng is None:
         rng = jax.random.PRNGKey(0)
     return _generate_jit(dmodel, params, jnp.asarray(prompt), steps,
                          jnp.float32(temperature), rng)
+
+
+def generate_parallel(model, params, prompt, steps: int, *, mesh,
+                      batch_axis: Optional[str] = None,
+                      temperature: float = 0.0,
+                      rng: Optional[jax.Array] = None) -> jax.Array:
+    """Sharded generation: the fused prefill+decode scan under
+    ``shard_map`` over ``mesh``.
+
+    The decode inherits the model's training-time parallelism: an
+    expert-parallel model (``moe_axis`` set) routes each step's tokens
+    through the same dispatch/combine all-to-all as training, with the
+    per-step expert capacity computed from the tiny decode token count
+    (capacity-based routing degrades to near-capacity-1).  With
+    ``batch_axis`` the batch dimension additionally shards over that
+    mesh axis (the leading prompt dim must divide by its size); sampling
+    rngs are folded per-shard so sharded batches don't sample in
+    lockstep.  Params are taken replicated (P()).  Returns the full
+    [B, T_prompt + steps] sequence, sharded over ``batch_axis`` if set.
+
+    The reference has no serving story at all (SURVEY.md §1: 2016-era
+    convnets); this extends the beyond-reference EP/DP training axes to
+    inference so a model trained parallel can be sampled parallel.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    _check_prompt(model, prompt, steps)
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    fn = _parallel_fn(model.clone(decode=True), steps, mesh, batch_axis)
+    b_spec = P(batch_axis) if batch_axis else P()
+    prompt = jax.device_put(jnp.asarray(prompt),
+                            NamedSharding(mesh, b_spec))
+    return fn(params, prompt, jnp.float32(temperature), rng)
+
+
+@lru_cache(maxsize=None)
+def _parallel_fn(dmodel, steps, mesh, batch_axis):
+    """Build (once per (model, steps, mesh, batch_axis)) the jitted
+    shard_map serving fn — a fresh closure per call would retrace and
+    recompile the whole scan every invocation; temperature and rng stay
+    operands so greedy/sampled calls share the executable."""
+    from jax.sharding import PartitionSpec as P
+
+    b_spec = P(batch_axis) if batch_axis else P()
+
+    def body(params, prompt, temperature, rng):
+        if batch_axis is not None:
+            rng = jax.random.fold_in(rng, lax.axis_index(batch_axis))
+        return _generate_scan(dmodel, params, prompt, steps,
+                              temperature, rng)
+
+    return jax.jit(jax.shard_map(
+        body, mesh=mesh, in_specs=(P(), b_spec, P(), P()),
+        out_specs=b_spec, check_vma=False))
