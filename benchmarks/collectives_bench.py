@@ -93,40 +93,17 @@ def main():
                 print(f"{'allreduce':10s} {backend:13s} {nbytes:>12d} B  "
                       f"{dt*1e3:8.2f} ms  busbw {busbw:8.3f} GB/s")
 
-        # Broadcast next to allreduce: algo bytes = tensor size, so with the
-        # chain schedule bcast busbw should approach 2x the allreduce line.
-        for backend in [b for b in backends if b != "pallas"]:
-            if backend == "hierarchical" and mesh.shape[mpi.DCN_AXIS] <= 1:
-                continue
-            try:
-                out = mpi.broadcast(x, root=0, backend=backend)
-                fence(out)
-                t0 = time.time()
-                for _ in range(args.iters):
-                    out = mpi.broadcast(x, root=0, backend=backend)
-                fence(out)
-                dt = (time.time() - t0) / args.iters
-            except Exception as e:  # noqa: BLE001 — report and continue
-                print(f"broadcast {backend:13s} {nbytes:>12d} B  FAILED: {e}",
-                      file=sys.stderr)
-                continue
-            bw = nbytes / dt / 1e9
-            line = {"op": "broadcast", "backend": backend, "bytes": nbytes,
-                    "devices": n, "ms": round(dt * 1e3, 3),
-                    "busbw_GBs": round(bw, 3)}
-            if args.json:
-                print(json.dumps(line))
-            else:
-                print(f"{'broadcast':10s} {backend:13s} {nbytes:>12d} B  "
-                      f"{dt*1e3:8.2f} ms  busbw {bw:8.3f} GB/s")
-
-        # Gather/scatter next to allgather: above the chunk_bytes cutover
-        # the chain schedules move O(size) like the reference's
+        # Root-ops next to allreduce.  Broadcast: algo bytes = tensor
+        # size, so the chain schedule should approach 2x the allreduce
+        # busbw line.  Gather/scatter: above the chunk_bytes cutover the
+        # chain schedules move O(size) like the reference's
         # MPI_Gather/Scatter, so their time should track broadcast of the
         # same total payload — NOT the allgather row (which moves the
         # gathered payload to EVERY device).  algo bytes = the total
         # payload that must cross the root's link.
         root_ops = [
+            ("broadcast", lambda b: mpi.broadcast(x, root=0, backend=b),
+             nbytes),
             ("gather", lambda b: mpi.gather(x, root=0, backend=b),
              n * nbytes),
             ("scatter", lambda b: mpi.scatter(x, root=0, backend=b),
@@ -157,7 +134,7 @@ def main():
                     fence(out)
                     dt = (time.time() - t0) / args.iters
                 except Exception as e:  # noqa: BLE001 — report, continue
-                    print(f"{opname} {backend:13s} {nbytes:>12d} B  "
+                    print(f"{opname:10s} {backend:13s} {nbytes:>12d} B  "
                           f"FAILED: {e}", file=sys.stderr)
                     continue
                 bw = algo_bytes / dt / 1e9

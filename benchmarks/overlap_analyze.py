@@ -1,0 +1,152 @@
+#!/usr/bin/env python
+"""Analyze the bucketed-gradsync overlap: trace events + HLO evidence.
+
+Companion to ``overlap_trace.py`` (SURVEY.md §8.4.3): given a captured
+profiler trace, summarize how the per-bucket gradient all-reduces
+interleave with backward compute; independently, lower the bucketed DP
+step at several ``n_buckets`` settings and count collective ops
+pre-optimization vs in the compiled executable — the direct evidence of
+whether XLA's all-reduce combiner preserved or merged the configured
+buckets on this platform (it merges below its combine threshold, which
+is the scheduling fact any bucket-count default must be justified
+against).
+
+Run: ``python benchmarks/overlap_analyze.py [--devices 8]
+[--trace path/to/*.trace.json.gz] [--buckets 1,4,8]``
+Emits one JSON line per measurement and a final ``summary`` line.
+"""
+
+import argparse
+import collections
+import glob
+import gzip
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def analyze_trace(path):
+    """Summarize a perfetto/xplane JSON trace: collective events and
+    their position among compute ops on the busiest device lane."""
+    with gzip.open(path, "rt") as f:
+        data = json.load(f)
+    ev = [e for e in data.get("traceEvents", []) if e.get("ph") == "X"]
+    coll = collections.Counter(
+        e["name"] for e in ev
+        if "all-reduce" in e.get("name", "").lower()
+        and not e["name"].startswith("end:"))
+    lanes = collections.defaultdict(list)
+    for e in ev:
+        nm = e.get("name", "")
+        if nm.startswith(("fusion", "convolution", "all-reduce", "loop_",
+                          "transpose", "convert", "dot")) \
+                and not nm.startswith("end:"):
+            lanes[(e.get("pid"), e.get("tid"))].append((e.get("ts"), nm))
+    if not lanes:
+        return {"trace": path, "collective_ops": dict(coll), "lanes": 0}
+    lane = max(lanes.values(), key=len)
+    lane.sort()
+    ar_pos = [i for i, (_, nm) in enumerate(lane) if "all-reduce" in nm]
+    # Overlap evidence: a collective strictly between compute ops (not at
+    # the lane edges) means the scheduler placed compute after it that
+    # does not depend on it.
+    interleaved = [p for p in ar_pos if 0 < p < len(lane) - 1]
+    return {"trace": path,
+            "collective_ops": dict(coll),
+            "lane_ops": len(lane),
+            "allreduce_positions": ar_pos,
+            "interleaved": len(interleaved)}
+
+
+def bucket_hlo_counts(n_buckets, mesh, model_ctor, tx):
+    """Count all_reduce ops pre-optimization vs compiled for one bucket
+    setting of the standard BN DP train step."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import torchmpi_tpu as mpi
+
+    model = model_ctor()
+    v = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)),
+                   train=False)
+    params, bs = v["params"], v["batch_stats"]
+    step = mpi.recipes.make_bn_dp_train_step(model, tx, mesh=mesh,
+                                             n_buckets=n_buckets)
+    p2, o2, b2 = mpi.recipes.replicate_bn_state(params, tx.init(params),
+                                                bs, mesh=mesh)
+    sh = NamedSharding(mesh, P(mesh.axis_names))
+    X = jax.device_put(np.random.RandomState(0).rand(
+        16, 32, 32, 3).astype(np.float32), sh)
+    Y = jax.device_put(np.random.RandomState(1).randint(
+        0, 10, size=16).astype(np.int32), sh)
+    low = step.jitted.lower(p2, o2, b2, X, Y)
+    pre = low.as_text().count("stablehlo.all_reduce")
+    txt = low.compile().as_text()
+    # TPU's latency-hiding scheduler emits overlapped collectives as
+    # paired all-reduce-start/done ops; count starts OR the sync form,
+    # never both (a start is never also spelled "all-reduce(").
+    post = txt.count("all-reduce-start(") or txt.count("all-reduce(")
+    return {"n_buckets": n_buckets, "all_reduce_pre_opt": pre,
+            "all_reduce_compiled": post,
+            "async_form": bool(txt.count("all-reduce-start("))}
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--devices", type=int, default=0)
+    p.add_argument("--trace", default=None,
+                   help="trace .json.gz (default: newest under "
+                        "docs/artifacts/overlap_trace*)")
+    p.add_argument("--buckets", default="1,4,8")
+    args = p.parse_args()
+    if args.devices:
+        from torchmpi_tpu.utils.simulation import force_cpu_devices
+
+        force_cpu_devices(args.devices)
+
+    import optax
+
+    import torchmpi_tpu as mpi
+    from torchmpi_tpu.models import ResNet20
+
+    trace = args.trace
+    if trace is None:
+        root = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "docs", "artifacts")
+        cands = sorted(glob.glob(os.path.join(
+            root, "overlap_trace*", "**", "*.json.gz"), recursive=True))
+        trace = cands[-1] if cands else None
+    if trace:
+        print(json.dumps(analyze_trace(trace)))
+
+    mesh = mpi.init()
+    platform = list(mesh.devices.flat)[0].platform
+    rows = []
+    for nb in [int(b) for b in args.buckets.split(",")]:
+        row = bucket_hlo_counts(nb, mesh, lambda: ResNet20(num_classes=10),
+                                optax.sgd(0.1))
+        row["platform"] = platform
+        rows.append(row)
+        print(json.dumps(row))
+    merged = all(r["all_reduce_compiled"] <= rows[0]["all_reduce_compiled"]
+                 for r in rows)
+    print(json.dumps({
+        "summary": "combiner_merged_buckets" if merged
+        else "buckets_survive_compilation",
+        "platform": platform,
+        "note": ("XLA's all-reduce combiner merged the configured buckets "
+                 "into one compiled collective at this model scale — "
+                 "bucket-count tuning only matters above the combine "
+                 "threshold" if merged else
+                 "compiled collective count tracks n_buckets — bucket "
+                 "overlap is schedulable on this platform"),
+    }))
+    mpi.stop()
+
+
+if __name__ == "__main__":
+    main()
