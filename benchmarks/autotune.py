@@ -186,6 +186,65 @@ def main():
             best = (nb, dt)
     rec["gradsync_buckets"] = best[0]
 
+    # -- 4. flash-attention block sizes (real TPU only: Mosaic tiling) ----
+    if not is_cpu:
+        from torchmpi_tpu.ops.flash import flash_attention
+
+        Bf, Tf, Hf, Df = 2, (1024 if args.quick else 4096), 8, 128
+        rngf = np.random.RandomState(4)
+        qkv = [jnp.asarray(rngf.randn(Bf, Tf, Hf, Df), jnp.bfloat16)
+               for _ in range(3)]
+        best = (None, float("inf"))
+        grid = ((128, 128), (256, 256)) if args.quick else \
+            ((128, 128), (128, 256), (256, 128), (256, 256), (512, 256))
+        for bq, bk in grid:
+            try:
+                f = jax.jit(lambda q, k, v, bq=bq, bk=bk: flash_attention(
+                    q, k, v, causal=True, block_q=bq, block_k=bk))
+                dt = _time(lambda: f(*qkv), args.iters, fence)
+            except Exception as e:  # noqa: BLE001 — invalid tiling, skip
+                print(json.dumps({"phase": "flash_blocks",
+                                  "block_q": bq, "block_k": bk,
+                                  "error": str(e)[:120]}))
+                continue
+            print(json.dumps({"phase": "flash_blocks", "block_q": bq,
+                              "block_k": bk, "ms": round(dt * 1e3, 3)}))
+            if dt < best[1]:
+                best = ((bq, bk), dt)
+        if best[0] is not None:
+            rec["flash_block_q"], rec["flash_block_k"] = best[0]
+        del qkv
+
+    # -- 5. fused-xent block sizes (real TPU only) -------------------------
+    if not is_cpu:
+        from torchmpi_tpu.ops.xent import fused_linear_cross_entropy
+
+        Nx, Ex, Vx = (2048 if args.quick else 8192), 1024, 32768
+        rngx = np.random.RandomState(5)
+        xx = jnp.asarray(rngx.randn(Nx, Ex) * 0.05, jnp.bfloat16)
+        wx = jnp.asarray(rngx.randn(Ex, Vx) * 0.05, jnp.bfloat16)
+        lx = jnp.asarray(rngx.randint(0, Vx, size=Nx), jnp.int32)
+        best = (None, float("inf"))
+        grid = ((128, 512), (256, 512)) if args.quick else \
+            ((128, 512), (128, 1024), (256, 512), (256, 1024), (512, 512))
+        for bn, bv in grid:
+            try:
+                f = jax.jit(lambda x, w, l, bn=bn, bv=bv:
+                            fused_linear_cross_entropy(
+                                x, w, l, block_n=bn, block_v=bv).mean())
+                dt = _time(lambda: f(xx, wx, lx), args.iters, fence)
+            except Exception as e:  # noqa: BLE001 — invalid tiling, skip
+                print(json.dumps({"phase": "xent_blocks", "block_n": bn,
+                                  "block_v": bv, "error": str(e)[:120]}))
+                continue
+            print(json.dumps({"phase": "xent_blocks", "block_n": bn,
+                              "block_v": bv, "ms": round(dt * 1e3, 3)}))
+            if dt < best[1]:
+                best = ((bn, bv), dt)
+        if best[0] is not None:
+            rec["xent_block_n"], rec["xent_block_v"] = best[0]
+        del xx, wx, lx
+
     print(json.dumps({"recommend": True,
                       "platform": "cpu-sim" if is_cpu else "tpu",
                       "devices": n, "config": rec}))

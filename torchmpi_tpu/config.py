@@ -84,6 +84,17 @@ class Config:
     # directions concurrently (2x bandwidth bound on full-duplex ICI).
     pallas_bidirectional: bool = False
 
+    # --- pallas kernel tilings ---------------------------------------------
+    # Default block sizes for the flash-attention and fused linear+xent
+    # kernels when the call site does not pass them explicitly — the knobs
+    # benchmarks/autotune.py measures per platform (the reference's tuned
+    # chunk constants, kernel edition).  128/128 and 128/512 are safe
+    # v5e-shaped defaults.
+    flash_block_q: int = 128
+    flash_block_k: int = 128
+    xent_block_n: int = 128
+    xent_block_v: int = 512
+
     # --- gradient synchronization ------------------------------------------
     # Number of buckets for bucketed/overlapped gradient allreduce.
     gradsync_buckets: int = 1

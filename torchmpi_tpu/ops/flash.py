@@ -49,6 +49,23 @@ _LANES = 128
 _STAT_LANES = 8
 
 
+def resolve_blocks(block_a, block_b, field_a: str, field_b: str):
+    """Resolve ``None`` kernel-tiling arguments from the active Config —
+    the knobs ``benchmarks/autotune.py`` measures per platform.  The one
+    resolution point for every Pallas kernel entry (forward, custom-VJP,
+    ring, fused-xent), so the autotuned values reach training code, not
+    just forward-only calls."""
+    if block_a is None or block_b is None:
+        from .. import runtime
+
+        cfg = runtime.effective_config()
+        if block_a is None:
+            block_a = getattr(cfg, field_a)
+        if block_b is None:
+            block_b = getattr(cfg, field_b)
+    return block_a, block_b
+
+
 def _valid_mask(qo_ref, ko_ref, i, j, block_q: int, block_k: int,
                 kv_len: int, causal: bool):
     """[block_q, block_k] score-validity mask: k-padding rows out, and (for
@@ -217,7 +234,8 @@ def _flash_bwd_dkv_kernel(qo_ref, ko_ref, k_ref, v_ref, q_ref, do_ref,
 
 def flash_attention(q, k, v, *, causal: bool = False,
                     scale: Optional[float] = None, q_offset=0, kv_offset=0,
-                    block_q: int = 128, block_k: int = 128,
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None,
                     return_residuals: bool = False, interpret=None):
     """Blocked flash attention on one device.
 
@@ -244,6 +262,8 @@ def flash_attention(q, k, v, *, causal: bool = False,
                          f"v {v.shape}")
     if scale is None:
         scale = 1.0 / (D ** 0.5)
+    block_q, block_k = resolve_blocks(block_q, block_k,
+                                      "flash_block_q", "flash_block_k")
 
     block_q = min(block_q, Tq)
     block_k = min(block_k, Tkv)
@@ -463,16 +483,20 @@ def _flash_vjp(causal: bool, scale: float, block_q: int, block_k: int,
 
 def flash_attention_grad(q, k, v, *, causal: bool = False,
                          scale: Optional[float] = None, q_offset=0,
-                         kv_offset=0, block_q: int = 128, block_k: int = 128,
+                         kv_offset=0, block_q: Optional[int] = None,
+                         block_k: Optional[int] = None,
                          interpret=None):
     """Differentiable flash attention (custom VJP with Pallas backward
     kernels).  Same forward semantics as :func:`flash_attention`; gradients
     flow to q/k/v (offsets are integer-like, zero-cotangent).  Pallas has
     no autodiff rule, so this wrapper is what training code should call —
-    ``TransformerLM(attn_impl="flash")`` routes here."""
+    ``TransformerLM(attn_impl="flash")`` routes here.  Block sizes default
+    from Config (``flash_block_q``/``flash_block_k``)."""
     D = q.shape[-1]
     if scale is None:
         scale = 1.0 / (D ** 0.5)
+    block_q, block_k = resolve_blocks(block_q, block_k,
+                                      "flash_block_q", "flash_block_k")
     if interpret is None:
         from . import ring
 

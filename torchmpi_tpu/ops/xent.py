@@ -16,6 +16,7 @@ carries across the minor vocab-block grid dimension.
 from __future__ import annotations
 
 import functools
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -183,8 +184,10 @@ def _fused_xent_fwd(x, w, labels, block_n: int, block_v: int, interpret):
     return loss[:N, 0], lse[:N, 0]
 
 
-def fused_linear_cross_entropy(x, w, labels, *, block_n: int = 128,
-                               block_v: int = 512, interpret=None):
+def fused_linear_cross_entropy(x, w, labels, *,
+                               block_n: Optional[int] = None,
+                               block_v: Optional[int] = None,
+                               interpret=None):
     """Per-token ``softmax_xent(x @ w, labels)`` without materializing
     logits.
 
@@ -198,6 +201,10 @@ def fused_linear_cross_entropy(x, w, labels, *, block_n: int = 128,
     """
     if interpret is None:
         interpret = _interp()
+    from .flash import resolve_blocks
+
+    block_n, block_v = resolve_blocks(block_n, block_v,
+                                      "xent_block_n", "xent_block_v")
     f = _xent_vjp(x.shape[1], block_n, block_v, interpret)
     return f(x, w, labels)
 

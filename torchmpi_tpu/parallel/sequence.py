@@ -74,7 +74,8 @@ def _attn_block(q, k, v, scale, mask):
 
 def ring_attention(q, k, v, axis_name: str, *, causal: bool = False,
                    scale: Optional[float] = None, block_impl: str = "dense",
-                   block_q: int = 128, block_k: int = 128):
+                   block_q: Optional[int] = None,
+                   block_k: Optional[int] = None):
     """Blockwise ring attention over a sequence-sharded axis.
 
     Shapes (per device): q, k, v — ``[batch, seq_local, heads, head_dim]``,
@@ -97,6 +98,10 @@ def ring_attention(q, k, v, axis_name: str, *, causal: bool = False,
     if block_impl == "flash":
         if scale is None:
             scale = 1.0 / (q.shape[-1] ** 0.5)
+        from ..ops.flash import resolve_blocks
+
+        block_q, block_k = resolve_blocks(block_q, block_k,
+                                          "flash_block_q", "flash_block_k")
         axis_key = (axis_name if isinstance(axis_name, str)
                     else tuple(axis_name))
         return _ring_flash_vjp(axis_key, causal, float(scale), block_q,
