@@ -187,8 +187,11 @@ def main():
     rec["gradsync_buckets"] = best[0]
 
     # -- 4. flash-attention block sizes (real TPU only: Mosaic tiling) ----
+    # Timed through value_and_grad over flash_attention_grad — the
+    # training path the knobs primarily serve — so a tiling that wins the
+    # forward but loses the dq/dkv backward kernels cannot be recommended.
     if not is_cpu:
-        from torchmpi_tpu.ops.flash import flash_attention
+        from torchmpi_tpu.ops.flash import flash_attention_grad
 
         Bf, Tf, Hf, Df = 2, (1024 if args.quick else 4096), 8, 128
         rngf = np.random.RandomState(4)
@@ -199,8 +202,15 @@ def main():
             ((128, 128), (128, 256), (256, 128), (256, 256), (512, 256))
         for bq, bk in grid:
             try:
-                f = jax.jit(lambda q, k, v, bq=bq, bk=bk: flash_attention(
-                    q, k, v, causal=True, block_q=bq, block_k=bk))
+                def fwd_bwd(q, k, v, bq=bq, bk=bk):
+                    def loss(q, k, v):
+                        o = flash_attention_grad(q, k, v, causal=True,
+                                                 block_q=bq, block_k=bk)
+                        return jnp.sum(o.astype(jnp.float32) ** 2)
+
+                    return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+                f = jax.jit(fwd_bwd)
                 dt = _time(lambda: f(*qkv), args.iters, fence)
             except Exception as e:  # noqa: BLE001 — invalid tiling, skip
                 print(json.dumps({"phase": "flash_blocks",

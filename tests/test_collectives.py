@@ -169,6 +169,19 @@ def test_scatter_chain_large(flat_runtime, root):
 
 
 @pytest.mark.parametrize("root", [0, 5])
+def test_hier_scatter_chain_large(hier_runtime, root):
+    # Two-level chain scatter: dcn chain delivers slice blocks (one DCN
+    # crossing per block), ici chain splits within each slice.
+    mpi.set_config(chunk_bytes=1024)
+    size = 1024 * N
+    x = rank_data(size, np.float32)
+    out = np.asarray(mpi.scatter(x, root=root, backend="hierarchical"))
+    expect = x[root].reshape(N, -1)
+    for r in range(N):
+        np.testing.assert_allclose(out[r], expect[r])
+
+
+@pytest.mark.parametrize("root", [0, 5])
 def test_hier_gather_chain_large(hier_runtime, root):
     # Two-level chain gather: ici convergecast to slice leaders, then one
     # dcn chain — each tensor crosses the dcn level at most once.

@@ -46,3 +46,10 @@ def test_lm_generate_example():
     # Serving path: train, then KV-cache decode; asserts the generated
     # continuations follow the learned next-token rule.
     _run("lm_generate.py", "--devices", "1")
+
+
+@pytest.mark.slow
+def test_moe_generate_example():
+    # EP serving path: train expert-parallel, decode expert-parallel on
+    # the same mesh (generate_parallel); asserts rule-following output.
+    _run("moe_generate.py", "--devices", "8", "--dcn", "2")

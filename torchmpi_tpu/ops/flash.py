@@ -49,21 +49,12 @@ _LANES = 128
 _STAT_LANES = 8
 
 
-def resolve_blocks(block_a, block_b, field_a: str, field_b: str):
-    """Resolve ``None`` kernel-tiling arguments from the active Config —
-    the knobs ``benchmarks/autotune.py`` measures per platform.  The one
-    resolution point for every Pallas kernel entry (forward, custom-VJP,
-    ring, fused-xent), so the autotuned values reach training code, not
-    just forward-only calls."""
-    if block_a is None or block_b is None:
-        from .. import runtime
+def _resolve_blocks(block_a, block_b, field_a: str, field_b: str):
+    """Config-default tiling resolution — see runtime.resolve_blocks
+    (deferred import: ops must stay importable before the runtime)."""
+    from .. import runtime
 
-        cfg = runtime.effective_config()
-        if block_a is None:
-            block_a = getattr(cfg, field_a)
-        if block_b is None:
-            block_b = getattr(cfg, field_b)
-    return block_a, block_b
+    return runtime.resolve_blocks(block_a, block_b, field_a, field_b)
 
 
 def _valid_mask(qo_ref, ko_ref, i, j, block_q: int, block_k: int,
@@ -262,7 +253,7 @@ def flash_attention(q, k, v, *, causal: bool = False,
                          f"v {v.shape}")
     if scale is None:
         scale = 1.0 / (D ** 0.5)
-    block_q, block_k = resolve_blocks(block_q, block_k,
+    block_q, block_k = _resolve_blocks(block_q, block_k,
                                       "flash_block_q", "flash_block_k")
 
     block_q = min(block_q, Tq)
@@ -495,7 +486,7 @@ def flash_attention_grad(q, k, v, *, causal: bool = False,
     D = q.shape[-1]
     if scale is None:
         scale = 1.0 / (D ** 0.5)
-    block_q, block_k = resolve_blocks(block_q, block_k,
+    block_q, block_k = _resolve_blocks(block_q, block_k,
                                       "flash_block_q", "flash_block_k")
     if interpret is None:
         from . import ring

@@ -201,10 +201,10 @@ def fused_linear_cross_entropy(x, w, labels, *,
     """
     if interpret is None:
         interpret = _interp()
-    from .flash import resolve_blocks
+    from .. import runtime
 
-    block_n, block_v = resolve_blocks(block_n, block_v,
-                                      "xent_block_n", "xent_block_v")
+    block_n, block_v = runtime.resolve_blocks(
+        block_n, block_v, "xent_block_n", "xent_block_v")
     f = _xent_vjp(x.shape[1], block_n, block_v, interpret)
     return f(x, w, labels)
 

@@ -131,12 +131,34 @@ def hier_gather(x, axis_names, *, root: int = 0):
 
 
 def hier_scatter(x, axis_names, *, root: int = 0):
-    """Scatter staged over the tree: DCN+ICI broadcast, then each rank
-    slices its chunk (the stock scatter over the combined axes)."""
-    from .. import collectives
+    """Scatter staged over the tree with O(size) wire per level: a dcn
+    chain delivers each slice its contiguous block of chunks (one DCN
+    crossing per block — the flat combined-axis chain would drag far
+    slices' chunks across every intermediate slice boundary), then an
+    ici chain scatters within each slice.  Small tensors keep the stock
+    broadcast+slice via the same ``chunk_bytes`` cutover as the flat
+    path."""
+    from .. import collectives, runtime
 
     outer, inner = _check_axes(axis_names)
-    return collectives._xla_scatter(x, (outer, inner), root=root)
+    n_i = lax.axis_size(inner)
+    n_o = lax.axis_size(outer)
+    n = n_i * n_o
+    if x.shape[0] % n != 0:
+        raise ValueError(
+            f"scatter needs leading dim divisible by group size: "
+            f"{x.shape[0]} % {n}")
+    if selector.nbytes_of(x) < runtime.effective_config().chunk_bytes:
+        return collectives._xla_scatter(x, (outer, inner), root=root)
+    ro, ri = root // n_i, root % n_i
+    # Stage 1 over dcn: view the rank-major chunks as n_o slice blocks
+    # and chain-scatter them from root's slice.  Lanes with ici coord
+    # != ri run the same collective on their own (non-root) x, but that
+    # data never propagates: stage 2's chain only injects from the ri
+    # lane, whose stage-1 result came from (ro, ri) — the true root.
+    block = collectives._chain_scatter(x, (outer,), root=ro, n=n_o)
+    # Stage 2 over ici: chain-scatter each slice's block from the ri lane.
+    return collectives._chain_scatter(block, (inner,), root=ri, n=n_i)
 
 
 selector.register("allreduce", "hierarchical", hier_allreduce)

@@ -98,10 +98,10 @@ def ring_attention(q, k, v, axis_name: str, *, causal: bool = False,
     if block_impl == "flash":
         if scale is None:
             scale = 1.0 / (q.shape[-1] ** 0.5)
-        from ..ops.flash import resolve_blocks
+        from .. import runtime
 
-        block_q, block_k = resolve_blocks(block_q, block_k,
-                                          "flash_block_q", "flash_block_k")
+        block_q, block_k = runtime.resolve_blocks(
+            block_q, block_k, "flash_block_q", "flash_block_k")
         axis_key = (axis_name if isinstance(axis_name, str)
                     else tuple(axis_name))
         return _ring_flash_vjp(axis_key, causal, float(scale), block_q,

@@ -187,6 +187,21 @@ def effective_config() -> Config:
     return _state.config if _state.initialized else Config()
 
 
+def resolve_blocks(block_a, block_b, field_a: str, field_b: str):
+    """Resolve ``None`` kernel-tiling arguments from the active Config —
+    the knobs ``benchmarks/autotune.py`` measures per platform.  The one
+    resolution point for every Pallas kernel entry (flash forward, the
+    custom-VJP training wrappers, ring attention, fused-xent), so the
+    autotuned values reach training code, not just forward-only calls."""
+    if block_a is None or block_b is None:
+        cfg = effective_config()
+        if block_a is None:
+            block_a = getattr(cfg, field_a)
+        if block_b is None:
+            block_b = getattr(cfg, field_b)
+    return block_a, block_b
+
+
 def _validate_backend_per_op(table: Dict[str, str]) -> Dict[str, str]:
     """Per-op override tables fail loudly on typos (a silently-ignored key
     would let a user benchmark the wrong implementation)."""
