@@ -60,9 +60,12 @@ def analyze_trace(path):
             "interleaved": len(interleaved)}
 
 
-def bucket_hlo_counts(n_buckets, mesh, model_ctor, tx):
+def bucket_hlo_counts(n_buckets, mesh, model_ctor, tx, barrier=False):
     """Count all_reduce ops pre-optimization vs compiled for one bucket
-    setting of the standard BN DP train step."""
+    setting of the standard BN DP train step.  ``barrier=True`` chains
+    buckets through optimization barriers (``Config.gradsync_barrier``)
+    — the compiled count then shows whether THIS platform's combiner
+    respects them (TPU does; the CPU pipeline expands them first)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -70,6 +73,8 @@ def bucket_hlo_counts(n_buckets, mesh, model_ctor, tx):
 
     import torchmpi_tpu as mpi
 
+    prev_barrier = mpi.config().gradsync_barrier
+    mpi.set_config(gradsync_barrier=barrier)
     model = model_ctor()
     v = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)),
                    train=False)
@@ -86,11 +91,13 @@ def bucket_hlo_counts(n_buckets, mesh, model_ctor, tx):
     low = step.jitted.lower(p2, o2, b2, X, Y)
     pre = low.as_text().count("stablehlo.all_reduce")
     txt = low.compile().as_text()
+    mpi.set_config(gradsync_barrier=prev_barrier)  # no config leakage
     # TPU's latency-hiding scheduler emits overlapped collectives as
     # paired all-reduce-start/done ops; count starts OR the sync form,
     # never both (a start is never also spelled "all-reduce(").
     post = txt.count("all-reduce-start(") or txt.count("all-reduce(")
-    return {"n_buckets": n_buckets, "all_reduce_pre_opt": pre,
+    return {"n_buckets": n_buckets, "barrier": barrier,
+            "all_reduce_pre_opt": pre,
             "all_reduce_compiled": post,
             "async_form": bool(txt.count("all-reduce-start("))}
 
@@ -127,13 +134,18 @@ def main():
     platform = list(mesh.devices.flat)[0].platform
     rows = []
     for nb in [int(b) for b in args.buckets.split(",")]:
-        row = bucket_hlo_counts(nb, mesh, lambda: ResNet20(num_classes=10),
-                                optax.sgd(0.1))
-        row["platform"] = platform
-        rows.append(row)
-        print(json.dumps(row))
-    merged = all(r["all_reduce_compiled"] <= rows[0]["all_reduce_compiled"]
-                 for r in rows)
+        for barrier in ((False, True) if nb > 1 else (False,)):
+            row = bucket_hlo_counts(nb, mesh,
+                                    lambda: ResNet20(num_classes=10),
+                                    optax.sgd(0.1), barrier=barrier)
+            row["platform"] = platform
+            rows.append(row)
+            print(json.dumps(row))
+    # Verdict over the DEFAULT (barrier=False) rows only: barrier rows
+    # are the control lever, not the default behavior being judged.
+    plain_rows = [r for r in rows if not r["barrier"]]
+    merged = all(r["all_reduce_compiled"] <= plain_rows[0]
+                 ["all_reduce_compiled"] for r in plain_rows)
     print(json.dumps({
         "summary": "combiner_merged_buckets" if merged
         else "buckets_survive_compilation",

@@ -98,6 +98,12 @@ class Config:
     # --- gradient synchronization ------------------------------------------
     # Number of buckets for bucketed/overlapped gradient allreduce.
     gradsync_buckets: int = 1
+    # Chain buckets through optimization barriers so they stay distinct
+    # through XLA's all-reduce combiner (measured: the combiner otherwise
+    # merges sub-threshold buckets into one collective — see
+    # docs/artifacts/overlap_summary.md).  Off by default: one fused
+    # all-reduce is usually fastest below the combine threshold.
+    gradsync_barrier: bool = False
     # Average (pmean) instead of sum (psum) in synchronize_gradients.
     gradsync_average: bool = True
     # Optional on-the-wire gradient compression: None or "bf16".

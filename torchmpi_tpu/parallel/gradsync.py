@@ -131,12 +131,23 @@ def unflatten_tree(flat: jax.Array, spec: FlatSpec) -> PyTree:
 
 
 def _bucketed_allreduce(grads: PyTree, axes: Tuple[str, ...], *, op: str,
-                        n_buckets: int, backend: Optional[str]) -> PyTree:
+                        n_buckets: int, backend: Optional[str],
+                        barrier: bool = False) -> PyTree:
     """Flatten -> concat -> K buckets -> one allreduce each -> unflatten.
 
     The analog of the reference's async per-layer hooks (SURVEY §4.3): K
     independent collectives inside one jit give XLA the freedom to overlap
     them with surrounding compute.
+
+    ``barrier=True`` chains each bucket's input on the previous bucket's
+    output through ``lax.optimization_barrier``, which keeps the K
+    all-reduces DISTINCT through XLA's all-reduce combiner (measured:
+    below the combine threshold the combiner otherwise merges every
+    bucket into one collective — docs/artifacts/overlap_summary.md) and
+    issues them in order, so the latency-hiding scheduler can overlap
+    bucket i's downstream use with bucket i+1's collective.  The cost is
+    serialization of the collectives themselves; leave it off when one
+    fused all-reduce is fastest (small models).
     """
     if not jax.tree.leaves(grads):
         return grads
@@ -148,6 +159,9 @@ def _bucketed_allreduce(grads: PyTree, axes: Tuple[str, ...], *, op: str,
     out_parts = []
     for i in range(n_buckets):
         part = flat[bounds[i]:bounds[i + 1]]
+        if barrier and out_parts:
+            part, _ = jax.lax.optimization_barrier(
+                (part, out_parts[-1]))
         out_parts.append(collectives.allreduce_in_axis(
             part, axes, op=op, backend=backend))
     flat_out = jnp.concatenate(out_parts) if n_buckets > 1 else out_parts[0]
@@ -158,7 +172,8 @@ def synchronize_gradients(grads: PyTree, axis_names: Optional[AxisNames] = None,
                           *, op: Optional[str] = None,
                           n_buckets: Optional[int] = None,
                           backend: Optional[str] = None,
-                          compress: Optional[str] = None) -> PyTree:
+                          compress: Optional[str] = None,
+                          barrier: Optional[bool] = None) -> PyTree:
     """Allreduce a gradient pytree across the data-parallel axes.
 
     For use inside a shard_map'd/jitted train step (the hot path).  Defaults:
@@ -170,6 +185,10 @@ def synchronize_gradients(grads: PyTree, axis_names: Optional[AxisNames] = None,
     casting back — the lever that matters when the allreduce is DCN-bound
     (multi-slice scaling); gradients tolerate it in practice.  Config
     default: ``gradsync_compress``.
+
+    ``barrier`` (config default ``gradsync_barrier``) keeps bucketed
+    all-reduces distinct through XLA's combiner via optimization
+    barriers — see :func:`_bucketed_allreduce`.
     """
     if axis_names is None:
         axis_names = _all_axes(runtime.current_mesh())
@@ -181,6 +200,8 @@ def synchronize_gradients(grads: PyTree, axis_names: Optional[AxisNames] = None,
         n_buckets = cfg.gradsync_buckets if cfg is not None else 1
     if compress is None and cfg is not None:
         compress = cfg.gradsync_compress
+    if barrier is None:
+        barrier = cfg.gradsync_barrier if cfg is not None else False
     orig_dtypes = None
     if compress == "bf16":
         orig_dtypes = jax.tree.map(lambda g: g.dtype, grads)
@@ -192,7 +213,7 @@ def synchronize_gradients(grads: PyTree, axis_names: Optional[AxisNames] = None,
                                             backend=backend)
     else:
         out = _bucketed_allreduce(grads, axes, op=op, n_buckets=n_buckets,
-                                  backend=backend)
+                                  backend=backend, barrier=barrier)
     if orig_dtypes is not None:
         out = jax.tree.map(lambda g, d: g.astype(d), out, orig_dtypes)
     return out
