@@ -168,23 +168,28 @@ def main():
     bsz = (2 if args.quick else 8) * n
     img = np.random.RandomState(2).rand(bsz, 32, 32, 3).astype(np.float32)
     lab = np.random.RandomState(3).randint(0, 10, bsz).astype(np.int32)
-    best = (1, float("inf"))
+    best = ((1, False), float("inf"))
     for nb in ((1, 4) if args.quick else (1, 2, 4, 8, 16)):
-        mpi.set_config(gradsync_buckets=nb)
-        step = mpi.recipes.make_bn_dp_train_step(model, tx, mesh=mesh,
-                                                 donate=False)
-        p2, o2, b2 = mpi.recipes.replicate_bn_state(
-            params, tx.init(params), batch_stats, mesh=mesh)
+        # barrier=True only matters with >1 bucket: it is the lever that
+        # keeps buckets distinct through XLA's combiner (see
+        # overlap_analyze.py), so measure both scheduling modes.
+        for barrier in ((False, True) if nb > 1 else (False,)):
+            mpi.set_config(gradsync_buckets=nb, gradsync_barrier=barrier)
+            step = mpi.recipes.make_bn_dp_train_step(model, tx, mesh=mesh,
+                                                     donate=False)
+            p2, o2, b2 = mpi.recipes.replicate_bn_state(
+                params, tx.init(params), batch_stats, mesh=mesh)
 
-        def run(p2=p2, o2=o2, b2=b2, step=step):
-            return step(p2, o2, b2, img, lab)[3]
+            def run(p2=p2, o2=o2, b2=b2, step=step):
+                return step(p2, o2, b2, img, lab)[3]
 
-        dt = _time(run, max(2, args.iters // 2), fence)
-        print(json.dumps({"phase": "buckets", "buckets": nb,
-                          "step_ms": round(dt * 1e3, 3)}))
-        if dt < best[1]:
-            best = (nb, dt)
-    rec["gradsync_buckets"] = best[0]
+            dt = _time(run, max(2, args.iters // 2), fence)
+            print(json.dumps({"phase": "buckets", "buckets": nb,
+                              "barrier": barrier,
+                              "step_ms": round(dt * 1e3, 3)}))
+            if dt < best[1]:
+                best = ((nb, barrier), dt)
+    rec["gradsync_buckets"], rec["gradsync_barrier"] = best[0]
 
     # -- 4. flash-attention block sizes (real TPU only: Mosaic tiling) ----
     # Timed through value_and_grad over flash_attention_grad — the

@@ -119,6 +119,35 @@ def test_generate_parallel_ep_matches_naive(hier_runtime):
     np.testing.assert_array_equal(got, np.asarray(toks))
 
 
+def test_generate_parallel_ulysses_matches_local(hier_runtime):
+    # Ulysses decode: head-sharded KV cache over ici (1/n cache memory
+    # per device) must produce exactly the tokens of the single-device
+    # dense decode with the same params — attention params are identical
+    # across attn impls, so the local model IS the oracle.
+    import torchmpi_tpu as mpi
+    from torchmpi_tpu.models import generate_parallel
+
+    mesh = mpi.world_mesh()
+    kw = dict(vocab=41, embed=32, depth=2, num_heads=4, head_dim=8,
+              max_len=24)
+    ul = TransformerLM(attn_impl="ulysses", seq_axis="ici", **kw)
+    rng = np.random.RandomState(7)
+    prompt = rng.randint(0, 41, size=(4, 6)).astype(np.int32)
+    params = TransformerLM(**kw).init(jax.random.PRNGKey(8),
+                                      jnp.asarray(prompt))["params"]
+
+    got = np.asarray(generate_parallel(ul, params, prompt, steps=9,
+                                       mesh=mesh, batch_axis="dcn"))
+    expect = np.asarray(generate(TransformerLM(**kw), params, prompt,
+                                 steps=9))
+    np.testing.assert_array_equal(got, expect)
+
+    # Without the mesh, ulysses decode must refuse with a pointer to
+    # generate_parallel, not fail deep inside axis resolution.
+    with pytest.raises(ValueError, match="generate_parallel"):
+        generate(ul, params, prompt, steps=2)
+
+
 def test_generate_parallel_sampling_shards_differ(hier_runtime):
     # batch_axis rng folding: sharded batch rows must not sample in
     # lockstep (identical rows across shards would betray a shared rng).

@@ -134,6 +134,8 @@ class Config:
             chunk_bytes=_env_int("TORCHMPI_TPU_CHUNK_BYTES", 4 * 1024 * 1024),
             custom_min_bytes=_env_int("TORCHMPI_TPU_CUSTOM_MIN_BYTES", 64 * 1024),
             gradsync_buckets=_env_int("TORCHMPI_TPU_GRADSYNC_BUCKETS", 1),
+            gradsync_barrier=_env_bool("TORCHMPI_TPU_GRADSYNC_BARRIER",
+                                       False),
             gradsync_average=_env_bool("TORCHMPI_TPU_GRADSYNC_AVERAGE", True),
             gradsync_compress=(
                 os.environ.get("TORCHMPI_TPU_GRADSYNC_COMPRESS") or None),

@@ -100,6 +100,12 @@ def generate(model, params, prompt, steps: int, *,
             "generate() supports dense MLPs only: moe_axis routing needs "
             "a shard_map mesh axis — use generate_parallel(model, ..., "
             "mesh=...) to decode an expert-parallel model")
+    if (getattr(model, "attn_impl", "local").startswith("ulysses")
+            and getattr(model, "seq_axis", None) is not None):
+        raise ValueError(
+            "ulysses decode needs the mesh axis in scope — use "
+            "generate_parallel(model, ..., mesh=...) for the "
+            "head-sharded-cache serving path")
     dmodel = model.clone(decode=True)
     if rng is None:
         rng = jax.random.PRNGKey(0)

@@ -60,18 +60,43 @@ class SPAttention(nn.Module):
             # NOT a ring buffer: the caller must keep total decoded length
             # <= max_len (generate() pre-checks; past it,
             # dynamic_update_slice clamps and outputs silently corrupt).
-            # Single-device attention only (serving path — the
-            # sequence-parallel impls are for training).
-            if self.attn_impl != "local":
+            #
+            # Two cache layouts:
+            # - "local": single-device, full [B, max_len, H, D] cache.
+            # - "ulysses"/"ulysses_flash" with seq_axis (inside shard_map
+            #   — the generate_parallel path): HEAD-SHARDED cache — each
+            #   device caches H/n heads over the full sequence and
+            #   computes attention for them, outputs all_gather back
+            #   along the head dim.  The Ulysses decode analog: KV-cache
+            #   memory per device is 1/n of the dense layout, the
+            #   constraint that actually binds long-context serving.
+            # Ring impls have no decode path (their sequence-sharded
+            # cache cannot serve one new global token a step).
+            ulysses = (self.attn_impl in ("ulysses", "ulysses_flash")
+                       and self.seq_axis is not None)
+            if self.attn_impl != "local" and not ulysses:
                 raise ValueError(
-                    f"decode=True supports attn_impl='local' only, got "
+                    f"decode=True supports attn_impl='local' (or "
+                    f"'ulysses' under generate_parallel), got "
                     f"{self.attn_impl!r}")
             if self.max_len <= 0:
                 raise ValueError("decode=True needs max_len > 0")
+            h_cache = H
+            if ulysses:
+                n_sp = lax.axis_size(self.seq_axis)
+                if H % n_sp != 0:
+                    raise ValueError(
+                        f"ulysses decode needs num_heads {H} divisible "
+                        f"by axis size {n_sp}")
+                h_cache = H // n_sp
+                h0 = lax.axis_index(self.seq_axis) * h_cache
+                q = lax.dynamic_slice_in_dim(q, h0, h_cache, 2)
+                k = lax.dynamic_slice_in_dim(k, h0, h_cache, 2)
+                v = lax.dynamic_slice_in_dim(v, h0, h_cache, 2)
             ck = self.variable("cache", "k", jnp.zeros,
-                               (B, self.max_len, H, D), jnp.float32)
+                               (B, self.max_len, h_cache, D), jnp.float32)
             cv = self.variable("cache", "v", jnp.zeros,
-                               (B, self.max_len, H, D), jnp.float32)
+                               (B, self.max_len, h_cache, D), jnp.float32)
             idx = self.variable("cache", "idx",
                                 lambda: jnp.zeros((), jnp.int32))
             start = idx.value
@@ -87,6 +112,9 @@ class SPAttention(nn.Module):
             s = jnp.where(mask[None, None], s, -jnp.inf)
             p = jax.nn.softmax(s, axis=-1)
             o = jnp.einsum("bhqk,bkhd->bqhd", p, cv.value)
+            if ulysses:
+                # Heads back together in rank order (= original order).
+                o = lax.all_gather(o, self.seq_axis, axis=2, tiled=True)
         elif self.attn_impl == "local":
             o = seqlib.reference_attention(q, k, v, causal=True)
         elif self.attn_impl == "flash":
