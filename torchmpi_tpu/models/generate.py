@@ -1,12 +1,13 @@
 """Autoregressive generation with a KV cache (serving path).
 
 Beyond-reference (the reference predates LMs — SURVEY.md §6.7): greedy or
-temperature sampling from a :class:`TransformerLM`, one fused scan over
-prefill + decode.  Each step feeds ONE token through the model in
-``decode=True`` mode, where attention appends to per-layer [B, max_len]
-key/value caches instead of recomputing the whole prefix — O(T) work per
-token instead of O(T²), the standard serving transform.  The whole loop is
-one ``lax.scan`` inside one jit: static shapes, no host round-trips.
+temperature sampling from a :class:`TransformerLM`, single-forward
+PREFILL (the whole prompt fills the KV caches in one batched attention
+pass) followed by a ``lax.scan`` DECODE in which each step feeds ONE
+token through the model in ``decode=True`` mode, appending to per-layer
+[B, max_len] key/value caches instead of recomputing the whole prefix —
+O(T) work per token, ``steps`` model dispatches total, all inside one
+jit: static shapes, no host round-trips.
 
 Two entry points:
 
@@ -29,42 +30,56 @@ from jax import lax
 
 
 def _generate_scan(model, params, prompt, steps, temperature, rng):
-    """The fused prefill+decode loop: traceable anywhere a model.apply
-    is — directly under jit (dense path) or inside shard_map (parallel
-    path, where the model's collective ops see the mesh axes)."""
-    B, Tp = prompt.shape
-    total = Tp + steps
+    """Single-forward prefill + scanned decode: traceable anywhere a
+    model.apply is — directly under jit (dense path) or inside shard_map
+    (parallel path, where the model's collective ops see the mesh axes).
 
-    # Create the per-layer caches by tracing one decode step shape-only.
-    _, cache_vars = model.apply(
-        {"params": params}, jnp.zeros((B, 1), jnp.int32),
+    The whole prompt fills the KV caches in ONE forward (the decode-mode
+    attention handles T > 1 with the start-offset causal mask), then the
+    remaining tokens decode one at a time under ``lax.scan`` — the old
+    Tp + steps - 1 sequential model calls become ``steps`` total, the
+    standard serving prefill/decode split (the win is O(Tp) fewer
+    dispatches AND one big MXU-friendly attention over the prompt
+    instead of Tp tiny ones).
+    """
+    B, Tp = prompt.shape
+    if steps <= 0:
+        return prompt
+
+    def sample(logits, rng):  # logits: [B, vocab]
+        logits = logits.astype(jnp.float32)
+        return jnp.where(
+            temperature > 0.0,
+            jax.random.categorical(rng, logits / jnp.maximum(
+                temperature, 1e-6)),
+            jnp.argmax(logits, axis=-1)).astype(prompt.dtype)
+
+    # Prefill: one pass over the full prompt creates AND fills the KV
+    # caches (flax initializes missing mutable collections, so no
+    # separate shape-tracing pass).  return_prehead avoids the
+    # [B, Tp, vocab] logits matmul — only the last position's logits are
+    # needed to sample the first generated token.
+    (xs, head), updated = model.apply(
+        {"params": params}, prompt, pos_offset=0, return_prehead=True,
         mutable=["cache"])
-    cache0 = jax.tree.map(jnp.zeros_like, cache_vars["cache"])
+    rng, sub = jax.random.split(rng)
+    first = sample(xs[:, -1] @ head, sub)
+
+    if steps == 1:
+        return jnp.concatenate([prompt, first[:, None]], axis=1)
 
     def step(carry, i):
         cache, tok_in, rng = carry
-        # tok_in is position i's token: prompt[:, 0] initially, then each
-        # step's next_tok (prompt while inside it, sampled after).
         logits, updated = model.apply(
             {"params": params, "cache": cache}, tok_in[:, None],
             pos_offset=i, mutable=["cache"])
-        logits = logits[:, 0].astype(jnp.float32)  # [B, vocab]
         rng, sub = jax.random.split(rng)
-        sampled = jnp.where(
-            temperature > 0.0,
-            jax.random.categorical(sub, logits / jnp.maximum(
-                temperature, 1e-6)),
-            jnp.argmax(logits, axis=-1)).astype(prompt.dtype)
-        # The token at position i+1: prompt if still inside it, else the
-        # model's sample.
-        next_tok = jnp.where(i + 1 < Tp, prompt[:, jnp.minimum(i + 1,
-                                                               Tp - 1)],
-                             sampled)
-        return (updated["cache"], next_tok, rng), next_tok
+        nxt = sample(logits[:, 0], sub)
+        return (updated["cache"], nxt, rng), nxt
 
-    init = (cache0, prompt[:, 0], rng)
-    _, toks = lax.scan(step, init, jnp.arange(total - 1))
-    return jnp.concatenate([prompt[:, :1], toks.T], axis=1)
+    init = (updated["cache"], first, rng)
+    _, toks = lax.scan(step, init, Tp + jnp.arange(steps - 1))
+    return jnp.concatenate([prompt, first[:, None], toks.T], axis=1)
 
 
 @partial(jax.jit, static_argnums=(0, 3))
