@@ -32,7 +32,12 @@ def main():
         shards=dict(type=int, default=2),
         die_at=dict(type=int, default=30,
                     help="step at which worker 0 crashes"),
-        defaults={"steps": 120, "batch_size": 64, "lr": 0.02},
+        # 200 steps (not 120): convergence after losing a worker at step
+        # 30 is timing-sensitive under async staleness on a loaded host —
+        # the longer survivor run makes the >0.9 assert robust without
+        # weakening it (observed: 120 steps flaked to 0.81 once under
+        # full-sweep CPU contention, 1.0 rerun).
+        defaults={"steps": 200, "batch_size": 64, "lr": 0.02},
     )
     import jax
     import jax.numpy as jnp
