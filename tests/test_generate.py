@@ -55,6 +55,23 @@ def test_temperature_sampling_valid_and_seeded():
     np.testing.assert_array_equal(a[:, :3], prompt)  # prompt preserved
 
 
+def test_generate_step_count_edges():
+    # steps=0 returns the prompt unchanged; steps=1 takes the
+    # prefill-only path (no scan) and must match the first token of a
+    # longer greedy run.
+    model = _model()
+    rng = np.random.RandomState(5)
+    prompt = rng.randint(0, 37, size=(2, 4)).astype(np.int32)
+    params = model.init(jax.random.PRNGKey(6),
+                        jnp.asarray(prompt))["params"]
+    zero = np.asarray(generate(model, params, prompt, steps=0))
+    np.testing.assert_array_equal(zero, prompt)
+    one = np.asarray(generate(model, params, prompt, steps=1))
+    three = np.asarray(generate(model, params, prompt, steps=3))
+    assert one.shape == (2, 5)
+    np.testing.assert_array_equal(one, three[:, :5])
+
+
 def test_generate_rejects_overflow_and_sp():
     model = _model()
     prompt = np.zeros((1, 30), np.int32)
