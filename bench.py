@@ -51,9 +51,12 @@ def cost_model_mfu(lower_fn, dt, peak, platform, analytic_flops=0.0):
     PER-DEVICE FLOPs: the steps here are shard_map-wrapped, so XLA
     lowers and costs the per-shard body, and callers must divide any
     global-program analytic count by the device count themselves.
-    Returns (0.0, None) only when both sources are empty; MFU is only
-    reported on real accelerator runs."""
-    flops = 0.0
+    Returns (tflops, mfu, source) with ``source`` one of "cost_model" /
+    "analytic" / None, recorded in the JSON so an approximate analytic
+    MFU is distinguishable from a measured-cost-model one.  (0.0, None,
+    None) only when both sources are empty; MFU is only reported on real
+    accelerator runs."""
+    flops, source = 0.0, "cost_model"
     try:
         ca = lower_fn().cost_analysis()
         flops = float(ca.get("flops", 0.0)) if ca else 0.0
@@ -64,10 +67,12 @@ def cost_model_mfu(lower_fn, dt, peak, platform, analytic_flops=0.0):
         log(f"cost_analysis unavailable: {e}"
             + ("; using analytic count" if analytic_flops else ""))
     if not flops > 0:
-        flops = float(analytic_flops)
+        flops, source = float(analytic_flops), "analytic"
+    if not flops > 0:
+        source = None
     tflops = flops / dt / 1e12
     mfu = round(tflops / peak, 4) if platform == "tpu" and flops > 0 else None
-    return tflops, mfu
+    return tflops, mfu, source
 
 
 def timed(step, iters, fence):
@@ -354,7 +359,7 @@ def main():
             p_mm = (L_lm * (4.0 + 2.0 * Block.mlp_ratio) * E_lm * E_lm
                     + E_lm * lm.vocab)
             lm_flops = 3.0 * (Bt * T) * (2.0 * p_mm + L_lm * 2.0 * T * E_lm)
-            lm_tflops, lm_mfu = cost_model_mfu(
+            lm_tflops, lm_mfu, lm_src = cost_model_mfu(
                 lambda: lm_jit.jitted.lower(lm_state["v"], lm_state["o"],
                                             tok_d),
                 dt_step, peak, platform0,
@@ -371,7 +376,8 @@ def main():
                           "step_ms": round(dt_step * 1000, 2),
                           "dtype": "bfloat16", "platform": platform0,
                           "tflops_per_chip": round(lm_tflops, 4),
-                          "mfu": lm_mfu, "peak_tflops": peak,
+                          "mfu": lm_mfu, "flops_source": lm_src,
+                          "peak_tflops": peak,
                           "stage": "B (ResNet-50 stage pending)"},
             }), flush=True)
             del lm_vars, lm_opt, lm_state  # free HBM before later stages
@@ -563,7 +569,7 @@ def main():
     # (IMAGE/224)^2.  MFU is only meaningful on real accelerator runs.
     platform = list(mesh.devices.flat)[0].platform
     rn_flops = 3.0 * 8.2e9 * (IMAGE / 224.0) ** 2 * batch
-    tflops_chip, mfu = cost_model_mfu(
+    tflops_chip, mfu, flops_src = cost_model_mfu(
         lambda: dp_step.jitted.lower(params, opt_state, batch_stats,
                                      images, labels),
         dt / STEPS, peak, platform, analytic_flops=rn_flops / n_dev)
@@ -580,7 +586,8 @@ def main():
                   "step_ms": round(dt / STEPS * 1000, 2),
                   "dtype": "bfloat16", "image": IMAGE,
                   "tflops_per_chip": round(tflops_chip, 4),
-                  "mfu": mfu, "peak_tflops": peak,
+                  "mfu": mfu, "flops_source": flops_src,
+                  "peak_tflops": peak,
                   "platform": platform},
     }), flush=True)  # flush before any teardown hang can eat the record
 

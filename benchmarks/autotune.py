@@ -203,8 +203,9 @@ def main():
         qkv = [jnp.asarray(rngf.randn(Bf, Tf, Hf, Df), jnp.bfloat16)
                for _ in range(3)]
         best = (None, float("inf"))
-        grid = ((128, 128), (256, 256)) if args.quick else \
-            ((128, 128), (128, 256), (256, 128), (256, 256), (512, 256))
+        grid = ((256, 256), (512, 512)) if args.quick else \
+            ((128, 128), (256, 256), (512, 256), (256, 512), (512, 512),
+             (512, 1024), (1024, 512))
         for bq, bk in grid:
             try:
                 def fwd_bwd(q, k, v, bq=bq, bk=bk):

@@ -1,0 +1,69 @@
+#!/usr/bin/env python
+"""On-chip flash-attention block sweep vs XLA dense attention.
+
+Run on a live relay window (single chip).  Prints per-config ms,
+causal-credited TFLOP/s, and max|err| vs the library's dense oracle
+(parallel.sequence.reference_attention — the same oracle the test suite
+validates the kernel against).
+"""
+
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmpi_tpu.ops.flash import flash_attention
+from torchmpi_tpu.parallel.sequence import reference_attention
+from torchmpi_tpu.utils.metrics import fence
+
+B, T, H, D = 4, 4096, 8, 128
+CONFIGS = [(256, 256), (512, 256), (256, 512), (512, 512),
+           (512, 1024), (1024, 512)]
+
+
+def bench(f, *a, iters=10):
+    out = f(*a)
+    fence(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = f(*a)
+    fence(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    rs = np.random.RandomState(0)
+    q = jnp.asarray(rs.randn(B, T, H, D), jnp.bfloat16)
+    k = jnp.asarray(rs.randn(B, T, H, D), jnp.bfloat16)
+    v = jnp.asarray(rs.randn(B, T, H, D), jnp.bfloat16)
+
+    dj = jax.jit(functools.partial(reference_attention, causal=True))
+    od = dj(q, k, v)
+    t = bench(dj, q, k, v)
+    print(f"dense (reference_attention): {t*1e3:.2f} ms")
+
+    flops = 2 * B * H * T * T * D * 2 * 0.5  # causal-credited
+    for bq, bk in CONFIGS:
+        fj = jax.jit(functools.partial(flash_attention, causal=True,
+                                       block_q=bq, block_k=bk,
+                                       interpret=False))
+        try:
+            of = fj(q, k, v)
+            err = float(jnp.max(jnp.abs(of.astype(jnp.float32)
+                                        - od.astype(jnp.float32))))
+            t = bench(fj, q, k, v)
+            print(f"flash {bq}x{bk}: {t*1e3:.2f} ms  "
+                  f"{flops/t/1e12:.1f} TFLOP/s  err {err:.4f}")
+        except Exception as e:  # noqa: BLE001 — sweep continues
+            print(f"flash {bq}x{bk}: FAIL {type(e).__name__}: "
+                  f"{str(e)[:120]}")
+
+
+if __name__ == "__main__":
+    main()

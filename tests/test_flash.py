@@ -255,3 +255,53 @@ def test_flash_multiblock_online_softmax(flat_runtime):
     out = flash_attention(q, k, v, block_q=8, block_k=16)
     np.testing.assert_allclose(np.asarray(out), _oracle(q, k, v),
                                rtol=1e-4, atol=1e-4)
+
+
+def test_flash_unaligned_seq_with_default_blocks(flat_runtime):
+    """T between tile width and the (large) default blocks: the clamp
+    rounds the block UP to a tile-aligned size covering T (never a raw
+    min(block, T) that Mosaic may refuse), and pads internally.  Also
+    covers the skip predicate with a final partially-valid k block."""
+    from torchmpi_tpu.ops.flash import _clamp_block
+
+    assert _clamp_block(512, 300) == 384  # tile-aligned cover, not 300
+    assert _clamp_block(512, 8) == 128
+    assert _clamp_block(256, 4096) == 256  # explicit aligned passthrough
+
+    q = _rand((1, 300, 2, 8), 21)
+    k = _rand((1, 300, 2, 8), 22)
+    v = _rand((1, 300, 2, 8), 23)
+    out = flash_attention(q, k, v, causal=True)  # default (512) blocks
+    np.testing.assert_allclose(
+        np.asarray(out), _oracle(q, k, v, causal=True), rtol=2e-5,
+        atol=2e-5)
+
+
+def test_flash_grad_unaligned_seq_with_default_blocks(flat_runtime):
+    """Backward path through the same clamp: grads at T=300 with default
+    blocks match autodiff through the dense oracle."""
+    import jax
+
+    from torchmpi_tpu.ops.flash import flash_attention_grad
+
+    q = _rand((1, 300, 1, 8), 24)
+    k = _rand((1, 300, 1, 8), 25)
+    v = _rand((1, 300, 1, 8), 26)
+
+    def floss(q, k, v):
+        o = flash_attention_grad(q, k, v, causal=True)
+        return jnp.sum(o ** 2)
+
+    def dloss(q, k, v):
+        o = reference_attention(q, k, v, causal=True)
+        return jnp.sum(o ** 2)
+
+    got = jax.grad(floss, argnums=(0, 1, 2))(jnp.asarray(q),
+                                             jnp.asarray(k),
+                                             jnp.asarray(v))
+    want = jax.grad(dloss, argnums=(0, 1, 2))(jnp.asarray(q),
+                                              jnp.asarray(k),
+                                              jnp.asarray(v))
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   rtol=5e-5, atol=5e-5)

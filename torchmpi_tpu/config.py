@@ -88,10 +88,14 @@ class Config:
     # Default block sizes for the flash-attention and fused linear+xent
     # kernels when the call site does not pass them explicitly — the knobs
     # benchmarks/autotune.py measures per platform (the reference's tuned
-    # chunk constants, kernel edition).  128/128 and 128/512 are safe
-    # v5e-shaped defaults.
-    flash_block_q: int = 128
-    flash_block_k: int = 128
+    # chunk constants, kernel edition).  512x512 flash blocks measured
+    # fastest on a real v5e chip (2026-07-30 sweep, scripts/flash_sweep.py:
+    # 8.6 ms vs 10.6 ms at 256x256 for B=4 T=4096 H=8 D=128 causal);
+    # sequences shorter than a block use one tile-aligned block covering
+    # the whole sequence (ops/flash._clamp_block).  128/512 are safe v5e
+    # xent defaults.
+    flash_block_q: int = 512
+    flash_block_k: int = 512
     xent_block_n: int = 128
     xent_block_v: int = 512
 

@@ -57,6 +57,38 @@ def _resolve_blocks(block_a, block_b, field_a: str, field_b: str):
     return runtime.resolve_blocks(block_a, block_b, field_a, field_b)
 
 
+def _block_live(qo_ref, ko_ref, i, j, block_q: int, block_k: int,
+                kv_len: int, causal: bool):
+    """Scalar predicate: does block (i, j) have ANY valid score?  The
+    block-granular complement of :func:`_valid_mask` — a block is dead
+    when its first k position is past the last q row (causal) or past the
+    kv length.  The kv-length clause is purely defensive — callers pad by
+    less than one block, so the last k block always holds >=1 valid key
+    and in-block padding exclusion is _valid_mask's job.  Offsets are
+    traced SMEM scalars (ring attention), so this is a runtime predicate,
+    not grid pruning; for causal self-attention it halves the compute.
+    Forward and backward kernels MUST skip identically, so all of them
+    call this one helper."""
+    k_first = ko_ref[0] + j * block_k
+    live = k_first < ko_ref[0] + kv_len
+    if causal:
+        live = jnp.logical_and(
+            live, k_first <= qo_ref[0] + i * block_q + (block_q - 1))
+    return live
+
+
+def _clamp_block(block: int, t: int, align: int = 128) -> int:
+    """Clamp a config-default block size to a sequence of length ``t``
+    without producing tile-unaligned block shapes: a block larger than
+    ``t`` becomes ``t`` rounded UP to ``align`` (the input is then padded
+    to one full block), never a raw ``min(block, t)`` that Mosaic may
+    refuse to tile (e.g. t=300).  Explicit caller-passed blocks <= t are
+    respected as-is."""
+    if block >= t:
+        return -(-t // align) * align
+    return block
+
+
 def _valid_mask(qo_ref, ko_ref, i, j, block_q: int, block_k: int,
                 kv_len: int, causal: bool):
     """[block_q, block_k] score-validity mask: k-padding rows out, and (for
@@ -90,47 +122,58 @@ def _flash_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, o_ref, *rest,
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    q = q_ref[0, 0]  # [block_q, D]
-    k = k_ref[0, 0]  # [block_k, D]
-    v = v_ref[0, 0]
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32) * scale  # [block_q, block_k]
-
     i = pl.program_id(2)
-    s = jnp.where(_valid_mask(qo_ref, ko_ref, i, j, block_q, block_k,
-                              kv_len, causal), s, NEG_INF)
+    live = _block_live(qo_ref, ko_ref, i, j, block_q, block_k, kv_len,
+                       causal)
 
-    m_prev = jnp.max(m_ref[:], axis=1, keepdims=True)  # [block_q, 1]
-    m_cur = jnp.max(s, axis=1, keepdims=True)
-    m_new = jnp.maximum(m_prev, m_cur)
-    # Fully-masked-so-far rows have m_new == NEG_INF; exponentiate against
-    # 0 there so masked scores give p == 0, not exp(-1e30 + 1e30) == 1.
-    m_safe = jnp.where(m_new > 0.5 * NEG_INF, m_new, 0.0)
-    alpha = jnp.exp(m_prev - m_safe)  # 0 when m_prev is NEG_INF (init)
-    p = jnp.exp(s - m_safe)  # masked entries: exp(NEG_INF) == 0
-    l_prev = jnp.max(l_ref[:], axis=1, keepdims=True)
-    l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
-    acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
-    m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
-    l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+    @pl.when(live)
+    def _update():
+        q = q_ref[0, 0]  # [block_q, D]
+        k = k_ref[0, 0]  # [block_k, D]
+        v = v_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [bq, bk]
+
+        s = jnp.where(_valid_mask(qo_ref, ko_ref, i, j, block_q, block_k,
+                                  kv_len, causal), s, NEG_INF)
+
+        m_prev = jnp.max(m_ref[:], axis=1, keepdims=True)  # [block_q, 1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        # Fully-masked-so-far rows have m_new == NEG_INF; exponentiate
+        # against 0 there so masked scores give p == 0, not
+        # exp(-1e30 + 1e30) == 1.
+        m_safe = jnp.where(m_new > 0.5 * NEG_INF, m_new, 0.0)
+        alpha = jnp.exp(m_prev - m_safe)  # 0 when m_prev is NEG_INF (init)
+        p = jnp.exp(s - m_safe)  # masked entries: exp(NEG_INF) == 0
+        l_prev = jnp.max(l_ref[:], axis=1, keepdims=True)
+        l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
 
     @pl.when(j == nk - 1)
     def _finalize():
+        # Read the running state back from scratch (NOT the _update
+        # locals): the final j block can itself be skipped, e.g. the
+        # first q block of a causal layout never sees the last k block.
+        m_fin = jnp.max(m_ref[:], axis=1, keepdims=True)  # [block_q, 1]
+        l_fin = jnp.max(l_ref[:], axis=1, keepdims=True)
         if residuals:
             # Numerator + statistics for a cross-block combiner; rows whose
             # every key was masked carry m == NEG_INF, l == 0, acc == 0.
             o_ref[0, 0] = acc_ref[:].astype(o_ref.dtype)
-            m_out_ref[0, 0] = jnp.broadcast_to(m_new,
+            m_out_ref[0, 0] = jnp.broadcast_to(m_fin,
                                                (block_q, _STAT_LANES))
-            l_out_ref[0, 0] = jnp.broadcast_to(l_new,
+            l_out_ref[0, 0] = jnp.broadcast_to(l_fin,
                                                (block_q, _STAT_LANES))
         else:
             # Fully-masked rows (l == 0) read as zeros, matching the
             # parallel variants' convention in parallel/sequence.py.
-            denom = jnp.where(l_new > 0, l_new, 1.0)
+            denom = jnp.where(l_fin > 0, l_fin, 1.0)
             o_ref[0, 0] = (acc_ref[:] / denom).astype(o_ref.dtype)
 
 
@@ -148,28 +191,35 @@ def _flash_bwd_dq_kernel(qo_ref, ko_ref, q_ref, do_ref, lse_ref, d_ref,
     def _init():
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
-    q = q_ref[0, 0]  # [block_q, D]
-    do = do_ref[0, 0]
-    k = k_ref[0, 0]  # [block_k, D]
-    v = v_ref[0, 0]
-    lse = jnp.max(lse_ref[0, 0], axis=1, keepdims=True)  # [block_q, 1]
-    dvec = jnp.max(d_ref[0, 0], axis=1, keepdims=True)
-
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32) * scale
     i = pl.program_id(2)
-    s = jnp.where(_valid_mask(qo_ref, ko_ref, i, j, block_q, block_k,
-                              kv_len, causal), s, NEG_INF)
-    p = jnp.exp(s - lse)  # masked or fully-masked rows (lse=+1e30) give 0
+    # Fully-masked blocks contribute p == 0 everywhere, so dq is
+    # unchanged — skip all three matmuls.
+    live = _block_live(qo_ref, ko_ref, i, j, block_q, block_k, kv_len,
+                       causal)
 
-    dp = jax.lax.dot_general(
-        do, v, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32)  # [block_q, block_k]
-    ds = p * (dp - dvec)
-    dq_acc[:] = dq_acc[:] + scale * jax.lax.dot_general(
-        ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
+    @pl.when(live)
+    def _update():
+        q = q_ref[0, 0]  # [block_q, D]
+        do = do_ref[0, 0]
+        k = k_ref[0, 0]  # [block_k, D]
+        v = v_ref[0, 0]
+        lse = jnp.max(lse_ref[0, 0], axis=1, keepdims=True)  # [block_q, 1]
+        dvec = jnp.max(d_ref[0, 0], axis=1, keepdims=True)
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        s = jnp.where(_valid_mask(qo_ref, ko_ref, i, j, block_q, block_k,
+                                  kv_len, causal), s, NEG_INF)
+        p = jnp.exp(s - lse)  # masked / fully-masked rows (lse=+1e30): 0
+
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [block_q, block_k]
+        ds = p * (dp - dvec)
+        dq_acc[:] = dq_acc[:] + scale * jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
     @pl.when(j == nk - 1)
     def _finalize():
@@ -191,31 +241,39 @@ def _flash_bwd_dkv_kernel(qo_ref, ko_ref, k_ref, v_ref, q_ref, do_ref,
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    k = k_ref[0, 0]  # [block_k, D]
-    v = v_ref[0, 0]
-    q = q_ref[0, 0]  # [block_q, D]
-    do = do_ref[0, 0]
-    lse = jnp.max(lse_ref[0, 0], axis=1, keepdims=True)  # [block_q, 1]
-    dvec = jnp.max(d_ref[0, 0], axis=1, keepdims=True)
-
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32) * scale
     j = pl.program_id(2)
-    s = jnp.where(_valid_mask(qo_ref, ko_ref, i, j, block_q, block_k,
-                              kv_len, causal), s, NEG_INF)
-    p = jnp.exp(s - lse)  # [block_q, block_k]
+    # For this kv block, q blocks entirely in its past (causal)
+    # contribute p == 0 — skip all four matmuls.  (Padded keys inside a
+    # live block are excluded by _valid_mask, not here.)
+    live = _block_live(qo_ref, ko_ref, i, j, block_q, block_k, kv_len,
+                       causal)
 
-    dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
-        p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
-    dp = jax.lax.dot_general(
-        do, v, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32)
-    ds = p * (dp - dvec)
-    dk_acc[:] = dk_acc[:] + scale * jax.lax.dot_general(
-        ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
+    @pl.when(live)
+    def _update():
+        k = k_ref[0, 0]  # [block_k, D]
+        v = v_ref[0, 0]
+        q = q_ref[0, 0]  # [block_q, D]
+        do = do_ref[0, 0]
+        lse = jnp.max(lse_ref[0, 0], axis=1, keepdims=True)  # [block_q, 1]
+        dvec = jnp.max(d_ref[0, 0], axis=1, keepdims=True)
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        s = jnp.where(_valid_mask(qo_ref, ko_ref, i, j, block_q, block_k,
+                                  kv_len, causal), s, NEG_INF)
+        p = jnp.exp(s - lse)  # [block_q, block_k]
+
+        dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - dvec)
+        dk_acc[:] = dk_acc[:] + scale * jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
     @pl.when(i == nq - 1)
     def _finalize():
@@ -256,8 +314,8 @@ def flash_attention(q, k, v, *, causal: bool = False,
     block_q, block_k = _resolve_blocks(block_q, block_k,
                                       "flash_block_q", "flash_block_k")
 
-    block_q = min(block_q, Tq)
-    block_k = min(block_k, Tkv)
+    block_q = _clamp_block(block_q, Tq)
+    block_k = _clamp_block(block_k, Tkv)
     pad_q = (-Tq) % block_q
     pad_k = (-Tkv) % block_k
     qt = jnp.moveaxis(q, 2, 1)  # [B, H, Tq, D]
@@ -356,8 +414,8 @@ def flash_attention_bwd(q, k, v, do, lse, dvec, *, causal: bool,
     """
     B, Tq, H, D = q.shape
     Tkv = k.shape[1]
-    block_q = min(block_q, Tq)
-    block_k = min(block_k, Tkv)
+    block_q = _clamp_block(block_q, Tq)
+    block_k = _clamp_block(block_k, Tkv)
     pad_q = (-Tq) % block_q
     pad_k = (-Tkv) % block_k
     qt = jnp.moveaxis(q, 2, 1)
