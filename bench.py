@@ -75,20 +75,6 @@ def cost_model_mfu(lower_fn, dt, peak, platform, analytic_flops=0.0):
     return tflops, mfu, source
 
 
-def timed(step, iters, fence):
-    """One warm/compile call, then ``iters`` timed dispatches between
-    fences (device->host readback — see module docstring on why
-    block_until_ready alone is not a fence on this relay platform).
-    Returns seconds per iteration."""
-    out = step()
-    fence(out)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = step()
-    fence(out)
-    return (time.perf_counter() - t0) / iters
-
-
 def supervised() -> int:
     """Run the real benchmark in a child with a hard timeout, so a wedged
     device runtime (observed: the TPU relay can hang all device ops
@@ -221,14 +207,17 @@ def main():
     import torchmpi_tpu as mpi
     from torchmpi_tpu.models import ResNet50
     from torchmpi_tpu.utils import compilecache
-    from torchmpi_tpu.utils.metrics import fence
+    from torchmpi_tpu.utils.metrics import fence, timed
 
     # One successful compile of any stage becomes a disk artifact every
     # later run reuses — including the driver's end-of-round capture.
     cache_dir = compilecache.enable_persistent_cache()
     log(f"persistent compilation cache at {cache_dir}")
 
-    BATCH_PER_CHIP = 4 if tiny else 64
+    # 128/chip measured best on v5e (2026-07-30, scripts/hw_tune.py
+    # --study resnet): 2368 img/s MFU 0.296 vs 1945/0.243 at 64; 256 adds
+    # <2% for 2x the latency.
+    BATCH_PER_CHIP = 4 if tiny else 128
     IMAGE = 64 if tiny else 224
     STEPS = 3 if tiny else 20
     WARMUP = 1 if tiny else 3
@@ -250,12 +239,23 @@ def main():
     # launcher/coordinator ranks skip it (the number would be discarded and
     # the probe would cost every rank a compile on the serial queue).
     if staged:
-        N = 512 if tiny else 4096
+        N = 512 if tiny else 16384
+        CHAIN = 4  # dependent matmuls per dispatch: amortizes the relay's
+        # per-dispatch overhead, which dominates single-matmul timings
+        # (measured 2026-07-30: 4096 single = 44 TFLOP/s vs chained
+        # 8192 = 145, 16384 = 183.6 of the 197 bf16 peak).
         x = jnp.ones((N, N), jnp.bfloat16)
+
         # Scale each product by 1/N so chained squarings stay ~1 instead of
         # overflowing to inf within a few iterations (timing matmuls over
         # inf operands can mask value-dependent behavior on some backends).
-        mm = jax.jit(lambda a, b: (a @ b) * (1.0 / N))
+        @jax.jit
+        def mm(a, b):
+            y = a
+            for _ in range(CHAIN):
+                y = (y @ b) * (1.0 / N)
+            return y
+
         log("stage A: compiling matmul probe...")
         chain = {"y": x}  # dependent chain so dispatches cannot overlap away
 
@@ -263,8 +263,9 @@ def main():
             chain["y"] = mm(chain["y"], x)
             return chain["y"]
 
-        mm_dt = timed(mm_step, 3 if tiny else 30, fence)
+        mm_dt = timed(mm_step, 3 if tiny else 5, fence) / CHAIN
         mm_tflops = 2.0 * N ** 3 / mm_dt / 1e12
+        del chain, x  # free ~1.5 GB of HBM before the model stages
         log(f"stage A: {N}x{N} bf16 matmul {mm_dt*1e6:.0f} us, "
             f"{mm_tflops:.1f} TFLOP/s")
         print(json.dumps({
@@ -299,13 +300,22 @@ def main():
             T = 64 if tiny else 512
             from torchmpi_tpu.models import TransformerLM
 
+            # The Pallas flash kernel beats XLA dense attention even at
+            # T=512 on the v5e (8.9 vs 12.1 ms/step measured 2026-07-30,
+            # scripts/hw_tune.py --study lm), so the hardware benchmark
+            # trains the flagship attention path; CPU runs keep the dense
+            # impl (Pallas would drop to the interpreter there).
+            attn = "flash" if platform0 == "tpu" else "local"
             lm = TransformerLM(vocab=8192, embed=64 if tiny else 512,
                                depth=2 if tiny else 4, num_heads=8,
                                head_dim=8 if tiny else 64, max_len=T,
-                               dtype=jnp.bfloat16)
+                               dtype=jnp.bfloat16, attn_impl=attn)
             tok = np.random.RandomState(2).randint(
                 0, 8192, size=(Bt, T)).astype(np.int32)
-            with jax.default_device(init_dev):
+            # flash init must trace on the device platform (pallas_call
+            # cannot lower on the CPU backend); the init graph is small.
+            lm_init_dev = None if attn == "flash" else init_dev
+            with jax.default_device(lm_init_dev):
                 lm_vars = lm.init(jax.random.PRNGKey(1), tok[:1])
             tx_lm = optax.sgd(0.1)
 
@@ -549,16 +559,22 @@ def main():
             params, opt_state, batch_stats, images, labels)
     fence(loss)
     compilecache.mark_compiled(d_key)  # keyed by platform/shape/devices
-    log(f"warmup done in {time.time()-t0:.1f}s; timing {STEPS} steps...")
+    log(f"warmup done in {time.time()-t0:.1f}s; timing rounds of "
+        f"{STEPS} steps...")
 
-    t0 = time.time()
-    for _ in range(STEPS):
-        params, opt_state, batch_stats, loss = dp_step(
-            params, opt_state, batch_stats, images, labels)
-    fence(loss)
-    dt = time.time() - t0
+    rn_state = {"p": params, "o": opt_state, "b": batch_stats}
 
-    img_s = STEPS * batch / dt
+    def rn_step():
+        rn_state["p"], rn_state["o"], rn_state["b"], loss = dp_step(
+            rn_state["p"], rn_state["o"], rn_state["b"], images, labels)
+        rn_state["loss"] = loss  # from the last executed step
+        return loss
+
+    dt = timed(rn_step, STEPS, fence)  # min-of-rounds: relay warm tail
+    params, opt_state, batch_stats = rn_state["p"], rn_state["o"], rn_state["b"]
+    loss = rn_state["loss"]
+
+    img_s = batch / dt
     img_s_chip = img_s / n_dev
 
     # Achieved TFLOP/s + MFU from XLA's own cost model of the compiled
@@ -572,9 +588,9 @@ def main():
     tflops_chip, mfu, flops_src = cost_model_mfu(
         lambda: dp_step.jitted.lower(params, opt_state, batch_stats,
                                      images, labels),
-        dt / STEPS, peak, platform, analytic_flops=rn_flops / n_dev)
+        dt, peak, platform, analytic_flops=rn_flops / n_dev)
 
-    log(f"step time {dt/STEPS*1000:.1f} ms, total {img_s:.1f} img/s, "
+    log(f"step time {dt*1000:.1f} ms, total {img_s:.1f} img/s, "
         f"loss {float(loss):.3f}, {tflops_chip:.4g} TFLOP/s/chip, "
         f"MFU {mfu}")
     print(json.dumps({
@@ -583,7 +599,7 @@ def main():
         "unit": "img/s/chip",
         "vs_baseline": 1.0,
         "extra": {"devices": n_dev, "global_batch": batch,
-                  "step_ms": round(dt / STEPS * 1000, 2),
+                  "step_ms": round(dt * 1000, 2),
                   "dtype": "bfloat16", "image": IMAGE,
                   "tflops_per_chip": round(tflops_chip, 4),
                   "mfu": mfu, "flops_source": flops_src,

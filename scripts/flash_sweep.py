@@ -10,7 +10,6 @@ validates the kernel against).
 import functools
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -20,7 +19,7 @@ import numpy as np
 
 from torchmpi_tpu.ops.flash import flash_attention
 from torchmpi_tpu.parallel.sequence import reference_attention
-from torchmpi_tpu.utils.metrics import fence
+from torchmpi_tpu.utils.metrics import timed
 
 B, T, H, D = 4, 4096, 8, 128
 CONFIGS = [(256, 256), (512, 256), (256, 512), (512, 512),
@@ -28,13 +27,7 @@ CONFIGS = [(256, 256), (512, 256), (256, 512), (512, 512),
 
 
 def bench(f, *a, iters=10):
-    out = f(*a)
-    fence(out)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = f(*a)
-    fence(out)
-    return (time.perf_counter() - t0) / iters
+    return timed(lambda: f(*a), iters)
 
 
 def main():

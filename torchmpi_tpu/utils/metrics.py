@@ -23,6 +23,27 @@ def fence(x) -> None:
     np.asarray(jax.device_get(leaf.ravel()[0] if leaf.ndim else leaf))
 
 
+def timed(step, iters: int, fence=fence, rounds: int = 3) -> float:
+    """Seconds per iteration of ``step``: one warm/compile call, then the
+    FASTEST of ``rounds`` fenced timing rounds of ``iters`` dispatches.
+
+    Min-of-rounds is load-bearing on the relay platform: the first
+    post-compile round can run ~100x slower than steady state (measured
+    2026-07-30: ~600-1100 ms/step settling to ~7 ms) even after a fenced
+    warmup call, so a single timing pass understates throughput 2-3x.
+    The shared harness behind bench.py and the scripts/ sweeps."""
+    out = step()
+    fence(out)
+    best = float("inf")
+    for _ in range(max(1, rounds)):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = step()
+        fence(out)
+        best = min(best, (time.perf_counter() - t0) / iters)
+    return best
+
+
 class Timer:
     """Wall-clock step timer with warmup and fenced boundaries."""
 
