@@ -43,6 +43,11 @@ class SPAttention(nn.Module):
     dtype: jnp.dtype = jnp.float32
     decode: bool = False
     max_len: int = 0
+    # Sliding-window attention (Mistral-style): each query sees itself
+    # plus the window-1 tokens before it.  Supported by the single-device
+    # impls ("local" dense mask, "flash" block-skipping kernel — cost
+    # O(T * window)); sequence-parallel and decode paths reject it.
+    window: Optional[int] = None
 
     @nn.compact
     def __call__(self, x):  # x: [B, T_local, E]
@@ -53,6 +58,13 @@ class SPAttention(nn.Module):
         q, k, v = (qkv[:, :, 0].astype(jnp.float32),
                    qkv[:, :, 1].astype(jnp.float32),
                    qkv[:, :, 2].astype(jnp.float32))
+        if self.window is not None and (self.decode
+                                        or self.attn_impl not in
+                                        ("local", "flash")):
+            raise ValueError(
+                f"window= supports attn_impl='local'/'flash' training "
+                f"steps only (got attn_impl={self.attn_impl!r}, "
+                f"decode={self.decode})")
         if self.decode:
             # Autoregressive KV-cache step: x is the NEW token(s) ([B, 1]
             # in the steady state); keys/values append into this layer's
@@ -127,11 +139,13 @@ class SPAttention(nn.Module):
                 # Heads back together in rank order (= original order).
                 o = lax.all_gather(o, self.seq_axis, axis=2, tiled=True)
         elif self.attn_impl == "local":
-            o = seqlib.reference_attention(q, k, v, causal=True)
+            o = seqlib.reference_attention(q, k, v, causal=True,
+                                           window=self.window)
         elif self.attn_impl == "flash":
             from ..ops.flash import flash_attention_grad
 
-            o = flash_attention_grad(q, k, v, causal=True)
+            o = flash_attention_grad(q, k, v, causal=True,
+                                     window=self.window)
         elif self.attn_impl == "ring":
             o = seqlib.ring_attention(q, k, v, self.seq_axis, causal=True)
         elif self.attn_impl == "ring_flash":
@@ -225,6 +239,7 @@ class Block(nn.Module):
     dtype: jnp.dtype = jnp.float32
     decode: bool = False
     max_len: int = 0
+    window: Optional[int] = None
 
     @nn.compact
     def __call__(self, x):
@@ -232,7 +247,7 @@ class Block(nn.Module):
         h = nn.LayerNorm(dtype=jnp.float32)(x)
         x = x + SPAttention(self.num_heads, self.head_dim, self.attn_impl,
                             self.seq_axis, self.dtype, decode=self.decode,
-                            max_len=self.max_len)(h)
+                            max_len=self.max_len, window=self.window)(h)
         h = nn.LayerNorm(dtype=jnp.float32)(x)
         if self.moe_axis is not None:
             return x + MoEMLP(self.moe_experts_per_device, self.mlp_ratio,
@@ -264,6 +279,8 @@ class TransformerLM(nn.Module):
     # Autoregressive serving: decode=True switches attention to the KV
     # cache ("cache" collection; see models/generate.py for the loop).
     decode: bool = False
+    # Sliding-window attention width (see SPAttention.window).
+    window: Optional[int] = None
 
     @nn.compact
     def __call__(self, tokens, pos_offset=0, return_prehead: bool = False):
@@ -281,7 +298,8 @@ class TransformerLM(nn.Module):
                       moe_experts_per_device=self.moe_experts_per_device,
                       moe_capacity_factor=self.moe_capacity_factor,
                       moe_k=self.moe_k, dtype=self.dtype,
-                      decode=self.decode, max_len=self.max_len)(x)
+                      decode=self.decode, max_len=self.max_len,
+                      window=self.window)(x)
         x = nn.LayerNorm(dtype=jnp.float32)(x)
         # Bias-free explicit unembedding (standard for LMs) so callers can
         # feed (pre-head activations, head matrix) to the fused

@@ -58,23 +58,46 @@ def _resolve_blocks(block_a, block_b, field_a: str, field_b: str):
 
 
 def _block_live(qo_ref, ko_ref, i, j, block_q: int, block_k: int,
-                kv_len: int, causal: bool):
+                kv_len: int, causal: bool, window: Optional[int] = None):
     """Scalar predicate: does block (i, j) have ANY valid score?  The
     block-granular complement of :func:`_valid_mask` — a block is dead
-    when its first k position is past the last q row (causal) or past the
-    kv length.  The kv-length clause is purely defensive — callers pad by
-    less than one block, so the last k block always holds >=1 valid key
-    and in-block padding exclusion is _valid_mask's job.  Offsets are
-    traced SMEM scalars (ring attention), so this is a runtime predicate,
-    not grid pruning; for causal self-attention it halves the compute.
-    Forward and backward kernels MUST skip identically, so all of them
-    call this one helper."""
+    when its first k position is past the last q row (causal), when its
+    last k position is before the oldest key the block's FIRST q row may
+    see (sliding ``window`` — the first q row reaches furthest back), or
+    when it is past the kv length.  The
+    kv-length clause is purely defensive — callers pad by less than one
+    block, so the last k block always holds >=1 valid key and in-block
+    padding exclusion is _valid_mask's job.  Offsets are traced SMEM
+    scalars (ring attention), so this is a runtime predicate, not grid
+    pruning; for causal self-attention it halves the compute, and with a
+    window the live band is O(window/block_k) blocks per q block — the
+    kernel's cost becomes O(T * window) regardless of T.  Forward and
+    backward kernels MUST skip identically, so all of them call this one
+    helper."""
     k_first = ko_ref[0] + j * block_k
     live = k_first < ko_ref[0] + kv_len
     if causal:
-        live = jnp.logical_and(
-            live, k_first <= qo_ref[0] + i * block_q + (block_q - 1))
+        q_first = qo_ref[0] + i * block_q
+        live = jnp.logical_and(live, k_first <= q_first + (block_q - 1))
+        if window is not None:
+            # The OLDEST q row in the block (q_first) reaches furthest
+            # back: it sees keys >= q_first - (window - 1).  A k block
+            # whose last key is older than that serves no q row here.
+            live = jnp.logical_and(
+                live, k_first + (block_k - 1) >= q_first - (window - 1))
     return live
+
+
+def _check_window(window: Optional[int], causal: bool) -> None:
+    """Sliding windows are defined over causal order: ``window`` counts
+    the query itself plus the ``window - 1`` keys before it."""
+    if window is None:
+        return
+    if not causal:
+        raise ValueError("window= requires causal=True (a sliding window "
+                         "is defined over causal order)")
+    if window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
 
 
 def _clamp_block(block: int, t: int, align: int = 128) -> int:
@@ -89,12 +112,66 @@ def _clamp_block(block: int, t: int, align: int = 128) -> int:
     return block
 
 
+
+def _kv_band_start(i, *, qo: int, ko: int, window: int, block_q: int,
+                   block_k: int, nk: int, n_band: int):
+    """First kv-block index of q-block ``i``'s live band (static offsets).
+
+    The oldest key q-block i can see is ``qo + i*block_q - (window-1)``;
+    clamped so the whole band [start, start + n_band) stays inside
+    [0, nk) — edge bands cover extra blocks that _block_live then skips.
+    MUST match the kv index_map exactly (the kernel recomputes the true
+    block index from its band position with this same function)."""
+    lo = qo + i * block_q - (window - 1) - ko
+    return jnp.clip(jnp.floor_divide(lo, block_k), 0, max(nk - n_band, 0))
+
+
+def _q_band_start(j, *, qo: int, ko: int, window: int, block_q: int,
+                  block_k: int, nq: int, n_band: int):
+    """First q-block index of kv-block ``j``'s live band (static offsets):
+    the oldest query that can see this block is ``ko + j*block_k - qo``
+    (causal).  Same clamp/edge contract as :func:`_kv_band_start`."""
+    lo = ko + j * block_k - qo
+    return jnp.clip(jnp.floor_divide(lo, block_q), 0, max(nq - n_band, 0))
+
+
+def _band_setup(window, causal, q_offset, kv_offset, *, span_block: int,
+                step_block: int, n_total: int, start_fn, **start_kw):
+    """(band_start_fn | None, minor grid size): the ONE place the banded
+    sliding-window grid is derived, so the kernel's recomputed block
+    index and the index_map can never disagree.  ``span_block`` is the
+    major dim's block size (its rows define the band's reach),
+    ``step_block`` the minor dim's.  Returns (None, n_total) — full grid
+    — unless a window is set, masking is causal, offsets are static
+    Python ints, and the band is actually narrower than the full axis."""
+    if (window is None or not causal or not isinstance(q_offset, int)
+            or not isinstance(kv_offset, int)):
+        return None, n_total
+    n_band = min(n_total, (span_block + window - 2) // step_block + 2)
+    if n_band >= n_total:
+        return None, n_total
+    fn = functools.partial(start_fn, qo=q_offset, ko=kv_offset,
+                           window=window, n_band=n_band, **start_kw)
+    return fn, n_band
+
+
+def _banded_minor_map(band_fn):
+    """Minor-axis BlockSpec index_map: grid position ``minor`` offset by
+    the band start of ``major`` (identity map when not banded)."""
+    if band_fn is None:
+        return lambda b, h, major, minor: (b, h, minor, 0)
+    return lambda b, h, major, minor: (b, h, band_fn(major) + minor, 0)
+
+
 def _valid_mask(qo_ref, ko_ref, i, j, block_q: int, block_k: int,
-                kv_len: int, causal: bool):
-    """[block_q, block_k] score-validity mask: k-padding rows out, and (for
-    causal) global q position >= global k position.  Forward and backward
-    kernels MUST mask identically — the backward recomputes p against the
-    forward's lse — so all of them call this one helper."""
+                kv_len: int, causal: bool, window: Optional[int] = None):
+    """[block_q, block_k] score-validity mask: k-padding rows out, (for
+    causal) global q position >= global k position, and (for sliding
+    ``window``, causal-only) global q position - global k position <
+    ``window`` — each q attends to itself and the ``window - 1`` keys
+    before it.  Forward and backward kernels MUST mask identically — the
+    backward recomputes p against the forward's lse — so all of them call
+    this one helper."""
     kv_offset = ko_ref[0]
     k_global = kv_offset + j * block_k + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 1)
@@ -103,28 +180,35 @@ def _valid_mask(qo_ref, ko_ref, i, j, block_q: int, block_k: int,
         q_global = qo_ref[0] + i * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0)
         valid = jnp.logical_and(valid, q_global >= k_global)
+        if window is not None:
+            valid = jnp.logical_and(valid, q_global - k_global < window)
     return valid
 
 
 def _flash_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, o_ref, *rest,
                   scale: float, causal: bool, block_q: int, block_k: int,
-                  kv_len: int, residuals: bool):
+                  kv_len: int, residuals: bool,
+                  window: Optional[int] = None, band_j0=None):
     if residuals:
         m_out_ref, l_out_ref, m_ref, l_ref, acc_ref = rest
     else:
         m_ref, l_ref, acc_ref = rest
-    j = pl.program_id(3)
-    nk = pl.num_programs(3)
+    jb = pl.program_id(3)  # band position when band_j0, else kv block
+    nb = pl.num_programs(3)
 
-    @pl.when(j == 0)
+    @pl.when(jb == 0)
     def _init():
         m_ref[:] = jnp.full_like(m_ref, NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
     i = pl.program_id(2)
+    # Banded grid (static offsets + window): the grid's minor dim spans
+    # only the O(window/block_k) live band; recover the true kv-block
+    # index with the SAME band-start function the index_map used.
+    j = band_j0(i) + jb if band_j0 is not None else jb
     live = _block_live(qo_ref, ko_ref, i, j, block_q, block_k, kv_len,
-                       causal)
+                       causal, window)
 
     @pl.when(live)
     def _update():
@@ -136,7 +220,7 @@ def _flash_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, o_ref, *rest,
             preferred_element_type=jnp.float32) * scale  # [bq, bk]
 
         s = jnp.where(_valid_mask(qo_ref, ko_ref, i, j, block_q, block_k,
-                                  kv_len, causal), s, NEG_INF)
+                                  kv_len, causal, window), s, NEG_INF)
 
         m_prev = jnp.max(m_ref[:], axis=1, keepdims=True)  # [block_q, 1]
         m_cur = jnp.max(s, axis=1, keepdims=True)
@@ -155,7 +239,7 @@ def _flash_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, o_ref, *rest,
         m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
         l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
 
-    @pl.when(j == nk - 1)
+    @pl.when(jb == nb - 1)
     def _finalize():
         # Read the running state back from scratch (NOT the _update
         # locals): the final j block can itself be skipped, e.g. the
@@ -180,22 +264,25 @@ def _flash_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, o_ref, *rest,
 def _flash_bwd_dq_kernel(qo_ref, ko_ref, q_ref, do_ref, lse_ref, d_ref,
                          k_ref, v_ref, dq_ref, dq_acc, *, scale: float,
                          causal: bool, block_q: int, block_k: int,
-                         kv_len: int):
+                         kv_len: int, window: Optional[int] = None,
+                         band_j0=None):
     """dq = scale * sum_j [p_ij * (dO_i . v_j - D_i)] k_j, p recomputed
-    blockwise from lse.  Grid (B, H, nq, nk): the dq accumulator carries
-    across the (minor) kv-block dimension."""
-    j = pl.program_id(3)
-    nk = pl.num_programs(3)
+    blockwise from lse.  Grid (B, H, nq, nk) — or (B, H, nq, n_band) on
+    the banded window path; the dq accumulator carries across the (minor)
+    kv dimension."""
+    jb = pl.program_id(3)
+    nb = pl.num_programs(3)
 
-    @pl.when(j == 0)
+    @pl.when(jb == 0)
     def _init():
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
     i = pl.program_id(2)
+    j = band_j0(i) + jb if band_j0 is not None else jb
     # Fully-masked blocks contribute p == 0 everywhere, so dq is
     # unchanged — skip all three matmuls.
     live = _block_live(qo_ref, ko_ref, i, j, block_q, block_k, kv_len,
-                       causal)
+                       causal, window)
 
     @pl.when(live)
     def _update():
@@ -210,7 +297,7 @@ def _flash_bwd_dq_kernel(qo_ref, ko_ref, q_ref, do_ref, lse_ref, d_ref,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
         s = jnp.where(_valid_mask(qo_ref, ko_ref, i, j, block_q, block_k,
-                                  kv_len, causal), s, NEG_INF)
+                                  kv_len, causal, window), s, NEG_INF)
         p = jnp.exp(s - lse)  # masked / fully-masked rows (lse=+1e30): 0
 
         dp = jax.lax.dot_general(
@@ -221,7 +308,7 @@ def _flash_bwd_dq_kernel(qo_ref, ko_ref, q_ref, do_ref, lse_ref, d_ref,
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    @pl.when(j == nk - 1)
+    @pl.when(jb == nb - 1)
     def _finalize():
         dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
 
@@ -229,24 +316,27 @@ def _flash_bwd_dq_kernel(qo_ref, ko_ref, q_ref, do_ref, lse_ref, d_ref,
 def _flash_bwd_dkv_kernel(qo_ref, ko_ref, k_ref, v_ref, q_ref, do_ref,
                           lse_ref, d_ref, dk_ref, dv_ref, dk_acc, dv_acc, *,
                           scale: float, causal: bool, block_q: int,
-                          block_k: int, kv_len: int):
+                          block_k: int, kv_len: int,
+                          window: Optional[int] = None, band_i0=None):
     """dk_j = scale * sum_i ds_ij^T q_i;  dv_j = sum_i p_ij^T dO_i.
-    Grid (B, H, nk, nq): the q-block dimension is minor so the dk/dv
-    accumulators carry across it for one kv block."""
-    i = pl.program_id(3)
-    nq = pl.num_programs(3)
+    Grid (B, H, nk, nq) — or (B, H, nk, n_band) on the banded window
+    path: the q dimension is minor so the dk/dv accumulators carry
+    across it for one kv block."""
+    ib = pl.program_id(3)
+    nb = pl.num_programs(3)
 
-    @pl.when(i == 0)
+    @pl.when(ib == 0)
     def _init():
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
     j = pl.program_id(2)
+    i = band_i0(j) + ib if band_i0 is not None else ib
     # For this kv block, q blocks entirely in its past (causal)
     # contribute p == 0 — skip all four matmuls.  (Padded keys inside a
     # live block are excluded by _valid_mask, not here.)
     live = _block_live(qo_ref, ko_ref, i, j, block_q, block_k, kv_len,
-                       causal)
+                       causal, window)
 
     @pl.when(live)
     def _update():
@@ -261,7 +351,7 @@ def _flash_bwd_dkv_kernel(qo_ref, ko_ref, k_ref, v_ref, q_ref, do_ref,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
         s = jnp.where(_valid_mask(qo_ref, ko_ref, i, j, block_q, block_k,
-                                  kv_len, causal), s, NEG_INF)
+                                  kv_len, causal, window), s, NEG_INF)
         p = jnp.exp(s - lse)  # [block_q, block_k]
 
         dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
@@ -275,7 +365,7 @@ def _flash_bwd_dkv_kernel(qo_ref, ko_ref, k_ref, v_ref, q_ref, do_ref,
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    @pl.when(i == nq - 1)
+    @pl.when(ib == nb - 1)
     def _finalize():
         dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
         dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
@@ -285,6 +375,7 @@ def flash_attention(q, k, v, *, causal: bool = False,
                     scale: Optional[float] = None, q_offset=0, kv_offset=0,
                     block_q: Optional[int] = None,
                     block_k: Optional[int] = None,
+                    window: Optional[int] = None,
                     return_residuals: bool = False, interpret=None):
     """Blocked flash attention on one device.
 
@@ -303,12 +394,19 @@ def flash_attention(q, k, v, *, causal: bool = False,
     :func:`parallel.sequence.reference_attention` to dtype tolerance; the
     [T_q, T_kv] score matrix never exists in memory — VMEM residency is
     O(block_q * block_k + block_q * D) per (batch, head).
+
+    ``window`` (causal only) restricts each query to itself plus the
+    ``window - 1`` keys before it (Mistral-style sliding-window
+    attention); fully-out-of-window k blocks are skipped at block
+    granularity, so cost is O(T * window) instead of O(T^2) — on the
+    traced-offset ring path whole out-of-window kv shards skip too.
     """
     B, Tq, H, D = q.shape
     Tkv = k.shape[1]
     if k.shape != (B, Tkv, H, D) or v.shape != k.shape:
         raise ValueError(f"shape mismatch: q {q.shape} k {k.shape} "
                          f"v {v.shape}")
+    _check_window(window, causal)
     if scale is None:
         scale = 1.0 / (D ** 0.5)
     block_q, block_k = _resolve_blocks(block_q, block_k,
@@ -335,13 +433,25 @@ def flash_attention(q, k, v, *, causal: bool = False,
 
         interpret = ring._interpret_mode()
 
+    # Banded grid (window + STATIC offsets — the single-device model
+    # path): the minor grid dim spans only the live diagonal band, so
+    # iteration count and k/v DMA traffic are O(T * window) instead of
+    # O(T^2).  Traced offsets (ring shards) keep the full grid and rely
+    # on the runtime _block_live skip.
+    band_j0, grid_nk = _band_setup(
+        window, causal, q_offset, kv_offset, span_block=block_q,
+        step_block=block_k, n_total=nk, start_fn=_kv_band_start,
+        block_q=block_q, block_k=block_k, nk=nk)
+
     kernel = functools.partial(
         _flash_kernel, scale=scale, causal=causal, block_q=block_q,
-        block_k=block_k, kv_len=Tkv, residuals=return_residuals)
+        block_k=block_k, kv_len=Tkv, residuals=return_residuals,
+        window=window, band_j0=band_j0)
     qo = jnp.asarray(q_offset, jnp.int32).reshape(1)
     ko = jnp.asarray(kv_offset, jnp.int32).reshape(1)
     smem = pl.BlockSpec(memory_space=pltpu.SMEM)
     o_spec = pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0))
+    kv_map = _banded_minor_map(band_j0)
     out_shape = [jax.ShapeDtypeStruct(
         qt.shape, jnp.float32 if return_residuals else q.dtype)]
     out_specs = [o_spec]
@@ -355,15 +465,13 @@ def flash_attention(q, k, v, *, causal: bool = False,
     result = pl.pallas_call(
         kernel,
         out_shape=out_shape[0] if single else tuple(out_shape),
-        grid=(B, H, nq, nk),
+        grid=(B, H, nq, grid_nk),
         in_specs=[
             smem,
             smem,
             o_spec,
-            pl.BlockSpec((1, 1, block_k, D),
-                         lambda b, h, i, j: (b, h, j, 0)),
-            pl.BlockSpec((1, 1, block_k, D),
-                         lambda b, h, i, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, block_k, D), kv_map),
+            pl.BlockSpec((1, 1, block_k, D), kv_map),
         ],
         out_specs=out_specs[0] if single else tuple(out_specs),
         scratch_shapes=[
@@ -402,7 +510,7 @@ def _stat_lanes(x, Tqp):
 def flash_attention_bwd(q, k, v, do, lse, dvec, *, causal: bool,
                         scale: float, q_offset=0, kv_offset=0,
                         block_q: int = 128, block_k: int = 128,
-                        interpret=None):
+                        window: Optional[int] = None, interpret=None):
     """Gradients (dq, dk, dv) in f32 for one (q-shard, kv-shard) pair.
 
     The flash-attention backward: softmax probabilities are recomputed
@@ -414,6 +522,7 @@ def flash_attention_bwd(q, k, v, do, lse, dvec, *, causal: bool,
     """
     B, Tq, H, D = q.shape
     Tkv = k.shape[1]
+    _check_window(window, causal)
     block_q = _clamp_block(block_q, Tq)
     block_k = _clamp_block(block_k, Tkv)
     pad_q = (-Tq) % block_q
@@ -440,21 +549,31 @@ def flash_attention_bwd(q, k, v, do, lse, dvec, *, causal: bool,
 
         interpret = ring._interpret_mode()
 
+    # Banded grids for static offsets + window — see flash_attention.
+    band_j0, grid_nk = _band_setup(
+        window, causal, q_offset, kv_offset, span_block=block_q,
+        step_block=block_k, n_total=nk, start_fn=_kv_band_start,
+        block_q=block_q, block_k=block_k, nk=nk)
+    band_i0, grid_nq = _band_setup(
+        window, causal, q_offset, kv_offset, span_block=block_k,
+        step_block=block_q, n_total=nq, start_fn=_q_band_start,
+        block_q=block_q, block_k=block_k, nq=nq)
+
     qo = jnp.asarray(q_offset, jnp.int32).reshape(1)
     ko = jnp.asarray(kv_offset, jnp.int32).reshape(1)
     smem = pl.BlockSpec(memory_space=pltpu.SMEM)
     qb = pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0))
-    kb = pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h, j, 0))
+    kb = pl.BlockSpec((1, 1, block_k, D), _banded_minor_map(band_j0))
     sb = pl.BlockSpec((1, 1, block_q, _STAT_LANES),
                       lambda b, h, i, j: (b, h, i, 0))
 
     dq_kernel = functools.partial(
         _flash_bwd_dq_kernel, scale=scale, causal=causal, block_q=block_q,
-        block_k=block_k, kv_len=Tkv)
+        block_k=block_k, kv_len=Tkv, window=window, band_j0=band_j0)
     dq = pl.pallas_call(
         dq_kernel,
         out_shape=jax.ShapeDtypeStruct(qt.shape, jnp.float32),
-        grid=(B, H, nq, nk),
+        grid=(B, H, nq, grid_nk),
         in_specs=[smem, smem, qb, qb, sb, sb, kb, kb],
         out_specs=qb,
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
@@ -464,17 +583,17 @@ def flash_attention_bwd(q, k, v, do, lse, dvec, *, causal: bool,
     # dkv grid puts the q-block dimension minor; index maps swap i and j
     # relative to the dq call (grid = (B, H, nk, nq)).
     kb2 = pl.BlockSpec((1, 1, block_k, D), lambda b, h, j, i: (b, h, j, 0))
-    qb2 = pl.BlockSpec((1, 1, block_q, D), lambda b, h, j, i: (b, h, i, 0))
-    sb2 = pl.BlockSpec((1, 1, block_q, _STAT_LANES),
-                       lambda b, h, j, i: (b, h, i, 0))
+    q_map2 = _banded_minor_map(band_i0)
+    qb2 = pl.BlockSpec((1, 1, block_q, D), q_map2)
+    sb2 = pl.BlockSpec((1, 1, block_q, _STAT_LANES), q_map2)
     dkv_kernel = functools.partial(
         _flash_bwd_dkv_kernel, scale=scale, causal=causal, block_q=block_q,
-        block_k=block_k, kv_len=Tkv)
+        block_k=block_k, kv_len=Tkv, window=window, band_i0=band_i0)
     dk, dv = pl.pallas_call(
         dkv_kernel,
         out_shape=(jax.ShapeDtypeStruct(kt.shape, jnp.float32),
                    jax.ShapeDtypeStruct(kt.shape, jnp.float32)),
-        grid=(B, H, nk, nq),
+        grid=(B, H, nk, grid_nq),
         in_specs=[smem, smem, kb2, kb2, qb2, qb2, sb2, sb2],
         out_specs=(kb2, kb2),
         scratch_shapes=[pltpu.VMEM((block_k, D), jnp.float32),
@@ -499,31 +618,65 @@ def _float0_zero(x):
 
 @functools.lru_cache(maxsize=None)
 def _flash_vjp(causal: bool, scale: float, block_q: int, block_k: int,
-               interp_key):
+               interp_key, window: Optional[int] = None,
+               static_offsets: Optional[tuple] = None):
     """custom_vjp instance per static config.  ``interp_key`` is the
-    resolved interpret setting (hashable: False or InterpretParams)."""
+    resolved interpret setting (hashable: False or InterpretParams).
+
+    ``static_offsets=(qo, ko)`` bakes Python-int offsets into the closure
+    instead of passing them as (traced) arguments — required for the
+    banded sliding-window grids, whose index maps need static offsets;
+    the instance then takes only (q, k, v)."""
 
     kw = dict(causal=causal, scale=scale, block_q=block_q, block_k=block_k,
-              interpret=interp_key)
+              window=window, interpret=interp_key)
+
+    # ONE implementation of the VJP math, parameterized over how offsets
+    # arrive (baked-in static ints vs traced trailing args).
+    def _fwd_core(q, k, v, qo, ko):
+        num, m, l = flash_attention(q, k, v, q_offset=qo, kv_offset=ko,
+                                    return_residuals=True, **kw)
+        denom = jnp.where(l > 0, l, 1.0)
+        o = (num / jnp.moveaxis(denom, 1, 2)[..., None]).astype(q.dtype)
+        return o, lse_from_residuals(m, l)
+
+    def _bwd_core(q, k, v, o, lse, do, qo, ko):
+        dvec = jnp.einsum("bqhd,bqhd->bhq", do.astype(jnp.float32),
+                          o.astype(jnp.float32))
+        dq, dk, dv = flash_attention_bwd(q, k, v, do, lse, dvec,
+                                         q_offset=qo, kv_offset=ko, **kw)
+        return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+    if static_offsets is not None:
+        qo_s, ko_s = static_offsets
+
+        @jax.custom_vjp
+        def fs(q, k, v):
+            return flash_attention(q, k, v, q_offset=qo_s, kv_offset=ko_s,
+                                   **kw)
+
+        def fwd_s(q, k, v):
+            o, lse = _fwd_core(q, k, v, qo_s, ko_s)
+            return o, (q, k, v, o, lse)
+
+        def bwd_s(res, do):
+            q, k, v, o, lse = res
+            return _bwd_core(q, k, v, o, lse, do, qo_s, ko_s)
+
+        fs.defvjp(fwd_s, bwd_s)
+        return fs
 
     @jax.custom_vjp
     def f(q, k, v, qo, ko):
         return flash_attention(q, k, v, q_offset=qo, kv_offset=ko, **kw)
 
     def fwd(q, k, v, qo, ko):
-        num, m, l = flash_attention(q, k, v, q_offset=qo, kv_offset=ko,
-                                    return_residuals=True, **kw)
-        denom = jnp.where(l > 0, l, 1.0)
-        o = (num / jnp.moveaxis(denom, 1, 2)[..., None]).astype(q.dtype)
-        return o, (q, k, v, qo, ko, o, lse_from_residuals(m, l))
+        o, lse = _fwd_core(q, k, v, qo, ko)
+        return o, (q, k, v, qo, ko, o, lse)
 
     def bwd(res, do):
         q, k, v, qo, ko, o, lse = res
-        dvec = jnp.einsum("bqhd,bqhd->bhq", do.astype(jnp.float32),
-                          o.astype(jnp.float32))
-        dq, dk, dv = flash_attention_bwd(q, k, v, do, lse, dvec,
-                                         q_offset=qo, kv_offset=ko, **kw)
-        return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
+        return (*_bwd_core(q, k, v, o, lse, do, qo, ko),
                 _float0_zero(qo), _float0_zero(ko))
 
     f.defvjp(fwd, bwd)
@@ -534,6 +687,7 @@ def flash_attention_grad(q, k, v, *, causal: bool = False,
                          scale: Optional[float] = None, q_offset=0,
                          kv_offset=0, block_q: Optional[int] = None,
                          block_k: Optional[int] = None,
+                         window: Optional[int] = None,
                          interpret=None):
     """Differentiable flash attention (custom VJP with Pallas backward
     kernels).  Same forward semantics as :func:`flash_attention`; gradients
@@ -550,6 +704,19 @@ def flash_attention_grad(q, k, v, *, causal: bool = False,
         from . import ring
 
         interpret = ring._interpret_mode()
-    f = _flash_vjp(causal, float(scale), block_q, block_k, interpret)
+    if (window is not None and isinstance(q_offset, int)
+            and isinstance(kv_offset, int)
+            and q_offset == 0 and kv_offset == 0):
+        # Zero static offsets (the whole-sequence model path) bake into
+        # the closure so the banded O(T*window) grids apply to training
+        # too — traced offsets would defeat them.  Restricted to (0, 0)
+        # to keep the lru-cached VJP instances bounded: distinct nonzero
+        # int offsets (e.g. per-chunk prefill) would each mint a cache
+        # entry + compile; those callers get the traced path instead.
+        f = _flash_vjp(causal, float(scale), block_q, block_k, interpret,
+                      window, static_offsets=(0, 0))
+        return f(q, k, v)
+    f = _flash_vjp(causal, float(scale), block_q, block_k, interpret,
+                   window)
     return f(q, k, v, jnp.asarray(q_offset, jnp.int32),
              jnp.asarray(kv_offset, jnp.int32))

@@ -283,15 +283,26 @@ def ulysses_attention(q, k, v, axis_name: str, *, causal: bool = False,
 
 
 def reference_attention(q, k, v, *, causal: bool = False,
-                        scale: Optional[float] = None):
-    """Single-device full attention (the oracle for the parallel variants)."""
+                        scale: Optional[float] = None,
+                        window: Optional[int] = None):
+    """Single-device full attention (the oracle for the parallel variants).
+
+    ``window`` (causal only) restricts each query to itself plus the
+    ``window - 1`` keys before it — the dense oracle for
+    ``ops.flash``'s sliding-window mode."""
     B, T, H, D = q.shape
     if scale is None:
         scale = 1.0 / (D ** 0.5)
+    if window is not None:
+        from ..ops.flash import _check_window
+
+        _check_window(window, causal)  # same errors as the kernel path
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
     if causal:
         pos = jnp.arange(T)
-        s = jnp.where((pos[:, None] >= pos[None, :])[None, None], s,
-                      -jnp.inf)
+        keep = pos[:, None] >= pos[None, :]
+        if window is not None:
+            keep = keep & (pos[:, None] - pos[None, :] < window)
+        s = jnp.where(keep[None, None], s, -jnp.inf)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)
