@@ -48,16 +48,38 @@ class SPAttention(nn.Module):
     # impls ("local" dense mask, "flash" block-skipping kernel — cost
     # O(T * window)); sequence-parallel and decode paths reject it.
     window: Optional[int] = None
+    # Grouped-query attention: fewer kv heads than q heads (None = MHA).
+    # Each kv head serves num_heads/num_kv_heads consecutive q heads;
+    # the decode KV cache stores only num_kv_heads — the serving-memory
+    # win GQA exists for.  Supported by "local"/"flash" training and
+    # "local" decode; sequence-parallel impls reject it.
+    num_kv_heads: Optional[int] = None
 
     @nn.compact
     def __call__(self, x):  # x: [B, T_local, E]
         B, T, E = x.shape
         H, D = self.num_heads, self.head_dim
-        qkv = nn.DenseGeneral((3, H, D), axis=-1, dtype=self.dtype,
-                              name="qkv")(x)
-        q, k, v = (qkv[:, :, 0].astype(jnp.float32),
-                   qkv[:, :, 1].astype(jnp.float32),
-                   qkv[:, :, 2].astype(jnp.float32))
+        Hkv = self.num_kv_heads if self.num_kv_heads is not None else H
+        if Hkv != H:
+            from ..ops.flash import _gqa_group
+
+            _gqa_group(H, Hkv)  # validates divisibility
+            if self.attn_impl not in ("local", "flash"):
+                raise ValueError(
+                    f"num_kv_heads= supports attn_impl='local'/'flash' "
+                    f"(got {self.attn_impl!r})")
+            q = nn.DenseGeneral((H, D), axis=-1, dtype=self.dtype,
+                                name="q")(x).astype(jnp.float32)
+            kv = nn.DenseGeneral((2, Hkv, D), axis=-1, dtype=self.dtype,
+                                 name="kv")(x)
+            k = kv[:, :, 0].astype(jnp.float32)
+            v = kv[:, :, 1].astype(jnp.float32)
+        else:
+            qkv = nn.DenseGeneral((3, H, D), axis=-1, dtype=self.dtype,
+                                  name="qkv")(x)
+            q, k, v = (qkv[:, :, 0].astype(jnp.float32),
+                       qkv[:, :, 1].astype(jnp.float32),
+                       qkv[:, :, 2].astype(jnp.float32))
         if self.window is not None and (self.decode
                                         or self.attn_impl not in
                                         ("local", "flash")):
@@ -93,8 +115,10 @@ class SPAttention(nn.Module):
                     f"{self.attn_impl!r}")
             if self.max_len <= 0:
                 raise ValueError("decode=True needs max_len > 0")
-            h_cache = H
+            h_cache = Hkv  # GQA: the cache stores only the kv heads
             if ulysses:
+                # (GQA cannot reach here: Hkv != H already restricted
+                # attn_impl to local/flash above.)
                 n_sp = lax.axis_size(self.seq_axis)
                 if H % n_sp != 0:
                     raise ValueError(
@@ -131,10 +155,27 @@ class SPAttention(nn.Module):
                 q_pos = start + jnp.arange(T)
                 kv_pos = jnp.arange(self.max_len)
                 mask = kv_pos[None, :] <= q_pos[:, None]  # [T, max_len]
-                s = jnp.einsum("bqhd,bkhd->bhqk", q, ck.value) / (D ** 0.5)
-                s = jnp.where(mask[None, None], s, -jnp.inf)
-                p = jax.nn.softmax(s, axis=-1)
-                o = jnp.einsum("bhqk,bkhd->bqhd", p, cv.value)
+                if h_cache != q.shape[2]:
+                    # GQA (q has more heads than the cache — under
+                    # ulysses decode q was head-sliced to h_cache too,
+                    # so this is GQA only): GROUP the einsum instead of
+                    # materializing a repeated full-H KV temporary per
+                    # decode step — the cache stays Hkv-headed on the
+                    # wire and in the dot.
+                    g_rep = q.shape[2] // h_cache
+                    qg = q.reshape(B, T, h_cache, g_rep, D)
+                    s = jnp.einsum("bqhgd,bkhd->bhgqk", qg,
+                                   ck.value) / (D ** 0.5)
+                    s = jnp.where(mask[None, None, None], s, -jnp.inf)
+                    p = jax.nn.softmax(s, axis=-1)
+                    o = jnp.einsum("bhgqk,bkhd->bqhgd", p, cv.value)
+                    o = o.reshape(B, T, q.shape[2], D)
+                else:
+                    s = jnp.einsum("bqhd,bkhd->bhqk", q,
+                                   ck.value) / (D ** 0.5)
+                    s = jnp.where(mask[None, None], s, -jnp.inf)
+                    p = jax.nn.softmax(s, axis=-1)
+                    o = jnp.einsum("bhqk,bkhd->bqhd", p, cv.value)
             if ulysses:
                 # Heads back together in rank order (= original order).
                 o = lax.all_gather(o, self.seq_axis, axis=2, tiled=True)
@@ -240,6 +281,7 @@ class Block(nn.Module):
     decode: bool = False
     max_len: int = 0
     window: Optional[int] = None
+    num_kv_heads: Optional[int] = None
 
     @nn.compact
     def __call__(self, x):
@@ -247,7 +289,8 @@ class Block(nn.Module):
         h = nn.LayerNorm(dtype=jnp.float32)(x)
         x = x + SPAttention(self.num_heads, self.head_dim, self.attn_impl,
                             self.seq_axis, self.dtype, decode=self.decode,
-                            max_len=self.max_len, window=self.window)(h)
+                            max_len=self.max_len, window=self.window,
+                            num_kv_heads=self.num_kv_heads)(h)
         h = nn.LayerNorm(dtype=jnp.float32)(x)
         if self.moe_axis is not None:
             return x + MoEMLP(self.moe_experts_per_device, self.mlp_ratio,
@@ -281,6 +324,8 @@ class TransformerLM(nn.Module):
     decode: bool = False
     # Sliding-window attention width (see SPAttention.window).
     window: Optional[int] = None
+    # Grouped-query attention kv-head count (see SPAttention.num_kv_heads).
+    num_kv_heads: Optional[int] = None
 
     @nn.compact
     def __call__(self, tokens, pos_offset=0, return_prehead: bool = False):
@@ -299,7 +344,8 @@ class TransformerLM(nn.Module):
                       moe_capacity_factor=self.moe_capacity_factor,
                       moe_k=self.moe_k, dtype=self.dtype,
                       decode=self.decode, max_len=self.max_len,
-                      window=self.window)(x)
+                      window=self.window,
+                      num_kv_heads=self.num_kv_heads)(x)
         x = nn.LayerNorm(dtype=jnp.float32)(x)
         # Bias-free explicit unembedding (standard for LMs) so callers can
         # feed (pre-head activations, head matrix) to the fused

@@ -88,6 +88,17 @@ def _block_live(qo_ref, ko_ref, i, j, block_q: int, block_k: int,
     return live
 
 
+def _gqa_group(h: int, h_kv: int) -> int:
+    """Query-heads-per-kv-head (grouped-query attention).  1 == MHA;
+    kv head for q head ``h`` is ``h // group`` (the jnp.repeat layout)."""
+    if h_kv == h:
+        return 1
+    if h_kv < 1 or h % h_kv != 0:
+        raise ValueError(f"num q heads {h} must be a multiple of kv "
+                         f"heads {h_kv}")
+    return h // h_kv
+
+
 def _check_window(window: Optional[int], causal: bool) -> None:
     """Sliding windows are defined over causal order: ``window`` counts
     the query itself plus the ``window - 1`` keys before it."""
@@ -155,12 +166,15 @@ def _band_setup(window, causal, q_offset, kv_offset, *, span_block: int,
     return fn, n_band
 
 
-def _banded_minor_map(band_fn):
+def _banded_minor_map(band_fn, head_group: int = 1):
     """Minor-axis BlockSpec index_map: grid position ``minor`` offset by
-    the band start of ``major`` (identity map when not banded)."""
+    the band start of ``major`` (identity map when not banded).
+    ``head_group`` > 1 is GQA: q head ``h`` reads kv head ``h // group``
+    (consecutive q heads share a kv head, the jnp.repeat layout)."""
+    g = head_group
     if band_fn is None:
-        return lambda b, h, major, minor: (b, h, minor, 0)
-    return lambda b, h, major, minor: (b, h, band_fn(major) + minor, 0)
+        return lambda b, h, major, minor: (b, h // g, minor, 0)
+    return lambda b, h, major, minor: (b, h // g, band_fn(major) + minor, 0)
 
 
 def _valid_mask(qo_ref, ko_ref, i, j, block_q: int, block_k: int,
@@ -379,8 +393,13 @@ def flash_attention(q, k, v, *, causal: bool = False,
                     return_residuals: bool = False, interpret=None):
     """Blocked flash attention on one device.
 
-    ``q``: [B, T_q, H, D]; ``k``/``v``: [B, T_kv, H, D] (the bqhd layout of
-    parallel/sequence.py).  Returns [B, T_q, H, D] in ``q``'s dtype — or,
+    ``q``: [B, T_q, H, D]; ``k``/``v``: [B, T_kv, H_kv, D] (the bqhd
+    layout of parallel/sequence.py).  ``H_kv`` may be a divisor of ``H``
+    (grouped-query attention): q head ``h`` attends against kv head
+    ``h // (H // H_kv)`` — the ``jnp.repeat`` layout — with the kv blocks
+    fetched once per group straight from the ``H_kv``-headed arrays, no
+    repeated tensor ever materialized.  Returns [B, T_q, H, D] in ``q``'s
+    dtype — or,
     with ``return_residuals=True``, the tuple ``(numerator, m, l)`` with
     ``numerator`` un-normalized (f32, [B, T_q, H, D]) and ``m``/``l`` the
     per-row softmax max/denominator shaped [B, H, T_q] (f32), the
@@ -402,10 +421,11 @@ def flash_attention(q, k, v, *, causal: bool = False,
     traced-offset ring path whole out-of-window kv shards skip too.
     """
     B, Tq, H, D = q.shape
-    Tkv = k.shape[1]
-    if k.shape != (B, Tkv, H, D) or v.shape != k.shape:
+    Tkv, Hkv = k.shape[1], k.shape[2]
+    if k.shape != (B, Tkv, Hkv, D) or v.shape != k.shape:
         raise ValueError(f"shape mismatch: q {q.shape} k {k.shape} "
                          f"v {v.shape}")
+    group = _gqa_group(H, Hkv)
     _check_window(window, causal)
     if scale is None:
         scale = 1.0 / (D ** 0.5)
@@ -451,7 +471,7 @@ def flash_attention(q, k, v, *, causal: bool = False,
     ko = jnp.asarray(kv_offset, jnp.int32).reshape(1)
     smem = pl.BlockSpec(memory_space=pltpu.SMEM)
     o_spec = pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0))
-    kv_map = _banded_minor_map(band_j0)
+    kv_map = _banded_minor_map(band_j0, group)
     out_shape = [jax.ShapeDtypeStruct(
         qt.shape, jnp.float32 if return_residuals else q.dtype)]
     out_specs = [o_spec]
@@ -521,7 +541,8 @@ def flash_attention_bwd(q, k, v, do, lse, dvec, *, causal: bool,
     rotates.
     """
     B, Tq, H, D = q.shape
-    Tkv = k.shape[1]
+    Tkv, Hkv = k.shape[1], k.shape[2]
+    group = _gqa_group(H, Hkv)
     _check_window(window, causal)
     block_q = _clamp_block(block_q, Tq)
     block_k = _clamp_block(block_k, Tkv)
@@ -563,7 +584,7 @@ def flash_attention_bwd(q, k, v, do, lse, dvec, *, causal: bool,
     ko = jnp.asarray(kv_offset, jnp.int32).reshape(1)
     smem = pl.BlockSpec(memory_space=pltpu.SMEM)
     qb = pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0))
-    kb = pl.BlockSpec((1, 1, block_k, D), _banded_minor_map(band_j0))
+    kb = pl.BlockSpec((1, 1, block_k, D), _banded_minor_map(band_j0, group))
     sb = pl.BlockSpec((1, 1, block_q, _STAT_LANES),
                       lambda b, h, i, j: (b, h, i, 0))
 
@@ -581,25 +602,37 @@ def flash_attention_bwd(q, k, v, do, lse, dvec, *, causal: bool,
     )(qo, ko, qt, dot_, lse_l, d_l, kt, vt)
 
     # dkv grid puts the q-block dimension minor; index maps swap i and j
-    # relative to the dq call (grid = (B, H, nk, nq)).
-    kb2 = pl.BlockSpec((1, 1, block_k, D), lambda b, h, j, i: (b, h, j, 0))
+    # relative to the dq call (grid = (B, H, nk, nq)).  GQA: k/v INPUTS
+    # are fetched at the group's kv head (h // group), but the kernel
+    # emits PER-Q-HEAD dk/dv partials (out at full H) — writing
+    # Hkv-headed outs directly would let each group member's finalize
+    # overwrite the last (out blocks are written, not accumulated).  The
+    # group-sum afterwards is exactly autodiff's transpose of the
+    # jnp.repeat head broadcast.
+    kv_in_map2 = lambda b, h, j, i: (b, h // group, j, 0)  # noqa: E731
+    kb2 = pl.BlockSpec((1, 1, block_k, D), kv_in_map2)
+    dout2 = pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, h, j, i: (b, h, j, 0))
     q_map2 = _banded_minor_map(band_i0)
     qb2 = pl.BlockSpec((1, 1, block_q, D), q_map2)
     sb2 = pl.BlockSpec((1, 1, block_q, _STAT_LANES), q_map2)
     dkv_kernel = functools.partial(
         _flash_bwd_dkv_kernel, scale=scale, causal=causal, block_q=block_q,
         block_k=block_k, kv_len=Tkv, window=window, band_i0=band_i0)
+    dkv_shape = jax.ShapeDtypeStruct((B, H, Tkvp, D), jnp.float32)
     dk, dv = pl.pallas_call(
         dkv_kernel,
-        out_shape=(jax.ShapeDtypeStruct(kt.shape, jnp.float32),
-                   jax.ShapeDtypeStruct(kt.shape, jnp.float32)),
+        out_shape=(dkv_shape, dkv_shape),
         grid=(B, H, nk, grid_nq),
         in_specs=[smem, smem, kb2, kb2, qb2, qb2, sb2, sb2],
-        out_specs=(kb2, kb2),
+        out_specs=(dout2, dout2),
         scratch_shapes=[pltpu.VMEM((block_k, D), jnp.float32),
                         pltpu.VMEM((block_k, D), jnp.float32)],
         interpret=interpret,
     )(qo, ko, kt, vt, qt, dot_, lse_l, d_l)
+    if group > 1:
+        dk = dk.reshape(B, Hkv, group, Tkvp, D).sum(axis=2)
+        dv = dv.reshape(B, Hkv, group, Tkvp, D).sum(axis=2)
 
     if pad_q:
         dq = dq[:, :, :Tq]

@@ -289,7 +289,9 @@ def reference_attention(q, k, v, *, causal: bool = False,
 
     ``window`` (causal only) restricts each query to itself plus the
     ``window - 1`` keys before it — the dense oracle for
-    ``ops.flash``'s sliding-window mode."""
+    ``ops.flash``'s sliding-window mode.  ``k``/``v`` may carry fewer
+    heads than ``q`` (GQA): each kv head serves ``H // H_kv``
+    consecutive q heads, matching the kernel's layout."""
     B, T, H, D = q.shape
     if scale is None:
         scale = 1.0 / (D ** 0.5)
@@ -297,6 +299,12 @@ def reference_attention(q, k, v, *, causal: bool = False,
         from ..ops.flash import _check_window
 
         _check_window(window, causal)  # same errors as the kernel path
+    if k.shape[2] != H:
+        from ..ops.flash import _gqa_group
+
+        g = _gqa_group(H, k.shape[2])
+        k = jnp.repeat(k, g, axis=2)
+        v = jnp.repeat(v, g, axis=2)
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
     if causal:
         pos = jnp.arange(T)
