@@ -151,3 +151,88 @@ def test_deep_resnet_variants_shapes():
         n = sum(int(np.prod(l.shape))
                 for l in jax.tree.leaves(variables["params"]))
         assert abs(n / 1e6 - expect_m) < 0.5, (ctor.__name__, n)
+
+
+def test_transformer_rope_decode_matches_full(flat_runtime):
+    """pos_emb="rope": cached greedy decode == full-recompute argmax (the
+    rotate-then-cache protocol: old entries never re-rotate)."""
+    import jax
+
+    from torchmpi_tpu.models import TransformerLM
+    from torchmpi_tpu.models.generate import generate
+
+    tok = jnp.asarray(np.random.RandomState(70).randint(0, 64, (2, 24)),
+                      jnp.int32)
+    lm = TransformerLM(vocab=64, embed=32, depth=2, num_heads=4,
+                       head_dim=8, max_len=48, pos_emb="rope",
+                       num_kv_heads=2)  # compose with GQA
+    params = lm.init(jax.random.PRNGKey(1), tok)["params"]
+    assert "pos_embed" not in params  # no position table under rope
+    got = generate(lm, params, tok[:, :8], steps=6, temperature=0.0)
+    cur = tok[:, :8]
+    for _ in range(6):
+        logits = lm.apply({"params": params}, cur)
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(cur.dtype)
+        cur = jnp.concatenate([cur, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(cur))
+
+
+def test_transformer_rope_local_vs_flash_with_window(flat_runtime):
+    """rope + sliding window + flash == rope + window + dense mask."""
+    import jax
+
+    from torchmpi_tpu.models import TransformerLM
+
+    tok = jnp.asarray(np.random.RandomState(71).randint(0, 64, (2, 48)),
+                      jnp.int32)
+    outs = {}
+    for impl in ("local", "flash"):
+        lm = TransformerLM(vocab=64, embed=32, depth=2, num_heads=2,
+                           head_dim=16, max_len=48, attn_impl=impl,
+                           pos_emb="rope", window=8)
+        v = lm.init(jax.random.PRNGKey(0), tok)
+        outs[impl] = lm.apply(v, tok)
+    np.testing.assert_allclose(np.asarray(outs["flash"]),
+                               np.asarray(outs["local"]),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_transformer_rope_ring_shards_match_single_device(flat_runtime):
+    """rope under sequence parallelism: each shard rotates by its global
+    offset (pos_offset), so the sharded forward equals the unsharded
+    one."""
+    import jax
+    from jax import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import torchmpi_tpu as mpi
+    from torchmpi_tpu.models import TransformerLM
+
+    mesh = mpi.world_mesh()
+    n = mesh.devices.size
+    B, T = 2, 8 * n
+    tok = jnp.asarray(np.random.RandomState(72).randint(0, 64, (B, T)),
+                      jnp.int32)
+
+    single = TransformerLM(vocab=64, embed=32, depth=2, num_heads=4,
+                           head_dim=8, max_len=T, pos_emb="rope")
+    v = single.init(jax.random.PRNGKey(3), tok)
+    expect = np.asarray(single.apply(v, tok))
+
+    sp = TransformerLM(vocab=64, embed=32, depth=2, num_heads=4,
+                       head_dim=8, max_len=T, pos_emb="rope",
+                       attn_impl="ring", seq_axis=("dcn", "ici"))
+
+    def body(tok_shard):
+        idx = (jax.lax.axis_index("dcn") * jax.lax.axis_size("ici")
+               + jax.lax.axis_index("ici"))
+        t_local = tok_shard.shape[1]
+        return sp.apply(v, tok_shard, pos_offset=idx * t_local)
+
+    spec = P(None, ("dcn", "ici"))
+    sh = NamedSharding(mesh, spec)
+    got = jax.jit(shard_map(body, mesh=mesh, in_specs=(spec,),
+                            out_specs=spec, check_vma=False))(
+        jax.device_put(tok, sh))
+    np.testing.assert_allclose(np.asarray(got), expect, rtol=3e-4,
+                               atol=3e-4)

@@ -35,6 +35,26 @@ from ..parallel import sequence as seqlib
 AxisNames = Union[str, Tuple[str, ...]]
 
 
+def apply_rope(x, pos, *, base: float = 10000.0):
+    """Rotary position embedding (RoPE): rotate feature pairs of ``x``
+    ([B, T, H, D], D even) by angles ``pos[t] * base**(-2i/D)``.  Applied
+    to q and k before attention — relative positions then live in the
+    dot products, so no learned position table exists and decode just
+    rotates each new token by its absolute position (``pos`` may be
+    traced: cache index, ring-shard offset)."""
+    D = x.shape[-1]
+    if D % 2:
+        raise ValueError(f"rope requires an even head_dim, got {D}")
+    half = D // 2
+    inv = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    ang = pos.astype(jnp.float32)[:, None] * inv[None]  # [T, half]
+    cos = jnp.cos(ang)[None, :, None, :]  # [1, T, 1, half]
+    sin = jnp.sin(ang)[None, :, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([x1 * cos - x2 * sin,
+                            x2 * cos + x1 * sin], axis=-1).astype(x.dtype)
+
+
 class SPAttention(nn.Module):
     num_heads: int
     head_dim: int
@@ -54,9 +74,13 @@ class SPAttention(nn.Module):
     # win GQA exists for.  Supported by "local"/"flash" training and
     # "local" decode; sequence-parallel impls reject it.
     num_kv_heads: Optional[int] = None
+    # Rotary position embeddings: rotate q/k by absolute positions
+    # (pos_offset + local index; decode uses the cache index).  The
+    # caller (TransformerLM(pos_emb="rope")) then adds no position table.
+    rope: bool = False
 
     @nn.compact
-    def __call__(self, x):  # x: [B, T_local, E]
+    def __call__(self, x, pos_offset=0):  # x: [B, T_local, E]
         B, T, E = x.shape
         H, D = self.num_heads, self.head_dim
         Hkv = self.num_kv_heads if self.num_kv_heads is not None else H
@@ -87,6 +111,10 @@ class SPAttention(nn.Module):
                 f"window= supports attn_impl='local'/'flash' training "
                 f"steps only (got attn_impl={self.attn_impl!r}, "
                 f"decode={self.decode})")
+        if self.rope and not self.decode:
+            rpos = pos_offset + jnp.arange(T)
+            q = apply_rope(q, rpos)
+            k = apply_rope(k, rpos)
         if self.decode:
             # Autoregressive KV-cache step: x is the NEW token(s) ([B, 1]
             # in the steady state); keys/values append into this layer's
@@ -136,6 +164,13 @@ class SPAttention(nn.Module):
             idx = self.variable("cache", "idx",
                                 lambda: jnp.zeros((), jnp.int32))
             start = idx.value
+            if self.rope:
+                # Rotate by absolute cache positions, THEN cache: the
+                # cache holds rotated keys, so old entries never need
+                # re-rotation as decoding advances.
+                rpos = start + jnp.arange(T)
+                q = apply_rope(q, rpos)
+                k = apply_rope(k, rpos)
             ck.value = lax.dynamic_update_slice(ck.value, k, (0, start, 0, 0))
             cv.value = lax.dynamic_update_slice(cv.value, v, (0, start, 0, 0))
             idx.value = start + T
@@ -282,15 +317,17 @@ class Block(nn.Module):
     max_len: int = 0
     window: Optional[int] = None
     num_kv_heads: Optional[int] = None
+    rope: bool = False
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, pos_offset=0):
         E = x.shape[-1]
         h = nn.LayerNorm(dtype=jnp.float32)(x)
         x = x + SPAttention(self.num_heads, self.head_dim, self.attn_impl,
                             self.seq_axis, self.dtype, decode=self.decode,
                             max_len=self.max_len, window=self.window,
-                            num_kv_heads=self.num_kv_heads)(h)
+                            num_kv_heads=self.num_kv_heads,
+                            rope=self.rope)(h, pos_offset)
         h = nn.LayerNorm(dtype=jnp.float32)(x)
         if self.moe_axis is not None:
             return x + MoEMLP(self.moe_experts_per_device, self.mlp_ratio,
@@ -326,16 +363,23 @@ class TransformerLM(nn.Module):
     window: Optional[int] = None
     # Grouped-query attention kv-head count (see SPAttention.num_kv_heads).
     num_kv_heads: Optional[int] = None
+    # Position encoding: "learned" (absolute table, the default) or
+    # "rope" (rotary embeddings applied to q/k in every attention layer;
+    # no position table - max_len then only bounds the decode cache).
+    pos_emb: str = "learned"
 
     @nn.compact
     def __call__(self, tokens, pos_offset=0, return_prehead: bool = False):
         # tokens: [B, T_local] int32
         B, T = tokens.shape
         x = nn.Embed(self.vocab, self.embed, dtype=self.dtype)(tokens)
-        pos = pos_offset + jnp.arange(T)
-        pe = nn.Embed(self.max_len, self.embed, dtype=self.dtype,
-                      name="pos_embed")(pos)
-        x = x + pe[None]
+        if self.pos_emb == "learned":
+            pos = pos_offset + jnp.arange(T)
+            pe = nn.Embed(self.max_len, self.embed, dtype=self.dtype,
+                          name="pos_embed")(pos)
+            x = x + pe[None]
+        elif self.pos_emb != "rope":
+            raise ValueError(f"unknown pos_emb {self.pos_emb!r}")
         for _ in range(self.depth):
             x = Block(self.num_heads, self.head_dim,
                       attn_impl=self.attn_impl, seq_axis=self.seq_axis,
@@ -345,7 +389,8 @@ class TransformerLM(nn.Module):
                       moe_k=self.moe_k, dtype=self.dtype,
                       decode=self.decode, max_len=self.max_len,
                       window=self.window,
-                      num_kv_heads=self.num_kv_heads)(x)
+                      num_kv_heads=self.num_kv_heads,
+                      rope=self.pos_emb == "rope")(x, pos_offset)
         x = nn.LayerNorm(dtype=jnp.float32)(x)
         # Bias-free explicit unembedding (standard for LMs) so callers can
         # feed (pre-head activations, head matrix) to the fused
