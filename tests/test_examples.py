@@ -53,3 +53,10 @@ def test_moe_generate_example():
     # EP serving path: train expert-parallel, decode expert-parallel on
     # the same mesh (generate_parallel); asserts rule-following output.
     _run("moe_generate.py", "--devices", "8", "--dcn", "2")
+
+
+@pytest.mark.slow
+def test_swa_gqa_lm_example():
+    # Modern-LM stack: rope + sliding-window + GQA trains and decodes
+    # through the kv-heads-only cache; asserts rule-following output.
+    _run("swa_gqa_lm.py", "--devices", "1")

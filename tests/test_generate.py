@@ -80,12 +80,19 @@ def test_generate_rejects_overflow_and_sp():
     with pytest.raises(ValueError, match="max_len"):
         generate(model, params, prompt, steps=10)
 
-    sp = TransformerLM(vocab=8, embed=16, depth=1, num_heads=2, head_dim=8,
+    # flash-trained models serve WITHOUT rebinding attn_impl (decode
+    # attends against the cache either way)...
+    fl = TransformerLM(vocab=8, embed=16, depth=1, num_heads=2, head_dim=8,
                        max_len=16, attn_impl="flash")
     p2 = np.zeros((1, 2), np.int32)
-    params2 = sp.init(jax.random.PRNGKey(0), jnp.asarray(p2))["params"]
+    params2 = fl.init(jax.random.PRNGKey(0), jnp.asarray(p2))["params"]
+    assert generate(fl, params2, p2, steps=2).shape == (1, 4)
+
+    # ...but ring impls have no decode path (sequence-sharded cache).
+    rg = TransformerLM(vocab=8, embed=16, depth=1, num_heads=2, head_dim=8,
+                       max_len=16, attn_impl="ring", seq_axis="ici")
     with pytest.raises(ValueError, match="local"):
-        generate(sp, params2, p2, steps=2)
+        generate(rg, params2, p2, steps=2)
 
 
 def test_generate_parallel_ep_matches_naive(hier_runtime):
@@ -184,3 +191,18 @@ def test_generate_parallel_sampling_shards_differ(hier_runtime):
     # Rows 0/1 live on dcn shard 0, rows 2/3 on shard 1: folded rngs must
     # decorrelate the shards.
     assert not np.array_equal(out[0], out[2])
+
+
+def test_generate_windowed_model_matches_full_recompute():
+    """A sliding-window model decodes through the cache with the SAME
+    band mask it trained with: cached greedy == full-recompute greedy of
+    the windowed model, even past the window length."""
+    model = TransformerLM(vocab=37, embed=32, depth=2, num_heads=2,
+                          head_dim=8, max_len=32, window=4)
+    rng = np.random.RandomState(5)
+    prompt = rng.randint(0, 37, size=(2, 6)).astype(np.int32)
+    params = model.init(jax.random.PRNGKey(2),
+                        jnp.asarray(prompt))["params"]
+    expect = _naive_greedy(model, params, prompt, steps=10)  # 16 > window
+    got = np.asarray(generate(model, params, prompt, steps=10))
+    np.testing.assert_array_equal(got, expect)

@@ -66,13 +66,14 @@ class SPAttention(nn.Module):
     # Sliding-window attention (Mistral-style): each query sees itself
     # plus the window-1 tokens before it.  Supported by the single-device
     # impls ("local" dense mask, "flash" block-skipping kernel — cost
-    # O(T * window)); sequence-parallel and decode paths reject it.
+    # O(T * window)) for both training and decode (the cache mask applies
+    # the same band); sequence-parallel impls reject it.
     window: Optional[int] = None
     # Grouped-query attention: fewer kv heads than q heads (None = MHA).
     # Each kv head serves num_heads/num_kv_heads consecutive q heads;
     # the decode KV cache stores only num_kv_heads — the serving-memory
-    # win GQA exists for.  Supported by "local"/"flash" training and
-    # "local" decode; sequence-parallel impls reject it.
+    # win GQA exists for.  Supported by the "local"/"flash" impls for
+    # both training and decode; sequence-parallel impls reject it.
     num_kv_heads: Optional[int] = None
     # Rotary position embeddings: rotate q/k by absolute positions
     # (pos_offset + local index; decode uses the cache index).  The
@@ -104,13 +105,11 @@ class SPAttention(nn.Module):
             q, k, v = (qkv[:, :, 0].astype(jnp.float32),
                        qkv[:, :, 1].astype(jnp.float32),
                        qkv[:, :, 2].astype(jnp.float32))
-        if self.window is not None and (self.decode
-                                        or self.attn_impl not in
-                                        ("local", "flash")):
+        if self.window is not None and self.attn_impl not in ("local",
+                                                              "flash"):
             raise ValueError(
-                f"window= supports attn_impl='local'/'flash' training "
-                f"steps only (got attn_impl={self.attn_impl!r}, "
-                f"decode={self.decode})")
+                f"window= supports attn_impl='local'/'flash' (got "
+                f"attn_impl={self.attn_impl!r})")
         if self.rope and not self.decode:
             rpos = pos_offset + jnp.arange(T)
             q = apply_rope(q, rpos)
@@ -136,9 +135,13 @@ class SPAttention(nn.Module):
             # cache cannot serve one new global token a step).
             ulysses = (self.attn_impl in ("ulysses", "ulysses_flash")
                        and self.seq_axis is not None)
-            if self.attn_impl != "local" and not ulysses:
+            # "flash" is accepted as an alias of "local" here: decode
+            # attends against the cache with the einsum below either
+            # way (the train-time kernel never runs in decode), so a
+            # flash-trained model serves without rebinding attn_impl.
+            if self.attn_impl not in ("local", "flash") and not ulysses:
                 raise ValueError(
-                    f"decode=True supports attn_impl='local' (or "
+                    f"decode=True supports attn_impl='local'/'flash' (or "
                     f"'ulysses' under generate_parallel), got "
                     f"{self.attn_impl!r}")
             if self.max_len <= 0:
@@ -182,7 +185,8 @@ class SPAttention(nn.Module):
                 # FLOPs/memory).  Assumes start == 0, which is the only
                 # way the serving path produces T > 1; chunked prefill
                 # with history would need the cache-prefix form.
-                o = seqlib.reference_attention(q, k, v, causal=True)
+                o = seqlib.reference_attention(q, k, v, causal=True,
+                                               window=self.window)
             else:
                 # Steady-state single-token step: query the filled cache.
                 # Causal mask over the cache: query t attends to cache
@@ -190,6 +194,13 @@ class SPAttention(nn.Module):
                 q_pos = start + jnp.arange(T)
                 kv_pos = jnp.arange(self.max_len)
                 mask = kv_pos[None, :] <= q_pos[:, None]  # [T, max_len]
+                if self.window is not None:
+                    # Sliding window over the cache: same band the
+                    # training mask applied, so decode logits match the
+                    # trained distribution past the window.  (The cache
+                    # still stores max_len entries; a rolling buffer is
+                    # a memory optimization, not a semantics change.)
+                    mask &= kv_pos[None, :] > q_pos[:, None] - self.window
                 if h_cache != q.shape[2]:
                     # GQA (q has more heads than the cache — under
                     # ulysses decode q was head-sliced to h_cache too,
