@@ -64,10 +64,13 @@ class SPAttention(nn.Module):
     decode: bool = False
     max_len: int = 0
     # Sliding-window attention (Mistral-style): each query sees itself
-    # plus the window-1 tokens before it.  Supported by the single-device
-    # impls ("local" dense mask, "flash" block-skipping kernel — cost
-    # O(T * window)) for both training and decode (the cache mask applies
-    # the same band); sequence-parallel impls reject it.
+    # plus the window-1 tokens before it.  Supported by every impl:
+    # local/flash (banded O(T*window) kernel grids), ring/ring_flash
+    # (global-position band; the flash blocks skip fully-out-of-window
+    # work at runtime — the dense ring masks but still pays its einsum,
+    # and all n rotations run either way), ulysses/ulysses_flash (banded
+    # grids on each head shard), and decode (the cache mask applies the
+    # same band).
     window: Optional[int] = None
     # Grouped-query attention: fewer kv heads than q heads (None = MHA).
     # Each kv head serves num_heads/num_kv_heads consecutive q heads;
@@ -105,11 +108,6 @@ class SPAttention(nn.Module):
             q, k, v = (qkv[:, :, 0].astype(jnp.float32),
                        qkv[:, :, 1].astype(jnp.float32),
                        qkv[:, :, 2].astype(jnp.float32))
-        if self.window is not None and self.attn_impl not in ("local",
-                                                              "flash"):
-            raise ValueError(
-                f"window= supports attn_impl='local'/'flash' (got "
-                f"attn_impl={self.attn_impl!r})")
         if self.rope and not self.decode:
             rpos = pos_offset + jnp.arange(T)
             q = apply_rope(q, rpos)
@@ -234,15 +232,19 @@ class SPAttention(nn.Module):
             o = flash_attention_grad(q, k, v, causal=True,
                                      window=self.window)
         elif self.attn_impl == "ring":
-            o = seqlib.ring_attention(q, k, v, self.seq_axis, causal=True)
+            o = seqlib.ring_attention(q, k, v, self.seq_axis, causal=True,
+                                      window=self.window)
         elif self.attn_impl == "ring_flash":
             o = seqlib.ring_attention(q, k, v, self.seq_axis, causal=True,
-                                      block_impl="flash")
+                                      block_impl="flash",
+                                      window=self.window)
         elif self.attn_impl == "ulysses":
-            o = seqlib.ulysses_attention(q, k, v, self.seq_axis, causal=True)
+            o = seqlib.ulysses_attention(q, k, v, self.seq_axis, causal=True,
+                                         window=self.window)
         elif self.attn_impl == "ulysses_flash":
             o = seqlib.ulysses_attention(q, k, v, self.seq_axis, causal=True,
-                                         block_impl="flash")
+                                         block_impl="flash",
+                                         window=self.window)
         else:
             raise ValueError(f"unknown attn_impl {self.attn_impl!r}")
         o = o.astype(self.dtype).reshape(B, T, H * D)

@@ -72,10 +72,22 @@ def _attn_block(q, k, v, scale, mask):
     return o, m, l
 
 
+
+def causal_window_mask(q_pos, k_pos, window=None):
+    """[Tq, Tk] bool: causal over global positions, optionally restricted
+    to the sliding band ``q - k < window``.  The ONE definition of the
+    band every dense path (ring, ulysses, reference oracle) shares."""
+    keep = q_pos[:, None] >= k_pos[None, :]
+    if window is not None:
+        keep &= q_pos[:, None] - k_pos[None, :] < window
+    return keep
+
+
 def ring_attention(q, k, v, axis_name: str, *, causal: bool = False,
                    scale: Optional[float] = None, block_impl: str = "dense",
                    block_q: Optional[int] = None,
-                   block_k: Optional[int] = None):
+                   block_k: Optional[int] = None,
+                   window: Optional[int] = None):
     """Blockwise ring attention over a sequence-sharded axis.
 
     Shapes (per device): q, k, v — ``[batch, seq_local, heads, head_dim]``,
@@ -95,6 +107,10 @@ def ring_attention(q, k, v, axis_name: str, *, causal: bool = False,
     shards; the kv owner's global offset rides into the kernel as a traced
     SMEM scalar).
     """
+    if window is not None:
+        from ..ops.flash import _check_window
+
+        _check_window(window, causal)
     if block_impl == "flash":
         if scale is None:
             scale = 1.0 / (q.shape[-1] ** 0.5)
@@ -105,7 +121,7 @@ def ring_attention(q, k, v, axis_name: str, *, causal: bool = False,
         axis_key = (axis_name if isinstance(axis_name, str)
                     else tuple(axis_name))
         return _ring_flash_vjp(axis_key, causal, float(scale), block_q,
-                               block_k)(q, k, v)
+                               block_k, window)(q, k, v)
     if block_impl != "dense":
         raise ValueError(f"unknown block_impl {block_impl!r}")
     n = lax.axis_size(axis_name)
@@ -126,7 +142,7 @@ def ring_attention(q, k, v, axis_name: str, *, causal: bool = False,
         if not causal:
             return None
         k_pos = kv_owner * k.shape[1] + jnp.arange(k.shape[1])
-        return q_pos[:, None] >= k_pos[None, :]
+        return causal_window_mask(q_pos, k_pos, window)
 
     for step in range(n):  # n is static: unrolled
         kv_owner = lax.rem(my - step + n, n)
@@ -141,7 +157,7 @@ def ring_attention(q, k, v, axis_name: str, *, causal: bool = False,
 
 
 def _ring_flash_forward(q, k, v, axis_name, causal, scale, block_q,
-                        block_k):
+                        block_k, window=None):
     """Ring forward with Pallas flash blocks; returns (o, lse) with f32
     softmax statistics (lse feeds the backward's blockwise recompute)."""
     from ..ops.flash import flash_attention, lse_from_residuals
@@ -161,7 +177,7 @@ def _ring_flash_forward(q, k, v, axis_name, causal, scale, block_q,
         o_b, m_b, l_b = flash_attention(
             q, k, v, causal=causal, scale=scale, q_offset=my * Tq,
             kv_offset=kv_owner * Tk, block_q=block_q, block_k=block_k,
-            return_residuals=True)
+            window=window, return_residuals=True)
         m_run, l_run, o_run = _combine(m_run, l_run, o_run, o_b, m_b, l_b)
         if step != n - 1:
             k = lax.ppermute(k, axis_name, perm)
@@ -175,7 +191,7 @@ def _ring_flash_forward(q, k, v, axis_name, causal, scale, block_q,
 
 @functools.lru_cache(maxsize=None)
 def _ring_flash_vjp(axis_name, causal: bool, scale: float, block_q: int,
-                    block_k: int):
+                    block_k: int, window: Optional[int] = None):
     """Ring attention as one differentiable unit: Pallas kernels in both
     directions, with the backward running its own ring — (k, v) and the
     (dk, dv) accumulators rotate together for a full cycle (n ppermutes, so
@@ -186,11 +202,11 @@ def _ring_flash_vjp(axis_name, causal: bool, scale: float, block_q: int,
     @jax.custom_vjp
     def f(q, k, v):
         return _ring_flash_forward(q, k, v, axis_name, causal, scale,
-                                   block_q, block_k)[0]
+                                   block_q, block_k, window)[0]
 
     def fwd(q, k, v):
         o, lse = _ring_flash_forward(q, k, v, axis_name, causal, scale,
-                                     block_q, block_k)
+                                     block_q, block_k, window)
         return o, (q, k, v, o, lse)
 
     def bwd(res, do):
@@ -212,7 +228,7 @@ def _ring_flash_vjp(axis_name, causal: bool, scale: float, block_q: int,
             dq_c, dk_c, dv_c = flash_attention_bwd(
                 q, k_cur, v_cur, do, lse, dvec, causal=causal, scale=scale,
                 q_offset=my * Tq, kv_offset=kv_owner * Tk, block_q=block_q,
-                block_k=block_k)
+                block_k=block_k, window=window)
             dq = dq + dq_c
             dk_cur = dk_cur + dk_c
             dv_cur = dv_cur + dv_c
@@ -233,7 +249,8 @@ def _ring_flash_vjp(axis_name, causal: bool, scale: float, block_q: int,
 
 def ulysses_attention(q, k, v, axis_name: str, *, causal: bool = False,
                       scale: Optional[float] = None,
-                      block_impl: str = "dense"):
+                      block_impl: str = "dense",
+                      window: Optional[int] = None):
     """All-to-all (DeepSpeed-Ulysses style) sequence-parallel attention.
 
     Shapes (per device): ``[batch, seq_local, heads, head_dim]`` with
@@ -250,6 +267,10 @@ def ulysses_attention(q, k, v, axis_name: str, *, causal: bool = False,
     """
     n = lax.axis_size(axis_name)
     B, Tl, H, D = q.shape
+    if window is not None:
+        from ..ops.flash import _check_window
+
+        _check_window(window, causal)
     if H % n != 0:
         raise ValueError(f"heads {H} not divisible by axis size {n}")
     if scale is None:
@@ -268,15 +289,18 @@ def ulysses_attention(q, k, v, axis_name: str, *, causal: bool = False,
     if block_impl == "flash":
         from ..ops.flash import flash_attention_grad
 
+        # Static zero offsets: with a window this takes the banded
+        # O(T*window) kernel grids on each device's head subset.
         return heads_to_seq(
-            flash_attention_grad(qg, kg, vg, causal=causal, scale=scale))
+            flash_attention_grad(qg, kg, vg, causal=causal, scale=scale,
+                                 window=window))
     if block_impl != "dense":
         raise ValueError(f"unknown block_impl {block_impl!r}")
     T = qg.shape[1]
     mask = None
     if causal:
         pos = jnp.arange(T)
-        mask = pos[:, None] >= pos[None, :]
+        mask = causal_window_mask(pos, pos, window)
     o, m, l = _attn_block(qg, kg, vg, scale, mask)
     denom = jnp.where(l > 0, l, 1.0).transpose(0, 2, 1)[..., None]
     return heads_to_seq(o / denom)
@@ -308,9 +332,7 @@ def reference_attention(q, k, v, *, causal: bool = False,
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
     if causal:
         pos = jnp.arange(T)
-        keep = pos[:, None] >= pos[None, :]
-        if window is not None:
-            keep = keep & (pos[:, None] - pos[None, :] < window)
-        s = jnp.where(keep[None, None], s, -jnp.inf)
+        s = jnp.where(causal_window_mask(pos, pos, window)[None, None], s,
+                      -jnp.inf)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)
