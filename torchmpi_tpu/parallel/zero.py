@@ -1,4 +1,5 @@
-"""ZeRO-1 data parallelism: shard the optimizer state over the DP axes.
+"""ZeRO-1/ZeRO-3 data parallelism: shard optimizer state (and params) over
+the DP axes.
 
 Beyond-reference (TorchMPI is replicated-state DP only — SURVEY.md §3.3),
 but it is the natural TPU-native evolution of the same allreduce step: the
@@ -9,7 +10,7 @@ the largest replicated memory term after the params themselves.  On a
 (dcn, ici) mesh the reduce_scatter/all_gather legs ride the same
 selector-routed collectives as :func:`gradsync.synchronize_gradients`.
 
-Usage, inside a ``shard_map``-based train step (per-device code)::
+ZeRO-1 usage, inside a ``shard_map``-based train step (per-device code)::
 
     opt_state = zero.init(params, tx, axes, mesh=mesh)   # sharded state
     ...
@@ -18,7 +19,27 @@ Usage, inside a ``shard_map``-based train step (per-device code)::
         params, opt_state = zero.update(params, grads, opt_state, tx, axes)
         ...
 
-or end-to-end via ``recipes.make_bn_dp_train_step(..., zero=True)``.
+ZeRO-3 goes one level further: the PARAMETERS are also stored sharded
+between steps (each device holds a flat 1/n shard); the step all-gathers
+them transiently for forward+backward and reduce-scatters the gradients
+back to shards — persistent memory for params AND optimizer state is 1/n,
+with the full parameters existing only for the duration of a step::
+
+    p_shard = zero.shard_params(params, axes, mesh=mesh)
+    opt_state = zero.init(params, tx, axes, mesh=mesh)   # same state shape
+    spec = zero.flat_spec(params, axes, mesh=mesh)       # static metadata
+    ...
+    def step(p_shard, opt_state, batch):                 # inside shard_map
+        params = zero.gather_params(p_shard, spec, axes)
+        grads = jax.grad(loss)(params, batch)
+        p_shard, opt_state = zero.update3(p_shard, grads, opt_state, tx,
+                                          axes, spec=spec)
+        ...
+
+End-to-end via ``recipes.make_bn_dp_train_step(..., zero=1)`` (state
+sharded) or ``zero=3`` (state + params sharded), or annotation-driven FSDP
+via ``recipes.make_fsdp_train_step`` (per-parameter GSPMD shardings — XLA
+schedules the per-use gathers itself).
 """
 
 from __future__ import annotations
@@ -150,26 +171,9 @@ def update(params: PyTree, grads: PyTree, opt_state: PyTree,
     if axis_names is None:
         axis_names = tuple(runtime.current_mesh().axis_names)
     axes = _axes_tuple(axis_names)
-    cfg = runtime.config() if runtime.is_initialized() else None
-    if op is None:
-        op = "mean" if (cfg is None or cfg.gradsync_average) else "sum"
-    if op not in ("mean", "sum"):
-        raise ValueError(f"zero.update op must be mean|sum, got {op!r}")
-    if compress is None and cfg is not None:
-        compress = cfg.gradsync_compress
-    if compress not in (None, "none", "bf16"):
-        raise ValueError(f"unknown gradient compression {compress!r}")
-
-    n = _axis_size(axes)
-    spec = _FlatSpec(params, int(n))
-    g_flat = _flatten(grads, spec)
-    if compress == "bf16":
-        g_flat = g_flat.astype(jnp.bfloat16)
-    g_shard = collectives.reduce_scatter_in_axis(g_flat, axes,
-                                                 backend=backend)
-    g_shard = g_shard.astype(spec.dtype)
-    if op == "mean":
-        g_shard = g_shard / n
+    g_shard, spec = _reduce_scatter_grads(grads, axes, spec=None,
+                                          params=params, op=op,
+                                          backend=backend, compress=compress)
     p_shard = lax.dynamic_slice(
         _flatten(params, spec), (_axis_index(axes) * spec.shard,),
         (spec.shard,))
@@ -178,3 +182,130 @@ def update(params: PyTree, grads: PyTree, opt_state: PyTree,
     p_flat = collectives.allgather_in_axis(p_shard, axes,
                                            backend=backend).reshape(-1)
     return _unflatten(p_flat, spec), new_state
+
+
+def _reduce_scatter_grads(grads: PyTree, axes: Tuple[str, ...], *,
+                          spec: Optional[_FlatSpec],
+                          params: Optional[PyTree],
+                          op: Optional[str],
+                          backend: Optional[str],
+                          compress: Optional[str]
+                          ) -> Tuple[jax.Array, _FlatSpec]:
+    """The shared ZeRO gradient leg (ZeRO-1 :func:`update` and ZeRO-3
+    :func:`update3`): resolve op/compress defaults from config (validated
+    BEFORE any axis/tracing use, so bad arguments raise eagerly outside
+    shard_map too), flatten, optionally bf16-compress the wire,
+    reduce_scatter over ``axes``, restore dtype, apply mean scaling.
+    Pass either a prebuilt ``spec`` (ZeRO-3) or ``params`` to derive one
+    (ZeRO-1).  Returns ``(flat gradient shard, spec)``."""
+    cfg = runtime.config() if runtime.is_initialized() else None
+    if op is None:
+        op = "mean" if (cfg is None or cfg.gradsync_average) else "sum"
+    if op not in ("mean", "sum"):
+        raise ValueError(f"zero update op must be mean|sum, got {op!r}")
+    if compress is None and cfg is not None:
+        compress = cfg.gradsync_compress
+    if compress not in (None, "none", "bf16"):
+        raise ValueError(f"unknown gradient compression {compress!r}")
+
+    n = _axis_size(axes)
+    if spec is None:
+        spec = _FlatSpec(params, int(n))
+    g_flat = _flatten(grads, spec)
+    if compress == "bf16":
+        g_flat = g_flat.astype(jnp.bfloat16)
+    g_shard = collectives.reduce_scatter_in_axis(g_flat, axes,
+                                                 backend=backend)
+    g_shard = g_shard.astype(spec.dtype)
+    if op == "mean":
+        g_shard = g_shard / n
+    return g_shard, spec
+
+
+# --------------------------------------------------------------------------
+# ZeRO-3: parameters sharded between steps as well.
+
+
+def flat_spec(params: PyTree, axis_names: Optional[AxisNames] = None, *,
+              mesh: Optional[Mesh] = None) -> _FlatSpec:
+    """Static flatten/shard metadata for ``params`` over ``axis_names`` —
+    the one object :func:`gather_params` / :func:`update3` need to map
+    between the flat shard and the structured pytree.  Build it OUTSIDE
+    jit from the real (or eval_shape'd) parameter pytree."""
+    _, _, n = _resolve(axis_names, mesh)
+    return _FlatSpec(params, n)
+
+
+def shard_params(params: PyTree, axis_names: Optional[AxisNames] = None, *,
+                 mesh: Optional[Mesh] = None) -> jax.Array:
+    """Slice a replicated parameter pytree down to this device's flat
+    ZeRO-3 shard ``[shard]``, physically sharded ``P(axes)`` across the
+    mesh.  Init-time convenience (runs its own jitted shard_map), like
+    :func:`init`."""
+    m, axes, n = _resolve(axis_names, mesh)
+    spec = _FlatSpec(params, n)
+
+    def body(params):
+        return lax.dynamic_slice(
+            _flatten(params, spec), (_axis_index(axes) * spec.shard,),
+            (spec.shard,))
+
+    return jax.jit(shard_map(
+        body, mesh=m, in_specs=P(), out_specs=P(axes),
+        check_vma=False))(params)
+
+
+def gather_params(p_shard: jax.Array, spec: _FlatSpec,
+                  axis_names: AxisNames, *,
+                  backend: Optional[str] = None) -> PyTree:
+    """All-gather the flat ZeRO-3 shards into the full parameter pytree —
+    the transient materialization at the top of a step.  For use INSIDE a
+    shard_map'd step; selector-routed like every other collective."""
+    axes = _axes_tuple(axis_names)
+    flat = collectives.allgather_in_axis(p_shard, axes,
+                                         backend=backend).reshape(-1)
+    return _unflatten(flat, spec)
+
+
+def update3(p_shard: jax.Array, grads: PyTree, opt_state: PyTree,
+            tx: optax.GradientTransformation,
+            axis_names: AxisNames, *, spec: _FlatSpec,
+            op: Optional[str] = None,
+            backend: Optional[str] = None,
+            compress: Optional[str] = None
+            ) -> Tuple[jax.Array, PyTree]:
+    """One ZeRO-3 step, for use INSIDE a shard_map'd train step.
+
+    reduce_scatter the flat gradients over ``axis_names``, apply ``tx`` on
+    the local shard, and return the updated FLAT SHARD — unlike
+    :func:`update` there is no trailing all_gather: the parameters stay
+    sharded until the next step's :func:`gather_params`.  Defaults
+    (``op``/``compress``) follow :func:`update`.
+
+    Returns ``(new_p_shard, new_opt_state)`` — numerically identical to
+    allreduce-then-update replicated DP (test_zero.py proves it).
+    """
+    axes = _axes_tuple(axis_names)
+    g_shard, _ = _reduce_scatter_grads(grads, axes, spec=spec, params=None,
+                                       op=op, backend=backend,
+                                       compress=compress)
+    updates, new_state = tx.update(g_shard, opt_state, p_shard)
+    return optax.apply_updates(p_shard, updates), new_state
+
+
+def unshard_params(p_shard: jax.Array, params_template: PyTree,
+                   axis_names: Optional[AxisNames] = None, *,
+                   mesh: Optional[Mesh] = None) -> PyTree:
+    """Reassemble the full replicated parameter pytree from ZeRO-3 shards
+    (checkpoint export / eval).  Init-time convenience mirror of
+    :func:`shard_params`."""
+    m, axes, n = _resolve(axis_names, mesh)
+    spec = _FlatSpec(params_template, n)
+
+    def body(p_shard):
+        return gather_params(p_shard, spec, axes)
+
+    return jax.jit(shard_map(
+        body, mesh=m, in_specs=P(axes),
+        out_specs=jax.tree.map(lambda _: P(), params_template),
+        check_vma=False))(p_shard)

@@ -30,7 +30,8 @@ def make_bn_dp_train_step(
     n_buckets: Optional[int] = None,
     donate: bool = True,
     remat: bool = False,
-    zero: bool = False,
+    zero: int = 0,
+    params_template: Any = None,
 ) -> Callable:
     """Build the canonical data-parallel SGD step for a flax model carrying a
     ``batch_stats`` (BatchNorm) collection.
@@ -40,7 +41,7 @@ def make_bn_dp_train_step(
     allreduced through the selector-routed backend, BatchNorm running stats
     cross-replica averaged on the same path, loss reduced for logging.
 
-    ``zero=True`` switches gradient sync + update to ZeRO-1
+    ``zero=1`` (or ``True``) switches gradient sync + update to ZeRO-1
     (:mod:`torchmpi_tpu.parallel.zero`): reduce_scatter / shard-local
     optimizer / all_gather, with the optimizer state physically sharded
     over the mesh — numerically identical, 1/n the optimizer memory.
@@ -48,9 +49,28 @@ def make_bn_dp_train_step(
     ``tx.init``); ``n_buckets`` does not apply (the reduce_scatter is one
     fused collective); ``Config(gradsync_compress="bf16")`` is honored on
     the gradient reduce_scatter exactly like the replicated path.
+
+    ``zero=3`` additionally stores the PARAMETERS sharded between steps:
+    the step's ``params`` argument is the flat shard from
+    ``zero.shard_params(params, mesh=mesh)``, all-gathered transiently at
+    the top of each step and never re-materialized after the update —
+    persistent params + optimizer memory both drop to 1/n.  Export full
+    params with ``zero.unshard_params``.  ``batch_stats`` stays replicated
+    (it is updated by a cross-replica mean, not by ``tx``).
     """
+    zero = int(zero)
+    if zero not in (0, 1, 3):
+        raise ValueError(f"zero must be 0, 1, or 3, got {zero}")
     m = mesh if mesh is not None else runtime.current_mesh()
     axes = tuple(m.axis_names)
+    spec3 = None
+    if zero == 3:
+        if params_template is None:
+            raise ValueError(
+                "zero=3 stores params as a flat shard; pass params_template"
+                " (the full parameter pytree, or its eval_shape) so the step"
+                " can map shards back to the model structure")
+        spec3 = parallel_zero.flat_spec(params_template, axes, mesh=m)
 
     def forward(variables, images):
         return model.apply(variables, images, train=True,
@@ -63,6 +83,12 @@ def make_bn_dp_train_step(
         forward = jax.checkpoint(forward)
 
     def step(params, opt_state, batch_stats, images, labels):
+        # zero=3: ``params`` is the flat shard; materialize the full tree
+        # only for the duration of this step.
+        full = (parallel_zero.gather_params(params, spec3, axes,
+                                            backend=backend)
+                if zero == 3 else params)
+
         def loss_fn(p):
             logits, updated = forward(
                 {"params": p, "batch_stats": batch_stats}, images)
@@ -71,10 +97,14 @@ def make_bn_dp_train_step(
             return loss, updated["batch_stats"]
 
         (loss, new_stats), grads = jax.value_and_grad(
-            loss_fn, has_aux=True)(params)
-        if zero:
+            loss_fn, has_aux=True)(full)
+        if zero == 3:
+            params, opt_state = parallel_zero.update3(
+                params, grads, opt_state, tx, axes, spec=spec3,
+                backend=backend)
+        elif zero == 1:
             params, opt_state = parallel_zero.update(
-                params, grads, opt_state, tx, axes, backend=backend)
+                full, grads, opt_state, tx, axes, backend=backend)
         else:
             grads = nn.synchronize_gradients(grads, axes, backend=backend,
                                              n_buckets=n_buckets)
@@ -90,27 +120,115 @@ def make_bn_dp_train_step(
             step, mesh=m, batch_argnums=(3, 4),
             donate_argnums=(0, 1, 2) if donate else ())
 
-    # ZeRO path: the optimizer state crosses the shard_map boundary SHARDED
-    # (P(axes) on per-parameter leaves), so the generic replicated-state
-    # wrapper does not apply — build the specs from the state's own pytree.
-    import jax.numpy as jnp
+    # ZeRO path: the optimizer state (and for zero=3 the flat param shard)
+    # crosses the shard_map boundary SHARDED (P(axes) on per-parameter
+    # leaves), so the generic replicated-state wrapper does not apply —
+    # build the specs from the state's own pytree.
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
     batch_spec = P(axes)
+    param_spec = P(axes) if zero == 3 else P()
 
     def wrapped(params, opt_state, batch_stats, images, labels):
         sspecs = parallel_zero.specs_like(opt_state, axes)
         fn = shard_map(
             step, mesh=m,
-            in_specs=(P(), sspecs, P(), batch_spec, batch_spec),
-            out_specs=(P(), sspecs, P(), P()), check_vma=False)
+            in_specs=(param_spec, sspecs, P(), batch_spec, batch_spec),
+            out_specs=(param_spec, sspecs, P(), P()), check_vma=False)
         out = fn(params, opt_state, batch_stats, images, labels)
         return out, _gradsync.completion_token(out)
 
     jitted = jax.jit(wrapped,
                      donate_argnums=(0, 1, 2) if donate else ())
     return _gradsync.throttle_dispatch(jitted, mesh=m)
+
+
+def fsdp_specs(params: Any, axis_names=None, *, mesh=None) -> Any:
+    """Per-leaf ``PartitionSpec`` for annotation-driven FSDP: shard each
+    parameter's largest ``n``-divisible dimension over the DP axes,
+    replicate leaves that have none (tiny biases).  The ONE definition of
+    the FSDP layout, shared by :func:`make_fsdp_train_step` and tests."""
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    m = mesh if mesh is not None else runtime.current_mesh()
+    axes = tuple(m.axis_names) if axis_names is None else (
+        (axis_names,) if isinstance(axis_names, str) else tuple(axis_names))
+    n = int(np.prod([m.shape[a] for a in axes]))
+    entry = axes if len(axes) > 1 else axes[0]
+
+    def leaf_spec(leaf):
+        shape = getattr(leaf, "shape", ())
+        for i in sorted(range(len(shape)), key=lambda i: -shape[i]):
+            if shape[i] >= n and shape[i] % n == 0:
+                spec = [None] * len(shape)
+                spec[i] = entry
+                return P(*spec)
+        return P()
+
+    return jax.tree.map(leaf_spec, params)
+
+
+def make_fsdp_train_step(model, tx: optax.GradientTransformation,
+                         params: Any, *, mesh=None, remat: bool = False,
+                         donate: bool = True) -> Tuple[Callable, Any, Any]:
+    """Annotation-driven FSDP (the GSPMD / scaling-book recipe), the
+    idiomatic-TPU complement to the explicit flat ZeRO-3 of
+    ``make_bn_dp_train_step(zero=3)``: parameters and optimizer state LIVE
+    sharded per-parameter (:func:`fsdp_specs`), the train step is plain
+    single-program code under ``jit``, and XLA's sharding propagation
+    inserts the per-use parameter all-gathers and gradient reduce-scatters
+    itself — which lets the compiler schedule gathers layer-by-layer, a
+    memory profile the hand-written whole-model flat gather cannot express.
+
+    ``model`` is a plain (BatchNorm-free) classifier: ``apply({"params"},
+    x) -> logits``.  Returns ``(step, params, opt_state)`` with the state
+    already placed sharded; ``step(params, opt_state, images, labels) ->
+    (params, opt_state, loss)``.  Place batches with ``P(axes)`` on the
+    leading dim (``prefetch_to_mesh`` or ``device_put``).  Numerics equal
+    full-batch single-device SGD (test_zero.py proves it).
+    """
+    from jax.sharding import NamedSharding
+
+    m = mesh if mesh is not None else runtime.current_mesh()
+    specs = fsdp_specs(params, mesh=m)
+    shardings = jax.tree.map(lambda s: NamedSharding(m, s), specs)
+    params = jax.tree.map(jax.device_put, params, shardings)
+    # Explicit out_shardings: momenta are built by zeros_like (constants, no
+    # data edge from the sharded params), so propagation alone would land
+    # the whole state tree on one device at init.  The same per-leaf rule
+    # as the params gives param-shaped state leaves the matching layout and
+    # scalars (step counts) replication — and keeps the step's input
+    # shardings stable from the first call (no silent recompile).
+    state_shapes = jax.eval_shape(tx.init, params)
+    state_shardings = jax.tree.map(
+        lambda s: NamedSharding(m, s), fsdp_specs(state_shapes, mesh=m))
+    opt_state = jax.jit(tx.init, out_shardings=state_shardings)(params)
+
+    def forward(p, images):
+        return model.apply({"params": p}, images)
+
+    if remat:
+        forward = jax.checkpoint(forward)
+
+    def step(params, opt_state, images, labels):
+        def loss_fn(p):
+            logits = forward(p, images)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, labels).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state_ = tx.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        # Pin the updated params to the FSDP layout: XLA then solves the
+        # backward for a reduce-scatter of each grad instead of a full
+        # all-reduce.
+        new_params = jax.lax.with_sharding_constraint(new_params, shardings)
+        return new_params, opt_state_, loss
+
+    step = jax.jit(step, donate_argnums=(0, 1) if donate else ())
+    return step, params, opt_state
 
 
 def replicate_bn_state(params, opt_state, batch_stats, *, mesh=None
