@@ -98,6 +98,130 @@ def test_gpipe_backward_matches_sequential(flat_runtime):
                                rtol=2e-4, atol=2e-5)
 
 
+def test_interleave_stages_layout():
+    L, S = 16, 8
+    W = np.arange(L * 3).reshape(L, 3).astype(np.float32)
+    out = pp.interleave_stages(W, S)
+    assert out.shape == (S, L // S, 3)
+    for d in range(S):
+        for v in range(L // S):
+            np.testing.assert_array_equal(out[d, v], W[v * S + d])
+    with pytest.raises(ValueError, match="divisible"):
+        pp.interleave_stages(np.zeros((7, 3)), S)
+
+
+def test_interleaved_matches_sequential(flat_runtime):
+    # 16 logical stages on 8 devices (V=2), 16 microbatches (two groups).
+    mesh = mpi.world_mesh()
+    S, L, Mi = 8, 16, 16
+    W, b = _stages(L, seed=6)
+    xs = np.random.RandomState(7).randn(Mi, MB, D).astype(np.float32)
+    expect = np.stack([_sequential(W, b, xs[m]) for m in range(Mi)])
+
+    Wi = pp.interleave_stages(W, S)   # [S, V, D, D]
+    bi = pp.interleave_stages(b, S)   # [S, V, D]
+
+    def body(Wl, bl, xs):
+        return pp.interleaved_apply(_stage_fn, (Wl[0], bl[0]), xs,
+                                    ("dcn", "ici"))
+
+    spec_W = P(("dcn", "ici"))
+    out = jax.jit(shard_map(
+        body, mesh=mesh, in_specs=(spec_W, spec_W, P()), out_specs=P(),
+        check_vma=False))(
+        jax.device_put(Wi, NamedSharding(mesh, spec_W)),
+        jax.device_put(bi, NamedSharding(mesh, spec_W)), xs)
+    np.testing.assert_allclose(np.asarray(out), expect, rtol=2e-5,
+                               atol=2e-5)
+
+
+def test_interleaved_v1_equals_gpipe(flat_runtime):
+    # V == 1 is the degenerate case: same schedule as gpipe_apply.
+    mesh = mpi.world_mesh()
+    S, Mi = 8, 8
+    W, b = _stages(S, seed=8)
+    xs = np.random.RandomState(9).randn(Mi, MB, D).astype(np.float32)
+
+    def body(Wl, bl, xs):
+        a = pp.gpipe_apply(_stage_fn, (Wl[0], bl[0]), xs, ("dcn", "ici"))
+        c = pp.interleaved_apply(_stage_fn, (Wl[0][None], bl[0][None]),
+                                 xs, ("dcn", "ici"))
+        return a, c
+
+    spec_W = P(("dcn", "ici"))
+    a, c = jax.jit(shard_map(
+        body, mesh=mesh, in_specs=(spec_W, spec_W, P()),
+        out_specs=(P(), P()), check_vma=False))(
+        jax.device_put(W, NamedSharding(mesh, spec_W)),
+        jax.device_put(b, NamedSharding(mesh, spec_W)), xs)
+    np.testing.assert_allclose(np.asarray(c), np.asarray(a), rtol=1e-6,
+                               atol=1e-6)
+
+
+def test_interleaved_backward_matches_sequential(flat_runtime):
+    mesh = mpi.world_mesh()
+    S, L, Mi = 8, 16, 8
+    W, b = _stages(L, seed=10)
+    xs = np.random.RandomState(11).randn(Mi, MB, D).astype(np.float32)
+
+    def seq_loss(W, b):
+        total = 0.0
+        for m in range(Mi):
+            y = xs[m]
+            for s in range(L):
+                y = jnp.tanh(y @ W[s] + b[s])
+            total = total + jnp.sum(y ** 2)
+        return total
+
+    gW_ref, gb_ref = jax.grad(seq_loss, argnums=(0, 1))(jnp.asarray(W),
+                                                        jnp.asarray(b))
+    gW_ref = pp.interleave_stages(np.asarray(gW_ref), S)
+    gb_ref = pp.interleave_stages(np.asarray(gb_ref), S)
+
+    Wi = pp.interleave_stages(W, S)
+    bi = pp.interleave_stages(b, S)
+
+    def body(Wl, bl, xs):
+        def loss(Wl_, bl_):
+            out = pp.interleaved_apply(_stage_fn, (Wl_[0], bl_[0]), xs,
+                                       ("dcn", "ici"), broadcast_out=False)
+            from torchmpi_tpu.parallel.tensor import g_allreduce
+            return g_allreduce(jnp.sum(out ** 2), ("dcn", "ici"))
+
+        return jax.grad(loss, argnums=(0, 1))(Wl, bl)
+
+    spec_W = P(("dcn", "ici"))
+    gW, gb = jax.jit(shard_map(
+        body, mesh=mesh, in_specs=(spec_W, spec_W, P()),
+        out_specs=(spec_W, spec_W), check_vma=False))(
+        jax.device_put(Wi, NamedSharding(mesh, spec_W)),
+        jax.device_put(bi, NamedSharding(mesh, spec_W)), xs)
+    np.testing.assert_allclose(np.asarray(gW), np.asarray(gW_ref),
+                               rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(gb), np.asarray(gb_ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_interleaved_rejects_ragged_microbatches(flat_runtime):
+    mesh = mpi.world_mesh()
+    S = 8
+    W, b = _stages(16, seed=12)
+    Wi, bi = pp.interleave_stages(W, S), pp.interleave_stages(b, S)
+    xs = np.zeros((6, MB, D), np.float32)  # 6 % 8 != 0
+
+    def body(Wl, bl, xs):
+        return pp.interleaved_apply(_stage_fn, (Wl[0], bl[0]), xs,
+                                    ("dcn", "ici"))
+
+    spec_W = P(("dcn", "ici"))
+    with pytest.raises(ValueError, match="M % S"):
+        jax.jit(shard_map(
+            body, mesh=mesh, in_specs=(spec_W, spec_W, P()), out_specs=P(),
+            check_vma=False))(
+            jax.device_put(Wi, NamedSharding(mesh, spec_W)),
+            jax.device_put(bi, NamedSharding(mesh, spec_W)), xs)
+
+
 def test_gpipe_composes_with_dp(hier_runtime):
     # pp over ici (4 stages), dp over dcn (different microbatch streams).
     mesh = mpi.world_mesh()

@@ -1,28 +1,34 @@
-"""Pipeline (stage) parallelism building block.
+"""Pipeline (stage) parallelism building blocks.
 
 Not in the reference (SURVEY.md §3.3: PP explicitly out of its scope; the
-mesh design just must not preclude a stage axis).  This module provides the
-minimal, correct GPipe-style schedule on a mesh axis, mostly as proof that
-the communicator tree composes with a pipeline axis — not a production
-pipeline trainer.
+mesh design just must not preclude a stage axis).  This module provides two
+correct schedules on a mesh axis — plain GPipe and the interleaved
+(virtual-stage) variant that divides the bubble by the number of virtual
+chunks — mostly as proof that the communicator tree composes with a
+pipeline axis, not a production pipeline trainer.
 
-SPMD formulation: every device runs the same ``M + S - 1`` tick loop.  At
-each tick a device receives its predecessor's activation (linear ppermute,
-no wraparound), stage 0 instead injects the next microbatch, every device
-applies its local stage, and the last stage's outputs are collected.  The
-loop is unrolled under jit, so XLA overlaps the ppermute with the next
-tick's compute where profitable, and autodiff differentiates the schedule
-for free (ppermute's transpose is the reverse ppermute — activations flow
-backward through the pipe in reverse stage order, which IS pipeline
-backward).
+SPMD formulation (:func:`gpipe_apply`): every device runs the same
+``M + S - 1`` tick loop.  At each tick a device receives its predecessor's
+activation (linear ppermute, no wraparound), stage 0 instead injects the
+next microbatch, every device applies its local stage, and the last
+stage's outputs are collected.  The loop is unrolled under jit, so XLA
+overlaps the ppermute with the next tick's compute where profitable, and
+autodiff differentiates the schedule for free (ppermute's transpose is the
+reverse ppermute — activations flow backward through the pipe in reverse
+stage order, which IS pipeline backward).  Bubble fraction is the usual
+GPipe ``(S-1)/(M+S-1)``; pick ``M >> S``.
 
-Bubble fraction is the usual GPipe ``(S-1)/(M+S-1)``; pick ``M >> S``.
+:func:`interleaved_apply` runs the virtual-stage variant of the same idea
+(``V*M + S - 1`` ticks, WRAPAROUND ring ppermute carrying chunk handoffs),
+dividing the bubble by the number of chunks per device — see its docstring
+for the schedule decode.
 """
 
 from __future__ import annotations
 
 from typing import Callable
 
+import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -63,6 +69,103 @@ def gpipe_apply(stage_fn: Callable, stage_params, microbatches,
             outs.append(jnp.where(my == S - 1, h, jnp.zeros_like(h)))
         if t != M + S - 2:
             recv = lax.ppermute(h, axis_name, perm)
+    result = jnp.stack(outs)  # [M, mb, ...]
+    if broadcast_out:
+        result = collectives.broadcast_in_axis(result, axis_name,
+                                               root=S - 1)
+    return result
+
+
+def interleave_stages(stage_tree, n_devices: int):
+    """Reorder an ``[L, ...]`` per-stage pytree into the ``[S, V, ...]``
+    round-robin layout :func:`interleaved_apply` expects: element
+    ``[d, v]`` is logical stage ``v*S + d``, so device ``d`` owns stages
+    ``{d, S+d, 2S+d, ...}``.  Shard dim 0 over the pipeline axis (a plain
+    contiguous split of ``[L, ...]`` would hand each device CONSECUTIVE
+    stages, which defeats interleaving)."""
+    def re(leaf):
+        L = leaf.shape[0]
+        if L % n_devices:
+            raise ValueError(
+                f"stage count {L} not divisible by pipeline size "
+                f"{n_devices}")
+        V = L // n_devices
+        return leaf.reshape(V, n_devices, *leaf.shape[1:]).swapaxes(0, 1)
+    return jax.tree.map(re, stage_tree)
+
+
+def interleaved_apply(stage_fn: Callable, stage_params, microbatches,
+                      axis_name: str, *, broadcast_out: bool = True):
+    """Interleaved (virtual-stage) pipeline over ``axis_name`` — the
+    Megatron-style schedule: each device holds ``V`` non-adjacent stage
+    chunks (logical stage ``v*S + d`` on device ``d``), so the pipeline
+    flush costs ``S-1`` VIRTUAL-stage times instead of ``S-1``
+    composite-stage times.  Bubble fraction ``(S-1)/(V*M + S-1)`` vs
+    GPipe's ``(S-1)/(M + S-1)`` at equal total work per tick.
+
+    SPMD formulation: microbatches run in groups of ``S``; at tick ``t``
+    device ``d`` decodes its unique work item from ``u = t - d`` as
+    ``(group, chunk, slot) = (u // VS, (u % VS) // S, u % S)`` and applies
+    exactly one virtual stage; activations ride a WRAPAROUND ring ppermute
+    (the ``S-1 -> 0`` hop is the chunk ``v -> v+1`` handoff).  The loop is
+    ``V*M + S - 1`` ticks, statically unrolled, and autodiff runs the
+    schedule backward for free, exactly as in :func:`gpipe_apply`.
+
+    - ``stage_params``: this device's ``[V, ...]`` chunk tree in the
+      round-robin layout (build with :func:`interleave_stages`, shard dim 0
+      over the axis, index ``[0]`` away the shard dim inside shard_map —
+      then dim 0 is ``V``).
+    - ``microbatches``: ``[M, mb, ...]`` replicated; ``M`` must be a
+      multiple of ``S`` (the group structure of the schedule).
+    - ``V == 1`` reduces tick-for-tick to :func:`gpipe_apply`.
+    """
+    S = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    leaves = jax.tree.leaves(stage_params)
+    if not leaves:
+        raise ValueError("stage_params is empty")
+    V = leaves[0].shape[0]
+    M = microbatches.shape[0]
+    if M % S:
+        raise ValueError(
+            f"interleaved schedule needs M % S == 0, got M={M}, S={S}")
+    act_shape = microbatches.shape[1:]
+    VS = V * S
+    T = V * M + S - 1
+
+    perm = [(i, (i + 1) % S) for i in range(S)]  # ring WITH wraparound
+    recv = jnp.zeros(act_shape, microbatches.dtype)
+    outs = [None] * M
+    for t in range(T):  # static unroll
+        # This device's virtual chunk for the tick (traced via my).  For
+        # the not-yet-filled head (u < 0) the floor-mod already lands in
+        # [0, VS) — those ticks compute garbage that is overwritten before
+        # first valid use and never collected.
+        u = t - my
+        v = (u % VS) // S
+        params_v = jax.tree.map(
+            lambda l: lax.dynamic_index_in_dim(l, v, 0, keepdims=False),
+            stage_params)
+        # Injection happens at device 0's chunk-0 ticks — static in t.
+        g, r = divmod(t, VS)
+        m_in = g * S + r
+        x = recv
+        if r < S and m_in < M:
+            x = jnp.where(my == 0, microbatches[m_in], recv)
+        h = stage_fn(params_v, x)
+        # Collection happens at the last device's chunk-(V-1) ticks —
+        # also static in t.
+        u_last = t - (S - 1)
+        if u_last >= 0:
+            gl, rl = divmod(u_last, VS)
+            if rl >= (V - 1) * S:
+                m_out = gl * S + (rl - (V - 1) * S)
+                if m_out < M:
+                    outs[m_out] = jnp.where(my == S - 1, h,
+                                            jnp.zeros_like(h))
+        if t != T - 1:
+            recv = lax.ppermute(h, axis_name, perm)
+    assert all(o is not None for o in outs)
     result = jnp.stack(outs)  # [M, mb, ...]
     if broadcast_out:
         result = collectives.broadcast_in_axis(result, axis_name,

@@ -87,6 +87,17 @@ from .gradsync import (FlatSpec as _FlatSpec,  # noqa: E402
                        unflatten_tree as _unflatten)
 
 
+def _local_shard(params: PyTree, spec: _FlatSpec,
+                 axes: Tuple[str, ...]) -> jax.Array:
+    """This device's flat extent of ``params`` — THE definition of the
+    shard linearization (row-major :func:`_axis_index` over ``axes``),
+    shared by :func:`init`, :func:`update`, and :func:`shard_params` so
+    they can never disagree about which extent a device owns."""
+    return lax.dynamic_slice(
+        _flatten(params, spec), (_axis_index(axes) * spec.shard,),
+        (spec.shard,))
+
+
 def _resolve(axis_names: Optional[AxisNames], mesh: Optional[Mesh]
              ) -> Tuple[Mesh, Tuple[str, ...], int]:
     m = mesh if mesh is not None else runtime.current_mesh()
@@ -136,10 +147,7 @@ def init(params: PyTree, tx: optax.GradientTransformation,
     specs = state_specs(params, tx, axes, mesh=m)
 
     def body(params):
-        p_shard = lax.dynamic_slice(
-            _flatten(params, spec), (_axis_index(axes) * spec.shard,),
-            (spec.shard,))
-        return tx.init(p_shard)
+        return tx.init(_local_shard(params, spec, axes))
 
     return jax.jit(shard_map(
         body, mesh=m, in_specs=P(), out_specs=specs,
@@ -174,9 +182,7 @@ def update(params: PyTree, grads: PyTree, opt_state: PyTree,
     g_shard, spec = _reduce_scatter_grads(grads, axes, spec=None,
                                           params=params, op=op,
                                           backend=backend, compress=compress)
-    p_shard = lax.dynamic_slice(
-        _flatten(params, spec), (_axis_index(axes) * spec.shard,),
-        (spec.shard,))
+    p_shard = _local_shard(params, spec, axes)
     updates, new_state = tx.update(g_shard, opt_state, p_shard)
     p_shard = optax.apply_updates(p_shard, updates)
     p_flat = collectives.allgather_in_axis(p_shard, axes,
@@ -246,9 +252,7 @@ def shard_params(params: PyTree, axis_names: Optional[AxisNames] = None, *,
     spec = _FlatSpec(params, n)
 
     def body(params):
-        return lax.dynamic_slice(
-            _flatten(params, spec), (_axis_index(axes) * spec.shard,),
-            (spec.shard,))
+        return _local_shard(params, spec, axes)
 
     return jax.jit(shard_map(
         body, mesh=m, in_specs=P(), out_specs=P(axes),
