@@ -448,3 +448,11 @@ def test_fsdp_recipe_matches_single_device_oracle(flat_runtime):
             assert shard_elems == leaf.size // n
             checked += 1
     assert checked >= 3  # convs + dense kernels actually sharded
+
+    # The state must come out of the step still in the FSDP layout too
+    # (the step pins it with with_sharding_constraint — propagation alone
+    # could re-replicate it and lose the 1/n persistent memory).
+    state_sharded = sum(
+        1 for leaf in jax.tree.leaves(o_f1)
+        if leaf.ndim >= 1 and len(leaf.sharding.device_set) == n)
+    assert state_sharded >= 3

@@ -248,8 +248,8 @@ def shard_params(params: PyTree, axis_names: Optional[AxisNames] = None, *,
     ZeRO-3 shard ``[shard]``, physically sharded ``P(axes)`` across the
     mesh.  Init-time convenience (runs its own jitted shard_map), like
     :func:`init`."""
-    m, axes, n = _resolve(axis_names, mesh)
-    spec = _FlatSpec(params, n)
+    m, axes, _ = _resolve(axis_names, mesh)
+    spec = flat_spec(params, axes, mesh=m)
 
     def body(params):
         return _local_shard(params, spec, axes)
@@ -303,8 +303,8 @@ def unshard_params(p_shard: jax.Array, params_template: PyTree,
     """Reassemble the full replicated parameter pytree from ZeRO-3 shards
     (checkpoint export / eval).  Init-time convenience mirror of
     :func:`shard_params`."""
-    m, axes, n = _resolve(axis_names, mesh)
-    spec = _FlatSpec(params_template, n)
+    m, axes, _ = _resolve(axis_names, mesh)
+    spec = flat_spec(params_template, axes, mesh=m)
 
     def body(p_shard):
         return gather_params(p_shard, spec, axes)

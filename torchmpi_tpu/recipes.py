@@ -194,7 +194,7 @@ def make_fsdp_train_step(model, tx: optax.GradientTransformation,
     m = mesh if mesh is not None else runtime.current_mesh()
     specs = fsdp_specs(params, mesh=m)
     shardings = jax.tree.map(lambda s: NamedSharding(m, s), specs)
-    params = jax.tree.map(jax.device_put, params, shardings)
+    params = jax.device_put(params, shardings)  # one batched transfer
     # Explicit out_shardings: momenta are built by zeros_like (constants, no
     # data edge from the sharded params), so propagation alone would land
     # the whole state tree on one device at init.  The same per-leaf rule
@@ -221,10 +221,14 @@ def make_fsdp_train_step(model, tx: optax.GradientTransformation,
         loss, grads = jax.value_and_grad(loss_fn)(params)
         updates, opt_state_ = tx.update(grads, opt_state, params)
         new_params = optax.apply_updates(params, updates)
-        # Pin the updated params to the FSDP layout: XLA then solves the
-        # backward for a reduce-scatter of each grad instead of a full
-        # all-reduce.
+        # Pin both outputs to the FSDP layout: XLA then solves the backward
+        # for a reduce-scatter of each grad instead of a full all-reduce,
+        # and the state output keeps the donated input's layout (otherwise
+        # propagation could re-replicate it, losing both the aliasing and
+        # the 1/n persistent memory).
         new_params = jax.lax.with_sharding_constraint(new_params, shardings)
+        opt_state_ = jax.lax.with_sharding_constraint(opt_state_,
+                                                      state_shardings)
         return new_params, opt_state_, loss
 
     step = jax.jit(step, donate_argnums=(0, 1) if donate else ())
