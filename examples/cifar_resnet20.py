@@ -7,20 +7,26 @@ statistics live in a separate collection and are cross-replica averaged with
 the same selector-routed collectives as the gradients.
 
 Run: ``python examples/cifar_resnet20.py --devices 8 --steps 60``
+(add ``--zero 1`` for a sharded optimizer, ``--zero 3`` to also keep the
+parameters as flat 1/n shards between steps — same numerics either way).
 """
 
 import common
 
 
 def main():
-    args = common.parse_args(__doc__, defaults={"lr": 0.2, "steps": 60,
-                                                "batch_size": 128})
+    args = common.parse_args(
+        __doc__, defaults={"lr": 0.2, "steps": 60, "batch_size": 128},
+        zero=dict(type=int, default=0, choices=[0, 1, 3],
+                  help="ZeRO level: 1 shards optimizer state, 3 also "
+                       "shards the parameters between steps"))
     import jax
     import jax.numpy as jnp
     import optax
 
     import torchmpi_tpu as mpi
     from torchmpi_tpu.models import ResNet20
+    from torchmpi_tpu.parallel import zero as pzero
     from torchmpi_tpu.utils import data as dutil
 
     mpi.init(mpi.Config(dcn_size=args.dcn))
@@ -33,15 +39,28 @@ def main():
                            jnp.zeros((1, 32, 32, 3)), train=False)
     params, batch_stats = variables["params"], variables["batch_stats"]
     tx = optax.sgd(args.lr, momentum=args.momentum)
-    opt_state = tx.init(params)
 
     # Canonical DP recipe: grad allreduce + BatchNorm running-stats average
     # on the same selector-routed collective path + metric reduction.
-    dp_step = mpi.recipes.make_bn_dp_train_step(model, tx, mesh=mesh,
-                                                backend=args.backend,
-                                                n_buckets=args.buckets)
-    params, opt_state, batch_stats = mpi.recipes.replicate_bn_state(
-        params, opt_state, batch_stats, mesh=mesh)
+    # ZeRO levels reuse the same recipe with sharded persistent state.
+    # Templates carry shapes only — holding real replicated arrays through
+    # the run would defeat the 1/n persistent-params story of zero=3.
+    shape_template = jax.tree.map(
+        lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype), params)
+    dp_step = mpi.recipes.make_bn_dp_train_step(
+        model, tx, mesh=mesh, backend=args.backend, n_buckets=args.buckets,
+        zero=args.zero,
+        params_template=shape_template if args.zero == 3 else None)
+    if args.zero:
+        batch_stats = mpi.nn.synchronize_parameters(batch_stats, mesh=mesh)
+        opt_state = pzero.init(params, tx, mesh=mesh)
+        if args.zero == 3:
+            params = pzero.shard_params(params, mesh=mesh)
+        else:
+            params = mpi.nn.synchronize_parameters(params, mesh=mesh)
+    else:
+        params, opt_state, batch_stats = mpi.recipes.replicate_bn_state(
+            params, tx.init(params), batch_stats, mesh=mesh)
 
     X, Y = dutil.synthetic_cifar(4096, seed=args.seed)
     timer = common.StepTimer()
@@ -54,6 +73,10 @@ def main():
         timer.tick()
         if i % 10 == 0 or i == args.steps - 1:
             print(f"step {i:4d}  loss {float(loss):.4f}")
+
+    if args.zero == 3:
+        # Export the full parameter pytree from the flat shards for eval.
+        params = pzero.unshard_params(params, shape_template, mesh=mesh)
 
     def eval_logits(xb):
         return model.apply({"params": params, "batch_stats": batch_stats},

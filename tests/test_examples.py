@@ -60,3 +60,17 @@ def test_swa_gqa_lm_example():
     # Modern-LM stack: rope + sliding-window + GQA trains and decodes
     # through the kv-heads-only cache; asserts rule-following output.
     _run("swa_gqa_lm.py", "--devices", "1")
+
+
+@pytest.mark.slow
+def test_cifar_zero3_example():
+    # ZeRO-3: params live as flat 1/n shards through real training, then
+    # unshard for eval; asserts >= 85% accuracy internally.
+    _run("cifar_resnet20.py", "--devices", "8", "--zero", "3")
+
+
+@pytest.mark.slow
+def test_mnist_fsdp_example():
+    # Annotation-driven FSDP: per-parameter GSPMD shardings, prefetch
+    # pipeline placement; asserts convergence AND 1/n persistent layout.
+    _run("mnist_fsdp.py", "--devices", "8")
