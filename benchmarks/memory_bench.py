@@ -1,0 +1,121 @@
+"""Persistent-memory footprint per device across the DP state-sharding
+ladder: replicated DP -> ZeRO-1 -> ZeRO-3 -> annotation-driven FSDP.
+
+The reference's DP replicated everything on every rank (SURVEY.md §3.3);
+the TPU rebuild's ladder trades collective traffic for per-device
+persistent memory.  This bench MEASURES the footprint rather than claiming
+it: it places the model + Adam state each way on a real (or simulated)
+mesh and sums the bytes each strategy physically pins on device 0 —
+addressable shard bytes, not theory.
+
+Run: ``python benchmarks/memory_bench.py --devices 8 [--model resnet20]``
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _bytes_on(dev, tree) -> int:
+    import jax
+
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        for sh in getattr(leaf, "addressable_shards", []):
+            if sh.device == dev:
+                total += sh.data.nbytes
+        if not hasattr(leaf, "addressable_shards"):
+            total += getattr(leaf, "nbytes", 0)
+    return total
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--devices", type=int, default=0)
+    p.add_argument("--model", default="resnet20",
+                   choices=["lenet", "resnet20"])
+    p.add_argument("--json", action="store_true")
+    args = p.parse_args()
+    if args.devices:
+        from torchmpi_tpu.utils.simulation import force_cpu_devices
+
+        force_cpu_devices(args.devices)
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import torchmpi_tpu as mpi
+    from torchmpi_tpu.models import LeNet, ResNet20
+    from torchmpi_tpu.parallel import zero
+
+    mesh = mpi.init()
+    n = mesh.devices.size
+    dev0 = list(mesh.devices.flat)[0]
+    tx = optax.adam(1e-3)  # 2x params of state: makes the ladder vivid
+
+    if args.model == "lenet":
+        model = LeNet(num_classes=10)
+        variables = model.init(jax.random.PRNGKey(0),
+                               jnp.zeros((1, 28, 28, 1)))
+        params, bn = variables["params"], None
+    else:
+        model = ResNet20(num_classes=10)
+        variables = model.init(jax.random.PRNGKey(0),
+                               jnp.zeros((1, 32, 32, 3)), train=False)
+        params, bn = variables["params"], variables["batch_stats"]
+
+    rows = []
+
+    def row(strategy, p_tree, o_tree):
+        pb, ob = _bytes_on(dev0, p_tree), _bytes_on(dev0, o_tree)
+        rows.append({
+            "strategy": strategy, "devices": n,
+            "params_kib_per_device": round(pb / 1024, 1),
+            "opt_state_kib_per_device": round(ob / 1024, 1),
+            "total_kib_per_device": round((pb + ob) / 1024, 1),
+        })
+
+    # 1. Replicated DP (the reference's regime): full copy everywhere.
+    p_r = mpi.nn.synchronize_parameters(params, mesh=mesh)
+    o_r = mpi.nn.synchronize_parameters(tx.init(params), mesh=mesh)
+    row("replicated_dp", p_r, o_r)
+
+    # 2. ZeRO-1: optimizer state sharded, params replicated.
+    o_1 = zero.init(params, tx, mesh=mesh)
+    row("zero1", p_r, o_1)
+
+    # 3. ZeRO-3: params AND state live as flat 1/n shards between steps.
+    p_3 = zero.shard_params(params, mesh=mesh)
+    row("zero3", p_3, o_1)
+
+    # 4. Annotation-driven FSDP: per-parameter GSPMD shardings (leaves
+    #    with no n-divisible dim stay replicated — measured, not assumed).
+    #    make_fsdp_train_step takes plain (BatchNorm-free) models, so this
+    #    rung runs for lenet and is explicitly skipped otherwise.
+    if bn is None:
+        _, p_f, o_f = mpi.recipes.make_fsdp_train_step(model, tx, params,
+                                                       mesh=mesh)
+        row("fsdp", p_f, o_f)
+    else:
+        print(f"fsdp rung SKIPPED: make_fsdp_train_step takes plain "
+              f"models and {args.model} carries batch_stats — run with "
+              f"--model lenet for the full ladder", file=sys.stderr)
+
+    base = rows[0]["total_kib_per_device"]
+    for r in rows:
+        r["vs_replicated"] = round(r["total_kib_per_device"] / base, 3)
+        print(json.dumps(r), flush=True)
+
+    if not args.json:
+        print(f"\nreplicated {base:.0f} KiB/device -> "
+              f"best {min(r['total_kib_per_device'] for r in rows):.0f} "
+              f"KiB/device on {n} devices")
+    mpi.stop()
+
+
+if __name__ == "__main__":
+    main()

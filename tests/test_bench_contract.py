@@ -35,3 +35,25 @@ def test_bench_emits_one_json_line():
     assert rec["value"] > 0
     # the last line must be the headline stage, not the probe
     assert rec["metric"] == "resnet50_dp_train_throughput", rec
+
+
+@pytest.mark.slow
+def test_memory_bench_measures_the_ladder():
+    # replicated -> zero1 -> zero3/fsdp per-device persistent bytes must
+    # actually shrink as measured from addressable shards (not theory):
+    # with Adam (state = 2x params) on n=8, zero1 = (1+2/8)/3 and
+    # zero3/fsdp = 3/8/3 of replicated.
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(_REPO, "benchmarks", "memory_bench.py"),
+         "--devices", "8", "--model", "lenet", "--json"],
+        capture_output=True, text=True, timeout=300, env=env, cwd=_REPO)
+    assert out.returncode == 0, out.stderr[-2000:]
+    rows = {r["strategy"]: r for r in
+            (json.loads(l) for l in out.stdout.strip().splitlines())}
+    assert rows["replicated_dp"]["vs_replicated"] == 1.0
+    assert abs(rows["zero1"]["vs_replicated"] - (1 + 2 / 8) / 3) < 0.02
+    assert abs(rows["zero3"]["vs_replicated"] - 3 / 8 / 3) < 0.02
+    assert abs(rows["fsdp"]["vs_replicated"] - 3 / 8 / 3) < 0.03
