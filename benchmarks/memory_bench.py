@@ -108,9 +108,17 @@ def main():
     base = rows[0]["total_kib_per_device"]
     for r in rows:
         r["vs_replicated"] = round(r["total_kib_per_device"] / base, 3)
-        print(json.dumps(r), flush=True)
 
-    if not args.json:
+    if args.json:  # sibling-bench convention: JSON only when asked
+        for r in rows:
+            print(json.dumps(r), flush=True)
+    else:
+        for r in rows:
+            print(f"{r['strategy']:>14}: params "
+                  f"{r['params_kib_per_device']:>9.1f} KiB  opt "
+                  f"{r['opt_state_kib_per_device']:>9.1f} KiB  total "
+                  f"{r['total_kib_per_device']:>9.1f} KiB/device  "
+                  f"({r['vs_replicated']:.3f}x)")
         print(f"\nreplicated {base:.0f} KiB/device -> "
               f"best {min(r['total_kib_per_device'] for r in rows):.0f} "
               f"KiB/device on {n} devices")

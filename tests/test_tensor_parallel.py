@@ -114,3 +114,182 @@ def test_tp_input_grad_matches_dense(flat_runtime):
         jax.device_put(w2, NamedSharding(mesh, P(("dcn", "ici"), None))))
     np.testing.assert_allclose(np.asarray(gx), np.asarray(gx_ref),
                                rtol=1e-4, atol=1e-5)
+
+
+# --------------------------------------------------------------------------
+# Tensor-parallel attention and the full Megatron block
+
+
+def _attn_weights(B=2, T=6, D=32, H=8, seed=0):
+    rng = np.random.RandomState(seed)
+    s = 1.0 / np.sqrt(D)
+    x = rng.randn(B, T, D).astype(np.float32)
+    wq, wk, wv = (rng.randn(D, D).astype(np.float32) * s for _ in range(3))
+    wo = rng.randn(D, D).astype(np.float32) * s
+    return x, wq, wk, wv, wo
+
+
+def _dense_attention(x, wq, wk, wv, wo, H, causal=True):
+    # Projections here; the attention itself is the suite's ONE exact
+    # oracle (sequence.reference_attention), not another hand-rolled copy.
+    from torchmpi_tpu.parallel.sequence import reference_attention
+
+    B, T, D = x.shape
+    Dh = D // H
+    q = jnp.asarray((x @ wq).reshape(B, T, H, Dh))
+    k = jnp.asarray((x @ wk).reshape(B, T, H, Dh))
+    v = jnp.asarray((x @ wv).reshape(B, T, H, Dh))
+    ctx = np.asarray(reference_attention(q, k, v, causal=causal))
+    return ctx.reshape(B, T, D) @ wo
+
+
+def _col_shards(w, mesh):
+    n = mesh.devices.size
+    return np.stack([tp.shard_columns(w, None, n, i) for i in range(n)])
+
+
+def _row_shards(w, mesh):
+    n = mesh.devices.size
+    return np.stack([tp.shard_rows(w, None, n, i) for i in range(n)])
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_tp_attention_matches_dense(flat_runtime, causal):
+    mesh = mpi.world_mesh()
+    H = 8
+    x, wq, wk, wv, wo = _attn_weights(H=H)
+    expect = _dense_attention(x, wq, wk, wv, wo, H, causal=causal)
+    axes = ("dcn", "ici")
+
+    def body(x, wql, wkl, wvl, wol):
+        return tp.tp_attention(x, wql[0], wkl[0], wvl[0], wol[0], axes,
+                               num_heads=H, causal=causal)
+
+    spec = P(axes)
+    out = jax.jit(shard_map(
+        body, mesh=mesh, in_specs=(P(), spec, spec, spec, spec),
+        out_specs=P(), check_vma=False))(
+        x,
+        jax.device_put(_col_shards(wq, mesh), NamedSharding(mesh, spec)),
+        jax.device_put(_col_shards(wk, mesh), NamedSharding(mesh, spec)),
+        jax.device_put(_col_shards(wv, mesh), NamedSharding(mesh, spec)),
+        jax.device_put(_row_shards(wo, mesh), NamedSharding(mesh, spec)))
+    np.testing.assert_allclose(np.asarray(out), expect, rtol=2e-4,
+                               atol=2e-5)
+
+
+def _dense_block(x, params, H):
+    def ln(h, scale, bias):
+        mu = h.mean(-1, keepdims=True)
+        var = ((h - mu) ** 2).mean(-1, keepdims=True)
+        return (h - mu) / np.sqrt(var + 1e-6) * scale + bias
+
+    a = _dense_attention(ln(x, *params["ln1"]), params["wq"], params["wk"],
+                         params["wv"], params["wo"], H)
+    x = x + a
+    h = ln(x, *params["ln2"]) @ params["w1"]
+    m = np.asarray(jax.nn.gelu(jnp.asarray(h), approximate=False)
+                   ) @ params["w2"]
+    return x + m
+
+
+def test_tp_transformer_block_matches_dense(flat_runtime):
+    mesh = mpi.world_mesh()
+    H, D, F = 8, 32, 64
+    x, wq, wk, wv, wo = _attn_weights(H=H, D=D, seed=3)
+    rng = np.random.RandomState(4)
+    w1 = rng.randn(D, F).astype(np.float32) * (1.0 / np.sqrt(D))
+    w2 = rng.randn(F, D).astype(np.float32) * (1.0 / np.sqrt(F))
+    ln1 = (np.ones(D, np.float32), np.zeros(D, np.float32))
+    ln2 = (np.ones(D, np.float32) * 1.1, np.zeros(D, np.float32) + 0.05)
+    dense = {"ln1": ln1, "ln2": ln2, "wq": wq, "wk": wk, "wv": wv,
+             "wo": wo, "w1": w1, "w2": w2}
+    expect = _dense_block(x, dense, H)
+    axes = ("dcn", "ici")
+    spec = P(axes)
+
+    shards = {
+        "wq": _col_shards(wq, mesh), "wk": _col_shards(wk, mesh),
+        "wv": _col_shards(wv, mesh), "wo": _row_shards(wo, mesh),
+        "w1": _col_shards(w1, mesh), "w2": _row_shards(w2, mesh),
+    }
+
+    def body(x, ln1s, ln1b, ln2s, ln2b, wq, wk, wv, wo, w1, w2):
+        p = {"ln1": (ln1s, ln1b), "ln2": (ln2s, ln2b),
+             "wq": wq[0], "wk": wk[0], "wv": wv[0], "wo": wo[0],
+             "w1": w1[0], "w2": w2[0]}
+        return tp.tp_transformer_block(x, p, axes, num_heads=H)
+
+    out = jax.jit(shard_map(
+        body, mesh=mesh,
+        in_specs=(P(),) * 5 + (spec,) * 6, out_specs=P(),
+        check_vma=False))(
+        x, *ln1, *ln2,
+        *(jax.device_put(shards[k], NamedSharding(mesh, spec))
+          for k in ("wq", "wk", "wv", "wo", "w1", "w2")))
+    np.testing.assert_allclose(np.asarray(out), expect, rtol=3e-4,
+                               atol=3e-5)
+
+
+def test_tp_block_grads_match_dense(flat_runtime):
+    # Gradients through BOTH tensor-parallel sublayers equal the dense
+    # oracle's: the f/g pairs compose correctly across attention + MLP.
+    mesh = mpi.world_mesh()
+    H, D, F = 8, 16, 32
+    x, wq, wk, wv, wo = _attn_weights(B=2, T=4, D=D, H=H, seed=5)
+    rng = np.random.RandomState(6)
+    w1 = rng.randn(D, F).astype(np.float32) * (1.0 / np.sqrt(D))
+    w2 = rng.randn(F, D).astype(np.float32) * (1.0 / np.sqrt(F))
+    ln = (jnp.ones(D), jnp.zeros(D))
+    axes = ("dcn", "ici")
+    spec = P(axes)
+
+    def jdense_block(wq_, w2_):
+        def lnf(h, scale, bias):
+            mu = h.mean(-1, keepdims=True)
+            var = ((h - mu) ** 2).mean(-1, keepdims=True)
+            return (h - mu) * jax.lax.rsqrt(var + 1e-6) * scale + bias
+
+        from torchmpi_tpu.parallel.sequence import reference_attention
+
+        B, T, D_ = x.shape
+        Dh = D_ // H
+        hx = lnf(jnp.asarray(x), *ln)
+        q = (hx @ wq_).reshape(B, T, H, Dh)
+        k = (hx @ jnp.asarray(wk)).reshape(B, T, H, Dh)
+        v = (hx @ jnp.asarray(wv)).reshape(B, T, H, Dh)
+        ctx = reference_attention(q, k, v, causal=True).reshape(B, T, D_)
+        h = jnp.asarray(x) + ctx @ jnp.asarray(wo)
+        m = jax.nn.gelu(lnf(h, *ln) @ jnp.asarray(w1),
+                        approximate=False) @ w2_
+        return jnp.sum((h + m) ** 2)
+
+    g_wq_ref, g_w2_ref = jax.grad(jdense_block, argnums=(0, 1))(
+        jnp.asarray(wq), jnp.asarray(w2))
+
+    def body(wql, wkl, wvl, wol, w1l, w2l):
+        p = {"ln1": ln, "ln2": ln, "wq": wql[0], "wk": wkl[0],
+             "wv": wvl[0], "wo": wol[0], "w1": w1l[0], "w2": w2l[0]}
+
+        def loss(wq_, w2_):
+            p2 = dict(p, wq=wq_[0], w2=w2_[0])
+            out = tp.tp_transformer_block(jnp.asarray(x), p2, axes,
+                                          num_heads=H)
+            # out is replicated (each sublayer ends in g's forward
+            # allreduce), so the loss needs NO collective: g's backward
+            # identity already delivers exact local-shard cotangents.
+            return jnp.sum(out ** 2)
+
+        return jax.grad(loss, argnums=(0, 1))(wql, w2l)
+
+    shards = [_col_shards(wq, mesh), _col_shards(wk, mesh),
+              _col_shards(wv, mesh), _row_shards(wo, mesh),
+              _col_shards(w1, mesh), _row_shards(w2, mesh)]
+    g_wq, g_w2 = jax.jit(shard_map(
+        body, mesh=mesh, in_specs=(spec,) * 6, out_specs=(spec, spec),
+        check_vma=False))(
+        *(jax.device_put(s, NamedSharding(mesh, spec)) for s in shards))
+    np.testing.assert_allclose(np.asarray(g_wq), _col_shards(
+        np.asarray(g_wq_ref), mesh), rtol=3e-4, atol=3e-5)
+    np.testing.assert_allclose(np.asarray(g_w2), _row_shards(
+        np.asarray(g_w2_ref), mesh), rtol=3e-4, atol=3e-5)

@@ -101,6 +101,76 @@ def tp_mlp(x, w1_local, w2_local, axis_name: str, act=jnp.tanh,
     return row_parallel_dense(h, w2_local, axis_name, backend=backend)
 
 
+def tp_attention(x, wq_local, wk_local, wv_local, wo_local,
+                 axis_name: str, *, num_heads: int, causal: bool = True,
+                 backend: Optional[str] = None):
+    """Megatron-style tensor-parallel multi-head self-attention: the heads
+    shard over ``axis_name``.
+
+    ``x``: [B, T, D] replicated.  ``wq/wk/wv_local``: [D, Hl*Dh] column
+    blocks (this device's Hl = num_heads/n heads, head-major columns — a
+    :func:`shard_columns` slice of the full projection).  ``wo_local``:
+    [Hl*Dh, D] row block.  ``num_heads`` is the GLOBAL head count (the
+    per-head width is not recoverable from the local shapes alone: the
+    local width is D/n for every valid head split).  Each device runs its
+    heads end-to-end — scores, softmax, and the value contraction never
+    cross devices — and the output projection's partial products sum over
+    the axis: exactly one allreduce forward (``g``) and one backward
+    (``f``), the same cost profile as :func:`tp_mlp`.
+    """
+    B, T, _ = x.shape
+    n = lax.axis_size(axis_name)
+    if num_heads % n:
+        raise ValueError(f"num_heads {num_heads} must divide by the "
+                         f"axis size {n}")
+    h_local = num_heads // n
+    width = wq_local.shape[-1]
+    if width % h_local:
+        raise ValueError(f"local qkv width {width} must divide by local "
+                         f"head count {h_local}")
+    d_head = width // h_local
+
+    xr = f_identity(x, axis_name)
+    q = (xr @ wq_local).reshape(B, T, h_local, d_head)
+    k = (xr @ wk_local).reshape(B, T, h_local, d_head)
+    v = (xr @ wv_local).reshape(B, T, h_local, d_head)
+    scores = jnp.einsum("bthd,bshd->bhts", q, k) / jnp.sqrt(
+        jnp.float32(d_head)).astype(x.dtype)
+    if causal:
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(
+        x.dtype)
+    ctx = jnp.einsum("bhts,bshd->bthd", probs, v).reshape(B, T, width)
+    return row_parallel_dense(ctx, wo_local, axis_name, backend=backend)
+
+
+def tp_transformer_block(x, p_local, axis_name: str, *, num_heads: int,
+                         causal: bool = True,
+                         backend: Optional[str] = None):
+    """A full pre-LN transformer block with BOTH sublayers tensor-parallel:
+    ``x + tp_attention(LN(x))`` then ``x + tp_mlp(LN(x))`` — two
+    allreduces forward (one per sublayer), the canonical Megatron block.
+
+    ``p_local``: dict with ``ln1/ln2`` (scale, bias — replicated),
+    ``wq/wk/wv/wo`` (attention blocks as in :func:`tp_attention`), and
+    ``w1/w2`` (MLP blocks as in :func:`tp_mlp`).
+    """
+    def ln(h, scale, bias):
+        mu = h.mean(-1, keepdims=True)
+        var = ((h - mu) ** 2).mean(-1, keepdims=True)
+        return (h - mu) * lax.rsqrt(var + 1e-6) * scale + bias
+
+    a = tp_attention(ln(x, *p_local["ln1"]), p_local["wq"], p_local["wk"],
+                     p_local["wv"], p_local["wo"], axis_name,
+                     num_heads=num_heads, causal=causal, backend=backend)
+    x = x + a
+    m = tp_mlp(ln(x, *p_local["ln2"]), p_local["w1"], p_local["w2"],
+               axis_name, act=partial(jax.nn.gelu, approximate=False),
+               backend=backend)
+    return x + m
+
+
 def shard_columns(w, axis_name: str, n: int, index):
     """Static helper: slice a full [d_in, d_out] weight into this device's
     column block (used at setup time, outside jit, via numpy)."""
