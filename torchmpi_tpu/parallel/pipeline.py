@@ -36,7 +36,8 @@ from .. import collectives
 
 
 def gpipe_apply(stage_fn: Callable, stage_params, microbatches,
-                axis_name: str, *, broadcast_out: bool = True):
+                axis_name: str, *, broadcast_out: bool = True,
+                remat: bool = False):
     """Run a linear pipeline over ``axis_name``.
 
     - ``stage_fn(stage_params, x) -> y``: one stage, same activation shape
@@ -50,11 +51,19 @@ def gpipe_apply(stage_fn: Callable, stage_params, microbatches,
     Returns ``[M, mb, ...]`` outputs — valid on the last stage, broadcast to
     every device when ``broadcast_out`` (one collective), else zeros off the
     last stage.
+
+    ``remat=True`` rematerializes each stage application in backward
+    (``jax.checkpoint``): training stores one activation per tick edge
+    instead of every stage-internal intermediate — the standard lever when
+    the ``M`` in-flight microbatches bound pipeline memory.  Numerics are
+    unchanged (the backward recomputes exactly the forward).
     """
     S = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     M = microbatches.shape[0]
     act_shape = microbatches.shape[1:]
+    if remat:
+        stage_fn = jax.checkpoint(stage_fn)
 
     perm = [(i, i + 1) for i in range(S - 1)]  # linear, no wraparound
     recv = jnp.zeros(act_shape, microbatches.dtype)
@@ -95,7 +104,8 @@ def interleave_stages(stage_tree, n_devices: int):
 
 
 def interleaved_apply(stage_fn: Callable, stage_params, microbatches,
-                      axis_name: str, *, broadcast_out: bool = True):
+                      axis_name: str, *, broadcast_out: bool = True,
+                      remat: bool = False):
     """Interleaved (virtual-stage) pipeline over ``axis_name`` — the
     Megatron-style schedule: each device holds ``V`` non-adjacent stage
     chunks (logical stage ``v*S + d`` on device ``d``), so the pipeline
@@ -118,9 +128,13 @@ def interleaved_apply(stage_fn: Callable, stage_params, microbatches,
     - ``microbatches``: ``[M, mb, ...]`` replicated; ``M`` must be a
       multiple of ``S`` (the group structure of the schedule).
     - ``V == 1`` reduces tick-for-tick to :func:`gpipe_apply`.
+    - ``remat=True`` rematerializes each virtual-stage application in
+      backward, exactly as in :func:`gpipe_apply`.
     """
     S = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
+    if remat:
+        stage_fn = jax.checkpoint(stage_fn)
     leaves = jax.tree.leaves(stage_params)
     if not leaves:
         raise ValueError("stage_params is empty")
