@@ -172,7 +172,9 @@ def fsdp_specs(params: Any, axis_names=None, *, mesh=None) -> Any:
 
 def make_fsdp_train_step(model, tx: optax.GradientTransformation,
                          params: Any, *, mesh=None, remat: bool = False,
-                         donate: bool = True) -> Tuple[Callable, Any, Any]:
+                         donate: bool = True,
+                         loss_fn: Optional[Callable] = None
+                         ) -> Tuple[Callable, Any, Any]:
     """Annotation-driven FSDP (the GSPMD / scaling-book recipe), the
     idiomatic-TPU complement to the explicit flat ZeRO-3 of
     ``make_bn_dp_train_step(zero=3)``: parameters and optimizer state LIVE
@@ -182,12 +184,16 @@ def make_fsdp_train_step(model, tx: optax.GradientTransformation,
     itself — which lets the compiler schedule gathers layer-by-layer, a
     memory profile the hand-written whole-model flat gather cannot express.
 
-    ``model`` is a plain (BatchNorm-free) classifier: ``apply({"params"},
-    x) -> logits``.  Returns ``(step, params, opt_state)`` with the state
-    already placed sharded; ``step(params, opt_state, images, labels) ->
-    (params, opt_state, loss)``.  Place batches with ``P(axes)`` on the
-    leading dim (``prefetch_to_mesh`` or ``device_put``).  Numerics equal
-    full-batch single-device SGD (test_zero.py proves it).
+    ``model`` is a plain (BatchNorm-free) module.  By default it is
+    treated as a classifier (``apply({"params"}, x) -> logits`` against
+    integer labels); pass ``loss_fn(apply_fn, params, xb, yb) -> scalar``
+    for any other objective — e.g. a next-token LM loss — where
+    ``apply_fn`` is the (possibly rematerialized) ``model.apply``.
+    Returns ``(step, params, opt_state)`` with the state already placed
+    sharded; ``step(params, opt_state, xb, yb) -> (params, opt_state,
+    loss)``.  Place batches with ``P(axes)`` on the leading dim
+    (``prefetch_to_mesh`` or ``device_put``).  Numerics equal full-batch
+    single-device SGD (test_zero.py proves it).
     """
     from jax.sharding import NamedSharding
 
@@ -206,19 +212,21 @@ def make_fsdp_train_step(model, tx: optax.GradientTransformation,
         lambda s: NamedSharding(m, s), fsdp_specs(state_shapes, mesh=m))
     opt_state = jax.jit(tx.init, out_shardings=state_shardings)(params)
 
-    def forward(p, images):
-        return model.apply({"params": p}, images)
-
+    forward = model.apply
     if remat:
         forward = jax.checkpoint(forward)
 
-    def step(params, opt_state, images, labels):
-        def loss_fn(p):
-            logits = forward(p, images)
+    if loss_fn is None:
+        def loss_fn(apply_fn, p, images, labels):
+            logits = apply_fn({"params": p}, images)
             return optax.softmax_cross_entropy_with_integer_labels(
                 logits, labels).mean()
 
-        loss, grads = jax.value_and_grad(loss_fn)(params)
+    def step(params, opt_state, xb, yb):
+        def objective(p):
+            return loss_fn(forward, p, xb, yb)
+
+        loss, grads = jax.value_and_grad(objective)(params)
         updates, opt_state_ = tx.update(grads, opt_state, params)
         new_params = optax.apply_updates(params, updates)
         # Pin both outputs to the FSDP layout: XLA then solves the backward
