@@ -1,0 +1,135 @@
+"""2D model parallelism: tensor-parallel transformer blocks inside
+pipeline stages — TP over `ici`, PP over `dcn` on one mesh.
+
+Beyond the reference (TorchMPI is DP-only — SURVEY.md §3.3); this is the
+composition its communicator-tree design must not preclude (§6.7), run
+for real: every pipeline stage is a Megatron block
+(`tensor.tp_transformer_block`: heads and MLP sharded over `ici`, one
+allreduce per sublayer) and the stages ride a `pipeline` schedule over
+`dcn` (`gpipe_apply`, or `interleaved_apply` with two virtual chunks per
+stage via `--schedule interleaved`).  Gradients flow through both axes'
+collectives at once — ppermute stage handoffs outside, f/g allreduce
+pairs inside.  Trains a fixed-batch regression and asserts the loss
+drops 5x.
+
+Run: ``python examples/megatron_pipeline.py --devices 8``
+     (mesh 2x4: two pipeline stages of tensor-parallel width four)
+"""
+
+import common
+
+
+def main():
+    args = common.parse_args(
+        __doc__, defaults={"lr": 0.05, "steps": 120, "dcn": 2},
+        schedule=dict(type=str, default="gpipe",
+                      choices=["gpipe", "interleaved"]))
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import torchmpi_tpu as mpi
+    from torchmpi_tpu.parallel import pipeline as pp
+    from torchmpi_tpu.parallel import tensor as tp
+
+    mpi.init(mpi.Config(dcn_size=args.dcn))
+    mesh = mpi.world_mesh()
+    S = mesh.shape["dcn"]           # pipeline stages
+    n_tp = mesh.shape["ici"]        # tensor-parallel width
+    V = 2 if args.schedule == "interleaved" else 1
+    L = S * V                       # logical transformer blocks
+    H, D, F, B, T, M = n_tp, 8 * n_tp, 16 * n_tp, 2, 8, 2 * S
+
+    rng = np.random.RandomState(args.seed)
+
+    def dense_block(seed):
+        r = np.random.RandomState(seed)
+        s = 1.0 / np.sqrt(D)
+        return {
+            "wq": r.randn(D, D).astype(np.float32) * s,
+            "wk": r.randn(D, D).astype(np.float32) * s,
+            "wv": r.randn(D, D).astype(np.float32) * s,
+            "wo": r.randn(D, D).astype(np.float32) * s,
+            "w1": r.randn(D, F).astype(np.float32) * s,
+            "w2": r.randn(F, D).astype(np.float32) * (1.0 / np.sqrt(F)),
+        }
+
+    # [L, ...] per-block weights -> TP shards on a new axis 1 -> pipeline
+    # layout on axis 0 ([S, V, n_tp, ...], P("dcn", None, "ici")).
+    blocks = [dense_block(args.seed + 1 + l) for l in range(L)]
+
+    def tp_shard(key, w):
+        shard = (tp.shard_rows if key in ("wo", "w2") else tp.shard_columns)
+        return np.stack([shard(w, None, n_tp, i) for i in range(n_tp)])
+
+    stacked = {k: np.stack([tp_shard(k, blk[k]) for blk in blocks])
+               for k in blocks[0]}          # [L, n_tp, ...]
+    staged = {k: pp.interleave_stages(v, S)  # [S, V, n_tp, ...]
+              for k, v in stacked.items()}
+    wspec = P("dcn", None, "ici")
+    staged = {k: jax.device_put(v, NamedSharding(mesh, wspec))
+              for k, v in staged.items()}
+    lnp = (jnp.ones(D), jnp.zeros(D))
+
+    xs = rng.randn(M, B, T, D).astype(np.float32)
+    ys = (rng.randn(M, B, T, D) * 0.3).astype(np.float32)
+
+    def stage_fn(params, x):
+        # One pipeline tick = one TP transformer block (the schedule
+        # hands this device's chunk tree for the tick).
+        p = {"ln1": lnp, "ln2": lnp}
+        p.update(params)
+        return tp.tp_transformer_block(x, p, "ici", num_heads=H)
+
+    def gpipe_stage(pv, x):
+        # gpipe's stage params keep the V=1 chunk dim; strip it.
+        return stage_fn({k: v[0] for k, v in pv.items()}, x)
+
+    def body(staged_local):
+        # staged_local leaves: [1, V, 1, ...] -> [V, ...] chunk tree.
+        chunks = {k: v[0, :, 0] for k, v in staged_local.items()}
+
+        def loss(chunks):
+            if args.schedule == "interleaved":
+                out = pp.interleaved_apply(stage_fn, chunks,
+                                           jnp.asarray(xs), "dcn",
+                                           broadcast_out=False)
+            else:
+                out = pp.gpipe_apply(gpipe_stage, chunks, jnp.asarray(xs),
+                                     "dcn", broadcast_out=False)
+            # Real outputs exist only on the last stage (zeros elsewhere,
+            # where (out-ys)^2 would contribute a spurious ys^2): mask to
+            # the last stage, then psum counts the true loss once with
+            # backward identity via the g pair.
+            my = jax.lax.axis_index("dcn")
+            err = jnp.where(my == S - 1,
+                            jnp.sum((out - jnp.asarray(ys)) ** 2), 0.0)
+            return tp.g_allreduce(err, "dcn") / ys.size
+
+        l, g = jax.value_and_grad(loss)(chunks)
+        new = {k: chunks[k] - args.lr * g[k] for k in chunks}
+        return l, {k: v[None, :, None] for k, v in new.items()}
+
+    sspec = {k: wspec for k in staged}
+    step = jax.jit(shard_map(
+        body, mesh=mesh, in_specs=(sspec,),
+        out_specs=(P(), sspec), check_vma=False))
+
+    losses = []
+    for i in range(args.steps):
+        l, staged = step(staged)
+        losses.append(float(l))
+        if i % 20 == 0 or i == args.steps - 1:
+            print(f"step {i:4d}  loss {losses[-1]:.4f}")
+
+    drop = losses[-1] / losses[0]
+    print(f"loss {losses[0]:.4f} -> {losses[-1]:.4f}  "
+          f"({args.schedule}, {S} stages x tp{n_tp}, {L} blocks)")
+    mpi.stop()
+    assert drop < 0.2, f"2D-parallel training did not converge: {drop:.3f}"
+
+
+if __name__ == "__main__":
+    main()

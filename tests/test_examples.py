@@ -74,3 +74,12 @@ def test_mnist_fsdp_example():
     # Annotation-driven FSDP: per-parameter GSPMD shardings, prefetch
     # pipeline placement; asserts convergence AND 1/n persistent layout.
     _run("mnist_fsdp.py", "--devices", "8")
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("schedule", ["gpipe", "interleaved"])
+def test_megatron_pipeline_example(schedule):
+    # 2D model parallelism: TP blocks inside pipeline stages (both
+    # schedules — their param-indexing paths differ); asserts a 5x loss
+    # drop through both axes' collectives at once.
+    _run("megatron_pipeline.py", "--devices", "8", "--schedule", schedule)
