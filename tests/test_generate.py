@@ -206,3 +206,80 @@ def test_generate_windowed_model_matches_full_recompute():
     expect = _naive_greedy(model, params, prompt, steps=10)  # 16 > window
     got = np.asarray(generate(model, params, prompt, steps=10))
     np.testing.assert_array_equal(got, expect)
+
+
+def test_top_k_and_top_p_sampling():
+    """Support-restriction semantics: top_k=1 and a tiny nucleus both
+    collapse sampling to greedy; top_k=vocab is a no-op filter (same draw
+    as unfiltered at the same rng); moderate settings stay in-vocab."""
+    model = _model()
+    rng = np.random.RandomState(8)
+    prompt = rng.randint(0, 37, size=(2, 5)).astype(np.int32)
+    params = model.init(jax.random.PRNGKey(3),
+                        jnp.asarray(prompt))["params"]
+
+    greedy = np.asarray(generate(model, params, prompt, steps=8))
+
+    # top_k=1 at any temperature == greedy.
+    k1 = np.asarray(generate(model, params, prompt, steps=8,
+                             temperature=5.0, top_k=1,
+                             rng=jax.random.PRNGKey(4)))
+    np.testing.assert_array_equal(k1, greedy)
+
+    # A tiny nucleus at low temperature keeps only the argmax token.
+    p_tiny = np.asarray(generate(model, params, prompt, steps=8,
+                                 temperature=0.05, top_p=1e-6,
+                                 rng=jax.random.PRNGKey(5)))
+    np.testing.assert_array_equal(p_tiny, greedy)
+
+    # top_k=vocab filters nothing: identical draw to the unfiltered
+    # sampler at the same rng/temperature.
+    free = np.asarray(generate(model, params, prompt, steps=8,
+                               temperature=1.0,
+                               rng=jax.random.PRNGKey(6)))
+    k_all = np.asarray(generate(model, params, prompt, steps=8,
+                                temperature=1.0, top_k=37,
+                                rng=jax.random.PRNGKey(6)))
+    np.testing.assert_array_equal(k_all, free)
+
+    # Moderate nucleus+k sampling stays in-vocab and seeded-reproducible.
+    s1 = np.asarray(generate(model, params, prompt, steps=8,
+                             temperature=1.0, top_k=8, top_p=0.9,
+                             rng=jax.random.PRNGKey(7)))
+    s2 = np.asarray(generate(model, params, prompt, steps=8,
+                             temperature=1.0, top_k=8, top_p=0.9,
+                             rng=jax.random.PRNGKey(7)))
+    np.testing.assert_array_equal(s1, s2)
+    assert s1.max() < 37 and s1.min() >= 0
+
+
+def test_top_k_parallel_matches_single_device(hier_runtime):
+    """The filters ride generate_parallel too: top_k=1 sharded-batch
+    decode equals single-device greedy."""
+    import torchmpi_tpu as mpi
+    from torchmpi_tpu.models.generate import generate_parallel
+
+    mesh = mpi.world_mesh()
+    model = _model()
+    rng = np.random.RandomState(9)
+    prompt = rng.randint(0, 37, size=(4, 5)).astype(np.int32)
+    params = model.init(jax.random.PRNGKey(8),
+                        jnp.asarray(prompt))["params"]
+    greedy = np.asarray(generate(model, params, prompt, steps=6))
+    got = np.asarray(generate_parallel(
+        model, params, prompt, steps=6, mesh=mesh, batch_axis="dcn",
+        temperature=3.0, top_k=1, rng=jax.random.PRNGKey(9)))
+    np.testing.assert_array_equal(got, greedy)
+
+
+def test_sampling_knobs_validated():
+    model = _model()
+    prompt = np.zeros((1, 4), np.int32)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.asarray(prompt))["params"]
+    with pytest.raises(ValueError, match="top_k"):
+        generate(model, params, prompt, steps=2, top_k=0)
+    with pytest.raises(ValueError, match="top_p"):
+        generate(model, params, prompt, steps=2, top_p=0.0)
+    with pytest.raises(ValueError, match="top_p"):
+        generate(model, params, prompt, steps=2, top_p=1.5)

@@ -295,3 +295,32 @@ def test_remat_grads_equal_plain(flat_runtime, schedule):
                                rtol=1e-5, atol=1e-6)
     np.testing.assert_allclose(np.asarray(gb_r), np.asarray(gb_p),
                                rtol=1e-5, atol=1e-6)
+
+
+def test_interleaved_composes_with_dp(hier_runtime):
+    # pp over ici (4 stages x V=2 chunks), dp over dcn (different
+    # microbatch streams) — mirror of test_gpipe_composes_with_dp.
+    mesh = mpi.world_mesh()
+    S, L, Mi = 4, 8, 4
+    W, b = _stages(L, seed=15)
+    xs = np.random.RandomState(16).randn(2, Mi, MB, D).astype(np.float32)
+    expect = np.stack([
+        np.stack([_sequential(W, b, xs[g, m]) for m in range(Mi)])
+        for g in range(2)])
+
+    Wi, bi = pp.interleave_stages(W, S), pp.interleave_stages(b, S)
+
+    def body(Wl, bl, xg):
+        out = pp.interleaved_apply(_stage_fn, (Wl[0], bl[0]), xg[0],
+                                   "ici")
+        return out[None]
+
+    out = jax.jit(shard_map(
+        body, mesh=mesh,
+        in_specs=(P("ici"), P("ici"), P("dcn")),
+        out_specs=P("dcn"), check_vma=False))(
+        jax.device_put(Wi, NamedSharding(mesh, P("ici"))),
+        jax.device_put(bi, NamedSharding(mesh, P("ici"))),
+        jax.device_put(xs, NamedSharding(mesh, P("dcn"))))
+    np.testing.assert_allclose(np.asarray(out), expect, rtol=2e-5,
+                               atol=2e-5)

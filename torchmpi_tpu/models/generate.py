@@ -29,7 +29,50 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def _generate_scan(model, params, prompt, steps, temperature, rng):
+def _check_sampling(top_k, top_p):
+    """Entry-boundary validation: out-of-range knobs would otherwise
+    silently degenerate (top_p=0 masks EVERY logit and categorical then
+    emits token 0 forever; top_k=0 indexes the minimum logit)."""
+    if top_k is not None and int(top_k) < 1:
+        raise ValueError(f"top_k must be >= 1, got {top_k}")
+    if top_p is not None and not 0.0 < float(top_p) <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+
+
+def _filter_logits(logits, temperature, top_k, top_p):
+    """Restrict sampling support: ``top_k`` keeps the k highest logits,
+    ``top_p`` keeps the smallest set whose probability mass (at the given
+    temperature, over the top-k-filtered support) reaches p — both
+    static, composable (k first, then p), and no-ops for greedy decoding
+    (argmax ignores the filtered tail).  One vocab sort serves both
+    filters; softmax monotonicity lets the nucleus cut be applied as a
+    LOGIT threshold, so no unsorted-probs pass is needed.
+    """
+    if top_k is None and top_p is None:
+        return logits
+    V = logits.shape[-1]
+    sorted_desc = jnp.sort(logits, axis=-1)[:, ::-1]
+    if top_k is not None:
+        k = min(int(top_k), V)
+        kth = sorted_desc[:, k - 1][:, None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+        sorted_desc = jnp.where(jnp.arange(V)[None, :] < k, sorted_desc,
+                                -jnp.inf)
+    if top_p is not None:
+        sp = jax.nn.softmax(
+            sorted_desc / jnp.maximum(temperature, 1e-6), axis=-1)
+        cum = jnp.cumsum(sp, axis=-1)
+        keep_sorted = (cum - sp) < top_p  # exclusive-cumsum nucleus rule
+        # The first sorted entry always survives (cum - sp == 0 there),
+        # so the threshold is finite and at least one token remains.
+        thresh = jnp.min(jnp.where(keep_sorted, sorted_desc, jnp.inf),
+                         axis=-1, keepdims=True)
+        logits = jnp.where(logits >= thresh, logits, -jnp.inf)
+    return logits
+
+
+def _generate_scan(model, params, prompt, steps, temperature, rng,
+                   top_k=None, top_p=None):
     """Single-forward prefill + scanned decode: traceable anywhere a
     model.apply is — directly under jit (dense path) or inside shard_map
     (parallel path, where the model's collective ops see the mesh axes).
@@ -47,7 +90,8 @@ def _generate_scan(model, params, prompt, steps, temperature, rng):
         return prompt
 
     def sample(logits, rng):  # logits: [B, vocab]
-        logits = logits.astype(jnp.float32)
+        logits = _filter_logits(logits.astype(jnp.float32), temperature,
+                                top_k, top_p)
         return jnp.where(
             temperature > 0.0,
             jax.random.categorical(rng, logits / jnp.maximum(
@@ -82,9 +126,11 @@ def _generate_scan(model, params, prompt, steps, temperature, rng):
     return jnp.concatenate([prompt, first[:, None], toks.T], axis=1)
 
 
-@partial(jax.jit, static_argnums=(0, 3))
-def _generate_jit(model, params, prompt, steps, temperature, rng):
-    return _generate_scan(model, params, prompt, steps, temperature, rng)
+@partial(jax.jit, static_argnums=(0, 3, 6, 7))
+def _generate_jit(model, params, prompt, steps, temperature, rng,
+                  top_k=None, top_p=None):
+    return _generate_scan(model, params, prompt, steps, temperature, rng,
+                          top_k=top_k, top_p=top_p)
 
 
 def _check_prompt(model, prompt, steps):
@@ -100,16 +146,21 @@ def _check_prompt(model, prompt, steps):
 
 def generate(model, params, prompt, steps: int, *,
              temperature: float = 0.0,
+             top_k: Optional[int] = None,
+             top_p: Optional[float] = None,
              rng: Optional[jax.Array] = None) -> jax.Array:
     """Generate ``steps`` tokens after ``prompt`` ([B, T_prompt] int).
 
     ``model`` must be a TransformerLM-like flax module supporting
     ``decode=True`` (single-device attention); pass the TRAINING model —
     this wrapper rebinds it for decoding.  ``temperature=0`` is greedy;
-    otherwise softmax sampling at the given temperature using ``rng``.
+    otherwise softmax sampling at the given temperature using ``rng``,
+    optionally restricted to the ``top_k`` highest-logit tokens and/or
+    the ``top_p`` nucleus (smallest set reaching that probability mass).
     Returns the full [B, T_prompt + steps] sequence.
     """
     _check_prompt(model, prompt, steps)
+    _check_sampling(top_k, top_p)
     if getattr(model, "moe_axis", None) is not None:
         raise ValueError(
             "generate() supports dense MLPs only: moe_axis routing needs "
@@ -125,12 +176,14 @@ def generate(model, params, prompt, steps: int, *,
     if rng is None:
         rng = jax.random.PRNGKey(0)
     return _generate_jit(dmodel, params, jnp.asarray(prompt), steps,
-                         jnp.float32(temperature), rng)
+                         jnp.float32(temperature), rng, top_k, top_p)
 
 
 def generate_parallel(model, params, prompt, steps: int, *, mesh,
                       batch_axis: Optional[str] = None,
                       temperature: float = 0.0,
+                      top_k: Optional[int] = None,
+                      top_p: Optional[float] = None,
                       rng: Optional[jax.Array] = None) -> jax.Array:
     """Sharded generation: the fused prefill+decode scan under
     ``shard_map`` over ``mesh``.
@@ -153,9 +206,11 @@ def generate_parallel(model, params, prompt, steps: int, *, mesh,
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     _check_prompt(model, prompt, steps)
+    _check_sampling(top_k, top_p)
     if rng is None:
         rng = jax.random.PRNGKey(0)
-    fn = _parallel_fn(model.clone(decode=True), steps, mesh, batch_axis)
+    fn = _parallel_fn(model.clone(decode=True), steps, mesh, batch_axis,
+                      top_k, top_p)
     b_spec = P(batch_axis) if batch_axis else P()
     prompt = jax.device_put(jnp.asarray(prompt),
                             NamedSharding(mesh, b_spec))
@@ -163,11 +218,11 @@ def generate_parallel(model, params, prompt, steps: int, *, mesh,
 
 
 @lru_cache(maxsize=None)
-def _parallel_fn(dmodel, steps, mesh, batch_axis):
-    """Build (once per (model, steps, mesh, batch_axis)) the jitted
-    shard_map serving fn — a fresh closure per call would retrace and
-    recompile the whole scan every invocation; temperature and rng stay
-    operands so greedy/sampled calls share the executable."""
+def _parallel_fn(dmodel, steps, mesh, batch_axis, top_k=None, top_p=None):
+    """Build (once per (model, steps, mesh, batch_axis, filters)) the
+    jitted shard_map serving fn — a fresh closure per call would retrace
+    and recompile the whole scan every invocation; temperature and rng
+    stay operands so greedy/sampled calls share the executable."""
     from jax.sharding import PartitionSpec as P
 
     b_spec = P(batch_axis) if batch_axis else P()
@@ -176,7 +231,7 @@ def _parallel_fn(dmodel, steps, mesh, batch_axis):
         if batch_axis is not None:
             rng = jax.random.fold_in(rng, lax.axis_index(batch_axis))
         return _generate_scan(dmodel, params, prompt, steps,
-                              temperature, rng)
+                              temperature, rng, top_k=top_k, top_p=top_p)
 
     return jax.jit(jax.shard_map(
         body, mesh=mesh, in_specs=(P(), b_spec, P(), P()),
