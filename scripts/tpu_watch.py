@@ -102,7 +102,11 @@ def bank():
     with open(os.path.join(ART, f"bench_{stamp}.json"), "w") as f:
         json.dump(results["bench"], f, indent=1)
     log(f"bench rc={rc}, {len(recs)} records banked")
-    got_hw = any(r.get("extra", {}).get("platform") == "tpu" for r in recs)
+    # A banked-fallback re-emission (bench.py's wedge fallback) is not
+    # evidence the hardware is alive — only LIVE tpu records count.
+    got_hw = any(r.get("extra", {}).get("platform") == "tpu"
+                 and not r.get("extra", {}).get("banked_fallback")
+                 for r in recs)
     if not got_hw:
         log("no hardware-platform record in bench output; relay likely "
             "re-wedged — not queueing more device work")
