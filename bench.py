@@ -82,6 +82,22 @@ STAGE_PRIORITY = ["resnet50_dp_train_throughput",
                   "matmul_bf16_tflops"]
 
 
+def pick_best(recs):
+    """The ONE selection rule for a final record: highest-priority stage
+    present (headline training metric beats kernel/probe micro-benches),
+    annotated with every sibling stage's value.  Shared by the live
+    supervisor path and the banked fallback so the two record shapes
+    cannot diverge."""
+    by_metric = {r.get("metric"): r for r in recs}
+    best = next((by_metric[m] for m in STAGE_PRIORITY if m in by_metric),
+                recs[-1])
+    rec = dict(best)
+    extra = dict(rec.get("extra") or {})
+    extra["stages"] = {r.get("metric"): r.get("value") for r in recs}
+    rec["extra"] = extra
+    return rec
+
+
 def latest_banked_record(art_dir=None):
     """Best LIVE on-hardware record from the round's banked watcher
     artifacts (``docs/artifacts/bench_*.json``, newest mtime first): the
@@ -95,8 +111,11 @@ def latest_banked_record(art_dir=None):
 
     art_dir = art_dir or os.path.join(os.path.dirname(
         os.path.abspath(__file__)), "docs", "artifacts")
+    # Filename order, not mtime: a fresh checkout resets every mtime to
+    # checkout time (making mtime order arbitrary), while the watcher's
+    # %m%d_%H%M%S stamps sort correctly within a round's artifacts.
     paths = sorted(glob.glob(os.path.join(art_dir, "bench_*.json")),
-                   key=os.path.getmtime, reverse=True)
+                   key=os.path.basename, reverse=True)
     for path in paths:
         try:
             with open(path) as f:
@@ -110,16 +129,9 @@ def latest_banked_record(art_dir=None):
                 and "banked_from" not in (r.get("extra") or {})]
         if not recs:
             continue
-        by_metric = {r.get("metric"): r for r in recs}
-        best = next((by_metric[m] for m in STAGE_PRIORITY
-                     if m in by_metric), recs[-1])
-        rec = dict(best)
-        extra = dict(rec.get("extra") or {})
-        # Strip live-run context that is false outside its original run,
-        # and carry the sibling stages map final records normally have.
-        extra.pop("stage", None)
-        extra["stages"] = {r.get("metric"): r.get("value") for r in recs}
-        rec["extra"] = extra
+        rec = pick_best(recs)
+        # Strip live-run context that is false outside its original run.
+        rec["extra"].pop("stage", None)
         return rec, os.path.basename(path)
     return None
 
@@ -210,14 +222,7 @@ def supervised() -> int:
         # training metric beats kernel/probe micro-benchmarks even though
         # evidence stages may have printed after it), annotated with every
         # stage's value and any partial-failure context.
-        by_metric = {r.get("metric"): r for r in forwarded}
-        best = next((by_metric[m] for m in STAGE_PRIORITY
-                     if m in by_metric), forwarded[-1])
-        rec = dict(best)
-        extra = dict(rec.get("extra") or {})
-        extra["stages"] = {r.get("metric"): r.get("value")
-                           for r in forwarded}
-        rec["extra"] = extra
+        rec = pick_best(forwarded)
         if reason is not None:
             rec["note"] = f"partial: some stages failed ({reason})"
         print(json.dumps(rec), flush=True)

@@ -15,4 +15,8 @@ from .resnet import (  # noqa: F401
     BottleneckBlock,
 )
 from .transformer import TransformerLM  # noqa: F401
-from .generate import generate, generate_parallel  # noqa: F401
+from .generate import (  # noqa: F401
+    beam_search,
+    generate,
+    generate_parallel,
+)

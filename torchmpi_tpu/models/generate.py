@@ -144,6 +144,101 @@ def _check_prompt(model, prompt, steps):
             f"{model.max_len}")
 
 
+def _beam_scan(model, params, prompt, steps, K):
+    """KV-cache beam search: prefill once on B rows, tile the caches to
+    B*K beam rows, then scan decode steps keeping the K best
+    (cumulative-log-prob) hypotheses per batch row.  Beam reindexing
+    gathers cache rows by parent; sequences are reconstructed by a
+    reverse scan over the (token, parent) trellis — no history carried
+    in the decode loop."""
+    B, Tp = prompt.shape
+    if steps <= 0:
+        return prompt
+
+    (xs, head), updated = model.apply(
+        {"params": params}, prompt, pos_offset=0, return_prehead=True,
+        mutable=["cache"])
+    lp0 = jax.nn.log_softmax((xs[:, -1] @ head).astype(jnp.float32), -1)
+    V = lp0.shape[-1]
+    top_lp, top_tok = lax.top_k(lp0, K)          # [B, K] initial beams
+    top_tok = top_tok.astype(prompt.dtype)
+    cache = jax.tree.map(
+        lambda c: (jnp.repeat(c, K, axis=0)
+                   if c.ndim >= 2 and c.shape[0] == B else c),
+        updated["cache"])
+
+    if steps == 1:
+        best = top_tok[:, 0]  # top_k sorts descending: beam 0 is argmax
+        return jnp.concatenate([prompt, best[:, None]], axis=1)
+
+    def step(carry, i):
+        cache, lp, tok = carry                   # lp/tok: [B, K]
+        logits, updated = model.apply(
+            {"params": params, "cache": cache}, tok.reshape(B * K, 1),
+            pos_offset=i, mutable=["cache"])
+        step_lp = jax.nn.log_softmax(
+            logits[:, 0].astype(jnp.float32), -1).reshape(B, K, V)
+        total = lp[:, :, None] + step_lp         # [B, K, V]
+        new_lp, flat = lax.top_k(total.reshape(B, K * V), K)
+        parent, new_tok = flat // V, (flat % V).astype(prompt.dtype)
+        reorder = (jnp.arange(B)[:, None] * K + parent).reshape(-1)
+        cache = jax.tree.map(
+            lambda c: (c[reorder]
+                       if c.ndim >= 2 and c.shape[0] == B * K else c),
+            updated["cache"])
+        return (cache, new_lp, new_tok), (new_tok, parent)
+
+    (_, final_lp, _), (toks, parents) = lax.scan(
+        step, (cache, top_lp, top_tok), Tp + jnp.arange(steps - 1))
+
+    # Backtrack the best hypothesis through the trellis.
+    best = jnp.argmax(final_lp, axis=-1)         # [B]
+
+    def back(beam, y):
+        tok_t, par_t = y
+        t = jnp.take_along_axis(tok_t, beam[:, None], 1)[:, 0]
+        return jnp.take_along_axis(par_t, beam[:, None], 1)[:, 0], t
+
+    beam0, path = lax.scan(back, best, (toks, parents), reverse=True)
+    first = jnp.take_along_axis(top_tok, beam0[:, None], 1)[:, 0]
+    return jnp.concatenate([prompt, first[:, None], path.T], axis=1)
+
+
+@partial(jax.jit, static_argnums=(0, 3, 4))
+def _beam_jit(model, params, prompt, steps, beams):
+    return _beam_scan(model, params, prompt, steps, beams)
+
+
+def beam_search(model, params, prompt, steps: int, *, beams: int,
+                rng=None) -> jax.Array:
+    """Beam-search decoding over the KV cache: returns, per batch row,
+    the highest-cumulative-log-prob continuation among ``beams``
+    hypotheses expanded per step — ``beams=1`` is exactly greedy
+    :func:`generate`, and with ``beams >= vocab`` and ``steps == 2`` it
+    is exhaustive (both tested).  Fixed ``steps`` for every row (these
+    models have no EOS concept), so no length normalization is applied.
+    Same single-device dense scope as :func:`generate`; ``rng`` is
+    accepted for signature symmetry and unused (beam search is
+    deterministic)."""
+    _check_prompt(model, prompt, steps)
+    if beams < 1:
+        raise ValueError(f"beams must be >= 1, got {beams}")
+    if getattr(model, "vocab", None) is not None and beams > model.vocab:
+        raise ValueError(f"beams {beams} exceeds vocab {model.vocab}")
+    if getattr(model, "moe_axis", None) is not None:
+        raise ValueError(
+            "beam_search supports dense MLPs only (see generate())")
+    if (getattr(model, "attn_impl", "local").startswith("ulysses")
+            and getattr(model, "seq_axis", None) is not None):
+        raise ValueError(
+            "ulysses decode needs the mesh axis in scope — beam_search "
+            "is single-device dense only (see generate_parallel for the "
+            "head-sharded-cache serving path)")
+    del rng
+    return _beam_jit(model.clone(decode=True), params,
+                     jnp.asarray(prompt), steps, int(beams))
+
+
 def generate(model, params, prompt, steps: int, *,
              temperature: float = 0.0,
              top_k: Optional[int] = None,
