@@ -27,7 +27,7 @@ def main():
     import optax
 
     import torchmpi_tpu as mpi
-    from torchmpi_tpu.models import TransformerLM, generate
+    from torchmpi_tpu.models import TransformerLM, beam_search, generate
 
     mpi.init()
     V, T = args.vocab, args.seq_len
@@ -65,23 +65,41 @@ def main():
             print(f"step {i:4d}  loss {float(loss):.4f}")
     print(f"final train loss {float(loss):.4f}")
 
-    # Decode held-out prompts; the continuation must follow the rule.
+    # Decode held-out prompts; the continuation must follow the rule —
+    # through every decode mode the serving path offers.
     prompts = make_batch(np.random.RandomState(args.seed + 999), 8)[:, :4]
-    out = np.asarray(generate(model, params, prompts, steps=args.gen_steps))
-    correct = total = 0
-    for b in range(out.shape[0]):
-        t = int(prompts[b, -1])
-        for j in range(4, 4 + args.gen_steps):
-            t = (t * 3 + 1) % V
-            correct += int(out[b, j] == t)
-            total += 1
-    acc = correct / total
-    print(f"decode: {out.shape[0]} prompts x {args.gen_steps} tokens, "
-          f"rule accuracy {acc:.3f}")
+
+    def rule_acc(out):
+        correct = total = 0
+        for b in range(out.shape[0]):
+            t = int(prompts[b, -1])
+            for j in range(4, 4 + args.gen_steps):
+                t = (t * 3 + 1) % V
+                correct += int(out[b, j] == t)
+                total += 1
+        return correct / total
+
+    out = np.asarray(generate(model, params, prompts,
+                              steps=args.gen_steps))
+    acc = rule_acc(out)
+    print(f"greedy decode: {out.shape[0]} prompts x {args.gen_steps} "
+          f"tokens, rule accuracy {acc:.3f}")
     print(f"sample: prompt {prompts[0].tolist()} -> "
           f"{out[0, 4:].tolist()}")
+
+    # A trained model's rule tokens sit inside any reasonable nucleus, so
+    # filtered sampling must follow the rule too; beam search likewise.
+    acc_s = rule_acc(np.asarray(generate(
+        model, params, prompts, steps=args.gen_steps, temperature=0.7,
+        top_k=4, top_p=0.95, rng=jax.random.PRNGKey(7))))
+    acc_b = rule_acc(np.asarray(beam_search(
+        model, params, prompts, steps=args.gen_steps, beams=4)))
+    print(f"top-k/top-p sampled accuracy {acc_s:.3f}, "
+          f"beam-4 accuracy {acc_b:.3f}")
     mpi.stop()
-    assert acc > 0.8, "decoded continuations do not follow the learned rule"
+    assert acc > 0.8, "greedy continuations do not follow the rule"
+    assert acc_b > 0.8, "beam continuations do not follow the rule"
+    assert acc_s > 0.5, "sampled continuations ignore the rule"
 
 
 if __name__ == "__main__":
