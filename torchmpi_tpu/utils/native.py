@@ -31,8 +31,18 @@ def load_native(so_name: str, src_name: str,
                 ) -> ctypes.CDLL:
     """Load ``build/<so_name>``, rebuilding from ``csrc/<src_name>`` when
     its content hash changed; ``bind(lib)`` declares ctypes signatures.
-    Callers hold their own cache + lock — this function is stateless."""
+    Callers hold their own cache + lock — this function is stateless.
+
+    ``TORCHMPI_TPU_NATIVE_VARIANT=tsan`` loads the ``_tsan``-suffixed
+    sanitizer build instead (``make -C csrc tsan``), so the whole PS/IO
+    test suite can execute under ThreadSanitizer — pair with
+    ``TSAN_OPTIONS=halt_on_error=1`` to turn any detected race into a
+    loud test failure."""
     root = repo_root()
+    variant = os.environ.get("TORCHMPI_TPU_NATIVE_VARIANT", "")
+    if variant:
+        base, ext = os.path.splitext(so_name)
+        so_name = f"{base}_{variant}{ext}"
     so = os.path.join(root, "build", so_name)
     src = os.path.join(root, "csrc", src_name)
     if os.path.exists(src):
@@ -44,7 +54,8 @@ def load_native(so_name: str, src_name: str,
                 built = f.read().strip()
         if built != digest:
             try:
-                subprocess.run(["make", "-C", os.path.join(root, "csrc")],
+                subprocess.run(["make", "-C", os.path.join(root, "csrc")]
+                               + ([variant] if variant else []),
                                check=True, capture_output=True, text=True)
             except subprocess.CalledProcessError as e:
                 raise RuntimeError(
