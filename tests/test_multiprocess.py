@@ -59,7 +59,9 @@ def test_two_process_world():
         assert f"CHECK rank={i} done" in out, out
         assert f"CHECK rank={i} eager-allreduce ok" in out, out
         assert f"CHECK rank={i} hierarchical ok" in out, out
+        assert f"CHECK rank={i} broadcast ok" in out, out
         assert f"CHECK rank={i} zero ok" in out, out
+        assert f"CHECK rank={i} zero3 ok" in out, out
 
 
 @pytest.mark.slow
