@@ -49,9 +49,46 @@ def log(*a):
     print(time.strftime("[%H:%M:%S]"), *a, file=sys.stderr, flush=True)
 
 
+def _compile_inflight():
+    """True while ANY process holds a fresh compile-inflight heartbeat
+    (written by torchmpi_tpu.utils.compilegate during a blessed relay
+    compile).  Matched by glob, not pid: bench.py's compiles run in a
+    grandchild of the proc this watcher holds, so keying on the direct
+    child's pid would miss the heartbeat that matters.  Escalating to
+    SIGKILL while one is fresh would abandon the relay's serial compile
+    queue — the exact wedge this watcher exists to avoid."""
+    import glob as _glob
+
+    for path in _glob.glob(os.path.join(REPO, ".jax_compile_cache",
+                                        "compile_inflight_*")):
+        try:
+            if (time.time() - os.path.getmtime(path)) < 45.0:
+                return True
+        except OSError:
+            continue
+    return False
+
+
+def _wait_compile_drain(why, cap_s=2700.0):
+    """Sleep while a compile heartbeat is fresh, up to ``cap_s`` (3x the
+    cold-compile budget): a heartbeat that outlives any plausible
+    compile means the relay is already wedged and waiting longer buys
+    nothing — the watcher must get back to probing (code review r4)."""
+    t0 = time.time()
+    while _compile_inflight():
+        if time.time() - t0 > cap_s:
+            log(f"{why}: compile heartbeat still fresh after {cap_s:.0f}s "
+                "cap; relay presumed wedged — proceeding to signal")
+            return
+        log(f"{why}: compile in flight; waiting before signalling")
+        time.sleep(30)
+
+
 def run_bounded(cmd, timeout, log_path, env=None):
     """Run cmd with SIGTERM-then-KILL bounding; tee output to log_path.
-    Returns (rc, last_lines)."""
+    Returns (rc, last_lines).  The KILL escalation WAITS (bounded) while
+    a relay compile heartbeat is fresh — a compile must never be
+    abandoned mid-queue (docs/ROUND3_NOTES.md)."""
     with open(log_path, "a") as lf:
         lf.write(f"\n=== {time.strftime('%F %T')} {' '.join(cmd)} "
                  f"(timeout {timeout}s)\n")
@@ -61,10 +98,12 @@ def run_bounded(cmd, timeout, log_path, env=None):
         try:
             proc.wait(timeout=timeout)
         except subprocess.TimeoutExpired:
+            _wait_compile_drain("timeout")
             proc.terminate()  # SIGTERM + grace — never bare SIGKILL
             try:
                 proc.wait(timeout=30)
             except subprocess.TimeoutExpired:
+                _wait_compile_drain("SIGTERM grace expired")
                 proc.kill()
                 proc.wait()
     with open(log_path) as f:
@@ -85,7 +124,7 @@ def bank():
     """The liveness window is open: run the sequence, cheapest first.
     Each step is individually bounded; a hang in one still leaves the
     earlier artifacts on disk."""
-    stamp = time.strftime("%m%d_%H%M%S")
+    stamp = time.strftime("%Y%m%d_%H%M%S")  # year-qualified (ADVICE r3)
     results = {}
 
     bench_log = os.path.join(ART, f"bench_{stamp}.log")

@@ -109,3 +109,50 @@ def test_latest_banked_record_fallback(tmp_path):
         "transformer_lm_train_throughput": 2e5}
 
     assert bench.latest_banked_record(str(tmp_path / "empty")) is None
+
+
+def test_banked_record_config_matching(tmp_path):
+    # ADVICE r3: a banked record at different shapes (the batch-256
+    # experiment class) must not stand in for the current config.
+    import bench
+
+    (tmp_path / "bench_20260730_000000.json").write_text(json.dumps({
+        "records": [
+            {"metric": "resnet50_dp_train_throughput", "value": 999.0,
+             "unit": "img/s/chip", "vs_baseline": 1.0,
+             "extra": {"platform": "tpu", "devices": 1,
+                       "global_batch": 256, "image": 224}}]}))
+    (tmp_path / "bench_0615_000000.json").write_text(json.dumps({
+        "records": [
+            {"metric": "resnet50_dp_train_throughput", "value": 123.0,
+             "unit": "img/s/chip", "vs_baseline": 1.0,
+             "extra": {"platform": "tpu", "devices": 1,
+                       "global_batch": 128, "image": 224}}]}))
+    # Unconstrained: the year-stamped (newer) batch-256 artifact wins.
+    rec, src = bench.latest_banked_record(str(tmp_path))
+    assert rec["value"] == 999.0 and src == "bench_20260730_000000.json"
+    # Constrained to this run's config: only the batch-128 record
+    # qualifies, even though its artifact stamp is older.
+    rec, src = bench.latest_banked_record(str(tmp_path),
+                                          want=bench.BANKED_WANT)
+    assert rec["value"] == 123.0 and src == "bench_0615_000000.json"
+    # Metrics not in want at all are excluded.
+    rec2, _ = bench.latest_banked_record(
+        str(tmp_path), want={"some_other_metric": {}}) or (None, None)
+    assert rec2 is None
+
+
+def test_stamp_sort_key_year_boundary():
+    # Year-qualified stamps sort after every legacy stamp, and correctly
+    # across a year boundary among themselves (ADVICE r3).
+    import bench
+
+    names = ["bench_1231_235959.json",       # legacy (round 3)
+             "bench_20261231_235959.json",
+             "bench_20270101_000001.json",
+             "bench_0101_000000.json"]       # legacy
+    ordered = sorted(names, key=bench._stamp_sort_key)
+    assert ordered == ["bench_0101_000000.json",
+                       "bench_1231_235959.json",
+                       "bench_20261231_235959.json",
+                       "bench_20270101_000001.json"]

@@ -1,0 +1,208 @@
+"""Tests for the library-wide relay compile-budget gate.
+
+The gate (utils/compilegate.py) is the round-4 hoisting of bench.py's
+stage-D rule into the library: no device client may dispatch a large
+cold compile to the relay's serial queue without either a prior-success
+marker for that exact graph key or an explicitly declared budget that
+can absorb it (VERDICT r3 next-round #1).
+
+These tests exercise the policy and the wrapper off-platform: the CPU
+test mesh must never be gated (the gate is relay-only), so the wrapper
+is driven directly with a fake tpu backend and the policy function with
+synthetic keys.
+"""
+
+import os
+import signal
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+import torchmpi_tpu as mpi
+from torchmpi_tpu.utils import compilecache, compilegate
+
+
+@pytest.fixture(autouse=True)
+def _clean_budget_env(monkeypatch, tmp_path):
+    monkeypatch.delenv("TORCHMPI_TPU_COMPILE_BUDGET", raising=False)
+    monkeypatch.delenv("TORCHMPI_TPU_BENCH_DEADLINE", raising=False)
+    monkeypatch.delenv("TORCHMPI_TPU_COMPILE_NEED", raising=False)
+    assert not compilegate._gate.budget_stack
+    yield
+    assert not compilegate._gate.budget_stack
+
+
+def test_gate_installed_at_import():
+    # Package import arms the gate (idempotent); the jax chokepoints
+    # carry the wrapper marker.
+    from jax._src import compiler as jc
+
+    assert compilegate._gate.installed
+    assert hasattr(jc.backend_compile_and_load, "__wrapped__")
+    assert hasattr(jc.backend_compile, "__wrapped__")
+
+
+def test_cpu_platform_never_gated():
+    # The whole CPU test suite runs under the armed gate; a fresh jit
+    # compile (cold, large-ish, no budget declared) must pass untouched.
+    x = jnp.ones((64, 64))
+    y = jax.jit(lambda a: a @ a + 3.0)(x)
+    assert y.shape == (64, 64)
+
+
+def test_check_budget_refuses_cold_unbudgeted(tmp_path, monkeypatch):
+    monkeypatch.setenv("TORCHMPI_TPU_COMPILE_CACHE", str(tmp_path))
+    with pytest.raises(compilegate.CompileBudgetError) as ei:
+        compilegate._check_budget("hlo_deadbeef_n1", 5_000_000, "big_step")
+    assert "relay" in str(ei.value)
+
+
+def test_check_budget_unbounded_context_allows(tmp_path, monkeypatch):
+    monkeypatch.setenv("TORCHMPI_TPU_COMPILE_CACHE", str(tmp_path))
+    with mpi.compile_budget():  # unbounded
+        compilegate._check_budget("hlo_deadbeef_n1", 5_000_000, "big_step")
+
+
+def test_check_budget_env_unbounded_allows(tmp_path, monkeypatch):
+    monkeypatch.setenv("TORCHMPI_TPU_COMPILE_CACHE", str(tmp_path))
+    monkeypatch.setenv("TORCHMPI_TPU_COMPILE_BUDGET", "unbounded")
+    compilegate._check_budget("hlo_deadbeef_n1", 5_000_000, "big_step")
+
+
+def test_check_budget_deadline_math(tmp_path, monkeypatch):
+    monkeypatch.setenv("TORCHMPI_TPU_COMPILE_CACHE", str(tmp_path))
+    # 100 s declared < 900 s cold need -> refused.
+    with mpi.compile_budget(seconds=100):
+        with pytest.raises(compilegate.CompileBudgetError):
+            compilegate._check_budget("hlo_deadbeef_n1", 5e6, "big_step")
+    # 2000 s declared > 900 s cold need -> allowed.
+    with mpi.compile_budget(seconds=2000):
+        compilegate._check_budget("hlo_deadbeef_n1", 5e6, "big_step")
+
+
+def test_check_budget_marker_shrinks_need(tmp_path, monkeypatch):
+    monkeypatch.setenv("TORCHMPI_TPU_COMPILE_CACHE", str(tmp_path))
+    key = "hlo_cafecafe_n1"
+    # Marker present: allowed with no declared budget at all (the
+    # fast-recompile class), and with a 300 s budget (> 240 s marked
+    # need) though that would refuse a cold compile.
+    compilecache.mark_compiled(key, str(tmp_path))
+    compilegate._check_budget(key, 5e6, "big_step")
+    with mpi.compile_budget(seconds=300):
+        compilegate._check_budget(key, 5e6, "big_step")
+
+
+def test_bench_deadline_env_is_a_budget(tmp_path, monkeypatch):
+    monkeypatch.setenv("TORCHMPI_TPU_COMPILE_CACHE", str(tmp_path))
+    # bench.py's existing deadline contract doubles as the declared
+    # budget, so the driver-run bench composes with the gate unchanged.
+    monkeypatch.setenv("TORCHMPI_TPU_BENCH_DEADLINE",
+                       str(time.time() + 5000))
+    compilegate._check_budget("hlo_deadbeef_n1", 5e6, "big_step")
+    monkeypatch.setenv("TORCHMPI_TPU_BENCH_DEADLINE",
+                       str(time.time() + 50))
+    with pytest.raises(compilegate.CompileBudgetError):
+        compilegate._check_budget("hlo_deadbeef_n1", 5e6, "big_step")
+
+
+class _FakeBackend:
+    platform = "tpu"
+
+
+def _lowered_module(n=256):
+    """A real StableHLO module to drive the wrapper with."""
+    x = jnp.ones((n, n))
+    return jax.jit(lambda a: a @ a).lower(x).compiler_ir()
+
+
+def test_wrapper_gates_fake_tpu_backend(tmp_path, monkeypatch):
+    """Drive the installed wrapper directly with a fake tpu backend:
+    large cold module + no budget -> CompileBudgetError before the
+    underlying compile runs; with a declared budget the compile runs
+    and a success marker is written for the graph key."""
+    monkeypatch.setenv("TORCHMPI_TPU_COMPILE_CACHE", str(tmp_path))
+    # Force-gate regardless of relay-plugin registration on this host.
+    monkeypatch.setenv("TORCHMPI_TPU_COMPILE_GATE", "1")
+    # Gate everything: threshold below this tiny module's size.
+    monkeypatch.setenv("TORCHMPI_TPU_COMPILE_GATE_MIN_BYTES", "1")
+    calls = []
+
+    def orig(backend, module, devices, options):
+        calls.append(module)
+        return "executable"
+
+    gated = compilegate._wrap(orig)
+    module = _lowered_module()
+    with pytest.raises(compilegate.CompileBudgetError):
+        gated(_FakeBackend(), module, [None], None)
+    assert not calls  # refused BEFORE dispatch
+
+    with mpi.compile_budget():
+        out = gated(_FakeBackend(), module, [None], None)
+    assert out == "executable" and len(calls) == 1
+    key, size = compilegate._graph_key(module, 1)
+    assert size > 1
+    assert compilecache.was_compiled(key, str(tmp_path))
+    # Marked now: the same compile passes with no declared budget.
+    out = gated(_FakeBackend(), module, [None], None)
+    assert out == "executable" and len(calls) == 2
+
+
+def test_wrapper_small_module_passes(tmp_path, monkeypatch):
+    monkeypatch.setenv("TORCHMPI_TPU_COMPILE_CACHE", str(tmp_path))
+    monkeypatch.setenv("TORCHMPI_TPU_COMPILE_GATE", "1")
+    # Default threshold (512 KiB) far exceeds this module: no gating.
+    calls = []
+    gated = compilegate._wrap(
+        lambda backend, module, devices, options: calls.append(1) or "ok")
+    assert gated(_FakeBackend(), _lowered_module(), [None], None) == "ok"
+    assert calls  # dispatched without any budget declared
+
+
+def test_signal_deferral_during_blessed_compile(tmp_path, monkeypatch):
+    """SIGTERM delivered while a blessed compile is in flight is
+    deferred until the compile returns (non-abandonable budget)."""
+    monkeypatch.setenv("TORCHMPI_TPU_COMPILE_CACHE", str(tmp_path))
+    monkeypatch.setenv("TORCHMPI_TPU_COMPILE_GATE", "1")
+    monkeypatch.setenv("TORCHMPI_TPU_COMPILE_GATE_MIN_BYTES", "1")
+    seen = []
+    prev = signal.signal(signal.SIGTERM, lambda n, f: seen.append(n))
+    try:
+        during = []
+
+        def slow_compile(backend, module, devices, options):
+            os.kill(os.getpid(), signal.SIGTERM)
+            time.sleep(0.05)  # give a mis-delivered signal time to land
+            during.append(list(seen))
+            return "done"
+
+        gated = compilegate._wrap(slow_compile)
+        with mpi.compile_budget():
+            out = gated(_FakeBackend(), _lowered_module(), [None], None)
+        assert out == "done"
+        assert during == [[]]  # nothing delivered DURING the compile
+        assert seen == [signal.SIGTERM]  # re-delivered after
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+
+
+def test_heartbeat_file_lifecycle(tmp_path, monkeypatch):
+    """The inflight heartbeat exists during a blessed compile (for
+    tpu_watch.run_bounded's grace extension) and is removed after."""
+    monkeypatch.setenv("TORCHMPI_TPU_COMPILE_CACHE", str(tmp_path))
+    monkeypatch.setenv("TORCHMPI_TPU_COMPILE_GATE", "1")
+    monkeypatch.setenv("TORCHMPI_TPU_COMPILE_GATE_MIN_BYTES", "1")
+    observed = []
+
+    def compile_fn(backend, module, devices, options):
+        observed.append(os.path.exists(compilegate.inflight_path()))
+        return "ok"
+
+    gated = compilegate._wrap(compile_fn)
+    with mpi.compile_budget():
+        gated(_FakeBackend(), _lowered_module(), [None], None)
+    assert observed == [True]
+    assert not os.path.exists(compilegate.inflight_path())

@@ -66,6 +66,18 @@ from .collectives import (
     sync_handle,
     AsyncHandle,
 )
+from .utils.compilegate import (
+    CompileBudgetError,
+    compile_budget,
+    install as _install_compile_gate,
+)
+
+# Arm the relay compile-budget gate for EVERY client of this library at
+# import time (round-3 postmortem: prose discipline does not survive;
+# the rule has to live in the library).  Passive unless the axon relay
+# platform dispatches a large cold compile; opt out with
+# TORCHMPI_TPU_COMPILE_GATE=0.
+_install_compile_gate()
 
 __version__ = "0.1.0"
 
@@ -76,5 +88,6 @@ __all__ = [
     "set_config", "config", "DCN_AXIS", "ICI_AXIS", "WORLD_AXES",
     "collectives", "selector", "parallel", "allreduce", "broadcast", "reduce",
     "allgather", "reduce_scatter", "sendreceive", "alltoall", "gather",
-    "scatter", "async_", "sync_handle", "AsyncHandle", "__version__",
+    "scatter", "async_", "sync_handle", "AsyncHandle", "compile_budget",
+    "CompileBudgetError", "__version__",
 ]

@@ -142,6 +142,13 @@ def init(config: Optional[Config] = None, **overrides) -> Mesh:
             )
             _state.distributed_initialized = True
 
+        # Re-assert the relay compile-budget gate (armed at package
+        # import; a client may have uninstalled it or imported around
+        # the package __init__).  See utils/compilegate.py.
+        from .utils import compilegate
+
+        compilegate.install()
+
         _state.config = cfg
         _state.devices = list(jax.devices())
         world = _build_world_mesh(cfg, _state.devices)

@@ -59,11 +59,14 @@ def marker_path(name: str, directory: str | None = None) -> str:
     stage-D gate).  ``name`` must encode everything that changes the
     compiled graph (platform, shapes, device count) — a marker from a
     different configuration would shrink the budget for what is actually
-    a cold compile.  Resolution order matches the cache actually enabled:
-    explicit arg > the directory passed to enable_persistent_cache >
-    env > default."""
-    directory = (directory or _enabled
+    a cold compile.  Resolution order: explicit arg > env > the directory
+    passed to enable_persistent_cache > default.  Env outranks the
+    enabled directory so a process that armed the cache at import (the
+    compile gate does) still honors a later TORCHMPI_TPU_COMPILE_CACHE
+    override for marker bookkeeping."""
+    directory = (directory
                  or os.environ.get("TORCHMPI_TPU_COMPILE_CACHE")
+                 or _enabled
                  or DEFAULT_DIR)
     return os.path.join(directory, f"compiled_ok_{name}")
 

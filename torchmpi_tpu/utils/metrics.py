@@ -23,6 +23,13 @@ def fence(x) -> None:
     np.asarray(jax.device_get(leaf.ravel()[0] if leaf.ndim else leaf))
 
 
+# Per-round seconds/iter of the most recent timed() call, fastest first
+# is NOT applied — this is the raw chronological spread, so a consumer
+# can audit how far min-of-rounds sits from the mean (ADVICE r3: the
+# min-selection headline must leave the spread on the record).
+last_round_times: List[float] = []
+
+
 def timed(step, iters: int, fence=fence, rounds: int = 3) -> float:
     """Seconds per iteration of ``step``: one warm/compile call, then the
     FASTEST of ``rounds`` fenced timing rounds of ``iters`` dispatches.
@@ -31,17 +38,20 @@ def timed(step, iters: int, fence=fence, rounds: int = 3) -> float:
     post-compile round can run ~100x slower than steady state (measured
     2026-07-30: ~600-1100 ms/step settling to ~7 ms) even after a fenced
     warmup call, so a single timing pass understates throughput 2-3x.
-    The shared harness behind bench.py and the scripts/ sweeps."""
+    The per-round times of the last call are published in
+    ``last_round_times`` (chronological) so callers can attach the
+    spread to their records.  The shared harness behind bench.py and the
+    scripts/ sweeps."""
     out = step()
     fence(out)
-    best = float("inf")
+    del last_round_times[:]
     for _ in range(max(1, rounds)):
         t0 = time.perf_counter()
         for _ in range(iters):
             out = step()
         fence(out)
-        best = min(best, (time.perf_counter() - t0) / iters)
-    return best
+        last_round_times.append((time.perf_counter() - t0) / iters)
+    return min(last_round_times)
 
 
 class Timer:
