@@ -326,12 +326,11 @@ def test_interleaved_composes_with_dp(hier_runtime):
                                atol=2e-5)
 
 
-def test_3d_pp_tp_dp_composition(flat_runtime):
-    """Full 3D model parallelism on ONE mesh via the communicator-split
-    API (the reference's push_communicator analog): pipeline stages over
-    `pp`, Megatron TP blocks over `tp`, independent batch streams over
-    `dp` — forward equals the dense sequential oracle per dp stream."""
-    import torchmpi_tpu as mpi
+def _run_3d_composition(mesh3):
+    """Shared 3D-parallelism body: pipeline stages over `pp`, Megatron TP
+    blocks over `tp`, independent batch streams over `dp` on the given
+    (pp=2, tp=2, dp=2) mesh — forward equals the dense sequential oracle
+    per dp stream."""
     from torchmpi_tpu.parallel import tensor as tp
 
     S, n_tp, n_dp = 2, 2, 2
@@ -388,25 +387,43 @@ def test_3d_pp_tp_dp_composition(flat_runtime):
     staged = {k: np.stack([shards(k, blk[k]) for blk in blocks])
               for k in blocks[0]}          # [S, n_tp, ...]
 
-    with mpi.communicator("3d", shape={"pp": S, "tp": n_tp,
-                                       "dp": n_dp}) as mesh3:
-        wspec = P("pp", "tp")
+    wspec = P("pp", "tp")
 
-        def stage_fn(pv, x):
-            p = {"ln1": lnp, "ln2": lnp}
-            p.update({k: v[0, 0] for k, v in pv.items()})
-            return tp.tp_transformer_block(x, p, "tp", num_heads=H)
+    def stage_fn(pv, x):
+        p = {"ln1": lnp, "ln2": lnp}
+        p.update({k: v[0, 0] for k, v in pv.items()})
+        return tp.tp_transformer_block(x, p, "tp", num_heads=H)
 
-        def body(staged_local, xg):
-            out = pp.gpipe_apply(stage_fn, staged_local, xg[0], "pp")
-            return out[None]
+    def body(staged_local, xg):
+        out = pp.gpipe_apply(stage_fn, staged_local, xg[0], "pp")
+        return out[None]
 
-        out = jax.jit(shard_map(
-            body, mesh=mesh3,
-            in_specs=({k: wspec for k in staged}, P("dp")),
-            out_specs=P("dp"), check_vma=False))(
-            {k: jax.device_put(v, NamedSharding(mesh3, wspec))
-             for k, v in staged.items()},
-            jax.device_put(xs, NamedSharding(mesh3, P("dp"))))
+    out = jax.jit(shard_map(
+        body, mesh=mesh3,
+        in_specs=({k: wspec for k in staged}, P("dp")),
+        out_specs=P("dp"), check_vma=False))(
+        {k: jax.device_put(v, NamedSharding(mesh3, wspec))
+         for k, v in staged.items()},
+        jax.device_put(xs, NamedSharding(mesh3, P("dp"))))
     np.testing.assert_allclose(np.asarray(out), expect, rtol=3e-4,
                                atol=3e-5)
+
+
+def test_3d_pp_tp_dp_composition(flat_runtime):
+    """3D parallelism on ONE mesh built via the communicator-split API
+    (the reference's push_communicator analog)."""
+    with mpi.communicator("3d", shape={"pp": 2, "tp": 2,
+                                       "dp": 2}) as mesh3:
+        _run_3d_composition(mesh3)
+
+
+def test_3d_pp_tp_dp_on_first_class_mesh():
+    """The same 3D composition on the init-level N-D world mesh
+    (Config(mesh_shape=...), VERDICT r3 #6): no communicator pushes at
+    all — the world mesh itself carries the pp/tp/dp axes."""
+    mpi.stop()
+    mesh3 = mpi.init(mpi.Config(mesh_shape={"pp": 2, "tp": 2, "dp": 2}))
+    try:
+        _run_3d_composition(mesh3)
+    finally:
+        mpi.stop()

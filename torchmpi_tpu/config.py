@@ -52,6 +52,18 @@ class Config:
     # Number of slices / outer (DCN) axis.  None = auto (process count // hosts
     # per slice, or 1).
     dcn_size: Optional[int] = None
+    # First-class N-D world mesh (VERDICT r3 #6; SURVEY.md §6.7: the mesh
+    # design must not hard-code axes): ordered dict of axis-name -> size,
+    # e.g. {"pp": 2, "tp": 2, "dp": 2}.  Built as ONE mesh at init with
+    # those named axes — no communicator pushes needed for N-D
+    # parallelism; push_communicator remains the split/subset API on
+    # top.  Dict order is major -> minor: the LAST axis varies fastest
+    # over the raw device order, i.e. is the most interconnect-local —
+    # put tensor-parallel innermost, data/pipeline outermost.  At most
+    # one size may be -1 (inferred from the device count).  Mutually
+    # exclusive with ici_size/dcn_size (which build the classic 2-level
+    # (dcn, ici) world).  Env: TORCHMPI_TPU_MESH_SHAPE="pp=2,tp=2,dp=-1".
+    mesh_shape: Optional[dict] = None
     # Use GPU/TPU devices if available (mirrors mpi.start(withCuda)).
     use_accelerator: bool = True
 
@@ -153,6 +165,16 @@ class Config:
         dcn = os.environ.get("TORCHMPI_TPU_DCN_SIZE")
         if dcn is not None:
             cfg.dcn_size = int(dcn)
+        mesh = os.environ.get("TORCHMPI_TPU_MESH_SHAPE")
+        if mesh:
+            cfg.mesh_shape = {}
+            for part in mesh.split(","):
+                name, _, size = part.partition("=")
+                if not name.strip() or not size.strip():
+                    raise ValueError(
+                        f"TORCHMPI_TPU_MESH_SHAPE: malformed entry {part!r} "
+                        "(want name=size,name=size,...)")
+                cfg.mesh_shape[name.strip()] = int(size)
         # Set by `python -m torchmpi_tpu.launch` (the mpirun analog):
         coord = os.environ.get("TORCHMPI_TPU_COORDINATOR")
         if coord:
