@@ -17,6 +17,7 @@ from .resnet import (  # noqa: F401
 from .transformer import TransformerLM  # noqa: F401
 from .generate import (  # noqa: F401
     beam_search,
+    beam_search_parallel,
     generate,
     generate_parallel,
 )
