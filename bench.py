@@ -579,19 +579,43 @@ def main():
             rngf = np.random.RandomState(3)
             qkv = [jnp.asarray(rngf.randn(Bf, Tf, Hf, Df), jnp.bfloat16)
                    for _ in range(3)]
+            # Floor-honest timing (VERDICT r3 #4): the relay imposes a
+            # ~7 ms PER-DISPATCH floor (ROUND3_NOTES), larger than the
+            # kernel itself at these dims, so single-call timings put
+            # the floor in both sides of every ratio.  Stage A's fix,
+            # applied here: CHAIN of dependent invocations inside ONE
+            # jit program — the floor is paid once per dispatch and the
+            # data dependence (q <- output) stops CSE from collapsing
+            # the chain — then divide by the chain depth.
+            CHF = 4
+
+            @jax.jit
+            def fl_chain(q, k, v):
+                for _ in range(CHF):
+                    q = flash_attention(q, k, v, causal=True)
+                return q
+
             fl = jax.jit(lambda q, k, v: flash_attention(q, k, v,
                                                          causal=True))
             log("stage C: compiling flash attention kernel...")
             iters_d = 10
-            dt_d = timed(lambda: fl(*qkv), iters_d, fence)
+            dt_single = timed(lambda: fl(*qkv), iters_d, fence)
+            dt_d = timed(lambda: fl_chain(*qkv), iters_d, fence) / CHF
             fl_tflops = 4.0 * Bf * Hf * Tf * Tf * Df * 0.5 / dt_d / 1e12
             dense_ms = None
             oracle_err = None
             try:
+                @jax.jit
+                def dn_chain(q, k, v):
+                    for _ in range(CHF):
+                        q = reference_attention(q, k, v, causal=True
+                                                ).astype(q.dtype)
+                    return q
+
                 dn = jax.jit(lambda q, k, v: reference_attention(
                     q, k, v, causal=True))
-                dense_ms = round(timed(lambda: dn(*qkv), iters_d, fence)
-                                 * 1e3, 3)
+                dense_ms = round(timed(lambda: dn_chain(*qkv), iters_d,
+                                       fence) / CHF * 1e3, 3)
                 # On-device oracle: a Mosaic-lowered kernel can still
                 # miscompute at run time (round-2 verdict's largest
                 # residual correctness risk) — assert, don't just time.
@@ -605,9 +629,10 @@ def main():
                 raise
             except Exception as e:  # noqa: BLE001 — dense OOMs first
                 log(f"stage C dense comparison failed: {e}")
-            log(f"stage C: flash {dt_d*1e3:.2f} ms ({fl_tflops:.1f} "
-                f"TFLOP/s) vs xla-dense {dense_ms} ms, "
-                f"oracle max|err|={oracle_err}")
+            log(f"stage C: flash {dt_d*1e3:.2f} ms/invocation "
+                f"(chained x{CHF}; single-dispatch {dt_single*1e3:.2f} "
+                f"ms) ({fl_tflops:.1f} TFLOP/s) vs xla-dense {dense_ms} "
+                f"ms, oracle max|err|={oracle_err}")
             print(json.dumps({
                 "metric": "flash_attention_tflops",
                 "value": round(fl_tflops, 1),
@@ -616,7 +641,10 @@ def main():
                 "extra": {"batch": Bf, "seq": Tf, "heads": Hf,
                           "head_dim": Df, "causal": True,
                           "dtype": "bfloat16",
+                          "chained_per_dispatch": CHF,
                           "flash_ms": round(dt_d * 1e3, 3),
+                          "flash_ms_single_dispatch":
+                              round(dt_single * 1e3, 3),
                           "xla_dense_ms": dense_ms,
                           "oracle_max_err": oracle_err,
                           "platform": platform0},
@@ -638,10 +666,24 @@ def main():
             xx = jnp.asarray(rngx.randn(Nx, Ex) * 0.05, jnp.bfloat16)
             wx = jnp.asarray(rngx.randn(Ex, Vx) * 0.05, jnp.bfloat16)
             lx = jnp.asarray(rngx.randint(0, Vx, size=Nx), jnp.int32)
+            # Floor-honest chain (VERDICT r3 #4), same trick as stage C.
+            # The loss output cannot feed the input, so CSE is defeated
+            # by rolling the labels per link (identical shapes, distinct
+            # operands) and summing the per-link losses.
+            CHX = 4
+
+            @jax.jit
+            def fx_chain(x, w, l):
+                tot = jnp.float32(0)
+                for _ in range(CHX):
+                    tot = tot + fused_linear_cross_entropy(x, w, l).sum()
+                    l = jnp.roll(l, 1)
+                return tot
             fx = jax.jit(lambda x, w, l: fused_linear_cross_entropy(
                 x, w, l))
             log("stage C2: compiling fused linear+xent kernel...")
-            dt_x = timed(lambda: fx(xx, wx, lx), 10, fence)
+            dt_x_single = timed(lambda: fx(xx, wx, lx), 10, fence)
+            dt_x = timed(lambda: fx_chain(xx, wx, lx), 10, fence) / CHX
             # matmul flops dominate: 2*N*E*V fwd (fwd-only here).
             xt_tflops = 2.0 * Nx * Ex * Vx / dt_x / 1e12
 
@@ -660,8 +702,10 @@ def main():
             assert err_x < 5e-3, (
                 f"fused xent disagrees with XLA oracle on {platform0}: "
                 f"max|err|={err_x}")
-            log(f"stage C2: fused xent {dt_x*1e3:.2f} ms "
-                f"({xt_tflops:.1f} TFLOP/s), oracle max|err|={err_x:.2e}")
+            log(f"stage C2: fused xent {dt_x*1e3:.2f} ms/invocation "
+                f"(chained x{CHX}; single-dispatch {dt_x_single*1e3:.2f} "
+                f"ms) ({xt_tflops:.1f} TFLOP/s), oracle "
+                f"max|err|={err_x:.2e}")
             print(json.dumps({
                 "metric": "fused_xent_tflops",
                 "value": round(xt_tflops, 1),
@@ -669,7 +713,10 @@ def main():
                 "vs_baseline": round(xt_tflops / peak, 4),
                 "extra": {"tokens": Nx, "embed": Ex, "vocab": Vx,
                           "dtype": "bfloat16",
+                          "chained_per_dispatch": CHX,
                           "fused_ms": round(dt_x * 1e3, 3),
+                          "fused_ms_single_dispatch":
+                              round(dt_x_single * 1e3, 3),
                           "oracle_max_err": err_x,
                           "platform": platform0},
             }), flush=True)

@@ -178,6 +178,42 @@ def test_tp_attention_matches_dense(flat_runtime, causal):
                                atol=2e-5)
 
 
+def test_tp_attention_flash_impl_matches_dense(flat_runtime):
+    """impl='flash' (ADVICE r3: route the O(T^2) dense inner attention
+    through the Pallas kernel) must match impl='dense' on the same
+    shards — interpreted kernel on the CPU mesh, tiny block-aligned
+    dims."""
+    mesh = mpi.world_mesh()
+    H = 8
+    x, wq, wk, wv, wo = _attn_weights(H=H)
+    axes = ("dcn", "ici")
+    spec = P(axes)
+
+    def run(impl):
+        def body(x, wql, wkl, wvl, wol):
+            return tp.tp_attention(x, wql[0], wkl[0], wvl[0], wol[0],
+                                   axes, num_heads=H, causal=True,
+                                   impl=impl)
+
+        return np.asarray(jax.jit(shard_map(
+            body, mesh=mesh, in_specs=(P(), spec, spec, spec, spec),
+            out_specs=P(), check_vma=False))(
+            x,
+            jax.device_put(_col_shards(wq, mesh),
+                           NamedSharding(mesh, spec)),
+            jax.device_put(_col_shards(wk, mesh),
+                           NamedSharding(mesh, spec)),
+            jax.device_put(_col_shards(wv, mesh),
+                           NamedSharding(mesh, spec)),
+            jax.device_put(_row_shards(wo, mesh),
+                           NamedSharding(mesh, spec))))
+
+    np.testing.assert_allclose(run("flash"), run("dense"), rtol=2e-4,
+                               atol=2e-5)
+    with pytest.raises(ValueError, match="impl"):
+        run("nope")
+
+
 def _dense_block(x, params, H):
     def ln(h, scale, bias):
         mu = h.mean(-1, keepdims=True)

@@ -103,7 +103,8 @@ def tp_mlp(x, w1_local, w2_local, axis_name: str, act=jnp.tanh,
 
 def tp_attention(x, wq_local, wk_local, wv_local, wo_local,
                  axis_name: str, *, num_heads: int, causal: bool = True,
-                 backend: Optional[str] = None):
+                 backend: Optional[str] = None, impl: str = "dense",
+                 window: Optional[int] = None):
     """Megatron-style tensor-parallel multi-head self-attention: the heads
     shard over ``axis_name``.
 
@@ -117,6 +118,14 @@ def tp_attention(x, wq_local, wk_local, wv_local, wo_local,
     cross devices — and the output projection's partial products sum over
     the axis: exactly one allreduce forward (``g``) and one backward
     (``f``), the same cost profile as :func:`tp_mlp`.
+
+    ``impl``: ``"dense"`` materializes the [B, Hl, T, T] score matrix —
+    fine at short T, O(T^2) memory (ADVICE r3).  ``"flash"`` runs this
+    device's heads through the Pallas blocked flash kernel
+    (``ops/flash.py``) instead — O(T * block) memory, composes with the
+    long-context stack, and accepts ``window`` for sliding-window
+    attention; the TP collective structure is identical either way
+    (the kernel is per-device, head-local).
     """
     B, T, _ = x.shape
     n = lax.axis_size(axis_name)
@@ -130,31 +139,46 @@ def tp_attention(x, wq_local, wk_local, wv_local, wo_local,
                          f"head count {h_local}")
     d_head = width // h_local
 
+    if impl not in ("dense", "flash"):
+        raise ValueError(f"impl must be 'dense' or 'flash', got {impl!r}")
+    if impl == "dense" and window is not None:
+        raise ValueError("window= requires impl='flash'")
+
     xr = f_identity(x, axis_name)
     q = (xr @ wq_local).reshape(B, T, h_local, d_head)
     k = (xr @ wk_local).reshape(B, T, h_local, d_head)
     v = (xr @ wv_local).reshape(B, T, h_local, d_head)
-    scores = jnp.einsum("bthd,bshd->bhts", q, k) / jnp.sqrt(
-        jnp.float32(d_head)).astype(x.dtype)
-    if causal:
-        mask = jnp.tril(jnp.ones((T, T), bool))
-        scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
-    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(
-        x.dtype)
-    ctx = jnp.einsum("bhts,bshd->bthd", probs, v).reshape(B, T, width)
+    if impl == "flash":
+        from ..ops.flash import flash_attention_grad
+
+        ctx = flash_attention_grad(q, k, v, causal=causal,
+                                   window=window).reshape(B, T, width)
+    else:
+        scores = jnp.einsum("bthd,bshd->bhts", q, k) / jnp.sqrt(
+            jnp.float32(d_head)).astype(x.dtype)
+        if causal:
+            mask = jnp.tril(jnp.ones((T, T), bool))
+            scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
+        probs = jax.nn.softmax(scores.astype(jnp.float32),
+                               axis=-1).astype(x.dtype)
+        ctx = jnp.einsum("bhts,bshd->bthd", probs, v).reshape(B, T, width)
     return row_parallel_dense(ctx, wo_local, axis_name, backend=backend)
 
 
 def tp_transformer_block(x, p_local, axis_name: str, *, num_heads: int,
                          causal: bool = True,
-                         backend: Optional[str] = None):
+                         backend: Optional[str] = None,
+                         attn_impl: str = "dense",
+                         window: Optional[int] = None):
     """A full pre-LN transformer block with BOTH sublayers tensor-parallel:
     ``x + tp_attention(LN(x))`` then ``x + tp_mlp(LN(x))`` — two
     allreduces forward (one per sublayer), the canonical Megatron block.
 
     ``p_local``: dict with ``ln1/ln2`` (scale, bias — replicated),
     ``wq/wk/wv/wo`` (attention blocks as in :func:`tp_attention`), and
-    ``w1/w2`` (MLP blocks as in :func:`tp_mlp`).
+    ``w1/w2`` (MLP blocks as in :func:`tp_mlp`).  ``attn_impl="flash"``
+    routes the attention sublayer through the Pallas flash kernel for
+    long-context TP training (O(T*block) memory; ``window`` supported).
     """
     def ln(h, scale, bias):
         mu = h.mean(-1, keepdims=True)
@@ -163,7 +187,8 @@ def tp_transformer_block(x, p_local, axis_name: str, *, num_heads: int,
 
     a = tp_attention(ln(x, *p_local["ln1"]), p_local["wq"], p_local["wk"],
                      p_local["wv"], p_local["wo"], axis_name,
-                     num_heads=num_heads, causal=causal, backend=backend)
+                     num_heads=num_heads, causal=causal, backend=backend,
+                     impl=attn_impl, window=window)
     x = x + a
     m = tp_mlp(ln(x, *p_local["ln2"]), p_local["w1"], p_local["w2"],
                axis_name, act=partial(jax.nn.gelu, approximate=False),
