@@ -16,8 +16,11 @@ record; the supervisor re-emits the HIGHEST-PRIORITY completed record
 the driver records — with every stage's value under ``extra.stages``:
   {"metric": ..., "value": N, "unit": "img/s/chip", "vs_baseline": N}
 
-``vs_baseline`` is measured/1.0 because the upstream repo published no
-benchmark tables (BASELINE.json "published": {}); see BASELINE.md.
+``vs_baseline``: the upstream repo published no benchmark tables
+(BASELINE.json "published": {}; see BASELINE.md), so training metrics
+report the PERF TRAJECTORY — measured value over the previous round's
+banked on-silicon value (PREV_ROUND_BANKED; > 1.0 = faster than round
+3) — and kernel/probe stages report the fraction of chip peak.
 
 Platform notes (important for honest numbers):
 - data is device-resident (host->device on this relay platform is ~470 MB/s
@@ -96,6 +99,28 @@ BANKED_WANT = {
     "fused_xent_tflops": {},
     "matmul_bf16_tflops": {},
 }
+
+
+
+# Trajectory denominators (VERDICT r3 weak #8): the upstream repo
+# published no benchmark numbers (BASELINE.md), so a fixed external
+# baseline does not exist — instead the training metrics report
+# vs_baseline against the PREVIOUS round's banked on-silicon values
+# (docs/artifacts/bench_0730_105745.json, the record BENCH_r03 carried),
+# so the driver sees the perf trajectory: > 1.0 = faster than round 3.
+# Kernel stages keep their vs-peak ratios.  Metrics new this round have
+# no denominator yet and report 1.0.
+PREV_ROUND_BANKED = {
+    "resnet50_dp_train_throughput": 2521.9,   # img/s/chip, r3
+    "transformer_lm_train_throughput": 187490.3,  # tokens/s/chip, r3
+}
+
+
+def vs_prev(metric, value, platform):
+    prev = PREV_ROUND_BANKED.get(metric)
+    if platform == "tpu" and prev:
+        return round(value / prev, 4)
+    return 1.0
 
 
 def pick_best(recs):
@@ -552,7 +577,8 @@ def main():
                 "metric": "transformer_lm_train_throughput",
                 "value": round(tok_s_chip, 1),
                 "unit": "tokens/s/chip",
-                "vs_baseline": 1.0,
+                "vs_baseline": vs_prev("transformer_lm_train_throughput",
+                                       tok_s_chip, platform0),
                 "extra": {"devices": n_dev, "batch": Bt, "seq": T,
                           "step_ms": round(dt_step * 1000, 2),
                           "round_ms": [round(t * 1e3, 2)
@@ -848,7 +874,8 @@ def main():
                 "metric": "transformer_lm_large_train_throughput",
                 "value": round(tok_s2, 1),
                 "unit": "tokens/s/chip",
-                "vs_baseline": 1.0,
+                "vs_baseline": vs_prev("transformer_lm_large_train_throughput",
+                                       tok_s2, platform0),
                 "extra": {"devices": n_dev, "batch": B2, "seq": T2,
                           "embed": E2, "depth": L2, "vocab": V2,
                           "heads": H2, "kv_heads": HKV2, "window": W2,
@@ -964,7 +991,8 @@ def main():
         "metric": "resnet50_dp_train_throughput",
         "value": round(img_s_chip, 1),
         "unit": "img/s/chip",
-        "vs_baseline": 1.0,
+        "vs_baseline": vs_prev("resnet50_dp_train_throughput",
+                               img_s_chip, platform),
         "extra": {"devices": n_dev, "global_batch": batch,
                   "step_ms": round(dt * 1000, 2),
                   "round_ms": [round(t * 1e3, 2)
