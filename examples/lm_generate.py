@@ -93,9 +93,22 @@ def main():
         model, params, prompts, steps=args.gen_steps, temperature=0.7,
         top_k=4, top_p=0.95, rng=jax.random.PRNGKey(7))))
     acc_b = rule_acc(np.asarray(beam_search(
-        model, params, prompts, steps=args.gen_steps, beams=4)))
+        model, params, prompts, steps=args.gen_steps, beams=4,
+        length_penalty=0.6)))
     print(f"top-k/top-p sampled accuracy {acc_s:.3f}, "
           f"beam-4 accuracy {acc_b:.3f}")
+
+    # EOS stopping: pick the rule successor of the first prompt's last
+    # token as a stop token — that row must emit it immediately and
+    # eos-pad the rest, while rows whose rule path never hits it keep
+    # decoding.
+    eos = int((3 * prompts[0, -1] + 1) % V)
+    out_e = np.asarray(generate(model, params, prompts,
+                                steps=args.gen_steps, eos_id=eos))
+    stopped = out_e[0, prompts.shape[1]:]
+    print(f"eos={eos} stopping: row 0 -> {stopped.tolist()}")
+    assert (stopped == eos).all(), "row hitting eos must flatline"
+
     mpi.stop()
     assert acc > 0.8, "greedy continuations do not follow the rule"
     assert acc_b > 0.8, "beam continuations do not follow the rule"
