@@ -133,6 +133,14 @@ def main():
     from torchmpi_tpu.utils.metrics import fence
 
     mesh = mpi.init(mpi.Config(dcn_size=args.dcn, custom_min_bytes=0))
+    # Declare an unbounded, non-abandonable compile budget for the
+    # whole sweep: this client is run by supervisors that honor the
+    # compile-gate heartbeat (tpu_watch.run_bounded), so no compile
+    # it starts can be abandoned mid-queue, and its candidate jits
+    # (ResNet-20 steps, flash-grad tilings) exceed the gate's
+    # large-graph threshold on the relay.
+    budget_cm = mpi.compile_budget()
+    budget_cm.__enter__()
     n = mpi.device_count()
     is_cpu = list(mesh.devices.flat)[0].platform == "cpu"
     if is_cpu:

@@ -48,6 +48,8 @@ def main():
     from torchmpi_tpu.utils.metrics import fence
 
     mesh = mpi.init()
+    budget_cm = mpi.compile_budget()  # watcher-supervised client
+    budget_cm.__enter__()
     n_dev = mpi.device_count()
     model = ResNet50(dtype=jnp.bfloat16)
     variables = model.init(jax.random.PRNGKey(0),

@@ -125,6 +125,16 @@ def study_transformer():
 
 
 if __name__ == "__main__":
+    # Operator-run device client: declare an unbounded, non-abandonable
+    # compile budget up front (its study steps exceed the compile gate's
+    # large-graph threshold on the relay).  The round-3 rule this
+    # encodes: run hw_tune WITHOUT an external timeout that could
+    # SIGKILL mid-compile — the gate defers SIGTERM and heartbeats so
+    # cooperating supervisors extend their grace.
+    import torchmpi_tpu as mpi
+
+    _budget = mpi.compile_budget()
+    _budget.__enter__()
     ap = argparse.ArgumentParser()
     ap.add_argument("--study", choices=["matmul", "resnet", "lm", "all"],
                     default="all")
