@@ -614,12 +614,9 @@ def main():
             # data dependence (q <- output) stops CSE from collapsing
             # the chain — then divide by the chain depth.
             CHF = 4
-
-            @jax.jit
-            def fl_chain(q, k, v):
-                for _ in range(CHF):
-                    q = flash_attention(q, k, v, causal=True)
-                return q
+            fl_chain = _metrics.chained(
+                lambda q, k, v: flash_attention(q, k, v, causal=True),
+                depth=CHF)
 
             fl = jax.jit(lambda q, k, v: flash_attention(q, k, v,
                                                          causal=True))
@@ -631,13 +628,10 @@ def main():
             dense_ms = None
             oracle_err = None
             try:
-                @jax.jit
-                def dn_chain(q, k, v):
-                    for _ in range(CHF):
-                        q = reference_attention(q, k, v, causal=True
-                                                ).astype(q.dtype)
-                    return q
-
+                dn_chain = _metrics.chained(
+                    lambda q, k, v: reference_attention(q, k, v,
+                                                        causal=True),
+                    depth=CHF)
                 dn = jax.jit(lambda q, k, v: reference_attention(
                     q, k, v, causal=True))
                 dense_ms = round(timed(lambda: dn_chain(*qkv), iters_d,

@@ -179,8 +179,11 @@ def main():
             print(json.dumps({"phase": "backend", "per_rank_bytes": nbytes,
                               "backend": backend, **_ms(cands[backend])}))
         # Noise-gated per size: pallas must beat xla beyond the pair's
-        # jitter to set the cutover here.
-        winner, ev = _gate(cands, "xla")
+        # jitter to set the cutover here.  Gated on the {xla, pallas}
+        # PAIR: a hierarchical win at this size must not mask a
+        # beyond-noise pallas-over-xla cutover (code review r4).
+        pair = {k: v for k, v in cands.items() if k in ("xla", "pallas")}
+        winner, ev = _gate(pair, "xla")
         if winner == "pallas" and cutover is None:
             cutover = nbytes
             evidence["custom_min_bytes"] = {"at_bytes": nbytes, **ev}

@@ -35,15 +35,11 @@ def bench(f, *a, iters=10):
 
 
 def chained(attn_fn):
-    """One jit program running CHAIN dependent invocations (q <- out):
+    """Dependent-chain jit (q <- out) via the shared harness helper:
     the dispatch floor is paid once and CSE cannot collapse the links."""
-    @jax.jit
-    def run(q, k, v):
-        for _ in range(CHAIN):
-            q = attn_fn(q, k, v).astype(q.dtype)
-        return q
+    from torchmpi_tpu.utils.metrics import chained as _chained
 
-    return run
+    return _chained(attn_fn, depth=CHAIN)
 
 
 def main():

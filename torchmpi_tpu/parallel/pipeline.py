@@ -77,7 +77,7 @@ def gpipe_apply(stage_fn: Callable, stage_params, microbatches,
     perm = [(i, i + 1) for i in range(S - 1)]  # linear, no wraparound
     zero_act = jnp.zeros(act_shape, microbatches.dtype)
 
-    def tick(recv, t):
+    def compute(recv, t):
         inject = jnp.where(
             t < M,
             lax.dynamic_index_in_dim(microbatches,
@@ -90,11 +90,21 @@ def gpipe_apply(stage_fn: Callable, stage_params, microbatches,
         # (t - S + 1)'s final output.
         out_t = jnp.where((my == S - 1) & (t >= S - 1), h,
                           jnp.zeros_like(h))
+        return h, out_t
+
+    def tick(recv, t):
+        h, out_t = compute(recv, t)
         return lax.ppermute(h, axis_name, perm), out_t
 
-    _, ticks_out = lax.scan(tick, zero_act,
-                            jnp.arange(M + S - 1), unroll=unroll)
-    result = ticks_out[S - 1:]  # [M, mb, ...]
+    # Final tick peeled out of the scan: its ppermute feeds nothing, and
+    # inside the scan body it could not be elided (each iteration's
+    # ppermute feeds the carry) — one dead collective per forward and
+    # its transpose per backward (code review r4).
+    T = M + S - 1
+    recv_last, ticks_out = lax.scan(tick, zero_act, jnp.arange(T - 1),
+                                    unroll=unroll)
+    _, out_last = compute(recv_last, jnp.asarray(T - 1))
+    result = jnp.concatenate([ticks_out[S - 1:], out_last[None]])
     if broadcast_out:
         result = collectives.broadcast_in_axis(result, axis_name,
                                                root=S - 1)
@@ -171,8 +181,7 @@ def interleaved_apply(stage_fn: Callable, stage_params, microbatches,
     zero_act = jnp.zeros(act_shape, microbatches.dtype)
     outs0 = jnp.zeros((M,) + act_shape, microbatches.dtype)
 
-    def tick(carry, t):
-        recv, outs = carry
+    def compute(recv, outs, t):
         # This device's virtual chunk for the tick (traced via my).  For
         # the not-yet-filled head (u < 0) the floor-mod already lands in
         # [0, VS) — those ticks compute garbage that is overwritten before
@@ -201,10 +210,17 @@ def interleaved_apply(stage_fn: Callable, stage_params, microbatches,
         new = jnp.where(valid_out,
                         jnp.where(my == S - 1, h, jnp.zeros_like(h)),
                         cur)
-        outs = lax.dynamic_update_index_in_dim(outs, new, m_out_c, 0)
+        return h, lax.dynamic_update_index_in_dim(outs, new, m_out_c, 0)
+
+    def tick(carry, t):
+        recv, outs = carry
+        h, outs = compute(recv, outs, t)
         return (lax.ppermute(h, axis_name, perm), outs), None
 
-    (_, result), _ = lax.scan(tick, (zero_act, outs0), jnp.arange(T))
+    # Final tick peeled: its ppermute is dead (see gpipe_apply).
+    (recv_last, outs_last), _ = lax.scan(tick, (zero_act, outs0),
+                                         jnp.arange(T - 1))
+    _, result = compute(recv_last, outs_last, jnp.asarray(T - 1))
     if broadcast_out:
         result = collectives.broadcast_in_axis(result, axis_name,
                                                root=S - 1)

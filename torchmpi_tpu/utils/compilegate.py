@@ -63,12 +63,15 @@ from . import compilecache
 # and collective/kernel microbenches compile in seconds even cold.
 # Calibration (this repo, jax 0.9.0, measured via jax.export serialized
 # module bytes — tests/test_flagship_lowering.py pins the boundary):
-# 1024^2 matmul probe ~3 KB, toy stage-B LM step ~101 KB, flagship
-# stage-B' LM step ~207 KB, ResNet-50 b128 train step ~272 KB (the
-# known >900 s cold-compile class on the relay).  Model train steps
-# lower COMPACTLY — minutes-long relay compiles arrive as mere
-# hundreds of KB — so the threshold sits just below the smallest
-# minutes-class graph, not at "big file" intuition.
+# 1024^2 matmul probe ~3 KB, toy stage-B LM step ~101 KB (a ~minute
+# relay compile), flagship stage-B' LM step ~207 KB, ResNet-50 b128
+# train step ~272 KB (the known >900 s class).  Model train steps lower
+# COMPACTLY — long relay compiles arrive as mere hundreds of KB — so
+# the threshold sits below the ENTIRE train-step band, minute-class
+# included: an abandoned in-flight compile wedges the serial queue
+# whatever its duration (round-1 postmortem was a kill mid-claim), so
+# a minute-class client needs the same declared budget as a 900 s one;
+# only the seconds-class probe/kernel tier passes ungated.
 DEFAULT_MIN_BYTES = 64 * 1024
 
 # Budget (seconds) a cold large compile is assumed to need on the relay,

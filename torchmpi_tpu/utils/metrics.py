@@ -54,6 +54,29 @@ def timed(step, iters: int, fence=fence, rounds: int = 3) -> float:
     return min(last_round_times)
 
 
+def chained(fn, depth: int = 4):
+    """One jit program running ``depth`` dependent invocations of
+    ``fn(x, *rest) -> y`` with ``y`` fed back as ``x`` — divide the
+    measured time by ``depth`` for the per-invocation figure.
+
+    The relay platform imposes a ~7 ms PER-DISPATCH floor (real TPU
+    dispatch is ~10 us), larger than many kernels: single-call timings
+    put the floor in both sides of every ratio.  Inside one program the
+    floor is paid once, and the data dependence stops CSE from
+    collapsing the identical calls (ops whose output cannot feed their
+    input must rotate an operand instead — see bench.py stage C2).
+    Shared by bench.py stage C and scripts/flash_sweep.py."""
+    import jax
+
+    @jax.jit
+    def run(x, *rest):
+        for _ in range(depth):
+            x = fn(x, *rest).astype(x.dtype)
+        return x
+
+    return run
+
+
 class Timer:
     """Wall-clock step timer with warmup and fenced boundaries."""
 
