@@ -42,3 +42,72 @@ def test_launch_two_processes(tmp_path):
     assert out.returncode == 0, out.stdout + out.stderr
     assert "LAUNCHED rank=0 ok" in out.stdout
     assert "LAUNCHED rank=1 ok" in out.stdout
+
+
+_MESH_SHAPE_SCRIPT = textwrap.dedent("""
+    import sys
+    sys.path.insert(0, {repo!r})
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    import torchmpi_tpu as mpi
+    from torchmpi_tpu.parallel import pipeline as pp
+
+    # First-class N-D mesh across REAL processes: pp spans the process
+    # boundary (2 procs x 2 devices -> pp=2 outer, tp=2 inner).
+    mesh = mpi.init(mpi.Config(mesh_shape={{"pp": 2, "tp": -1}}))
+    assert mesh.axis_names == ("pp", "tp"), mesh.axis_names
+    assert mesh.devices.shape == (2, 2), mesh.devices.shape
+
+    # A 2-stage gpipe forward over the cross-process pp axis: the stage
+    # handoff ppermute rides the gloo process boundary.
+    S, M, mb, d = 2, 2, 2, 4
+    rng = np.random.RandomState(0)
+    W = rng.randn(S, d, d).astype(np.float32) * 0.3
+    b = rng.randn(S, d).astype(np.float32) * 0.1
+    xs = rng.randn(M, mb, d).astype(np.float32)
+
+    def stage_fn(params, x):
+        Wl, bl = params
+        return jnp.tanh(x @ Wl + bl)
+
+    def body(Wl, bl, xs):
+        return pp.gpipe_apply(stage_fn, (Wl[0, 0], bl[0, 0]), xs, "pp")
+
+    wspec = P("pp", "tp")
+    out = jax.jit(shard_map(
+        body, mesh=mesh, in_specs=(wspec, wspec, P()), out_specs=P(),
+        check_vma=False))(
+        jax.device_put(np.repeat(W[:, None], 2, 1),
+                       NamedSharding(mesh, wspec)),
+        jax.device_put(np.repeat(b[:, None], 2, 1),
+                       NamedSharding(mesh, wspec)), xs)
+    expect = xs
+    for s in range(S):
+        expect = np.tanh(expect @ W[s] + b[s])
+    np.testing.assert_allclose(
+        np.asarray(out), expect, rtol=2e-5, atol=2e-5)
+    print(f"MESHSHAPE rank={{mpi.rank()}} ok", flush=True)
+    mpi.stop()
+""")
+
+
+@pytest.mark.slow
+def test_launch_mesh_shape_pipeline_across_processes(tmp_path):
+    """Config(mesh_shape=...) under the 2-process launcher: the pp axis
+    crosses the real process boundary and a gpipe forward matches the
+    sequential oracle (VERDICT r3 #6 composed with the DCN rig)."""
+    script = tmp_path / "worker_mesh.py"
+    script.write_text(_MESH_SHAPE_SCRIPT.format(repo=_REPO))
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    out = subprocess.run(
+        [sys.executable, "-m", "torchmpi_tpu.launch", "--nproc", "2",
+         "--devices-per-proc", "2", str(script)],
+        capture_output=True, text=True, timeout=300, env=env,
+        cwd=_REPO)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "MESHSHAPE rank=0 ok" in out.stdout
+    assert "MESHSHAPE rank=1 ok" in out.stdout
