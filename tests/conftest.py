@@ -24,6 +24,14 @@ force_cpu_devices(8)
 import jax  # noqa: E402
 import pytest  # noqa: E402
 
+# Second belt for the interpreted-Pallas overlap abort (see
+# _drain_dispatched_effects below): synchronous CPU dispatch removes
+# the entire class — no execution returns before its callback threads
+# retire, so two interpreted calls can never overlap on the
+# interpreter's process-global barrier, within a test or across tests.
+# Tests block on results anyway, so the throughput cost is noise.
+jax.config.update("jax_cpu_enable_async_dispatch", False)
+
 import torchmpi_tpu as mpi  # noqa: E402
 
 
