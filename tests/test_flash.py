@@ -207,7 +207,15 @@ def test_flash_grad_matches_reference(flat_runtime, causal):
 @pytest.mark.parametrize("causal", [False, True])
 def test_ring_flash_grad_matches_dense_ring(flat_runtime, causal):
     """The ring-level custom VJP (backward ring: k/v/dk/dv rotate a full
-    cycle) == autodiff through the dense-block ring."""
+    cycle) == autodiff through the dense-block ring.
+
+    Runs on a 4-device sub-ring: the backward ring is BY FAR the
+    suite's heaviest interpreted-Pallas workload (flash kernels per ring
+    step, each crossing the interpreter's N-party barriers), and at 8
+    parties it is where the flaky full-suite abort struck in two
+    containers (docs/ROUND4_NOTES.md).  The rotating-accumulator VJP
+    math is ring-size-independent; 8-device ring FORWARD coverage
+    remains elsewhere in the suite."""
     import jax
     from jax import shard_map
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -215,32 +223,36 @@ def test_ring_flash_grad_matches_dense_ring(flat_runtime, causal):
     import torchmpi_tpu as mpi
     from torchmpi_tpu.parallel import sequence as seq
 
-    mesh = mpi.world_mesh()
+    world = mpi.world_mesh()
     B, T, H, D = 1, 32, 2, 8
     rng = np.random.RandomState(31)
     q, k, v, w = (rng.randn(B, T, H, D).astype(np.float32) * 0.5
                   for _ in range(4))
-    spec = P(None, ("dcn", "ici"))
-    sh = NamedSharding(mesh, spec)
 
-    def make_loss(block_impl):
-        def body(q, k, v, w):
-            o = seq.ring_attention(q, k, v, "ici", causal=causal,
-                                   block_impl=block_impl, block_q=4,
-                                   block_k=4)
-            from jax import lax
-            return lax.psum((o * w).sum(), ("dcn", "ici"))
+    with mpi.communicator("ring4",
+                          devices=list(world.devices.flat[:4]),
+                          shape={"ici": 4}) as mesh:
+        spec = P(None, "ici")
+        sh = NamedSharding(mesh, spec)
 
-        def loss(q, k, v, w):
-            return jax.jit(shard_map(
-                body, mesh=mesh, in_specs=(spec,) * 4, out_specs=P(),
-                check_vma=False))(q, k, v, w)
+        def make_loss(block_impl):
+            def body(q, k, v, w):
+                o = seq.ring_attention(q, k, v, "ici", causal=causal,
+                                       block_impl=block_impl, block_q=4,
+                                       block_k=4)
+                from jax import lax
+                return lax.psum((o * w).sum(), "ici")
 
-        return loss
+            def loss(q, k, v, w):
+                return jax.jit(shard_map(
+                    body, mesh=mesh, in_specs=(spec,) * 4,
+                    out_specs=P(), check_vma=False))(q, k, v, w)
 
-    args = [jax.device_put(x, sh) for x in (q, k, v, w)]
-    g_flash = jax.grad(make_loss("flash"), argnums=(0, 1, 2))(*args)
-    g_dense = jax.grad(make_loss("dense"), argnums=(0, 1, 2))(*args)
+            return loss
+
+        args = [jax.device_put(x, sh) for x in (q, k, v, w)]
+        g_flash = jax.grad(make_loss("flash"), argnums=(0, 1, 2))(*args)
+        g_dense = jax.grad(make_loss("dense"), argnums=(0, 1, 2))(*args)
     for a, b in zip(g_flash, g_dense):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=3e-5,
                                    atol=3e-5)

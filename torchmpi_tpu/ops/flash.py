@@ -49,6 +49,26 @@ _LANES = 128
 _STAT_LANES = 8
 
 
+
+
+def _local_kernel_params(interpret):
+    """Interpret-mode-only compiler params for these DEVICE-LOCAL kernels.
+
+    The pallas TPU interpreter runs an N-party global barrier before
+    every kernel that lacks a ``collective_id`` ("the kernel doesn't
+    specify its own barrier semaphore").  These kernels touch no remote
+    memory — in the ring/ulysses stacks the rotation happens OUTSIDE the
+    kernel via ppermute — so that pre-kernel barrier is pure interpreter
+    overhead, and on a starved host it is where the flaky full-suite
+    abort parks its threads (docs/ROUND4_NOTES.md).  Declaring a
+    collective_id under interpret skips it; real TPU lowering is
+    untouched (collective_id there allocates a cross-chip barrier
+    semaphore local kernels must not claim).
+    """
+    if interpret:
+        return pltpu.CompilerParams(collective_id=1)
+    return None
+
 def _resolve_blocks(block_a, block_b, field_a: str, field_b: str):
     """Config-default tiling resolution — see runtime.resolve_blocks
     (deferred import: ops must stay importable before the runtime)."""
@@ -500,6 +520,7 @@ def flash_attention(q, k, v, *, causal: bool = False,
             pltpu.VMEM((block_q, D), jnp.float32),       # output accum
         ],
         interpret=interpret,
+        compiler_params=_local_kernel_params(interpret),
     )(qo, ko, qt, kt, vt)
     out = result if single else result[0]
     if pad_q:
@@ -599,6 +620,7 @@ def flash_attention_bwd(q, k, v, do, lse, dvec, *, causal: bool,
         out_specs=qb,
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
         interpret=interpret,
+        compiler_params=_local_kernel_params(interpret),
     )(qo, ko, qt, dot_, lse_l, d_l, kt, vt)
 
     # dkv grid puts the q-block dimension minor; index maps swap i and j
@@ -629,6 +651,7 @@ def flash_attention_bwd(q, k, v, do, lse, dvec, *, causal: bool,
         scratch_shapes=[pltpu.VMEM((block_k, D), jnp.float32),
                         pltpu.VMEM((block_k, D), jnp.float32)],
         interpret=interpret,
+        compiler_params=_local_kernel_params(interpret),
     )(qo, ko, kt, vt, qt, dot_, lse_l, d_l)
     if group > 1:
         dk = dk.reshape(B, Hkv, group, Tkvp, D).sum(axis=2)
