@@ -33,6 +33,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from . import ring
+
 # Finite stand-in for -inf in masked scores: keeps exp() exactly 0 without
 # producing (-inf) - (-inf) = nan in the running-max rescale.
 NEG_INF = -1e30
@@ -49,25 +51,6 @@ _LANES = 128
 _STAT_LANES = 8
 
 
-
-
-def _local_kernel_params(interpret):
-    """Interpret-mode-only compiler params for these DEVICE-LOCAL kernels.
-
-    The pallas TPU interpreter runs an N-party global barrier before
-    every kernel that lacks a ``collective_id`` ("the kernel doesn't
-    specify its own barrier semaphore").  These kernels touch no remote
-    memory — in the ring/ulysses stacks the rotation happens OUTSIDE the
-    kernel via ppermute — so that pre-kernel barrier is pure interpreter
-    overhead, and on a starved host it is where the flaky full-suite
-    abort parks its threads (docs/ROUND4_NOTES.md).  Declaring a
-    collective_id under interpret skips it; real TPU lowering is
-    untouched (collective_id there allocates a cross-chip barrier
-    semaphore local kernels must not claim).
-    """
-    if interpret:
-        return pltpu.CompilerParams(collective_id=1)
-    return None
 
 def _resolve_blocks(block_a, block_b, field_a: str, field_b: str):
     """Config-default tiling resolution — see runtime.resolve_blocks
@@ -469,8 +452,6 @@ def flash_attention(q, k, v, *, causal: bool = False,
     nk = kt.shape[2] // block_k
 
     if interpret is None:
-        from . import ring
-
         interpret = ring._interpret_mode()
 
     # Banded grid (window + STATIC offsets — the single-device model
@@ -520,7 +501,7 @@ def flash_attention(q, k, v, *, causal: bool = False,
             pltpu.VMEM((block_q, D), jnp.float32),       # output accum
         ],
         interpret=interpret,
-        compiler_params=_local_kernel_params(interpret),
+        compiler_params=ring.local_kernel_params(interpret),
     )(qo, ko, qt, kt, vt)
     out = result if single else result[0]
     if pad_q:
@@ -587,8 +568,6 @@ def flash_attention_bwd(q, k, v, do, lse, dvec, *, causal: bool,
     d_l = _stat_lanes(dvec, Tqp)
 
     if interpret is None:
-        from . import ring
-
         interpret = ring._interpret_mode()
 
     # Banded grids for static offsets + window — see flash_attention.
@@ -620,7 +599,7 @@ def flash_attention_bwd(q, k, v, do, lse, dvec, *, causal: bool,
         out_specs=qb,
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
         interpret=interpret,
-        compiler_params=_local_kernel_params(interpret),
+        compiler_params=ring.local_kernel_params(interpret),
     )(qo, ko, qt, dot_, lse_l, d_l, kt, vt)
 
     # dkv grid puts the q-block dimension minor; index maps swap i and j
@@ -651,7 +630,7 @@ def flash_attention_bwd(q, k, v, do, lse, dvec, *, causal: bool,
         scratch_shapes=[pltpu.VMEM((block_k, D), jnp.float32),
                         pltpu.VMEM((block_k, D), jnp.float32)],
         interpret=interpret,
-        compiler_params=_local_kernel_params(interpret),
+        compiler_params=ring.local_kernel_params(interpret),
     )(qo, ko, kt, vt, qt, dot_, lse_l, d_l)
     if group > 1:
         dk = dk.reshape(B, Hkv, group, Tkvp, D).sum(axis=2)
@@ -757,8 +736,6 @@ def flash_attention_grad(q, k, v, *, causal: bool = False,
     block_q, block_k = _resolve_blocks(block_q, block_k,
                                       "flash_block_q", "flash_block_k")
     if interpret is None:
-        from . import ring
-
         interpret = ring._interpret_mode()
     if (window is not None and isinstance(q_offset, int)
             and isinstance(kv_offset, int)

@@ -91,6 +91,28 @@ def set_interpret(params) -> None:
     _INTERPRET = params
 
 
+def local_kernel_params(interpret):
+    """Interpret-mode-only compiler params for DEVICE-LOCAL pallas kernels.
+
+    The pallas TPU interpreter runs an N-party global barrier before
+    every kernel that lacks a ``collective_id`` ("the kernel doesn't
+    specify its own barrier semaphore").  Device-local kernels (flash,
+    fused-xent — in the ring/ulysses stacks the rotation happens OUTSIDE
+    the kernel via ppermute) touch no remote memory, so that pre-kernel
+    barrier is pure interpreter overhead, and on a starved host it is
+    where the flaky full-suite abort parks its threads
+    (docs/ROUND4_NOTES.md).  Declaring a collective_id under interpret
+    skips it; real TPU lowering is untouched (collective_id there
+    allocates a cross-chip barrier semaphore local kernels must not
+    claim).  Lives here next to :func:`_interpret_mode`, the shared
+    interpret-mode decision point, so the skip logic exists exactly
+    once.
+    """
+    if interpret:
+        return pltpu.CompilerParams(collective_id=1)
+    return None
+
+
 def _interpret_mode():
     """Explicit setting wins; in auto mode, enable the interpreter when the
     devices actually executing (the runtime mesh when initialized, else the

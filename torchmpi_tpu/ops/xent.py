@@ -23,31 +23,14 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from . import ring
+
 from .flash import NEG_INF, _float0_zero
 
 _LANES = 128
 _STAT_LANES = 8
 
 
-
-
-def _local_kernel_params(interpret):
-    """Interpret-mode-only compiler params for these DEVICE-LOCAL kernels.
-
-    The pallas TPU interpreter runs an N-party global barrier before
-    every kernel that lacks a ``collective_id`` ("the kernel doesn't
-    specify its own barrier semaphore").  These kernels touch no remote
-    memory — in the ring/ulysses stacks the rotation happens OUTSIDE the
-    kernel via ppermute — so that pre-kernel barrier is pure interpreter
-    overhead, and on a starved host it is where the flaky full-suite
-    abort parks its threads (docs/ROUND4_NOTES.md).  Declaring a
-    collective_id under interpret skips it; real TPU lowering is
-    untouched (collective_id there allocates a cross-chip barrier
-    semaphore local kernels must not claim).
-    """
-    if interpret:
-        return pltpu.CompilerParams(collective_id=1)
-    return None
 
 def _xent_fwd_kernel(labels_ref, x_ref, w_ref, loss_ref, lse_ref, m_scr,
                      l_scr, t_scr, *, block_n: int, block_v: int,
@@ -168,8 +151,6 @@ def _stats(x, n_pad):
 
 
 def _interp():
-    from . import ring
-
     return ring._interpret_mode()
 
 
@@ -200,7 +181,7 @@ def _fused_xent_fwd(x, w, labels, block_n: int, block_v: int, interpret):
                                 lambda i, j: (i, 0)),) * 2,
         scratch_shapes=[pltpu.VMEM((block_n, _LANES), jnp.float32)] * 3,
         interpret=interpret,
-        compiler_params=_local_kernel_params(interpret),
+        compiler_params=ring.local_kernel_params(interpret),
     )(labp, xp, wp)
     return loss[:N, 0], lse[:N, 0]
 
@@ -276,7 +257,7 @@ def _xent_vjp(embed: int, block_n: int, block_v: int, interp_key):
             out_specs=pl.BlockSpec((bn, E), lambda i, j: (i, 0)),
             scratch_shapes=[pltpu.VMEM((bn, E), jnp.float32)],
             interpret=interp_key,
-            compiler_params=_local_kernel_params(interp_key),
+            compiler_params=ring.local_kernel_params(interp_key),
         )(labp, xp, wp, lse_l, dl_l)
 
         dw_kern = functools.partial(_xent_bwd_dw_kernel, block_n=bn,
@@ -295,7 +276,7 @@ def _xent_vjp(embed: int, block_n: int, block_v: int, interp_key):
             out_specs=pl.BlockSpec((E, bv), lambda j, i: (0, j)),
             scratch_shapes=[pltpu.VMEM((E, bv), jnp.float32)],
             interpret=interp_key,
-            compiler_params=_local_kernel_params(interp_key),
+            compiler_params=ring.local_kernel_params(interp_key),
         )(labp, xp, wp, lse_l, dl_l)
         if pad_v:
             dw = dw[:, :V]
