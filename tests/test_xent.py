@@ -144,3 +144,40 @@ def test_xent_trains_lm_head(flat_runtime):
         if first is None:
             first = float(loss)
     assert float(loss) < 0.5 * first, (first, float(loss))
+
+
+def test_vmem_fit_keeps_tuned_blocks_at_flagship_dims():
+    """The stage-B' LM head (E=2048, V=32k, bf16) must fit Mosaic's scoped
+    VMEM with the tuned default blocks: the first real-silicon stage-B'
+    run died at 17 MiB vs the 16 MiB default scope, which _kernel_params
+    now raises to an honest 100 MiB (v5e has 128 MiB physical)."""
+    from torchmpi_tpu.ops import xent
+
+    bn, bv = xent._fit_blocks(128, 512, 2048, 2)
+    assert (bn, bv) == (128, 512)  # tuned defaults survive
+    assert xent._bwd_vmem_bytes(bn, bv, 2048, 2) <= xent._VMEM_LIMIT
+    params = xent._kernel_params(False)
+    assert params.vmem_limit_bytes == xent._VMEM_LIMIT
+
+
+def test_vmem_fit_shrinks_blocks_for_huge_embed():
+    """At very large E the [E, block_v] f32 accumulators dominate; the
+    vocab block shrinks (lane-tile floor 128) until the estimate fits."""
+    from torchmpi_tpu.ops import xent
+
+    bn, bv = xent._fit_blocks(128, 512, 16384, 2)
+    assert bv < 512
+    assert bv >= 128 and bn >= 128
+    assert xent._bwd_vmem_bytes(bn, bv, 16384, 2) <= xent._VMEM_BUDGET
+
+
+def test_xent_matches_dense_with_clamped_blocks(flat_runtime):
+    """Correctness is block-size independent: force the huge-E clamp path
+    shape-wise small but with explicit tiny blocks."""
+    x = _rand((48, 64), 11)
+    w = _rand((64, 96), 12)
+    labels = jnp.asarray(
+        np.random.RandomState(13).randint(0, 96, size=(48,)), jnp.int32)
+    got = fused_linear_cross_entropy(x, w, labels, block_n=16, block_v=32)
+    np.testing.assert_allclose(got, _dense(x, w, labels), rtol=2e-5,
+                               atol=2e-5)

@@ -154,6 +154,48 @@ def _interp():
     return ring._interpret_mode()
 
 
+# Mosaic's default scoped-VMEM budget is 16 MiB — tuned for small kernels,
+# not for an LM-head block carrying two [E, block_v] f32 accumulators plus
+# double-buffered bf16 operand blocks (at E=2048, block_v=512 the dW pass
+# needs ~17 MiB and the first real-silicon stage-B' run died on exactly
+# that).  v5e/v5p have 128 MiB of physical VMEM; declare an honest larger
+# scope and, for truly huge shapes, shrink the vocab block until the
+# estimate fits.
+_VMEM_LIMIT = 100 * 1024 * 1024
+_VMEM_BUDGET = 88 * 1024 * 1024
+
+
+def _bwd_vmem_bytes(bn: int, bv: int, embed: int, ds: int) -> int:
+    """Upper-bound scoped-VMEM estimate for the heavier (dW) backward
+    kernel: double-buffered input blocks, double-buffered f32 output,
+    the f32 accumulator scratch, and ~4 [bn, bv] f32 temporaries
+    (z, p, g, col)."""
+    ins = 2 * (bn * embed + embed * bv) * ds
+    outs = 3 * embed * bv * 4        # out (x2 pipeline) + accumulator
+    temps = 4 * bn * bv * 4
+    return ins + outs + temps
+
+
+def _fit_blocks(bn: int, bv: int, embed: int, ds: int):
+    """Shrink (block_n, block_v) until the backward estimate fits the
+    scoped-VMEM budget.  Vocab blocks shrink first (the [E, bv] f32
+    accumulators dominate); 128 is the lane-tile floor for both."""
+    while _bwd_vmem_bytes(bn, bv, embed, ds) > _VMEM_BUDGET and bv > _LANES:
+        bv = max(_LANES, bv // 2)
+    while _bwd_vmem_bytes(bn, bv, embed, ds) > _VMEM_BUDGET and bn > _LANES:
+        bn = max(_LANES, bn // 2)
+    return bn, bv
+
+
+def _kernel_params(interpret):
+    """Compiler params for the device-local xent kernels: the interpret
+    barrier skip (ring.local_kernel_params) under interpret, the raised
+    scoped-VMEM limit on real TPU lowering."""
+    if interpret:
+        return ring.local_kernel_params(interpret)
+    return pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT)
+
+
 def _fused_xent_fwd(x, w, labels, block_n: int, block_v: int, interpret):
     N, E = x.shape
     V = w.shape[1]
@@ -181,7 +223,7 @@ def _fused_xent_fwd(x, w, labels, block_n: int, block_v: int, interpret):
                                 lambda i, j: (i, 0)),) * 2,
         scratch_shapes=[pltpu.VMEM((block_n, _LANES), jnp.float32)] * 3,
         interpret=interpret,
-        compiler_params=ring.local_kernel_params(interpret),
+        compiler_params=_kernel_params(interpret),
     )(labp, xp, wp)
     return loss[:N, 0], lse[:N, 0]
 
@@ -207,6 +249,8 @@ def fused_linear_cross_entropy(x, w, labels, *,
 
     block_n, block_v = runtime.resolve_blocks(
         block_n, block_v, "xent_block_n", "xent_block_v")
+    block_n, block_v = _fit_blocks(block_n, block_v, x.shape[1],
+                                   jnp.dtype(x.dtype).itemsize)
     f = _xent_vjp(x.shape[1], block_n, block_v, interpret)
     return f(x, w, labels)
 
@@ -257,7 +301,7 @@ def _xent_vjp(embed: int, block_n: int, block_v: int, interp_key):
             out_specs=pl.BlockSpec((bn, E), lambda i, j: (i, 0)),
             scratch_shapes=[pltpu.VMEM((bn, E), jnp.float32)],
             interpret=interp_key,
-            compiler_params=ring.local_kernel_params(interp_key),
+            compiler_params=_kernel_params(interp_key),
         )(labp, xp, wp, lse_l, dl_l)
 
         dw_kern = functools.partial(_xent_bwd_dw_kernel, block_n=bn,
@@ -276,7 +320,7 @@ def _xent_vjp(embed: int, block_n: int, block_v: int, interp_key):
             out_specs=pl.BlockSpec((E, bv), lambda j, i: (0, j)),
             scratch_shapes=[pltpu.VMEM((E, bv), jnp.float32)],
             interpret=interp_key,
-            compiler_params=ring.local_kernel_params(interp_key),
+            compiler_params=_kernel_params(interp_key),
         )(labp, xp, wp, lse_l, dl_l)
         if pad_v:
             dw = dw[:, :V]
