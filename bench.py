@@ -505,11 +505,15 @@ def main():
                                dtype=jnp.bfloat16, attn_impl=attn)
             tok = np.random.RandomState(2).randint(
                 0, 8192, size=(Bt, T)).astype(np.int32)
-            # flash init must trace on the device platform (pallas_call
-            # cannot lower on the CPU backend); the init graph is small.
-            lm_init_dev = None if attn == "flash" else init_dev
-            with jax.default_device(lm_init_dev):
-                lm_vars = lm.init(jax.random.PRNGKey(1), tok[:1])
+            # Init a "local"-attention TWIN on the host CPU: attention
+            # impls share one parameter tree (impl only changes the
+            # score computation), so this avoids tracing pallas kernels
+            # at init — and avoids an on-device init round-trip
+            # entirely (the 04:05 cycle-2 wedge struck exactly there).
+            lm_init = lm if attn == "local" else lm.clone(
+                attn_impl="local")
+            with jax.default_device(init_dev):
+                lm_vars = lm_init.init(jax.random.PRNGKey(1), tok[:1])
             tx_lm = optax.sgd(0.1)
 
             def lm_step(v, o, tok):
@@ -790,9 +794,12 @@ def main():
                                 dtype=jnp.bfloat16, attn_impl=attn2)
             tok2 = np.random.RandomState(3).randint(
                 0, V2, size=(B2, T2)).astype(np.int32)
-            lm2_init_dev = None if attn2 == "flash" else init_dev
-            with jax.default_device(lm2_init_dev):
-                lm2_vars = lm2.init(jax.random.PRNGKey(4), tok2[:1])
+            # Host-CPU init via the "local"-attention twin (same param
+            # tree; see stage B note — keeps init off the relay).
+            lm2_init = lm2 if attn2 == "local" else lm2.clone(
+                attn_impl="local")
+            with jax.default_device(init_dev):
+                lm2_vars = lm2_init.init(jax.random.PRNGKey(4), tok2[:1])
             tx2 = optax.sgd(0.02)
 
             def lm2_step(v, o, tok):
@@ -820,7 +827,7 @@ def main():
 
             lm2_jit = mpi.nn.data_parallel_step(lm2_step, mesh=mesh,
                                                 batch_argnums=(2,))
-            with jax.default_device(lm2_init_dev):
+            with jax.default_device(init_dev):
                 lm2_opt = tx2.init(lm2_vars)
             lm2_vars = mpi.nn.synchronize_parameters(lm2_vars, mesh=mesh)
             lm2_opt = mpi.nn.synchronize_parameters(lm2_opt, mesh=mesh)

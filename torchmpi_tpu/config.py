@@ -108,7 +108,12 @@ class Config:
     # xent defaults.
     flash_block_q: int = 512
     flash_block_k: int = 512
-    xent_block_n: int = 128
+    # 256-token xent tiles measured above the noise gate on a real v5e
+    # (2026-07-31 live autotune, docs/artifacts/autotune_20260731_*.json:
+    # 14.6 ms median vs 15.4 at 128, jitter ~0.6 ms); the VMEM block-fit
+    # clamp (ops/xent._fit_blocks) shrinks them automatically where E is
+    # too large for the scoped budget.
+    xent_block_n: int = 256
     xent_block_v: int = 512
 
     # --- gradient synchronization ------------------------------------------

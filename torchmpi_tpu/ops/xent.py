@@ -166,14 +166,17 @@ _VMEM_BUDGET = 88 * 1024 * 1024
 
 
 def _bwd_vmem_bytes(bn: int, bv: int, embed: int, ds: int) -> int:
-    """Upper-bound scoped-VMEM estimate for the heavier (dW) backward
-    kernel: double-buffered input blocks, double-buffered f32 output,
-    the f32 accumulator scratch, and ~4 [bn, bv] f32 temporaries
-    (z, p, g, col)."""
+    """Upper-bound scoped-VMEM estimate for the backward pass: the max
+    of the dx and dW kernels' footprints (each: double-buffered input
+    blocks, double-buffered f32 output + f32 accumulator scratch, and
+    ~4 [bn, bv] f32 temporaries for z/p/g/col).  dW's out/accumulator
+    scale with E*bv, dx's with bn*E — both must fit (code review r4:
+    modelling only dW passes configs whose dx kernel overflows)."""
     ins = 2 * (bn * embed + embed * bv) * ds
-    outs = 3 * embed * bv * 4        # out (x2 pipeline) + accumulator
     temps = 4 * bn * bv * 4
-    return ins + outs + temps
+    dw = ins + 3 * embed * bv * 4 + temps
+    dx = ins + 3 * bn * embed * 4 + temps
+    return max(dw, dx)
 
 
 def _fit_blocks(bn: int, bv: int, embed: int, ds: int):
