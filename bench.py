@@ -774,8 +774,9 @@ def main():
             W2 = 64 if tiny else 1024    # sliding window
             B2 = (2 if tiny else 4) * n_dev
             attn2 = "flash" if platform0 == "tpu" else "local"
+            K2 = 2 if tiny else 8   # scanned train steps per dispatch
             b2_key = (f"lm_large_step_{platform0}_E{E2}L{L2}T{T2}"
-                      f"b{B2 // n_dev}_n{n_dev}")
+                      f"b{B2 // n_dev}_n{n_dev}_k{K2}")
             deadline = float(os.environ.get(
                 "TORCHMPI_TPU_BENCH_DEADLINE", "0"))
             b2_cached = compilecache.was_compiled(b2_key)
@@ -825,7 +826,28 @@ def main():
                 u, o = tx2.update(g, o, v)
                 return optax.apply_updates(v, u), o, loss
 
-            lm2_jit = mpi.nn.data_parallel_step(lm2_step, mesh=mesh,
+            # Steady-state program: K dependent train steps under ONE
+            # lax.scan'd dispatch (XLA compiles the body once, so the
+            # compile cost matches the single-step program).  The relay's
+            # per-dispatch pathology is worse than its ~7 ms floor —
+            # cycle-2 live rounds after the first ran 3x slower
+            # (round_ms [23.5, 74, 76]) — and production training IS a
+            # scanned step loop, so the amortized figure is the honest
+            # per-step number (same methodology the kernel stages adopted
+            # via chained(), VERDICT r3 #4).  K2 is set above (part of
+            # the compile-marker key).
+
+            def lm2_multi(v, o, tok):
+                def body(carry, _):
+                    cv, co = carry
+                    cv, co, loss = lm2_step(cv, co, tok)
+                    return (cv, co), loss
+
+                (v, o), losses = jax.lax.scan(body, (v, o), None,
+                                              length=K2)
+                return v, o, losses[-1]
+
+            lm2_jit = mpi.nn.data_parallel_step(lm2_multi, mesh=mesh,
                                                 batch_argnums=(2,))
             with jax.default_device(init_dev):
                 lm2_opt = tx2.init(lm2_vars)
@@ -849,9 +871,10 @@ def main():
             # the pre-check above already decided the ladder can afford
             # it.
             with mpi.compile_budget():
-                steps_b2 = 2 if tiny else 10
-                dt2 = timed(lm2_once, steps_b2, fence)
+                calls_b2 = 1 if tiny else 3
+                dt2_call = timed(lm2_once, calls_b2, fence)
             compilecache.mark_compiled(b2_key)
+            dt2 = dt2_call / K2          # per-train-step seconds
             tok_s2 = B2 * T2 / dt2 / n_dev
             # Analytic FLOPs (same method as stage B): matmul params =
             # per-layer q/out (2*E*H*hd) + kv (2*E*Hkv*hd) + 4x MLP
@@ -864,10 +887,14 @@ def main():
                 else T2 / 2
             attn_fl2 = L2 * 4.0 * H2 * HD2 * avg_ctx
             fl2 = 3.0 * (B2 * T2) * (2.0 * p_mm2 + attn_fl2)
+            # The lowered program holds K2 scanned steps, so both the
+            # cost-model flops and the measured time cover K2 steps —
+            # consistent numerator/denominator for MFU.
             tfl2, mfu2, src2 = cost_model_mfu(
                 lambda: lm2_jit.jitted.lower(lm2_state["v"],
                                              lm2_state["o"], tok2_d),
-                dt2, peak, platform0, analytic_flops=fl2 / n_dev)
+                dt2_call, peak, platform0,
+                analytic_flops=K2 * fl2 / n_dev)
             log(f"stage B': {tok_s2:.0f} tokens/s/chip, "
                 f"loss {float(lm2_state['loss']):.3f}, "
                 f"{tfl2:.4g} TFLOP/s/chip, MFU {mfu2}")
@@ -883,7 +910,12 @@ def main():
                           "pos_emb": "rope", "attn_impl": attn2,
                           "fused_xent": platform0 == "tpu",
                           "step_ms": round(dt2 * 1000, 2),
-                          "round_ms": [round(t * 1e3, 2)
+                          "scan_steps_per_dispatch": K2,
+                          # per-TRAIN-STEP like step_ms (each timing
+                          # round dispatches K2 scanned steps), so the
+                          # min(round_ms) == step_ms audit holds across
+                          # artifacts.
+                          "round_ms": [round(t * 1e3 / K2, 2)
                                        for t in _metrics.last_round_times],
                           "dtype": "bfloat16", "platform": platform0,
                           "tflops_per_chip": round(tfl2, 4),

@@ -161,6 +161,14 @@ def bank():
             "re-wedged — not queueing more device work")
         return False
 
+    # The relay can wedge MID-cycle (2026-07-31 04:05: bench stages A-C2
+    # live, then spontaneous wedge in stage B'): re-probe between stages
+    # so a dead relay costs one 150 s probe instead of two 1200 s
+    # timeouts queued against it.
+    if not probe(150):
+        log("relay died mid-cycle after bench; skipping autotune/trace")
+        return True
+
     at_log = os.path.join(ART, f"autotune_{stamp}.log")
     rc, tail = run_bounded(
         [sys.executable, "benchmarks/autotune.py", "--quick"], 1200, at_log)
@@ -170,6 +178,10 @@ def bank():
         with open(os.path.join(ART, f"autotune_{stamp}.json"), "w") as f:
             f.write(rec_line + "\n")
     log(f"autotune rc={rc}, recommend={'yes' if rec_line else 'no'}")
+
+    if not probe(150):
+        log("relay died mid-cycle after autotune; skipping trace")
+        return True
 
     tr_dir = os.path.join(ART, f"overlap_trace_{stamp}")
     rc, _ = run_bounded(
