@@ -93,8 +93,13 @@ STAGE_PRIORITY = ["resnet50_dp_train_throughput",
 BANKED_WANT = {
     "resnet50_dp_train_throughput":
         {"devices": 1, "global_batch": 128, "image": 224},
-    "transformer_lm_large_train_throughput": {"devices": 1, "seq": 2048},
-    "transformer_lm_train_throughput": {"devices": 1, "batch": 8, "seq": 512},
+    "transformer_lm_large_train_throughput":
+        {"devices": 1, "seq": 2048, "scan_steps_per_dispatch": 8},
+    # scan_steps_per_dispatch pins the timing methodology: a
+    # pre-scan-era single-dispatch record (different per-step figure by
+    # ~3x of pure dispatch overhead) must not stand in for a scanned run.
+    "transformer_lm_train_throughput":
+        {"devices": 1, "batch": 8, "seq": 512, "scan_steps_per_dispatch": 8},
     "flash_attention_tflops": {},
     "fused_xent_tflops": {},
     "matmul_bf16_tflops": {},
@@ -114,6 +119,31 @@ PREV_ROUND_BANKED = {
     "resnet50_dp_train_throughput": 2521.9,   # img/s/chip, r3
     "transformer_lm_train_throughput": 187490.3,  # tokens/s/chip, r3
 }
+
+
+def scanned_train_step(step_fn, length):
+    """Wrap a ``(v, o, tok) -> (v, o, loss)`` train step into one
+    program running ``length`` dependent steps under ``lax.scan``,
+    returning the last step's loss — the step-level analog of
+    ``metrics.chained()`` (VERDICT r3 #4): the relay's per-dispatch
+    pathology (~7 ms floor, 3x-slow later rounds) is paid once per
+    dispatch and production training is a scanned loop anyway.  Shared
+    by stages B and B'.  MFU bookkeeping for the wrapped program: XLA's
+    ``cost_analysis`` counts a scan body ONCE (verified empirically —
+    a length-8 scan of a matmul reports ~1x the body flops), so pair
+    PER-STEP time with PER-STEP flops when calling cost_model_mfu."""
+    import jax
+
+    def multi(v, o, tok):
+        def body(carry, _):
+            cv, co = carry
+            cv, co, loss = step_fn(cv, co, tok)
+            return (cv, co), loss
+
+        (v, o), losses = jax.lax.scan(body, (v, o), None, length=length)
+        return v, o, losses[-1]
+
+    return multi
 
 
 def vs_prev(metric, value, platform):
@@ -529,8 +559,18 @@ def main():
                 u, o = tx_lm.update(g, o, v)
                 return optax.apply_updates(v, u), o, loss
 
-            lm_jit = mpi.nn.data_parallel_step(lm_step, mesh=mesh,
-                                               batch_argnums=(2,))
+            # Steady-state program, same methodology as stage B' (and
+            # the chained kernel stages, VERDICT r3 #4): KB dependent
+            # train steps under ONE lax.scan'd dispatch, so the relay's
+            # per-dispatch pathology (~7 ms floor + 3x-slow later
+            # rounds) is paid once and amortized — production training
+            # IS a scanned step loop.  Adopted for stage B 2026-07-31;
+            # earlier rounds' single-step figures are labeled in
+            # README's methodology note.
+            KB = 2 if tiny else 8
+            lm_jit = mpi.nn.data_parallel_step(
+                scanned_train_step(lm_step, KB), mesh=mesh,
+                batch_argnums=(2,))
             with jax.default_device(init_dev):
                 lm_opt = tx_lm.init(lm_vars)
             lm_vars = mpi.nn.synchronize_parameters(lm_vars, mesh=mesh)
@@ -546,11 +586,12 @@ def main():
                 lm_state["loss"] = loss  # from the last executed step
                 return loss
 
-            steps_b = 3 if tiny else 20
+            calls_b = 3 if tiny else 5   # each call runs KB steps
             # Small-but-near-threshold compile: bless it so the library
             # gate never vetoes the ladder's own stages mid-run.
             with mpi.compile_budget():
-                dt_step = timed(lm_step_once, steps_b, fence)
+                dt_call = timed(lm_step_once, calls_b, fence)
+            dt_step = dt_call / KB       # per-train-step seconds
             lm_loss = lm_state["loss"]
             tok_s_chip = Bt * T / dt_step / n_dev
             # MFU from XLA's own cost model of the step lowering (same
@@ -569,6 +610,9 @@ def main():
             p_mm = (L_lm * (4.0 + 2.0 * Block.mlp_ratio) * E_lm * E_lm
                     + E_lm * lm.vocab)
             lm_flops = 3.0 * (Bt * T) * (2.0 * p_mm + L_lm * 2.0 * T * E_lm)
+            # PER-STEP time with PER-STEP flops: XLA's cost_analysis
+            # counts the scan body once (see scanned_train_step), and
+            # the analytic count below is for one step.
             lm_tflops, lm_mfu, lm_src = cost_model_mfu(
                 lambda: lm_jit.jitted.lower(lm_state["v"], lm_state["o"],
                                             tok_d),
@@ -585,7 +629,19 @@ def main():
                                        tok_s_chip, platform0),
                 "extra": {"devices": n_dev, "batch": Bt, "seq": T,
                           "step_ms": round(dt_step * 1000, 2),
-                          "round_ms": [round(t * 1e3, 2)
+                          "scan_steps_per_dispatch": KB,
+                          # vs_baseline divides by r3's SINGLE-dispatch
+                          # banked value (187490.3 tok/s); part of any
+                          # >1 ratio is the scan methodology amortizing
+                          # the relay's per-dispatch overhead, not pure
+                          # kernel speedup.  README "Measured
+                          # performance" states the switch.
+                          "vs_baseline_note": "r3 denominator is "
+                              "single-dispatch; this run scans "
+                              f"{KB} steps/dispatch",
+                          # per-TRAIN-STEP like step_ms (each timing
+                          # round dispatches KB scanned steps).
+                          "round_ms": [round(t * 1e3 / KB, 2)
                                        for t in _metrics.last_round_times],
                           "dtype": "bfloat16", "platform": platform0,
                           "tflops_per_chip": round(lm_tflops, 4),
@@ -826,29 +882,13 @@ def main():
                 u, o = tx2.update(g, o, v)
                 return optax.apply_updates(v, u), o, loss
 
-            # Steady-state program: K dependent train steps under ONE
-            # lax.scan'd dispatch (XLA compiles the body once, so the
-            # compile cost matches the single-step program).  The relay's
-            # per-dispatch pathology is worse than its ~7 ms floor —
+            # Steady-state scanned program — see scanned_train_step.
+            # K2 is set above (part of the compile-marker key); the
             # cycle-2 live rounds after the first ran 3x slower
-            # (round_ms [23.5, 74, 76]) — and production training IS a
-            # scanned step loop, so the amortized figure is the honest
-            # per-step number (same methodology the kernel stages adopted
-            # via chained(), VERDICT r3 #4).  K2 is set above (part of
-            # the compile-marker key).
-
-            def lm2_multi(v, o, tok):
-                def body(carry, _):
-                    cv, co = carry
-                    cv, co, loss = lm2_step(cv, co, tok)
-                    return (cv, co), loss
-
-                (v, o), losses = jax.lax.scan(body, (v, o), None,
-                                              length=K2)
-                return v, o, losses[-1]
-
-            lm2_jit = mpi.nn.data_parallel_step(lm2_multi, mesh=mesh,
-                                                batch_argnums=(2,))
+            # (round_ms [23.5, 74, 76]), which this amortizes away.
+            lm2_jit = mpi.nn.data_parallel_step(
+                scanned_train_step(lm2_step, K2), mesh=mesh,
+                batch_argnums=(2,))
             with jax.default_device(init_dev):
                 lm2_opt = tx2.init(lm2_vars)
             lm2_vars = mpi.nn.synchronize_parameters(lm2_vars, mesh=mesh)
@@ -887,14 +927,14 @@ def main():
                 else T2 / 2
             attn_fl2 = L2 * 4.0 * H2 * HD2 * avg_ctx
             fl2 = 3.0 * (B2 * T2) * (2.0 * p_mm2 + attn_fl2)
-            # The lowered program holds K2 scanned steps, so both the
-            # cost-model flops and the measured time cover K2 steps —
-            # consistent numerator/denominator for MFU.
+            # PER-STEP time with PER-STEP flops: XLA's cost_analysis
+            # counts the scan body once (see scanned_train_step), and
+            # fl2 is the one-step analytic count.
             tfl2, mfu2, src2 = cost_model_mfu(
                 lambda: lm2_jit.jitted.lower(lm2_state["v"],
                                              lm2_state["o"], tok2_d),
-                dt2_call, peak, platform0,
-                analytic_flops=K2 * fl2 / n_dev)
+                dt2, peak, platform0,
+                analytic_flops=fl2 / n_dev)
             log(f"stage B': {tok_s2:.0f} tokens/s/chip, "
                 f"loss {float(lm2_state['loss']):.3f}, "
                 f"{tfl2:.4g} TFLOP/s/chip, MFU {mfu2}")
