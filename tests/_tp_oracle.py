@@ -1,0 +1,63 @@
+"""Shared dense oracle for the TP/PP serving tests: single-device,
+cache-free greedy decode of the init_tp_lm architecture (recomputes the
+full forward every step, so a KV-cache bug cannot hide in both sides)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmpi_tpu.models import tp_generate as tpg
+from torchmpi_tpu.models.transformer import apply_rope
+
+
+def _ln(h, scale, bias):
+    mu = h.mean(-1, keepdims=True)
+    var = ((h - mu) ** 2).mean(-1, keepdims=True)
+    return (h - mu) / np.sqrt(var + 1e-6) * scale + bias
+
+
+def dense_forward(params, toks, num_heads):
+    """Full-sequence forward on the unsharded tree: returns last-position
+    logits [B, V]."""
+    x = params["embed"][toks]
+    B, T, D = x.shape
+    for p in params["blocks"]:
+        h = _ln(x, *p["ln1"])
+        width = p["wq"].shape[-1]
+        dh = width // num_heads
+        pos = jnp.arange(T, dtype=jnp.int32)
+        q = apply_rope((h @ p["wq"]).reshape(B, T, num_heads, dh), pos)
+        k = apply_rope((h @ p["wk"]).reshape(B, T, num_heads, dh), pos)
+        v = (h @ p["wv"]).reshape(B, T, num_heads, dh)
+        s = jnp.einsum("bthd,bshd->bhts", q, k) / np.sqrt(dh)
+        s = jnp.where(jnp.tril(jnp.ones((T, T), bool)), s,
+                      jnp.finfo(s.dtype).min)
+        probs = jax.nn.softmax(s.astype(jnp.float32), axis=-1)
+        ctx = jnp.einsum("bhts,bshd->bthd", probs.astype(x.dtype),
+                         v).reshape(B, T, width)
+        x = x + ctx @ p["wo"]
+        h2 = _ln(x, *p["ln2"])
+        x = x + jax.nn.gelu(h2 @ p["w1"]) @ p["w2"]
+    return _ln(x[:, -1], *params["ln_f"]) @ params["head"]
+
+
+def dense_greedy(params, prompt, steps, num_heads, eos_id=None):
+    toks = jnp.asarray(prompt)
+    done = np.zeros(toks.shape[0], bool)
+    for _ in range(steps):
+        logits = dense_forward(params, toks, num_heads)
+        nxt = np.asarray(jnp.argmax(logits, axis=-1)).astype(
+            np.asarray(prompt).dtype)
+        if eos_id is not None:
+            nxt = np.where(done, eos_id, nxt)
+            done = done | (nxt == eos_id)
+        toks = jnp.concatenate([toks, jnp.asarray(nxt)[:, None]], axis=1)
+    return np.asarray(toks)
+
+
+def setup(seed=0, vocab=64, embed=32, depth=2, num_heads=8, B=2, Tp=4):
+    params = tpg.init_tp_lm(jax.random.PRNGKey(seed), vocab=vocab,
+                            embed=embed, depth=depth, num_heads=num_heads)
+    prompt = np.random.RandomState(seed + 1).randint(
+        0, vocab, size=(B, Tp)).astype(np.int32)
+    return params, prompt

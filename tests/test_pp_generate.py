@@ -1,0 +1,104 @@
+"""Pipeline-parallel serving tests: the round-robin micro-group decode
+over the stage axis must emit the same tokens as the cache-free dense
+oracle (tests/_tp_oracle.py — also the TP serving oracle, since both
+paths consume the same init_tp_lm tree)."""
+
+import jax
+import numpy as np
+import pytest
+
+import torchmpi_tpu as mpi
+from _tp_oracle import dense_greedy, setup
+from torchmpi_tpu.models import pp_generate as ppg
+
+AXIS = ("dcn", "ici")  # 8 stages on the flat 1x8 world mesh
+
+
+def test_pp_generate_matches_dense_greedy(flat_runtime):
+    mesh = mpi.world_mesh()
+    # 8 stages x 1 layer, batch 8 = 8 micro-groups of 1 row.
+    params, prompt = setup(depth=8, B=8)
+    steps = 5
+    expect = dense_greedy(params, prompt, steps, num_heads=8)
+    got = ppg.pp_generate(params, prompt, steps, mesh=mesh, axis=AXIS,
+                          num_heads=8)
+    np.testing.assert_array_equal(np.asarray(got), expect)
+
+
+def test_pp_generate_multirow_groups(flat_runtime):
+    """16 rows over 8 stages: micro-groups of 2 rows each."""
+    mesh = mpi.world_mesh()
+    params, prompt = setup(seed=2, depth=8, B=16)
+    expect = dense_greedy(params, prompt, 3, num_heads=8)
+    got = ppg.pp_generate(params, prompt, 3, mesh=mesh, axis=AXIS,
+                          num_heads=8)
+    np.testing.assert_array_equal(np.asarray(got), expect)
+
+
+def test_pp_generate_over_ici_with_dcn(hier_runtime):
+    """4 stages over ici on a 2x4 mesh (dcn replicates): 2 layers per
+    stage."""
+    mesh = mpi.world_mesh()
+    params, prompt = setup(seed=3, depth=8, B=4)
+    expect = dense_greedy(params, prompt, 4, num_heads=8)
+    got = ppg.pp_generate(params, prompt, 4, mesh=mesh, axis="ici",
+                          num_heads=8)
+    np.testing.assert_array_equal(np.asarray(got), expect)
+
+
+def test_pp_generate_eos_freeze(flat_runtime):
+    mesh = mpi.world_mesh()
+    params, prompt = setup(seed=5, depth=8, B=8)
+    free = dense_greedy(params, prompt, 6, num_heads=8)
+    eos = int(free[0, prompt.shape[1] + 1])
+    expect = dense_greedy(params, prompt, 6, num_heads=8, eos_id=eos)
+    got = ppg.pp_generate(params, prompt, 6, mesh=mesh, axis=AXIS,
+                          num_heads=8, eos_id=eos)
+    np.testing.assert_array_equal(np.asarray(got), expect)
+    tail = np.asarray(got)[0, prompt.shape[1] + 2:]
+    np.testing.assert_array_equal(tail, np.full_like(tail, eos))
+
+
+def test_pp_generate_eos_predicted_during_prefill(flat_runtime):
+    """A token the model predicts at a TEACHER-FORCED position must not
+    freeze the row: that prediction is discarded (the prompt supplies
+    the real token), and only generated tokens may trip EOS — the dense
+    oracle's semantics."""
+    from _tp_oracle import dense_forward
+    import jax.numpy as jnp
+
+    mesh = mpi.world_mesh()
+    params, prompt = setup(seed=11, depth=8, B=8)
+    # Row 0's (discarded) prediction after the first 2 prompt tokens —
+    # with the old valid&is_last guard this froze row 0 during prefill.
+    pred = int(np.asarray(jnp.argmax(dense_forward(
+        params, jnp.asarray(prompt[:, :2]), 8), axis=-1))[0])
+    expect = dense_greedy(params, prompt, 4, num_heads=8, eos_id=pred)
+    got = ppg.pp_generate(params, prompt, 4, mesh=mesh, axis=AXIS,
+                          num_heads=8, eos_id=pred)
+    np.testing.assert_array_equal(np.asarray(got), expect)
+
+
+def test_pp_generate_sampling_valid(flat_runtime):
+    mesh = mpi.world_mesh()
+    params, prompt = setup(seed=7, depth=8, B=8)
+    kw = dict(mesh=mesh, axis=AXIS, num_heads=8, temperature=1.0,
+              top_k=5, rng=jax.random.PRNGKey(9))
+    a = np.asarray(ppg.pp_generate(params, prompt, 4, **kw))
+    b = np.asarray(ppg.pp_generate(params, prompt, 4, **kw))
+    np.testing.assert_array_equal(a, b)
+    assert a.shape == (prompt.shape[0], prompt.shape[1] + 4)
+    np.testing.assert_array_equal(a[:, :prompt.shape[1]], prompt)
+    assert a.min() >= 0 and a.max() < 64
+
+
+def test_pp_generate_shape_errors(flat_runtime):
+    mesh = mpi.world_mesh()
+    params, prompt = setup(depth=8, B=8)
+    with pytest.raises(ValueError, match="divide"):
+        ppg.pp_generate(params, prompt[:6], 2, mesh=mesh, axis=AXIS,
+                        num_heads=8)
+    bad, _ = setup(depth=6, B=8)
+    with pytest.raises(ValueError, match="divide"):
+        ppg.pp_generate(bad, prompt, 2, mesh=mesh, axis=AXIS,
+                        num_heads=8)

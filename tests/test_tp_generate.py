@@ -1,78 +1,24 @@
 """Tensor-parallel serving tests: TP prefill+decode over the 8-device
 mesh must emit the same tokens as a dense single-device oracle running
-the identical architecture (the oracle recomputes the full forward per
-step — no cache — so a cache bug cannot hide in both sides)."""
+the identical architecture (tests/_tp_oracle.py — cache-free, so a
+cache bug cannot hide in both sides)."""
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 import pytest
 
 import torchmpi_tpu as mpi
+from _tp_oracle import dense_greedy, setup
 from torchmpi_tpu.models import tp_generate as tpg
-from torchmpi_tpu.models.transformer import apply_rope
 
 AXIS = ("dcn", "ici")
 
 
-def _ln(h, scale, bias):
-    mu = h.mean(-1, keepdims=True)
-    var = ((h - mu) ** 2).mean(-1, keepdims=True)
-    return (h - mu) / np.sqrt(var + 1e-6) * scale + bias
-
-
-def _dense_forward(params, toks, num_heads):
-    """Full-sequence forward on the unsharded tree: returns last-position
-    logits [B, V]."""
-    x = params["embed"][toks]
-    B, T, D = x.shape
-    for p in params["blocks"]:
-        h = _ln(x, *p["ln1"])
-        width = p["wq"].shape[-1]
-        dh = width // num_heads
-        pos = jnp.arange(T, dtype=jnp.int32)
-        q = apply_rope((h @ p["wq"]).reshape(B, T, num_heads, dh), pos)
-        k = apply_rope((h @ p["wk"]).reshape(B, T, num_heads, dh), pos)
-        v = (h @ p["wv"]).reshape(B, T, num_heads, dh)
-        s = jnp.einsum("bthd,bshd->bhts", q, k) / np.sqrt(dh)
-        s = jnp.where(jnp.tril(jnp.ones((T, T), bool)), s,
-                      jnp.finfo(s.dtype).min)
-        probs = jax.nn.softmax(s.astype(jnp.float32), axis=-1)
-        ctx = jnp.einsum("bhts,bshd->bthd", probs.astype(x.dtype),
-                         v).reshape(B, T, width)
-        x = x + ctx @ p["wo"]
-        h2 = _ln(x, *p["ln2"])
-        x = x + jax.nn.gelu(h2 @ p["w1"]) @ p["w2"]
-    return _ln(x[:, -1], *params["ln_f"]) @ params["head"]
-
-
-def _dense_greedy(params, prompt, steps, num_heads, eos_id=None):
-    toks = jnp.asarray(prompt)
-    done = np.zeros(toks.shape[0], bool)
-    for _ in range(steps):
-        logits = _dense_forward(params, toks, num_heads)
-        nxt = np.asarray(jnp.argmax(logits, axis=-1)).astype(
-            np.asarray(prompt).dtype)
-        if eos_id is not None:
-            nxt = np.where(done, eos_id, nxt)
-            done = done | (nxt == eos_id)
-        toks = jnp.concatenate([toks, jnp.asarray(nxt)[:, None]], axis=1)
-    return np.asarray(toks)
-
-
-def _setup(seed=0, vocab=64, embed=32, depth=2, num_heads=8, B=2, Tp=4):
-    params = tpg.init_tp_lm(jax.random.PRNGKey(seed), vocab=vocab,
-                            embed=embed, depth=depth, num_heads=num_heads)
-    prompt = np.random.RandomState(seed + 1).randint(
-        0, vocab, size=(B, Tp)).astype(np.int32)
-    return params, prompt
-
-
 def test_tp_generate_matches_dense_greedy(flat_runtime):
     mesh = mpi.world_mesh()
-    params, prompt = _setup()
+    params, prompt = setup()
     steps = 6
-    expect = _dense_greedy(params, prompt, steps, num_heads=8)
+    expect = dense_greedy(params, prompt, steps, num_heads=8)
     got = tpg.tp_generate(params, prompt, steps, mesh=mesh, axis=AXIS,
                           num_heads=8)
     np.testing.assert_array_equal(np.asarray(got), expect)
@@ -82,8 +28,8 @@ def test_tp_generate_over_ici_with_dcn(hier_runtime):
     """TP over ici only on a 2x4 mesh: the dcn axis just replicates —
     tokens must still match the dense oracle."""
     mesh = mpi.world_mesh()
-    params, prompt = _setup(seed=3)
-    expect = _dense_greedy(params, prompt, 4, num_heads=8)
+    params, prompt = setup(seed=3)
+    expect = dense_greedy(params, prompt, 4, num_heads=8)
     got = tpg.tp_generate(params, prompt, 4, mesh=mesh, axis="ici",
                           num_heads=8)
     np.testing.assert_array_equal(np.asarray(got), expect)
@@ -94,10 +40,10 @@ def test_tp_generate_eos_freeze(flat_runtime):
     position in that row must freeze to it, matching the oracle's own
     freeze logic."""
     mesh = mpi.world_mesh()
-    params, prompt = _setup(seed=5)
-    free = _dense_greedy(params, prompt, 6, num_heads=8)
+    params, prompt = setup(seed=5)
+    free = dense_greedy(params, prompt, 6, num_heads=8)
     eos = int(free[0, prompt.shape[1] + 1])  # row 0's 2nd generated token
-    expect = _dense_greedy(params, prompt, 6, num_heads=8, eos_id=eos)
+    expect = dense_greedy(params, prompt, 6, num_heads=8, eos_id=eos)
     got = tpg.tp_generate(params, prompt, 6, mesh=mesh, axis=AXIS,
                           num_heads=8, eos_id=eos)
     np.testing.assert_array_equal(np.asarray(got), expect)
@@ -109,7 +55,7 @@ def test_tp_generate_sampling_valid(flat_runtime):
     """Temperature + top-k smoke: in-vocab tokens, deterministic for a
     fixed rng, prompt preserved."""
     mesh = mpi.world_mesh()
-    params, prompt = _setup(seed=7)
+    params, prompt = setup(seed=7)
     kw = dict(mesh=mesh, axis=AXIS, num_heads=8, temperature=1.0,
               top_k=5, rng=jax.random.PRNGKey(9))
     a = np.asarray(tpg.tp_generate(params, prompt, 5, **kw))
@@ -122,7 +68,7 @@ def test_tp_generate_sampling_valid(flat_runtime):
 
 def test_tp_generate_bad_prompt(flat_runtime):
     mesh = mpi.world_mesh()
-    params, _ = _setup()
+    params, _ = setup()
     with pytest.raises(ValueError, match=r"\[batch, time\]"):
         tpg.tp_generate(params, np.array([1, 2, 3], np.int32), 2,
                         mesh=mesh, axis=AXIS, num_heads=8)
@@ -130,7 +76,7 @@ def test_tp_generate_bad_prompt(flat_runtime):
 
 def test_tp_generate_bad_heads(flat_runtime):
     mesh = mpi.world_mesh()
-    params, prompt = _setup(num_heads=8)
+    params, prompt = setup(num_heads=8)
     with pytest.raises(ValueError, match="divide"):
         tpg.tp_generate(params, prompt, 2, mesh=mesh, axis=AXIS,
                         num_heads=6)

@@ -71,6 +71,20 @@ def _filter_logits(logits, temperature, top_k, top_p):
     return logits
 
 
+def _sample(logits, rng, temperature, top_k, top_p, dtype):
+    """Filtered greedy/categorical sampling — the one implementation
+    behind every serving path (dense scan, TP, PP), so the
+    temperature-0 select and the filter interplay can never diverge
+    between them."""
+    logits = _filter_logits(logits.astype(jnp.float32), temperature,
+                            top_k, top_p)
+    return jnp.where(
+        temperature > 0.0,
+        jax.random.categorical(rng, logits / jnp.maximum(
+            temperature, 1e-6)),
+        jnp.argmax(logits, axis=-1)).astype(dtype)
+
+
 def _generate_scan(model, params, prompt, steps, temperature, rng,
                    top_k=None, top_p=None, eos_id=None):
     """Single-forward prefill + scanned decode: traceable anywhere a
@@ -90,13 +104,8 @@ def _generate_scan(model, params, prompt, steps, temperature, rng,
         return prompt
 
     def sample(logits, rng):  # logits: [B, vocab]
-        logits = _filter_logits(logits.astype(jnp.float32), temperature,
-                                top_k, top_p)
-        return jnp.where(
-            temperature > 0.0,
-            jax.random.categorical(rng, logits / jnp.maximum(
-                temperature, 1e-6)),
-            jnp.argmax(logits, axis=-1)).astype(prompt.dtype)
+        return _sample(logits, rng, temperature, top_k, top_p,
+                       prompt.dtype)
 
     # Prefill: one pass over the full prompt creates AND fills the KV
     # caches (flax initializes missing mutable collections, so no

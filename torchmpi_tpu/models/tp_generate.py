@@ -32,7 +32,7 @@ import numpy as np
 from jax import lax
 
 from ..parallel import tensor as tp
-from .generate import _check_sampling, _filter_logits
+from .generate import _check_sampling, _sample
 from .transformer import apply_rope
 
 
@@ -192,13 +192,8 @@ def _tp_generate_body(params, prompt, temperature, rng, *, axis,
     t_max = Tp + steps
 
     def sample(logits, rng):
-        logits = _filter_logits(logits.astype(jnp.float32), temperature,
-                                top_k, top_p)
-        return jnp.where(
-            temperature > 0.0,
-            jax.random.categorical(rng, logits / jnp.maximum(
-                temperature, 1e-6)),
-            jnp.argmax(logits, axis=-1)).astype(prompt.dtype)
+        return _sample(logits, rng, temperature, top_k, top_p,
+                       prompt.dtype)
 
     x = params["embed"][prompt]              # [B, Tp, D] replicated
     caches = []
