@@ -42,6 +42,12 @@ def test_moe_lm_top2_example():
 
 
 @pytest.mark.slow
+def test_parallel_serving_example():
+    # Dense == TP == PP greedy tokens over the same checkpoint tree.
+    _run("parallel_serving.py", "--devices", "8")
+
+
+@pytest.mark.slow
 def test_lm_generate_example():
     # Serving path: train, then KV-cache decode; asserts the generated
     # continuations follow the learned next-token rule.
