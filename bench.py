@@ -828,7 +828,14 @@ def main():
             T2 = 128 if tiny else 2048
             V2 = 512 if tiny else 32768
             W2 = 64 if tiny else 1024    # sliding window
-            B2 = (2 if tiny else 4) * n_dev
+            # Per-chip batch: 4 measured MFU 0.5706 vs 8 at 0.5499
+            # (2026-07-31 live study — throughput/chip DROPS at 8:
+            # 40.6k vs 42.1k tok/s, the b4 program already saturates
+            # the MXU and b8 doubles HBM activation traffic).  Env knob
+            # for re-running the study; the marker key below includes
+            # the batch, so each shape gates independently.
+            B2 = (2 if tiny else int(os.environ.get(
+                "TORCHMPI_TPU_BENCH_B2_BATCH", "4"))) * n_dev
             attn2 = "flash" if platform0 == "tpu" else "local"
             K2 = 2 if tiny else 8   # scanned train steps per dispatch
             b2_key = (f"lm_large_step_{platform0}_E{E2}L{L2}T{T2}"

@@ -139,8 +139,16 @@ def bank():
         [sys.executable, "bench.py"],
         int(bench_env["TORCHMPI_TPU_BENCH_TIMEOUT"]) + 600, bench_log,
         env=bench_env)
+    # Parse the WHOLE log for records, not run_bounded's 40-line tail:
+    # the ladder's leading stages scroll out of a fixed tail as runs add
+    # log lines (the 08:23 cycle-3 bank silently dropped its matmul
+    # record at 49 log lines — code review r4).  This run's appended
+    # segment starts at the last "=== ... bench.py" banner.
     recs = []
-    for ln in tail:
+    with open(bench_log) as f:
+        lines = f.readlines()
+    starts = [i for i, ln in enumerate(lines) if ln.startswith("=== ")]
+    for ln in lines[starts[-1]:] if starts else lines:
         try:
             rec = json.loads(ln.strip())
             if isinstance(rec, dict) and "metric" in rec:
