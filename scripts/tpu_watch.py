@@ -135,7 +135,7 @@ def bank():
     # grant the child a full cold-ladder budget and bound it outside.
     bench_env = dict(os.environ)
     bench_env.setdefault("TORCHMPI_TPU_BENCH_TIMEOUT", "2700")
-    rc, tail = run_bounded(
+    rc, _ = run_bounded(
         [sys.executable, "bench.py"],
         int(bench_env["TORCHMPI_TPU_BENCH_TIMEOUT"]) + 600, bench_log,
         env=bench_env)
@@ -143,11 +143,15 @@ def bank():
     # the ladder's leading stages scroll out of a fixed tail as runs add
     # log lines (the 08:23 cycle-3 bank silently dropped its matmul
     # record at 49 log lines — code review r4).  This run's appended
-    # segment starts at the last "=== ... bench.py" banner.
+    # segment starts at the last run_bounded banner, matched by its
+    # exact format ("=== <timestamp> <cmd>") so a stray "=== " in bench
+    # output can't re-truncate the records.
     recs = []
     with open(bench_log) as f:
         lines = f.readlines()
-    starts = [i for i, ln in enumerate(lines) if ln.startswith("=== ")]
+    starts = [i for i, ln in enumerate(lines)
+              if ln.startswith("=== ") and "bench.py" in ln
+              and "(timeout" in ln]
     for ln in lines[starts[-1]:] if starts else lines:
         try:
             rec = json.loads(ln.strip())
