@@ -55,6 +55,22 @@ def dense_greedy(params, prompt, steps, num_heads, eos_id=None):
     return np.asarray(toks)
 
 
+def seq_logprob(params, toks, num_heads, prompt_len):
+    """Sum of log p(tok_i | prefix) over the generated positions, eos
+    repeats after the first eos included at their true (0 after freeze?
+    no — true model) probability: the brute-force beam-scoring oracle."""
+    toks = np.asarray(toks)
+    B, total = toks.shape
+    lp = np.zeros(B)
+    for i in range(prompt_len, total):
+        logits = dense_forward(params, jnp.asarray(toks[:, :i]),
+                               num_heads)
+        logp = np.asarray(jax.nn.log_softmax(
+            logits.astype(jnp.float32), axis=-1))
+        lp += logp[np.arange(B), toks[:, i]]
+    return lp
+
+
 def setup(seed=0, vocab=64, embed=32, depth=2, num_heads=8, B=2, Tp=4):
     params = tpg.init_tp_lm(jax.random.PRNGKey(seed), vocab=vocab,
                             embed=embed, depth=depth, num_heads=num_heads)

@@ -66,6 +66,72 @@ def test_tp_generate_sampling_valid(flat_runtime):
     assert a.min() >= 0 and a.max() < 64
 
 
+def test_tp_beam_beams1_equals_greedy(flat_runtime):
+    mesh = mpi.world_mesh()
+    params, prompt = _oracle_setup_small()
+    greedy = np.asarray(tpg.tp_generate(params, prompt, 4, mesh=mesh,
+                                        axis=AXIS, num_heads=8))
+    beam1 = np.asarray(tpg.tp_beam_search(params, prompt, 4, mesh=mesh,
+                                          axis=AXIS, num_heads=8,
+                                          beams=1))
+    np.testing.assert_array_equal(beam1, greedy)
+
+
+def test_tp_beam_exhaustive_at_steps2(flat_runtime):
+    """beams == vocab at steps=2 IS exhaustive search: the TP beam's
+    best hypothesis must score as high as brute force over all vocab^2
+    continuations (scored by the dense oracle)."""
+    from _tp_oracle import seq_logprob
+
+    mesh = mpi.world_mesh()
+    params, prompt = _oracle_setup_small()
+    V = 16
+    got = np.asarray(tpg.tp_beam_search(params, prompt, 2, mesh=mesh,
+                                        axis=AXIS, num_heads=8, beams=V))
+    B = prompt.shape[0]
+    best_lp = np.full(B, -np.inf)
+    for t1 in range(V):
+        for t2 in range(V):
+            cand = np.concatenate(
+                [prompt, np.full((B, 1), t1, np.int32),
+                 np.full((B, 1), t2, np.int32)], axis=1)
+            lp = seq_logprob(params, cand, 8, prompt.shape[1])
+            best_lp = np.maximum(best_lp, lp)
+    got_lp = seq_logprob(params, got, 8, prompt.shape[1])
+    np.testing.assert_allclose(got_lp, best_lp, rtol=1e-4, atol=1e-4)
+
+
+def test_tp_beam_eos_pads_tail(flat_runtime):
+    """With eos = row 0's highest-probability first token and no length
+    penalty, the frozen beam is GUARANTEED to win (any continuation
+    adds <= 0 log-prob to a smaller start), so the emitted suffix must
+    be all-eos — the shared _beam_expand freeze semantics on TP,
+    asserted unconditionally."""
+    mesh = mpi.world_mesh()
+    params, prompt = _oracle_setup_small(seed=9)
+    greedy = np.asarray(tpg.tp_generate(params, prompt, 1, mesh=mesh,
+                                        axis=AXIS, num_heads=8))
+    eos = int(greedy[0, prompt.shape[1]])  # row 0's ARGMAX first token
+    got = np.asarray(tpg.tp_beam_search(params, prompt, 5, mesh=mesh,
+                                        axis=AXIS, num_heads=8, beams=3,
+                                        eos_id=eos))
+    row = got[0, prompt.shape[1]:]
+    np.testing.assert_array_equal(row, np.full_like(row, eos))
+
+
+def test_tp_beam_too_many_beams(flat_runtime):
+    mesh = mpi.world_mesh()
+    params, prompt = _oracle_setup_small()
+    with pytest.raises(ValueError, match="exceeds vocab"):
+        tpg.tp_beam_search(params, prompt, 2, mesh=mesh, axis=AXIS,
+                           num_heads=8, beams=17)
+
+
+def _oracle_setup_small(seed=13):
+    return setup(seed=seed, vocab=16, embed=32, depth=2, num_heads=8,
+                 B=2, Tp=3)
+
+
 def test_tp_generate_bad_prompt(flat_runtime):
     mesh = mpi.world_mesh()
     params, _ = setup()

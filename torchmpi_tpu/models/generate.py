@@ -161,6 +161,55 @@ def _check_prompt(model, prompt, steps):
             f"{model.max_len}")
 
 
+def _beam_expand(lp, fin, ln, step_lp, eos_id, dtype):
+    """One beam expansion given per-beam next-token log-probs — the
+    trellis bookkeeping shared by the dense/EP/Ulysses beam
+    (:func:`_beam_scan`) and the TP beam
+    (:func:`.tp_generate.tp_beam_search`), so the finished-beam and
+    parent-gather semantics can never diverge between them.
+
+    ``lp/fin/ln``: [B, K] cumulative log-prob / finished flag /
+    generated length; ``step_lp``: [B, K, V].  Returns
+    ``(new_lp, new_tok, new_fin, new_ln, parent)``."""
+    B, K, V = step_lp.shape
+    if eos_id is not None:
+        # Finished beams: the single finite continuation is eos at +0,
+        # so their cumulative score survives top_k unchanged.
+        pad_row = jnp.where(jnp.arange(V) == eos_id, 0.0, -jnp.inf)
+        step_lp = jnp.where(fin[:, :, None], pad_row[None, None, :],
+                            step_lp)
+    total = lp[:, :, None] + step_lp             # [B, K, V]
+    new_lp, flat = lax.top_k(total.reshape(B, K * V), K)
+    parent, new_tok = flat // V, (flat % V).astype(dtype)
+    par_fin = jnp.take_along_axis(fin, parent, 1)
+    new_ln = jnp.take_along_axis(ln, parent, 1) + \
+        jnp.where(par_fin, 0, 1)
+    new_fin = par_fin
+    if eos_id is not None:
+        new_fin = par_fin | (new_tok == eos_id)
+    return new_lp, new_tok, new_fin, new_ln, parent
+
+
+def _beam_backtrack(prompt, top_tok, toks, parents, final_lp, final_len,
+                    length_penalty):
+    """Reconstruct the best hypothesis through the (token, parent)
+    trellis, ranked by the (optionally length-normalized) score."""
+    score = final_lp
+    if length_penalty:
+        score = final_lp / jnp.maximum(
+            final_len.astype(jnp.float32), 1.0) ** length_penalty
+    best = jnp.argmax(score, axis=-1)            # [B]
+
+    def back(beam, y):
+        tok_t, par_t = y
+        t = jnp.take_along_axis(tok_t, beam[:, None], 1)[:, 0]
+        return jnp.take_along_axis(par_t, beam[:, None], 1)[:, 0], t
+
+    beam0, path = lax.scan(back, best, (toks, parents), reverse=True)
+    first = jnp.take_along_axis(top_tok, beam0[:, None], 1)[:, 0]
+    return jnp.concatenate([prompt, first[:, None], path.T], axis=1)
+
+
 def _beam_scan(model, params, prompt, steps, K, eos_id=None,
                length_penalty=0.0):
     """KV-cache beam search: prefill once on B rows, tile the caches to
@@ -208,21 +257,8 @@ def _beam_scan(model, params, prompt, steps, K, eos_id=None,
             pos_offset=i, mutable=["cache"])
         step_lp = jax.nn.log_softmax(
             logits[:, 0].astype(jnp.float32), -1).reshape(B, K, V)
-        if eos_id is not None:
-            # Finished beams: the single finite continuation is eos at
-            # +0, so their cumulative score survives top_k unchanged.
-            pad_row = jnp.where(jnp.arange(V) == eos_id, 0.0, -jnp.inf)
-            step_lp = jnp.where(fin[:, :, None], pad_row[None, None, :],
-                                step_lp)
-        total = lp[:, :, None] + step_lp         # [B, K, V]
-        new_lp, flat = lax.top_k(total.reshape(B, K * V), K)
-        parent, new_tok = flat // V, (flat % V).astype(prompt.dtype)
-        par_fin = jnp.take_along_axis(fin, parent, 1)
-        new_ln = jnp.take_along_axis(ln, parent, 1) + \
-            jnp.where(par_fin, 0, 1)
-        new_fin = par_fin
-        if eos_id is not None:
-            new_fin = par_fin | (new_tok == eos_id)
+        new_lp, new_tok, new_fin, new_ln, parent = _beam_expand(
+            lp, fin, ln, step_lp, eos_id, prompt.dtype)
         reorder = (jnp.arange(B)[:, None] * K + parent).reshape(-1)
         cache = jax.tree.map(
             lambda c: (c[reorder]
@@ -234,22 +270,8 @@ def _beam_scan(model, params, prompt, steps, K, eos_id=None,
         step, (cache, top_lp, top_tok, fin0, len0),
         Tp + jnp.arange(steps - 1))
 
-    # Backtrack the best hypothesis through the trellis, ranked by the
-    # (optionally length-normalized) score.
-    score = final_lp
-    if length_penalty:
-        score = final_lp / jnp.maximum(
-            final_len.astype(jnp.float32), 1.0) ** length_penalty
-    best = jnp.argmax(score, axis=-1)            # [B]
-
-    def back(beam, y):
-        tok_t, par_t = y
-        t = jnp.take_along_axis(tok_t, beam[:, None], 1)[:, 0]
-        return jnp.take_along_axis(par_t, beam[:, None], 1)[:, 0], t
-
-    beam0, path = lax.scan(back, best, (toks, parents), reverse=True)
-    first = jnp.take_along_axis(top_tok, beam0[:, None], 1)[:, 0]
-    return jnp.concatenate([prompt, first[:, None], path.T], axis=1)
+    return _beam_backtrack(prompt, top_tok, toks, parents, final_lp,
+                           final_len, length_penalty)
 
 
 @partial(jax.jit, static_argnums=(0, 3, 4, 5, 6))

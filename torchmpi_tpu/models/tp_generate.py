@@ -231,6 +231,108 @@ def _tp_generate_body(params, prompt, temperature, rng, *, axis,
     return jnp.concatenate([prompt, first[:, None], toks.T], axis=1)
 
 
+def _tp_beam_body(params, prompt, *, axis, num_heads, steps, K, eos_id,
+                  length_penalty):
+    """Beam search over the TP stack: prefill on B rows, tile the
+    head-local caches to B*K beam rows, decode with the SAME trellis
+    bookkeeping as the dense beam (``generate._beam_expand`` /
+    ``_beam_backtrack``).  The parent-gather cache reindex is a local
+    batch-dim gather on every device — beam rows are replicated, only
+    heads are sharded — so TP adds no collective beyond the per-token
+    psum/all_gather the greedy path already pays."""
+    from .generate import _beam_backtrack, _beam_expand
+
+    B, Tp = prompt.shape
+    t_max = Tp + steps
+    x = params["embed"][prompt]
+    caches = []
+    for p in params["blocks"]:
+        x, cache = _block_prefill(x, p, axis, num_heads, t_max)
+        caches.append(cache)
+    lp0 = jax.nn.log_softmax(
+        _logits(_ln(x[:, -1], *params["ln_f"]), params,
+                axis).astype(jnp.float32), -1)
+    V = lp0.shape[-1]
+    top_lp, top_tok = lax.top_k(lp0, K)          # [B, K]
+    top_tok = top_tok.astype(prompt.dtype)
+    caches = jax.tree.map(lambda c: jnp.repeat(c, K, axis=0), caches)
+
+    if steps == 1:
+        best = top_tok[:, 0]
+        return jnp.concatenate([prompt, best[:, None]], axis=1)
+
+    fin0 = (top_tok == eos_id) if eos_id is not None else \
+        jnp.zeros((B, K), bool)
+    len0 = jnp.ones((B, K), jnp.int32)
+
+    def step(carry, i):
+        caches, lp, tok, fin, ln = carry
+        x = params["embed"][tok.reshape(B * K, 1)]
+        new_caches = []
+        for p, cache in zip(params["blocks"], caches):
+            x, cache = _block_decode(x, p, cache, i, axis, num_heads)
+            new_caches.append(cache)
+        logits = _logits(_ln(x[:, 0], *params["ln_f"]), params, axis)
+        step_lp = jax.nn.log_softmax(
+            logits.astype(jnp.float32), -1).reshape(B, K, V)
+        new_lp, new_tok, new_fin, new_ln, parent = _beam_expand(
+            lp, fin, ln, step_lp, eos_id, prompt.dtype)
+        reorder = (jnp.arange(B)[:, None] * K + parent).reshape(-1)
+        new_caches = jax.tree.map(lambda c: c[reorder], new_caches)
+        return (new_caches, new_lp, new_tok, new_fin, new_ln), \
+            (new_tok, parent)
+
+    (_, final_lp, _, _, final_len), (toks, parents) = lax.scan(
+        step, (caches, top_lp, top_tok, fin0, len0),
+        Tp + jnp.arange(steps - 1, dtype=jnp.int32))
+
+    return _beam_backtrack(prompt, top_tok, toks, parents, final_lp,
+                           final_len, length_penalty)
+
+
+@lru_cache(maxsize=None)
+def _tp_beam_fn(mesh, axis, num_heads, steps, depth, beams, eos_id,
+                length_penalty):
+    from jax.sharding import PartitionSpec as P
+
+    body = partial(_tp_beam_body, axis=axis, num_heads=num_heads,
+                   steps=steps, K=beams, eos_id=eos_id,
+                   length_penalty=length_penalty)
+    return jax.jit(jax.shard_map(
+        body, mesh=mesh, in_specs=(_tp_specs(depth, axis), P()),
+        out_specs=P(), check_vma=False))
+
+
+def tp_beam_search(params, prompt, steps: int, *, mesh, axis,
+                   num_heads: int, beams: int,
+                   eos_id: Optional[int] = None,
+                   length_penalty: float = 0.0,
+                   sharded: Optional[Tuple] = None) -> jax.Array:
+    """Beam search on the tensor-parallel stack — semantics identical
+    to :func:`.generate.beam_search` (cumulative log-prob, finished
+    beams freeze at zero added score on ``eos_id``, final ranking by
+    ``logprob / len**length_penalty``), with weights and KV caches
+    sharded 1/n over the model axis."""
+    prompt = jnp.asarray(prompt)
+    if prompt.ndim != 2:
+        raise ValueError(f"prompt must be [batch, time], got "
+                         f"{prompt.shape}")
+    if steps <= 0:
+        return prompt
+    if beams < 1:
+        raise ValueError(f"beams must be >= 1, got {beams}")
+    vocab = params["embed"].shape[0]
+    if beams > vocab:
+        raise ValueError(f"beams {beams} exceeds vocab {vocab}")
+    placed, _ = sharded if sharded is not None else \
+        shard_tp_lm(params, mesh, axis)
+    fn = _tp_beam_fn(mesh, axis, num_heads, steps,
+                     len(params["blocks"]), int(beams),
+                     None if eos_id is None else int(eos_id),
+                     float(length_penalty))
+    return fn(placed, prompt)
+
+
 @lru_cache(maxsize=None)
 def _tp_fn(mesh, axis, num_heads, steps, depth, top_k, top_p, eos_id):
     """Build (once per static config — jit itself respecializes per
