@@ -81,9 +81,19 @@ def main():
     assert (tp_eos[0, prompt.shape[1]:] == eos).all(), (
         "row 0 should freeze at its first emitted token")
 
+    # Beam decode on the TP stack: beams=1 must reduce to greedy.
+    beam1 = np.asarray(tpg.tp_beam_search(
+        params, prompt, steps, mesh=mesh, axis=axis, num_heads=8,
+        beams=1))
+    assert (beam1 == toks).all(), "TP beam(1) diverged from greedy"
+    beam3 = np.asarray(tpg.tp_beam_search(
+        params, prompt, steps, mesh=mesh, axis=axis, num_heads=8,
+        beams=3, length_penalty=0.6))
+    assert beam3.shape == toks.shape
+
     print(f"parallel serving OK: dense == TP == PP over {n_dev} devices "
           f"({B}x{prompt.shape[1]} prompt + {steps} tokens; EOS freeze "
-          f"consistent)")
+          f"consistent; TP beam(1) == greedy)")
 
 
 if __name__ == "__main__":

@@ -56,9 +56,12 @@ def dense_greedy(params, prompt, steps, num_heads, eos_id=None):
 
 
 def seq_logprob(params, toks, num_heads, prompt_len):
-    """Sum of log p(tok_i | prefix) over the generated positions, eos
-    repeats after the first eos included at their true (0 after freeze?
-    no — true model) probability: the brute-force beam-scoring oracle."""
+    """Sum of log p(tok_i | prefix) over the generated positions — the
+    brute-force beam-scoring oracle.  Caveat: every position is scored
+    at its TRUE model probability, including eos repeats after a first
+    eos, whereas an eos-stopped beam freezes finished hypotheses at 0
+    added log-prob — so only compare against beams run WITHOUT
+    eos_id."""
     toks = np.asarray(toks)
     B, total = toks.shape
     lp = np.zeros(B)

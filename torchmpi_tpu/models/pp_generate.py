@@ -28,7 +28,13 @@ the HLO (the weak-#6 rule: schedules scan, never unroll).
 
 Same parameter layout as :func:`.tp_generate.init_tp_lm` (per-block
 ln1/ln2, wq/wk/wv/wo, w1/w2 + embed/ln_f/head) — one checkpoint tree
-serves dense, TP and PP decode.  Sampling semantics (greedy /
+serves dense, TP and PP decode.  Beam search is deliberately NOT
+offered on PP: the beam parent-gather would have to reindex cache rows
+for a micro-group whose K beam rows live at different pipeline depths
+mid-flight, serializing the round-robin schedule to one group per S
+ticks — at which point TP beam (:func:`.tp_generate.tp_beam_search`,
+local-gather reindex, no schedule coupling) strictly dominates; use it
+when beams are needed on a sharded model.  Sampling semantics (greedy /
 temperature / top-k / top-p via ``generate._filter_logits``, EOS
 freeze) mirror ``_generate_scan``.  The reference has no serving at all
 (SURVEY.md §1); beyond-reference surface on the §6.7 mesh guarantee.

@@ -32,7 +32,8 @@ import numpy as np
 from jax import lax
 
 from ..parallel import tensor as tp
-from .generate import _check_sampling, _sample
+from .generate import _beam_backtrack, _beam_expand, _check_sampling, \
+    _sample
 from .transformer import apply_rope
 
 
@@ -240,8 +241,6 @@ def _tp_beam_body(params, prompt, *, axis, num_heads, steps, K, eos_id,
     batch-dim gather on every device — beam rows are replicated, only
     heads are sharded — so TP adds no collective beyond the per-token
     psum/all_gather the greedy path already pays."""
-    from .generate import _beam_backtrack, _beam_expand
-
     B, Tp = prompt.shape
     t_max = Tp + steps
     x = params["embed"][prompt]
