@@ -62,6 +62,7 @@ def test_two_process_world():
         assert f"CHECK rank={i} broadcast ok" in out, out
         assert f"CHECK rank={i} zero ok" in out, out
         assert f"CHECK rank={i} zero3 ok" in out, out
+        assert f"CHECK rank={i} tp-serving ok" in out, out
 
 
 @pytest.mark.slow
