@@ -21,3 +21,10 @@ from .generate import (  # noqa: F401
     generate,
     generate_parallel,
 )
+from .tp_generate import (  # noqa: F401
+    init_tp_lm,
+    shard_tp_lm,
+    tp_beam_search,
+    tp_generate,
+)
+from .pp_generate import pp_generate, shard_pp_lm  # noqa: F401
