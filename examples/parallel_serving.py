@@ -34,8 +34,10 @@ def main():
     import numpy as np
 
     import torchmpi_tpu as mpi
-    from torchmpi_tpu.models import pp_generate as ppg
-    from torchmpi_tpu.models import tp_generate as tpg
+    from torchmpi_tpu.models.pp_generate import pp_generate
+    from torchmpi_tpu.models.tp_generate import (init_tp_lm,
+                                                 tp_beam_search,
+                                                 tp_generate)
 
     mesh = mpi.init()
     axis = tuple(mesh.axis_names)
@@ -44,8 +46,8 @@ def main():
     # One parameter tree, depth divisible by the stage count.
     n_dev = mesh.devices.size
     depth = n_dev
-    params = tpg.init_tp_lm(jax.random.PRNGKey(args.seed), vocab=V,
-                            embed=32, depth=depth, num_heads=8)
+    params = init_tp_lm(jax.random.PRNGKey(args.seed), vocab=V,
+                        embed=32, depth=depth, num_heads=8)
     prompt = np.random.RandomState(args.seed + 1).randint(
         0, V, size=(B, 4)).astype(np.int32)
 
@@ -59,9 +61,9 @@ def main():
 
     toks = dense_greedy(params, prompt, steps, num_heads=8)
 
-    tp_toks = np.asarray(tpg.tp_generate(
+    tp_toks = np.asarray(tp_generate(
         params, prompt, steps, mesh=mesh, axis=axis, num_heads=8))
-    pp_toks = np.asarray(ppg.pp_generate(
+    pp_toks = np.asarray(pp_generate(
         params, prompt, steps, mesh=mesh, axis=axis, num_heads=8))
 
     assert (tp_toks == toks).all(), (
@@ -71,10 +73,10 @@ def main():
 
     # EOS: freeze on a token the dense decode actually emits.
     eos = int(toks[0, prompt.shape[1]])
-    tp_eos = np.asarray(tpg.tp_generate(
+    tp_eos = np.asarray(tp_generate(
         params, prompt, steps, mesh=mesh, axis=axis, num_heads=8,
         eos_id=eos))
-    pp_eos = np.asarray(ppg.pp_generate(
+    pp_eos = np.asarray(pp_generate(
         params, prompt, steps, mesh=mesh, axis=axis, num_heads=8,
         eos_id=eos))
     assert (tp_eos == pp_eos).all(), "TP vs PP EOS divergence"
@@ -82,11 +84,11 @@ def main():
         "row 0 should freeze at its first emitted token")
 
     # Beam decode on the TP stack: beams=1 must reduce to greedy.
-    beam1 = np.asarray(tpg.tp_beam_search(
+    beam1 = np.asarray(tp_beam_search(
         params, prompt, steps, mesh=mesh, axis=axis, num_heads=8,
         beams=1))
     assert (beam1 == toks).all(), "TP beam(1) diverged from greedy"
-    beam3 = np.asarray(tpg.tp_beam_search(
+    beam3 = np.asarray(tp_beam_search(
         params, prompt, steps, mesh=mesh, axis=axis, num_heads=8,
         beams=3, length_penalty=0.6))
     assert beam3.shape == toks.shape

@@ -129,12 +129,12 @@ print(f"CHECK rank={pid} zero3 ok", flush=True)
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 from _tp_oracle import dense_greedy, setup  # noqa: E402
 
-from torchmpi_tpu.models import tp_generate as tpg  # noqa: E402
+from torchmpi_tpu.models.tp_generate import tp_generate  # noqa: E402
 
 tp_params, tp_prompt = setup(seed=21, vocab=32, embed=16, depth=2,
                              num_heads=4, B=2, Tp=3)
 tp_expect = dense_greedy(tp_params, tp_prompt, 3, num_heads=4)
-tp_got = np.asarray(tpg.tp_generate(
+tp_got = np.asarray(tp_generate(
     tp_params, tp_prompt, 3, mesh=mesh,
     axis=tuple(mesh.axis_names), num_heads=4))
 np.testing.assert_array_equal(tp_got, tp_expect)

@@ -6,7 +6,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from torchmpi_tpu.models import tp_generate as tpg
+from torchmpi_tpu.models.tp_generate import init_tp_lm
 from torchmpi_tpu.models.transformer import apply_rope
 
 
@@ -75,8 +75,8 @@ def seq_logprob(params, toks, num_heads, prompt_len):
 
 
 def setup(seed=0, vocab=64, embed=32, depth=2, num_heads=8, B=2, Tp=4):
-    params = tpg.init_tp_lm(jax.random.PRNGKey(seed), vocab=vocab,
-                            embed=embed, depth=depth, num_heads=num_heads)
+    params = init_tp_lm(jax.random.PRNGKey(seed), vocab=vocab,
+                        embed=embed, depth=depth, num_heads=num_heads)
     prompt = np.random.RandomState(seed + 1).randint(
         0, vocab, size=(B, Tp)).astype(np.int32)
     return params, prompt

@@ -9,7 +9,7 @@ import pytest
 
 import torchmpi_tpu as mpi
 from _tp_oracle import dense_greedy, setup
-from torchmpi_tpu.models import pp_generate as ppg
+from torchmpi_tpu.models.pp_generate import pp_generate
 
 AXIS = ("dcn", "ici")  # 8 stages on the flat 1x8 world mesh
 
@@ -20,8 +20,8 @@ def test_pp_generate_matches_dense_greedy(flat_runtime):
     params, prompt = setup(depth=8, B=8)
     steps = 5
     expect = dense_greedy(params, prompt, steps, num_heads=8)
-    got = ppg.pp_generate(params, prompt, steps, mesh=mesh, axis=AXIS,
-                          num_heads=8)
+    got = pp_generate(params, prompt, steps, mesh=mesh, axis=AXIS,
+                      num_heads=8)
     np.testing.assert_array_equal(np.asarray(got), expect)
 
 
@@ -30,8 +30,8 @@ def test_pp_generate_multirow_groups(flat_runtime):
     mesh = mpi.world_mesh()
     params, prompt = setup(seed=2, depth=8, B=16)
     expect = dense_greedy(params, prompt, 3, num_heads=8)
-    got = ppg.pp_generate(params, prompt, 3, mesh=mesh, axis=AXIS,
-                          num_heads=8)
+    got = pp_generate(params, prompt, 3, mesh=mesh, axis=AXIS,
+                      num_heads=8)
     np.testing.assert_array_equal(np.asarray(got), expect)
 
 
@@ -41,8 +41,8 @@ def test_pp_generate_over_ici_with_dcn(hier_runtime):
     mesh = mpi.world_mesh()
     params, prompt = setup(seed=3, depth=8, B=4)
     expect = dense_greedy(params, prompt, 4, num_heads=8)
-    got = ppg.pp_generate(params, prompt, 4, mesh=mesh, axis="ici",
-                          num_heads=8)
+    got = pp_generate(params, prompt, 4, mesh=mesh, axis="ici",
+                      num_heads=8)
     np.testing.assert_array_equal(np.asarray(got), expect)
 
 
@@ -52,8 +52,8 @@ def test_pp_generate_eos_freeze(flat_runtime):
     free = dense_greedy(params, prompt, 6, num_heads=8)
     eos = int(free[0, prompt.shape[1] + 1])
     expect = dense_greedy(params, prompt, 6, num_heads=8, eos_id=eos)
-    got = ppg.pp_generate(params, prompt, 6, mesh=mesh, axis=AXIS,
-                          num_heads=8, eos_id=eos)
+    got = pp_generate(params, prompt, 6, mesh=mesh, axis=AXIS,
+                      num_heads=8, eos_id=eos)
     np.testing.assert_array_equal(np.asarray(got), expect)
     tail = np.asarray(got)[0, prompt.shape[1] + 2:]
     np.testing.assert_array_equal(tail, np.full_like(tail, eos))
@@ -74,8 +74,8 @@ def test_pp_generate_eos_predicted_during_prefill(flat_runtime):
     pred = int(np.asarray(jnp.argmax(dense_forward(
         params, jnp.asarray(prompt[:, :2]), 8), axis=-1))[0])
     expect = dense_greedy(params, prompt, 4, num_heads=8, eos_id=pred)
-    got = ppg.pp_generate(params, prompt, 4, mesh=mesh, axis=AXIS,
-                          num_heads=8, eos_id=pred)
+    got = pp_generate(params, prompt, 4, mesh=mesh, axis=AXIS,
+                      num_heads=8, eos_id=pred)
     np.testing.assert_array_equal(np.asarray(got), expect)
 
 
@@ -84,8 +84,8 @@ def test_pp_generate_sampling_valid(flat_runtime):
     params, prompt = setup(seed=7, depth=8, B=8)
     kw = dict(mesh=mesh, axis=AXIS, num_heads=8, temperature=1.0,
               top_k=5, rng=jax.random.PRNGKey(9))
-    a = np.asarray(ppg.pp_generate(params, prompt, 4, **kw))
-    b = np.asarray(ppg.pp_generate(params, prompt, 4, **kw))
+    a = np.asarray(pp_generate(params, prompt, 4, **kw))
+    b = np.asarray(pp_generate(params, prompt, 4, **kw))
     np.testing.assert_array_equal(a, b)
     assert a.shape == (prompt.shape[0], prompt.shape[1] + 4)
     np.testing.assert_array_equal(a[:, :prompt.shape[1]], prompt)
@@ -96,9 +96,9 @@ def test_pp_generate_shape_errors(flat_runtime):
     mesh = mpi.world_mesh()
     params, prompt = setup(depth=8, B=8)
     with pytest.raises(ValueError, match="divide"):
-        ppg.pp_generate(params, prompt[:6], 2, mesh=mesh, axis=AXIS,
-                        num_heads=8)
+        pp_generate(params, prompt[:6], 2, mesh=mesh, axis=AXIS,
+                    num_heads=8)
     bad, _ = setup(depth=6, B=8)
     with pytest.raises(ValueError, match="divide"):
-        ppg.pp_generate(bad, prompt, 2, mesh=mesh, axis=AXIS,
-                        num_heads=8)
+        pp_generate(bad, prompt, 2, mesh=mesh, axis=AXIS,
+                    num_heads=8)

@@ -9,7 +9,8 @@ import pytest
 
 import torchmpi_tpu as mpi
 from _tp_oracle import dense_greedy, setup
-from torchmpi_tpu.models import tp_generate as tpg
+from torchmpi_tpu.models.tp_generate import (tp_beam_search,
+                                             tp_generate)
 
 AXIS = ("dcn", "ici")
 
@@ -19,8 +20,8 @@ def test_tp_generate_matches_dense_greedy(flat_runtime):
     params, prompt = setup()
     steps = 6
     expect = dense_greedy(params, prompt, steps, num_heads=8)
-    got = tpg.tp_generate(params, prompt, steps, mesh=mesh, axis=AXIS,
-                          num_heads=8)
+    got = tp_generate(params, prompt, steps, mesh=mesh, axis=AXIS,
+                      num_heads=8)
     np.testing.assert_array_equal(np.asarray(got), expect)
 
 
@@ -30,8 +31,8 @@ def test_tp_generate_over_ici_with_dcn(hier_runtime):
     mesh = mpi.world_mesh()
     params, prompt = setup(seed=3)
     expect = dense_greedy(params, prompt, 4, num_heads=8)
-    got = tpg.tp_generate(params, prompt, 4, mesh=mesh, axis="ici",
-                          num_heads=8)
+    got = tp_generate(params, prompt, 4, mesh=mesh, axis="ici",
+                      num_heads=8)
     np.testing.assert_array_equal(np.asarray(got), expect)
 
 
@@ -44,8 +45,8 @@ def test_tp_generate_eos_freeze(flat_runtime):
     free = dense_greedy(params, prompt, 6, num_heads=8)
     eos = int(free[0, prompt.shape[1] + 1])  # row 0's 2nd generated token
     expect = dense_greedy(params, prompt, 6, num_heads=8, eos_id=eos)
-    got = tpg.tp_generate(params, prompt, 6, mesh=mesh, axis=AXIS,
-                          num_heads=8, eos_id=eos)
+    got = tp_generate(params, prompt, 6, mesh=mesh, axis=AXIS,
+                      num_heads=8, eos_id=eos)
     np.testing.assert_array_equal(np.asarray(got), expect)
     tail = np.asarray(got)[0, prompt.shape[1] + 2:]
     np.testing.assert_array_equal(tail, np.full_like(tail, eos))
@@ -58,8 +59,8 @@ def test_tp_generate_sampling_valid(flat_runtime):
     params, prompt = setup(seed=7)
     kw = dict(mesh=mesh, axis=AXIS, num_heads=8, temperature=1.0,
               top_k=5, rng=jax.random.PRNGKey(9))
-    a = np.asarray(tpg.tp_generate(params, prompt, 5, **kw))
-    b = np.asarray(tpg.tp_generate(params, prompt, 5, **kw))
+    a = np.asarray(tp_generate(params, prompt, 5, **kw))
+    b = np.asarray(tp_generate(params, prompt, 5, **kw))
     np.testing.assert_array_equal(a, b)
     assert a.shape == (prompt.shape[0], prompt.shape[1] + 5)
     np.testing.assert_array_equal(a[:, :prompt.shape[1]], prompt)
@@ -69,11 +70,11 @@ def test_tp_generate_sampling_valid(flat_runtime):
 def test_tp_beam_beams1_equals_greedy(flat_runtime):
     mesh = mpi.world_mesh()
     params, prompt = _oracle_setup_small()
-    greedy = np.asarray(tpg.tp_generate(params, prompt, 4, mesh=mesh,
-                                        axis=AXIS, num_heads=8))
-    beam1 = np.asarray(tpg.tp_beam_search(params, prompt, 4, mesh=mesh,
-                                          axis=AXIS, num_heads=8,
-                                          beams=1))
+    greedy = np.asarray(tp_generate(params, prompt, 4, mesh=mesh,
+                                    axis=AXIS, num_heads=8))
+    beam1 = np.asarray(tp_beam_search(params, prompt, 4, mesh=mesh,
+                                      axis=AXIS, num_heads=8,
+                                      beams=1))
     np.testing.assert_array_equal(beam1, greedy)
 
 
@@ -86,8 +87,8 @@ def test_tp_beam_exhaustive_at_steps2(flat_runtime):
     mesh = mpi.world_mesh()
     params, prompt = _oracle_setup_small()
     V = 16
-    got = np.asarray(tpg.tp_beam_search(params, prompt, 2, mesh=mesh,
-                                        axis=AXIS, num_heads=8, beams=V))
+    got = np.asarray(tp_beam_search(params, prompt, 2, mesh=mesh,
+                                    axis=AXIS, num_heads=8, beams=V))
     B = prompt.shape[0]
     best_lp = np.full(B, -np.inf)
     for t1 in range(V):
@@ -109,12 +110,12 @@ def test_tp_beam_eos_pads_tail(flat_runtime):
     asserted unconditionally."""
     mesh = mpi.world_mesh()
     params, prompt = _oracle_setup_small(seed=9)
-    greedy = np.asarray(tpg.tp_generate(params, prompt, 1, mesh=mesh,
-                                        axis=AXIS, num_heads=8))
+    greedy = np.asarray(tp_generate(params, prompt, 1, mesh=mesh,
+                                    axis=AXIS, num_heads=8))
     eos = int(greedy[0, prompt.shape[1]])  # row 0's ARGMAX first token
-    got = np.asarray(tpg.tp_beam_search(params, prompt, 5, mesh=mesh,
-                                        axis=AXIS, num_heads=8, beams=3,
-                                        eos_id=eos))
+    got = np.asarray(tp_beam_search(params, prompt, 5, mesh=mesh,
+                                    axis=AXIS, num_heads=8, beams=3,
+                                    eos_id=eos))
     row = got[0, prompt.shape[1]:]
     np.testing.assert_array_equal(row, np.full_like(row, eos))
 
@@ -123,8 +124,8 @@ def test_tp_beam_too_many_beams(flat_runtime):
     mesh = mpi.world_mesh()
     params, prompt = _oracle_setup_small()
     with pytest.raises(ValueError, match="exceeds vocab"):
-        tpg.tp_beam_search(params, prompt, 2, mesh=mesh, axis=AXIS,
-                           num_heads=8, beams=17)
+        tp_beam_search(params, prompt, 2, mesh=mesh, axis=AXIS,
+                       num_heads=8, beams=17)
 
 
 def _oracle_setup_small(seed=13):
@@ -136,13 +137,13 @@ def test_tp_generate_bad_prompt(flat_runtime):
     mesh = mpi.world_mesh()
     params, _ = setup()
     with pytest.raises(ValueError, match=r"\[batch, time\]"):
-        tpg.tp_generate(params, np.array([1, 2, 3], np.int32), 2,
-                        mesh=mesh, axis=AXIS, num_heads=8)
+        tp_generate(params, np.array([1, 2, 3], np.int32), 2,
+                    mesh=mesh, axis=AXIS, num_heads=8)
 
 
 def test_tp_generate_bad_heads(flat_runtime):
     mesh = mpi.world_mesh()
     params, prompt = setup(num_heads=8)
     with pytest.raises(ValueError, match="divide"):
-        tpg.tp_generate(params, prompt, 2, mesh=mesh, axis=AXIS,
-                        num_heads=6)
+        tp_generate(params, prompt, 2, mesh=mesh, axis=AXIS,
+                    num_heads=6)
