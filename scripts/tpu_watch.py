@@ -183,7 +183,11 @@ def bank():
 
     at_log = os.path.join(ART, f"autotune_{stamp}.log")
     rc, tail = run_bounded(
-        [sys.executable, "benchmarks/autotune.py", "--quick"], 1200, at_log)
+        # 2400 s, not 1200: under full-suite CPU contention the quick
+        # sweep legitimately exceeds 20 min, and the 10:54 2026-07-31
+        # SIGTERM of a contention-slowed autotune mid-device-work
+        # immediately preceded a relay wedge — give it room to finish.
+        [sys.executable, "benchmarks/autotune.py", "--quick"], 2400, at_log)
     rec_line = next((ln.strip() for ln in reversed(tail)
                      if '"recommend"' in ln), None)
     if rec_line:
