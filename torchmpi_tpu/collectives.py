@@ -409,6 +409,94 @@ def _mesh_and_n(mesh: Optional[Mesh]) -> Tuple[Mesh, int]:
     return m, int(m.devices.size)
 
 
+_NP_REDUCERS = {
+    "sum": lambda a: a.sum(axis=0),
+    "mean": lambda a: a.mean(axis=0),
+    "max": lambda a: a.max(axis=0),
+    "min": lambda a: a.min(axis=0),
+}
+
+
+def _host_staged(op_name: str, xs: np.ndarray, n: int, **params):
+    """Host-staged eager collectives (reference:
+    ``torchmpi_set_staged_collectives`` — GPU tensors staged through
+    pinned host buffers when MPI was not CUDA-aware, SURVEY.md §6.6 and
+    §3 C5).  The TPU analog: the rank-major buffers round-trip through
+    host memory and the reduction/routing runs on the host CPU; the
+    direct path keeps everything on the device fabric.  Semantics match
+    the direct implementations op-for-op (tests assert staged == direct
+    across the full op sweep)."""
+    root = params.get("root", 0)
+    if op_name in ("allreduce", "reduce"):
+        op = params.get("op", "sum")
+        # Match the direct path's dtype promotion: lax.pmean on integer
+        # inputs yields float32; every other reduction keeps the input
+        # dtype (code review r5 — staged == direct is op-for-op
+        # INCLUDING dtype).
+        rdt = (np.dtype(np.float32)
+               if op == "mean" and not np.issubdtype(xs.dtype, np.inexact)
+               else xs.dtype)
+        red = _NP_REDUCERS[op](xs).astype(rdt)
+        if op_name == "allreduce":
+            return np.broadcast_to(red[None], (n,) + red.shape)
+        out = xs.astype(rdt).copy()
+        out[root] = red
+        return out
+    if op_name == "broadcast":
+        return np.broadcast_to(xs[root][None], xs.shape)
+    if op_name == "allgather":
+        return np.broadcast_to(xs[None], (n,) + xs.shape)
+    if op_name == "gather":
+        # Non-root outputs are zeros, matching the direct path's defined
+        # analog of MPI's untouched non-root buffers.
+        out = np.zeros((n,) + xs.shape, xs.dtype)
+        out[root] = xs
+        return out
+    if op_name == "scatter":
+        if xs.shape[1] % n != 0:
+            raise ValueError(
+                f"scatter needs leading dim divisible by group size: "
+                f"{xs.shape[1]} % {n}")
+        return np.stack(np.split(xs[root], n, axis=0))
+    if op_name == "reduce_scatter":
+        assert params.get("op", "sum") == "sum", \
+            "reduce_scatter supports sum"
+        s = xs.sum(axis=0).astype(xs.dtype)
+        return np.stack(np.split(s, n, axis=0))
+    if op_name == "sendreceive":
+        out = xs.copy()
+        out[params.get("dst", 1)] = xs[params.get("src", 0)]
+        return out
+    if op_name == "alltoall":
+        sa = params.get("split_axis", 0)
+        ca = params.get("concat_axis", 0)
+        # pieces[p][j] = rank j's p-th split piece; rank i's output is
+        # every rank's piece i, concatenated (tiled all_to_all).
+        pieces = np.split(xs, n, axis=sa + 1)
+        return np.stack([
+            np.concatenate([pieces[i][j] for j in range(n)], axis=ca)
+            for i in range(n)])
+    raise ValueError(f"host-staged path does not implement {op_name!r}")
+
+
+def _place_rank_major(x, m: Mesh):
+    """Place a host rank-major array onto the mesh, slice i on device i."""
+    sharding = NamedSharding(m, P(m.axis_names))
+    if jax.process_count() > 1:
+        # Multi-host: device_put of a host array onto a global sharding is
+        # not allowed; every process passes the identical full rank-major
+        # array (SPMD-consistent, TorchMPI's per-rank tensors stacked), and
+        # each process contributes its addressable shards.
+        flat_devices = list(m.devices.flat)
+        shards = []
+        for i, d in enumerate(flat_devices):
+            if d.process_index == jax.process_index():
+                shards.append(jax.device_put(x[i:i + 1], d))
+        return jax.make_array_from_single_device_arrays(x.shape, sharding,
+                                                        shards)
+    return jax.device_put(x, sharding)
+
+
 def _eager_collective(op_name: str, x, *, mesh: Optional[Mesh] = None,
                       backend: Optional[str] = None, **params):
     m, n = _mesh_and_n(mesh)
@@ -418,6 +506,14 @@ def _eager_collective(op_name: str, x, *, mesh: Optional[Mesh] = None,
             f"{op_name}: leading (rank) axis must have length {n} "
             f"(the current communicator size); got shape {x.shape}"
         )
+    # Staged mode (config.staged / backend="host"): devices -> host ->
+    # compute -> devices, the reference's staged data path.  An explicit
+    # non-host backend argument still forces the direct path, mirroring
+    # how per-call selector choices overrode the global staged flag.
+    if backend == "host" or (backend is None
+                             and runtime.effective_config().staged):
+        out = _host_staged(op_name, np.asarray(x), n, **params)
+        return _place_rank_major(np.ascontiguousarray(out), m)
     axes = m.axis_names
     # Resolve the implementation *before* the cache lookup: the key must
     # include the resolved impl, or runtime set_config() backend switches
@@ -443,22 +539,7 @@ def _eager_collective(op_name: str, x, *, mesh: Optional[Mesh] = None,
                              out_specs=out_spec, check_vma=False)
         fn = jax.jit(shmapped)
         _jit_cache[key] = fn
-    sharding = NamedSharding(m, P(m.axis_names))
-    if jax.process_count() > 1:
-        # Multi-host: device_put of a host array onto a global sharding is
-        # not allowed; every process passes the identical full rank-major
-        # array (SPMD-consistent, TorchMPI's per-rank tensors stacked), and
-        # each process contributes its addressable shards.
-        flat_devices = list(m.devices.flat)
-        shards = []
-        for i, d in enumerate(flat_devices):
-            if d.process_index == jax.process_index():
-                shards.append(jax.device_put(x[i:i + 1], d))
-        x = jax.make_array_from_single_device_arrays(x.shape, sharding,
-                                                     shards)
-    else:
-        x = jax.device_put(x, sharding)
-    return fn(x)
+    return fn(_place_rank_major(x, m))
 
 
 def allreduce(x, *, op: str = "sum", mesh: Optional[Mesh] = None,

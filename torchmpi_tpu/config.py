@@ -95,6 +95,17 @@ class Config:
     # Bidirectional pallas ring allreduce: halves rotate in opposite
     # directions concurrently (2x bandwidth bound on full-duplex ICI).
     pallas_bidirectional: bool = False
+    # Staged vs direct collectives (reference: torchmpi_set_staged/
+    # direct_collectives — GPU tensors staged through pinned host buffers
+    # when MPI was not CUDA-aware, SURVEY.md §6.6/§3 C5).  TPU mapping:
+    # when True, the EAGER tensor verbs round-trip through host memory
+    # and reduce on the host CPU (devices -> host -> devices), the same
+    # data path the reference's staged mode took.  In-axis collectives
+    # (inside jit/shard_map) are always direct — the device fabric is
+    # "CUDA-aware" by construction — so direct is the default and staged
+    # exists for debugging/bring-up, exactly the reference's fallback
+    # role.  Env: TORCHMPI_TPU_STAGED.
+    staged: bool = False
 
     # --- pallas kernel tilings ---------------------------------------------
     # Default block sizes for the flash-attention and fused linear+xent
@@ -154,6 +165,7 @@ class Config:
             hierarchical=_env_bool("TORCHMPI_TPU_HIERARCHICAL", False),
             chunk_bytes=_env_int("TORCHMPI_TPU_CHUNK_BYTES", 4 * 1024 * 1024),
             custom_min_bytes=_env_int("TORCHMPI_TPU_CUSTOM_MIN_BYTES", 64 * 1024),
+            staged=_env_bool("TORCHMPI_TPU_STAGED", False),
             gradsync_buckets=_env_int("TORCHMPI_TPU_GRADSYNC_BUCKETS", 1),
             gradsync_barrier=_env_bool("TORCHMPI_TPU_GRADSYNC_BARRIER",
                                        False),
