@@ -79,6 +79,38 @@ def test_pp_generate_eos_predicted_during_prefill(flat_runtime):
     np.testing.assert_array_equal(np.asarray(got), expect)
 
 
+def test_pp_generate_bf16_tree_matches_dense(flat_runtime):
+    """ADVICE r4: a bf16 checkpoint must run bf16 on PP (caches + embed
+    activation in the checkpoint dtype, not hardcoded fp32) and still be
+    token-exact against the dense oracle evaluated on the same bf16
+    tree."""
+    import jax.numpy as jnp
+
+    mesh = mpi.world_mesh()
+    params, prompt = setup(seed=13, depth=8, B=8)
+    bf16 = jax.tree.map(
+        lambda a: a.astype(jnp.bfloat16)
+        if jnp.issubdtype(a.dtype, jnp.floating) else a, params)
+    expect = dense_greedy(bf16, prompt, 4, num_heads=8)
+    got = pp_generate(bf16, prompt, 4, mesh=mesh, axis=AXIS, num_heads=8)
+    np.testing.assert_array_equal(np.asarray(got), expect)
+
+
+def test_pp_generate_mixed_dtype_tree(flat_runtime):
+    """A tree with bf16 embed but fp32 blocks must still run (code
+    review r5): caches follow the PROMOTED compute dtype, not the embed
+    dtype alone."""
+    import jax.numpy as jnp
+
+    mesh = mpi.world_mesh()
+    params, prompt = setup(seed=17, depth=8, B=8)
+    mixed = dict(params)
+    mixed["embed"] = params["embed"].astype(jnp.bfloat16)
+    expect = dense_greedy(mixed, prompt, 3, num_heads=8)
+    got = pp_generate(mixed, prompt, 3, mesh=mesh, axis=AXIS, num_heads=8)
+    np.testing.assert_array_equal(np.asarray(got), expect)
+
+
 def test_pp_generate_sampling_valid(flat_runtime):
     mesh = mpi.world_mesh()
     params, prompt = setup(seed=7, depth=8, B=8)

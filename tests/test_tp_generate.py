@@ -147,3 +147,19 @@ def test_tp_generate_bad_heads(flat_runtime):
     with pytest.raises(ValueError, match="divide"):
         tp_generate(params, prompt, 2, mesh=mesh, axis=AXIS,
                     num_heads=6)
+
+
+def test_clear_serving_caches(flat_runtime):
+    # ADVICE r4: the unbounded compiled-executable caches must be
+    # releasable by long-lived servers between shape regimes.
+    import sys
+
+    import torchmpi_tpu.models.tp_generate  # noqa: F401 — module import
+    tpg = sys.modules["torchmpi_tpu.models.tp_generate"]
+
+    mesh = mpi.world_mesh()
+    params, prompt = setup()
+    tp_generate(params, prompt, 2, mesh=mesh, axis=AXIS, num_heads=8)
+    assert tpg._tp_fn.cache_info().currsize >= 1
+    tpg.clear_serving_caches()
+    assert tpg._tp_fn.cache_info().currsize == 0

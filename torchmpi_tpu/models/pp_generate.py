@@ -36,8 +36,13 @@ ticks — at which point TP beam (:func:`.tp_generate.tp_beam_search`,
 local-gather reindex, no schedule coupling) strictly dominates; use it
 when beams are needed on a sharded model.  Sampling semantics (greedy /
 temperature / top-k / top-p via ``generate._filter_logits``, EOS
-freeze) mirror ``_generate_scan``.  The reference has no serving at all
-(SURVEY.md §1); beyond-reference surface on the §6.7 mesh guarantee.
+freeze) mirror ``_generate_scan`` — but note that only GREEDY
+(temperature=0) output is token-identical across dense/TP/PP: at
+temperature>0 this schedule draws from a ``fold_in(rng, group, k)``
+stream while the dense/TP paths split one key sequentially, so sampled
+streams are deterministic per path, not shared across paths (ADVICE
+r4).  The reference has no serving at all (SURVEY.md §1);
+beyond-reference surface on the §6.7 mesh guarantee.
 """
 
 from __future__ import annotations
@@ -134,17 +139,25 @@ def _pp_generate_body(blocks_local, aux, prompt, temperature, rng, *,
     # KV caches: one (k, v) pair per LOCAL layer, allocated over the
     # FULL batch so any micro-group can slice its own rows (cache
     # memory still 1/S per device: only this stage's layers live here).
+    # Allocated in the COMPUTE dtype, like tp_generate's prefill-built
+    # caches (ADVICE r4): a bf16 tree must run bf16 on PP too — both for
+    # the dense == TP == PP guarantee and for the cache footprint.  The
+    # compute dtype is the embed/weight promotion (a mixed tree, e.g.
+    # bf16 embed + fp32 blocks, promotes activations at the first
+    # matmul, and the cache rows hold those promoted k/v).
     H = num_heads
     dh = blocks_local["wq"].shape[-1] // H
+    cdtype = jnp.result_type(aux["embed"].dtype,
+                             blocks_local["wq"].dtype)
     caches = [
-        (jnp.zeros((B, t_max, H, dh), jnp.float32),
-         jnp.zeros((B, t_max, H, dh), jnp.float32))
+        (jnp.zeros((B, t_max, H, dh), cdtype),
+         jnp.zeros((B, t_max, H, dh), cdtype))
         for _ in range(layers_per_stage)
     ]
 
     outbuf = jnp.zeros((B, steps), prompt.dtype)
     done = jnp.zeros((B,), bool)
-    x0 = jnp.zeros((Bg, D), jnp.float32)
+    x0 = jnp.zeros((Bg, D), cdtype)
     tok0 = jnp.zeros((Bg,), prompt.dtype)
 
     n_ticks = S * (Tp + steps)
@@ -161,8 +174,7 @@ def _pp_generate_body(blocks_local, aux, prompt, temperature, rng, *,
         prom_g = lax.dynamic_slice(prompt, (rows, jnp.clip(k, 0, Tp - 1)),
                                    (Bg, 1))[:, 0]
         tok = jnp.where(k < Tp, prom_g, tok_in)
-        x = jnp.where(is_first, aux["embed"][tok].astype(jnp.float32),
-                      x_in)
+        x = jnp.where(is_first, aux["embed"][tok].astype(cdtype), x_in)
 
         new_caches = []
         for li in range(layers_per_stage):

@@ -336,7 +336,14 @@ def tp_beam_search(params, prompt, steps: int, *, mesh, axis,
 def _tp_fn(mesh, axis, num_heads, steps, depth, top_k, top_p, eos_id):
     """Build (once per static config — jit itself respecializes per
     prompt shape) the jitted shard_map decode fn; same caching idiom as
-    ``generate._parallel_fn``."""
+    ``generate._parallel_fn``.
+
+    Unbounded by design (ADVICE r4, consistency-accepted): each distinct
+    (mesh, steps, sampling) tuple retains its compiled executable and
+    mesh reference forever.  A long-lived server that varies ``steps``
+    freely should quantize it to buckets (e.g. round up to a multiple of
+    64 and truncate the output) or call :func:`clear_serving_caches`
+    between shape regimes."""
     from jax.sharding import PartitionSpec as P
 
     body = partial(_tp_generate_body, axis=axis, num_heads=num_heads,
@@ -344,6 +351,30 @@ def _tp_fn(mesh, axis, num_heads, steps, depth, top_k, top_p, eos_id):
     return jax.jit(jax.shard_map(
         body, mesh=mesh, in_specs=(_tp_specs(depth, axis), P(), P(), P()),
         out_specs=P(), check_vma=False))
+
+
+def clear_serving_caches():
+    """Drop every cached compiled serving executable across the serving
+    modules (``_tp_fn``/``_tp_beam_fn`` here, ``pp_generate._pp_fn``,
+    ``generate._parallel_fn``/``_beam_parallel_fn``).  The factory
+    caches are keyed on (mesh, steps, sampling config, ...) and
+    unbounded (see :func:`_tp_fn`); long-lived servers that cycle
+    through many step counts or sampling configs can call this between
+    shape regimes to release executables and mesh references."""
+    import importlib
+
+    # Module-path imports: the package re-exports same-named FUNCTIONS
+    # (`models.generate` is the function), so `from . import generate`
+    # would bind the function, not the module (the round-4 shadowing
+    # class).
+    _g = importlib.import_module(__package__ + ".generate")
+    _pp = importlib.import_module(__package__ + ".pp_generate")
+
+    _tp_fn.cache_clear()
+    _tp_beam_fn.cache_clear()
+    _pp._pp_fn.cache_clear()
+    _g._parallel_fn.cache_clear()
+    _g._beam_parallel_fn.cache_clear()
 
 
 def tp_generate(params, prompt, steps: int, *, mesh, axis,
