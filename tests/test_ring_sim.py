@@ -1,0 +1,196 @@
+"""Depth-faithful validation of the chunked ring schedule (VERDICT r4
+#4): the pure-numpy simulator executes ring._chunked_pipeline's exact
+slot/ack protocol at PRODUCTION depth — beyond the pallas interpreter's
+28-iteration cap — asserting numerics, absence of slot-reuse and
+source-mutation hazards under randomized/adversarial interleavings, and
+that the hazard detectors really fire when the ack protocol is removed.
+
+Plan values (sub_elems, C) for the production-shape cases come from the
+real planner (ring._chunk_plan) at ResNet-50 gradient size with the real
+config chunk_bytes; the simulated per-subchunk width is shrunk (the
+protocol depends only on (n, C, steps), not payload width — see
+ring_sim module docstring).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from torchmpi_tpu.ops import ring
+from torchmpi_tpu.ops.ring_sim import (DeadlockError, HazardError,
+                                       simulate_all_gather,
+                                       simulate_allreduce,
+                                       simulate_reduce_scatter)
+
+RESNET50_PARAMS = 25_557_032  # f32 gradient elements
+
+
+def _x(n, C, sub, seed=0, dtype=np.int64):
+    # Integer payloads make ring-order vs numpy-order sums exactly equal.
+    return np.random.RandomState(seed).randint(
+        -1000, 1000, size=(n, n, C, sub)).astype(dtype)
+
+
+def test_production_plan_exceeds_interpret_cap():
+    # The default-config plan at ResNet-50 size is deeper than anything
+    # the interpreter ever executed: that gap is what this suite closes.
+    sub, C = ring._chunk_plan(RESNET50_PARAMS, 8, jnp.float32,
+                              4 * 1024 * 1024)
+    assert C > 1
+    assert 2 * (8 - 1) * C > ring._INTERPRET_MAX_ITERS
+
+
+def test_allreduce_at_resnet50_default_plan():
+    # n=8, the real default chunk_bytes plan.
+    sub, C = ring._chunk_plan(RESNET50_PARAMS, 8, jnp.float32,
+                              4 * 1024 * 1024)
+    x = _x(8, C, 16)
+    out = simulate_allreduce(x, C, rng=np.random.RandomState(1))
+    want = x.sum(axis=0)
+    for w in out:
+        np.testing.assert_array_equal(w, want)
+
+
+def test_allreduce_depth_50_plus():
+    # The done-criterion: C >= 50 where the interpret cap was 28 TOTAL
+    # iterations.  Real planner at ResNet-50 size with chunk_bytes=128K.
+    sub, C = ring._chunk_plan(RESNET50_PARAMS, 8, jnp.float32, 128 * 1024)
+    assert C >= 50, C
+    x = _x(8, C, 8, seed=2)
+    out = simulate_allreduce(x, C, rng=np.random.RandomState(3))
+    want = x.sum(axis=0)
+    for w in out:
+        np.testing.assert_array_equal(w, want)
+
+
+def test_allreduce_32_devices_production_plan():
+    sub, C = ring._chunk_plan(RESNET50_PARAMS, 32, jnp.float32,
+                              256 * 1024)
+    assert C > 1
+    x = _x(32, C, 4, seed=4)
+    out = simulate_allreduce(x, C, rng=np.random.RandomState(5))
+    want = x.sum(axis=0)
+    for w in out:
+        np.testing.assert_array_equal(w, want)
+
+
+@pytest.mark.parametrize("n", [2, 3, 4, 8])
+@pytest.mark.parametrize("C", [2, 3, 7])
+def test_allreduce_property_grid(n, C):
+    # Ack-protocol property sweep over (n, C) with multiple random
+    # interleavings per cell: numerics exact, no hazard, acks drained.
+    for seed in range(3):
+        x = _x(n, C, 4, seed=seed)
+        out = simulate_allreduce(x, C,
+                                 rng=np.random.RandomState(100 + seed))
+        want = x.sum(axis=0)
+        for w in out:
+            np.testing.assert_array_equal(w, want)
+
+
+def test_allreduce_ccw_direction():
+    # The bidirectional kernel's second half runs the same protocol with
+    # sign=-1 (send-left); the simulator must validate that direction too.
+    x = _x(8, 5, 4, seed=6)
+    out = simulate_allreduce(x, 5, sign=-1, rng=np.random.RandomState(7))
+    want = x.sum(axis=0)
+    for w in out:
+        np.testing.assert_array_equal(w, want)
+
+
+def test_allreduce_float32_values():
+    # One float case: per-element the ring's reduction order is
+    # deterministic (chunk d accumulates in ring order), so repeated runs
+    # agree with themselves and with the oracle to fp tolerance.
+    x = np.random.RandomState(8).randn(8, 8, 9, 4).astype(np.float32)
+    out = simulate_allreduce(x, 9, rng=np.random.RandomState(9))
+    want = x.sum(axis=0)
+    for w in out:
+        np.testing.assert_allclose(w, want, rtol=2e-6, atol=2e-6)
+
+
+@pytest.mark.parametrize("n,C", [(4, 3), (8, 13), (8, 60)])
+def test_reduce_scatter_chunked(n, C):
+    x = _x(n, C, 4, seed=10 + n + C)
+    got = simulate_reduce_scatter(x, C,
+                                  rng=np.random.RandomState(11))
+    want = x.sum(axis=0)  # [n, C, sub]; row d = chunk d
+    np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize("n,C", [(4, 3), (8, 13), (8, 60)])
+def test_all_gather_chunked(n, C):
+    chunks = np.random.RandomState(20 + n).randint(
+        -99, 99, size=(n, C, 4)).astype(np.int64)
+    out = simulate_all_gather(chunks, C,
+                              rng=np.random.RandomState(21))
+    for w in out:
+        np.testing.assert_array_equal(w, chunks)
+
+
+def test_protocol_survives_adversarial_starvation():
+    # With acks ON, refusing to schedule one device until it is the only
+    # runnable one must still complete with exact numerics (flow control
+    # bounds every neighbor's lead at the double-buffer depth).
+    x = _x(8, 10, 4, seed=30)
+    out = simulate_allreduce(x, 10, scheduler="greedy", starve=1)
+    want = x.sum(axis=0)
+    for w in out:
+        np.testing.assert_array_equal(w, want)
+
+
+def test_missing_acks_trips_slot_overwrite():
+    # Mutation test: remove the ack waits and starve one device — the
+    # slot-overwrite detector must fire (proving the detector works and
+    # the ack protocol is load-bearing, not decorative).
+    x = _x(8, 10, 4, seed=31)
+    with pytest.raises(HazardError, match="slot overwrite"):
+        simulate_allreduce(x, 10, scheduler="greedy", starve=1,
+                           use_acks=False)
+
+
+def test_missing_acks_random_schedules_eventually_trip():
+    # Under random scheduling the mutated protocol must also be caught
+    # (not only under the hand-built adversary): across seeds at this
+    # depth at least one interleaving overruns a slot.
+    x = _x(8, 20, 2, seed=32)
+    tripped = 0
+    for seed in range(5):
+        try:
+            simulate_allreduce(x, 20, rng=np.random.RandomState(seed),
+                               use_acks=False)
+        except HazardError:
+            tripped += 1
+    assert tripped > 0
+
+
+def test_deadlock_detector_reports_state():
+    # A schedule that cannot finish (acks enabled but one device's
+    # program replaced by silence) must raise DeadlockError, not hang.
+    from torchmpi_tpu.ops import ring_sim
+
+    x = _x(4, 3, 2, seed=33)
+    orig = ring_sim._device_program
+    made = []
+
+    def broken(K, use_acks):
+        gen = orig(K, use_acks)
+        if made:
+            return gen
+        made.append(1)
+
+        def one_event():
+            # The FIRST device emits one rdma_start then falls silent:
+            # its right neighbor eventually blocks on a delivery that
+            # never comes, and the stall propagates around the ring.
+            yield next(gen)
+
+        return one_event()
+
+    ring_sim._device_program = broken
+    try:
+        with pytest.raises(DeadlockError):
+            simulate_allreduce(x, 3, scheduler="greedy")
+    finally:
+        ring_sim._device_program = orig

@@ -34,6 +34,11 @@ Flow-control protocol per step (slot = step % 2):
 Registered with the selector as backend ``"pallas"`` for allreduce.  Tested
 in Pallas TPU interpret mode on the CPU mesh (with ``detect_races=True`` —
 the race-detection story, SURVEY.md §6.2) and runnable on real ICI unchanged.
+The interpreter caps ring iterations (``_INTERPRET_MAX_ITERS``), so the
+production-depth slot/ack protocol is additionally executed at FULL depth —
+ResNet-50-gradient plans, C >= 50, adversarial interleavings, mutation
+tests — by the pure-numpy schedule simulator in :mod:`.ring_sim`
+(tests/test_ring_sim.py).
 """
 
 from __future__ import annotations

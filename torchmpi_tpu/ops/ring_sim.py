@@ -1,0 +1,256 @@
+"""Depth-faithful schedule simulator for the chunked ring kernels.
+
+VERDICT r4 #4: the pallas TPU interpreter caps total ring iterations at
+``ring._INTERPRET_MAX_ITERS`` (28) on single-core hosts, so the
+production-depth double-buffer + ack protocol — the part of
+:mod:`.ring` most like the reference's pipelined chunk loop (SURVEY.md
+§4.2) — had only ever been validated by AOT lowering, never by an
+executed schedule.  This module executes the EXACT slot/ack protocol of
+:func:`.ring._chunked_pipeline` in pure numpy: one state machine per
+device running the same iteration sequence as the kernel (issue ->
+pipelined next-issue -> wait -> combine/copy -> writeback -> ack), with
+no interpreter threads and no iteration cap, driven by an arbitrary
+scheduler (randomized or adversarial interleavings).
+
+The simulator is STRICTER than hardware in three ways:
+
+- **slot-overwrite hazard**: an RDMA delivery into a comm slot whose
+  previous payload the receiver has not consumed yet raises
+  :class:`HazardError`.  Delivery is modeled at RDMA *start* — the
+  earliest point real hardware could write — so any interleaving the
+  protocol permits that COULD corrupt under some link timing is caught,
+  not just ones that happen to corrupt under one timing.
+- **source-mutation hazard**: a writeback into the HBM region an
+  in-flight outgoing RDMA is still reading raises :class:`HazardError`
+  (the pipelined ``issue(k+1)``-before-``writeback(k)`` overlap is safe
+  only because their regions are provably disjoint — this check proves
+  it on every executed schedule instead of by argument).
+- **deadlock**: a state where no device can advance raises
+  :class:`DeadlockError` with each device's progress and blocked event.
+
+Numerics are asserted by the tests against closed-form numpy reductions.
+The per-subchunk payload width does not enter the protocol (indices,
+slots, and acks depend only on ``(n, C, steps)``), so tests may shrink
+``sub_elems`` to keep production-depth ``C`` cheap while taking the real
+``(sub_elems, C)`` plan from :func:`.ring._chunk_plan` for the
+plan-parity assertions.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+
+class HazardError(AssertionError):
+    """A data race the flow-control protocol is supposed to prevent."""
+
+
+class DeadlockError(AssertionError):
+    """No device can advance; carries the stuck per-device state."""
+
+
+def step_indices_allreduce(my: int, n: int, s: int, sign: int = 1):
+    """Pure-python mirror of :func:`.ring._step_indices` (same formulas,
+    ``lax.rem`` replaced by ``%``): reduce-scatter phase for
+    ``s < n - 1``, all-gather phase after."""
+    if s < n - 1:
+        return (my - sign * s) % n, (my - sign * (s + 1)) % n
+    t = s - (n - 1)
+    return (my + sign * (1 - t)) % n, (my - sign * t) % n
+
+
+def step_indices_rs(my: int, n: int, s: int):
+    """Mirror of :func:`.ring._rs_step_indices` (the shifted RS schedule
+    under which each device finishes owning its own chunk index)."""
+    return (my - s - 1) % n, (my - s - 2) % n
+
+
+def _device_program(K: int, use_acks: bool):
+    """The event sequence of one device in ring._chunked_pipeline,
+    expressed as the generator of blocking/effectful events the
+    scheduler interprets.  Mirrors the kernel line for line: issue(0);
+    then for each k: issue(k+1) BEFORE waiting k (the software
+    pipeline), wait k, combine+writeback, ack; finally drain."""
+
+    def issue(k):
+        if use_acks and k >= 2:
+            yield ("ack_wait", 1)
+        yield ("rdma_start", k)
+
+    yield from issue(0)
+    for k in range(K):
+        if k + 1 < K:
+            yield from issue(k + 1)
+        yield ("rdma_wait", k)
+        yield ("writeback", k)
+        yield ("signal_ack",)
+    if use_acks:
+        yield ("ack_wait", min(2, K))
+
+
+def simulate(work0: List[np.ndarray], C: int, steps: int,
+             step_indices: Callable[[int, int], Tuple[int, int]],
+             reduce_at: Callable[[int], bool], *, sign: int = 1,
+             scheduler: str = "random",
+             rng: Optional[np.random.RandomState] = None,
+             use_acks: bool = True,
+             starve: Optional[int] = None) -> List[np.ndarray]:
+    """Run the chunked-ring schedule to completion and return the final
+    per-device work buffers.
+
+    ``work0[d]`` is device d's HBM working buffer ``[n, C, sub]``
+    (mutated in place on a copy); ``step_indices(d, s)`` maps a device
+    and ring step to its (send_idx, recv_idx) chunk pair; ``sign``
+    selects the neighbor direction (+1 send-right as the cw kernels do,
+    -1 the ccw half of the bidirectional kernel).  ``scheduler``:
+    "random" picks uniformly among runnable devices per event (pass
+    ``rng``), "greedy" always advances the lowest-index runnable device.
+    ``starve=d`` refuses to schedule device d while any other device is
+    runnable (the adversarial interleaving that makes a missing-ack
+    protocol fail fast).  ``use_acks=False`` runs the MUTATED protocol
+    with the ack waits removed — used by tests to prove the hazard
+    detectors actually fire."""
+    n = len(work0)
+    K = steps * C
+    work = [w.copy() for w in work0]
+    rng = rng or np.random.RandomState(0)
+
+    right = [(d + sign) % n for d in range(n)]
+    left = [(d - sign) % n for d in range(n)]
+
+    ack = [0] * n
+    # comm slot state per device: pending iteration (None = free/consumed)
+    # and the payload itself.
+    comm_pending: List[List[Optional[int]]] = [[None, None]
+                                               for _ in range(n)]
+    comm_data = [[None, None] for _ in range(n)]
+    delivered = [set() for _ in range(n)]   # iterations arrived at d
+    inflight_out = [dict() for _ in range(n)]  # k -> (send_idx, c)
+
+    progs = [_device_program(K, use_acks) for _ in range(n)]
+    current = [next(p) for p in progs]
+    done = [False] * n
+
+    def runnable(d):
+        ev = current[d]
+        if ev[0] == "ack_wait":
+            return ack[d] >= ev[1]
+        if ev[0] == "rdma_wait":
+            return ev[1] in delivered[d]
+        return True  # rdma_start / writeback / signal_ack are immediate
+
+    def execute(d):
+        ev = current[d]
+        kind = ev[0]
+        if kind == "ack_wait":
+            ack[d] -= ev[1]
+        elif kind == "rdma_start":
+            k = ev[1]
+            s, c = divmod(k, C)
+            send_idx, _ = step_indices(d, s)
+            slot = k % 2
+            tgt = right[d]
+            if comm_pending[tgt][slot] is not None:
+                raise HazardError(
+                    f"slot overwrite: device {d} iteration {k} delivers "
+                    f"into device {tgt} comm[{slot}] while its iteration "
+                    f"{comm_pending[tgt][slot]} payload is unconsumed "
+                    f"(n={n}, C={C}, steps={steps})")
+            comm_data[tgt][slot] = work[d][send_idx, c].copy()
+            comm_pending[tgt][slot] = k
+            delivered[tgt].add(k)
+            inflight_out[d][k] = (send_idx, c)
+        elif kind == "rdma_wait":
+            # Send side of the same descriptor: the DMA read of our
+            # source region is complete once wait() returns.
+            inflight_out[d].pop(ev[1], None)
+        elif kind == "writeback":
+            k = ev[1]
+            s, c = divmod(k, C)
+            _, recv_idx = step_indices(d, s)
+            slot = k % 2
+            for k2, (si, ci) in inflight_out[d].items():
+                if (si, ci) == (recv_idx, c):
+                    raise HazardError(
+                        f"source mutation: device {d} iteration {k} "
+                        f"writes work[{recv_idx},{c}] while its "
+                        f"iteration {k2} RDMA still reads it")
+            val = comm_data[d][slot]
+            if reduce_at(s):
+                work[d][recv_idx, c] = work[d][recv_idx, c] + val
+            else:
+                work[d][recv_idx, c] = val
+            comm_pending[d][slot] = None  # slot free for the next round
+        elif kind == "signal_ack":
+            ack[left[d]] += 1
+        else:  # pragma: no cover - defensive
+            raise ValueError(f"unknown event {ev!r}")
+        try:
+            current[d] = next(progs[d])
+        except StopIteration:
+            done[d] = True
+
+    while not all(done):
+        ready = [d for d in range(n) if not done[d] and runnable(d)]
+        if starve is not None:
+            others = [d for d in ready if d != starve]
+            if others:
+                ready = others
+        if not ready:
+            state = {d: ("done" if done[d] else current[d])
+                     for d in range(n)}
+            raise DeadlockError(
+                f"no runnable device (n={n}, C={C}, steps={steps}, "
+                f"acks={use_acks}): {state}; ack counts {ack}")
+        if scheduler == "greedy":
+            d = ready[0]
+        else:
+            d = ready[int(rng.randint(len(ready)))]
+        execute(d)
+
+    if use_acks and any(a != 0 for a in ack):
+        raise HazardError(
+            f"semaphores not drained at exit: ack counts {ack} "
+            f"(kernel contract: every device leaves its ack at zero)")
+    return work
+
+
+def simulate_allreduce(x: np.ndarray, C: int, **kw) -> List[np.ndarray]:
+    """Chunked ring allreduce at depth C.  ``x``: [n, n, C, sub] —
+    device d's initial buffer is ``x[d]``.  Returns the n final
+    buffers (each should equal ``x.sum(0)``)."""
+    n = x.shape[0]
+    sign = kw.get("sign", 1)
+    return simulate(
+        [x[d] for d in range(n)], C, 2 * (n - 1),
+        lambda d, s: step_indices_allreduce(d, n, s, sign),
+        lambda s: s < n - 1, **kw)
+
+
+def simulate_reduce_scatter(x: np.ndarray, C: int, **kw) -> np.ndarray:
+    """Chunked RS phase: returns [n, C, sub] where row d is device d's
+    owned reduced chunk (work[d][d] after the shifted schedule)."""
+    n = x.shape[0]
+    out = simulate(
+        [x[d] for d in range(n)], C, n - 1,
+        lambda d, s: step_indices_rs(d, n, s),
+        lambda s: True, **kw)
+    return np.stack([out[d][d] for d in range(n)])
+
+
+def simulate_all_gather(chunks: np.ndarray, C: int, **kw) -> List[np.ndarray]:
+    """Chunked AG phase: ``chunks`` [n, C, sub] (device d's local
+    chunk); device d's work starts as zeros except work[d] = chunks[d].
+    Every final buffer should equal ``chunks``."""
+    n = chunks.shape[0]
+    work0 = []
+    for d in range(n):
+        w = np.zeros((n,) + chunks.shape[1:], chunks.dtype)
+        w[d] = chunks[d]
+        work0.append(w)
+    return simulate(
+        work0, C, n - 1,
+        lambda d, t: step_indices_allreduce(d, n, t, 1),
+        lambda t: False, **kw)
