@@ -51,6 +51,32 @@ def main():
                   f"send {nbytes/send_dt/1e9:6.2f} GB/s  "
                   f"recv {nbytes/recv_dt/1e9:6.2f} GB/s  "
                   f"pipelined-send {nbytes/pipe_dt/1e9:6.2f} GB/s")
+            # Server-loop cycle-cost decomposition (VERDICT r4 #8): the
+            # measured split behind the loopback numbers — syscall
+            # (recv+send) vs memcpy/rule-apply vs mutex contention.
+            # The scaling model (docs/ROUND3_NOTES.md) rests on these
+            # constants: apply_ns/byte is the per-core shard-work floor,
+            # recv/send the TCP stack share that a real NIC replaces.
+            st = ps.stats()
+            busy = st["recv_s"] + st["lock_wait_s"] + st["apply_s"] \
+                + st["send_s"]
+            if busy > 0 and st["ops"] > 0:
+                def pct(x):
+                    return f"{100.0 * x / busy:5.1f}%"
+
+                # Bytes the apply bucket actually touched: send payloads
+                # in + receive payloads out (bytes_out minus the 1-byte
+                # status per op) — receives run their memcpy in `apply`
+                # too (code review r5).
+                apply_bytes = st["bytes_in"] + st["bytes_out"] - st["ops"]
+                print(f"{'':>12s}   server-loop decomposition over "
+                      f"{st['ops']} ops ({busy*1e3:.1f} ms busy): "
+                      f"recv {pct(st['recv_s'])}  "
+                      f"lock-wait {pct(st['lock_wait_s'])}  "
+                      f"apply {pct(st['apply_s'])}  "
+                      f"send {pct(st['send_s'])}  | "
+                      f"apply {st['apply_s']*1e9/max(1,apply_bytes):.2f}"
+                      f" ns/B")
         finally:
             ps.shutdown()
 

@@ -74,6 +74,13 @@ struct __attribute__((packed)) Header {
   uint64_t count;   // number of floats
 };
 
+uint64_t now_ns() {
+  return static_cast<uint64_t>(
+      std::chrono::duration_cast<std::chrono::nanoseconds>(
+          std::chrono::steady_clock::now().time_since_epoch())
+          .count());
+}
+
 bool read_exact(int fd, void* buf, size_t n) {
   auto* p = static_cast<char*>(buf);
   while (n > 0) {
@@ -108,6 +115,16 @@ struct Server {
   std::mutex handlers_mu;
   std::atomic<bool> stopping{false};
   std::atomic<uint64_t> ops_served{0};
+  // Cycle-cost decomposition (VERDICT r4 #8): where a served op's time
+  // goes, accumulated in nanoseconds across all handler threads.  The
+  // blocking wait for the NEXT request header is deliberately excluded —
+  // that is idle time between ops, not op cost.  recv = payload read
+  // (syscall share), lock_wait = shard-mutex acquisition (contention
+  // share), apply = rule loop / memcpy under the mutex, send = response
+  // write.  Backs benchmarks/ps_bench.py's loopback breakdown and the
+  // ROUND3_NOTES scaling model with measured constants.
+  std::atomic<uint64_t> recv_ns{0}, lock_wait_ns{0}, apply_ns{0},
+      send_ns{0}, bytes_in{0}, bytes_out{0};
 
   ~Server() { stop(); }
 
@@ -159,10 +176,14 @@ struct Server {
       if (h.count > shard.size() || h.offset > shard.size() - h.count)
         break;  // malformed; drop client
       if (h.op == OP_SEND) {
-        buf.resize(h.count);
+        buf.resize(h.count);  // allocation kept out of every bucket
+        uint64_t t0 = now_ns();
         if (!read_exact(fd, buf.data(), h.count * sizeof(float))) break;
+        uint64_t t1 = now_ns();
+        uint64_t t2;
         {
           std::lock_guard<std::mutex> g(shard_mu);
+          t2 = now_ns();
           float* s = shard.data() + h.offset;
           switch (h.rule) {
             case RULE_COPY:
@@ -188,22 +209,40 @@ struct Server {
               break;
           }
         }
+        uint64_t t3 = now_ns();
         uint8_t ok = 1;
         if (!write_exact(fd, &ok, 1)) break;
         if (h.rule == RULE_ELASTIC &&
             !write_exact(fd, buf.data(), h.count * sizeof(float)))
           break;
+        uint64_t t4 = now_ns();
+        recv_ns.fetch_add(t1 - t0);
+        lock_wait_ns.fetch_add(t2 - t1);
+        apply_ns.fetch_add(t3 - t2);
+        send_ns.fetch_add(t4 - t3);
+        bytes_in.fetch_add(h.count * sizeof(float));
+        bytes_out.fetch_add(
+            1 + (h.rule == RULE_ELASTIC ? h.count * sizeof(float) : 0));
         ops_served.fetch_add(1);
       } else if (h.op == OP_RECEIVE) {
-        buf.resize(h.count);
+        buf.resize(h.count);  // allocation kept out of every bucket
+        uint64_t t0 = now_ns();
+        uint64_t t1;
         {
           std::lock_guard<std::mutex> g(shard_mu);
+          t1 = now_ns();
           std::memcpy(buf.data(), shard.data() + h.offset,
                       h.count * sizeof(float));
         }
+        uint64_t t2 = now_ns();
         uint8_t ok = 1;
         if (!write_exact(fd, &ok, 1)) break;
         if (!write_exact(fd, buf.data(), h.count * sizeof(float))) break;
+        uint64_t t3 = now_ns();
+        lock_wait_ns.fetch_add(t1 - t0);
+        apply_ns.fetch_add(t2 - t1);
+        send_ns.fetch_add(t3 - t2);
+        bytes_out.fetch_add(1 + h.count * sizeof(float));
         ops_served.fetch_add(1);
       } else {
         break;
@@ -388,6 +427,28 @@ uint64_t tm_ps_server_ops(int64_t sid) {
   std::lock_guard<std::mutex> g(g_mu);
   auto it = g_servers.find(sid);
   return it == g_servers.end() ? 0 : it->second->ops_served.load();
+}
+
+// Cycle-cost decomposition (VERDICT r4 #8): fills out[0..n-1] (n >= 7)
+// with {ops_served, bytes_in, bytes_out, recv_ns, lock_wait_ns,
+// apply_ns, send_ns} — cumulative since server start, summed over all
+// handler threads.  Returns the number of fields written, or -1 for an
+// unknown server / too-small buffer.  The idle wait for each next
+// request header is NOT in any bucket (see the Server field comment).
+int tm_ps_server_stats(int64_t sid, uint64_t* out, int n) {
+  if (n < 7) return -1;
+  std::lock_guard<std::mutex> g(g_mu);
+  auto it = g_servers.find(sid);
+  if (it == g_servers.end()) return -1;
+  Server& s = *it->second;
+  out[0] = s.ops_served.load();
+  out[1] = s.bytes_in.load();
+  out[2] = s.bytes_out.load();
+  out[3] = s.recv_ns.load();
+  out[4] = s.lock_wait_ns.load();
+  out[5] = s.apply_ns.load();
+  out[6] = s.send_ns.load();
+  return 7;
 }
 
 void tm_ps_server_destroy(int64_t sid) {

@@ -60,6 +60,9 @@ def _bind(lib: ctypes.CDLL) -> None:
     lib.tm_ps_server_port.argtypes = [ctypes.c_int64]
     lib.tm_ps_server_ops.restype = ctypes.c_uint64
     lib.tm_ps_server_ops.argtypes = [ctypes.c_int64]
+    lib.tm_ps_server_stats.restype = ctypes.c_int
+    lib.tm_ps_server_stats.argtypes = [
+        ctypes.c_int64, ctypes.POINTER(ctypes.c_uint64), ctypes.c_int]
     lib.tm_ps_server_destroy.restype = None
     lib.tm_ps_server_destroy.argtypes = [ctypes.c_int64]
     lib.tm_ps_client_connect.restype = ctypes.c_int64
@@ -207,6 +210,30 @@ class ShardedParameterServer:
     def ops_served(self) -> int:
         return sum(self._lib.tm_ps_server_ops(s) for s in self.server_ids)
 
+    def stats(self) -> dict:
+        """Cycle-cost decomposition of the server loop (VERDICT r4 #8),
+        summed over shards: where a served op's time went, in seconds —
+        ``recv_s`` (payload read syscalls), ``lock_wait_s`` (shard-mutex
+        contention), ``apply_s`` (rule loop / memcpy under the mutex),
+        ``send_s`` (response writes) — plus ``ops``, ``bytes_in``,
+        ``bytes_out``.  The idle wait between requests is in no bucket.
+        Backs benchmarks/ps_bench.py's loopback breakdown and the
+        scaling model in docs/ROUND3_NOTES.md."""
+        tot = np.zeros(7, dtype=np.uint64)
+        buf = (ctypes.c_uint64 * 7)()
+        for sid in self.server_ids:
+            if self._lib.tm_ps_server_stats(sid, buf, 7) == 7:
+                tot += np.ctypeslib.as_array(buf)
+        return {
+            "ops": int(tot[0]),
+            "bytes_in": int(tot[1]),
+            "bytes_out": int(tot[2]),
+            "recv_s": float(tot[3]) / 1e9,
+            "lock_wait_s": float(tot[4]) / 1e9,
+            "apply_s": float(tot[5]) / 1e9,
+            "send_s": float(tot[6]) / 1e9,
+        }
+
     def shutdown(self) -> None:
         for sid in self.server_ids:
             self._lib.tm_ps_server_destroy(sid)
@@ -344,6 +371,11 @@ class ParameterServer:
 
     def ops_served(self) -> int:
         return self.servers.ops_served()
+
+    def stats(self) -> dict:
+        """Server-loop cycle-cost decomposition — see
+        :meth:`ShardedParameterServer.stats`."""
+        return self.servers.stats()
 
     def healthy(self) -> bool:
         """All shard servers reachable (see PSClient.ping)."""
