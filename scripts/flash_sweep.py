@@ -7,6 +7,7 @@ causal-credited TFLOP/s, and max|err| vs the library's dense oracle
 validates the kernel against).
 """
 
+import argparse
 import functools
 import os
 import sys
@@ -24,6 +25,13 @@ from torchmpi_tpu.utils.metrics import timed
 B, T, H, D = 4, 4096, 8, 128
 CONFIGS = [(256, 256), (512, 256), (256, 512), (512, 512),
            (512, 1024), (1024, 512)]
+# --wide (VERDICT r4 #2): candidates beyond the 512x512 plateau — the
+# full-block mask-skip specialization shifts the VPU:MXU balance, so the
+# old optimum must be re-derived, and larger blocks amortize per-block
+# bookkeeping further (VMEM at 1024x1024: q+acc+2x(k,v) ~ 1.6 MiB, well
+# inside scope).
+WIDE_EXTRA = [(1024, 1024), (2048, 512), (512, 2048), (1024, 256),
+              (768, 512), (512, 768), (2048, 1024)]
 # Dependent-chain depth per dispatch: amortizes the relay's ~7 ms
 # per-dispatch floor out of the per-kernel number (VERDICT r3 #4 — the
 # floor otherwise sits in BOTH sides of every flash-vs-dense ratio).
@@ -42,28 +50,27 @@ def chained(attn_fn):
     return _chained(attn_fn, depth=CHAIN)
 
 
-def main():
-    # Operator-run device client (see hw_tune.py): unbounded budget so
-    # the gate blesses the chained kernel jits on the relay.
-    import torchmpi_tpu as mpi
-
-    _budget = mpi.compile_budget()
-    _budget.__enter__()
-    rs = np.random.RandomState(0)
-    q = jnp.asarray(rs.randn(B, T, H, D), jnp.bfloat16)
-    k = jnp.asarray(rs.randn(B, T, H, D), jnp.bfloat16)
-    v = jnp.asarray(rs.randn(B, T, H, D), jnp.bfloat16)
-
-    dj = jax.jit(functools.partial(reference_attention, causal=True))
+def sweep_shape(label, q, k, v, configs, *, window=None):
+    """One (shape, window) sweep: dense oracle once, then each block
+    config with chained floor-honest timing + on-device oracle check."""
+    Bs, Ts, Hs, Ds = q.shape
+    dj = jax.jit(functools.partial(reference_attention, causal=True,
+                                   window=window))
     od = dj(q, k, v)
-    t = bench(chained(functools.partial(reference_attention,
-                                        causal=True)), q, k, v) / CHAIN
-    print(f"dense (reference_attention): {t*1e3:.2f} ms/invocation "
-          f"(chained x{CHAIN})")
+    t = bench(chained(functools.partial(reference_attention, causal=True,
+                                        window=window)), q, k, v) / CHAIN
+    print(f"[{label}] dense: {t*1e3:.2f} ms/invocation (chained x{CHAIN})",
+          flush=True)
 
-    flops = 2 * B * H * T * T * D * 2 * 0.5  # causal-credited
-    for bq, bk in CONFIGS:
-        f1 = functools.partial(flash_attention, causal=True,
+    if window is None:
+        flops = 2 * Bs * Hs * Ts * Ts * Ds * 2 * 0.5  # causal-credited
+    else:
+        avg_ctx = ((window / 2) * window + (Ts - window) * window) / Ts \
+            if Ts > window else Ts / 2
+        flops = 2 * Bs * Hs * Ts * avg_ctx * Ds * 2
+    best = None
+    for bq, bk in configs:
+        f1 = functools.partial(flash_attention, causal=True, window=window,
                                block_q=bq, block_k=bk, interpret=False)
         fj = jax.jit(f1)
         try:
@@ -71,12 +78,50 @@ def main():
             err = float(jnp.max(jnp.abs(of.astype(jnp.float32)
                                         - od.astype(jnp.float32))))
             t = bench(chained(f1), q, k, v) / CHAIN
-            print(f"flash {bq}x{bk}: {t*1e3:.2f} ms/invocation "
-                  f"(chained x{CHAIN})  {flops/t/1e12:.1f} TFLOP/s  "
-                  f"err {err:.4f}")
+            tfl = flops / t / 1e12
+            print(f"[{label}] flash {bq}x{bk}: {t*1e3:.2f} ms/invocation "
+                  f"(chained x{CHAIN})  {tfl:.1f} TFLOP/s  "
+                  f"err {err:.4f}", flush=True)
+            if best is None or tfl > best[2]:
+                best = (bq, bk, tfl)
         except Exception as e:  # noqa: BLE001 — sweep continues
-            print(f"flash {bq}x{bk}: FAIL {type(e).__name__}: "
-                  f"{str(e)[:120]}")
+            print(f"[{label}] flash {bq}x{bk}: FAIL {type(e).__name__}: "
+                  f"{str(e)[:120]}", flush=True)
+    if best:
+        print(f"[{label}] BEST {best[0]}x{best[1]} {best[2]:.1f} TFLOP/s",
+              flush=True)
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--wide", action="store_true",
+                   help="extended candidate blocks + the stage-B' "
+                        "GQA/window shape")
+    args = p.parse_args()
+
+    # Operator-run device client (see hw_tune.py): unbounded budget so
+    # the gate blesses the chained kernel jits on the relay.
+    import torchmpi_tpu as mpi
+
+    _budget = mpi.compile_budget()
+    _budget.__enter__()
+    configs = CONFIGS + (WIDE_EXTRA if args.wide else [])
+    rs = np.random.RandomState(0)
+    q = jnp.asarray(rs.randn(B, T, H, D), jnp.bfloat16)
+    k = jnp.asarray(rs.randn(B, T, H, D), jnp.bfloat16)
+    v = jnp.asarray(rs.randn(B, T, H, D), jnp.bfloat16)
+    sweep_shape(f"mha B{B} T{T} H{H}", q, k, v, configs)
+
+    if args.wide:
+        # The flagship stage-B' attention shape: GQA 16q/4kv, T=2048,
+        # sliding window 1024 — the config whose cost sits inside the
+        # headline MFU (VERDICT r4 #2 done-criterion: B' MFU >= 0.62).
+        B2, T2, H2, HKV2, W2 = 4, 2048, 16, 4, 1024
+        q2 = jnp.asarray(rs.randn(B2, T2, H2, D), jnp.bfloat16)
+        k2 = jnp.asarray(rs.randn(B2, T2, HKV2, D), jnp.bfloat16)
+        v2 = jnp.asarray(rs.randn(B2, T2, HKV2, D), jnp.bfloat16)
+        sweep_shape(f"gqa B{B2} T{T2} H{H2}/{HKV2} w{W2}", q2, k2, v2,
+                    configs, window=W2)
 
 
 if __name__ == "__main__":

@@ -204,6 +204,28 @@ def bank():
         [sys.executable, "benchmarks/overlap_trace.py", "--trace-dir",
          tr_dir], 1200, os.path.join(ART, f"overlap_{stamp}.log"))
     log(f"overlap_trace rc={rc}")
+
+    if not probe(150):
+        log("relay died mid-cycle after overlap trace; skipping profile")
+        return True
+
+    # ResNet-50 step profile (VERDICT r4 #3): the top-time-sink table
+    # behind the headline's MFU — warm-cache compile, ~2 min live.
+    rc, _ = run_bounded(
+        [sys.executable, "scripts/resnet_profile.py"], 1800,
+        os.path.join(ART, f"resnet_profile_{stamp}.log"))
+    log(f"resnet_profile rc={rc}")
+
+    if not probe(150):
+        log("relay died mid-cycle after profile; skipping flash sweep")
+        return True
+
+    # Widened flash autotune sweep (VERDICT r4 #2): candidates beyond
+    # the 512x512 plateau, floor-honest chained timing.
+    rc, _ = run_bounded(
+        [sys.executable, "scripts/flash_sweep.py", "--wide"], 2400,
+        os.path.join(ART, f"flash_sweep_{stamp}.log"))
+    log(f"flash_sweep rc={rc}")
     return True
 
 

@@ -91,6 +91,35 @@ def _block_live(qo_ref, ko_ref, i, j, block_q: int, block_k: int,
     return live
 
 
+def _block_full(qo_ref, ko_ref, i, j, block_q: int, block_k: int,
+                kv_len: int, causal: bool, window: Optional[int] = None):
+    """Scalar predicate: does block (i, j) have NO masked score at all?
+    The complement question to :func:`_block_live` — a block is FULL when
+    every (q row, k col) pair is valid: the k block sits entirely inside
+    the kv length, entirely in the causal past of the block's OLDEST q
+    row (k_last <= q_first), and (sliding window) entirely inside the
+    window of the block's NEWEST q row (q_last - k_first < window).
+
+    Why it exists (VERDICT r4 #2): the per-block VPU work — two iotas,
+    compares, logical-ands and a [block_q, block_k] select — costs more
+    than the block's two MXU matmuls at production shapes, and for
+    causal T=4096 at 512x512 blocks ~78% of live blocks are interior
+    (mask all-true).  Splitting the update into a full path (no mask
+    math) and a partial path keeps numerics bit-identical: on a full
+    block the mask is the identity.  Forward and backward kernels share
+    this ONE predicate so they specialize identically."""
+    k_first = ko_ref[0] + j * block_k
+    k_last = k_first + (block_k - 1)
+    full = k_last < ko_ref[0] + kv_len
+    if causal:
+        q_first = qo_ref[0] + i * block_q
+        full = jnp.logical_and(full, k_last <= q_first)
+        if window is not None:
+            q_last = q_first + (block_q - 1)
+            full = jnp.logical_and(full, q_last - k_first < window)
+    return full
+
+
 def _gqa_group(h: int, h_kv: int) -> int:
     """Query-heads-per-kv-head (grouped-query attention).  1 == MHA;
     kv head for q head ``h`` is ``h // group`` (the jnp.repeat layout)."""
@@ -226,9 +255,10 @@ def _flash_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, o_ref, *rest,
     j = band_j0(i) + jb if band_j0 is not None else jb
     live = _block_live(qo_ref, ko_ref, i, j, block_q, block_k, kv_len,
                        causal, window)
+    full = _block_full(qo_ref, ko_ref, i, j, block_q, block_k, kv_len,
+                       causal, window)
 
-    @pl.when(live)
-    def _update():
+    def _update(masked):
         q = q_ref[0, 0]  # [block_q, D]
         k = k_ref[0, 0]  # [block_k, D]
         v = v_ref[0, 0]
@@ -236,15 +266,19 @@ def _flash_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, o_ref, *rest,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # [bq, bk]
 
-        s = jnp.where(_valid_mask(qo_ref, ko_ref, i, j, block_q, block_k,
-                                  kv_len, causal, window), s, NEG_INF)
+        if masked:
+            s = jnp.where(_valid_mask(qo_ref, ko_ref, i, j, block_q,
+                                      block_k, kv_len, causal, window),
+                          s, NEG_INF)
 
         m_prev = jnp.max(m_ref[:], axis=1, keepdims=True)  # [block_q, 1]
         m_cur = jnp.max(s, axis=1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
         # Fully-masked-so-far rows have m_new == NEG_INF; exponentiate
         # against 0 there so masked scores give p == 0, not
-        # exp(-1e30 + 1e30) == 1.
+        # exp(-1e30 + 1e30) == 1.  (A FULL block always yields finite
+        # m_new, but the rescale must still guard m_prev rows from
+        # earlier fully-masked blocks, so the guard stays in both paths.)
         m_safe = jnp.where(m_new > 0.5 * NEG_INF, m_new, 0.0)
         alpha = jnp.exp(m_prev - m_safe)  # 0 when m_prev is NEG_INF (init)
         p = jnp.exp(s - m_safe)  # masked entries: exp(NEG_INF) == 0
@@ -255,6 +289,16 @@ def _flash_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, o_ref, *rest,
             preferred_element_type=jnp.float32)
         m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
         l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    # Full blocks (the interior majority at production shapes) skip the
+    # iota/compare/select mask math entirely — see _block_full.
+    @pl.when(jnp.logical_and(live, full))
+    def _update_full():
+        _update(masked=False)
+
+    @pl.when(jnp.logical_and(live, jnp.logical_not(full)))
+    def _update_partial():
+        _update(masked=True)
 
     @pl.when(jb == nb - 1)
     def _finalize():
@@ -300,9 +344,10 @@ def _flash_bwd_dq_kernel(qo_ref, ko_ref, q_ref, do_ref, lse_ref, d_ref,
     # unchanged — skip all three matmuls.
     live = _block_live(qo_ref, ko_ref, i, j, block_q, block_k, kv_len,
                        causal, window)
+    full = _block_full(qo_ref, ko_ref, i, j, block_q, block_k, kv_len,
+                       causal, window)
 
-    @pl.when(live)
-    def _update():
+    def _update(masked):
         q = q_ref[0, 0]  # [block_q, D]
         do = do_ref[0, 0]
         k = k_ref[0, 0]  # [block_k, D]
@@ -313,8 +358,10 @@ def _flash_bwd_dq_kernel(qo_ref, ko_ref, q_ref, do_ref, lse_ref, d_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
-        s = jnp.where(_valid_mask(qo_ref, ko_ref, i, j, block_q, block_k,
-                                  kv_len, causal, window), s, NEG_INF)
+        if masked:
+            s = jnp.where(_valid_mask(qo_ref, ko_ref, i, j, block_q,
+                                      block_k, kv_len, causal, window),
+                          s, NEG_INF)
         p = jnp.exp(s - lse)  # masked / fully-masked rows (lse=+1e30): 0
 
         dp = jax.lax.dot_general(
@@ -324,6 +371,14 @@ def _flash_bwd_dq_kernel(qo_ref, ko_ref, q_ref, do_ref, lse_ref, d_ref,
         dq_acc[:] = dq_acc[:] + scale * jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
+
+    @pl.when(jnp.logical_and(live, full))
+    def _update_full():
+        _update(masked=False)
+
+    @pl.when(jnp.logical_and(live, jnp.logical_not(full)))
+    def _update_partial():
+        _update(masked=True)
 
     @pl.when(jb == nb - 1)
     def _finalize():
@@ -354,9 +409,10 @@ def _flash_bwd_dkv_kernel(qo_ref, ko_ref, k_ref, v_ref, q_ref, do_ref,
     # live block are excluded by _valid_mask, not here.)
     live = _block_live(qo_ref, ko_ref, i, j, block_q, block_k, kv_len,
                        causal, window)
+    full = _block_full(qo_ref, ko_ref, i, j, block_q, block_k, kv_len,
+                       causal, window)
 
-    @pl.when(live)
-    def _update():
+    def _update(masked):
         k = k_ref[0, 0]  # [block_k, D]
         v = v_ref[0, 0]
         q = q_ref[0, 0]  # [block_q, D]
@@ -367,8 +423,10 @@ def _flash_bwd_dkv_kernel(qo_ref, ko_ref, k_ref, v_ref, q_ref, do_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
-        s = jnp.where(_valid_mask(qo_ref, ko_ref, i, j, block_q, block_k,
-                                  kv_len, causal, window), s, NEG_INF)
+        if masked:
+            s = jnp.where(_valid_mask(qo_ref, ko_ref, i, j, block_q,
+                                      block_k, kv_len, causal, window),
+                          s, NEG_INF)
         p = jnp.exp(s - lse)  # [block_q, block_k]
 
         dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
@@ -381,6 +439,14 @@ def _flash_bwd_dkv_kernel(qo_ref, ko_ref, k_ref, v_ref, q_ref, do_ref,
         dk_acc[:] = dk_acc[:] + scale * jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
+
+    @pl.when(jnp.logical_and(live, full))
+    def _update_full():
+        _update(masked=False)
+
+    @pl.when(jnp.logical_and(live, jnp.logical_not(full)))
+    def _update_partial():
+        _update(masked=True)
 
     @pl.when(ib == nb - 1)
     def _finalize():
