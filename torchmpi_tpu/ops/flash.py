@@ -52,6 +52,23 @@ _STAT_LANES = 8
 
 
 
+def _flash_params(interpret):
+    """Compiler params for the flash kernels.  Interpret: the device-
+    local barrier skip (ring.local_kernel_params).  Real Mosaic
+    lowering: mark the (batch, head, major-block) grid dims ``parallel``
+    and only the minor accumulation dim ``arbitrary`` — the scratch
+    state carries ONLY across the minor dim (re-initialized at its
+    first step), so declaring the outer dims parallel is sound and lets
+    Mosaic schedule/pipeline across grid steps instead of assuming a
+    serial carried dependency (the jax TPU flash kernels mark their
+    grids the same way)."""
+    if interpret:
+        return ring.local_kernel_params(interpret)
+    return pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "parallel",
+                             "arbitrary"))
+
+
 def _resolve_blocks(block_a, block_b, field_a: str, field_b: str):
     """Config-default tiling resolution — see runtime.resolve_blocks
     (deferred import: ops must stay importable before the runtime)."""
@@ -567,7 +584,7 @@ def flash_attention(q, k, v, *, causal: bool = False,
             pltpu.VMEM((block_q, D), jnp.float32),       # output accum
         ],
         interpret=interpret,
-        compiler_params=ring.local_kernel_params(interpret),
+        compiler_params=_flash_params(interpret),
     )(qo, ko, qt, kt, vt)
     out = result if single else result[0]
     if pad_q:
@@ -665,7 +682,7 @@ def flash_attention_bwd(q, k, v, do, lse, dvec, *, causal: bool,
         out_specs=qb,
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
         interpret=interpret,
-        compiler_params=ring.local_kernel_params(interpret),
+        compiler_params=_flash_params(interpret),
     )(qo, ko, qt, dot_, lse_l, d_l, kt, vt)
 
     # dkv grid puts the q-block dimension minor; index maps swap i and j
@@ -696,7 +713,7 @@ def flash_attention_bwd(q, k, v, do, lse, dvec, *, causal: bool,
         scratch_shapes=[pltpu.VMEM((block_k, D), jnp.float32),
                         pltpu.VMEM((block_k, D), jnp.float32)],
         interpret=interpret,
-        compiler_params=ring.local_kernel_params(interpret),
+        compiler_params=_flash_params(interpret),
     )(qo, ko, kt, vt, qt, dot_, lse_l, d_l)
     if group > 1:
         dk = dk.reshape(B, Hkv, group, Tkvp, D).sum(axis=2)
