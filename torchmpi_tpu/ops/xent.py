@@ -34,7 +34,7 @@ _STAT_LANES = 8
 
 def _xent_fwd_kernel(labels_ref, x_ref, w_ref, loss_ref, lse_ref, m_scr,
                      l_scr, t_scr, *, block_n: int, block_v: int,
-                     vocab: int):
+                     vocab: int, pad_vocab: bool):
     j = pl.program_id(1)
     nv = pl.num_programs(1)
 
@@ -49,7 +49,12 @@ def _xent_fwd_kernel(labels_ref, x_ref, w_ref, loss_ref, lse_ref, m_scr,
         preferred_element_type=jnp.float32)  # [block_n, block_v]
     col = j * block_v + jax.lax.broadcasted_iota(
         jnp.int32, (block_n, block_v), 1)
-    z = jnp.where(col < vocab, z, NEG_INF)  # mask vocab padding
+    if pad_vocab:
+        # Statically skipped when vocab % block_v == 0 (the production
+        # case): no padded w columns exist, so the select is the
+        # identity — one fewer [block_n, block_v] VPU pass per block.
+        # The iota stays either way (the label-hit compare needs col).
+        z = jnp.where(col < vocab, z, NEG_INF)  # mask vocab padding
 
     m_prev = jnp.max(m_scr[:], axis=1, keepdims=True)
     m_new = jnp.maximum(m_prev, jnp.max(z, axis=1, keepdims=True))
@@ -75,7 +80,8 @@ def _xent_fwd_kernel(labels_ref, x_ref, w_ref, loss_ref, lse_ref, m_scr,
 
 
 def _xent_bwd_dx_kernel(labels_ref, x_ref, w_ref, lse_ref, dl_ref, dx_ref,
-                        dx_acc, *, block_n: int, block_v: int, vocab: int):
+                        dx_acc, *, block_n: int, block_v: int,
+                        vocab: int, pad_vocab: bool):
     """dx_i = dloss_i * sum_v (p_iv - y_iv) W_v^T, p recomputed from lse."""
     j = pl.program_id(1)
     nv = pl.num_programs(1)
@@ -89,7 +95,8 @@ def _xent_bwd_dx_kernel(labels_ref, x_ref, w_ref, lse_ref, dl_ref, dx_ref,
         preferred_element_type=jnp.float32)
     col = j * block_v + jax.lax.broadcasted_iota(
         jnp.int32, (block_n, block_v), 1)
-    z = jnp.where(col < vocab, z, NEG_INF)
+    if pad_vocab:  # see _xent_fwd_kernel: identity when unpadded
+        z = jnp.where(col < vocab, z, NEG_INF)
     lse = jnp.max(lse_ref[:], axis=1, keepdims=True)
     p = jnp.exp(z - lse)  # vocab-padding cols give 0
     y = (col == labels_ref[:]).astype(jnp.float32)
@@ -105,7 +112,8 @@ def _xent_bwd_dx_kernel(labels_ref, x_ref, w_ref, lse_ref, dl_ref, dx_ref,
 
 
 def _xent_bwd_dw_kernel(labels_ref, x_ref, w_ref, lse_ref, dl_ref, dw_ref,
-                        dw_acc, *, block_n: int, block_v: int, vocab: int):
+                        dw_acc, *, block_n: int, block_v: int,
+                        vocab: int, pad_vocab: bool):
     """dW_v = sum_i x_i^T (p_iv - y_iv) dloss_i.  Grid (nv, nn): the token
     dimension is minor so the dW accumulator carries across it."""
     i = pl.program_id(1)
@@ -121,7 +129,8 @@ def _xent_bwd_dw_kernel(labels_ref, x_ref, w_ref, lse_ref, dl_ref, dw_ref,
         preferred_element_type=jnp.float32)
     col = j * block_v + jax.lax.broadcasted_iota(
         jnp.int32, (block_n, block_v), 1)
-    z = jnp.where(col < vocab, z, NEG_INF)
+    if pad_vocab:  # see _xent_fwd_kernel: identity when unpadded
+        z = jnp.where(col < vocab, z, NEG_INF)
     lse = jnp.max(lse_ref[:], axis=1, keepdims=True)
     p = jnp.exp(z - lse)
     y = (col == labels_ref[:]).astype(jnp.float32)
@@ -192,11 +201,17 @@ def _fit_blocks(bn: int, bv: int, embed: int, ds: int):
 
 def _kernel_params(interpret):
     """Compiler params for the device-local xent kernels: the interpret
-    barrier skip (ring.local_kernel_params) under interpret, the raised
-    scoped-VMEM limit on real TPU lowering."""
+    barrier skip (ring.local_kernel_params) under interpret; on real
+    TPU lowering the raised scoped-VMEM limit plus grid semantics — all
+    three kernels run 2-D grids whose scratch carries only across the
+    MINOR dim (re-initialized at its first step), so the major dim is
+    parallel and Mosaic may pipeline across it (see
+    flash._flash_params)."""
     if interpret:
         return ring.local_kernel_params(interpret)
-    return pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT)
+    return pltpu.CompilerParams(
+        vmem_limit_bytes=_VMEM_LIMIT,
+        dimension_semantics=("parallel", "arbitrary"))
 
 
 def _fused_xent_fwd(x, w, labels, block_n: int, block_v: int, interpret):
@@ -211,7 +226,8 @@ def _fused_xent_fwd(x, w, labels, block_n: int, block_v: int, interpret):
     Np, Vp = xp.shape[0], wp.shape[1]
     grid = (Np // block_n, Vp // block_v)
     kern = functools.partial(_xent_fwd_kernel, block_n=block_n,
-                             block_v=block_v, vocab=V)
+                             block_v=block_v, vocab=V,
+                             pad_vocab=pad_v > 0)
     loss, lse = pl.pallas_call(
         kern,
         out_shape=(jax.ShapeDtypeStruct((Np, _STAT_LANES), jnp.float32),
@@ -289,7 +305,8 @@ def _xent_vjp(embed: int, block_n: int, block_v: int, interp_key):
 
         nn_, nv_ = Np // bn, Vp // bv
         dx_kern = functools.partial(_xent_bwd_dx_kernel, block_n=bn,
-                                    block_v=bv, vocab=V)
+                                    block_v=bv, vocab=V,
+                                    pad_vocab=pad_v > 0)
         dx = pl.pallas_call(
             dx_kern,
             out_shape=jax.ShapeDtypeStruct((Np, E), jnp.float32),
@@ -308,7 +325,8 @@ def _xent_vjp(embed: int, block_n: int, block_v: int, interp_key):
         )(labp, xp, wp, lse_l, dl_l)
 
         dw_kern = functools.partial(_xent_bwd_dw_kernel, block_n=bn,
-                                    block_v=bv, vocab=V)
+                                    block_v=bv, vocab=V,
+                                    pad_vocab=pad_v > 0)
         dw = pl.pallas_call(
             dw_kern,
             out_shape=jax.ShapeDtypeStruct((E, Vp), jnp.float32),
