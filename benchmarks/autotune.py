@@ -289,9 +289,14 @@ def main():
         qkv = [jnp.asarray(rngf.randn(Bf, Tf, Hf, Df), jnp.bfloat16)
                for _ in range(3)]
         cands = {}
-        grid = ((256, 256), (512, 512)) if args.quick else \
+        # Quick grid includes the beyond-512 candidates (VERDICT r4 #2):
+        # the full-block mask-skip specialization shifted the VPU:MXU
+        # balance, so the 512x512 plateau must be re-derived.
+        grid = ((256, 256), (512, 512), (1024, 512),
+                (512, 1024)) if args.quick else \
             ((128, 128), (256, 256), (512, 256), (256, 512), (512, 512),
-             (512, 1024), (1024, 512))
+             (512, 1024), (1024, 512), (1024, 1024), (2048, 512),
+             (768, 512))
         for bq, bk in grid:
             try:
                 def fwd_bwd(q, k, v, bq=bq, bk=bk):
