@@ -244,6 +244,55 @@ def test_bench_probe_mode():
     assert "ALIVE cpu" in out.stdout
 
 
+@pytest.mark.slow
+def test_scanned_train_step_matches_sequential():
+    # Stage D2's scan wrapper (scanned_train_step, n_carry=3) must
+    # compute the same training math as sequential dispatches of the
+    # same step — bf16 tolerance, since scanned vs sequential are
+    # different compiled programs.
+    import numpy as np
+
+    from torchmpi_tpu.utils.simulation import force_cpu_devices
+
+    force_cpu_devices(4)
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import bench
+    import torchmpi_tpu as mpi
+    from torchmpi_tpu.models import ResNet50
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = mpi.init()
+    model = ResNet50(dtype=jnp.bfloat16)
+    v = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 64, 64, 3)),
+                   train=False)
+    params, bst = v["params"], v["batch_stats"]
+    tx = optax.sgd(0.1, momentum=0.9)
+    opt = tx.init(params)
+    dp_ref = mpi.recipes.make_bn_dp_train_step(model, tx, mesh=mesh,
+                                               donate=False)
+    params, opt, bst = mpi.recipes.replicate_bn_state(params, opt, bst,
+                                                      mesh=mesh)
+    shard = NamedSharding(mesh, P(mesh.axis_names))
+    im = jax.device_put(np.random.RandomState(0).rand(8, 64, 64, 3)
+                        .astype(np.float32), shard)
+    lb = jax.device_put(np.random.RandomState(1).randint(
+        0, 1000, size=8).astype(np.int32), shard)
+
+    multi = jax.jit(bench.scanned_train_step(dp_ref, 2, n_carry=3))
+    p1, o1, b1, _ = dp_ref(params, opt, bst, im, lb)
+    p2, _, _, l2 = dp_ref(p1, o1, b1, im, lb)
+    ps, _, _, ls = multi(params, opt, bst, im, lb)
+    np.testing.assert_allclose(float(ls), float(l2), rtol=5e-3)
+    pa = np.concatenate([np.asarray(x, np.float32).ravel()
+                         for x in jax.tree.leaves(p2)])
+    pb = np.concatenate([np.asarray(x, np.float32).ravel()
+                         for x in jax.tree.leaves(ps)])
+    np.testing.assert_allclose(pa, pb, atol=2e-2)
+
+
 def test_stamp_sort_key_year_boundary():
     # Year-qualified stamps sort after every legacy stamp, and correctly
     # across a year boundary among themselves (ADVICE r3).
