@@ -90,6 +90,7 @@ def sweep_shape(label, q, k, v, configs, *, window=None):
     if best:
         print(f"[{label}] BEST {best[0]}x{best[1]} {best[2]:.1f} TFLOP/s",
               flush=True)
+    return best, flops
 
 
 def main():
@@ -105,14 +106,42 @@ def main():
 
     _budget = mpi.compile_budget()
     _budget.__enter__()
+    # Explicit prescale=False baseline: an exported
+    # TORCHMPI_TPU_FLASH_PRESCALE=1 must not make the "direct" side of
+    # the A/B run prescaled too (code review r5).
+    mpi.init(mpi.Config.from_env(flash_prescale=False))
+
+    def prescale_ab(label, q, k, v, best, flops, window=None):
+        """Re-time the winning block config with Config.flash_prescale
+        on (the scale folded into q; kernel runs scale=1) — the A/B
+        that decides whether to adopt the knob as default."""
+        if not best:
+            return
+        bq, bk, base_tfl = best
+        mpi.set_config(flash_prescale=True)
+        try:
+            f1 = functools.partial(flash_attention, causal=True,
+                                   window=window, block_q=bq, block_k=bk,
+                                   interpret=False)
+            t = bench(chained(f1), q, k, v) / CHAIN
+            tfl = flops / t / 1e12
+            print(f"[{label}] prescale@{bq}x{bk}: {t*1e3:.2f} ms "
+                  f"{tfl:.1f} TFLOP/s (vs {base_tfl:.1f} direct)",
+                  flush=True)
+        finally:
+            mpi.set_config(flash_prescale=False)
+
     configs = CONFIGS + (WIDE_EXTRA if args.wide else [])
     rs = np.random.RandomState(0)
     q = jnp.asarray(rs.randn(B, T, H, D), jnp.bfloat16)
     k = jnp.asarray(rs.randn(B, T, H, D), jnp.bfloat16)
     v = jnp.asarray(rs.randn(B, T, H, D), jnp.bfloat16)
-    sweep_shape(f"mha B{B} T{T} H{H}", q, k, v, configs)
-
+    best, flops = sweep_shape(f"mha B{B} T{T} H{H}", q, k, v, configs)
     if args.wide:
+        # --wide adds the prescale A/B runs and the flagship shape on
+        # top of the extended block candidates.
+        prescale_ab(f"mha B{B} T{T} H{H}", q, k, v, best, flops)
+
         # The flagship stage-B' attention shape: GQA 16q/4kv, T=2048,
         # sliding window 1024 — the config whose cost sits inside the
         # headline MFU (VERDICT r4 #2 done-criterion: B' MFU >= 0.62).
@@ -120,8 +149,10 @@ def main():
         q2 = jnp.asarray(rs.randn(B2, T2, H2, D), jnp.bfloat16)
         k2 = jnp.asarray(rs.randn(B2, T2, HKV2, D), jnp.bfloat16)
         v2 = jnp.asarray(rs.randn(B2, T2, HKV2, D), jnp.bfloat16)
-        sweep_shape(f"gqa B{B2} T{T2} H{H2}/{HKV2} w{W2}", q2, k2, v2,
-                    configs, window=W2)
+        best2, flops2 = sweep_shape(f"gqa B{B2} T{T2} H{H2}/{HKV2} w{W2}",
+                                    q2, k2, v2, configs, window=W2)
+        prescale_ab(f"gqa B{B2} T{T2} H{H2}/{HKV2} w{W2}", q2, k2, v2,
+                    best2, flops2, window=W2)
 
 
 if __name__ == "__main__":

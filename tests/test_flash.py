@@ -204,6 +204,70 @@ def test_flash_grad_matches_reference(flat_runtime, causal):
                                    atol=2e-5)
 
 
+def test_flash_prescale_matches_reference(flat_runtime):
+    """Config.flash_prescale folds the scale into q at the boundary;
+    forward AND gradients must still match the dense oracle (q is
+    rounded to its dtype after scaling, so tolerance is dtype-level,
+    and in f32 the rounding is negligible)."""
+    import jax
+
+    import torchmpi_tpu as mpi
+    from torchmpi_tpu.ops.flash import flash_attention, \
+        flash_attention_grad
+
+    rng = np.random.RandomState(31)
+    q, k, v, w = (jnp.asarray(rng.randn(1, 32, 2, 8), jnp.float32) * 0.5
+                  for _ in range(4))
+    expect_o = np.asarray(reference_attention(q, k, v, causal=True))
+
+    def loss_ref(q, k, v):
+        return (reference_attention(q, k, v, causal=True) * w).sum()
+
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+
+    mpi.stop()
+    mpi.init(mpi.Config(flash_prescale=True))
+    try:
+        assert mpi.config().flash_prescale
+        got_o = np.asarray(flash_attention(q, k, v, causal=True,
+                                           block_q=8, block_k=8))
+        np.testing.assert_allclose(got_o, expect_o, rtol=5e-5, atol=5e-5)
+
+        def loss_flash(q, k, v):
+            return (flash_attention_grad(q, k, v, causal=True, block_q=8,
+                                         block_k=8) * w).sum()
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=5e-5, atol=5e-5)
+        # Window path (static offsets -> baked-closure VJP instance,
+        # the fs/fwd_s/bwd_s wiring): forward AND gradients asserted.
+        expect_w = np.asarray(reference_attention(q, k, v, causal=True,
+                                                  window=16))
+
+        def loss_win(q, k, v):
+            return (flash_attention_grad(q, k, v, causal=True, window=16,
+                                         block_q=8, block_k=8) * w).sum()
+
+        def loss_win_ref(q, k, v):
+            return (reference_attention(q, k, v, causal=True,
+                                        window=16) * w).sum()
+
+        got_w = np.asarray(flash_attention(q, k, v, causal=True,
+                                           window=16, block_q=8,
+                                           block_k=8))
+        np.testing.assert_allclose(got_w, expect_w, rtol=5e-5, atol=5e-5)
+        gw = jax.grad(loss_win, argnums=(0, 1, 2))(q, k, v)
+        gw_ref = jax.grad(loss_win_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gw, gw_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=5e-5, atol=5e-5)
+    finally:
+        mpi.stop()
+        mpi.init()
+
+
 @pytest.mark.parametrize("causal", [False, True])
 def test_ring_flash_grad_matches_dense_ring(flat_runtime, causal):
     """The ring-level custom VJP (backward ring: k/v/dk/dv rotate a full

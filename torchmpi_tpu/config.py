@@ -126,6 +126,18 @@ class Config:
     # too large for the scoped budget.
     xent_block_n: int = 256
     xent_block_v: int = 512
+    # Fold the attention scale into q once at the kernel boundary
+    # (q' = bf16(q * scale), kernels run scale=1) instead of scaling
+    # every [block_q, block_k] score block on the VPU — removes one
+    # full elementwise pass per block (~10% of the kernel's VPU work).
+    # Numerics: q is rounded to its dtype after scaling, so scores move
+    # by ~1 bf16 ulp relative; gradients stay consistent (the VJP
+    # prescales fwd AND bwd recompute identically and rescales dq by
+    # the chain rule).  Off by default pending a measured win on
+    # silicon; the ring/residual paths ignore it (their backward
+    # composes flash_attention_bwd directly at the caller's scale).
+    # Env: TORCHMPI_TPU_FLASH_PRESCALE.
+    flash_prescale: bool = False
 
     # --- gradient synchronization ------------------------------------------
     # Number of buckets for bucketed/overlapped gradient allreduce.
@@ -166,6 +178,7 @@ class Config:
             chunk_bytes=_env_int("TORCHMPI_TPU_CHUNK_BYTES", 4 * 1024 * 1024),
             custom_min_bytes=_env_int("TORCHMPI_TPU_CUSTOM_MIN_BYTES", 64 * 1024),
             staged=_env_bool("TORCHMPI_TPU_STAGED", False),
+            flash_prescale=_env_bool("TORCHMPI_TPU_FLASH_PRESCALE", False),
             gradsync_buckets=_env_int("TORCHMPI_TPU_GRADSYNC_BUCKETS", 1),
             gradsync_barrier=_env_bool("TORCHMPI_TPU_GRADSYNC_BARRIER",
                                        False),

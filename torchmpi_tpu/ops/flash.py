@@ -77,6 +77,20 @@ def _resolve_blocks(block_a, block_b, field_a: str, field_b: str):
     return runtime.resolve_blocks(block_a, block_b, field_a, field_b)
 
 
+def _prescale_enabled() -> bool:
+    """``Config.flash_prescale`` (see config.py): fold the attention
+    scale into q once instead of scaling every score block."""
+    from .. import runtime
+
+    return bool(runtime.effective_config().flash_prescale)
+
+
+def _prescale_q(q, scale):
+    """q' = q * scale in q's dtype — one [B, T, H, D] pass replacing a
+    [block_q, block_k] pass per live block inside the kernel."""
+    return (q.astype(jnp.float32) * scale).astype(q.dtype)
+
+
 def _block_live(qo_ref, ko_ref, i, j, block_q: int, block_k: int,
                 kv_len: int, causal: bool, window: Optional[int] = None):
     """Scalar predicate: does block (i, j) have ANY valid score?  The
@@ -281,7 +295,9 @@ def _flash_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, o_ref, *rest,
         v = v_ref[0, 0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale  # [bq, bk]
+            preferred_element_type=jnp.float32)  # [bq, bk]
+        if scale != 1.0:  # statically elided under Config.flash_prescale
+            s = s * scale
 
         if masked:
             s = jnp.where(_valid_mask(qo_ref, ko_ref, i, j, block_q,
@@ -374,7 +390,9 @@ def _flash_bwd_dq_kernel(qo_ref, ko_ref, q_ref, do_ref, lse_ref, d_ref,
 
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
+            preferred_element_type=jnp.float32)
+        if scale != 1.0:  # statically elided under Config.flash_prescale
+            s = s * scale
         if masked:
             s = jnp.where(_valid_mask(qo_ref, ko_ref, i, j, block_q,
                                       block_k, kv_len, causal, window),
@@ -385,9 +403,10 @@ def _flash_bwd_dq_kernel(qo_ref, ko_ref, q_ref, do_ref, lse_ref, d_ref,
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)  # [block_q, block_k]
         ds = p * (dp - dvec)
-        dq_acc[:] = dq_acc[:] + scale * jax.lax.dot_general(
+        dqk = jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
+        dq_acc[:] = dq_acc[:] + (scale * dqk if scale != 1.0 else dqk)
 
     @pl.when(jnp.logical_and(live, full))
     def _update_full():
@@ -439,7 +458,9 @@ def _flash_bwd_dkv_kernel(qo_ref, ko_ref, k_ref, v_ref, q_ref, do_ref,
 
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
+            preferred_element_type=jnp.float32)
+        if scale != 1.0:  # statically elided under Config.flash_prescale
+            s = s * scale
         if masked:
             s = jnp.where(_valid_mask(qo_ref, ko_ref, i, j, block_q,
                                       block_k, kv_len, causal, window),
@@ -453,9 +474,10 @@ def _flash_bwd_dkv_kernel(qo_ref, ko_ref, k_ref, v_ref, q_ref, do_ref,
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         ds = p * (dp - dvec)
-        dk_acc[:] = dk_acc[:] + scale * jax.lax.dot_general(
+        dkq = jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
+        dk_acc[:] = dk_acc[:] + (scale * dkq if scale != 1.0 else dkq)
 
     @pl.when(jnp.logical_and(live, full))
     def _update_full():
@@ -517,6 +539,14 @@ def flash_attention(q, k, v, *, causal: bool = False,
         scale = 1.0 / (D ** 0.5)
     block_q, block_k = _resolve_blocks(block_q, block_k,
                                       "flash_block_q", "flash_block_k")
+    if not return_residuals and scale != 1.0 and _prescale_enabled():
+        # Plain-forward path of Config.flash_prescale: fold the scale
+        # into q once here; the kernel's scale==1.0 guard then elides
+        # the per-block multiply.  The residual (ring) path is excluded
+        # — its callers compose flash_attention_bwd themselves at the
+        # original scale.
+        q = _prescale_q(q, scale)
+        scale = 1.0
 
     block_q = _clamp_block(block_q, Tq)
     block_k = _clamp_block(block_k, Tkv)
@@ -737,32 +767,49 @@ def _float0_zero(x):
 @functools.lru_cache(maxsize=None)
 def _flash_vjp(causal: bool, scale: float, block_q: int, block_k: int,
                interp_key, window: Optional[int] = None,
-               static_offsets: Optional[tuple] = None):
+               static_offsets: Optional[tuple] = None,
+               prescale: bool = False):
     """custom_vjp instance per static config.  ``interp_key`` is the
     resolved interpret setting (hashable: False or InterpretParams).
 
     ``static_offsets=(qo, ko)`` bakes Python-int offsets into the closure
     instead of passing them as (traced) arguments — required for the
     banded sliding-window grids, whose index maps need static offsets;
-    the instance then takes only (q, k, v)."""
+    the instance then takes only (q, k, v).
 
-    kw = dict(causal=causal, scale=scale, block_q=block_q, block_k=block_k,
+    ``prescale`` (Config.flash_prescale): q is scaled ONCE at the
+    boundary (q' = dtype(q * scale)) and the kernels run scale=1 — the
+    forward, the saved residual, and the backward's s-recompute all see
+    the SAME q', so lse stays consistent by construction; the chain
+    rule puts the scale back on dq (dL/dq = scale * dL/dq')."""
+
+    kw = dict(causal=causal, scale=1.0 if prescale else scale,
+              block_q=block_q, block_k=block_k,
               window=window, interpret=interp_key)
 
+    def _maybe_prescale(q):
+        return _prescale_q(q, scale) if prescale else q
+
     # ONE implementation of the VJP math, parameterized over how offsets
-    # arrive (baked-in static ints vs traced trailing args).
+    # arrive (baked-in static ints vs traced trailing args).  ``q`` here
+    # is ALWAYS the (possibly prescaled) kernel-side q; fwd returns it
+    # so the residual saves exactly what the backward must recompute
+    # against.
     def _fwd_core(q, k, v, qo, ko):
+        q = _maybe_prescale(q)
         num, m, l = flash_attention(q, k, v, q_offset=qo, kv_offset=ko,
                                     return_residuals=True, **kw)
         denom = jnp.where(l > 0, l, 1.0)
         o = (num / jnp.moveaxis(denom, 1, 2)[..., None]).astype(q.dtype)
-        return o, lse_from_residuals(m, l)
+        return o, lse_from_residuals(m, l), q
 
     def _bwd_core(q, k, v, o, lse, do, qo, ko):
         dvec = jnp.einsum("bqhd,bqhd->bhq", do.astype(jnp.float32),
                           o.astype(jnp.float32))
         dq, dk, dv = flash_attention_bwd(q, k, v, do, lse, dvec,
                                          q_offset=qo, kv_offset=ko, **kw)
+        if prescale:
+            dq = dq * scale  # chain rule through q' = scale * q
         return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
     if static_offsets is not None:
@@ -770,31 +817,32 @@ def _flash_vjp(causal: bool, scale: float, block_q: int, block_k: int,
 
         @jax.custom_vjp
         def fs(q, k, v):
-            return flash_attention(q, k, v, q_offset=qo_s, kv_offset=ko_s,
-                                   **kw)
+            return flash_attention(_maybe_prescale(q), k, v,
+                                   q_offset=qo_s, kv_offset=ko_s, **kw)
 
         def fwd_s(q, k, v):
-            o, lse = _fwd_core(q, k, v, qo_s, ko_s)
-            return o, (q, k, v, o, lse)
+            o, lse, q_used = _fwd_core(q, k, v, qo_s, ko_s)
+            return o, (q_used, k, v, o, lse)
 
         def bwd_s(res, do):
-            q, k, v, o, lse = res
-            return _bwd_core(q, k, v, o, lse, do, qo_s, ko_s)
+            q_used, k, v, o, lse = res
+            return _bwd_core(q_used, k, v, o, lse, do, qo_s, ko_s)
 
         fs.defvjp(fwd_s, bwd_s)
         return fs
 
     @jax.custom_vjp
     def f(q, k, v, qo, ko):
-        return flash_attention(q, k, v, q_offset=qo, kv_offset=ko, **kw)
+        return flash_attention(_maybe_prescale(q), k, v, q_offset=qo,
+                               kv_offset=ko, **kw)
 
     def fwd(q, k, v, qo, ko):
-        o, lse = _fwd_core(q, k, v, qo, ko)
-        return o, (q, k, v, qo, ko, o, lse)
+        o, lse, q_used = _fwd_core(q, k, v, qo, ko)
+        return o, (q_used, k, v, qo, ko, o, lse)
 
     def bwd(res, do):
-        q, k, v, qo, ko, o, lse = res
-        return (*_bwd_core(q, k, v, o, lse, do, qo, ko),
+        q_used, k, v, qo, ko, o, lse = res
+        return (*_bwd_core(q_used, k, v, o, lse, do, qo, ko),
                 _float0_zero(qo), _float0_zero(ko))
 
     f.defvjp(fwd, bwd)
@@ -820,6 +868,7 @@ def flash_attention_grad(q, k, v, *, causal: bool = False,
                                       "flash_block_q", "flash_block_k")
     if interpret is None:
         interpret = ring._interpret_mode()
+    prescale = scale != 1.0 and _prescale_enabled()
     if (window is not None and isinstance(q_offset, int)
             and isinstance(kv_offset, int)
             and q_offset == 0 and kv_offset == 0):
@@ -830,9 +879,9 @@ def flash_attention_grad(q, k, v, *, causal: bool = False,
         # int offsets (e.g. per-chunk prefill) would each mint a cache
         # entry + compile; those callers get the traced path instead.
         f = _flash_vjp(causal, float(scale), block_q, block_k, interpret,
-                      window, static_offsets=(0, 0))
+                      window, static_offsets=(0, 0), prescale=prescale)
         return f(q, k, v)
     f = _flash_vjp(causal, float(scale), block_q, block_k, interpret,
-                   window)
+                   window, prescale=prescale)
     return f(q, k, v, jnp.asarray(q_offset, jnp.int32),
              jnp.asarray(kv_offset, jnp.int32))
