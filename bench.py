@@ -123,6 +123,7 @@ BANKED_WANT = {
     # the want tracks the same env knob the child reads.
     "transformer_lm_train_throughput":
         {"devices": 1, "batch": 8, "seq": 512,
+         "embed": int(os.environ.get("TORCHMPI_TPU_BENCH_B_EMBED", "512")),
          "scan_steps_per_dispatch":
              int(os.environ.get("TORCHMPI_TPU_BENCH_B_SCAN", "32"))},
     "flash_attention_tflops": {},
@@ -914,8 +915,17 @@ def main():
             # trains the flagship attention path; CPU runs keep the dense
             # impl (Pallas would drop to the interpreter there).
             attn = "flash" if platform0 == "tpu" else "local"
-            lm = TransformerLM(vocab=8192, embed=64 if tiny else 512,
-                               depth=2 if tiny else 4, num_heads=8,
+            # Embed width knob (VERDICT r4 weak #5): if k=32 still
+            # leaves E=512 at dispatch-floor MFU (<=0.35), a live study
+            # can promote a mid-size LM (E=1024) into this slot without
+            # a code change; BANKED_WANT pins the same env-resolved
+            # width (tiny runs use E=64 but are already excluded from
+            # banking by their batch/seq pins).
+            E_B = 64 if tiny else int(os.environ.get(
+                "TORCHMPI_TPU_BENCH_B_EMBED", "512"))
+            lm = TransformerLM(vocab=8192, embed=E_B,
+                               depth=2 if tiny else 4,
+                               num_heads=8 if tiny else max(1, E_B // 64),
                                head_dim=8 if tiny else 64, max_len=T,
                                dtype=jnp.bfloat16, attn_impl=attn)
             tok = np.random.RandomState(2).randint(
@@ -1018,6 +1028,7 @@ def main():
                 "vs_baseline": vs_prev("transformer_lm_train_throughput",
                                        tok_s_chip, platform0),
                 "extra": {"devices": n_dev, "batch": Bt, "seq": T,
+                          "embed": E_B,
                           "step_ms": round(dt_step * 1000, 2),
                           "scan_steps_per_dispatch": KB,
                           # vs_baseline divides by r3's SINGLE-dispatch
