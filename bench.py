@@ -263,18 +263,20 @@ def _stamp_sort_key(path):
 def _config_matches(rec, want):
     """True when ``rec`` is a metric we would measure THIS run with the
     same configuration: its metric must appear in ``want`` and every
-    expected extra field present in the record must match.  Prevents the
-    fallback from substituting a banked record measured at different
-    shapes (ADVICE r3: e.g. a batch-256 run must not stand in for the
-    batch-128 config this run would have measured)."""
+    expected extra field must match — a record MISSING a required key
+    is a mismatch, not a pass (found live 2026-08-01: a pre-scan-era
+    stage-B record without ``scan_steps_per_dispatch`` slipped past the
+    methodology pin precisely because the old ``if k in extra`` guard
+    skipped absent keys).  Prevents the fallback from substituting a
+    banked record measured at different shapes OR under a different
+    timing methodology (ADVICE r3 / VERDICT r4 #6)."""
     if want is None:
         return True
     expected = want.get(rec.get("metric"))
     if expected is None:
         return False
     extra = rec.get("extra") or {}
-    return all(extra.get(k) == v for k, v in expected.items()
-               if k in extra)
+    return all(extra.get(k) == v for k, v in expected.items())
 
 
 def _is_live_tpu(rec):

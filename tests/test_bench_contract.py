@@ -131,6 +131,16 @@ def test_banked_record_config_matching(tmp_path):
     assert bench.latest_banked_for_metric(
         "resnet50_dp_train_throughput", want={"some_other_metric": {}},
         art_dir=str(tmp_path)) is None
+    # A record MISSING a required config key is a mismatch, not a pass:
+    # pre-methodology records (e.g. stage B without
+    # scan_steps_per_dispatch) must never stand in for a pinned run
+    # (found live 2026-08-01).
+    assert bench.latest_banked_for_metric(
+        "resnet50_dp_train_throughput",
+        want={"resnet50_dp_train_throughput":
+              {"devices": 1, "global_batch": 128, "image": 224,
+               "scan_steps_per_dispatch": 4}},
+        art_dir=str(tmp_path)) is None
 
 
 def test_latest_banked_for_metric_reads_streams(tmp_path):
