@@ -20,7 +20,11 @@ by the MEDIAN; the per-candidate jitter (half the inter-quartile range)
 is printed with every measurement; and a NOISE GATE keeps the
 config-default value unless a challenger beats it by more than the
 combined jitter of the two.  A re-run therefore agrees with itself:
-within-noise knobs stay at their defaults instead of flapping.
+within-noise knobs stay at their defaults instead of flapping.  The
+discipline itself lives in ``torchmpi_tpu.tuning.measure`` (structured
+``TimedResult`` from ``utils/metrics.timed`` + ``noise_gate``) — the
+same library the online ``backend="auto"`` selector uses; this harness
+just drives it over the full knob grid.
 
 Prints one JSON line per measurement plus a final ``recommend`` line that
 can be applied directly::
@@ -31,17 +35,25 @@ can be applied directly::
 The recommend line carries ``evidence`` per knob: chosen vs default
 medians, the delta, and the jitter the delta had to clear.
 
+``--plan-out PATH`` additionally writes the backend sweep into a
+versioned tuning-plan file — one entry per (op, size bucket) at this
+platform/mesh — that
+``mpi.init(Config(backend="auto", tuning_plan_path=PATH))`` replays
+directly, so the offline sweep seeds the online plan DB.  (The plan
+drives selection only where a backend resolves to ``"auto"``; a plan
+path alone loads the file and logs that it is inactive.)
+
 On the CPU-simulated mesh the absolute numbers are meaningless but the
 harness (and its JSON contract) is identical to what runs on a real slice.
 
-Run: ``python benchmarks/autotune.py [--devices 8] [--quick] [--rounds 5]``
+Run: ``python benchmarks/autotune.py [--devices 8] [--quick] [--rounds 5]
+[--plan-out plans.json]``
 """
 
 import argparse
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -49,61 +61,28 @@ ROUNDS = 5  # set from --rounds in main()
 
 
 def _measure(fn, iters, fence):
-    """(median_sec_per_iter, jitter_sec, rounds_sec): ROUNDS fenced
-    timing rounds of ``iters`` dispatches after one warm/compile call.
-    Jitter = half the inter-quartile range — the scale a knob delta must
-    clear to be more than noise."""
-    out = fn()  # compile
-    fence(out)
-    ts = []
-    for _ in range(max(1, ROUNDS)):
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            out = fn()
-        fence(out)
-        ts.append((time.perf_counter() - t0) / iters)
-    s = sorted(ts)
-    n = len(s)
-    med = s[n // 2] if n % 2 else 0.5 * (s[n // 2 - 1] + s[n // 2])
-    jit = 0.5 * (s[(3 * n) // 4] - s[n // 4]) if n >= 4 else \
-        0.5 * (s[-1] - s[0])
-    return med, jit, ts
+    """Structured TimedResult (median/jitter/rounds attached) over
+    ROUNDS fenced timing rounds of ``iters`` dispatches after one
+    warm/compile call — tuning.measure's discipline at this module's
+    round count."""
+    from torchmpi_tpu.tuning import measure as tmeasure
+
+    return tmeasure.measure(fn, iters=iters, rounds=ROUNDS, fence=fence)
 
 
-def _ms(rec_times):
-    med, jit, ts = rec_times
-    return {"ms": round(med * 1e3, 3), "jitter_ms": round(jit * 1e3, 3),
-            "rounds_ms": [round(t * 1e3, 3) for t in ts]}
+def _ms(res):
+    from torchmpi_tpu.tuning import measure as tmeasure
+
+    return tmeasure.result_ms(res)
 
 
 def _gate(cands, default_key):
-    """Noise-gated argmin over ``cands`` ({key: (med, jit, ts)}).
-
-    Returns (chosen_key, evidence).  The config default wins unless some
-    candidate's median beats the default's by MORE than the pair's
+    """Noise-gated argmin (tuning.measure.noise_gate): the config
+    default wins unless a challenger beats it beyond the pair's
     combined jitter — the anti-flap rule that makes re-runs agree."""
-    if not cands:
-        return default_key, {"note": "no successful measurements"}
-    if default_key not in cands:
-        k = min(cands, key=lambda k: cands[k][0])
-        return k, {"note": "default candidate failed; plain argmin",
-                   "chosen_ms": round(cands[k][0] * 1e3, 3)}
-    dmed, djit, _ = cands[default_key]
-    k_min = min(cands, key=lambda k: cands[k][0])
-    mmed, mjit, _ = cands[k_min]
-    delta = dmed - mmed
-    needed = max(djit + mjit, 0.0)
-    chosen = k_min if (k_min != default_key and delta > needed) \
-        else default_key
-    return chosen, {
-        "default": str(default_key),
-        "default_ms": round(dmed * 1e3, 3),
-        "fastest": str(k_min),
-        "fastest_ms": round(mmed * 1e3, 3),
-        "delta_ms": round(delta * 1e3, 3),
-        "noise_floor_ms": round(needed * 1e3, 3),
-        "gated_to_default": chosen == default_key and k_min != default_key,
-    }
+    from torchmpi_tpu.tuning import measure as tmeasure
+
+    return tmeasure.noise_gate(cands, default_key)
 
 
 def main():
@@ -119,6 +98,10 @@ def main():
                    help="timing rounds per candidate (median scored)")
     p.add_argument("--quick", action="store_true",
                    help="tiny sweep (CI smoke)")
+    p.add_argument("--plan-out", default=None, metavar="PATH",
+                   help="write the backend sweep as a tuning-plan file "
+                        "(loadable via Config.tuning_plan_path / "
+                        "backend='auto')")
     args = p.parse_args()
     ROUNDS = args.rounds
     if args.devices:
@@ -146,7 +129,10 @@ def main():
     if is_cpu:
         from jax.experimental.pallas import tpu as pltpu
 
-        ring.set_interpret(pltpu.InterpretParams())
+        if hasattr(pltpu, "InterpretParams"):
+            ring.set_interpret(pltpu.InterpretParams())
+        # else: jax too old for the TPU interpreter — pallas candidates
+        # fail to compile on CPU and the sweep records them as errors.
 
     defaults = mpi.Config()  # the values the noise gate protects
     rec = {}
@@ -157,6 +143,7 @@ def main():
              else [1 << 14, 1 << 17, 1 << 20, 1 << 24])
     cutover = None
     last = {}
+    plan_sweep = []  # (per_rank_bytes, cands) per size, for --plan-out
     for nbytes in sizes:
         x = np.random.RandomState(0).rand(n, nbytes // 4).astype(np.float32)
         cands = {}
@@ -188,6 +175,7 @@ def main():
             cutover = nbytes
             evidence["custom_min_bytes"] = {"at_bytes": nbytes, **ev}
         last = cands
+        plan_sweep.append((nbytes, cands))
     winner, ev = _gate(last, "xla")
     if winner == "hierarchical":
         # Two-level wins at gradient scale on this multi-slice mesh.
@@ -208,6 +196,30 @@ def main():
         rec["backend"] = defaults.backend
         rec["custom_min_bytes"] = defaults.custom_min_bytes
         evidence.setdefault("backend", ev)
+
+    # Seed the online plan DB from the sweep: one noise-gated entry per
+    # (op, size bucket) at this platform/mesh, in the exact format
+    # mpi.init(Config(tuning_plan_path=...)) / backend="auto" replays.
+    if args.plan_out:
+        from torchmpi_tpu import tuning as tlib
+
+        cache = tlib.PlanCache(args.plan_out)
+        for nbytes, cands in plan_sweep:
+            if not cands:
+                continue
+            w, _ev = _gate(cands, "xla")
+            cache.put(
+                tlib.make_fingerprint("allreduce", nbytes, "float32", mesh),
+                tlib.PlanEntry(
+                    backend=str(w), source="autotune",
+                    median_ms={b: round(r.median * 1e3, 4)
+                               for b, r in cands.items()},
+                    jitter_ms={b: round(r.jitter * 1e3, 4)
+                               for b, r in cands.items()},
+                    rounds=ROUNDS))
+        saved = cache.save(args.plan_out)
+        print(json.dumps({"phase": "plan_out", "path": args.plan_out,
+                          "entries": len(cache), "saved": saved}))
 
     # -- 2. chunk_bytes ----------------------------------------------------
     if not is_cpu:  # streaming ring needs real lowering to mean anything

@@ -56,7 +56,8 @@ def main():
     if is_cpu:
         from jax.experimental.pallas import tpu as pltpu
 
-        ring.set_interpret(pltpu.InterpretParams())
+        if hasattr(pltpu, "InterpretParams"):
+            ring.set_interpret(pltpu.InterpretParams())
     print(f"# mesh {dict(zip(mesh.axis_names, mesh.devices.shape))} "
           f"({'cpu-sim' if is_cpu else 'tpu'})", file=sys.stderr)
 

@@ -41,7 +41,10 @@ def test_gate_installed_at_import():
     from jax._src import compiler as jc
 
     assert compilegate._gate.installed
-    assert hasattr(jc.backend_compile_and_load, "__wrapped__")
+    # Older jax has no backend_compile_and_load; the gate wraps whichever
+    # chokepoints exist.
+    if hasattr(jc, "backend_compile_and_load"):
+        assert hasattr(jc.backend_compile_and_load, "__wrapped__")
     assert hasattr(jc.backend_compile, "__wrapped__")
 
 

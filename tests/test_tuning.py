@@ -1,0 +1,546 @@
+"""Tuning subsystem tests: plan cache durability, topology fingerprints,
+the online ``backend="auto"`` lifecycle, and the noise-gate discipline
+(ISSUE 1 acceptance: first run measures and populates a plan file, a
+second process replays it with zero re-measurement, and a corrupt plan
+degrades to static selection without error)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import torchmpi_tpu as mpi
+from torchmpi_tpu import selector, tuning
+from torchmpi_tpu.tuning import PlanCache, PlanEntry, plancache
+from torchmpi_tpu.utils import metrics
+
+
+def entry(backend="pallas", ts=1.0):
+    return PlanEntry(backend=backend, source="measured",
+                     median_ms={"xla": 1.0, backend: 0.5},
+                     jitter_ms={"xla": 0.1, backend: 0.1},
+                     rounds=3, timestamp=ts)
+
+
+# ---------------------------------------------------------------------------
+# PlanCache persistence
+# ---------------------------------------------------------------------------
+
+
+def test_plan_roundtrip(tmp_path):
+    path = str(tmp_path / "plans.json")
+    cache = PlanCache(path)
+    cache.put("cpu|dcn:1,ici:8|allreduce|float32|b20", entry())
+    assert cache.save()
+    back = PlanCache.load(path)
+    assert back.degraded_reason is None
+    e = back.get("cpu|dcn:1,ici:8|allreduce|float32|b20")
+    assert e is not None and e.backend == "pallas"
+    assert e.median_ms == {"xla": 1.0, "pallas": 0.5}
+    assert e.rounds == 3 and e.source == "measured"
+
+
+def test_plan_missing_file_is_empty(tmp_path):
+    back = PlanCache.load(str(tmp_path / "nope.json"))
+    assert back.degraded_reason is None and len(back) == 0
+
+
+def test_plan_corrupt_degrades_silently(tmp_path):
+    path = str(tmp_path / "plans.json")
+    with open(path, "w") as f:
+        f.write("{not json")
+    back = PlanCache.load(path)  # must not raise
+    assert back.degraded_reason is not None and len(back) == 0
+
+
+def test_plan_version_mismatch_degrades_silently(tmp_path):
+    path = str(tmp_path / "plans.json")
+    with open(path, "w") as f:
+        json.dump({"version": 999, "entries": {"k": {"backend": "xla"}}}, f)
+    back = PlanCache.load(path)
+    assert back.degraded_reason is not None and len(back) == 0
+
+
+def test_plan_bad_entry_skipped_not_fatal(tmp_path):
+    path = str(tmp_path / "plans.json")
+    with open(path, "w") as f:
+        json.dump({"version": plancache.PLAN_VERSION,
+                   "entries": {"good": {"backend": "xla"},
+                               "bad": {"no_backend": 1},
+                               "worse": "not a dict"}}, f)
+    back = PlanCache.load(path)
+    assert back.degraded_reason is None
+    assert back.get("good") is not None
+    assert back.get("bad") is None and back.get("worse") is None
+
+
+def test_plan_foreign_timestamp_coerced_never_crashes(tmp_path):
+    """A hand-edited entry with a null/string timestamp must not make a
+    later merge/save raise (the never-crash contract covers every
+    field)."""
+    path = str(tmp_path / "plans.json")
+    with open(path, "w") as f:
+        json.dump({"version": plancache.PLAN_VERSION,
+                   "entries": {"k": {"backend": "xla", "timestamp": None,
+                                     "rounds": "three"}}}, f)
+    back = PlanCache.load(path)
+    assert back.degraded_reason is None
+    assert back.get("k").timestamp == 0.0 and back.get("k").rounds == 0
+    back.put("k2", entry())
+    assert back.save()  # merge against the foreign entry must not raise
+
+
+def test_plan_concurrent_writers_merge(tmp_path):
+    """Two writers against one path keep BOTH writers' entries."""
+    path = str(tmp_path / "plans.json")
+    a = PlanCache(path)
+    b = PlanCache(path)  # opened before a saves: knows nothing of a
+    a.put("key_a", entry("pallas", ts=1.0))
+    b.put("key_b", entry("hierarchical", ts=2.0))
+    assert a.save()
+    assert b.save()  # must merge a's entry, not clobber it
+    back = PlanCache.load(path)
+    assert back.get("key_a").backend == "pallas"
+    assert back.get("key_b").backend == "hierarchical"
+
+
+def test_plan_conflict_newer_timestamp_wins(tmp_path):
+    path = str(tmp_path / "plans.json")
+    a = PlanCache(path)
+    a.put("k", entry("pallas", ts=100.0))
+    assert a.save()
+    b = PlanCache(path)
+    b.put("k", entry("xla", ts=200.0))
+    assert b.save()
+    assert PlanCache.load(path).get("k").backend == "xla"
+    c = PlanCache(path)
+    c.put("k", entry("hierarchical", ts=50.0))  # stale writer
+    assert c.save()
+    assert PlanCache.load(path).get("k").backend == "xla"
+
+
+def test_plan_save_unwritable_returns_false():
+    cache = PlanCache("/proc/definitely/not/writable/plans.json")
+    cache.put("k", entry())
+    assert cache.save() is False
+
+
+def test_plan_prune_and_merge_from(tmp_path):
+    a = PlanCache()
+    a.put("cpu|x|allreduce|float32|b10", entry(ts=1.0))
+    a.put("tpu|y|allreduce|float32|b20", entry(ts=2.0))
+    assert a.prune(lambda k, e: k.startswith("tpu")) == 1
+    assert list(a.entries) == ["tpu|y|allreduce|float32|b20"]
+    b = PlanCache()
+    assert b.merge_from(a) == 1
+
+
+# ---------------------------------------------------------------------------
+# Fingerprints
+# ---------------------------------------------------------------------------
+
+
+def test_size_bucket_log2():
+    assert tuning.size_bucket(0) == 0
+    assert tuning.size_bucket(1) == 0
+    assert tuning.size_bucket(1024) == 10
+    assert tuning.size_bucket(1025) == 10
+    assert tuning.size_bucket(2047) == 10
+    assert tuning.size_bucket(2048) == 11
+    assert tuning.bucket_bytes(10) == 1024
+
+
+def test_fingerprint_keys_topology(flat_runtime):
+    key = tuning.make_fingerprint("allreduce", 4096, np.float32,
+                                  flat_runtime)
+    assert key == "cpu|dcn:1,ici:8|allreduce|float32|b12"
+
+
+def test_fingerprint_distinguishes_mesh(hier_runtime):
+    key = tuning.make_fingerprint("allreduce", 4096, np.float32,
+                                  hier_runtime)
+    assert "dcn:2,ici:4" in key
+
+
+def test_fingerprint_axes_subset_gets_own_key(hier_runtime, tmp_path):
+    """A whole-mesh decision must not be replayed for an axis subset:
+    different axes, different key (safe plan miss)."""
+    tuning.configure(str(tmp_path / "p.json"))
+    full = tuning.make_fingerprint("allreduce", 4096, np.float32,
+                                   hier_runtime)
+    both = tuning.make_fingerprint("allreduce", 4096, np.float32,
+                                   hier_runtime, axes=("dcn", "ici"))
+    sub = tuning.make_fingerprint("allreduce", 4096, np.float32,
+                                  hier_runtime, axes=("dcn",))
+    assert both == full  # spanning every axis == the whole-mesh key
+    assert sub != full and "dcn:2" in sub and "ici" not in sub
+    # Axis order is normalized to mesh order: equivalent spans, one key.
+    rev = tuning.make_fingerprint("allreduce", 4096, np.float32,
+                                  hier_runtime, axes=("ici", "dcn"))
+    assert rev == full
+    # And the provider consults with the subset key: a full-mesh entry
+    # does not answer a subset-axis lookup.
+    tuning.plan().put(full, PlanEntry(backend="pallas", source="manual"))
+    assert tuning.plan_lookup("allreduce", 4096, np.float32,
+                              ("dcn", "ici")) == "pallas"
+    assert tuning.plan_lookup("allreduce", 4096, np.float32,
+                              ("dcn",)) is None
+
+
+# ---------------------------------------------------------------------------
+# nbytes_of over pytrees (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_nbytes_of_single_array():
+    assert selector.nbytes_of(np.zeros((4, 4), np.float32)) == 64
+
+
+def test_nbytes_of_pytree_sums_leaves():
+    tree = {"a": np.zeros((2, 3), np.float32),
+            "b": [np.zeros(5, np.float64), np.zeros((1,), np.int8)]}
+    assert selector.nbytes_of(tree) == 2 * 3 * 4 + 5 * 8 + 1
+
+
+def test_nbytes_of_non_array_is_zero():
+    assert selector.nbytes_of(None) == 0
+    assert selector.nbytes_of(3.5) == 0
+
+
+# ---------------------------------------------------------------------------
+# metrics.timed structured result (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_timed_result_is_float_with_spread():
+    import jax.numpy as jnp
+
+    x = jnp.ones((16,))
+    res = metrics.timed(lambda: x * 2, iters=1, rounds=4)
+    assert isinstance(res, float) and isinstance(res, metrics.TimedResult)
+    assert len(res.round_times) == 4
+    assert float(res) == min(res.round_times)
+    assert res.median >= float(res) >= 0.0
+    assert res.jitter >= 0.0
+    # Backward-compat global still published, chronological.
+    assert metrics.last_round_times == res.round_times
+
+
+def test_timed_result_median_jitter_math():
+    r = metrics.TimedResult([4.0, 1.0, 3.0, 2.0])
+    assert float(r) == 1.0
+    assert r.median == 2.5
+    assert r.jitter == 0.5 * (4.0 - 2.0)
+
+
+# ---------------------------------------------------------------------------
+# Noise gate
+# ---------------------------------------------------------------------------
+
+
+def test_noise_gate_keeps_default_within_noise():
+    cands = {"xla": metrics.TimedResult([1.0, 1.1, 1.2, 1.3]),
+             "pallas": metrics.TimedResult([0.9, 1.0, 1.1, 1.2])}
+    chosen, ev = tuning.noise_gate(cands, "xla")
+    assert chosen == "xla" and ev["gated_to_default"]
+
+
+def test_noise_gate_switches_beyond_noise():
+    cands = {"xla": metrics.TimedResult([1.0, 1.0, 1.0, 1.0]),
+             "pallas": metrics.TimedResult([0.1, 0.1, 0.1, 0.1])}
+    chosen, ev = tuning.noise_gate(cands, "xla")
+    assert chosen == "pallas" and ev["delta_ms"] > 0
+
+
+def test_noise_gate_empty_and_missing_default():
+    chosen, _ = tuning.noise_gate({}, "xla")
+    assert chosen == "xla"
+    chosen, ev = tuning.noise_gate(
+        {"pallas": metrics.TimedResult([0.5, 0.5])}, "xla")
+    assert chosen == "pallas" and "argmin" in ev["note"]
+
+
+# ---------------------------------------------------------------------------
+# Online "auto" lifecycle (the acceptance scenario)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def auto_runtime(tmp_path):
+    """2x4 mesh with backend="auto" against a tmp plan file."""
+    plan = str(tmp_path / "plans.json")
+    mpi.stop()
+    tuning.reset_measurement_count()
+    mesh = mpi.init(mpi.Config(dcn_size=2, backend="auto",
+                               tuning_plan_path=plan))
+    yield mesh, plan
+    mpi.stop()
+
+
+def rank_major(n=8, elems=1024):
+    return np.stack([np.full(elems, float(r), np.float32)
+                     for r in range(n)])
+
+
+def test_auto_first_call_measures_then_reuses(auto_runtime):
+    mesh, plan = auto_runtime
+    x = rank_major()
+    before = tuning.measurement_count()
+    y = np.asarray(mpi.allreduce(x))
+    np.testing.assert_allclose(y[0], x.sum(axis=0))
+    assert tuning.measurement_count() == before + 1
+    # Plan file populated with a versioned, keyed entry.
+    data = json.load(open(plan))
+    assert data["version"] == plancache.PLAN_VERSION
+    (key, e), = data["entries"].items()
+    assert "allreduce" in key and "dcn:2,ici:4" in key
+    assert e["backend"] in ("xla", "hierarchical", "pallas")
+    # Same key again: plan hit, no new measurement.
+    np.asarray(mpi.allreduce(x))
+    assert tuning.measurement_count() == before + 1
+    # Different size bucket: one more measurement, one more entry.
+    np.asarray(mpi.allreduce(rank_major(elems=64)))
+    assert tuning.measurement_count() == before + 2
+    assert len(json.load(open(plan))["entries"]) == 2
+
+
+def test_auto_second_process_zero_remeasurement(auto_runtime, tmp_path):
+    mesh, plan = auto_runtime
+    x = rank_major()
+    first = np.asarray(mpi.allreduce(x))
+    chosen = tuning.plan().get(list(tuning.plan().entries)[0]).backend
+    mpi.stop()  # "process" 1 exits
+
+    # "Process" 2: fresh init against the same plan file.
+    tuning.reset_measurement_count()
+    mpi.init(mpi.Config(dcn_size=2, backend="auto", tuning_plan_path=plan))
+    y = np.asarray(mpi.allreduce(x))
+    assert tuning.measurement_count() == 0  # zero re-measurement
+    np.testing.assert_allclose(y[0], first[0])
+    # And the decision replays the recorded winner (no flapping).
+    dec = [d for d in tuning.decisions()
+           if d.get("event") == "tuning_decision"][-1]
+    assert dec["source"] == "plan" and dec["backend"] == chosen
+
+
+def test_auto_stable_across_runs_via_noise_gate(auto_runtime, monkeypatch):
+    """Deterministic anti-flap check: candidates within noise of each
+    other must yield the default ("xla") on every re-measurement."""
+    mesh, plan = auto_runtime
+    from torchmpi_tpu.tuning import autoselect
+
+    def fake_measure(step, iters=1, rounds=3, fence=None):
+        step()  # still execute the collective once (correctness path)
+        return metrics.TimedResult([1.00, 1.05, 1.10, 1.15])
+
+    monkeypatch.setattr(autoselect.measure, "measure", fake_measure)
+    winners = []
+    for _ in range(2):
+        np.asarray(mpi.allreduce(rank_major()))
+        key = list(tuning.plan().entries)[0]
+        winners.append(tuning.plan().get(key).backend)
+        tuning.plan().entries.clear()  # force re-measure next run
+    assert winners == ["xla", "xla"]
+
+
+def test_auto_corrupt_plan_falls_back_static(tmp_path):
+    """Corrupt plan + backend="auto": no crash, no measuring, no
+    overwriting the corrupt evidence; collectives run on the stock
+    path."""
+    plan = str(tmp_path / "plans.json")
+    with open(plan, "w") as f:
+        f.write("{definitely not json")
+    mpi.stop()
+    mpi.init(mpi.Config(dcn_size=2, backend="auto", tuning_plan_path=plan))
+    try:
+        before = tuning.measurement_count()
+        x = rank_major()
+        y = np.asarray(mpi.allreduce(x))  # must not raise
+        np.testing.assert_allclose(y[0], x.sum(axis=0))
+        assert tuning.measurement_count() == before
+        with open(plan) as f:  # evidence preserved for debugging
+            assert f.read() == "{definitely not json"
+    finally:
+        mpi.stop()
+
+
+def test_auto_plan_hit_bypasses_size_cutover(auto_runtime):
+    """A planned backend applies even below custom_min_bytes: the plan
+    was measured at this bucket, so the static cutover must not veto
+    it.  (selector.select consults the plan before the cutover.)"""
+    mesh, plan = auto_runtime
+    x = rank_major(elems=8)  # 32 B/rank, far below custom_min_bytes
+    key = tuning.make_fingerprint("allreduce", 32, np.float32, mesh)
+    tuning.plan().put(key, PlanEntry(backend="hierarchical",
+                                     source="manual"))
+    impl = selector.select("allreduce", "auto", nbytes=32,
+                           custom_min_bytes=64 * 1024, n_dcn=2,
+                           dtype=np.float32)
+    assert impl is selector.available("allreduce")["hierarchical"]
+    y = np.asarray(mpi.allreduce(x))  # runs the planned backend, no error
+    np.testing.assert_allclose(y[0], x.sum(axis=0))
+    assert tuning.measurement_count() == 0  # manual plan: nothing measured
+
+
+def test_auto_miss_without_provider_degrades_to_xla(flat_runtime):
+    """backend="auto" with tuning inactive resolves to the stock path."""
+    impl = selector.select("allreduce", "auto", nbytes=1 << 20,
+                           custom_min_bytes=0, n_dcn=1, dtype=np.float32)
+    assert impl is selector.available("allreduce")["xla"]
+
+
+def test_auto_in_axis_consults_plan(auto_runtime):
+    """In-axis (trace-time) collectives use the plan read-only."""
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    mesh, plan = auto_runtime
+    from torchmpi_tpu import collectives
+
+    x = rank_major(elems=128)
+
+    def body(xs):
+        return collectives.allreduce_in_axis(xs[0], ("dcn", "ici"))[None]
+
+    y = jax.jit(shard_map(body, mesh=mesh,
+                          in_specs=(P(("dcn", "ici")),),
+                          out_specs=P(("dcn", "ici")),
+                          check_vma=False))(x)
+    np.testing.assert_allclose(np.asarray(y)[0], x.sum(axis=0))
+    # Trace time cannot measure: in-axis resolution is read-only.
+    assert tuning.measurement_count() == 0
+
+
+def test_per_op_auto_table(auto_runtime):
+    """backend_per_op={"allreduce": "auto"} routes just that op through
+    the plan DB."""
+    mesh, plan = auto_runtime
+    mpi.set_config(backend="xla", backend_per_op={"allreduce": "auto"})
+    before = tuning.measurement_count()
+    x = rank_major()
+    np.asarray(mpi.allreduce(x))
+    assert tuning.measurement_count() == before + 1
+    np.asarray(mpi.broadcast(x, root=0))  # non-auto op: static, unmeasured
+    assert tuning.measurement_count() == before + 1
+
+
+def test_decisions_surface_through_metrics(auto_runtime, tmp_path):
+    mesh, plan = auto_runtime
+    log = metrics.MetricsLogger(str(tmp_path / "decisions.jsonl"))
+    tuning.set_decision_logger(log)
+    np.asarray(mpi.allreduce(rank_major()))
+    recs = [r for r in log.records if r.get("event") == "tuning_decision"]
+    assert recs and recs[-1]["source"] == "measured"
+    assert recs[-1]["backend"] in ("xla", "hierarchical", "pallas")
+    assert "evidence" in recs[-1]
+    lines = (tmp_path / "decisions.jsonl").read_text().strip().splitlines()
+    assert len(lines) == len(log.records)
+    tuning.set_decision_logger(None)
+
+
+# ---------------------------------------------------------------------------
+# plan_tool.py
+# ---------------------------------------------------------------------------
+
+
+def test_plan_path_without_auto_loads_but_logs_inactive(tmp_path):
+    """tuning_plan_path with backend="xla": the plan loads but cannot
+    drive selection; the decision log says so (no silent dead weight)."""
+    plan = str(tmp_path / "plans.json")
+    seeded = PlanCache(plan)
+    seeded.put("k", entry())
+    assert seeded.save()
+    mpi.stop()
+    mpi.init(mpi.Config(dcn_size=2, backend="xla", tuning_plan_path=plan))
+    try:
+        assert tuning.is_active() and len(tuning.plan()) == 1
+        ev = [d for d in tuning.decisions()
+              if d.get("event") == "tuning_plan_inactive"]
+        assert ev and "auto" in ev[-1]["reason"]
+        before = tuning.measurement_count()
+        x = rank_major()
+        y = np.asarray(mpi.allreduce(x))  # static xla path, unmeasured
+        np.testing.assert_allclose(y[0], x.sum(axis=0))
+        assert tuning.measurement_count() == before
+    finally:
+        mpi.stop()
+
+
+def test_multiprocess_disables_online_measurement(auto_runtime,
+                                                  monkeypatch):
+    """Multi-host SPMD must not measure per-process (divergent winners
+    would compile mismatched programs): plan read-only, static
+    fallback, logged."""
+    mesh, plan = auto_runtime
+    from torchmpi_tpu.tuning import autoselect
+
+    monkeypatch.setattr(autoselect, "_multiprocess", lambda: True)
+    x = rank_major()
+    y = np.asarray(mpi.allreduce(x))  # degrades to static, still correct
+    np.testing.assert_allclose(y[0], x.sum(axis=0))
+    assert tuning.measurement_count() == 0
+    assert not os.path.exists(plan)
+    dec = [d for d in tuning.decisions()
+           if d.get("event") == "tuning_decision"][-1]
+    assert dec["source"] == "fallback" and "multiprocess" in dec["reason"]
+    # A pre-seeded plan entry IS honored read-only.
+    key = tuning.make_fingerprint("allreduce", 4096, np.float32, mesh)
+    tuning.plan().put(key, PlanEntry(backend="hierarchical",
+                                     source="manual"))
+    y = np.asarray(mpi.allreduce(x))
+    np.testing.assert_allclose(y[0], x.sum(axis=0))
+    assert tuning.measurement_count() == 0
+
+
+def test_configure_same_path_keeps_memory_entries(auto_runtime):
+    """set_config on an unrelated knob must not discard in-memory
+    measurements (they may be unpersistable on read-only trees)."""
+    mesh, plan = auto_runtime
+    key = tuning.make_fingerprint("allreduce", 32, np.float32, mesh)
+    tuning.plan().put(key, PlanEntry(backend="hierarchical",
+                                     source="manual"))
+    mpi.set_config(chunk_bytes=1 << 20)  # reconfigures tuning
+    assert tuning.plan().get(key) is not None  # entry survived
+    mpi.set_config(tuning_plan_path=plan + ".other")  # path change: reload
+    assert tuning.plan().get(key) is None
+    assert tuning.plan().path == plan + ".other"
+
+
+def test_plan_tool_show_merge_prune(tmp_path, capsys):
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "scripts"))
+    import plan_tool
+
+    a_path, b_path = str(tmp_path / "a.json"), str(tmp_path / "b.json")
+    out = str(tmp_path / "merged.json")
+    a = PlanCache(a_path)
+    a.put("cpu|dcn:1,ici:8|allreduce|float32|b10", entry("pallas", ts=1.0))
+    assert a.save()
+    b = PlanCache(b_path)
+    b.put("tpu|dcn:2,ici:4|allreduce|float32|b20",
+          entry("hierarchical", ts=2.0))
+    assert b.save()
+
+    assert plan_tool.main(["show", a_path]) == 0
+    assert "pallas" in capsys.readouterr().out
+
+    assert plan_tool.main(["merge", out, a_path, b_path]) == 0
+    capsys.readouterr()
+    merged = PlanCache.load(out)
+    assert len(merged) == 2
+
+    assert plan_tool.main(["prune", out, "--drop-match", "cpu|"]) == 0
+    capsys.readouterr()
+    assert list(PlanCache.load(out).entries) == \
+        ["tpu|dcn:2,ici:4|allreduce|float32|b20"]
+
+    # Corrupt input: reported, not a traceback.
+    bad = str(tmp_path / "bad.json")
+    with open(bad, "w") as f:
+        f.write("nope")
+    assert plan_tool.main(["show", bad]) == 0
+    assert plan_tool.main(["prune", bad]) == 1

@@ -23,6 +23,13 @@ empty during the survey — SURVEY.md §0) and cited at file-path granularity
 with confidence tags.
 """
 
+from .utils import jaxcompat as _jaxcompat
+
+# Backfill modern jax names (jax.shard_map / check_vma) onto older jax
+# BEFORE any module that uses them is imported — including test modules
+# that do `from jax import shard_map` after importing this package.
+_jaxcompat.install()
+
 from .config import Config
 from .runtime import (
     init,
@@ -47,6 +54,7 @@ from .runtime import (
 )
 from . import collectives
 from . import selector
+from . import tuning
 from . import parallel
 from . import ops
 from . import nn
@@ -86,7 +94,8 @@ __all__ = [
     "device_count", "local_device_count", "barrier", "world_mesh",
     "current_mesh", "push_communicator", "pop_communicator", "communicator",
     "set_config", "config", "DCN_AXIS", "ICI_AXIS", "WORLD_AXES",
-    "collectives", "selector", "parallel", "allreduce", "broadcast", "reduce",
+    "collectives", "selector", "tuning", "parallel", "allreduce",
+    "broadcast", "reduce",
     "allgather", "reduce_scatter", "sendreceive", "alltoall", "gather",
     "scatter", "async_", "sync_handle", "AsyncHandle", "compile_budget",
     "CompileBudgetError", "__version__",

@@ -291,19 +291,28 @@ for _op, _fn in [
 # ---------------------------------------------------------------------------
 
 
+def _config_backend(op_name: str, cfg) -> Tuple[str, bool]:
+    """Resolve the config-level backend for ``op_name``: per-op table
+    first (a deliberate choice, carrying explicit/per-call authority),
+    then the hierarchical flag, then the config default.  The ONE home
+    of this precedence — shared by _pick and the eager "auto" trigger
+    so they can never drift apart."""
+    if cfg.backend_per_op:
+        b = cfg.backend_per_op.get(op_name)
+        if b is not None:
+            return b, True
+    return ("hierarchical" if cfg.hierarchical else cfg.backend), False
+
+
 def _pick(op_name: str, x, backend: Optional[str], axes: Tuple[str, ...],
           mesh: Optional[Mesh] = None):
     explicit = backend is not None
     if runtime.is_initialized():
         cfg = runtime.config()
-        if backend is None and cfg.backend_per_op:
-            backend = cfg.backend_per_op.get(op_name)
-            # A per-op table entry is a deliberate choice; like a per-call
-            # backend it bypasses the size cutover (topology fallback still
-            # applies).
-            explicit = backend is not None
-        backend = backend or (
-            "hierarchical" if cfg.hierarchical else cfg.backend)
+        if backend is None:
+            # A per-op table entry bypasses the size cutover like a
+            # per-call backend (topology fallback still applies).
+            backend, explicit = _config_backend(op_name, cfg)
         custom_min = cfg.custom_min_bytes
     else:
         backend = backend or "xla"
@@ -324,6 +333,8 @@ def _pick(op_name: str, x, backend: Optional[str], axes: Tuple[str, ...],
         custom_min_bytes=custom_min,
         n_dcn=n_dcn,
         explicit=explicit,
+        dtype=getattr(x, "dtype", None),
+        axes=axes,
     )
 
 
@@ -514,6 +525,28 @@ def _eager_collective(op_name: str, x, *, mesh: Optional[Mesh] = None,
                              and runtime.effective_config().staged):
         out = _host_staged(op_name, np.asarray(x), n, **params)
         return _place_rank_major(np.ascontiguousarray(out), m)
+    # Online "auto" mode (config default, per-op table, or an explicit
+    # backend="auto"): resolve against the persistent tuning plan.  The
+    # first eager call of an uncached (op, size bucket, mesh, platform)
+    # key measures the registered candidates and persists the winner;
+    # every later call — this process or any future one — replays the
+    # plan (torchmpi_tpu/tuning/).  A degraded plan resolves to None and
+    # the static selector path below applies.
+    eff = backend
+    if eff is None and runtime.is_initialized():
+        eff, _ = _config_backend(op_name, runtime.config())
+    if eff == "auto":
+        from . import tuning
+
+        resolved = tuning.resolve_eager(
+            op_name, selector.nbytes_of(x[0]), x.dtype, m,
+            lambda b: _eager_collective(op_name, x, mesh=m, backend=b,
+                                        **params))
+        if resolved is not None:
+            # A measured decision carries per-call-backend authority
+            # (bypasses the size cutover; topology fallback still
+            # applies in the selector).
+            backend = resolved
     axes = m.axis_names
     # Resolve the implementation *before* the cache lookup: the key must
     # include the resolved impl, or runtime set_config() backend switches

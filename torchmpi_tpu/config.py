@@ -71,8 +71,20 @@ class Config:
     # Default backend for collectives: "xla" (stock, = reference's mpi/nccl
     # path), "hierarchical" (2-level ICI+DCN, = reference's custom
     # hierarchical path), "pallas" (chunked ring kernels, = reference's custom
-    # chunked/pipelined path).
+    # chunked/pipelined path), or "auto" (measured online per (op, size
+    # bucket, mesh, platform) and persisted in the tuning plan DB — see
+    # torchmpi_tpu/tuning/ and docs/TUNING.md).
     backend: str = "xla"
+    # Path of the persistent tuning-plan JSON consulted/extended by
+    # backend="auto" (and loadable from benchmarks/autotune.py --plan-out).
+    # None resolves to TORCHMPI_TPU_TUNING_PLAN, then the repo-local
+    # default (tuning.DEFAULT_PLAN_PATH).  Corrupt/mismatched files
+    # degrade silently to static selection; they never crash a job.
+    tuning_plan_path: Optional[str] = None
+    # Fenced timing rounds per candidate for the online measurement (the
+    # median is scored; the noise gate needs >= 3 rounds to be
+    # meaningful — same discipline as benchmarks/autotune.py).
+    tuning_rounds: int = 3
     # Per-op overrides of `backend` (reference: the collectiveSelector table
     # chose an implementation per collective class).  e.g.
     # {"allreduce": "pallas", "broadcast": "xla"}.
@@ -170,10 +182,14 @@ class Config:
         Env overrides (reference analog: FFI setters callable at any time):
           TORCHMPI_TPU_BACKEND, TORCHMPI_TPU_HIERARCHICAL,
           TORCHMPI_TPU_CHUNK_BYTES, TORCHMPI_TPU_GRADSYNC_BUCKETS,
-          TORCHMPI_TPU_PS_PORT, TORCHMPI_TPU_ICI_SIZE, TORCHMPI_TPU_DCN_SIZE
+          TORCHMPI_TPU_PS_PORT, TORCHMPI_TPU_ICI_SIZE, TORCHMPI_TPU_DCN_SIZE,
+          TORCHMPI_TPU_TUNING_PLAN, TORCHMPI_TPU_TUNING_ROUNDS
         """
         cfg = Config(
             backend=_env_str("TORCHMPI_TPU_BACKEND", "xla"),
+            tuning_plan_path=(
+                os.environ.get("TORCHMPI_TPU_TUNING_PLAN") or None),
+            tuning_rounds=_env_int("TORCHMPI_TPU_TUNING_ROUNDS", 3),
             hierarchical=_env_bool("TORCHMPI_TPU_HIERARCHICAL", False),
             chunk_bytes=_env_int("TORCHMPI_TPU_CHUNK_BYTES", 4 * 1024 * 1024),
             custom_min_bytes=_env_int("TORCHMPI_TPU_CUSTOM_MIN_BYTES", 64 * 1024),

@@ -188,7 +188,17 @@ def init(config: Optional[Config] = None, **overrides) -> Mesh:
         _state.mesh_stack = [("world", world)]
         _state.mesh_cache = {"world": world}
         _state.initialized = True
-        return world
+    # Outside the lock: tuning.configure reads runtime state via the
+    # public accessors.  Loads the persistent collective plan DB and
+    # registers the selector's plan provider when the config opts into
+    # measured selection (backend="auto", a per-op "auto", or an
+    # explicit plan path — e.g. one emitted by benchmarks/autotune.py).
+    if _tuning_opted_in(cfg):
+        from . import tuning
+
+        tuning.configure(cfg.tuning_plan_path, rounds=cfg.tuning_rounds,
+                         auto_active=_tuning_auto_active(cfg))
+    return world
 
 
 def stop() -> None:
@@ -197,9 +207,10 @@ def stop() -> None:
         _state.initialized = False
         _state.mesh_stack = []
         _state.mesh_cache = {}
-    from . import collectives
+    from . import collectives, tuning
 
     collectives.clear_cache()
+    tuning.reset()
 
 
 def is_initialized() -> bool:
@@ -253,12 +264,27 @@ def _validate_backend_per_op(table: Dict[str, str]) -> Dict[str, str]:
             raise ValueError(
                 f"backend_per_op: unknown collective {op!r} "
                 f"(known: {sorted(avail)})")
-        if backend != "xla" and backend not in avail[op]:
+        if backend not in ("xla", "auto") and backend not in avail[op]:
             raise ValueError(
                 f"backend_per_op[{op!r}]: backend {backend!r} has no "
                 f"implementation for this op (available: "
                 f"{sorted(avail[op])})")
     return dict(table)  # private copy: never alias the caller's dict
+
+
+def _tuning_auto_active(cfg: Config) -> bool:
+    """Does some backend actually resolve to "auto" (plan-driven)?"""
+    if cfg.backend == "auto":
+        return True
+    return bool(cfg.backend_per_op
+                and "auto" in cfg.backend_per_op.values())
+
+
+def _tuning_opted_in(cfg: Config) -> bool:
+    """Did this config ask the tuning subsystem to load a plan?  A plan
+    path WITHOUT any "auto" backend still loads (and the decision log
+    notes it is inactive) so the misconfiguration is visible."""
+    return _tuning_auto_active(cfg) or cfg.tuning_plan_path is not None
 
 
 def set_config(**kw) -> None:
@@ -278,9 +304,20 @@ def set_config(**kw) -> None:
         if k == "backend_per_op" and v is not None:
             v = _validate_backend_per_op(v)
         setattr(_state.config, k, v)
-    from . import collectives
+    from . import collectives, tuning
 
     collectives.clear_cache()
+    # (Re)configure tuning whenever the config opts into auto/planned
+    # selection: a changed tuning_plan_path or tuning_rounds takes
+    # effect immediately (the reference's setters likewise did), and
+    # switching INTO auto at runtime activates the plan DB.  An
+    # unchanged path keeps the in-memory entries (they may be
+    # unpersistable on a read-only tree) and merges in whatever
+    # appeared on disk meanwhile; a changed path reloads outright.
+    if _tuning_opted_in(_state.config):
+        tuning.configure(_state.config.tuning_plan_path,
+                         rounds=_state.config.tuning_rounds,
+                         auto_active=_tuning_auto_active(_state.config))
 
 
 # --- rank/size family -------------------------------------------------------

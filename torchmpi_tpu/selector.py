@@ -42,6 +42,28 @@ def available(op: Optional[str] = None) -> Dict:
     return {k: sorted(v.keys()) for k, v in _REGISTRY.items()}
 
 
+# Plan provider hook (torchmpi_tpu.tuning): fn(op, nbytes, dtype, axes)
+# -> Optional[backend name].  Registered by tuning.configure() when the
+# config opts into backend="auto"; consulted by select() BEFORE the
+# static cutover so measured per-(op, size, mesh) decisions take
+# precedence over the hand-tuned constants.
+_plan_provider: Optional[Callable] = None
+
+
+def set_plan_provider(fn: Callable) -> None:
+    global _plan_provider
+    _plan_provider = fn
+
+
+def clear_plan_provider() -> None:
+    global _plan_provider
+    _plan_provider = None
+
+
+def plan_provider() -> Optional[Callable]:
+    return _plan_provider
+
+
 def select(
     op: str,
     backend: str,
@@ -50,8 +72,16 @@ def select(
     custom_min_bytes: int = 0,
     n_dcn: int = 1,
     explicit: bool = False,
+    dtype=None,
+    axes=None,
 ) -> Callable:
     """Pick the implementation for ``op``.
+
+    ``backend="auto"`` consults the registered tuning-plan provider (a
+    measured, persisted per-topology decision — see
+    ``torchmpi_tpu/tuning/``) BEFORE the static cutover; a plan hit
+    bypasses the ``custom_min_bytes`` heuristic (the entry was measured
+    at this size bucket), a miss degrades to the stock ``"xla"`` path.
 
     Falls back to ``"xla"`` when the requested backend has no implementation
     for this op, when the tensor is below the custom-path size cutover, or
@@ -65,6 +95,21 @@ def select(
     if not impls:
         raise KeyError(f"no implementations registered for collective {op!r}")
     name = backend
+    if name == "auto":
+        planned = None
+        if _plan_provider is not None:
+            try:
+                planned = _plan_provider(op, int(nbytes or 0), dtype, axes)
+            except Exception:  # noqa: BLE001 — a plan must never crash a step
+                planned = None
+        if planned is None:
+            name = "xla"
+        else:
+            # A measured plan decision carries the same authority as an
+            # explicit per-call backend: no size cutover, but topology/
+            # availability degradation below still applies.
+            name = planned
+            explicit = True
     if name != "xla":
         if (not explicit and nbytes is not None
                 and nbytes < custom_min_bytes):
@@ -82,4 +127,17 @@ def select(
 
 
 def nbytes_of(x) -> int:
-    return int(np.prod(x.shape)) * x.dtype.itemsize if hasattr(x, "shape") else 0
+    """Total payload bytes of ``x`` — a single array OR any pytree of
+    arrays, summed across leaves, so gradient-tree callers get real
+    sizes for cutover/bucketing decisions.  Leaves without shape/dtype
+    (python scalars, None) contribute 0, preserving the old behavior of
+    returning 0 for non-arrays."""
+    if hasattr(x, "shape") and hasattr(x, "dtype"):
+        return int(np.prod(x.shape)) * np.dtype(x.dtype).itemsize
+    import jax
+
+    total = 0
+    for leaf in jax.tree.leaves(x):
+        if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+            total += int(np.prod(leaf.shape)) * np.dtype(leaf.dtype).itemsize
+    return total

@@ -1,0 +1,53 @@
+"""Tuning subsystem: persistent, topology-keyed collective plans.
+
+TorchMPI's ``collectiveSelector`` picked an implementation from
+hand-tuned constants; this package replaces the constants with a
+measured, persisted, per-topology plan database:
+
+- :mod:`fingerprint` — the key space: (platform, mesh shape, op, dtype,
+  log2 size bucket).
+- :mod:`plancache` — the versioned JSON plan DB with atomic writes,
+  concurrent-writer merge, and never-crash load semantics.
+- :mod:`measure` — the shared noise-gated median measurement discipline
+  (also driving ``benchmarks/autotune.py``).
+- :mod:`autoselect` — the online ``backend="auto"`` mode: first eager
+  call of an uncached key measures, caches, persists; every later call
+  (this process or any future one) replays the plan.
+
+See ``docs/TUNING.md`` for the file format and lifecycle.
+"""
+
+from . import fingerprint, measure, plancache, autoselect  # noqa: F401
+from .fingerprint import fingerprint as make_fingerprint  # noqa: F401
+from .fingerprint import size_bucket, bucket_bytes, mesh_key  # noqa: F401
+from .plancache import (  # noqa: F401
+    PLAN_VERSION,
+    DEFAULT_PLAN_PATH,
+    PlanCache,
+    PlanEntry,
+    resolve_plan_path,
+)
+from .measure import measure as measure_step, noise_gate  # noqa: F401
+from .autoselect import (  # noqa: F401
+    configure,
+    reset,
+    is_active,
+    plan,
+    plan_lookup,
+    resolve_eager,
+    decisions,
+    set_decision_logger,
+    measurement_count,
+    reset_measurement_count,
+    DEFAULT_BACKEND,
+)
+
+__all__ = [
+    "fingerprint", "measure", "plancache", "autoselect",
+    "make_fingerprint", "size_bucket", "bucket_bytes", "mesh_key",
+    "PLAN_VERSION", "DEFAULT_PLAN_PATH", "PlanCache", "PlanEntry",
+    "resolve_plan_path", "measure_step", "noise_gate",
+    "configure", "reset", "is_active", "plan", "plan_lookup",
+    "resolve_eager", "decisions", "set_decision_logger",
+    "measurement_count", "reset_measurement_count", "DEFAULT_BACKEND",
+]

@@ -1,0 +1,283 @@
+"""Online ``backend="auto"`` selection against the persistent plan DB.
+
+Lifecycle of one (op, size bucket, mesh, platform) key:
+
+1. ``mpi.init`` with ``backend="auto"`` loads the plan file (missing /
+   corrupt / version-mismatched files silently yield an empty plan).
+2. The FIRST eager call of an uncached key measures every registered,
+   topology-eligible candidate backend with the noise-gated median
+   discipline of :mod:`torchmpi_tpu.tuning.measure` (the rules
+   ``benchmarks/autotune.py`` proved out), caches the winner, and
+   best-effort persists the plan to disk.
+3. Every later call — in this process or any future one — hits the
+   plan with zero re-measurement (assertable via
+   :func:`measurement_count`).
+4. In-axis collectives (inside a user's jit) cannot measure at trace
+   time; they consult the plan read-only via the selector's plan
+   provider and degrade to the static path on a miss.
+
+Every decision is surfaced through ``utils/metrics``: an in-memory
+record (:func:`decisions`) plus an optional JSONL ``MetricsLogger``
+(``set_decision_logger`` / ``TORCHMPI_TPU_TUNING_LOG``), so a step log
+records which backend ran and why.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Callable, Dict, List, Optional
+
+from . import fingerprint, measure, plancache
+from ..utils import metrics
+
+# The gate's protected default: the stock path every platform has.
+DEFAULT_BACKEND = "xla"
+
+
+class _State:
+    def __init__(self) -> None:
+        self.lock = threading.RLock()
+        self.cache: Optional[plancache.PlanCache] = None
+        self.rounds = 3
+        self.iters = 1
+        self.measure_count = 0
+        self.measuring = False
+        self.decisions: List[dict] = []
+        self.logger: Optional[metrics.MetricsLogger] = None
+        self.logged_keys: set = set()
+
+
+_state = _State()
+
+
+def _log(record: dict) -> None:
+    _state.decisions.append(record)
+    del _state.decisions[:-1000]  # bounded in-memory history
+    if _state.logger is not None:
+        _state.logger.log(**record)
+
+
+def decisions() -> List[dict]:
+    """The decision log so far (most recent last, bounded)."""
+    return list(_state.decisions)
+
+
+def set_decision_logger(logger: Optional[metrics.MetricsLogger]) -> None:
+    _state.logger = logger
+
+
+def measurement_count() -> int:
+    """How many plan keys this process measured online (the hook the
+    zero-re-measurement acceptance test asserts on)."""
+    return _state.measure_count
+
+
+def reset_measurement_count() -> None:
+    _state.measure_count = 0
+
+
+def is_active() -> bool:
+    return _state.cache is not None
+
+
+def plan() -> Optional[plancache.PlanCache]:
+    return _state.cache
+
+
+def configure(plan_path: Optional[str] = None, rounds: int = 3,
+              log_path: Optional[str] = None,
+              auto_active: bool = True) -> plancache.PlanCache:
+    """Activate online tuning: load the plan file (silently degrading
+    to an empty plan) and register the selector's plan provider.
+    Called by ``runtime.init`` when the config opts into ``"auto"``.
+    ``auto_active=False`` records that a plan was loaded while no
+    backend resolves to ``"auto"`` — the plan is then dead weight, and
+    the decision log says so instead of leaving the user to wonder why
+    their seeded plan never applies."""
+    from .. import selector
+
+    with _state.lock:
+        path = plancache.resolve_plan_path(plan_path)
+        if (_state.cache is not None and _state.cache.path == path
+                and _state.cache.degraded_reason is None):
+            # Same DB, already live: keep the in-memory entries — they
+            # may include measurements that could not be persisted
+            # (unwritable path) and a reload would throw them away,
+            # forcing a full re-measurement sweep after any set_config.
+            # Still pick up entries that appeared on disk meanwhile
+            # (another process, or a plan_tool merge into the live file).
+            disk = plancache.PlanCache.load(path)
+            if disk.degraded_reason is None:
+                _state.cache.merge_from(disk)
+        else:
+            _state.cache = plancache.PlanCache.load(path)
+        _state.rounds = max(1, int(rounds))
+        _state.logged_keys = set()
+        log_path = log_path or os.environ.get("TORCHMPI_TPU_TUNING_LOG")
+        # Rebind (or drop) the JSONL logger every configure: a stale
+        # logger from a previous init must not keep receiving this
+        # run's records.  set_decision_logger() can still override.
+        _state.logger = (metrics.MetricsLogger(log_path) if log_path
+                         else None)
+        if _state.cache.degraded_reason:
+            _log({"event": "tuning_plan_degraded", "path": path,
+                  "reason": _state.cache.degraded_reason})
+        if not auto_active:
+            _log({"event": "tuning_plan_inactive", "path": path,
+                  "entries": len(_state.cache),
+                  "reason": "plan loaded but no backend resolves to "
+                            "'auto'; set backend='auto' (or a per-op "
+                            "'auto') for the plan to drive selection"})
+        selector.set_plan_provider(plan_lookup)
+        return _state.cache
+
+
+def reset() -> None:
+    """Deactivate (``runtime.stop``): drop the in-memory plan and
+    unregister the provider.  Counters survive — they are process-level
+    bookkeeping the tests read across init/stop cycles."""
+    from .. import selector
+
+    with _state.lock:
+        _state.cache = None
+        selector.clear_plan_provider()
+
+
+def plan_lookup(op: str, nbytes: int, dtype,
+                axes=None) -> Optional[str]:
+    """Read-only plan consult (the selector's plan provider): returns
+    the planned backend for this key, or None on a miss / inactive
+    tuning.  ``axes`` is the axis subset the collective spans (None =
+    whole mesh) — part of the key, so whole-mesh decisions are never
+    replayed for unmeasured axis subsets.  Never raises, never
+    measures — safe at trace time."""
+    from .. import runtime
+
+    st = _state
+    cache = st.cache  # snapshot: a concurrent stop() may null st.cache
+    if (cache is None or cache.degraded_reason is not None
+            or dtype is None or not runtime.is_initialized()):
+        return None
+    try:
+        mesh = runtime.current_mesh()
+        key = fingerprint.fingerprint(op, int(nbytes or 0), dtype, mesh,
+                                      axes=axes)
+    except Exception:  # noqa: BLE001 — lookup must never take down a step
+        return None
+    entry = cache.get(key)
+    if entry is None:
+        return None
+    if key not in st.logged_keys:
+        st.logged_keys.add(key)
+        _log({"event": "tuning_decision", "op": op, "key": key,
+              "backend": entry.backend, "source": "plan",
+              "entry_source": entry.source})
+    return entry.backend
+
+
+def _multiprocess() -> bool:
+    try:
+        import jax
+
+        return jax.process_count() > 1
+    except Exception:  # noqa: BLE001
+        return False
+
+
+def _eligible_candidates(op: str, n_dcn: int) -> List[str]:
+    from .. import selector
+
+    cands = []
+    for b in sorted(selector.available(op)):
+        if b == "hierarchical" and n_dcn <= 1:
+            continue  # two-level staging needs a real outer axis
+        cands.append(b)
+    if DEFAULT_BACKEND not in cands:
+        cands.insert(0, DEFAULT_BACKEND)
+    return cands
+
+
+def resolve_eager(op: str, nbytes: int, dtype, mesh,
+                  runner: Callable[[str], object]) -> Optional[str]:
+    """Resolve ``"auto"`` for one eager collective call.
+
+    ``runner(backend)`` executes the collective with that explicit
+    backend (supplied by ``collectives._eager_collective``).  Returns
+    the backend to use, or None to degrade to static selection.
+    """
+    st = _state
+    cache = st.cache  # snapshot: a concurrent stop() may null st.cache
+    if cache is None or cache.degraded_reason is not None:
+        # Degraded plan (corrupt / version mismatch): static-cutover
+        # behavior, no measuring, no overwriting the evidence on disk.
+        return None
+    key = fingerprint.fingerprint(op, nbytes, dtype, mesh)
+    entry = cache.get(key)
+    if entry is None and _multiprocess():
+        # Multi-host SPMD: per-process online measurement cannot agree
+        # across hosts (local timings, local files, locally-skipped
+        # candidates) and divergent backend choices compile mismatched
+        # programs -> distributed hang.  Plans are read-only here:
+        # distribute ONE plan file to every host (shared FS, or
+        # plan_tool merge + copy) — docs/TUNING.md.
+        if key not in st.logged_keys:
+            st.logged_keys.add(key)
+            _log({"event": "tuning_decision", "op": op, "key": key,
+                  "backend": DEFAULT_BACKEND, "source": "fallback",
+                  "reason": "multiprocess: online measurement disabled"})
+        return None
+    if entry is not None:
+        if key not in st.logged_keys:
+            st.logged_keys.add(key)
+            _log({"event": "tuning_decision", "op": op, "key": key,
+                  "backend": entry.backend, "source": "plan",
+                  "entry_source": entry.source})
+        return entry.backend
+    with st.lock:
+        if st.measuring:
+            return None  # re-entrant call during a measurement: static
+        # Key may have been measured while we waited on the lock.
+        entry = cache.get(key)
+        if entry is not None:
+            return entry.backend
+        st.measuring = True
+    try:
+        axes = mesh.axis_names
+        n_dcn = int(mesh.shape[axes[0]]) if len(axes) > 1 else 1
+        cands: Dict[str, metrics.TimedResult] = {}
+        errors: Dict[str, str] = {}
+        for b in _eligible_candidates(op, n_dcn):
+            try:
+                cands[b] = measure.measure(lambda b=b: runner(b),
+                                           iters=st.iters,
+                                           rounds=st.rounds)
+            except Exception as e:  # noqa: BLE001 — skip broken candidate
+                errors[b] = str(e)[:120]
+        if not cands:
+            _log({"event": "tuning_decision", "op": op, "key": key,
+                  "backend": DEFAULT_BACKEND, "source": "fallback",
+                  "errors": errors})
+            return None
+        winner, evidence = measure.noise_gate(cands, DEFAULT_BACKEND)
+        st.measure_count += 1
+        new = plancache.PlanEntry(
+            backend=str(winner), source="measured",
+            median_ms={b: round(r.median * 1e3, 4)
+                       for b, r in cands.items()},
+            jitter_ms={b: round(r.jitter * 1e3, 4)
+                       for b, r in cands.items()},
+            rounds=st.rounds)
+        cache.put(key, new)
+        try:
+            cache.save()  # best-effort; unwritable paths stay in-memory
+        except Exception:  # noqa: BLE001 — persistence never fails a step
+            pass
+        st.logged_keys.add(key)
+        _log({"event": "tuning_decision", "op": op, "key": key,
+              "backend": new.backend, "source": "measured",
+              "evidence": evidence, **({"errors": errors} if errors
+                                       else {})})
+        return new.backend
+    finally:
+        st.measuring = False

@@ -1,0 +1,70 @@
+"""Topology fingerprints: the key space of the collective plan database.
+
+A plan entry answers "which backend won for THIS situation"; the
+fingerprint is what "situation" means: platform, mesh axis shape, op,
+dtype, and a log2 size bucket.  Two processes on the same platform and
+mesh shape produce identical keys, which is what lets a plan measured
+once be reused by every later process (the compilecache move, applied
+to backend selection).
+
+Sizes are bucketed to floor(log2(nbytes)) — the granularity at which
+backend crossover points actually move (the reference's cutover
+constants were powers of two for the same reason), and coarse enough
+that a handful of entries covers a training run's gradient sizes.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+def size_bucket(nbytes: int) -> int:
+    """floor(log2(nbytes)); sizes of 0/1 byte share bucket 0."""
+    return max(0, int(nbytes).bit_length() - 1)
+
+
+def bucket_bytes(bucket: int) -> int:
+    """Lower edge (in bytes) of ``bucket`` — inverse of size_bucket."""
+    return 1 << bucket
+
+
+def mesh_key(mesh, axes=None) -> str:
+    """Ordered axis-name:size signature, e.g. ``dcn:2,ici:4``.
+
+    ``axes`` restricts the signature to the axes the collective actually
+    spans (in-axis calls over a mesh subset): a decision measured over
+    the whole mesh must not be replayed for an axis subset that was
+    never measured — different axes, different key, safe plan miss.
+    """
+    if axes is None:
+        return ",".join(f"{a}:{int(s)}" for a, s in mesh.shape.items())
+    # Normalize to mesh order so equivalent spans share a key:
+    # ("ici", "dcn") and ("dcn", "ici") name the same device group.
+    sel = set(axes)
+    return ",".join(f"{a}:{int(s)}" for a, s in mesh.shape.items()
+                    if a in sel)
+
+
+def platform_of(mesh) -> str:
+    try:
+        # flatiter indexing: O(1), no device-list materialization on the
+        # per-call plan-hit path.
+        return mesh.devices.flat[0].platform
+    except Exception:  # noqa: BLE001 — degrade to a generic key
+        return "unknown"
+
+
+def fingerprint(op: str, nbytes: int, dtype, mesh,
+                platform: Optional[str] = None, axes=None) -> str:
+    """The plan-database key for one (op, size, mesh, platform) decision.
+
+    ``nbytes`` is the PER-RANK payload (what the selector's size cutover
+    compares against), ``dtype`` anything ``np.dtype`` accepts, ``axes``
+    the mesh axes the collective spans (None = the whole mesh — what the
+    eager rank-major mode always uses).
+    """
+    plat = platform if platform is not None else platform_of(mesh)
+    return (f"{plat}|{mesh_key(mesh, axes)}|{op}|{np.dtype(dtype).name}"
+            f"|b{size_bucket(nbytes)}")

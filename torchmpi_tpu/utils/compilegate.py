@@ -383,11 +383,16 @@ def install() -> bool:
         # for a previous host's machine features (SIGILL risk after a
         # container migration).
         _gate.orig_backend_compile = _compiler.backend_compile
-        _gate.orig_backend_compile_and_load = (
-            _compiler.backend_compile_and_load)
         _compiler.backend_compile = _wrap(_compiler.backend_compile)
-        _compiler.backend_compile_and_load = _wrap(
-            _compiler.backend_compile_and_load)
+        # Older jax (< 0.6) has no backend_compile_and_load; wrap it only
+        # where it exists so the gate arms on either version.
+        if hasattr(_compiler, "backend_compile_and_load"):
+            _gate.orig_backend_compile_and_load = (
+                _compiler.backend_compile_and_load)
+            _compiler.backend_compile_and_load = _wrap(
+                _compiler.backend_compile_and_load)
+        else:
+            _gate.orig_backend_compile_and_load = None
         _gate.installed = True
         return True
 
@@ -399,6 +404,7 @@ def uninstall() -> None:
         from jax._src import compiler as _compiler
 
         _compiler.backend_compile = _gate.orig_backend_compile
-        _compiler.backend_compile_and_load = (
-            _gate.orig_backend_compile_and_load)
+        if _gate.orig_backend_compile_and_load is not None:
+            _compiler.backend_compile_and_load = (
+                _gate.orig_backend_compile_and_load)
         _gate.installed = False

@@ -1,0 +1,45 @@
+"""Forward-compatibility shims for older jax releases.
+
+The codebase targets the modern jax surface (``jax.shard_map`` taking
+``check_vma=``).  On jax 0.4.x that API lives at
+``jax.experimental.shard_map.shard_map`` and the kwarg is spelled
+``check_rep=``.  :func:`install` backfills the modern name onto the
+``jax`` module itself so every call site — library, tests, examples,
+including plain ``from jax import shard_map`` — works unchanged on
+either version.  On a jax that already has ``jax.shard_map`` this is a
+no-op.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+
+def install() -> None:
+    """Backfill missing modern names onto ``jax`` (idempotent)."""
+    if not hasattr(jax, "shard_map"):
+        from jax.experimental import shard_map as _sm
+
+        _orig = _sm.shard_map
+
+        @functools.wraps(_orig)
+        def shard_map(f, mesh=None, in_specs=None, out_specs=None, *,
+                      check_vma=None, check_rep=None, **kw):
+            if check_rep is None:
+                check_rep = True if check_vma is None else check_vma
+            return _orig(f, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_rep=check_rep, **kw)
+
+        jax.shard_map = shard_map
+
+    from jax import lax
+
+    if not hasattr(lax, "axis_size"):
+        # psum of a Python literal constant-folds to the static axis size
+        # (the long-standing idiom lax.axis_size formalized).
+        def axis_size(axis_name):
+            return lax.psum(1, axis_name)
+
+        lax.axis_size = axis_size

@@ -26,22 +26,52 @@ def fence(x) -> None:
 # Per-round seconds/iter of the most recent timed() call, fastest first
 # is NOT applied — this is the raw chronological spread, so a consumer
 # can audit how far min-of-rounds sits from the mean (ADVICE r3: the
-# min-selection headline must leave the spread on the record).
+# min-selection headline must leave the spread on the record).  Kept for
+# backward compatibility; new code should read TimedResult.round_times.
 last_round_times: List[float] = []
 
 
-def timed(step, iters: int, fence=fence, rounds: int = 3) -> float:
-    """Seconds per iteration of ``step``: one warm/compile call, then the
-    FASTEST of ``rounds`` fenced timing rounds of ``iters`` dispatches.
+class TimedResult(float):
+    """Structured result of :func:`timed`.
+
+    IS a float (min-of-rounds seconds/iter) so every existing consumer
+    keeps working, and carries the full per-round spread:
+
+    - ``round_times``  chronological seconds/iter of each round
+    - ``median``       median of the rounds (the autotune scoring rule)
+    - ``jitter``       half the inter-quartile range — the scale a knob
+                       delta must clear to be more than noise
+    """
+
+    __slots__ = ("round_times", "median", "jitter")
+
+    def __new__(cls, round_times: List[float]) -> "TimedResult":
+        ts = list(round_times)
+        self = super().__new__(cls, min(ts))
+        s = sorted(ts)
+        n = len(s)
+        self.round_times = ts
+        self.median = (s[n // 2] if n % 2
+                       else 0.5 * (s[n // 2 - 1] + s[n // 2]))
+        self.jitter = (0.5 * (s[(3 * n) // 4] - s[n // 4]) if n >= 4
+                       else 0.5 * (s[-1] - s[0]))
+        return self
+
+
+def timed(step, iters: int, fence=fence, rounds: int = 3) -> TimedResult:
+    """Seconds per iteration of ``step``: one warm/compile call, then
+    ``rounds`` fenced timing rounds of ``iters`` dispatches, returned as
+    a :class:`TimedResult` — a float equal to the FASTEST round, with
+    the median/jitter/per-round spread attached.
 
     Min-of-rounds is load-bearing on the relay platform: the first
     post-compile round can run ~100x slower than steady state (measured
     2026-07-30: ~600-1100 ms/step settling to ~7 ms) even after a fenced
     warmup call, so a single timing pass understates throughput 2-3x.
-    The per-round times of the last call are published in
-    ``last_round_times`` (chronological) so callers can attach the
-    spread to their records.  The shared harness behind bench.py and the
-    scripts/ sweeps."""
+    The per-round times of the last call are also published in
+    ``last_round_times`` (chronological, backward compat).  The shared
+    harness behind bench.py, the scripts/ sweeps, and the online
+    collective autoselector (``torchmpi_tpu.tuning``)."""
     out = step()
     fence(out)
     del last_round_times[:]
@@ -51,7 +81,7 @@ def timed(step, iters: int, fence=fence, rounds: int = 3) -> float:
             out = step()
         fence(out)
         last_round_times.append((time.perf_counter() - t0) / iters)
-    return min(last_round_times)
+    return TimedResult(last_round_times)
 
 
 def chained(fn, depth: int = 4):
