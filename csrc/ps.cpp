@@ -435,6 +435,10 @@ uint64_t tm_ps_server_ops(int64_t sid) {
 // handler threads.  Returns the number of fields written, or -1 for an
 // unknown server / too-small buffer.  The idle wait for each next
 // request header is NOT in any bucket (see the Server field comment).
+// The snapshot can be TORN: each atomic loads individually while
+// handler threads keep incrementing, so a snapshot may be mutually
+// inconsistent (ops ticked, its bytes_in not yet visible).  Acceptable
+// for a diagnostic; consumers compare successive snapshots with >=.
 int tm_ps_server_stats(int64_t sid, uint64_t* out, int n) {
   if (n < 7) return -1;
   std::lock_guard<std::mutex> g(g_mu);

@@ -1,0 +1,297 @@
+"""Fused pytree collectives (torchmpi_tpu/fusion.py).
+
+The coalescing layer's contract, proven on the CPU mesh via lowering
+text (the statically verifiable half of the perf claim) plus bitwise
+result equality:
+
+- an N-leaf mixed-dtype tree lowers to <= (dtype groups x buckets)
+  collectives instead of N;
+- bf16 leaves stay bf16 on the wire (no ``result_type`` upcast);
+- fused == per-leaf results bit-for-bit, per dtype;
+- the ZeRO shard layout built on the same spec round-trips exactly.
+"""
+
+import re
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import torchmpi_tpu as mpi
+from torchmpi_tpu import fusion
+from torchmpi_tpu.parallel import gradsync
+
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+N_LEAVES = 32
+
+
+def _mixed_tree(n_leaves=N_LEAVES, seed=0):
+    """>= 32 leaves alternating fp32/bf16, varied shapes, every leading
+    dim divisible by the 8-device mesh (for the reduce_scatter tests)."""
+    rng = np.random.RandomState(seed)
+    tree = {}
+    for i in range(n_leaves):
+        dt = np.float32 if i % 2 == 0 else jnp.bfloat16
+        tree[f"p{i:02d}"] = jnp.asarray(rng.randn(8 * (1 + i % 3), 4), dt)
+    return tree
+
+
+def _jit_in_axis(fn, mesh, in_spec=P(), out_spec=P()):
+    return jax.jit(shard_map(fn, mesh=mesh, in_specs=in_spec,
+                             out_specs=out_spec, check_vma=False))
+
+
+def _collective_sigs(txt, opname):
+    """(element_count, element_type) of each ``opname`` op in lowered
+    StableHLO text — the wire payloads, for the no-upcast assertion."""
+    return re.findall(
+        opname + r'.*?tensor<([0-9]+)x(bf16|f16|f32|f64|i32)>', txt, re.S)
+
+
+# ---------------------------------------------------------------------------
+# Lowering: launch count and wire dtypes
+# ---------------------------------------------------------------------------
+
+
+def test_allreduce_lowering_collective_count(flat_runtime):
+    """The acceptance criterion: N>=32-leaf mixed-dtype allreduce emits
+    <= dtype-groups x buckets collectives (2 here) instead of N."""
+    mesh = flat_runtime
+    axes = tuple(mesh.axis_names)
+    tree = _mixed_tree()
+
+    def f(t):
+        return mpi.collectives.allreduce_in_axis(t, axes, op="sum")
+
+    spec = fusion.FusedSpec(tree,
+                            max_bytes=mpi.config().fuse_max_bytes)
+    assert len(spec.groups) == 2  # fp32 + bf16
+    txt = _jit_in_axis(f, mesh).lower(tree).as_text()
+    n_ar = txt.count("stablehlo.all_reduce")
+    assert n_ar == spec.n_launches == 2, (n_ar, spec.n_launches)
+
+    # Fusion off: back to one launch per leaf.
+    mpi.set_config(fuse_max_bytes=0)
+    txt0 = _jit_in_axis(f, mesh).lower(tree).as_text()
+    assert txt0.count("stablehlo.all_reduce") == N_LEAVES
+
+
+def test_no_bf16_upcast_on_the_wire(flat_runtime):
+    """Every fused all_reduce payload keeps its group dtype: the bf16
+    group travels as bf16 (the old promoted concat sent it as f32)."""
+    mesh = flat_runtime
+    axes = tuple(mesh.axis_names)
+    tree = _mixed_tree()
+
+    def f(t):
+        return mpi.collectives.allreduce_in_axis(t, axes, op="sum")
+
+    txt = _jit_in_axis(f, mesh).lower(tree).as_text()
+    sigs = _collective_sigs(txt, "all_reduce")
+    spec = fusion.FusedSpec(tree)
+    by_dtype = {("f32" if g.dtype == np.float32 else "bf16"): g.total
+                for g in spec.groups}
+    assert sorted(sigs) == sorted(
+        (str(total), name) for name, total in by_dtype.items()), sigs
+
+
+def test_bucket_splitting_by_max_bytes(flat_runtime):
+    """A small fuse_max_bytes splits each dtype group into
+    ceil(group_bytes / max_bytes) buckets — more launches, still far
+    fewer than leaves."""
+    mesh = flat_runtime
+    axes = tuple(mesh.axis_names)
+    tree = _mixed_tree()
+    max_bytes = 512
+    mpi.set_config(fuse_max_bytes=max_bytes)
+
+    def f(t):
+        return mpi.collectives.allreduce_in_axis(t, axes, op="sum")
+
+    spec = fusion.FusedSpec(tree, max_bytes=max_bytes)
+    expect = sum(-(-g.nbytes // max_bytes) for g in spec.groups)
+    assert spec.n_launches == expect > 2
+    txt = _jit_in_axis(f, mesh).lower(tree).as_text()
+    assert txt.count("stablehlo.all_reduce") == expect < N_LEAVES
+
+
+def test_reduce_scatter_lowering_and_results(flat_runtime):
+    """Fused reduce_scatter: <= groups x buckets collectives, per-leaf
+    tile semantics preserved bit-for-bit, dtypes untouched."""
+    mesh = flat_runtime
+    axes = tuple(mesh.axis_names)
+    tree = _mixed_tree(16)
+
+    def rs(t):
+        return mpi.collectives.reduce_scatter_in_axis(t, axes, op="sum")
+
+    fused_fn = _jit_in_axis(rs, mesh, out_spec=P(axes))
+    txt = fused_fn.lower(tree).as_text()
+    assert txt.count("stablehlo.reduce_scatter") == 2
+
+    mpi.set_config(fuse_max_bytes=0)
+    leaf_fn = _jit_in_axis(rs, mesh, out_spec=P(axes))
+    assert leaf_fn.lower(tree).as_text().count(
+        "stablehlo.reduce_scatter") == 16
+    a, b = fused_fn(tree), leaf_fn(tree)
+    for k in tree:
+        assert a[k].dtype == tree[k].dtype
+        np.testing.assert_array_equal(np.asarray(a[k]), np.asarray(b[k]))
+
+
+def test_reduce_scatter_indivisible_falls_back(flat_runtime):
+    """Leaves whose leading dim the mesh doesn't divide can't coalesce
+    tile-aligned; the tree falls back per-leaf (and still errors on the
+    genuinely un-scatterable leaf, exactly as before fusion)."""
+    mesh = flat_runtime
+    axes = tuple(mesh.axis_names)
+    tree = {"a": jnp.ones((8, 2)), "b": jnp.ones((3, 2))}
+
+    def rs(t):
+        return mpi.collectives.reduce_scatter_in_axis(t, axes, op="sum")
+
+    with pytest.raises(Exception, match="divisible"):
+        _jit_in_axis(rs, mesh, out_spec=P(axes)).lower(tree)
+
+
+# ---------------------------------------------------------------------------
+# Results: fused == per-leaf bit-for-bit, per dtype
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("op", ["sum", "mean", "max"])
+def test_fused_allreduce_bitwise_equals_per_leaf(flat_runtime, op):
+    mesh = flat_runtime
+    axes = tuple(mesh.axis_names)
+    tree = _mixed_tree(seed=3)
+
+    def f(t):
+        return mpi.collectives.allreduce_in_axis(t, axes, op=op)
+
+    fused = _jit_in_axis(f, mesh)(tree)
+    mpi.set_config(fuse_max_bytes=0)
+    leaf = _jit_in_axis(f, mesh)(tree)
+    for k in tree:
+        assert fused[k].dtype == leaf[k].dtype == tree[k].dtype
+        np.testing.assert_array_equal(np.asarray(fused[k]),
+                                      np.asarray(leaf[k]))
+
+
+@pytest.mark.parametrize("opname", ["broadcast", "reduce"])
+def test_fused_rooted_ops_bitwise_equal(flat_runtime, opname):
+    mesh = flat_runtime
+    axes = tuple(mesh.axis_names)
+    tree = _mixed_tree(8, seed=5)
+    entry = getattr(mpi.collectives, f"{opname}_in_axis")
+
+    def f(t):
+        return entry(t, axes, root=2)
+
+    fused_fn = _jit_in_axis(f, mesh)
+    # Both broadcast (masked psum) and reduce lower to all_reduce here.
+    assert fused_fn.lower(tree).as_text().count(
+        "stablehlo.all_reduce") <= 2  # one per dtype group
+    fused = fused_fn(tree)
+    mpi.set_config(fuse_max_bytes=0)
+    leaf = _jit_in_axis(f, mesh)(tree)
+    for k in tree:
+        np.testing.assert_array_equal(np.asarray(fused[k]),
+                                      np.asarray(leaf[k]))
+
+
+def test_gradsync_fused_matches_and_buckets(flat_runtime):
+    """synchronize_gradients on a mixed tree: the n_buckets path
+    distributes buckets per dtype group natively (no promotion), and
+    results match the unfused sync bitwise."""
+    mesh = flat_runtime
+    axes = tuple(mesh.axis_names)
+    tree = _mixed_tree(12, seed=7)
+
+    def sync(n_buckets):
+        def f(t):
+            return gradsync.synchronize_gradients(
+                t, axes, op="sum", n_buckets=n_buckets)
+        return _jit_in_axis(f, mesh)
+
+    fused = sync(1)(tree)
+    bucketed = sync(4)(tree)
+    mpi.set_config(fuse_max_bytes=0)
+    leaf = sync(1)(tree)
+    for k in tree:
+        assert fused[k].dtype == tree[k].dtype
+        np.testing.assert_array_equal(np.asarray(fused[k]),
+                                      np.asarray(leaf[k]))
+        np.testing.assert_allclose(
+            np.asarray(bucketed[k], np.float32),
+            np.asarray(leaf[k], np.float32), rtol=1e-6)
+
+
+def test_scalar_and_single_leaf_trees_unfused(flat_runtime):
+    """Python-scalar leaves and single-leaf trees keep the per-leaf
+    path (nothing to coalesce; scalars have no dtype to group by)."""
+    mesh = flat_runtime
+    axes = tuple(mesh.axis_names)
+
+    def f(t):
+        return mpi.collectives.allreduce_in_axis(t, axes, op="sum")
+
+    out = _jit_in_axis(f, mesh)({"a": jnp.ones((4,)), "b": 1.0})
+    np.testing.assert_allclose(np.asarray(out["a"]), 8 * np.ones(4))
+    assert float(out["b"]) == 8.0
+    single = _jit_in_axis(f, mesh)(jnp.ones((4,)))
+    np.testing.assert_allclose(np.asarray(single), 8 * np.ones(4))
+
+
+# ---------------------------------------------------------------------------
+# FusedSpec / ZeRO shard-layout unit checks (no collectives involved)
+# ---------------------------------------------------------------------------
+
+
+def test_fusedspec_groups_and_launches():
+    tree = _mixed_tree(10)
+    spec = fusion.FusedSpec(tree, max_bytes=1 << 30)
+    assert spec.n_leaves == 10
+    assert [str(np.dtype(g.dtype)) for g in spec.groups] == \
+        ["float32", "bfloat16"]
+    assert spec.n_launches == 2
+    assert sum(g.total for g in spec.groups) == spec.total
+    # n_buckets contract: a single-dtype tree gets exactly K buckets.
+    mono = {k: v for k, v in tree.items() if v.dtype == np.float32}
+    spec_k = fusion.FusedSpec(mono, n_buckets=4)
+    assert spec_k.n_launches == 4
+
+
+def test_flat_roundtrip_and_shard_layout():
+    """flatten/unflatten and the per-device shard layout are exact
+    inverses on a mixed-dtype tree (the ZeRO data path, statically)."""
+    tree = _mixed_tree(9, seed=11)
+    n = 8
+    spec = fusion.FusedSpec(tree, n)
+    assert spec.padded % n == 0 and spec.shard * n == spec.padded
+
+    flat = fusion.flatten_tree(tree, spec)
+    assert flat.shape == (spec.padded,) and flat.dtype == spec.dtype
+    back = fusion.unflatten_tree(flat, spec)
+    for k in tree:
+        assert back[k].dtype == tree[k].dtype
+        np.testing.assert_array_equal(np.asarray(back[k]),
+                                      np.asarray(tree[k]))
+
+    # local_shard over every device index, concatenated rank-major,
+    # regroups to the original tree via unflatten_shards.
+    shards = [fusion.local_shard(tree, spec, i) for i in range(n)]
+    assert all(s.shape == (spec.shard,) for s in shards)
+    regrouped = fusion.unflatten_shards(jnp.concatenate(shards), spec)
+    for k in tree:
+        assert regrouped[k].dtype == tree[k].dtype
+        np.testing.assert_array_equal(np.asarray(regrouped[k]),
+                                      np.asarray(tree[k]))
+
+
+def test_flatspec_alias_is_fusedspec():
+    # gradsync.FlatSpec remains the importable name for the shared spec.
+    assert gradsync.FlatSpec is fusion.FusedSpec

@@ -53,6 +53,7 @@ from .runtime import (
     WORLD_AXES,
 )
 from . import collectives
+from . import fusion
 from . import selector
 from . import tuning
 from . import parallel
@@ -94,7 +95,7 @@ __all__ = [
     "device_count", "local_device_count", "barrier", "world_mesh",
     "current_mesh", "push_communicator", "pop_communicator", "communicator",
     "set_config", "config", "DCN_AXIS", "ICI_AXIS", "WORLD_AXES",
-    "collectives", "selector", "tuning", "parallel", "allreduce",
+    "collectives", "fusion", "selector", "tuning", "parallel", "allreduce",
     "broadcast", "reduce",
     "allgather", "reduce_scatter", "sendreceive", "alltoall", "gather",
     "scatter", "async_", "sync_handle", "AsyncHandle", "compile_budget",

@@ -39,7 +39,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
-from . import runtime, selector
+from . import fusion, runtime, selector
 
 AxisNames = Union[str, Tuple[str, ...]]
 
@@ -151,7 +151,10 @@ def _xla_allgather(x, axis_names):
 
 
 def _xla_reduce_scatter(x, axis_names, *, op="sum"):
-    assert op == "sum", "reduce_scatter supports sum"
+    # ValueError, not assert: an unsupported reduction must fail loudly
+    # under ``python -O`` too, instead of silently computing a sum.
+    if op != "sum":
+        raise ValueError(f"reduce_scatter supports op='sum', got {op!r}")
     return lax.psum_scatter(x, _axes_tuple(axis_names), scatter_dimension=0,
                             tiled=True)
 
@@ -305,10 +308,11 @@ def _config_backend(op_name: str, cfg) -> Tuple[str, bool]:
 
 
 def _pick(op_name: str, x, backend: Optional[str], axes: Tuple[str, ...],
-          mesh: Optional[Mesh] = None):
+          mesh: Optional[Mesh] = None, cfg=None):
     explicit = backend is not None
-    if runtime.is_initialized():
-        cfg = runtime.config()
+    if cfg is not None or runtime.is_initialized():
+        if cfg is None:
+            cfg = runtime.config()
         if backend is None:
             # A per-op table entry bypasses the size cutover like a
             # per-call backend (topology fallback still applies).
@@ -340,8 +344,16 @@ def _pick(op_name: str, x, backend: Optional[str], axes: Tuple[str, ...],
 
 def allreduce_in_axis(x, axis_names: AxisNames, *, op: str = "sum",
                       backend: Optional[str] = None):
-    """Allreduce across mesh axes; for use inside shard_map (hot path)."""
+    """Allreduce across mesh axes; for use inside shard_map (hot path).
+
+    Multi-leaf pytrees coalesce into dtype-grouped, size-bucketed flat
+    transfers (``config.fuse_max_bytes``; one selector-routed collective
+    per bucket, bit-identical results) instead of one launch per leaf —
+    see :mod:`torchmpi_tpu.fusion`."""
     axes = _axes_tuple(axis_names)
+    fused = fusion.maybe_fuse("allreduce", x, axes, backend=backend, op=op)
+    if fused is not None:
+        return fused
     return jax.tree.map(lambda v: _pick("allreduce", v, backend, axes)(
         v, axes, op=op), x)
 
@@ -349,6 +361,10 @@ def allreduce_in_axis(x, axis_names: AxisNames, *, op: str = "sum",
 def broadcast_in_axis(x, axis_names: AxisNames, *, root: int = 0,
                       backend: Optional[str] = None):
     axes = _axes_tuple(axis_names)
+    fused = fusion.maybe_fuse("broadcast", x, axes, backend=backend,
+                              root=root)
+    if fused is not None:
+        return fused
     return jax.tree.map(lambda v: _pick("broadcast", v, backend, axes)(
         v, axes, root=root), x)
 
@@ -356,6 +372,10 @@ def broadcast_in_axis(x, axis_names: AxisNames, *, root: int = 0,
 def reduce_in_axis(x, axis_names: AxisNames, *, root: int = 0, op: str = "sum",
                    backend: Optional[str] = None):
     axes = _axes_tuple(axis_names)
+    fused = fusion.maybe_fuse("reduce", x, axes, backend=backend,
+                              root=root, op=op)
+    if fused is not None:
+        return fused
     return jax.tree.map(lambda v: _pick("reduce", v, backend, axes)(
         v, axes, root=root, op=op), x)
 
@@ -370,6 +390,10 @@ def allgather_in_axis(x, axis_names: AxisNames, *,
 def reduce_scatter_in_axis(x, axis_names: AxisNames, *, op: str = "sum",
                            backend: Optional[str] = None):
     axes = _axes_tuple(axis_names)
+    fused = fusion.maybe_fuse_reduce_scatter(x, axes, backend=backend,
+                                             op=op)
+    if fused is not None:
+        return fused
     return jax.tree.map(lambda v: _pick("reduce_scatter", v, backend, axes)(
         v, axes, op=op), x)
 
@@ -410,9 +434,22 @@ def alltoall_in_axis(x, axis_names: AxisNames, *, split_axis: int = 0,
 
 _jit_cache: Dict[Any, Any] = {}
 
+# Rank-major NamedSharding per mesh: building one costs Python-side
+# work on EVERY eager dispatch (the hot path of the rank-major mode);
+# meshes are few and hashable, so it is cached like the executables.
+_sharding_cache: Dict[Mesh, NamedSharding] = {}
+
 
 def clear_cache() -> None:
     _jit_cache.clear()
+    _sharding_cache.clear()
+
+
+def _rank_major_sharding(m: Mesh) -> NamedSharding:
+    s = _sharding_cache.get(m)
+    if s is None:
+        s = _sharding_cache[m] = NamedSharding(m, P(m.axis_names))
+    return s
 
 
 def _mesh_and_n(mesh: Optional[Mesh]) -> Tuple[Mesh, int]:
@@ -470,8 +507,11 @@ def _host_staged(op_name: str, xs: np.ndarray, n: int, **params):
                 f"{xs.shape[1]} % {n}")
         return np.stack(np.split(xs[root], n, axis=0))
     if op_name == "reduce_scatter":
-        assert params.get("op", "sum") == "sum", \
-            "reduce_scatter supports sum"
+        # ValueError, not assert: must fail loudly under ``python -O``.
+        if params.get("op", "sum") != "sum":
+            raise ValueError(
+                f"reduce_scatter supports op='sum', "
+                f"got {params.get('op')!r}")
         s = xs.sum(axis=0).astype(xs.dtype)
         return np.stack(np.split(s, n, axis=0))
     if op_name == "sendreceive":
@@ -490,9 +530,10 @@ def _host_staged(op_name: str, xs: np.ndarray, n: int, **params):
     raise ValueError(f"host-staged path does not implement {op_name!r}")
 
 
-def _place_rank_major(x, m: Mesh):
+def _place_rank_major(x, m: Mesh, sharding: Optional[NamedSharding] = None):
     """Place a host rank-major array onto the mesh, slice i on device i."""
-    sharding = NamedSharding(m, P(m.axis_names))
+    if sharding is None:
+        sharding = _rank_major_sharding(m)
     if jax.process_count() > 1:
         # Multi-host: device_put of a host array onto a global sharding is
         # not allowed; every process passes the identical full rank-major
@@ -517,12 +558,16 @@ def _eager_collective(op_name: str, x, *, mesh: Optional[Mesh] = None,
             f"{op_name}: leading (rank) axis must have length {n} "
             f"(the current communicator size); got shape {x.shape}"
         )
+    # ONE config read per dispatch (it feeds the staged check, the
+    # "auto" trigger, and _pick's cutover below — re-reading it three
+    # times was measurable Python overhead on the eager hot path).
+    cfg = runtime.config() if runtime.is_initialized() else None
     # Staged mode (config.staged / backend="host"): devices -> host ->
     # compute -> devices, the reference's staged data path.  An explicit
     # non-host backend argument still forces the direct path, mirroring
     # how per-call selector choices overrode the global staged flag.
     if backend == "host" or (backend is None
-                             and runtime.effective_config().staged):
+                             and cfg is not None and cfg.staged):
         out = _host_staged(op_name, np.asarray(x), n, **params)
         return _place_rank_major(np.ascontiguousarray(out), m)
     # Online "auto" mode (config default, per-op table, or an explicit
@@ -533,8 +578,8 @@ def _eager_collective(op_name: str, x, *, mesh: Optional[Mesh] = None,
     # plan (torchmpi_tpu/tuning/).  A degraded plan resolves to None and
     # the static selector path below applies.
     eff = backend
-    if eff is None and runtime.is_initialized():
-        eff, _ = _config_backend(op_name, runtime.config())
+    if eff is None and cfg is not None:
+        eff, _ = _config_backend(op_name, cfg)
     if eff == "auto":
         from . import tuning
 
@@ -551,11 +596,11 @@ def _eager_collective(op_name: str, x, *, mesh: Optional[Mesh] = None,
     # Resolve the implementation *before* the cache lookup: the key must
     # include the resolved impl, or runtime set_config() backend switches
     # would silently reuse a stale executable.
-    impl = _pick(op_name, x[0], backend, axes, mesh=m)
+    impl = _pick(op_name, x[0], backend, axes, mesh=m, cfg=cfg)
     key = (op_name, m, impl, x.shape, x.dtype.name,
            tuple(sorted(params.items())))
-    fn = _jit_cache.get(key)
-    if fn is None:
+    entry = _jit_cache.get(key)
+    if entry is None:
 
         def body(xs):
             y = impl(xs[0], axes, **params)
@@ -570,9 +615,12 @@ def _eager_collective(op_name: str, x, *, mesh: Optional[Mesh] = None,
         # through pallas_call uniformly.
         shmapped = shard_map(body, mesh=m, in_specs=(in_spec,),
                              out_specs=out_spec, check_vma=False)
-        fn = jax.jit(shmapped)
-        _jit_cache[key] = fn
-    return fn(_place_rank_major(x, m))
+        # The cache entry carries the rank-major sharding alongside the
+        # executable so the per-call path does no sharding construction.
+        entry = (jax.jit(shmapped), _rank_major_sharding(m))
+        _jit_cache[key] = entry
+    fn, sharding = entry
+    return fn(_place_rank_major(x, m, sharding))
 
 
 def allreduce(x, *, op: str = "sum", mesh: Optional[Mesh] = None,

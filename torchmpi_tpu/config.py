@@ -151,6 +151,21 @@ class Config:
     # Env: TORCHMPI_TPU_FLASH_PRESCALE.
     flash_prescale: bool = False
 
+    # --- fused pytree collectives ------------------------------------------
+    # Upper bound (bytes) on one fused bucket when the in-axis pytree
+    # collectives (allreduce/reduce/broadcast/reduce_scatter _in_axis,
+    # and nn.synchronize_gradients on top of them) coalesce a tree's
+    # leaves into dtype-grouped flat transfers: leaves group by dtype
+    # (never promoted — mixed fp32/bf16 trees keep bf16 on the wire),
+    # each group concatenates and splits into ceil(bytes/fuse_max_bytes)
+    # buckets, and ONE selector-routed collective is issued per bucket.
+    # O(dtypes x buckets) launches instead of O(leaves), and the
+    # selector size cutover + tuning plan keys see the true fused
+    # transfer size instead of per-leaf crumbs (the torchmpi coalescing
+    # move; same shape as DDP's gradient buckets).  0 disables fusion
+    # (per-leaf launches).  Env: TORCHMPI_TPU_FUSE_MAX_BYTES.
+    fuse_max_bytes: int = 32 * 1024 * 1024
+
     # --- gradient synchronization ------------------------------------------
     # Number of buckets for bucketed/overlapped gradient allreduce.
     gradsync_buckets: int = 1
@@ -181,7 +196,8 @@ class Config:
 
         Env overrides (reference analog: FFI setters callable at any time):
           TORCHMPI_TPU_BACKEND, TORCHMPI_TPU_HIERARCHICAL,
-          TORCHMPI_TPU_CHUNK_BYTES, TORCHMPI_TPU_GRADSYNC_BUCKETS,
+          TORCHMPI_TPU_CHUNK_BYTES, TORCHMPI_TPU_FUSE_MAX_BYTES,
+          TORCHMPI_TPU_GRADSYNC_BUCKETS,
           TORCHMPI_TPU_PS_PORT, TORCHMPI_TPU_ICI_SIZE, TORCHMPI_TPU_DCN_SIZE,
           TORCHMPI_TPU_TUNING_PLAN, TORCHMPI_TPU_TUNING_ROUNDS
         """
@@ -194,6 +210,8 @@ class Config:
             chunk_bytes=_env_int("TORCHMPI_TPU_CHUNK_BYTES", 4 * 1024 * 1024),
             custom_min_bytes=_env_int("TORCHMPI_TPU_CUSTOM_MIN_BYTES", 64 * 1024),
             staged=_env_bool("TORCHMPI_TPU_STAGED", False),
+            fuse_max_bytes=_env_int("TORCHMPI_TPU_FUSE_MAX_BYTES",
+                                    32 * 1024 * 1024),
             flash_prescale=_env_bool("TORCHMPI_TPU_FLASH_PRESCALE", False),
             gradsync_buckets=_env_int("TORCHMPI_TPU_GRADSYNC_BUCKETS", 1),
             gradsync_barrier=_env_bool("TORCHMPI_TPU_GRADSYNC_BARRIER",

@@ -4,6 +4,13 @@ Thin facade over :mod:`torchmpi_tpu.parallel.gradsync` keeping the reference's
 module layout (``torchmpi/nn.lua``, SURVEY.md §3 C10): users who knew
 ``mpinn.synchronizeParameters`` / ``mpinn.synchronizeGradients`` find the same
 verbs here; the TPU-native step builder lives alongside.
+
+``synchronize_gradients`` rides the fused pytree collectives
+(:mod:`torchmpi_tpu.fusion`, ``config.fuse_max_bytes``): a parameter
+tree's gradients coalesce into dtype-grouped, size-bounded flat buckets
+— O(dtypes x buckets) collective launches per step instead of one per
+layer, the coalescing the reference's async per-layer hooks fed into
+its chunked collectives.
 """
 
 from .parallel.gradsync import (  # noqa: F401

@@ -29,7 +29,7 @@ import numpy as np
 from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .. import collectives, runtime
+from .. import collectives, fusion, runtime
 
 PyTree = Any
 AxisNames = Union[str, Tuple[str, ...]]
@@ -93,79 +93,46 @@ def resynchronize_parameters_in_axis(params: PyTree, axis_names: AxisNames,
 # ---------------------------------------------------------------------------
 
 
-class FlatSpec:
-    """Static flatten metadata for one pytree: leaf shapes/dtypes, the
-    promoted concat dtype, and zero-padding up to a multiple of
-    ``n_shards`` (1 = no padding).  The single definition shared by the
-    bucketed allreduce here and ZeRO's reduce_scatter sharding
-    (parallel/zero.py)."""
-
-    def __init__(self, tree: PyTree, n_shards: int = 1):
-        leaves, self.treedef = jax.tree.flatten(tree)
-        self.shapes = [l.shape for l in leaves]
-        self.dtypes = [l.dtype for l in leaves]
-        self.sizes = [int(np.prod(s)) for s in self.shapes]
-        self.total = int(sum(self.sizes))
-        self.dtype = jnp.result_type(*self.dtypes) if leaves else jnp.float32
-        self.padded = max(n_shards, -(-self.total // n_shards) * n_shards)
-        self.shard = self.padded // n_shards
-
-
-def flatten_tree(tree: PyTree, spec: FlatSpec) -> jax.Array:
-    """Concat all leaves (promoted to ``spec.dtype``) into one padded flat
-    vector.  The tree must be non-empty (FlatSpec.total > 0)."""
-    leaves = jax.tree.leaves(tree)
-    flat = jnp.concatenate(
-        [l.astype(spec.dtype).reshape(-1) for l in leaves])
-    return jnp.pad(flat, (0, spec.padded - spec.total))
-
-
-def unflatten_tree(flat: jax.Array, spec: FlatSpec) -> PyTree:
-    """Inverse of :func:`flatten_tree`: slice, reshape, and cast each leaf
-    back to its original dtype (padding dropped)."""
-    outs, off = [], 0
-    for shape, size, dtype in zip(spec.shapes, spec.sizes, spec.dtypes):
-        outs.append(flat[off:off + size].reshape(shape).astype(dtype))
-        off += size
-    return jax.tree.unflatten(spec.treedef, outs)
+# The flatten/bucket/shard machinery is the fusion layer's FusedSpec —
+# ONE definition shared by the fused in-axis collectives, the bucketed
+# allreduce here, and ZeRO's shard layout (parallel/zero.py).  The old
+# names stay importable: FlatSpec(tree, n_shards) is the same contract
+# (single-dtype trees lay out byte-identically; mixed-dtype trees are
+# now group-major so the wire never promotes).
+FlatSpec = fusion.FusedSpec
+flatten_tree = fusion.flatten_tree
+unflatten_tree = fusion.unflatten_tree
 
 
 def _bucketed_allreduce(grads: PyTree, axes: Tuple[str, ...], *, op: str,
                         n_buckets: int, backend: Optional[str],
                         barrier: bool = False) -> PyTree:
-    """Flatten -> concat -> K buckets -> one allreduce each -> unflatten.
+    """Per dtype group: concat -> ~K buckets -> one allreduce each ->
+    unflatten (buckets distribute across groups by byte share; a
+    single-dtype tree gets exactly K, the pre-fusion contract).
 
     The analog of the reference's async per-layer hooks (SURVEY §4.3): K
     independent collectives inside one jit give XLA the freedom to overlap
-    them with surrounding compute.
+    them with surrounding compute.  Unlike the old promoted concat, each
+    group reduces in its native dtype — a mixed fp32/bf16 tree keeps
+    bf16 leaves bf16 on the wire.
 
     ``barrier=True`` chains each bucket's input on the previous bucket's
-    output through ``lax.optimization_barrier``, which keeps the K
-    all-reduces DISTINCT through XLA's all-reduce combiner (measured:
-    below the combine threshold the combiner otherwise merges every
-    bucket into one collective — docs/artifacts/overlap_summary.md) and
-    issues them in order, so the latency-hiding scheduler can overlap
-    bucket i's downstream use with bucket i+1's collective.  The cost is
-    serialization of the collectives themselves; leave it off when one
-    fused all-reduce is fastest (small models).
+    output (across dtype groups too) through ``lax.optimization_barrier``,
+    which keeps the K all-reduces DISTINCT through XLA's all-reduce
+    combiner (measured: below the combine threshold the combiner
+    otherwise merges every bucket into one collective —
+    docs/artifacts/overlap_summary.md) and issues them in order, so the
+    latency-hiding scheduler can overlap bucket i's downstream use with
+    bucket i+1's collective.  The cost is serialization of the
+    collectives themselves; leave it off when one fused all-reduce is
+    fastest (small models).
     """
     if not jax.tree.leaves(grads):
         return grads
-    spec = FlatSpec(grads)
-    flat = flatten_tree(grads, spec)
-    total = spec.total
-    n_buckets = max(1, min(n_buckets, total))
-    bounds = np.linspace(0, total, n_buckets + 1).astype(int)
-    out_parts = []
-    for i in range(n_buckets):
-        part = flat[bounds[i]:bounds[i + 1]]
-        if barrier and out_parts:
-            part, _ = jax.lax.optimization_barrier(
-                (part, out_parts[-1]))
-        out_parts.append(collectives.allreduce_in_axis(
-            part, axes, op=op, backend=backend))
-    flat_out = jnp.concatenate(out_parts) if n_buckets > 1 else out_parts[0]
-    return unflatten_tree(flat_out, spec)
+    spec = fusion.FusedSpec(grads, n_buckets=n_buckets)
+    return fusion.fuse_tree("allreduce", grads, axes, backend=backend,
+                            barrier=barrier, spec=spec, op=op)
 
 
 def synchronize_gradients(grads: PyTree, axis_names: Optional[AxisNames] = None,
@@ -189,6 +156,10 @@ def synchronize_gradients(grads: PyTree, axis_names: Optional[AxisNames] = None,
     ``barrier`` (config default ``gradsync_barrier``) keeps bucketed
     all-reduces distinct through XLA's combiner via optimization
     barriers — see :func:`_bucketed_allreduce`.
+
+    With ``n_buckets <= 1`` the tree rides the fused in-axis allreduce
+    (``config.fuse_max_bytes``): dtype-grouped coalescing, O(dtypes x
+    buckets) launches instead of one per leaf, bit-identical results.
     """
     if axis_names is None:
         axis_names = _all_axes(runtime.current_mesh())

@@ -218,7 +218,13 @@ class ShardedParameterServer:
         ``send_s`` (response writes) — plus ``ops``, ``bytes_in``,
         ``bytes_out``.  The idle wait between requests is in no bucket.
         Backs benchmarks/ps_bench.py's loopback breakdown and the
-        scaling model in docs/ROUND3_NOTES.md."""
+        scaling model in docs/ROUND3_NOTES.md.
+
+        Snapshots can be TORN: the seven counters are read individually
+        while handler threads keep incrementing, so one snapshot may be
+        mutually inconsistent (e.g. ``ops`` ticked but its ``bytes_in``
+        not yet visible).  Fine for a diagnostic — compare successive
+        snapshots with ``>=``, never ``==`` (the tests do)."""
         tot = np.zeros(7, dtype=np.uint64)
         buf = (ctypes.c_uint64 * 7)()
         for sid in self.server_ids:

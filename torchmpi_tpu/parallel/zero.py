@@ -53,7 +53,7 @@ import optax
 from jax import lax, shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
-from .. import collectives, runtime
+from .. import collectives, fusion, runtime
 
 PyTree = Any
 AxisNames = Union[str, Tuple[str, ...]]
@@ -80,22 +80,22 @@ def _axis_index(axes: Tuple[str, ...]):
     return idx
 
 
-# The flatten/pad/unflatten machinery is shared with the bucketed
-# allreduce — one definition in gradsync.
-from .gradsync import (FlatSpec as _FlatSpec,  # noqa: E402
-                       flatten_tree as _flatten,
-                       unflatten_tree as _unflatten)
+# The flatten/pad/shard machinery is the fusion layer's FusedSpec — one
+# definition shared with the fused in-axis collectives and the bucketed
+# allreduce (torchmpi_tpu/fusion.py).
+_FlatSpec = fusion.FusedSpec
 
 
 def _local_shard(params: PyTree, spec: _FlatSpec,
                  axes: Tuple[str, ...]) -> jax.Array:
     """This device's flat extent of ``params`` — THE definition of the
-    shard linearization (row-major :func:`_axis_index` over ``axes``),
-    shared by :func:`init`, :func:`update`, and :func:`shard_params` so
-    they can never disagree about which extent a device owns."""
-    return lax.dynamic_slice(
-        _flatten(params, spec), (_axis_index(axes) * spec.shard,),
-        (spec.shard,))
+    shard linearization (each dtype group's row-major
+    :func:`_axis_index` extent, concatenated group-major, promoted to
+    ``spec.dtype``), shared by :func:`init`, :func:`update`, and
+    :func:`shard_params` so they can never disagree about which extent
+    a device owns — and aligned with the per-dtype-group fused
+    reduce_scatter legs, which deliver exactly these extents."""
+    return fusion.local_shard(params, spec, _axis_index(axes))
 
 
 def _resolve(axis_names: Optional[AxisNames], mesh: Optional[Mesh]
@@ -187,7 +187,7 @@ def update(params: PyTree, grads: PyTree, opt_state: PyTree,
     p_shard = optax.apply_updates(p_shard, updates)
     p_flat = collectives.allgather_in_axis(p_shard, axes,
                                            backend=backend).reshape(-1)
-    return _unflatten(p_flat, spec), new_state
+    return fusion.unflatten_shards(p_flat, spec), new_state
 
 
 def _reduce_scatter_grads(grads: PyTree, axes: Tuple[str, ...], *,
@@ -217,12 +217,23 @@ def _reduce_scatter_grads(grads: PyTree, axes: Tuple[str, ...], *,
     n = _axis_size(axes)
     if spec is None:
         spec = _FlatSpec(params, int(n))
-    g_flat = _flatten(grads, spec)
-    if compress == "bf16":
-        g_flat = g_flat.astype(jnp.bfloat16)
-    g_shard = collectives.reduce_scatter_in_axis(g_flat, axes,
-                                                 backend=backend)
-    g_shard = g_shard.astype(spec.dtype)
+    # One reduce_scatter per dtype group, each in its NATIVE dtype (the
+    # old promoted concat upcast every bf16 leaf to the tree's
+    # result_type on the wire); the group shards then promote to
+    # spec.dtype and concatenate — exactly the _local_shard
+    # linearization, so the optimizer pairs them with the right
+    # parameter extents.  ``compress="bf16"`` still narrows wider
+    # groups on top.
+    g_leaves = jax.tree.leaves(grads)
+    parts = []
+    for g in spec.groups:
+        g_flat = fusion.group_flat(g_leaves, g, pad=True)
+        if compress == "bf16":
+            g_flat = g_flat.astype(jnp.bfloat16)
+        shard = collectives.reduce_scatter_in_axis(g_flat, axes,
+                                                   backend=backend)
+        parts.append(shard.astype(spec.dtype))
+    g_shard = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
     if op == "mean":
         g_shard = g_shard / n
     return g_shard, spec
@@ -268,7 +279,7 @@ def gather_params(p_shard: jax.Array, spec: _FlatSpec,
     axes = _axes_tuple(axis_names)
     flat = collectives.allgather_in_axis(p_shard, axes,
                                          backend=backend).reshape(-1)
-    return _unflatten(flat, spec)
+    return fusion.unflatten_shards(flat, spec)
 
 
 def update3(p_shard: jax.Array, grads: PyTree, opt_state: PyTree,

@@ -18,6 +18,12 @@ topology and tensor size, and the implementations become:
 
 Backends self-register; lookup is by name with size-cutover logic mirroring the
 reference's "small tensors stay on the stock path" constants.
+
+``nbytes`` is the real transfer size: the fused pytree collectives
+(torchmpi_tpu/fusion.py) coalesce a tree's leaves into dtype-grouped
+buckets BEFORE routing, so the cutover and the tuning-plan provider see
+the fused bucket's bytes — not per-leaf crumbs that would always fall
+below ``custom_min_bytes`` and key plan entries at sizes nobody measured.
 """
 
 from __future__ import annotations
