@@ -1,0 +1,205 @@
+"""Lint step functions and example entry points for SPMD collective
+hazards (docs/ANALYSIS.md).
+
+Two target forms, auto-detected per file:
+
+1. **Declared targets** — a Python file defining ``LINT_TARGETS``: a
+   list of dicts ``{"fn": callable, "args": (arrays or
+   jax.ShapeDtypeStructs, ...), "axis_env": [("axis", size), ...],
+   "rules": None}``.  Each target is traced (never executed) and
+   checked in-process.  This is how seeded-bad fixtures and library
+   step functions are linted.
+
+2. **Example entry points** — any other Python file (e.g.
+   ``examples/mnist_allreduce.py``): run as a subprocess with the
+   runtime analysis hook armed (``TORCHMPI_TPU_ANALYSIS=warn`` +
+   ``TORCHMPI_TPU_ANALYSIS_OUT``); every program the example compiles
+   through the library's step builders and eager collectives is checked
+   once per jit-cache entry, and the findings JSON is collected when
+   the process exits.  Pass example arguments after ``--args``.  The
+   example's own exit code is reported but does not gate the lint
+   verdict (tiny ``--steps`` smoke runs legitimately fail convergence
+   asserts); use ``--strict-run`` to gate on it too.
+
+Exit codes: 0 clean (or warnings only), 1 error-severity findings,
+2 a target could not be loaded/analyzed at all.
+
+Usage:
+    python scripts/lint_collectives.py tests/fixtures_analysis.py
+    python scripts/lint_collectives.py examples/mnist_allreduce.py \\
+        --args "--devices 8 --steps 2"
+    python scripts/lint_collectives.py --json ...
+"""
+
+import argparse
+import ast
+import importlib.util
+import json
+import os
+import shlex
+import subprocess
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def _load_module(path: str):
+    name = os.path.splitext(os.path.basename(path))[0]
+    spec = importlib.util.spec_from_file_location(f"_lint_{name}", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _declares_lint_targets(path: str) -> bool:
+    """True iff ``path`` has a top-level ``LINT_TARGETS = ...``
+    assignment — checked via AST, not substring, so a file that merely
+    *mentions* the convention in a docstring is never imported
+    in-process (example imports force device counts / start training)."""
+    try:
+        with open(path) as f:
+            tree = ast.parse(f.read())
+    except (OSError, SyntaxError):
+        return False
+    for node in tree.body:
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign):
+            targets = [node.target]
+        for t in targets:
+            if isinstance(t, ast.Name) and t.id == "LINT_TARGETS":
+                return True
+    return False
+
+
+def _declared_targets(path: str):
+    """LINT_TARGETS from ``path`` if it declares them, else None."""
+    if not _declares_lint_targets(path):
+        return None
+    mod = _load_module(path)
+    return getattr(mod, "LINT_TARGETS", None)
+
+
+def lint_declared(path: str, targets) -> list:
+    from torchmpi_tpu import analysis
+
+    findings = []
+    for i, t in enumerate(targets):
+        label = t.get("label") or f"{os.path.basename(path)}[{i}]"
+        findings.extend(analysis.check(
+            t["fn"], *t.get("args", ()), rules=t.get("rules"),
+            axis_env=t.get("axis_env"), label=label))
+    return findings
+
+
+def lint_example(path: str, extra_args: str, timeout: float):
+    """Run one example under the runtime analysis hook; returns
+    ``(findings, run_rc)`` or raises RuntimeError when the example
+    produced no report at all."""
+    from torchmpi_tpu import analysis
+
+    fd, out_path = tempfile.mkstemp(prefix="lint_findings_",
+                                    suffix=".json")
+    os.close(fd)
+    os.unlink(out_path)
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # examples size their own device counts
+    env["TORCHMPI_TPU_ANALYSIS"] = "warn"
+    env[analysis.ANALYSIS_OUT_ENV] = out_path
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(path),
+         *shlex.split(extra_args or "")],
+        cwd=os.path.dirname(os.path.abspath(path)) or ".",
+        capture_output=True, text=True, timeout=timeout, env=env)
+    try:
+        with open(out_path) as f:
+            raw = json.load(f)
+    except (OSError, ValueError):
+        raise RuntimeError(
+            f"{path}: no analysis report produced (rc={proc.returncode});"
+            f"\nstderr tail: {proc.stderr[-800:]}")
+    finally:
+        try:
+            os.unlink(out_path)
+        except OSError:
+            pass
+    return [analysis.Finding.from_json(d) for d in raw], proc.returncode
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+        epilog=__doc__)
+    p.add_argument("targets", nargs="+",
+                   help="python files: LINT_TARGETS declarations or "
+                        "example entry points")
+    p.add_argument("--args", default="",
+                   help="arguments passed to example subprocesses "
+                        "(e.g. \"--devices 8 --steps 2\")")
+    p.add_argument("--json", action="store_true",
+                   help="emit findings as JSON on stdout")
+    p.add_argument("--rules", default=None,
+                   help="comma-separated rule subset for declared "
+                        "targets (e.g. D1,D2,C1)")
+    p.add_argument("--timeout", type=float, default=600.0,
+                   help="per-example subprocess timeout (seconds)")
+    p.add_argument("--strict-run", action="store_true",
+                   help="also fail when an example subprocess exits "
+                        "nonzero")
+    args = p.parse_args(argv)
+
+    from torchmpi_tpu import analysis
+
+    rules = args.rules.split(",") if args.rules else None
+    all_findings = []
+    load_failures = 0
+    run_failures = 0
+    for path in args.targets:
+        try:
+            targets = _declared_targets(path)
+        except Exception as e:  # noqa: BLE001 — report, keep linting
+            print(f"error: cannot load {path}: {e}", file=sys.stderr)
+            load_failures += 1
+            continue
+        try:
+            if targets is not None:
+                found = lint_declared(path, [
+                    dict(t, rules=t.get("rules") or rules)
+                    for t in targets])
+                rc = 0
+            else:
+                found, rc = lint_example(path, args.args, args.timeout)
+        except Exception as e:  # noqa: BLE001 — report, keep linting
+            print(f"error: {path}: {e}", file=sys.stderr)
+            load_failures += 1
+            continue
+        if rc != 0:
+            run_failures += 1
+            print(f"note: {path} subprocess exited {rc} "
+                  f"(not gating; --strict-run gates)", file=sys.stderr)
+        all_findings.extend(found)
+        if not args.json:
+            tag = analysis.max_severity(found) or "clean"
+            print(f"{path}: {len(found)} finding(s) [{tag}]")
+
+    all_findings = analysis.sort_findings(all_findings)
+    if args.json:
+        print(json.dumps([f.to_json() for f in all_findings], indent=1))
+    else:
+        for f in all_findings:
+            print(f"  {f}")
+    if load_failures:
+        return 2
+    if analysis.has_errors(all_findings):
+        return 1
+    if args.strict_run and run_failures:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -8,11 +8,20 @@ machine; fleets accumulate several (one per topology, or per job's
     python scripts/plan_tool.py merge merged.json a.json b.json [...]
     python scripts/plan_tool.py prune plans.json --older-than-days 30
     python scripts/plan_tool.py prune plans.json --drop-match "ici:4"
+    python scripts/plan_tool.py lint  a.json [b.json ...] [--json]
 
 ``show`` prints one line per entry (key, backend, evidence medians).
 ``merge`` unions entries (newer timestamp wins a key conflict) into OUT.
 ``prune`` drops entries by age and/or key substring, atomically
-rewriting the file.  All commands use PlanCache's never-crash load: a
+rewriting the file.  ``lint`` validates plan files for cross-host
+divergence hazards (the same fingerprint resolved to DIFFERENT backends
+in different files — two hosts of one job would pick different
+implementations for the same collective and deadlock; rule PL1, error)
+and orphaned size buckets (a lone measurement more than 4 log2 buckets
+from its nearest neighbor in an otherwise-measured group — a size
+nobody actually runs, usually a stale experiment; rule PL2, warning),
+reporting via the analyzer's structured Finding type and exiting
+nonzero on errors.  All commands use PlanCache's never-crash load: a
 corrupt input is reported, not a traceback.
 """
 
@@ -91,6 +100,79 @@ def cmd_prune(args) -> int:
     return 0
 
 
+def cmd_lint(args) -> int:
+    import json
+
+    from torchmpi_tpu import analysis
+
+    findings = []
+    # key -> {backend -> [files]} across every input.
+    seen = {}
+    caches = []
+    for path in args.files:
+        cache = _load_or_die(path)
+        caches.append((path, cache))
+        for key, e in cache.entries.items():
+            seen.setdefault(key, {}).setdefault(e.backend, []).append(path)
+
+    # PL1: cross-host divergence — one fingerprint, different backends.
+    for key, by_backend in sorted(seen.items()):
+        if len(by_backend) > 1:
+            detail = "; ".join(
+                f"{b} in {', '.join(sorted(set(fs)))}"
+                for b, fs in sorted(by_backend.items()))
+            findings.append(analysis.Finding(
+                rule="PL1", severity=analysis.ERROR,
+                message=(f"plan key {key} resolves to different backends "
+                         f"across files ({detail}): hosts replaying "
+                         f"different plans pick different collective "
+                         f"implementations for the same step and "
+                         f"deadlock — re-merge with plan_tool merge "
+                         f"(newest wins) before deploying"),
+                path=key))
+
+    # PL2: orphaned size buckets — a measurement >4 log2 buckets from
+    # its nearest neighbor in a group that has other entries.
+    groups = {}
+    for path, cache in caches:
+        for key in cache.entries:
+            prefix, _, bucket = key.rpartition("|b")
+            try:
+                groups.setdefault(prefix, set()).add((int(bucket), key))
+            except ValueError:
+                continue
+    for prefix, buckets in sorted(groups.items()):
+        if len(buckets) < 2:
+            continue
+        ordered = sorted(buckets)
+        for i, (b, key) in enumerate(ordered):
+            gaps = []
+            if i > 0:
+                gaps.append(b - ordered[i - 1][0])
+            if i + 1 < len(ordered):
+                gaps.append(ordered[i + 1][0] - b)
+            if gaps and min(gaps) > 4:
+                findings.append(analysis.Finding(
+                    rule="PL2", severity=analysis.WARNING,
+                    message=(f"size bucket b{b} is {min(gaps)} log2 "
+                             f"buckets from its nearest measured "
+                             f"neighbor in this group — an orphaned "
+                             f"one-off measurement (stale experiment?); "
+                             f"prune it or re-measure the sizes between"),
+                    path=key))
+
+    findings = analysis.sort_findings(findings)
+    if args.json:
+        print(json.dumps([f.to_json() for f in findings], indent=1))
+    else:
+        total = sum(len(c.entries) for _, c in caches)
+        print(f"linted {len(args.files)} file(s), {total} entries: "
+              f"{len(findings)} finding(s)")
+        for f in findings:
+            print(f"  {f}")
+    return 1 if analysis.has_errors(findings) else 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -112,6 +194,14 @@ def main(argv=None) -> int:
     s.add_argument("--drop-match", default=None,
                    help="drop keys containing this substring")
     s.set_defaults(fn=cmd_prune)
+
+    s = sub.add_parser("lint", help="validate plan files: cross-host "
+                                    "divergence (PL1), orphaned size "
+                                    "buckets (PL2)")
+    s.add_argument("files", nargs="+")
+    s.add_argument("--json", action="store_true",
+                   help="emit findings as JSON")
+    s.set_defaults(fn=cmd_lint)
 
     args = p.parse_args(argv)
     return args.fn(args)

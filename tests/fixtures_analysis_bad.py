@@ -1,0 +1,33 @@
+"""Seeded-bad programs for ``scripts/lint_collectives.py`` (declared
+LINT_TARGETS mode): each target trips exactly one error-severity rule,
+so the CLI must exit nonzero on this file.  Not a pytest module —
+``tests/test_analysis.py`` drives the CLI over it.
+
+Targets use ``axis_env`` (not shard_map) so linting needs no forced
+device count: the analyzer only traces.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_VEC = jax.ShapeDtypeStruct((128,), jnp.float32)
+
+
+def bad_d1_rank_divergent_collective(x):
+    """Rank-derived cond predicate; psum only on rank 0's branch."""
+    r = lax.axis_index("i")
+    return lax.cond(r == 0, lambda u: lax.psum(u, "i"), lambda u: u, x)
+
+
+def bad_d2_unbound_axis(x):
+    """Collective over an axis no mesh/axis_env binds."""
+    return lax.psum(x, "nonexistent_axis")
+
+
+LINT_TARGETS = [
+    dict(fn=bad_d1_rank_divergent_collective, args=(_VEC,),
+         axis_env=[("i", 8)], label="bad_d1"),
+    dict(fn=bad_d2_unbound_axis, args=(_VEC,),
+         axis_env=[("i", 8)], label="bad_d2"),
+]

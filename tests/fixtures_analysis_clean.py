@@ -1,0 +1,30 @@
+"""Clean near-miss programs for ``scripts/lint_collectives.py``: the
+same shapes as ``fixtures_analysis_bad.py`` with the hazard removed.
+The CLI must exit 0 on this file.  Not a pytest module.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_VEC = jax.ShapeDtypeStruct((131072,), jnp.float32)
+
+
+def clean_data_dependent_cond(x):
+    """Both branches issue the SAME collective sequence; the predicate
+    is data-derived, not rank-derived."""
+    return lax.cond(x.sum() > 0,
+                    lambda u: lax.psum(u, "i"),
+                    lambda u: lax.psum(2.0 * u, "i"), x)
+
+
+def clean_bound_axis(x):
+    return lax.pmax(lax.psum(x, "i"), "i")
+
+
+LINT_TARGETS = [
+    dict(fn=clean_data_dependent_cond, args=(_VEC,),
+         axis_env=[("i", 8)], label="clean_cond"),
+    dict(fn=clean_bound_axis, args=(_VEC,),
+         axis_env=[("i", 8)], label="clean_bound"),
+]

@@ -1,0 +1,606 @@
+"""Static collective-consistency analyzer (torchmpi_tpu.analysis).
+
+Per-rule coverage: every rule D1-D3/P1-P2/C1 has a seeded-bad program
+asserting the exact rule id fires AND a passing near-miss.  Plus: the
+recursive jaxpr walk (pjit/shard_map/scan/cond), the pytest helper, the
+runtime hook (Config.analysis), the lint CLI over the seeded fixture
+files, and plan_tool's plan-DB lint.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax import lax, shard_map
+from jax.sharding import PartitionSpec as P
+
+import torchmpi_tpu as mpi
+from torchmpi_tpu import analysis
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+AXIS_ENV = [("i", 8)]
+VEC = jax.ShapeDtypeStruct((512,), jnp.float32)      # 2 KB
+BIG = jax.ShapeDtypeStruct((32768,), jnp.float32)    # 128 KB >= cutover
+
+
+def _rules(findings):
+    return sorted({f.rule for f in findings})
+
+
+# ---------------------------------------------------------------------------
+# D1: collective under a rank-derived branch
+# ---------------------------------------------------------------------------
+
+
+def test_d1_fires_on_rank_divergent_cond():
+    def bad(x):
+        r = lax.axis_index("i")
+        return lax.cond(r == 0, lambda u: lax.psum(u, "i"),
+                        lambda u: u, x)
+
+    found = analysis.check(bad, VEC, axis_env=AXIS_ENV)
+    assert "D1" in _rules(found)
+    d1 = [f for f in found if f.rule == "D1"][0]
+    assert d1.severity == analysis.ERROR
+    assert d1.op == "psum" and d1.axes == ("i",)
+    assert "test_analysis.py" in d1.source  # provenance survives the walk
+
+
+def test_d1_near_miss_data_dependent_cond():
+    def ok(x):
+        return lax.cond(x.sum() > 0, lambda u: lax.psum(u, "i"),
+                        lambda u: lax.psum(2.0 * u, "i"), x)
+
+    assert "D1" not in _rules(analysis.check(ok, VEC, axis_env=AXIS_ENV))
+
+
+def test_d1_taint_flows_through_arithmetic():
+    # The predicate is (axis_index * 3 + 1) % 2 == 0: still rank-derived
+    # after three ops of laundering.
+    def bad(x):
+        r = (lax.axis_index("i") * 3 + 1) % 2
+        return lax.cond(r == 0, lambda u: lax.psum(u, "i"),
+                        lambda u: u, x)
+
+    assert "D1" in _rules(analysis.check(bad, VEC, axis_env=AXIS_ENV))
+
+
+# ---------------------------------------------------------------------------
+# D2: unbound axis name
+# ---------------------------------------------------------------------------
+
+
+def test_d2_fires_via_trace_error():
+    def bad(x):
+        return lax.psum(x, "ghost")
+
+    found = analysis.check(bad, VEC, axis_env=AXIS_ENV)
+    assert _rules(found) == ["D2"]
+    assert found[0].severity == analysis.ERROR
+
+
+def test_d2_structural_walk_flags_unbound_axes():
+    # Trace with both axes bound, then re-check the jaxpr as if only
+    # "i" were: the walker itself must flag the "j" collective.
+    def f(x):
+        return lax.psum(x, "i") + lax.psum(x, "j")
+
+    closed, records = analysis.trace_fn(
+        f, VEC, axis_env=[("i", 4), ("j", 2)])
+    found = analysis.check_jaxpr(closed, records=records,
+                                 bound_axes=["i"])
+    d2 = [f for f in found if f.rule == "D2"]
+    assert len(d2) == 1 and d2[0].axes == ("j",)
+
+
+def test_d2_trace_error_respects_rules_subset():
+    # With D2 excluded the trace failure must stay loud (re-raise),
+    # not be silently converted into an unselected finding.
+    def bad(x):
+        return lax.psum(x, "ghost")
+
+    with pytest.raises(NameError):
+        analysis.check(bad, VEC, axis_env=AXIS_ENV, rules=("P1",))
+
+
+def test_d2_near_miss_bound_axis():
+    def ok(x):
+        return lax.psum(x, "i")
+
+    assert "D2" not in _rules(analysis.check(ok, VEC, axis_env=AXIS_ENV))
+
+
+# ---------------------------------------------------------------------------
+# D3: mixed collective ordering across branches
+# ---------------------------------------------------------------------------
+
+
+def test_d3_fires_on_mixed_branch_order():
+    def bad(x):
+        def b0(u):
+            return lax.psum(u, "i") + lax.pmax(u, "i")
+
+        def b1(u):
+            return lax.pmax(u, "i") + lax.psum(u, "i")
+
+        return lax.cond(x.sum() > 0, b0, b1, x)
+
+    found = analysis.check(bad, VEC, axis_env=AXIS_ENV, rules=("D3",))
+    assert _rules(found) == ["D3"]
+    assert found[0].severity == analysis.WARNING
+
+
+def test_d3_catches_non_adjacent_branch_reorder():
+    # switch with a 1-collective middle branch must not mask a
+    # b0-vs-b2 reordering (all pairs compared, not just adjacent).
+    def bad(x):
+        def b0(u):
+            return lax.psum(u, "i") + lax.pmax(u, "i")
+
+        def b1(u):
+            return lax.psum(u, "i")
+
+        def b2(u):
+            return lax.pmax(u, "i") + lax.psum(u, "i")
+
+        return lax.switch(jnp.int32(x.sum()) % 3, [b0, b1, b2], x)
+
+    found = analysis.check(bad, VEC, axis_env=AXIS_ENV, rules=("D3",))
+    assert _rules(found) == ["D3"]
+
+
+def test_d3_near_miss_same_order():
+    def ok(x):
+        def branch(u):
+            return lax.psum(u, "i") + lax.pmax(u, "i")
+
+        return lax.cond(x.sum() > 0, branch,
+                        lambda u: branch(2.0 * u), x)
+
+    assert analysis.check(ok, VEC, axis_env=AXIS_ENV, rules=("D3",)) == []
+
+
+# ---------------------------------------------------------------------------
+# P1: per-leaf launches that bypassed fusion
+# ---------------------------------------------------------------------------
+
+
+def test_p1_fires_on_many_small_launches():
+    def bad(xs):
+        return [lax.psum(x, "i") for x in xs]
+
+    found = analysis.check(bad, [VEC] * analysis.P1_MIN_COUNT,
+                           axis_env=AXIS_ENV, rules=("P1",))
+    assert _rules(found) == ["P1"]
+    assert found[0].severity == analysis.WARNING
+
+
+def test_p1_near_miss_below_count():
+    def ok(xs):
+        return [lax.psum(x, "i") for x in xs]
+
+    found = analysis.check(ok, [VEC] * (analysis.P1_MIN_COUNT - 1),
+                           axis_env=AXIS_ENV, rules=("P1",))
+    assert found == []
+
+
+def test_p1_near_miss_fused_path(flat_runtime):
+    # The real fused in-axis allreduce of a many-leaf tree issues a
+    # couple of launches, not one per leaf: P1 must stay quiet.
+    mesh = flat_runtime
+    tree = {f"w{k}": jnp.ones((256,)) for k in range(16)}
+
+    def step(t):
+        body = lambda tt: mpi.collectives.allreduce_in_axis(  # noqa: E731
+            tt, ("dcn", "ici"))
+        return shard_map(body, mesh=mesh, in_specs=P(),
+                         out_specs=P(), check_vma=False)(t)
+
+    found = analysis.check(step, tree, rules=("P1",))
+    assert found == []
+
+
+# ---------------------------------------------------------------------------
+# P2: payload below the cutover / plan bucket floor
+# ---------------------------------------------------------------------------
+
+
+def test_p2_fires_below_cutover():
+    def f(x):
+        return lax.psum(x, "i")
+
+    found = analysis.check(f, VEC, axis_env=AXIS_ENV, rules=("P2",))
+    assert _rules(found) == ["P2"]
+    assert found[0].severity == analysis.INFO
+    assert found[0].nbytes == 2048
+
+
+def test_p2_near_miss_above_cutover_and_scalar():
+    def f(x):
+        return lax.psum(x, "i")
+
+    # Big enough to route custom: quiet.
+    assert analysis.check(f, BIG, axis_env=AXIS_ENV, rules=("P2",)) == []
+    # Scalar-ish payloads (loss reductions) are exempt by design.
+    tiny = jax.ShapeDtypeStruct((2,), jnp.float32)
+    assert analysis.check(f, tiny, axis_env=AXIS_ENV, rules=("P2",)) == []
+
+
+# ---------------------------------------------------------------------------
+# C1: fused / ZeRO layout invariants
+# ---------------------------------------------------------------------------
+
+
+def _zero_rs_step(mesh, spec):
+    from torchmpi_tpu.parallel import zero
+
+    def inner(p):
+        g = jax.tree.map(jnp.ones_like, p)
+        g_shard, _ = zero._reduce_scatter_grads(
+            g, ("dcn", "ici"), spec=spec, params=None, op="sum",
+            backend=None, compress=None)
+        return g_shard
+
+    def step(p):
+        return shard_map(inner, mesh=mesh, in_specs=P(),
+                         out_specs=P(("dcn", "ici")), check_vma=False)(p)
+
+    return step
+
+
+def test_c1_fires_on_stale_zero_spec(flat_runtime):
+    mesh = flat_runtime
+    params = {"w": jnp.ones((16, 4)), "b": jnp.ones((16,))}
+    # Spec built for a 4-device group (a smaller communicator, or a
+    # stale checkpointed layout) but reduce-scattered over all 8
+    # devices: every device would update the wrong parameter extent.
+    stale = mpi.fusion.FusedSpec(params, 4)
+    found = analysis.check(_zero_rs_step(mesh, stale), params,
+                           rules=("C1",))
+    assert _rules(found) == ["C1"]
+    assert found[0].severity == analysis.ERROR
+    assert "8 devices" in found[0].message
+
+
+def test_c1_near_miss_correct_zero_spec(flat_runtime):
+    from torchmpi_tpu.parallel import zero
+
+    mesh = flat_runtime
+    params = {"w": jnp.ones((16, 4)), "b": jnp.ones((16,))}
+    good = zero.flat_spec(params, ("dcn", "ici"), mesh=mesh)
+    found = analysis.check(_zero_rs_step(mesh, good), params,
+                           rules=("C1",))
+    assert found == []
+
+
+def test_c1_fires_on_broken_barrier_chain():
+    # Unit-level: a fuse_tree record whose barrier chain misses a
+    # bucket transition (the invariant PR 2 established) is an error.
+    from torchmpi_tpu.analysis.rules import RuleContext, run_rules
+
+    rec = dict(kind="fuse_tree", op="allreduce", axes=("ici",),
+               source="x.py:1", spec_leaves=4, tree_leaves=4,
+               spec_dtypes=["float32"] * 4, tree_dtypes=["float32"] * 4,
+               spec_sizes=[8, 8, 8, 8], tree_sizes=[8, 8, 8, 8],
+               n_launches=3, barrier=True, barrier_links=1)
+    ctx = RuleContext(events=[], records=[rec], config=mpi.Config())
+    found = run_rules(ctx, rules=("C1",))
+    assert _rules(found) == ["C1"]
+    rec["barrier_links"] = 2  # complete chain: near-miss
+    assert run_rules(ctx, rules=("C1",)) == []
+
+
+def test_c1_gradsync_barrier_chain_is_complete(flat_runtime):
+    # The REAL bucketed+barrier gradsync path must satisfy its own
+    # invariant (chain spans all dtype-group buckets).
+    mesh = flat_runtime
+    grads = {"a": jnp.ones((4096,), jnp.float32),
+             "b": jnp.ones((4096,), jnp.bfloat16),
+             "c": jnp.ones((512,), jnp.float32)}
+
+    def step(g):
+        def inner(gt):
+            return mpi.nn.synchronize_gradients(
+                gt, ("dcn", "ici"), n_buckets=3, barrier=True)
+
+        return shard_map(inner, mesh=mesh, in_specs=P(),
+                         out_specs=P(), check_vma=False)(g)
+
+    found = analysis.check(step, grads, rules=("C1",))
+    assert found == []
+
+
+# ---------------------------------------------------------------------------
+# Recursive walk: pjit / shard_map / scan / cond
+# ---------------------------------------------------------------------------
+
+
+def test_walk_recurses_through_pjit_shard_map_scan_cond(flat_runtime):
+    mesh = flat_runtime
+
+    def inner(v):
+        r = lax.axis_index("ici")
+
+        def body(carry, x):
+            y = lax.cond(r == 0,
+                         lambda u: lax.psum(u, "ici"),
+                         lambda u: u, x)
+            return carry + y.sum(), y
+
+        s, _ = lax.scan(body, 0.0, v.reshape(4, -1))
+        return s
+
+    def step(x):
+        return jax.jit(shard_map(inner, mesh=mesh, in_specs=P(),
+                                 out_specs=P(),
+                                 check_vma=False))(x)
+
+    found = analysis.check(step, jnp.ones((64,)), rules=("D1",))
+    assert _rules(found) == ["D1"]
+    path = found[0].path
+    assert "shard_map" in path and "scan" in path and "cond" in path
+
+
+def test_events_capture_nbytes_dtype_axes():
+    def f(x):
+        return lax.psum(x, "i")
+
+    closed, _ = analysis.trace_fn(
+        f, jax.ShapeDtypeStruct((64,), jnp.bfloat16), axis_env=AXIS_ENV)
+    events = analysis.trace_events(closed, bound_axes=["i"])
+    assert len(events) == 1
+    ev = events[0]
+    assert (ev.primitive, ev.axes, ev.nbytes, ev.dtype) == \
+        ("psum", ("i",), 128, "bfloat16")
+
+
+# ---------------------------------------------------------------------------
+# assert_clean + runtime hook
+# ---------------------------------------------------------------------------
+
+
+def test_assert_clean_raises_with_findings_listed():
+    def bad(x):
+        r = lax.axis_index("i")
+        return lax.cond(r == 0, lambda u: lax.psum(u, "i"),
+                        lambda u: u, x)
+
+    with pytest.raises(AssertionError, match="D1"):
+        analysis.assert_clean(bad, VEC, axis_env=AXIS_ENV)
+
+
+def test_assert_clean_passes_and_returns_quiet_findings():
+    def ok(x):
+        return lax.psum(x, "i")
+
+    found = analysis.assert_clean(ok, VEC, axis_env=AXIS_ENV)
+    assert _rules(found) == ["P2"]  # info-level comes back, not raised
+
+
+def test_check_once_error_mode_raises():
+    def bad(x):
+        r = lax.axis_index("i")
+        return lax.cond(r == 0, lambda u: lax.psum(u, "i"),
+                        lambda u: u, x)
+
+    analysis.reset_captured()
+    with pytest.raises(analysis.AnalysisError, match="D1"):
+        analysis.check_once("unit", bad, VEC, mode="error",
+                            axis_env=AXIS_ENV)
+    assert any(f.rule == "D1" for f in analysis.captured_findings())
+
+
+def test_config_rejects_unknown_analysis_mode():
+    mpi.stop()
+    with pytest.raises(ValueError, match="analysis"):
+        mpi.init(mpi.Config(analysis="loud"))
+    mpi.stop()
+
+
+def test_analysis_mode_normalization(monkeypatch):
+    # Boolean-ish and case-variant spellings normalize identically for
+    # the env AND an explicit Config value.
+    mpi.stop()
+    monkeypatch.setenv("TORCHMPI_TPU_ANALYSIS", "1")
+    mpi.init(mpi.Config(dcn_size=1))
+    assert mpi.config().analysis == "warn"
+    mpi.stop()
+    monkeypatch.delenv("TORCHMPI_TPU_ANALYSIS")
+    mpi.init(mpi.Config(dcn_size=1, analysis="WARN"))
+    assert mpi.config().analysis == "warn"
+    mpi.stop()
+
+
+def test_error_mode_rechecks_on_retry():
+    # A retried call with the same shapes must re-raise, never silently
+    # run the flagged program (the signature is cached only on a
+    # passing check).
+    def bad(x):
+        r = lax.axis_index("i")
+        return lax.cond(r == 0, lambda u: lax.psum(u, "i"),
+                        lambda u: u, x)
+
+    ran = []
+    wrapped = analysis.wrap_step(lambda *a: ran.append(1),
+                                 lambda x: bad(x), label="retry",
+                                 mode="error")
+    # wrap_step's check traces without axis_env; the unbound-axis trace
+    # failure converts to D2 — still error severity, still raises.
+    for _ in range(2):
+        with pytest.raises(analysis.AnalysisError):
+            wrapped(jnp.ones((8,)))
+    assert ran == []
+
+
+def test_runtime_hook_checks_once_per_signature():
+    mpi.stop()
+    mpi.init(mpi.Config(dcn_size=1, analysis="warn"))
+    try:
+        analysis.reset_captured()
+
+        def step(params, opt_state, xb):
+            # Five separate sub-cutover psums: a P1 warning the hook
+            # must surface (warn mode) without failing the run.
+            outs = [lax.psum(p, ("dcn", "ici"))
+                    for p in jax.tree.leaves(params)]
+            return outs, opt_state, xb.sum()
+
+        dp = mpi.nn.data_parallel_step(step, batch_argnums=(2,),
+                                       donate_argnums=())
+        params = tuple(jnp.ones((256,)) for _ in range(5))
+        xb = jnp.ones((8, 2))
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            dp(params, (), xb)
+            n_after_first = len([x for x in w
+                                 if "analysis" in str(x.message)])
+            dp(params, (), xb)  # same signature: no re-check
+            n_after_second = len([x for x in w
+                                  if "analysis" in str(x.message)])
+        assert n_after_first == 1 and n_after_second == 1
+        assert any(f.rule == "P1"
+                   for f in analysis.captured_findings())
+    finally:
+        analysis.reset_captured()
+        mpi.stop()
+
+
+# ---------------------------------------------------------------------------
+# Clean bill: the library's own recipes
+# ---------------------------------------------------------------------------
+
+
+def _tiny_bn_model():
+    import flax.linen as fnn
+
+    class TinyBN(fnn.Module):
+        @fnn.compact
+        def __call__(self, x, train: bool = False):
+            x = x.reshape((x.shape[0], -1))
+            x = fnn.Dense(32)(x)
+            x = fnn.BatchNorm(use_running_average=not train,
+                              momentum=0.9)(x)
+            return fnn.Dense(10)(x)
+
+    return TinyBN()
+
+
+def test_recipes_replicated_step_clean_bill(flat_runtime):
+    from torchmpi_tpu import recipes
+
+    mesh = flat_runtime
+    model = _tiny_bn_model()
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 8, 8, 1)), train=False)
+    params, stats = variables["params"], variables["batch_stats"]
+    tx = optax.sgd(0.1)
+    dp = recipes.make_bn_dp_train_step(model, tx, mesh=mesh,
+                                       donate=False)
+    xb = jnp.zeros((8, 8, 8, 1))
+    yb = jnp.zeros((8,), jnp.int32)
+    # Trace-only over the jitted step: no execution, no compile.
+    analysis.assert_clean(dp.jitted, params, tx.init(params), stats,
+                          xb, yb, label="bn_dp_replicated")
+
+
+def test_recipes_zero1_step_clean_bill(flat_runtime):
+    from torchmpi_tpu import recipes
+    from torchmpi_tpu.parallel import zero
+
+    mesh = flat_runtime
+    model = _tiny_bn_model()
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 8, 8, 1)), train=False)
+    params, stats = variables["params"], variables["batch_stats"]
+    tx = optax.sgd(0.1)
+    zp = recipes.make_bn_dp_train_step(model, tx, mesh=mesh,
+                                       donate=False, zero=1)
+    opt_state = zero.init(params, tx, mesh=mesh)
+    xb = jnp.zeros((8, 8, 8, 1))
+    yb = jnp.zeros((8,), jnp.int32)
+    analysis.assert_clean(zp.jitted, params, opt_state, stats, xb, yb,
+                          label="bn_dp_zero1")
+
+
+# ---------------------------------------------------------------------------
+# CLI: lint_collectives on the seeded fixture files
+# ---------------------------------------------------------------------------
+
+
+def _run_cli(*args, timeout=240):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    return subprocess.run(
+        [sys.executable, os.path.join(_REPO, "scripts",
+                                      "lint_collectives.py"), *args],
+        capture_output=True, text=True, timeout=timeout, cwd=_REPO,
+        env=env)
+
+def test_cli_exits_nonzero_on_seeded_bad_fixtures():
+    out = _run_cli("tests/fixtures_analysis_bad.py", "--json")
+    assert out.returncode == 1, out.stderr
+    findings = json.loads(out.stdout)
+    assert {"D1", "D2"} <= {f["rule"] for f in findings}
+
+
+def test_cli_exits_zero_on_clean_fixtures():
+    out = _run_cli("tests/fixtures_analysis_clean.py")
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
+@pytest.mark.slow
+def test_cli_clean_bill_on_example_entry_points():
+    # Two real examples/ entry points run under the runtime hook: the
+    # library's own training paths must lint clean.
+    for example, args in [
+        ("examples/mnist_allreduce.py", "--devices 8 --steps 2"),
+        ("examples/mnist_sequential.py", "--devices 1 --steps 2"),
+    ]:
+        out = _run_cli(example, "--args", args, timeout=600)
+        assert out.returncode == 0, (example, out.stdout, out.stderr)
+
+
+# ---------------------------------------------------------------------------
+# plan_tool lint
+# ---------------------------------------------------------------------------
+
+
+def test_plan_tool_lint_divergence_and_orphans(tmp_path):
+    from torchmpi_tpu.tuning import PlanCache, PlanEntry
+
+    a = PlanCache(str(tmp_path / "a.json"))
+    b = PlanCache(str(tmp_path / "b.json"))
+    key = "cpu|dcn:2,ici:4|allreduce|float32|b20"
+    a.put(key, PlanEntry(backend="pallas"))
+    b.put(key, PlanEntry(backend="xla"))  # PL1: cross-host divergence
+    # PL2: bucket 4 sits 16 buckets from its only neighbor.
+    a.put("cpu|dcn:2,ici:4|broadcast|float32|b4",
+          PlanEntry(backend="xla"))
+    a.put("cpu|dcn:2,ici:4|broadcast|float32|b20",
+          PlanEntry(backend="xla"))
+    assert a.save() and b.save()
+
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    out = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "scripts", "plan_tool.py"),
+         "lint", a.path, b.path, "--json"],
+        capture_output=True, text=True, timeout=240, cwd=_REPO, env=env)
+    assert out.returncode == 1, out.stderr  # divergence = error
+    rules = {f["rule"] for f in json.loads(out.stdout)}
+    assert rules == {"PL1", "PL2"}
+
+    # Clean single file: exit 0.
+    out = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "scripts", "plan_tool.py"),
+         "lint", a.path],
+        capture_output=True, text=True, timeout=240, cwd=_REPO, env=env)
+    assert out.returncode == 0, out.stdout + out.stderr

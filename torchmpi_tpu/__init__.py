@@ -88,6 +88,38 @@ from .utils.compilegate import (
 # TORCHMPI_TPU_COMPILE_GATE=0.
 _install_compile_gate()
 
+# The static analyzer subpackage loads lazily (PEP 562): with
+# Config.analysis="off" — the default — `import torchmpi_tpu` never
+# imports it, keeping the zero-added-cost claim literal.  Any access
+# (`mpi.analysis`, `from torchmpi_tpu import analysis`) imports it on
+# first touch.
+def __getattr__(name):
+    if name == "analysis":
+        # importlib, not ``from . import``: the from-import form does a
+        # hasattr() probe on this package first, which would re-enter
+        # this very function.
+        import importlib
+
+        mod = importlib.import_module(__name__ + ".analysis")
+        globals()["analysis"] = mod
+        return mod
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+# When the analyzer env opt-in is set, arm the findings capture at
+# import (not just init()): scripts/lint_collectives.py lints example
+# entry points by reading the TORCHMPI_TPU_ANALYSIS_OUT report, and an
+# example that never calls init() (single-device baselines) must still
+# leave an (empty) report rather than look like a crashed run.  Env
+# parsing matches runtime.init's normalization ("1"/"true" == "warn").
+import os as _os
+
+from .runtime import _normalize_analysis as _norm_analysis
+
+if _norm_analysis(_os.environ.get("TORCHMPI_TPU_ANALYSIS",
+                                  "off")) in ("warn", "error"):
+    __getattr__("analysis").arm_runtime_capture()
+
 __version__ = "0.1.0"
 
 __all__ = [
@@ -95,7 +127,8 @@ __all__ = [
     "device_count", "local_device_count", "barrier", "world_mesh",
     "current_mesh", "push_communicator", "pop_communicator", "communicator",
     "set_config", "config", "DCN_AXIS", "ICI_AXIS", "WORLD_AXES",
-    "collectives", "fusion", "selector", "tuning", "parallel", "allreduce",
+    "collectives", "fusion", "selector", "tuning", "analysis", "parallel",
+    "allreduce",
     "broadcast", "reduce",
     "allgather", "reduce_scatter", "sendreceive", "alltoall", "gather",
     "scatter", "async_", "sync_handle", "AsyncHandle", "compile_budget",

@@ -1,0 +1,74 @@
+"""Static SPMD collective-consistency analysis.
+
+TorchMPI's collectives were correct by construction — one communicator
+tree, one call order.  The jax_graft port's correctness instead depends
+on every ``*_in_axis`` call site agreeing across ranks: a rank-divergent
+branch or a shadowed axis name compiles fine and then deadlocks a
+v5e-64 pod.  This package is the static checker for that class of bug:
+trace a step function to a jaxpr (no device execution), walk it
+recursively through ``pjit``/``shard_map``/``scan``/``cond``/
+``custom_vjp`` sub-jaxprs into a stream of collective events, and run a
+rule registry over the stream.
+
+Surfaces:
+
+- :func:`check` — ``check(fn, *args)`` returns structured
+  :class:`Finding`\\ s (rule id, severity, jaxpr path, source
+  provenance).
+- :func:`assert_clean` — the pytest helper; raises on error-severity
+  findings.
+- ``scripts/lint_collectives.py`` — the CLI (``--json``, exit nonzero
+  on errors).
+- ``Config.analysis="warn"|"error"`` (env ``TORCHMPI_TPU_ANALYSIS``) —
+  opt-in runtime hook: the checker runs once per jit-cache entry inside
+  the eager collectives and the step builders.  Off by default; when
+  off there is zero added cost.
+
+Rule catalog: see :mod:`torchmpi_tpu.analysis.rules` and
+``docs/ANALYSIS.md``.
+"""
+
+from .findings import (  # noqa: F401
+    ERROR,
+    WARNING,
+    INFO,
+    Finding,
+    format_findings,
+    has_errors,
+    max_severity,
+    sort_findings,
+)
+from .events import CollectiveEvent, CondFrame, trace_events  # noqa: F401
+from .rules import (  # noqa: F401
+    RULES,
+    P1_MIN_COUNT,
+    P2_MIN_NBYTES,
+    Rule,
+    RuleContext,
+    register_rule,
+    rule_catalog,
+    run_rules,
+)
+from .checker import assert_clean, check, check_jaxpr, trace_fn  # noqa: F401
+from .hook import (  # noqa: F401
+    AnalysisError,
+    ANALYSIS_OUT_ENV,
+    arm_runtime_capture,
+    captured_findings,
+    check_once,
+    report,
+    reset_captured,
+    wrap_step,
+)
+
+__all__ = [
+    "ERROR", "WARNING", "INFO", "Finding", "format_findings",
+    "has_errors", "max_severity", "sort_findings",
+    "CollectiveEvent", "CondFrame", "trace_events",
+    "RULES", "Rule", "RuleContext", "register_rule", "rule_catalog",
+    "run_rules", "P1_MIN_COUNT", "P2_MIN_NBYTES",
+    "assert_clean", "check", "check_jaxpr", "trace_fn",
+    "AnalysisError", "ANALYSIS_OUT_ENV", "arm_runtime_capture",
+    "captured_findings", "check_once", "report",
+    "reset_captured", "wrap_step",
+]

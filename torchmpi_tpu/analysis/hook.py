@@ -1,0 +1,148 @@
+"""Opt-in runtime hook: run the analyzer once per jit-cache entry.
+
+``Config.analysis`` (env ``TORCHMPI_TPU_ANALYSIS``) turns this on:
+
+- ``"warn"``  — findings are emitted as Python warnings; execution
+  continues.
+- ``"error"`` — error-severity findings raise :class:`AnalysisError`
+  before the offending program ever compiles.
+
+The hook sits at the two places the library compiles user-facing
+programs — ``collectives._eager_collective`` (one check per executable
+cache entry) and the step builders in ``parallel/gradsync`` /
+``recipes`` (one check per argument-shape signature).  The check is
+trace-time only and runs exactly once per cache entry: with
+``Config.analysis="off"`` (the default) none of this module is even
+imported, so the steady-state step cost is identical to a build without
+the analyzer.
+
+When ``TORCHMPI_TPU_ANALYSIS_OUT`` names a file, every finding the
+process produced is written there as JSON at exit (clean runs write an
+empty list) — the transport ``scripts/lint_collectives.py`` uses to
+lint example entry points without parsing stdout.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import warnings
+from typing import Callable, List
+
+from .checker import check
+from .findings import Finding, format_findings, has_errors
+
+MODES = ("off", "warn", "error")
+
+ANALYSIS_OUT_ENV = "TORCHMPI_TPU_ANALYSIS_OUT"
+
+
+class AnalysisError(RuntimeError):
+    """Raised under ``Config.analysis="error"`` when the checker finds
+    an error-severity problem in a program about to compile."""
+
+    def __init__(self, label: str, findings: List[Finding]):
+        self.findings = findings
+        super().__init__(
+            f"collective-consistency analysis of {label!r}:\n"
+            f"{format_findings(findings)}")
+
+
+# Every finding any runtime check produced, in order (for the atexit
+# JSON report and for tests).
+_captured: List[Finding] = []
+_atexit_armed = False
+
+
+def captured_findings() -> List[Finding]:
+    return list(_captured)
+
+
+def reset_captured() -> None:
+    _captured.clear()
+
+
+def _write_report() -> None:
+    path = os.environ.get(ANALYSIS_OUT_ENV)
+    if not path:
+        return
+    try:
+        with open(path, "w") as f:
+            json.dump([fi.to_json() for fi in _captured], f, indent=1)
+    except OSError:
+        pass  # best-effort: a report failure must not mask the run
+
+
+def arm_runtime_capture() -> None:
+    """Idempotently register the atexit JSON report (called by
+    ``runtime.init`` when ``Config.analysis`` is on, so the report file
+    exists — possibly empty — for every analyzed process)."""
+    global _atexit_armed
+    if not _atexit_armed:
+        _atexit_armed = True
+        atexit.register(_write_report)
+        # An armed process with no checks yet should still produce the
+        # (empty) report if it dies early.
+        _write_report()
+
+
+def report(label: str, findings: List[Finding], mode: str) -> None:
+    """Deliver one check's findings per the configured mode.
+
+    Info-severity findings are captured (for the JSON report and
+    ``captured_findings``) but never surfaced as Python warnings — a
+    tiny-payload observation must not nag every training run that
+    opted into the checker."""
+    _captured.extend(findings)
+    if not findings:
+        return
+    if mode == "error" and has_errors(findings):
+        raise AnalysisError(label, findings)
+    loud = [f for f in findings if f.severity != "info"]
+    if loud:
+        warnings.warn(
+            f"torchmpi_tpu.analysis[{label}]:\n{format_findings(loud)}",
+            stacklevel=3)
+
+
+def check_once(label: str, fn, *args, mode: str,
+               axis_env=None) -> List[Finding]:
+    """Run the checker on one about-to-compile program and report per
+    ``mode``.  The caller is responsible for the once-per-cache-entry
+    discipline (it owns the cache)."""
+    findings = check(fn, *args, axis_env=axis_env, label=label)
+    report(label, findings, mode)
+    return findings
+
+
+def wrap_step(delegate: Callable, traceable: Callable, *, label: str,
+              mode: str) -> Callable:
+    """Wrap a jitted step so each new argument-shape signature is
+    analyzed (trace-only) before the delegate runs it.
+
+    ``traceable`` is the pre-jit function (the jitted wrapper itself
+    cannot be retraced by ``make_jaxpr``); the signature cache mirrors
+    jit's own, so the check runs exactly once per compiled entry.
+    """
+    import jax
+
+    seen = set()
+
+    def signature(args):
+        return tuple(
+            (getattr(l, "shape", None), str(getattr(l, "dtype", "")))
+            for a in args for l in jax.tree.leaves(a))
+
+    def checked(*args):
+        sig = signature(args)
+        if sig not in seen:
+            # Mark seen only AFTER a passing check: under mode="error"
+            # a retried call with the same shapes must re-check (and
+            # re-raise), never silently run the flagged program.
+            check_once(label, traceable, *args, mode=mode)
+            seen.add(sig)
+        return delegate(*args)
+
+    checked.jitted = getattr(delegate, "jitted", delegate)
+    return checked

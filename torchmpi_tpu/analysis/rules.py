@@ -1,0 +1,360 @@
+"""The rule registry: what the analyzer checks over the event stream.
+
+Each rule is a function ``fn(ctx) -> list[Finding]`` over a
+:class:`RuleContext` (the collective-event stream from
+:mod:`torchmpi_tpu.analysis.events`, the trace-time fusion/ZeRO layout
+records, and the active config).  Rules register under a short id; the
+default run executes all of them, ``check(..., rules=("D1", "P1"))``
+selects a subset.
+
+Shipped rules
+=============
+
+Deadlock / correctness (error severity):
+
+- **D1** — collective under a ``cond``/``switch`` branch whose predicate
+  derives from ``axis_index`` (device rank).  Different devices of the
+  same SPMD program can take different branches, so a collective inside
+  one branch is only entered by a subset of ranks: the classic SPMD
+  divergence deadlock.
+- **D2** — collective over an axis name not bound by any enclosing
+  mesh/``shard_map``/``axis_env``.  Today this surfaces as a late,
+  cryptic trace/XLA error; the rule reports it with provenance (the
+  checker also converts jax's trace-time "unbound axis name" failure
+  into this finding).
+- **C1** — fused-collective / ZeRO layout invariants, re-verified on the
+  actual traced program: the ``FusedSpec`` a fused launch ran with must
+  match the tree it was applied to, a requested ``gradsync_barrier``
+  chain must span ALL dtype-group buckets, and a ZeRO reduce-scatter's
+  shard layout (``n_shards``, per-group padding) must agree with the
+  axes it actually spans.
+
+Hazards / performance (warning or info severity):
+
+- **D3** — mixed-ordering hazard: two branches of the same
+  ``cond``/``switch`` issue the same collectives over the same axes in
+  different orders.  If the branch selection ever diverges across ranks
+  the collectives pair up crosswise and deadlock; even rank-uniform
+  programs are one refactor away.
+- **P1** — >= ``P1_MIN_COUNT`` small same-dtype, same-axes elementwise
+  collectives in one jaxpr region: the per-leaf launch pattern the
+  fused pytree path (``config.fuse_max_bytes``) exists to coalesce.
+- **P2** — collective whose payload falls below the selector's
+  cutover/plan bucket floor (``config.custom_min_bytes``): a transfer
+  too small to ever route to a measured custom backend — the "tiny
+  collective nobody measured" case.  Payloads under
+  ``P2_MIN_NBYTES`` (scalar loss reductions etc.) are exempt.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from .events import CollectiveEvent
+from .findings import ERROR, INFO, WARNING, Finding
+
+# P1: how many coalescable small collectives constitute a hot-path
+# fusion bypass worth flagging.
+P1_MIN_COUNT = 4
+# P2: payloads at or under this are intentionally tiny (scalar losses,
+# flags) and exempt from the "nobody measured this size" report.
+P2_MIN_NBYTES = 256
+
+# Elementwise-fusable primitives (what allreduce/reduce/broadcast lower
+# to): the ops fusion.ELEMENTWISE_OPS would have coalesced.
+_P1_PRIMITIVES = ("psum", "pmin", "pmax")
+
+
+@dataclasses.dataclass
+class RuleContext:
+    """Everything one rule invocation may consult."""
+
+    events: Sequence[CollectiveEvent]
+    records: Sequence[dict]          # fusion/ZeRO trace-time records
+    config: object                   # the effective Config
+    label: str = ""                  # caller-supplied name of the fn
+
+
+@dataclasses.dataclass
+class Rule:
+    id: str
+    severity: str
+    doc: str
+    fn: Callable[[RuleContext], List[Finding]]
+
+
+RULES: Dict[str, Rule] = {}
+
+
+def register_rule(id: str, severity: str, doc: str):
+    def deco(fn):
+        RULES[id] = Rule(id=id, severity=severity, doc=doc, fn=fn)
+        return fn
+    return deco
+
+
+def resolve_rules(rules: Optional[Sequence[str]] = None) -> List[Rule]:
+    if rules is None:
+        return list(RULES.values())
+    out = []
+    for r in rules:
+        if r not in RULES:
+            raise ValueError(
+                f"unknown analysis rule {r!r} (known: {sorted(RULES)})")
+        out.append(RULES[r])
+    return out
+
+
+def run_rules(ctx: RuleContext,
+              rules: Optional[Sequence[str]] = None) -> List[Finding]:
+    findings: List[Finding] = []
+    for rule in resolve_rules(rules):
+        findings.extend(rule.fn(ctx))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# D1: collective under a rank-derived branch (SPMD divergence deadlock)
+# ---------------------------------------------------------------------------
+
+
+@register_rule("D1", ERROR,
+               "collective under a cond/switch branch whose predicate "
+               "derives from axis_index (rank): SPMD divergence deadlock")
+def _rule_d1(ctx: RuleContext) -> List[Finding]:
+    out = []
+    for ev in ctx.events:
+        if not ev.under_divergent_cond:
+            continue
+        frame = next(f for f in ev.cond_stack if f.pred_tainted)
+        out.append(Finding(
+            rule="D1", severity=ERROR,
+            message=(f"{ev.primitive} inside branch {frame.branch} of a "
+                     f"cond whose predicate derives from axis_index: "
+                     f"ranks taking the other branch never enter this "
+                     f"collective (deadlock on hardware)"),
+            path=ev.path, source=ev.source or frame.source,
+            op=ev.primitive, axes=ev.axes, nbytes=ev.nbytes))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# D2: collective over an unbound axis name
+# ---------------------------------------------------------------------------
+
+
+@register_rule("D2", ERROR,
+               "collective over an axis name not bound by any enclosing "
+               "mesh/shard_map/axis_env")
+def _rule_d2(ctx: RuleContext) -> List[Finding]:
+    out = []
+    for ev in ctx.events:
+        missing = ev.unbound_axes
+        if not missing:
+            continue
+        out.append(Finding(
+            rule="D2", severity=ERROR,
+            message=(f"{ev.primitive} names axis "
+                     f"{'/'.join(missing)} which no enclosing mesh or "
+                     f"shard_map binds (bound here: "
+                     f"{sorted(ev.bound_axes) or 'none'})"),
+            path=ev.path, source=ev.source,
+            op=ev.primitive, axes=ev.axes, nbytes=ev.nbytes))
+    return out
+
+
+def unbound_axis_finding(exc: BaseException, label: str = "") -> Finding:
+    """Convert jax's trace-time unbound-axis failure into the D2 finding
+    (the checker calls this when ``make_jaxpr`` itself raises)."""
+    return Finding(
+        rule="D2", severity=ERROR,
+        message=(f"tracing failed with {type(exc).__name__}: {exc} — a "
+                 f"collective names an axis no enclosing mesh/shard_map/"
+                 f"axis_env binds"),
+        path=label)
+
+
+# ---------------------------------------------------------------------------
+# D3: mixed collective ordering across branches of one cond
+# ---------------------------------------------------------------------------
+
+
+@register_rule("D3", WARNING,
+               "same-axis collectives issued in different orders along "
+               "different branches of the same cond/switch")
+def _rule_d3(ctx: RuleContext) -> List[Finding]:
+    # site id -> branch idx -> ordered [(primitive, axes)]
+    sites: Dict[int, Dict[int, List[Tuple[str, Tuple[str, ...]]]]] = {}
+    meta: Dict[int, Tuple[str, str]] = {}  # site -> (source, path)
+    for ev in ctx.events:
+        for frame in ev.cond_stack:
+            sig = (ev.primitive, ev.axes)
+            sites.setdefault(frame.site, {}).setdefault(
+                frame.branch, []).append(sig)
+            meta.setdefault(frame.site, (frame.source, ev.path))
+    out = []
+    for site, branches in sites.items():
+        # ALL branch pairs, not just adjacent ones: an intervening
+        # branch with < 2 collectives must not mask a b0-vs-b2
+        # reordering.  Branch counts are tiny; O(n^2) is free.
+        seqs = [(b, s) for b, s in sorted(branches.items())
+                if len(s) >= 2]
+        done = False
+        for i, (bi, si) in enumerate(seqs):
+            for bj, sj in seqs[i + 1:]:
+                if si != sj and sorted(si) == sorted(sj):
+                    src, path = meta[site]
+                    ops = ", ".join(f"{p} over {'x'.join(a)}"
+                                    for p, a in si)
+                    out.append(Finding(
+                        rule="D3", severity=WARNING,
+                        message=(f"branches {bi} and {bj} of this cond "
+                                 f"issue the same collectives ({ops}) "
+                                 f"in different orders: if branch "
+                                 f"selection ever diverges across "
+                                 f"ranks the collectives pair up "
+                                 f"crosswise and deadlock"),
+                        path=path, source=src))
+                    done = True  # one finding per cond site
+                    break
+            if done:
+                break
+    return out
+
+
+# ---------------------------------------------------------------------------
+# P1: per-leaf launches that bypassed the fused path
+# ---------------------------------------------------------------------------
+
+
+@register_rule("P1", WARNING,
+               "many small same-dtype elementwise collectives that the "
+               "fused pytree path would coalesce")
+def _rule_p1(ctx: RuleContext) -> List[Finding]:
+    fuse_max = int(getattr(ctx.config, "fuse_max_bytes", 0) or 0)
+    if fuse_max <= 0:
+        return []  # fusion disabled on purpose: nothing bypassed it
+    groups: Dict[Tuple, List[CollectiveEvent]] = {}
+    for ev in ctx.events:
+        if ev.primitive not in _P1_PRIMITIVES:
+            continue
+        if not (0 < ev.nbytes < fuse_max):
+            continue
+        groups.setdefault(
+            (ev.region, ev.primitive, ev.axes, ev.dtype), []).append(ev)
+    out = []
+    for (region, prim, axes, dtype), evs in groups.items():
+        if len(evs) < P1_MIN_COUNT:
+            continue
+        total = sum(e.nbytes for e in evs)
+        out.append(Finding(
+            rule="P1", severity=WARNING,
+            message=(f"{len(evs)} separate {prim} launches of small "
+                     f"{dtype} buffers ({total} bytes total) in one "
+                     f"region: the fused pytree path "
+                     f"(config.fuse_max_bytes={fuse_max}) would coalesce "
+                     f"these into "
+                     f"{max(1, -(-total // fuse_max))} launch(es)"),
+            path=evs[0].path, source=evs[0].source,
+            op=prim, axes=axes, nbytes=total))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# P2: collective below the selector cutover / plan bucket floor
+# ---------------------------------------------------------------------------
+
+
+@register_rule("P2", INFO,
+               "collective payload below the selector's cutover/plan "
+               "bucket floor: too small to ever route to a measured "
+               "custom backend")
+def _rule_p2(ctx: RuleContext) -> List[Finding]:
+    floor = int(getattr(ctx.config, "custom_min_bytes", 0) or 0)
+    if floor <= 0:
+        return []
+    out = []
+    for ev in ctx.events:
+        if not (P2_MIN_NBYTES <= ev.nbytes < floor):
+            continue
+        out.append(Finding(
+            rule="P2", severity=INFO,
+            message=(f"{ev.primitive} payload of {ev.nbytes} bytes is "
+                     f"below the custom-backend cutover "
+                     f"(custom_min_bytes={floor}): it always takes the "
+                     f"stock path and no tuning plan will ever measure "
+                     f"this size — consider fusing it with neighbors"),
+            path=ev.path, source=ev.source,
+            op=ev.primitive, axes=ev.axes, nbytes=ev.nbytes))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# C1: fused / ZeRO shard-layout invariants (from trace-time records)
+# ---------------------------------------------------------------------------
+
+
+@register_rule("C1", ERROR,
+               "fused-collective / ZeRO layout invariants: spec matches "
+               "tree, barrier chain spans all dtype-group buckets, shard "
+               "layout agrees with the axes spanned")
+def _rule_c1(ctx: RuleContext) -> List[Finding]:
+    out = []
+    for rec in ctx.records:
+        kind = rec.get("kind")
+        src = rec.get("source", "")
+        if kind == "fuse_tree":
+            if rec.get("spec_leaves") != rec.get("tree_leaves") or \
+                    rec.get("spec_dtypes") != rec.get("tree_dtypes") or \
+                    rec.get("spec_sizes") != rec.get("tree_sizes"):
+                out.append(Finding(
+                    rule="C1", severity=ERROR,
+                    message=(f"fused {rec.get('op')} ran with a FusedSpec "
+                             f"built for a different tree "
+                             f"({rec.get('spec_leaves')} leaves/"
+                             f"{rec.get('spec_sizes')} sizes vs "
+                             f"{rec.get('tree_leaves')}/"
+                             f"{rec.get('tree_sizes')} actual): leaves "
+                             f"unpack from the wrong extents"),
+                    source=src, op=str(rec.get("op", "")),
+                    axes=tuple(rec.get("axes", ()))))
+            n_launches = int(rec.get("n_launches", 1))
+            if rec.get("barrier") and n_launches > 1 and \
+                    int(rec.get("barrier_links", 0)) != n_launches - 1:
+                out.append(Finding(
+                    rule="C1", severity=ERROR,
+                    message=(f"gradsync_barrier chain covers "
+                             f"{rec.get('barrier_links')} of the "
+                             f"{n_launches - 1} bucket transitions: "
+                             f"unchained buckets re-merge in XLA's "
+                             f"all-reduce combiner"),
+                    source=src, op=str(rec.get("op", "")),
+                    axes=tuple(rec.get("axes", ()))))
+        elif kind == "zero_reduce_scatter":
+            n_shards = int(rec.get("n_shards", 1))
+            axis_size = int(rec.get("axis_size", n_shards))
+            if n_shards != axis_size:
+                out.append(Finding(
+                    rule="C1", severity=ERROR,
+                    message=(f"ZeRO shard layout was built for "
+                             f"{n_shards} shards but the reduce_scatter "
+                             f"axes span {axis_size} devices: every "
+                             f"device updates the wrong parameter "
+                             f"extent"),
+                    source=src, axes=tuple(rec.get("axes", ()))))
+            for dtype, padded, shard in rec.get("groups", ()):
+                if n_shards and padded % n_shards != 0:
+                    out.append(Finding(
+                        rule="C1", severity=ERROR,
+                        message=(f"ZeRO {dtype} group padded length "
+                                 f"{padded} is not divisible by "
+                                 f"n_shards={n_shards}: group-major "
+                                 f"shard extents misalign"),
+                        source=src, axes=tuple(rec.get("axes", ()))))
+    return out
+
+
+def rule_catalog() -> List[Tuple[str, str, str]]:
+    """(id, severity, doc) for every registered rule — docs/CLI help."""
+    return [(r.id, r.severity, r.doc) for r in RULES.values()]

@@ -30,7 +30,7 @@ SURVEY.md §4.4).
 
 from __future__ import annotations
 
-from typing import Any, Dict, Optional, Sequence, Tuple, Union
+from typing import Any, Dict, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -615,8 +615,18 @@ def _eager_collective(op_name: str, x, *, mesh: Optional[Mesh] = None,
         # through pallas_call uniformly.
         shmapped = shard_map(body, mesh=m, in_specs=(in_spec,),
                              out_specs=out_spec, check_vma=False)
-        # The cache entry carries the rank-major sharding alongside the
-        # executable so the per-call path does no sharding construction.
+        # Opt-in static analysis, once per cache entry (Config.analysis;
+        # docs/ANALYSIS.md).  Trace-time only — the executable below is
+        # what every later call replays, so the steady state pays
+        # nothing; with the default "off" this branch never imports the
+        # analyzer at all.
+        mode = getattr(cfg, "analysis", "off") if cfg is not None else "off"
+        if mode in ("warn", "error"):
+            from . import analysis
+
+            analysis.check_once(
+                f"eager {op_name}", shmapped,
+                jax.ShapeDtypeStruct(x.shape, x.dtype), mode=mode)
         entry = (jax.jit(shmapped), _rank_major_sharding(m))
         _jit_cache[key] = entry
     fn, sharding = entry

@@ -166,6 +166,16 @@ class Config:
     # (per-leaf launches).  Env: TORCHMPI_TPU_FUSE_MAX_BYTES.
     fuse_max_bytes: int = 32 * 1024 * 1024
 
+    # --- static collective-consistency analysis ----------------------------
+    # Opt-in runtime hook for torchmpi_tpu.analysis (the SPMD
+    # collective-consistency checker — docs/ANALYSIS.md): "off" (default,
+    # zero added cost), "warn" (findings become Python warnings), or
+    # "error" (error-severity findings raise AnalysisError before the
+    # offending program compiles).  The checker runs once per jit-cache
+    # entry inside the eager collectives and the step builders —
+    # trace-time only, never per step.  Env: TORCHMPI_TPU_ANALYSIS.
+    analysis: str = "off"
+
     # --- gradient synchronization ------------------------------------------
     # Number of buckets for bucketed/overlapped gradient allreduce.
     gradsync_buckets: int = 1
@@ -210,6 +220,7 @@ class Config:
             chunk_bytes=_env_int("TORCHMPI_TPU_CHUNK_BYTES", 4 * 1024 * 1024),
             custom_min_bytes=_env_int("TORCHMPI_TPU_CUSTOM_MIN_BYTES", 64 * 1024),
             staged=_env_bool("TORCHMPI_TPU_STAGED", False),
+            analysis=_env_str("TORCHMPI_TPU_ANALYSIS", "off"),
             fuse_max_bytes=_env_int("TORCHMPI_TPU_FUSE_MAX_BYTES",
                                     32 * 1024 * 1024),
             flash_prescale=_env_bool("TORCHMPI_TPU_FLASH_PRESCALE", False),

@@ -39,6 +39,7 @@ equality, and that the lowered HLO collective count actually drops).
 
 from __future__ import annotations
 
+import os
 from typing import Any, List, Optional, Sequence, Tuple
 
 import jax
@@ -49,6 +50,45 @@ from jax import lax
 from . import runtime
 
 PyTree = Any
+
+# ---------------------------------------------------------------------------
+# Trace-time analysis hook (torchmpi_tpu.analysis, rule C1).  The
+# analyzer installs a listener around its make_jaxpr trace; fused
+# launches and the ZeRO reduce-scatter legs then describe their layout
+# (spec-vs-tree agreement, barrier chain coverage, shard alignment) as
+# plain dict records.  One None-check per *trace* when no listener is
+# installed — zero per-step runtime cost.
+# ---------------------------------------------------------------------------
+
+_trace_listener: Optional[Any] = None
+
+
+def set_trace_listener(fn):
+    """Install (``fn``) or clear (``None``) the analysis record
+    listener; returns the previous listener so nested checks restore
+    it."""
+    global _trace_listener
+    prev = _trace_listener
+    _trace_listener = fn
+    return prev
+
+
+def _emit_trace_record(record: dict) -> None:
+    if _trace_listener is not None:
+        _trace_listener(record)
+
+
+def _record_source() -> str:
+    """Best-effort user call-site (``file.py:line``) for a record —
+    the first stack frame outside this package."""
+    import traceback
+
+    pkg = os.path.dirname(os.path.abspath(__file__))
+    for fr in reversed(traceback.extract_stack()[:-2]):
+        if not os.path.abspath(fr.filename).startswith(pkg):
+            return f"{fr.filename}:{fr.lineno}"
+    return ""
+
 
 # In-axis ops with elementwise, shape-preserving semantics: reducing (or
 # copying) a concatenated buffer is exactly the concatenation of the
@@ -222,6 +262,7 @@ def fuse_tree(op_name: str, tree: PyTree, axes: Tuple[str, ...], *,
         spec = FusedSpec(tree)
     out_leaves: List = [None] * spec.n_leaves
     prev = None
+    links = 0
     for g in spec.groups:
         flat = group_flat(leaves, g)
         parts = []
@@ -229,11 +270,25 @@ def fuse_tree(op_name: str, tree: PyTree, axes: Tuple[str, ...], *,
             part = flat[lo:hi]
             if barrier and prev is not None:
                 part, _ = lax.optimization_barrier((part, prev))
+                links += 1
             impl = _pick(op_name, part, backend, axes)
             prev = impl(part, axes, **params)
             parts.append(prev)
         gout = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
         _unpack_group(gout, g, out_leaves)
+    if _trace_listener is not None:
+        _emit_trace_record(dict(
+            kind="fuse_tree", op=op_name, axes=tuple(axes),
+            source=_record_source(),
+            spec_leaves=spec.n_leaves, tree_leaves=len(leaves),
+            spec_dtypes=[np.dtype(d).name for d in spec.dtypes],
+            tree_dtypes=[np.dtype(l.dtype).name for l in leaves
+                         if hasattr(l, "dtype")],
+            spec_sizes=list(spec.sizes),
+            tree_sizes=[int(np.prod(l.shape)) for l in leaves
+                        if hasattr(l, "shape")],
+            n_launches=spec.n_launches, barrier=bool(barrier),
+            barrier_links=links))
     return jax.tree.unflatten(spec.treedef, out_leaves)
 
 

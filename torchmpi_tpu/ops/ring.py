@@ -49,7 +49,6 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
@@ -134,7 +133,10 @@ def _interpret_mode():
         else:
             platform = jax.default_backend()
         if platform == "cpu":
-            return pltpu.InterpretParams()
+            if hasattr(pltpu, "InterpretParams"):
+                return pltpu.InterpretParams()
+            # Older jax (no InterpretParams): the boolean interpreter.
+            return True
     except Exception:
         pass
     return False

@@ -290,6 +290,17 @@ def data_parallel_step(
         return out, completion_token(out)
 
     jitted = jax.jit(wrapped, donate_argnums=tuple(donate_argnums))
+    # Opt-in static analysis (Config.analysis; docs/ANALYSIS.md): check
+    # each new argument-shape signature once — the same cadence as jit's
+    # own compile cache — before the delegate dispatches it.  Off (the
+    # default) wraps nothing: the steady-state path is unchanged.
+    cfg = runtime.config() if runtime.is_initialized() else None
+    mode = getattr(cfg, "analysis", "off") if cfg is not None else "off"
+    if mode in ("warn", "error"):
+        from .. import analysis
+
+        jitted = analysis.wrap_step(jitted, wrapped,
+                                    label="data_parallel_step", mode=mode)
     return throttle_dispatch(jitted, mesh=m, max_inflight=max_inflight)
 
 

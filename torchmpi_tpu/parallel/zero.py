@@ -217,6 +217,17 @@ def _reduce_scatter_grads(grads: PyTree, axes: Tuple[str, ...], *,
     n = _axis_size(axes)
     if spec is None:
         spec = _FlatSpec(params, int(n))
+    # Trace-time layout record for the static analyzer (rule C1): the
+    # shard layout the spec was built for vs the axes this call actually
+    # spans.  A stale spec (wrong n_shards) silently pairs every device
+    # with the wrong parameter extent — exactly what C1 exists to catch.
+    if fusion._trace_listener is not None:
+        fusion._emit_trace_record(dict(
+            kind="zero_reduce_scatter", axes=tuple(axes),
+            source=fusion._record_source(),
+            n_shards=int(spec.n_shards), axis_size=int(n),
+            groups=[(np.dtype(g.dtype).name, int(g.padded), int(g.shard))
+                    for g in spec.groups]))
     # One reduce_scatter per dtype group, each in its NATIVE dtype (the
     # old promoted concat upcast every bf16 leaf to the tree's
     # result_type on the wire); the group shards then promote to

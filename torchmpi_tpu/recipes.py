@@ -141,6 +141,14 @@ def make_bn_dp_train_step(
 
     jitted = jax.jit(wrapped,
                      donate_argnums=(0, 1, 2) if donate else ())
+    cfg = runtime.config() if runtime.is_initialized() else None
+    mode = getattr(cfg, "analysis", "off") if cfg is not None else "off"
+    if mode in ("warn", "error"):
+        from . import analysis
+
+        jitted = analysis.wrap_step(
+            jitted, wrapped, label=f"bn_dp_train_step(zero={zero})",
+            mode=mode)
     return _gradsync.throttle_dispatch(jitted, mesh=m)
 
 

@@ -122,6 +122,21 @@ def _build_world_mesh(cfg: Config, devices: Sequence[jax.Device]) -> Mesh:
     return Mesh(dev_array, WORLD_AXES)
 
 
+def _normalize_analysis(value) -> Optional[str]:
+    """Canonical analysis mode for a config/env value: "off"|"warn"|
+    "error", with boolean-ish spellings accepted ("1"/"true"/"yes"/"on"
+    mean "warn", "0"/"false"/"no"/"" mean "off").  None = unrecognized
+    (the caller raises)."""
+    v = str(value).strip().lower()
+    if v in ("off", "0", "false", "no", "none", ""):
+        return "off"
+    if v in ("warn", "1", "true", "yes", "on"):
+        return "warn"
+    if v == "error":
+        return "error"
+    return None
+
+
 def init(config: Optional[Config] = None, **overrides) -> Mesh:
     """Start the runtime (reference: ``mpi.start(withCuda)`` -> torchmpi_start).
 
@@ -149,6 +164,20 @@ def init(config: Optional[Config] = None, **overrides) -> Mesh:
         # an explicit Config; they must still join the launched job rather
         # than silently running N disconnected single-process copies).
         import os
+
+        # Same any-config rule for the analyzer opt-in: an operator (or
+        # scripts/lint_collectives.py) exporting TORCHMPI_TPU_ANALYSIS
+        # must reach scripts that build their Config explicitly.  An
+        # explicit non-default field still wins.  Normalization happens
+        # in one place for BOTH sources (explicit Config value and env)
+        # so "WARN", "1", and "warn" behave identically everywhere.
+        if _normalize_analysis(cfg.analysis) == "off":
+            cfg.analysis = os.environ.get("TORCHMPI_TPU_ANALYSIS", "off")
+        cfg.analysis = _normalize_analysis(cfg.analysis)
+        if cfg.analysis is None:
+            raise ValueError(
+                "config.analysis (or TORCHMPI_TPU_ANALYSIS) must be "
+                "off|warn|error")
 
         if cfg.coordinator_address is None:
             coord = os.environ.get("TORCHMPI_TPU_COORDINATOR")
@@ -198,6 +227,13 @@ def init(config: Optional[Config] = None, **overrides) -> Mesh:
 
         tuning.configure(cfg.tuning_plan_path, rounds=cfg.tuning_rounds,
                          auto_active=_tuning_auto_active(cfg))
+    if cfg.analysis != "off":
+        # Arm the findings capture (and the TORCHMPI_TPU_ANALYSIS_OUT
+        # atexit report) so even a process that dies before its first
+        # checked compile leaves an (empty) report behind.
+        from . import analysis
+
+        analysis.arm_runtime_capture()
     return world
 
 

@@ -43,3 +43,45 @@ def install() -> None:
             return lax.psum(1, axis_name)
 
         lax.axis_size = axis_size
+
+    # ``jax.export`` is a real submodule on 0.4.x but is lazily gated:
+    # plain ``import jax`` leaves the attribute unset and
+    # ``jax.export.export(...)`` dies with a cryptic AttributeError.
+    # Importing the submodule once makes the modern spelling work.
+    try:
+        # importlib, not ``import jax.export``: a plain import statement
+        # would make ``jax`` a local name for this whole function body.
+        import importlib
+
+        importlib.import_module("jax.export")
+    except ImportError:
+        pass
+
+    # Pallas-TPU renames: the kernels here use the modern spellings
+    # (``CompilerParams``, ``MemorySpace``); 0.4.x only has the
+    # TPU-prefixed ones.  ``InterpretParams`` (the modern interpreter
+    # with race detection / RDMA simulation) has NO 0.4.x analog and is
+    # deliberately NOT backfilled — call sites feature-detect it and
+    # fall back to the boolean ``interpret=True`` interpreter, and
+    # tests that need the modern interpreter's semantics skip.
+    try:
+        from jax.experimental.pallas import tpu as _pltpu
+
+        if not hasattr(_pltpu, "CompilerParams") and \
+                hasattr(_pltpu, "TPUCompilerParams"):
+            _pltpu.CompilerParams = _pltpu.TPUCompilerParams
+        if not hasattr(_pltpu, "MemorySpace") and \
+                hasattr(_pltpu, "TPUMemorySpace"):
+            class _CompatMemorySpace:
+                """Modern ``pltpu.MemorySpace`` names on 0.4.x.  HBM
+                maps to ANY — the 0.4.x spelling of off-VMEM scratch."""
+
+                ANY = _pltpu.TPUMemorySpace.ANY
+                VMEM = _pltpu.TPUMemorySpace.VMEM
+                SMEM = _pltpu.TPUMemorySpace.SMEM
+                SEMAPHORE = _pltpu.TPUMemorySpace.SEMAPHORE
+                HBM = _pltpu.TPUMemorySpace.ANY
+
+            _pltpu.MemorySpace = _CompatMemorySpace
+    except ImportError:
+        pass
