@@ -98,6 +98,39 @@ def _pytree_mode(args, mpi, mesh, sizes):
                   f"(per-leaf/fused)")
 
 
+def _obs_compare_mode(args, mpi, n):
+    """Eager-dispatch overhead of the telemetry layer: the same small
+    allreduce timed under obs=off / metrics / trace (docs/OBSERVABILITY
+    acceptance: off->metrics must sit within the timing noise floor).
+    Small payload on purpose — the Python dispatch path is what the obs
+    branch sits on; large tensors would bury it under transfer time."""
+    import numpy as np
+
+    from torchmpi_tpu.utils import metrics as umetrics
+
+    x = np.random.RandomState(0).rand(n, 1024).astype(np.float32)
+    results = {}
+    for mode in ("off", "metrics", "trace"):
+        mpi.set_config(obs=mode)  # clears the eager jit cache
+        mpi.allreduce(x)  # re-warm the executable under this mode
+        results[mode] = umetrics.timed(lambda: mpi.allreduce(x),
+                                       iters=args.iters, rounds=5)
+        r = results[mode]
+        line = {"mode": mode, "us_per_dispatch": round(r.median * 1e6, 2),
+                "jitter_us": round(r.jitter * 1e6, 2)}
+        print(json.dumps(line) if args.json else
+              f"obs={mode:8s} {r.median * 1e6:9.2f} us/dispatch "
+              f"(jitter {r.jitter * 1e6:.2f} us)")
+    mpi.set_config(obs="off")
+    base, m = results["off"], results["metrics"]
+    delta = m.median - base.median
+    floor = base.jitter + m.jitter
+    verdict = "WITHIN NOISE" if abs(delta) <= floor else "MEASURABLE"
+    print(f"# metrics-vs-off delta {delta * 1e6:+.2f} us "
+          f"(noise floor {floor * 1e6:.2f} us): {verdict}",
+          file=sys.stderr)
+
+
 def main():
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--devices", type=int, default=0,
@@ -120,6 +153,10 @@ def main():
     p.add_argument("--fuse-bytes", type=int, default=None,
                    help="pytree mode: fuse_max_bytes for the fused rows "
                         "(default: the Config default)")
+    p.add_argument("--obs-compare", action="store_true",
+                   help="telemetry overhead mode: the same small eager "
+                        "allreduce under obs=off/metrics/trace "
+                        "(docs/OBSERVABILITY.md)")
     args = p.parse_args()
     if args.devices:
         from torchmpi_tpu.utils.simulation import force_cpu_devices
@@ -145,6 +182,11 @@ def main():
 
     backends = args.backends.split(",")
     sizes = [int(s) for s in args.sizes.split(",")]
+
+    if args.obs_compare:
+        _obs_compare_mode(args, mpi, n)
+        mpi.stop()
+        return
 
     if args.pytree:
         _pytree_mode(args, mpi, mesh, sizes)

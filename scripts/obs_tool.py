@@ -1,0 +1,285 @@
+"""Dump, diff, aggregate, and blame torchmpi_tpu telemetry files
+(docs/OBSERVABILITY.md).
+
+The obs layer (``torchmpi_tpu.obs``) writes one JSONL file per host:
+``metrics_host*.jsonl`` (counter/histogram snapshot) and
+``flight_host*.jsonl`` (the deadlock flight recorder's event ring).
+This tool is the operator surface over those files:
+
+    python scripts/obs_tool.py dump  FILE [FILE ...]
+    python scripts/obs_tool.py agg   FILE [FILE ...] [--json]
+    python scripts/obs_tool.py diff  BEFORE AFTER
+    python scripts/obs_tool.py prom  FILE [FILE ...]
+    python scripts/obs_tool.py blame FLIGHT [FLIGHT ...]
+
+``dump`` validates and pretty-prints any obs file.  ``agg`` sums
+counters and merges histograms across per-host metric files (the
+fleet view).  ``diff`` prints per-series counter deltas between two
+snapshots of the same host (rate over an interval).  ``prom`` renders
+the aggregated snapshot in Prometheus text format.  ``blame`` aligns
+per-host flight-recorder seq streams and names the FIRST diverging
+collective — the runtime complement of the static analyzer's D1/D3
+deadlock rules: hosts of one SPMD gang must issue identical collective
+sequences, so the first seq where op/bytes differ (or where one host
+keeps launching past the others' last event) is where the hang began.
+Exits nonzero on divergence (blame) or unparseable input.
+
+Standalone on purpose: no jax — parsing a pod's post-mortem must not
+need the pod's software stack.  The Prometheus renderer is loaded
+straight from ``torchmpi_tpu/obs/registry.py`` (itself dependency-free)
+without importing the package.
+"""
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+from typing import Dict, List, Tuple
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_registry_module():
+    """Load obs/registry.py by path — reuses prometheus_lines without
+    triggering the torchmpi_tpu package import (which pulls in jax)."""
+    path = os.path.join(_REPO, "torchmpi_tpu", "obs", "registry.py")
+    spec = importlib.util.spec_from_file_location("_obs_registry", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def load_jsonl(path: str) -> Tuple[dict, List[dict]]:
+    """Parse one obs JSONL file -> (meta, records).  Raises ValueError
+    with a line number on malformed input."""
+    meta: dict = {}
+    records: List[dict] = []
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as e:
+                raise ValueError(f"{path}:{i}: not JSON ({e})") from None
+            if not isinstance(rec, dict) or "kind" not in rec:
+                raise ValueError(f"{path}:{i}: record without 'kind'")
+            if rec["kind"] == "meta":
+                meta = rec
+            else:
+                records.append(rec)
+    return meta, records
+
+
+def _series_key(rec: dict) -> Tuple[str, Tuple[Tuple[str, str], ...]]:
+    return (rec["name"], tuple(sorted(rec.get("labels", {}).items())))
+
+
+def _fmt_series(name: str, labels) -> str:
+    lab = ",".join(f"{k}={v}" for k, v in labels)
+    return f"{name}{{{lab}}}" if lab else name
+
+
+def aggregate(files: List[str]) -> List[dict]:
+    """Sum counters / merge histograms across metric files."""
+    counters: Dict = {}
+    hists: Dict = {}
+    for path in files:
+        _, records = load_jsonl(path)
+        for rec in records:
+            if rec["kind"] == "counter":
+                k = _series_key(rec)
+                counters[k] = counters.get(k, 0) + rec["value"]
+            elif rec["kind"] == "hist":
+                k = _series_key(rec)
+                h = hists.setdefault(k, {"buckets": {}, "count": 0,
+                                         "sum": 0.0})
+                for b, c in rec.get("buckets", {}).items():
+                    h["buckets"][b] = h["buckets"].get(b, 0) + c
+                h["count"] += rec.get("count", 0)
+                h["sum"] += rec.get("sum", 0.0)
+    out = [{"kind": "counter", "name": n, "labels": dict(lk), "value": v}
+           for (n, lk), v in sorted(counters.items())]
+    out += [{"kind": "hist", "name": n, "labels": dict(lk),
+             "buckets": dict(sorted(h["buckets"].items(),
+                                    key=lambda kv: int(kv[0]))),
+             "count": h["count"], "sum": h["sum"]}
+            for (n, lk), h in sorted(hists.items())]
+    return out
+
+
+def cmd_dump(args) -> int:
+    rc = 0
+    for path in args.files:
+        try:
+            meta, records = load_jsonl(path)
+        except (OSError, ValueError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            rc = 1
+            continue
+        stream = meta.get("stream", "?")
+        print(f"{path}: stream={stream} host={meta.get('host')} "
+              f"mode={meta.get('mode')} records={len(records)}")
+        for rec in records:
+            if rec["kind"] == "counter":
+                print(f"  {_fmt_series(rec['name'], sorted(rec.get('labels', {}).items()))}"
+                      f" = {rec['value']}")
+            elif rec["kind"] == "hist":
+                bk = " ".join(f"2^{b}:{c}" for b, c
+                              in sorted(rec.get("buckets", {}).items(),
+                                        key=lambda kv: int(kv[0])))
+                print(f"  {_fmt_series(rec['name'], sorted(rec.get('labels', {}).items()))}"
+                      f" count={rec['count']} sum={rec['sum']:.6g} [{bk}]")
+            elif rec["kind"] == "event":
+                print(f"  #{rec['seq']} {rec.get('ev')}:"
+                      f"{rec.get('op') or rec.get('detail')}"
+                      f" {rec.get('nbytes', 0)}B {rec.get('backend', '')}"
+                      f" t={rec.get('ts', 0):.6f}")
+    return rc
+
+
+def cmd_agg(args) -> int:
+    snap = aggregate(args.files)
+    if args.json:
+        print(json.dumps(snap, indent=1))
+    else:
+        print(f"aggregated {len(args.files)} file(s), {len(snap)} series")
+        for rec in snap:
+            labels = sorted(rec.get("labels", {}).items())
+            if rec["kind"] == "counter":
+                print(f"  {_fmt_series(rec['name'], labels)} = {rec['value']}")
+            else:
+                print(f"  {_fmt_series(rec['name'], labels)} "
+                      f"count={rec['count']} sum={rec['sum']:.6g}")
+    return 0
+
+
+def cmd_diff(args) -> int:
+    _, before = load_jsonl(args.before)
+    _, after = load_jsonl(args.after)
+    b = {_series_key(r): r["value"] for r in before
+         if r["kind"] == "counter"}
+    a = {_series_key(r): r["value"] for r in after
+         if r["kind"] == "counter"}
+    shown = 0
+    for k in sorted(set(b) | set(a)):
+        d = a.get(k, 0) - b.get(k, 0)
+        if d:
+            shown += 1
+            sign = "+" if d > 0 else ""
+            print(f"  {_fmt_series(k[0], k[1])} {b.get(k, 0)} -> "
+                  f"{a.get(k, 0)}  ({sign}{d})")
+    print(f"{shown} series changed")
+    return 0
+
+
+def cmd_prom(args) -> int:
+    reg = _load_registry_module()
+    snap = aggregate(args.files)
+    sys.stdout.write("\n".join(reg.prometheus_lines(snap)) + "\n")
+    return 0
+
+
+def _event_sig(e: dict) -> Tuple:
+    """What must agree across an SPMD gang at one seq: the event type,
+    op, and payload (backend compared too — hosts replaying divergent
+    tuning plans compile different programs, the PL1 hazard)."""
+    return (e.get("ev"), e.get("op"), e.get("nbytes"),
+            e.get("backend"))
+
+
+def cmd_blame(args) -> int:
+    streams: Dict[str, Dict[int, dict]] = {}
+    for path in args.files:
+        meta, records = load_jsonl(path)
+        events = {r["seq"]: r for r in records if r["kind"] == "event"}
+        host = str(meta.get("host", path))
+        streams[f"{host} ({os.path.basename(path)})"] = events
+    if len(streams) < 2:
+        print("blame needs >= 2 per-host flight files", file=sys.stderr)
+        return 2
+    names = sorted(streams)
+    if not all(streams.values()):
+        print("a host recorded no flight events — nothing to align")
+        return 2
+    lo = max(min(s) for s in streams.values())
+    hi = min(max(s) for s in streams.values())
+    if hi < lo:
+        print("no overlapping seq range across hosts (rings trimmed "
+              "past each other) — raise obs_ring_size")
+        return 2
+    for seq in range(lo, hi + 1):
+        sigs = {n: _event_sig(streams[n][seq]) for n in names
+                if seq in streams[n]}
+        if len(set(sigs.values())) > 1:
+            print(f"DIVERGENCE at seq {seq} — first collective the "
+                  f"hosts disagree on:")
+            for n in names:
+                e = streams[n].get(seq)
+                desc = (f"{e.get('ev')}:{e.get('op') or e.get('detail')} "
+                        f"{e.get('nbytes', 0)}B {e.get('backend', '')}"
+                        if e else "<no event>")
+                print(f"  {n}: {desc}")
+            return 1
+    # Aligned over the overlap: a host that kept launching past the
+    # others' last event names the collective the laggards never
+    # reached — the classic "rank 0 is stuck, rank 1 moved on" hang.
+    ends = {n: max(s) for n, s in streams.items()}
+    last = min(ends.values())
+    ahead = {n: e for n, e in ends.items() if e > last}
+    if ahead:
+        print(f"aligned through seq {last}; "
+              f"{len(ahead)}/{len(names)} host(s) continued past it:")
+        for n, e in sorted(ahead.items()):
+            nxt = streams[n].get(last + 1)
+            desc = (f"{nxt.get('ev')}:{nxt.get('op') or nxt.get('detail')} "
+                    f"{nxt.get('nbytes', 0)}B" if nxt else "?")
+            print(f"  {n}: reached seq {e}; first extra event: {desc}")
+        print("the lagging host(s) likely hang in (or before) that "
+              "collective")
+        return 1
+    print(f"aligned: {len(names)} hosts agree on seqs {lo}..{hi}")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    s = sub.add_parser("dump", help="validate + pretty-print obs files")
+    s.add_argument("files", nargs="+")
+    s.set_defaults(fn=cmd_dump)
+
+    s = sub.add_parser("agg", help="aggregate per-host metric files")
+    s.add_argument("files", nargs="+")
+    s.add_argument("--json", action="store_true")
+    s.set_defaults(fn=cmd_agg)
+
+    s = sub.add_parser("diff", help="counter deltas between two snapshots")
+    s.add_argument("before")
+    s.add_argument("after")
+    s.set_defaults(fn=cmd_diff)
+
+    s = sub.add_parser("prom", help="render aggregate as Prometheus text")
+    s.add_argument("files", nargs="+")
+    s.set_defaults(fn=cmd_prom)
+
+    s = sub.add_parser("blame", help="align per-host flight recorders, "
+                                     "name the first diverging collective")
+    s.add_argument("files", nargs="+")
+    s.set_defaults(fn=cmd_blame)
+
+    args = p.parse_args(argv)
+    try:
+        return args.fn(args)
+    except BrokenPipeError:
+        return 0  # `obs_tool ... | head` is fine
+    except (OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,57 @@
+"""Worker for the 2-process observability blame test (launched by
+test_obs.py; underscore prefix keeps pytest from collecting it).
+
+Each process is one emulated host: distributed bring-up, ``staged``
+(host-path) eager collectives under ``obs="metrics"``, and — on rank 1
+only — one INJECTED rank-divergent collective, the SPMD inconsistency
+class that deadlocks a gang on the direct device path (the staged host
+path computes locally, so the injection is observable without hanging
+the test).  Each host dumps its telemetry; the parent runs
+``obs_tool.py blame`` over the flight files and must see the injection
+named.
+"""
+
+import os
+import sys
+
+pid = int(sys.argv[1])
+nproc = int(sys.argv[2])
+port = sys.argv[3]
+out_dir = sys.argv[4]
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np  # noqa: E402
+
+import torchmpi_tpu as mpi  # noqa: E402
+
+mpi.init(mpi.Config(
+    coordinator_address=f"127.0.0.1:{port}",
+    num_processes=nproc,
+    process_id=pid,
+    staged=True,            # eager verbs take the host data path
+    obs="metrics",
+    obs_dir=out_dir,
+))
+
+n = mpi.device_count()
+x = np.stack([np.full(4, float(r), np.float32) for r in range(n)])
+for _ in range(3):
+    mpi.allreduce(x)
+if pid == 1:
+    # Injected rank-divergent collective: rank 1 launches one more
+    # collective than rank 0 ever issues.
+    mpi.broadcast(x)
+
+from torchmpi_tpu import obs  # noqa: E402
+
+paths = obs.dump()
+print(f"CHECK rank={pid} dumped={len(paths)} "
+      f"events={obs.recorder().total}", flush=True)
+mpi.stop()
+print(f"CHECK rank={pid} done", flush=True)

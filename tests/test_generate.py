@@ -193,6 +193,8 @@ def test_generate_parallel_sampling_shards_differ(hier_runtime):
     assert not np.array_equal(out[0], out[2])
 
 
+@pytest.mark.slow  # windowed-attention equivalence also covered by
+# test_flash's window tests (tier-1 budget, ISSUE 4 satellite)
 def test_generate_windowed_model_matches_full_recompute():
     """A sliding-window model decodes through the cache with the SAME
     band mask it trained with: cached greedy == full-recompute greedy of
@@ -500,6 +502,8 @@ def test_beam_length_penalty_prefers_longer():
                                    rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow  # beam+EP composition; EP generate and beam search
+# each have their own fast oracles (tier-1 budget, ISSUE 4 satellite)
 def test_beam_parallel_ep_matches_oracles(hier_runtime):
     # Expert-parallel beam search (VERDICT r3 #7): beam decode under
     # shard_map with MoE dispatch/combine over ici each step.  Two

@@ -34,6 +34,8 @@ def test_resnet20_shapes():
     assert 0.2e6 < n_params < 0.4e6, n_params
 
 
+@pytest.mark.slow  # shapes-only sweep; resnet50 bf16 + resnet20
+# convergence tests keep the model in tier-1 (budget)
 def test_resnet50_shapes_small_input():
     model = ResNet50(num_classes=100)
     variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 64, 64, 3)),
@@ -113,6 +115,8 @@ def test_resnet20_dp_convergence(flat_runtime):
     assert last < 0.5 * first, f"no convergence: {first} -> {last}"
 
 
+@pytest.mark.slow  # remat is a memory lever; equivalence also covered
+# by the non-remat recipe tests (tier-1 budget, ISSUE 4 satellite)
 def test_recipes_remat_matches(flat_runtime):
     # remat=True must be numerically identical (same math, recomputed).
     mesh = mpi.world_mesh()
@@ -153,6 +157,8 @@ def test_deep_resnet_variants_shapes():
         assert abs(n / 1e6 - expect_m) < 0.5, (ctor.__name__, n)
 
 
+@pytest.mark.slow  # decode==full also covered by test_generate's
+# cached-greedy oracle (tier-1 budget, ISSUE 4 satellite)
 def test_transformer_rope_decode_matches_full(flat_runtime):
     """pos_emb="rope": cached greedy decode == full-recompute argmax (the
     rotate-then-cache protocol: old entries never re-rotate)."""

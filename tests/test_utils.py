@@ -73,3 +73,27 @@ def test_annotate_inside_jit():
             return x * 2
 
     np.testing.assert_allclose(np.asarray(f(jnp.ones(3))), 2.0)
+
+
+def test_trace_nested_degrades_to_noop(tmp_path):
+    """jax allows one profiler trace per process: a trace() inside
+    another must degrade to a no-op span (and a failed start must not
+    let the finally's stop_trace mask the body's real exception)."""
+    ran = []
+    with tracing.trace(str(tmp_path / "outer")):
+        with tracing.trace(str(tmp_path / "inner")):  # nested: no-op
+            ran.append(1)
+    assert ran == [1]
+    # The profiler fully stopped: a fresh trace still works.
+    with tracing.trace(str(tmp_path / "again")):
+        ran.append(2)
+    assert ran == [1, 2]
+
+
+def test_trace_failed_start_propagates_body_error(tmp_path):
+    with tracing.trace(str(tmp_path / "outer")):
+        # Inner start fails (already tracing); the body's ValueError
+        # must surface — not a masking stop_trace RuntimeError.
+        with pytest.raises(ValueError, match="the real error"):
+            with tracing.trace(str(tmp_path / "inner")):
+                raise ValueError("the real error")

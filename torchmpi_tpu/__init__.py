@@ -88,20 +88,21 @@ from .utils.compilegate import (
 # TORCHMPI_TPU_COMPILE_GATE=0.
 _install_compile_gate()
 
-# The static analyzer subpackage loads lazily (PEP 562): with
-# Config.analysis="off" — the default — `import torchmpi_tpu` never
-# imports it, keeping the zero-added-cost claim literal.  Any access
-# (`mpi.analysis`, `from torchmpi_tpu import analysis`) imports it on
-# first touch.
+# The static analyzer and observability subpackages load lazily
+# (PEP 562): with Config.analysis="off" / Config.obs="off" — the
+# defaults — `import torchmpi_tpu` never imports them, keeping the
+# zero-added-cost claims literal (tests assert the modules are absent
+# from sys.modules).  Any access (`mpi.analysis`, `mpi.obs`,
+# `from torchmpi_tpu import obs`) imports on first touch.
 def __getattr__(name):
-    if name == "analysis":
+    if name in ("analysis", "obs"):
         # importlib, not ``from . import``: the from-import form does a
         # hasattr() probe on this package first, which would re-enter
         # this very function.
         import importlib
 
-        mod = importlib.import_module(__name__ + ".analysis")
-        globals()["analysis"] = mod
+        mod = importlib.import_module(__name__ + "." + name)
+        globals()[name] = mod
         return mod
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
@@ -127,7 +128,8 @@ __all__ = [
     "device_count", "local_device_count", "barrier", "world_mesh",
     "current_mesh", "push_communicator", "pop_communicator", "communicator",
     "set_config", "config", "DCN_AXIS", "ICI_AXIS", "WORLD_AXES",
-    "collectives", "fusion", "selector", "tuning", "analysis", "parallel",
+    "collectives", "fusion", "selector", "tuning", "analysis", "obs",
+    "parallel",
     "allreduce",
     "broadcast", "reduce",
     "allgather", "reduce_scatter", "sendreceive", "alltoall", "gather",

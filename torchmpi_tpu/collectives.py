@@ -342,6 +342,18 @@ def _pick(op_name: str, x, backend: Optional[str], axes: Tuple[str, ...],
     )
 
 
+def _obs_in_axis(op_name: str, x, axes: Tuple[str, ...]) -> None:
+    """Telemetry note for one in-axis call (``torchmpi_tpu.obs``).
+    Trace-time only — jit replays never re-enter — and one branch per
+    call when obs is off (the module is never imported then).  Gates on
+    ``effective_config`` like every other trace-time hook (fusion,
+    ZeRO, ps): live config when initialized, defaults (off) otherwise."""
+    if runtime.effective_config().obs != "off":
+        from . import obs
+
+        obs.record_in_axis(op_name, selector.nbytes_of(x), axes)
+
+
 def allreduce_in_axis(x, axis_names: AxisNames, *, op: str = "sum",
                       backend: Optional[str] = None):
     """Allreduce across mesh axes; for use inside shard_map (hot path).
@@ -351,6 +363,7 @@ def allreduce_in_axis(x, axis_names: AxisNames, *, op: str = "sum",
     per bucket, bit-identical results) instead of one launch per leaf —
     see :mod:`torchmpi_tpu.fusion`."""
     axes = _axes_tuple(axis_names)
+    _obs_in_axis("allreduce", x, axes)
     fused = fusion.maybe_fuse("allreduce", x, axes, backend=backend, op=op)
     if fused is not None:
         return fused
@@ -361,6 +374,7 @@ def allreduce_in_axis(x, axis_names: AxisNames, *, op: str = "sum",
 def broadcast_in_axis(x, axis_names: AxisNames, *, root: int = 0,
                       backend: Optional[str] = None):
     axes = _axes_tuple(axis_names)
+    _obs_in_axis("broadcast", x, axes)
     fused = fusion.maybe_fuse("broadcast", x, axes, backend=backend,
                               root=root)
     if fused is not None:
@@ -372,6 +386,7 @@ def broadcast_in_axis(x, axis_names: AxisNames, *, root: int = 0,
 def reduce_in_axis(x, axis_names: AxisNames, *, root: int = 0, op: str = "sum",
                    backend: Optional[str] = None):
     axes = _axes_tuple(axis_names)
+    _obs_in_axis("reduce", x, axes)
     fused = fusion.maybe_fuse("reduce", x, axes, backend=backend,
                               root=root, op=op)
     if fused is not None:
@@ -383,6 +398,7 @@ def reduce_in_axis(x, axis_names: AxisNames, *, root: int = 0, op: str = "sum",
 def allgather_in_axis(x, axis_names: AxisNames, *,
                       backend: Optional[str] = None):
     axes = _axes_tuple(axis_names)
+    _obs_in_axis("allgather", x, axes)
     return jax.tree.map(lambda v: _pick("allgather", v, backend, axes)(
         v, axes), x)
 
@@ -390,6 +406,7 @@ def allgather_in_axis(x, axis_names: AxisNames, *,
 def reduce_scatter_in_axis(x, axis_names: AxisNames, *, op: str = "sum",
                            backend: Optional[str] = None):
     axes = _axes_tuple(axis_names)
+    _obs_in_axis("reduce_scatter", x, axes)
     fused = fusion.maybe_fuse_reduce_scatter(x, axes, backend=backend,
                                              op=op)
     if fused is not None:
@@ -401,6 +418,7 @@ def reduce_scatter_in_axis(x, axis_names: AxisNames, *, op: str = "sum",
 def gather_in_axis(x, axis_names: AxisNames, *, root: int = 0,
                    backend: Optional[str] = None):
     axes = _axes_tuple(axis_names)
+    _obs_in_axis("gather", x, axes)
     return jax.tree.map(lambda v: _pick("gather", v, backend, axes)(
         v, axes, root=root), x)
 
@@ -408,6 +426,7 @@ def gather_in_axis(x, axis_names: AxisNames, *, root: int = 0,
 def scatter_in_axis(x, axis_names: AxisNames, *, root: int = 0,
                     backend: Optional[str] = None):
     axes = _axes_tuple(axis_names)
+    _obs_in_axis("scatter", x, axes)
     return jax.tree.map(lambda v: _pick("scatter", v, backend, axes)(
         v, axes, root=root), x)
 
@@ -415,6 +434,7 @@ def scatter_in_axis(x, axis_names: AxisNames, *, root: int = 0,
 def sendreceive_in_axis(x, axis_names: AxisNames, *, src: int, dst: int,
                         backend: Optional[str] = None):
     axes = _axes_tuple(axis_names)
+    _obs_in_axis("sendreceive", x, axes)
     return jax.tree.map(lambda v: _pick("sendreceive", v, backend, axes)(
         v, axes, src=src, dst=dst), x)
 
@@ -422,6 +442,7 @@ def sendreceive_in_axis(x, axis_names: AxisNames, *, src: int, dst: int,
 def alltoall_in_axis(x, axis_names: AxisNames, *, split_axis: int = 0,
                      concat_axis: int = 0, backend: Optional[str] = None):
     axes = _axes_tuple(axis_names)
+    _obs_in_axis("alltoall", x, axes)
     return jax.tree.map(lambda v: _pick("alltoall", v, backend, axes)(
         v, axes, split_axis=split_axis, concat_axis=concat_axis), x)
 
@@ -549,6 +570,23 @@ def _place_rank_major(x, m: Mesh, sharding: Optional[NamedSharding] = None):
     return jax.device_put(x, sharding)
 
 
+def _obs_record_eager(cfg, op_name: str, x, m: Mesh, impl=None) -> None:
+    """Telemetry record for one eager dispatch (``torchmpi_tpu.obs``):
+    one branch on the off path, recorded BEFORE dispatch so a
+    collective the gang never completes is the last flight event.
+    ``impl=None`` means the staged-host path.  Per-rank size comes from
+    metadata — ``x[0]`` would enqueue a device slice on the hot path
+    purely to read shape/dtype."""
+    if cfg is None or cfg.obs == "off":
+        return
+    from . import obs
+
+    backend = "host" if impl is None else selector.name_of(op_name, impl)
+    obs.record_eager(op_name,
+                     int(np.prod(x.shape[1:])) * x.dtype.itemsize,
+                     backend, m, dtype=x.dtype)
+
+
 def _eager_collective(op_name: str, x, *, mesh: Optional[Mesh] = None,
                       backend: Optional[str] = None, **params):
     m, n = _mesh_and_n(mesh)
@@ -568,6 +606,7 @@ def _eager_collective(op_name: str, x, *, mesh: Optional[Mesh] = None,
     # how per-call selector choices overrode the global staged flag.
     if backend == "host" or (backend is None
                              and cfg is not None and cfg.staged):
+        _obs_record_eager(cfg, op_name, x, m)
         out = _host_staged(op_name, np.asarray(x), n, **params)
         return _place_rank_major(np.ascontiguousarray(out), m)
     # Online "auto" mode (config default, per-op table, or an explicit
@@ -597,6 +636,7 @@ def _eager_collective(op_name: str, x, *, mesh: Optional[Mesh] = None,
     # include the resolved impl, or runtime set_config() backend switches
     # would silently reuse a stale executable.
     impl = _pick(op_name, x[0], backend, axes, mesh=m, cfg=cfg)
+    _obs_record_eager(cfg, op_name, x, m, impl=impl)
     key = (op_name, m, impl, x.shape, x.dtype.name,
            tuple(sorted(params.items())))
     entry = _jit_cache.get(key)

@@ -176,6 +176,26 @@ class Config:
     # trace-time only, never per step.  Env: TORCHMPI_TPU_ANALYSIS.
     analysis: str = "off"
 
+    # --- runtime observability ---------------------------------------------
+    # Opt-in runtime telemetry (torchmpi_tpu.obs — docs/OBSERVABILITY.md):
+    # "off" (default: one branch per collective call site, the module is
+    # never even imported — same discipline as ``analysis``), "metrics"
+    # (counter/histogram registry — per-collective launch+byte
+    # accounting, fusion/gradsync/ZeRO/tuning/PS counters — plus the
+    # deadlock flight recorder: a ring of the last obs_ring_size
+    # collective events per host, dumped as JSONL/Prometheus on
+    # SIGTERM/atexit for scripts/obs_tool.py blame), or "trace"
+    # (metrics plus per-event user call-site attribution).
+    # Env: TORCHMPI_TPU_OBS.
+    obs: str = "off"
+    # Directory for the per-host telemetry dumps (metrics_host*.jsonl /
+    # flight_host*.jsonl).  None resolves to TORCHMPI_TPU_OBS_DIR, then
+    # /tmp/torchmpi_tpu_obs.
+    obs_dir: Optional[str] = None
+    # Flight-recorder ring capacity (events retained per host).
+    # Env: TORCHMPI_TPU_OBS_RING.
+    obs_ring_size: int = 1024
+
     # --- gradient synchronization ------------------------------------------
     # Number of buckets for bucketed/overlapped gradient allreduce.
     gradsync_buckets: int = 1
@@ -221,6 +241,9 @@ class Config:
             custom_min_bytes=_env_int("TORCHMPI_TPU_CUSTOM_MIN_BYTES", 64 * 1024),
             staged=_env_bool("TORCHMPI_TPU_STAGED", False),
             analysis=_env_str("TORCHMPI_TPU_ANALYSIS", "off"),
+            obs=_env_str("TORCHMPI_TPU_OBS", "off"),
+            obs_dir=(os.environ.get("TORCHMPI_TPU_OBS_DIR") or None),
+            obs_ring_size=_env_int("TORCHMPI_TPU_OBS_RING", 1024),
             fuse_max_bytes=_env_int("TORCHMPI_TPU_FUSE_MAX_BYTES",
                                     32 * 1024 * 1024),
             flash_prescale=_env_bool("TORCHMPI_TPU_FLASH_PRESCALE", False),

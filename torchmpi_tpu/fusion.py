@@ -276,6 +276,15 @@ def fuse_tree(op_name: str, tree: PyTree, axes: Tuple[str, ...], *,
             parts.append(prev)
         gout = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
         _unpack_group(gout, g, out_leaves)
+    if runtime.effective_config().obs != "off":
+        from . import obs
+
+        # Trace-time accounting: leaves coalesced, launches issued, and
+        # wire bytes vs the promoted-concat layout fusion replaced.
+        wire = sum(g.nbytes for g in spec.groups)
+        promoted = spec.total * np.dtype(spec.dtype).itemsize
+        obs.record_fusion(op_name, spec.n_leaves, spec.n_launches, wire,
+                          max(0, promoted - wire))
     if _trace_listener is not None:
         _emit_trace_record(dict(
             kind="fuse_tree", op=op_name, axes=tuple(axes),

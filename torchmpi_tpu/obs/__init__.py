@@ -1,0 +1,353 @@
+"""Runtime observability: collective telemetry registry + flight recorder.
+
+Off by default and **never imported when off** — the same discipline as
+``torchmpi_tpu.analysis``: every call site in the library guards its
+``obs`` hook behind one ``Config.obs != "off"`` branch, so a build that
+never opts in pays one string compare per collective dispatch and zero
+import cost.  Enable via ``Config.obs`` / ``TORCHMPI_TPU_OBS``:
+
+- ``"metrics"`` — the :class:`~torchmpi_tpu.obs.registry.Registry`
+  accumulates counters and log2-bucketed histograms (per-op launch and
+  byte counts keyed by op/dtype/size-bucket/backend/mesh, fusion
+  coalescing stats, gradient-sync rounds, ZeRO legs, tuning plan
+  hits/misses and measured medians, parameter-server cycle counters),
+  and the :class:`~torchmpi_tpu.obs.recorder.FlightRecorder` ring
+  buffers the last N collective events appended *before* dispatch —
+  the post-mortem for runtime deadlocks (``scripts/obs_tool.py blame``
+  aligns per-host dumps and names the first diverging collective, the
+  runtime complement to the static analyzer's D1/D3 rules).  Both are
+  dumped per host as JSONL (renderable as Prometheus text) at exit, on
+  SIGTERM, or via :func:`dump`.
+- ``"trace"`` — metrics plus per-event *user call-site attribution*
+  (a stack walk per eager dispatch — the one genuinely costly hook, so
+  it is gated behind the louder mode).
+
+See docs/OBSERVABILITY.md for the metric catalog and workflows.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import signal
+import threading
+import time
+from typing import List, Optional
+
+from .recorder import FlightRecorder
+from .registry import Registry, log2_bucket, prometheus_lines
+
+MODES = ("off", "metrics", "trace")
+
+DEFAULT_OUT_DIR = "/tmp/torchmpi_tpu_obs"
+DEFAULT_RING = 1024
+
+_lock = threading.Lock()
+_mode = "off"
+_out_dir = DEFAULT_OUT_DIR
+_host = str(os.getpid())
+_registry = Registry()
+_recorder = FlightRecorder(DEFAULT_RING)
+_atexit_armed = False
+# Previous SIGTERM disposition while our handler is installed.  The
+# sentinel is NOT None: signal.signal() legitimately returns None when
+# the prior handler was installed from C, and that case must still
+# terminate (treated like SIG_DFL) rather than read as "not installed".
+_UNINSTALLED = object()
+_prev_sigterm = _UNINSTALLED
+
+
+def mode() -> str:
+    return _mode
+
+
+def active() -> bool:
+    return _mode != "off"
+
+
+def tracing() -> bool:
+    return _mode == "trace"
+
+
+def registry() -> Registry:
+    return _registry
+
+
+def recorder() -> FlightRecorder:
+    return _recorder
+
+
+def mesh_label(mesh) -> str:
+    """``axis:size`` signature of a mesh (duck-typed — no jax import
+    here), matching ``tuning.fingerprint.mesh_key``."""
+    try:
+        return ",".join(f"{a}:{int(s)}" for a, s in mesh.shape.items())
+    except Exception:  # noqa: BLE001 — a label must never fail a step
+        return "unknown"
+
+
+# ---------------------------------------------------------------------------
+# Activation (runtime.init / set_config call this when Config.obs is on)
+# ---------------------------------------------------------------------------
+
+
+def activate(obs_mode: str, *, out_dir: Optional[str] = None,
+             ring_size: Optional[int] = None,
+             host: Optional[str] = None) -> None:
+    """Turn telemetry on (idempotent; re-activation updates settings).
+
+    Installs the atexit dump once per process and chains a SIGTERM
+    handler (dump, then the previous disposition) so a preempted or
+    timed-out job still leaves its per-host evidence behind.
+    """
+    global _mode, _out_dir, _host, _recorder
+    if obs_mode not in ("metrics", "trace"):
+        raise ValueError(f"obs mode must be metrics|trace, got {obs_mode!r}")
+    with _lock:
+        _mode = obs_mode
+        if out_dir:
+            _out_dir = out_dir
+        if host is not None:
+            _host = str(host)
+        if ring_size is not None and int(ring_size) != _recorder.size:
+            # Carry history + seq forward: a mid-run resize (e.g.
+            # enlarging after blame reports trimmed rings) must not
+            # destroy the evidence already collected.
+            _recorder = _recorder.resized(int(ring_size))
+    _arm_handlers()
+
+
+def deactivate() -> None:
+    """Stop recording; restores the pre-activation SIGTERM disposition.
+    Accumulated data stays readable (and dumpable explicitly)."""
+    global _mode, _prev_sigterm
+    with _lock:
+        _mode = "off"
+        prev, _prev_sigterm = _prev_sigterm, _UNINSTALLED
+    if prev is not _UNINSTALLED:
+        try:
+            # A C-installed prior handler (None) cannot be restored
+            # from Python; SIG_DFL at least keeps TERM terminating.
+            signal.signal(signal.SIGTERM,
+                          prev if prev is not None else signal.SIG_DFL)
+        except (ValueError, OSError):  # non-main thread / teardown
+            pass
+
+
+def reset() -> None:
+    """Clear all accumulated telemetry (tests)."""
+    _registry.clear()
+    _recorder.clear()
+
+
+def _arm_handlers() -> None:
+    global _atexit_armed, _prev_sigterm
+    if not _atexit_armed:
+        _atexit_armed = True
+        atexit.register(_atexit_dump)
+    if _prev_sigterm is _UNINSTALLED:
+        try:
+            prev = signal.signal(signal.SIGTERM, _on_sigterm)
+        except (ValueError, OSError):
+            return  # signals only work in the main thread
+        # Re-activation after our handler is already installed must not
+        # chain to itself.
+        _prev_sigterm = prev if prev is not _on_sigterm else signal.SIG_DFL
+
+
+def _atexit_dump() -> None:
+    if active():
+        try:
+            # best_effort: this also runs from the SIGTERM handler on
+            # the main thread — a blocking acquire against a lock held
+            # by the interrupted frame would self-deadlock the dump.
+            dump(best_effort=True)
+        except Exception:  # noqa: BLE001 — never mask the exit path
+            pass
+
+
+def _on_sigterm(signum, frame) -> None:
+    _atexit_dump()
+    prev = _prev_sigterm
+    if callable(prev):
+        prev(signum, frame)
+    elif prev == signal.SIG_DFL or prev is None or prev is _UNINSTALLED:
+        # SIG_DFL, an unrestorable C-installed handler (None), or a
+        # race with deactivate: preserve die-on-TERM semantics after
+        # dumping — a polite kill must never be silently swallowed.
+        try:
+            signal.signal(signal.SIGTERM, signal.SIG_DFL)
+            os.kill(os.getpid(), signal.SIGTERM)
+        except (ValueError, OSError):
+            raise SystemExit(128 + signum)
+
+
+# ---------------------------------------------------------------------------
+# Dump (JSONL per host; Prometheus text on request)
+# ---------------------------------------------------------------------------
+
+
+def metrics_path(out_dir: Optional[str] = None) -> str:
+    return os.path.join(out_dir or _out_dir, f"metrics_host{_host}.jsonl")
+
+
+def flight_path(out_dir: Optional[str] = None) -> str:
+    return os.path.join(out_dir or _out_dir, f"flight_host{_host}.jsonl")
+
+
+def _meta(stream: str) -> dict:
+    return {"kind": "meta", "stream": stream, "host": _host,
+            "pid": os.getpid(), "mode": _mode, "time": time.time()}
+
+
+def dump(out_dir: Optional[str] = None,
+         prom_path: Optional[str] = None,
+         best_effort: bool = False) -> List[str]:
+    """Write this process's telemetry snapshot; returns paths written.
+
+    Overwrites (snapshot semantics): each dump is the complete
+    cumulative state, so the file left by SIGTERM/atexit is always
+    whole.  ``prom_path`` additionally renders the metrics snapshot in
+    Prometheus text format.  ``best_effort`` bounds the lock acquires
+    (the signal-handler path — see ``Registry.snapshot``).
+    """
+    base = out_dir or _out_dir
+    os.makedirs(base, exist_ok=True)
+    written: List[str] = []
+    snap = _registry.snapshot(best_effort)
+    mpath = metrics_path(base)
+    with open(mpath, "w") as f:
+        for rec in [_meta("metrics")] + snap:
+            f.write(json.dumps(rec) + "\n")
+    written.append(mpath)
+    fmeta = _meta("flight")
+    fmeta.update({"ring": _recorder.size, "total": _recorder.total,
+                  "dropped": _recorder.dropped})
+    fpath = flight_path(base)
+    with open(fpath, "w") as f:
+        for rec in [fmeta] + _recorder.to_records(best_effort):
+            f.write(json.dumps(rec) + "\n")
+    written.append(fpath)
+    if prom_path:
+        with open(prom_path, "w") as f:
+            f.write("\n".join(prometheus_lines(snap)) + "\n")
+        written.append(prom_path)
+    return written
+
+
+# ---------------------------------------------------------------------------
+# Call-site hooks.  Every caller gates on ``Config.obs != "off"`` before
+# importing this module, so these can assume telemetry is wanted; they
+# must still never raise into a training step.
+# ---------------------------------------------------------------------------
+
+
+def _call_site() -> str:
+    """Best-effort user call site (``file.py:line``): the first stack
+    frame outside this package AND outside installed libraries (the
+    eager verbs dispatch through ``jax.tree.map``, so jax frames sit
+    between us and the user) — trace-mode only (a stack walk per
+    dispatch is the one hook too costly for the metrics tier)."""
+    import traceback
+
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    for fr in reversed(traceback.extract_stack()[:-2]):
+        fn = os.path.abspath(fr.filename)
+        if fn.startswith(pkg) or "site-packages" in fn \
+                or "dist-packages" in fn:
+            continue
+        return f"{fr.filename}:{fr.lineno}"
+    return ""
+
+
+def record_eager(op: str, nbytes: int, backend: str, mesh,
+                 dtype=None) -> None:
+    """One eager rank-major collective dispatch (the runtime hot path:
+    counter + byte histogram + flight event; trace mode adds the user
+    call site to the event)."""
+    mk = mesh_label(mesh)
+    labels = dict(op=op, backend=backend, mesh=mk,
+                  dtype=str(dtype) if dtype is not None else "",
+                  nbytes_bucket=f"b{log2_bucket(nbytes)}")
+    _registry.counter_inc("tm_collectives_total", **labels)
+    _registry.counter_inc("tm_collective_bytes_total", nbytes, **labels)
+    _registry.hist_observe("tm_collective_nbytes", nbytes,
+                           op=op, backend=backend, mesh=mk)
+    detail = f"{mk} @{_call_site()}" if _mode == "trace" else mk
+    _recorder.append("eager", op, nbytes, backend, detail)
+
+
+def record_in_axis(op: str, nbytes: int, axes) -> None:
+    """One in-axis collective call (trace-time: counts program builds,
+    not steady-state executions — jit replays don't re-enter)."""
+    _registry.counter_inc("tm_inaxis_calls_total", op=op,
+                          axes=",".join(map(str, axes)),
+                          nbytes_bucket=f"b{log2_bucket(nbytes)}")
+
+
+def record_fusion(op: str, n_leaves: int, n_launches: int,
+                  wire_bytes: int, saved_bytes: int) -> None:
+    """One ``fusion.fuse_tree`` coalescing (trace-time)."""
+    _registry.counter_inc("tm_fusion_trees_total", op=op)
+    _registry.counter_inc("tm_fusion_leaves_total", n_leaves, op=op)
+    _registry.counter_inc("tm_fusion_buckets_total", n_launches, op=op)
+    _registry.counter_inc("tm_fusion_wire_bytes_total", wire_bytes, op=op)
+    _registry.counter_inc("tm_fusion_bytes_saved_total", saved_bytes, op=op)
+
+
+def record_gradsync(n_buckets: int, op: str, compressed: bool) -> None:
+    """One ``synchronize_gradients`` round (trace-time)."""
+    _registry.counter_inc("tm_gradsync_rounds_total", op=op,
+                          compressed=str(bool(compressed)).lower())
+    _registry.counter_inc("tm_gradsync_buckets_total", max(1, n_buckets))
+
+
+def record_zero(kind: str, n_groups: int, n_shards: int) -> None:
+    """One ZeRO reduce-scatter leg set (trace-time)."""
+    _registry.counter_inc("tm_zero_sync_rounds_total", kind=kind,
+                          n_shards=str(n_shards))
+    _registry.counter_inc("tm_zero_groups_total", n_groups, kind=kind)
+
+
+def record_tuning_plan(event: str, op: str = "") -> None:
+    """Plan consult outcome: ``hit`` | ``miss`` | ``measured``."""
+    _registry.counter_inc("tm_tuning_plan_lookups_total", event=event,
+                          op=op)
+
+
+def record_tuning_measure(op: str, backend: str, median_s: float) -> None:
+    """One measured candidate (``tuning.measure`` result), median in
+    microseconds on a log2 histogram."""
+    _registry.hist_observe("tm_tuning_measured_us",
+                           max(1.0, median_s * 1e6), op=op, backend=backend)
+
+
+def record_ps_stats(stats: dict, prev: Optional[dict]) -> None:
+    """Fold a ``ShardedParameterServer.stats()`` snapshot into the
+    registry as deltas against the previous snapshot (the native
+    counters are cumulative; the registry re-exports them as
+    monotonic ``tm_ps_*`` counters)."""
+    prev = prev or {}
+    for k, v in stats.items():
+        d = v - prev.get(k, 0)
+        if d > 0:
+            _registry.counter_inc(f"tm_ps_{k}_total", d)
+
+
+def record_step_build(label: str) -> None:
+    """One step-builder compilation-cache entry (trace-time)."""
+    _registry.counter_inc("tm_step_builds_total", label=label)
+
+
+def record_log(logger_name: str) -> None:
+    """One ``utils.metrics.MetricsLogger`` record (the logger is a thin
+    wrapper over this registry when obs is active)."""
+    _registry.counter_inc("tm_log_records_total", logger=logger_name)
+
+
+def record_barrier(name: str) -> None:
+    """A runtime barrier (barrier events anchor cross-host alignment in
+    ``obs_tool.py blame``)."""
+    _registry.counter_inc("tm_barriers_total")
+    _recorder.append("barrier", name)

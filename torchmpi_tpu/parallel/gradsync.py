@@ -173,6 +173,10 @@ def synchronize_gradients(grads: PyTree, axis_names: Optional[AxisNames] = None,
         compress = cfg.gradsync_compress
     if barrier is None:
         barrier = cfg.gradsync_barrier if cfg is not None else False
+    if cfg is not None and cfg.obs != "off":
+        from .. import obs
+
+        obs.record_gradsync(n_buckets, op, compress == "bf16")
     orig_dtypes = None
     if compress == "bf16":
         orig_dtypes = jax.tree.map(lambda g: g.dtype, grads)
@@ -301,6 +305,10 @@ def data_parallel_step(
 
         jitted = analysis.wrap_step(jitted, wrapped,
                                     label="data_parallel_step", mode=mode)
+    if cfg is not None and cfg.obs != "off":
+        from .. import obs
+
+        obs.record_step_build("data_parallel_step")
     return throttle_dispatch(jitted, mesh=m, max_inflight=max_inflight)
 
 

@@ -206,9 +206,21 @@ class ShardedParameterServer:
                 raise RuntimeError("failed to start PS shard server")
             self.server_ids.append(sid)
             self.ports.append(self._lib.tm_ps_server_port(sid))
+        # Previous stats() snapshot as recorded into the telemetry
+        # registry (torchmpi_tpu.obs) — deltas, not cumulative re-adds.
+        self._last_stats = None
 
     def ops_served(self) -> int:
         return sum(self._lib.tm_ps_server_ops(s) for s in self.server_ids)
+
+    def _read_counters(self) -> np.ndarray:
+        """One pass over every shard's 7 native counters."""
+        tot = np.zeros(7, dtype=np.uint64)
+        buf = (ctypes.c_uint64 * 7)()
+        for sid in self.server_ids:
+            if self._lib.tm_ps_server_stats(sid, buf, 7) == 7:
+                tot += np.ctypeslib.as_array(buf)
+        return tot
 
     def stats(self) -> dict:
         """Cycle-cost decomposition of the server loop (VERDICT r4 #8),
@@ -220,17 +232,23 @@ class ShardedParameterServer:
         Backs benchmarks/ps_bench.py's loopback breakdown and the
         scaling model in docs/ROUND3_NOTES.md.
 
-        Snapshots can be TORN: the seven counters are read individually
-        while handler threads keep incrementing, so one snapshot may be
-        mutually inconsistent (e.g. ``ops`` ticked but its ``bytes_in``
-        not yet visible).  Fine for a diagnostic — compare successive
-        snapshots with ``>=``, never ``==`` (the tests do)."""
-        tot = np.zeros(7, dtype=np.uint64)
-        buf = (ctypes.c_uint64 * 7)()
-        for sid in self.server_ids:
-            if self._lib.tm_ps_server_stats(sid, buf, 7) == 7:
-                tot += np.ctypeslib.as_array(buf)
-        return {
+        Tearing: the seven counters are read individually while handler
+        threads keep incrementing, so one pass may be mutually
+        inconsistent (e.g. ``ops`` ticked but its ``bytes_in`` not yet
+        visible).  The read is therefore performed twice and retried
+        once on mismatch (a seqlock without the seq: two identical
+        passes mean no increment landed mid-read).  Under sustained
+        concurrent load the retried pass can still tear — compare
+        successive snapshots with ``>=``, never ``==`` (the tests do).
+
+        With ``Config.obs`` on, each snapshot's deltas against the
+        previous one are folded into the telemetry registry as
+        ``tm_ps_*_total`` counters (docs/OBSERVABILITY.md)."""
+        tot = self._read_counters()
+        again = self._read_counters()
+        if not np.array_equal(tot, again):
+            tot = self._read_counters()  # retry once on seq mismatch
+        out = {
             "ops": int(tot[0]),
             "bytes_in": int(tot[1]),
             "bytes_out": int(tot[2]),
@@ -239,6 +257,14 @@ class ShardedParameterServer:
             "apply_s": float(tot[5]) / 1e9,
             "send_s": float(tot[6]) / 1e9,
         }
+        from .. import runtime
+
+        if runtime.effective_config().obs != "off":
+            from .. import obs
+
+            obs.record_ps_stats(out, self._last_stats)
+            self._last_stats = dict(out)
+        return out
 
     def shutdown(self) -> None:
         for sid in self.server_ids:

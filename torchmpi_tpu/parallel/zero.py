@@ -217,6 +217,11 @@ def _reduce_scatter_grads(grads: PyTree, axes: Tuple[str, ...], *,
     n = _axis_size(axes)
     if spec is None:
         spec = _FlatSpec(params, int(n))
+    if cfg is not None and cfg.obs != "off":
+        from .. import obs
+
+        obs.record_zero("reduce_scatter", len(spec.groups),
+                        int(spec.n_shards))
     # Trace-time layout record for the static analyzer (rule C1): the
     # shard layout the spec was built for vs the axes this call actually
     # spans.  A stale spec (wrong n_shards) silently pairs every device

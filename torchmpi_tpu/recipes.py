@@ -149,6 +149,10 @@ def make_bn_dp_train_step(
         jitted = analysis.wrap_step(
             jitted, wrapped, label=f"bn_dp_train_step(zero={zero})",
             mode=mode)
+    if cfg is not None and cfg.obs != "off":
+        from . import obs
+
+        obs.record_step_build(f"bn_dp_train_step(zero={zero})")
     return _gradsync.throttle_dispatch(jitted, mesh=m)
 
 

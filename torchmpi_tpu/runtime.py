@@ -137,6 +137,46 @@ def _normalize_analysis(value) -> Optional[str]:
     return None
 
 
+def _normalize_obs(value) -> Optional[str]:
+    """Canonical obs mode for a config/env value: "off"|"metrics"|
+    "trace", with boolean-ish spellings accepted ("1"/"true"/"yes"/"on"
+    mean "metrics", "0"/"false"/"no"/"" mean "off").  None =
+    unrecognized (the caller raises)."""
+    v = str(value).strip().lower()
+    if v in ("off", "0", "false", "no", "none", ""):
+        return "off"
+    if v in ("metrics", "1", "true", "yes", "on"):
+        return "metrics"
+    if v == "trace":
+        return "trace"
+    return None
+
+
+def _obs_activate(cfg: Config) -> None:
+    """Import and arm the telemetry layer (only ever called with
+    ``cfg.obs != "off"`` — the off path never imports the module).
+
+    The same any-config env pickup as the mode itself: obs_dir and
+    obs_ring_size left at their defaults defer to TORCHMPI_TPU_OBS_DIR
+    / _OBS_RING, so `TORCHMPI_TPU_OBS=metrics python some_script.py`
+    honors all three envs even when the script builds its Config
+    explicitly; an explicit non-default field still wins."""
+    import dataclasses as _dc
+
+    from . import obs
+
+    out_dir = (cfg.obs_dir or os.environ.get("TORCHMPI_TPU_OBS_DIR")
+               or obs.DEFAULT_OUT_DIR)
+    ring = cfg.obs_ring_size
+    env_ring = os.environ.get("TORCHMPI_TPU_OBS_RING")
+    default_ring = next(f.default for f in _dc.fields(Config)
+                        if f.name == "obs_ring_size")
+    if env_ring and ring == default_ring:
+        ring = int(env_ring)
+    obs.activate(cfg.obs, out_dir=out_dir, ring_size=ring,
+                 host=jax.process_index())
+
+
 def init(config: Optional[Config] = None, **overrides) -> Mesh:
     """Start the runtime (reference: ``mpi.start(withCuda)`` -> torchmpi_start).
 
@@ -178,6 +218,17 @@ def init(config: Optional[Config] = None, **overrides) -> Mesh:
             raise ValueError(
                 "config.analysis (or TORCHMPI_TPU_ANALYSIS) must be "
                 "off|warn|error")
+
+        # Same any-config env pickup + one-home normalization for the
+        # telemetry opt-in (TORCHMPI_TPU_OBS): an explicit non-default
+        # field wins; "1"/"true" mean "metrics".
+        if _normalize_obs(cfg.obs) == "off":
+            cfg.obs = os.environ.get("TORCHMPI_TPU_OBS", "off")
+        cfg.obs = _normalize_obs(cfg.obs)
+        if cfg.obs is None:
+            raise ValueError(
+                "config.obs (or TORCHMPI_TPU_OBS) must be "
+                "off|metrics|trace")
 
         if cfg.coordinator_address is None:
             coord = os.environ.get("TORCHMPI_TPU_COORDINATOR")
@@ -234,6 +285,19 @@ def init(config: Optional[Config] = None, **overrides) -> Mesh:
         from . import analysis
 
         analysis.arm_runtime_capture()
+    if cfg.obs != "off":
+        # Arm telemetry (registry + flight recorder + SIGTERM/atexit
+        # dump).  Off (the default) never imports torchmpi_tpu.obs.
+        _obs_activate(cfg)
+    else:
+        # A previous session's telemetry must not survive a re-init
+        # that opted out (stale mode, SIGTERM handler, atexit dump) —
+        # but only via sys.modules: turning obs off never imports it.
+        import sys
+
+        mod = sys.modules.get(__package__ + ".obs")
+        if mod is not None and mod.active():
+            mod.deactivate()
     return world
 
 
@@ -339,7 +403,21 @@ def set_config(**kw) -> None:
             raise ValueError(f"unknown config field {k!r}")
         if k == "backend_per_op" and v is not None:
             v = _validate_backend_per_op(v)
+        if k == "obs":
+            v = _normalize_obs(v)
+            if v is None:
+                raise ValueError("config.obs must be off|metrics|trace")
         setattr(_state.config, k, v)
+    if "obs" in kw or "obs_dir" in kw or "obs_ring_size" in kw:
+        if _state.config.obs != "off":
+            _obs_activate(_state.config)
+        else:
+            import sys
+
+            # Turning obs OFF must not import the module it disables.
+            mod = sys.modules.get(__package__ + ".obs")
+            if mod is not None:
+                mod.deactivate()
     from . import collectives, tuning
 
     collectives.clear_cache()
@@ -404,6 +482,12 @@ def barrier(name: str = "torchmpi_tpu_barrier") -> None:
     reached the barrier.
     """
     _require_init()
+    if _state.config.obs != "off":
+        from . import obs
+
+        # Recorded BEFORE the wait: a host stuck in this barrier shows
+        # it as the last flight event (obs_tool.py blame anchor).
+        obs.record_barrier(name)
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
 

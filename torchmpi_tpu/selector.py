@@ -132,6 +132,16 @@ def select(
     return impls[name]
 
 
+def name_of(op: str, impl: Callable) -> str:
+    """Reverse lookup: the backend name a resolved implementation was
+    registered under (telemetry labels — ``torchmpi_tpu.obs``).
+    Implementations not in the registry report ``"custom"``."""
+    for b, f in _REGISTRY.get(op, {}).items():
+        if f is impl:
+            return b
+    return "custom"
+
+
 def nbytes_of(x) -> int:
     """Total payload bytes of ``x`` — a single array OR any pytree of
     arrays, summed across leaves, so gradient-tree callers get real

@@ -51,6 +51,19 @@ class _State:
 _state = _State()
 
 
+def _obs():
+    """The telemetry module when ``Config.obs`` is on, else None — one
+    branch per call site and never an import on the off path (the
+    ``torchmpi_tpu.obs`` discipline)."""
+    from .. import runtime
+
+    if runtime.effective_config().obs == "off":
+        return None
+    from .. import obs
+
+    return obs
+
+
 def _log(record: dict) -> None:
     _state.decisions.append(record)
     del _state.decisions[:-1000]  # bounded in-memory history
@@ -166,6 +179,9 @@ def plan_lookup(op: str, nbytes: int, dtype,
     except Exception:  # noqa: BLE001 — lookup must never take down a step
         return None
     entry = cache.get(key)
+    o = _obs()
+    if o is not None:
+        o.record_tuning_plan("hit" if entry is not None else "miss", op)
     if entry is None:
         return None
     if key not in st.logged_keys:
@@ -228,6 +244,9 @@ def resolve_eager(op: str, nbytes: int, dtype, mesh,
                   "reason": "multiprocess: online measurement disabled"})
         return None
     if entry is not None:
+        o = _obs()
+        if o is not None:
+            o.record_tuning_plan("hit", op)
         if key not in st.logged_keys:
             st.logged_keys.add(key)
             _log({"event": "tuning_decision", "op": op, "key": key,
@@ -261,6 +280,11 @@ def resolve_eager(op: str, nbytes: int, dtype, mesh,
             return None
         winner, evidence = measure.noise_gate(cands, DEFAULT_BACKEND)
         st.measure_count += 1
+        o = _obs()
+        if o is not None:
+            o.record_tuning_plan("measured", op)
+            for b, r in cands.items():
+                o.record_tuning_measure(op, b, r.median)
         new = plancache.PlanEntry(
             backend=str(winner), source="measured",
             median_ms={b: round(r.median * 1e3, 4)

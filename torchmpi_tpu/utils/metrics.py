@@ -10,6 +10,7 @@ on this environment (and harmless elsewhere).
 from __future__ import annotations
 
 import json
+import sys
 import time
 from typing import Any, Dict, List, Optional
 
@@ -131,10 +132,18 @@ class Timer:
 
 
 class MetricsLogger:
-    """Per-step metrics as JSONL (img/s/chip, step time, achieved GB/s)."""
+    """Per-step metrics as JSONL (img/s/chip, step time, achieved GB/s).
 
-    def __init__(self, path: Optional[str] = None):
+    A thin wrapper over the observability registry: when
+    ``torchmpi_tpu.obs`` is active (``Config.obs != "off"``) every
+    record is also counted there (``tm_log_records_total{logger=...}``)
+    so a telemetry dump shows how much step-log traffic each stream
+    produced.  The lookup goes through ``sys.modules`` — a process that
+    never enabled obs never imports it (the off-path discipline)."""
+
+    def __init__(self, path: Optional[str] = None, name: str = "metrics"):
         self.path = path
+        self.name = name
         self.records: List[Dict[str, Any]] = []
 
     def log(self, **kw) -> None:
@@ -143,6 +152,9 @@ class MetricsLogger:
         if self.path:
             with open(self.path, "a") as f:
                 f.write(json.dumps(rec) + "\n")
+        obs = sys.modules.get("torchmpi_tpu.obs")
+        if obs is not None and obs.active():
+            obs.record_log(self.name)
 
 
 def allreduce_bus_bandwidth(nbytes: int, n_devices: int,

@@ -28,11 +28,27 @@ def trace(log_dir: str = "/tmp/torchmpi_tpu_trace",
 
     View with tensorboard or ui.perfetto.dev (the trace.json.gz under
     ``<log_dir>/plugins/profile/...``).
+
+    Robust to nested/failed ``start_trace``: jax allows one trace per
+    process, so a ``trace()`` inside another (or after a crashed one
+    left the profiler running) degrades to a no-op span instead of
+    raising — and ``stop_trace`` only runs when OUR start succeeded, so
+    a failed start can never raise a masking error out of the
+    ``finally`` over the body's real exception.
     """
     os.makedirs(log_dir, exist_ok=True)
-    jax.profiler.start_trace(log_dir,
-                             create_perfetto_link=create_perfetto_link)
+    started = False
+    try:
+        jax.profiler.start_trace(log_dir,
+                                 create_perfetto_link=create_perfetto_link)
+        started = True
+    except RuntimeError:
+        pass  # already tracing (nested start): body still runs, unprofiled
     try:
         yield log_dir
     finally:
-        jax.profiler.stop_trace()
+        if started:
+            try:
+                jax.profiler.stop_trace()
+            except RuntimeError:
+                pass  # torn down elsewhere; never mask the body's error
