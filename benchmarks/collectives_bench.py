@@ -131,6 +131,42 @@ def _obs_compare_mode(args, mpi, n):
           file=sys.stderr)
 
 
+def _faults_compare_mode(args, mpi, n):
+    """Dispatch overhead of the fault layer on its instrumented hot
+    path: the same small STAGED allreduce (the eager surface that
+    carries the ``Config.faults`` branch + policy wrapper) timed under
+    faults=off / policy (docs/FAULTS.md acceptance: off->policy must
+    sit within the same noise floor --obs-compare establishes for the
+    telemetry branch).  Policy-only on purpose — injection would
+    measure the injected faults, not the dispatch."""
+    import numpy as np
+
+    from torchmpi_tpu.utils import metrics as umetrics
+
+    x = np.random.RandomState(0).rand(n, 1024).astype(np.float32)
+    results = {}
+    for mode in ("off", "policy"):
+        mpi.set_config(faults=mode)
+        mpi.allreduce(x, backend="host")  # warm the placement path
+        results[mode] = umetrics.timed(
+            lambda: mpi.allreduce(x, backend="host"),
+            iters=args.iters, rounds=5)
+        r = results[mode]
+        line = {"mode": mode, "us_per_dispatch": round(r.median * 1e6, 2),
+                "jitter_us": round(r.jitter * 1e6, 2)}
+        print(json.dumps(line) if args.json else
+              f"faults={mode:7s} {r.median * 1e6:9.2f} us/dispatch "
+              f"(jitter {r.jitter * 1e6:.2f} us)")
+    mpi.set_config(faults="off")
+    base, pol = results["off"], results["policy"]
+    delta = pol.median - base.median
+    floor = base.jitter + pol.jitter
+    verdict = "WITHIN NOISE" if abs(delta) <= floor else "MEASURABLE"
+    print(f"# policy-vs-off delta {delta * 1e6:+.2f} us "
+          f"(noise floor {floor * 1e6:.2f} us): {verdict}",
+          file=sys.stderr)
+
+
 def main():
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--devices", type=int, default=0,
@@ -157,6 +193,10 @@ def main():
                    help="telemetry overhead mode: the same small eager "
                         "allreduce under obs=off/metrics/trace "
                         "(docs/OBSERVABILITY.md)")
+    p.add_argument("--faults-compare", action="store_true",
+                   help="fault-layer overhead mode: the same small "
+                        "staged allreduce under faults=off/policy "
+                        "(docs/FAULTS.md)")
     args = p.parse_args()
     if args.devices:
         from torchmpi_tpu.utils.simulation import force_cpu_devices
@@ -185,6 +225,11 @@ def main():
 
     if args.obs_compare:
         _obs_compare_mode(args, mpi, n)
+        mpi.stop()
+        return
+
+    if args.faults_compare:
+        _faults_compare_mode(args, mpi, n)
         mpi.stop()
         return
 

@@ -16,6 +16,26 @@ progress counters go stale — the same heartbeat-style detection the PS
 client's ``ping()`` provides for server liveness, applied to workers.
 
 Run: ``python examples/downpour_elastic.py --devices 8 --workers 4``
+
+Chaos walkthrough (docs/FAULTS.md): the same run under an injected
+transient PS fault — the fault layer retries the dropped exchanges and
+every worker still finishes::
+
+    python scripts/chaos_tool.py gen --out /tmp/elastic_chaos.json \
+        --seed 11 --rule ps.request:drop:1.0:2:0.02
+    TORCHMPI_TPU_FAULTS=/tmp/elastic_chaos.json \
+        python examples/downpour_elastic.py --devices 8 --workers 4
+
+Running this walkthrough exposed two latent robustness gaps, both fixed
+below: (1) a worker whose PS exchange stayed dead (``PeerTimeoutError``
+after the retry budget) crashed the WHOLE job through ``run_workers`` —
+in an elastic system a worker that loses its parameter server is just a
+dead worker, so ``guarded`` now retires it and lets the monitor report
+the loss; (2) a worker that exited (crash or fault) with its prefetch
+``receive()`` still in flight left the handle to a garbage-collection-
+time drain against a possibly-wedged server — the worker now settles
+its own prefetch on the way out, bounded by the socket timeout
+(``Config.ps_timeout_s``), which this example predated.
 """
 
 import threading
@@ -75,24 +95,39 @@ def main():
         with jax.default_device(dev):
             params = jax.tree.map(jnp.asarray, params0)
             fetch_handle = None
-            for step, (xb, yb) in enumerate(dutil.batches(
-                    X, Y, args.batch_size, steps=args.steps,
-                    seed=args.seed + widx + 1)):
-                if widx == 0 and step == args.die_at:
-                    raise SimulatedCrash(f"worker 0 dies at step {step}")
-                _, grads = grad_fn(params, jnp.asarray(xb),
-                                   jnp.asarray(yb))
-                update = jax.tree.map(lambda g: -args.lr * np.asarray(g),
-                                      grads)
-                ps.send(update, rule="add")
-                params = jax.tree.map(lambda p, u: p + u, params,
-                                      jax.tree.map(jnp.asarray, update))
-                progress[widx] = step + 1
-                if fetch_handle is not None and fetch_handle.done:
-                    params = jax.tree.map(jnp.asarray, fetch_handle.wait())
-                    fetch_handle = None
-                if step % args.fetch_every == 0 and fetch_handle is None:
-                    fetch_handle = ps.receive()
+            try:
+                for step, (xb, yb) in enumerate(dutil.batches(
+                        X, Y, args.batch_size, steps=args.steps,
+                        seed=args.seed + widx + 1)):
+                    if widx == 0 and step == args.die_at:
+                        raise SimulatedCrash(
+                            f"worker 0 dies at step {step}")
+                    _, grads = grad_fn(params, jnp.asarray(xb),
+                                       jnp.asarray(yb))
+                    update = jax.tree.map(
+                        lambda g: -args.lr * np.asarray(g), grads)
+                    ps.send(update, rule="add")
+                    params = jax.tree.map(lambda p, u: p + u, params,
+                                          jax.tree.map(jnp.asarray,
+                                                       update))
+                    progress[widx] = step + 1
+                    if fetch_handle is not None and fetch_handle.done:
+                        params = jax.tree.map(jnp.asarray,
+                                              fetch_handle.wait())
+                        fetch_handle = None
+                    if step % args.fetch_every == 0 and \
+                            fetch_handle is None:
+                        fetch_handle = ps.receive()
+            finally:
+                # Latent-hang fix (chaos walkthrough above): never exit
+                # with the prefetch in flight.  The wait is bounded by
+                # the socket timeout; a failed/late prefetch on a dying
+                # worker is simply discarded.
+                if fetch_handle is not None:
+                    try:
+                        fetch_handle.wait()
+                    except Exception:  # noqa: BLE001 — worker is done
+                        pass
 
     # Failure detector: a worker whose counter stops advancing while the
     # job is still running is declared dead (no gang abort — just noted).
@@ -129,14 +164,32 @@ def main():
     mon = threading.Thread(target=monitor, daemon=True)
     mon.start()
     # run_workers propagates exceptions; the simulated crash must not kill
-    # the job, so worker 0's death is caught and recorded instead.
+    # the job, so worker 0's death is caught and recorded instead.  The
+    # same goes for a worker whose parameter-server exchanges stayed dead
+    # past the fault layer's retry budget (PeerTimeoutError/
+    # RetriesExhaustedError under TORCHMPI_TPU_FAULTS): elastically, that
+    # is one lost worker, not a job failure — the monitor reports it and
+    # the survivors keep training.
     crashed = []
+    fault_lost = set()
+
+    def _fault_errors():
+        import sys as _sys
+
+        mod = _sys.modules.get("torchmpi_tpu.faults")
+        if mod is None:  # faults off: the classes don't exist
+            return ()
+        return (mod.PeerTimeoutError, mod.RetriesExhaustedError,
+                mod.FaultError)
 
     def guarded(widx):
         try:
             worker(widx)
         except SimulatedCrash as e:
             crashed.append(str(e))
+        except _fault_errors() as e:
+            fault_lost.add(widx)
+            crashed.append(f"worker {widx} lost its PS: {e!r}")
 
     common.run_workers(guarded, n_workers)
     stop_monitor.set()
@@ -144,15 +197,18 @@ def main():
 
     center = jax.tree.map(jnp.asarray, ps.receive().wait())
     acc = common.evaluate(model, center, X[:1024], Y[:1024])
-    survivors = [w for w in range(n_workers) if w != 0]
+    survivors = [w for w in range(n_workers)
+                 if w != 0 and w not in fault_lost]
     print(f"crashed: {crashed}")
     print(f"detected dead: {sorted(dead)}")
+    print(f"fault-lost workers: {sorted(fault_lost)}")
     print(f"survivor steps: {[progress[w] for w in survivors]}")
     print(f"final accuracy (PS params) {acc:.3f}")
     ps.shutdown()
     mpi.stop()
     assert crashed, "worker 0 should have crashed"
     assert 0 in dead, "monitor failed to detect the lost worker"
+    assert survivors, "every worker died — nothing elastic survived"
     assert all(progress[w] == args.steps for w in survivors), \
         "survivors did not finish"
     assert acc > 0.9, "elastic downpour did not converge"

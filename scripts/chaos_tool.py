@@ -1,0 +1,178 @@
+"""Generate, lint, and summarize torchmpi_tpu fault plans (docs/FAULTS.md).
+
+The chaos-engineering operator surface over ``torchmpi_tpu/faults/``:
+
+    python scripts/chaos_tool.py gen --out plan.json --seed 7 \\
+        --rule ps.request:drop:0.5:3:0.01 --rule host_staged.*:corrupt
+    python scripts/chaos_tool.py lint plan.json
+    python scripts/chaos_tool.py summarize metrics_host*.jsonl
+
+``gen`` writes a versioned fault-plan JSON from ``--rule`` specs
+(``site:kind[:prob[:max_hits[:delay_s]]]``; ``site`` may glob the
+instrumented sites, ``max_hits=-1`` means unbounded).  ``lint``
+validates a plan — schema/version errors exit 2, semantic problems
+(site patterns matching no instrumented site, dead rules) print and
+exit 1.  ``summarize`` reads per-host obs metric dumps (the files
+``TORCHMPI_TPU_OBS=metrics`` leaves behind) and prints only the
+``tm_fault_*`` series — what was injected, what survived a retry, what
+hit a deadline — the after-action report of a chaos run; exits 1 when a
+chaos run left NO fault counters (it injected nothing: wrong plan,
+wrong sites, or faults never armed).
+
+Standalone on purpose: no jax — writing a chaos plan for a pod (or
+reading its post-mortem) must not need the pod's software stack.  The
+plan schema is loaded straight from ``torchmpi_tpu/faults/inject.py``
+(itself dependency-free) without importing the package.
+"""
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+from typing import Dict, List, Tuple
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_inject():
+    path = os.path.join(_REPO, "torchmpi_tpu", "faults", "inject.py")
+    spec = importlib.util.spec_from_file_location("_faults_inject", path)
+    mod = importlib.util.module_from_spec(spec)
+    # Registered before exec: the dataclass machinery resolves the
+    # module's (future-style string) annotations through sys.modules.
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def parse_rule(inject, spec: str):
+    """``site:kind[:prob[:max_hits[:delay_s]]]`` -> FaultRule."""
+    parts = spec.split(":")
+    if len(parts) < 2 or len(parts) > 5:
+        raise ValueError(
+            f"--rule {spec!r}: want site:kind[:prob[:max_hits[:delay_s]]]")
+    kw = {"site": parts[0], "kind": parts[1]}
+    if len(parts) > 2:
+        kw["prob"] = float(parts[2])
+    if len(parts) > 3:
+        kw["max_hits"] = int(parts[3])
+    if len(parts) > 4:
+        kw["delay_s"] = float(parts[4])
+    rule = inject.FaultRule(**kw)
+    rule.validate()
+    return rule
+
+
+def cmd_gen(args) -> int:
+    inject = _load_inject()
+    try:
+        rules = [parse_rule(inject, spec) for spec in args.rule]
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    plan = inject.FaultPlan(seed=args.seed, note=args.note, rules=rules)
+    problems = inject.lint_plan(plan)
+    for p in problems:
+        print(f"warning: {p}")
+    plan.save(args.out)
+    print(f"wrote {args.out}: seed={plan.seed} rules={len(plan.rules)}"
+          + (f" ({len(problems)} warning(s))" if problems else ""))
+    return 0
+
+
+def cmd_lint(args) -> int:
+    inject = _load_inject()
+    rc = 0
+    for path in args.files:
+        try:
+            plan = inject.FaultPlan.load(path)
+        except (OSError, ValueError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        problems = inject.lint_plan(plan)
+        status = "OK" if not problems else f"{len(problems)} problem(s)"
+        print(f"{path}: version={inject.FAULT_PLAN_VERSION} "
+              f"seed={plan.seed} rules={len(plan.rules)} — {status}")
+        for p in problems:
+            print(f"  {p}")
+            rc = 1
+    return rc
+
+
+def _load_counters(path: str) -> List[dict]:
+    out = []
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as e:
+                raise ValueError(f"{path}:{i}: not JSON ({e})") from None
+            if isinstance(rec, dict) and rec.get("kind") == "counter":
+                out.append(rec)
+    return out
+
+
+def cmd_summarize(args) -> int:
+    totals: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
+    for path in args.files:
+        for rec in _load_counters(path):
+            name = rec.get("name", "")
+            if not name.startswith("tm_fault_"):
+                continue
+            key = (name, tuple(sorted(rec.get("labels", {}).items())))
+            totals[key] = totals.get(key, 0) + rec.get("value", 0)
+    if not totals:
+        print("no tm_fault_* counters found — the chaos run injected "
+              "nothing (plan never matched a site, or faults were not "
+              "armed)", file=sys.stderr)
+        return 1
+    by_action: Dict[str, float] = {}
+    print(f"fault summary over {len(args.files)} host dump(s):")
+    for (name, labels), v in sorted(totals.items()):
+        lab = ",".join(f"{k}={val}" for k, val in labels)
+        print(f"  {name}{{{lab}}} = {int(v)}")
+        action = name[len("tm_fault_"):-len("_total")]
+        by_action[action] = by_action.get(action, 0) + v
+    line = "  ".join(f"{a}={int(v)}" for a, v in sorted(by_action.items()))
+    print(f"totals: {line}")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    s = sub.add_parser("gen", help="write a fault plan from --rule specs")
+    s.add_argument("--out", required=True)
+    s.add_argument("--seed", type=int, default=0)
+    s.add_argument("--note", default="")
+    s.add_argument("--rule", action="append", default=[],
+                   help="site:kind[:prob[:max_hits[:delay_s]]] "
+                        "(repeatable)")
+    s.set_defaults(fn=cmd_gen)
+
+    s = sub.add_parser("lint", help="validate plan files")
+    s.add_argument("files", nargs="+")
+    s.set_defaults(fn=cmd_lint)
+
+    s = sub.add_parser("summarize",
+                       help="print tm_fault_* counters from obs dumps")
+    s.add_argument("files", nargs="+")
+    s.set_defaults(fn=cmd_summarize)
+
+    args = p.parse_args(argv)
+    try:
+        return args.fn(args)
+    except BrokenPipeError:
+        return 0
+    except (OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

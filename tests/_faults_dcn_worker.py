@@ -1,0 +1,79 @@
+"""Worker for the 2-process chaos acceptance test (test_faults.py;
+underscore prefix keeps pytest from collecting it).
+
+The docs/FAULTS.md acceptance scenario, one phase per argv mode:
+
+- clean  : host-staged allreduce across both hosts, no faults — prints
+           the result checksum.
+- retry  : the same exchange under a seeded transient-drop plan with
+           retries armed — must complete and print the SAME checksum
+           (bit-identical survival).
+- noretry: the same plan with retries disabled — the injected drop must
+           surface as PeerTimeoutError within the site deadline on BOTH
+           ranks (the fault fires before any cross-process dispatch, so
+           neither rank is left hanging in the gang collective).
+"""
+
+import hashlib
+import os
+import sys
+import time
+
+pid = int(sys.argv[1])
+nproc = int(sys.argv[2])
+port = sys.argv[3]
+mode = sys.argv[4]
+plan_path = sys.argv[5] if len(sys.argv) > 5 else ""
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np  # noqa: E402
+
+import torchmpi_tpu as mpi  # noqa: E402
+
+cfg = dict(coordinator_address=f"127.0.0.1:{port}", num_processes=nproc,
+           process_id=pid)
+if mode == "retry":
+    cfg.update(faults=plan_path, fault_retries=2, fault_backoff_s=0.01,
+               fault_deadline_s=30.0)
+elif mode == "noretry":
+    cfg.update(faults=plan_path, fault_retries=0, fault_deadline_s=5.0)
+
+mesh = mpi.init(mpi.Config(**cfg))
+n = mpi.device_count()
+x = np.stack([np.arange(7, dtype=np.float32) + r for r in range(n)])
+
+if mode == "noretry":
+    from torchmpi_tpu.faults import PeerTimeoutError
+
+    t0 = time.monotonic()
+    try:
+        mpi.allreduce(x, backend="host")
+        print(f"CHECK rank={pid} UNEXPECTED-SUCCESS", flush=True)
+    except PeerTimeoutError as e:
+        dt = time.monotonic() - t0
+        assert dt < 5.0, f"deadline overshot: {dt}"
+        assert e.site == "host_staged", e.site
+        print(f"CHECK rank={pid} peer-timeout ok ({dt:.2f}s)", flush=True)
+else:
+    local, idx = mpi.collectives.to_local(mpi.allreduce(x, backend="host"))
+    digest = hashlib.sha256(np.ascontiguousarray(local).tobytes())
+    print(f"CHECK rank={pid} digest={digest.hexdigest()}", flush=True)
+    if mode == "retry":
+        from torchmpi_tpu import faults
+
+        assert faults.plan() is not None
+        # The seeded drop really fired on this rank (deterministic plan,
+        # both ranks inject identically) and the exchange survived it.
+        assert faults.plan().arrivals("host_staged.gather") >= 2, \
+            faults.plan().arrivals("host_staged.gather")
+        print(f"CHECK rank={pid} survived ok", flush=True)
+
+mpi.barrier()
+mpi.stop()
+print(f"CHECK rank={pid} done", flush=True)

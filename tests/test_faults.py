@@ -1,0 +1,675 @@
+"""Fault injection + resilient dispatch (torchmpi_tpu/faults/ —
+docs/FAULTS.md): plan schema round-trip and schedule determinism, the
+retry/backoff/deadline policy, the per-peer health ledger, per-site
+injection through the real call sites (host-staged collectives, barrier,
+parameter server, async IO), the off-mode never-imported guarantee, and
+the 2-process chaos acceptance scenario (slow)."""
+
+import importlib.util
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import torchmpi_tpu as mpi
+from torchmpi_tpu.faults import health as fhealth
+from torchmpi_tpu.faults import inject as finject
+from torchmpi_tpu.faults import policy as fpolicy
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _chaos_tool():
+    spec = importlib.util.spec_from_file_location(
+        "_chaos_tool_under_test",
+        os.path.join(_REPO, "scripts", "chaos_tool.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _write_plan(path, rules, seed=7):
+    with open(path, "w") as f:
+        json.dump({"version": finject.FAULT_PLAN_VERSION, "seed": seed,
+                   "rules": rules}, f)
+    return str(path)
+
+
+@pytest.fixture()
+def fault_runtime(tmp_path):
+    """Callable fixture: arm a flat 8-device runtime under a rule list
+    (fresh plan file per call, so re-arming restarts the schedule)."""
+    counter = [0]
+
+    def arm(rules, seed=7, **cfg_kw):
+        counter[0] += 1
+        plan = _write_plan(tmp_path / f"plan{counter[0]}.json", rules,
+                           seed=seed)
+        mpi.stop()
+        return mpi.init(mpi.Config(dcn_size=1, faults=plan,
+                                   fault_backoff_s=0.01, **cfg_kw))
+
+    yield arm
+    from torchmpi_tpu import faults
+
+    faults.reset()
+    mpi.stop()
+
+
+# ---------------------------------------------------------------------------
+# Plan schema + deterministic schedule (pure python)
+# ---------------------------------------------------------------------------
+
+
+def test_plan_roundtrip(tmp_path):
+    plan = finject.FaultPlan(seed=11, note="chaos", rules=[
+        finject.FaultRule("ps.request", "drop", prob=0.5, after=2,
+                          max_hits=3, delay_s=0.25),
+        finject.FaultRule("host_staged.*", "corrupt"),
+    ])
+    path = plan.save(str(tmp_path / "plan.json"))
+    back = finject.FaultPlan.load(path)
+    assert back.seed == 11 and back.note == "chaos"
+    assert back.rules == plan.rules
+
+
+def test_plan_version_and_schema_raise(tmp_path):
+    p = tmp_path / "bad.json"
+    p.write_text(json.dumps({"version": 99, "rules": []}))
+    with pytest.raises(ValueError, match="version"):
+        finject.FaultPlan.load(str(p))
+    p.write_text("not json")
+    with pytest.raises(ValueError, match="not JSON"):
+        finject.FaultPlan.load(str(p))
+    for bad in [{"site": "x", "kind": "explode"},
+                {"site": "x", "kind": "drop", "prob": 2.0},
+                {"site": "x", "kind": "drop", "typo": 1},
+                {"kind": "drop"}]:
+        with pytest.raises((ValueError, TypeError)):
+            finject.FaultRule.from_json(bad)
+
+
+def test_schedule_determinism():
+    def fires(seed, n=64):
+        plan = finject.FaultPlan(seed=seed, rules=[
+            finject.FaultRule("s", "drop", prob=0.5, max_hits=-1)])
+        return [plan.decide("s") is not None for _ in range(n)]
+
+    a = fires(3)
+    assert a == fires(3), "same seed must give the same schedule"
+    assert a != fires(4), "seed must actually key the schedule"
+    assert 8 < sum(a) < 56, "prob=0.5 should fire roughly half the time"
+
+
+def test_schedule_after_and_max_hits():
+    plan = finject.FaultPlan(seed=0, rules=[
+        finject.FaultRule("s", "fail", after=2, max_hits=2)])
+    got = [plan.decide("s") is not None for _ in range(8)]
+    assert got == [False, False, True, True, False, False, False, False]
+    plan.reset_schedule()
+    assert [plan.decide("s") is not None for _ in range(3)] == \
+        [False, False, True]
+
+
+def test_glob_rule_max_hits_bounds_total_fires():
+    # A glob rule's max_hits caps the RULE, not each matched site — a
+    # "2 drops" plan must inject 2 drops however many sites the pattern
+    # matches, or it silently exceeds the retry budget it was written
+    # against (caught live: host_staged.* firing per leg).
+    plan = finject.FaultPlan(seed=0, rules=[
+        finject.FaultRule("host_staged.*", "drop", max_hits=2)])
+    fired = 0
+    for _ in range(4):
+        fired += plan.decide("host_staged.gather") is not None
+        fired += plan.decide("host_staged.scatter") is not None
+    assert fired == 2
+
+
+def test_corrupt_buffer_flips_and_respects_readonly():
+    buf = np.zeros(256, np.float32)
+    finject.corrupt_buffer(buf, seed=1, hit=0)
+    assert np.any(buf != 0), "writable buffer must actually corrupt"
+    again = np.zeros(256, np.float32)
+    finject.corrupt_buffer(again, seed=1, hit=0)
+    np.testing.assert_array_equal(buf, again)  # deterministic corruption
+    ro = np.broadcast_to(np.zeros(4, np.float32), (8, 4))
+    finject.corrupt_buffer(ro, seed=1, hit=0)  # must not raise
+    assert not np.any(ro)
+
+
+# ---------------------------------------------------------------------------
+# Policy (pure python)
+# ---------------------------------------------------------------------------
+
+
+def test_backoff_deterministic_doubling_capped():
+    pol = fpolicy.Policy(backoff_s=0.1, backoff_max_s=0.35, jitter=0.5,
+                         seed=3)
+    seq = [pol.backoff("site", i) for i in range(1, 6)]
+    assert seq == [pol.backoff("site", i) for i in range(1, 6)]
+    assert 0.1 <= seq[0] <= 0.15 and 0.2 <= seq[1] <= 0.3
+    assert all(s <= 0.35 * 1.5 for s in seq)  # capped (plus jitter)
+
+
+def test_run_retry_then_succeed():
+    calls = []
+    events = []
+
+    def attempt(i):
+        calls.append(i)
+        if i < 2:
+            raise finject.TransientFault("flaky")
+        return "ok"
+
+    out = fpolicy.run("s", attempt,
+                      policy=fpolicy.Policy(retries=3, backoff_s=0.001),
+                      on_event=lambda a, s: events.append(a))
+    assert out == "ok" and calls == [0, 1, 2]
+    assert events == ["retry", "retry", "survived"]
+
+
+def test_run_retries_exhausted():
+    def attempt(i):
+        raise finject.CorruptPayload("always corrupt")
+
+    with pytest.raises(fpolicy.RetriesExhaustedError) as ei:
+        fpolicy.run("s", attempt,
+                    policy=fpolicy.Policy(retries=1, backoff_s=0.001))
+    assert ei.value.attempts == 2
+    assert isinstance(ei.value.last_error, finject.CorruptPayload)
+
+
+def test_run_drop_without_retries_is_peer_timeout():
+    # Acceptance (b): a dropped packet with retries disabled converts
+    # into PeerTimeoutError (the hang, typed) instead of a bare error.
+    def attempt(i):
+        raise finject.DroppedPacket("silence")
+
+    t0 = time.monotonic()
+    with pytest.raises(fpolicy.PeerTimeoutError) as ei:
+        fpolicy.run("s", attempt, peer="p0",
+                    policy=fpolicy.Policy(retries=0, deadline_s=5.0))
+    assert time.monotonic() - t0 < 5.0, "must fail within the deadline"
+    assert ei.value.site == "s" and ei.value.peer == "p0"
+
+
+def test_run_deadline_overrides_remaining_retries():
+    def attempt(i):
+        time.sleep(0.03)
+        raise finject.TransientFault("slow flake")
+
+    with pytest.raises(fpolicy.PeerTimeoutError):
+        fpolicy.run("s", attempt,
+                    policy=fpolicy.Policy(retries=100, backoff_s=0.001,
+                                          deadline_s=0.05))
+
+
+def test_run_nontransient_propagates_untouched():
+    def attempt(i):
+        raise finject.InjectedFailure("dead peer")
+
+    with pytest.raises(finject.InjectedFailure):
+        fpolicy.run("s", attempt, policy=fpolicy.Policy(retries=5))
+
+
+def test_bounded_call_times_out_and_passes_through():
+    assert fpolicy.bounded_call("s", lambda: 42, deadline_s=5.0) == 42
+    with pytest.raises(fpolicy.PeerTimeoutError):
+        fpolicy.bounded_call("s", lambda: time.sleep(5), deadline_s=0.05)
+    with pytest.raises(KeyError):  # worker exceptions re-raise in caller
+        fpolicy.bounded_call("s", lambda: {}["missing"], deadline_s=5.0)
+
+
+def test_is_transient_classification():
+    assert fpolicy.is_transient(finject.DroppedPacket("x"))
+    assert fpolicy.is_transient(socket.timeout())
+    assert fpolicy.is_transient(ConnectionResetError())
+    assert not fpolicy.is_transient(finject.InjectedFailure("x"))
+    assert not fpolicy.is_transient(ValueError("x"))
+
+
+# ---------------------------------------------------------------------------
+# Health ledger (pure python)
+# ---------------------------------------------------------------------------
+
+
+def test_health_ledger_thresholds_and_decide():
+    seen = []
+    led = fhealth.HealthLedger(suspect_after=2, dead_after=4,
+                               on_transition=lambda p, o, n: seen.append(
+                                   (p, o, n)))
+    assert led.decide("a") == "ok"
+    assert led.record("a", ok=False) == "healthy"
+    assert led.record("a", ok=False) == "suspect"
+    assert led.decide("a") == "degrade"
+    led.record("a", ok=False)
+    assert led.record("a", ok=False) == "dead"
+    assert led.decide("a") == "raise"
+    # One success fully resurrects the peer.
+    assert led.record("a", ok=True) == "healthy"
+    assert led.decide("a") == "ok"
+    assert seen == [("a", "healthy", "suspect"), ("a", "suspect", "dead"),
+                    ("a", "dead", "healthy")]
+    h = led.get("a")
+    assert h.total_failures == 4 and h.total_successes == 1
+    with pytest.raises(ValueError):
+        fhealth.HealthLedger(suspect_after=5, dead_after=2)
+
+
+# ---------------------------------------------------------------------------
+# Per-site injection through the real call sites
+# ---------------------------------------------------------------------------
+
+
+def test_host_staged_drop_retried_bit_identical(fault_runtime):
+    x = np.arange(32, dtype=np.float32).reshape(8, 4)
+    mpi.stop()
+    mpi.init(mpi.Config(dcn_size=1))
+    clean = np.asarray(mpi.allreduce(x, backend="host"))
+    fault_runtime([{"site": "host_staged.gather", "kind": "drop",
+                    "max_hits": 1}])
+    got = np.asarray(mpi.allreduce(x, backend="host"))
+    np.testing.assert_array_equal(got, clean)
+    from torchmpi_tpu import faults
+
+    assert faults.plan().arrivals("host_staged.gather") == 2  # retried
+
+
+def test_host_staged_corrupt_then_heal_bit_identical(fault_runtime):
+    x = np.arange(32, dtype=np.float32).reshape(8, 4)
+    mpi.stop()
+    mpi.init(mpi.Config(dcn_size=1))
+    clean = np.asarray(mpi.allreduce(x, backend="host"))
+    fault_runtime([{"site": "host_staged.gather", "kind": "corrupt",
+                    "max_hits": 1}])
+    got = np.asarray(mpi.allreduce(x, backend="host"))
+    np.testing.assert_array_equal(got, clean)
+
+
+def test_host_staged_hard_fail_propagates(fault_runtime):
+    fault_runtime([{"site": "host_staged.gather", "kind": "fail"}])
+    from torchmpi_tpu import faults
+
+    with pytest.raises(faults.InjectedFailure):
+        mpi.allreduce(np.ones((8, 2), np.float32), backend="host")
+    # Not retried: one arrival, and the next call is clean (max_hits=1).
+    assert faults.plan().arrivals("host_staged.gather") == 1
+    np.testing.assert_array_equal(
+        np.asarray(mpi.allreduce(np.ones((8, 2), np.float32),
+                                 backend="host"))[0],
+        np.full(2, 8.0, np.float32))
+
+
+def test_host_staged_drop_no_retries_peer_timeout(fault_runtime):
+    fault_runtime([{"site": "host_staged.gather", "kind": "drop",
+                    "max_hits": -1}], fault_retries=0,
+                  fault_deadline_s=5.0)
+    from torchmpi_tpu import faults
+
+    t0 = time.monotonic()
+    with pytest.raises(faults.PeerTimeoutError) as ei:
+        mpi.allreduce(np.ones((8, 2), np.float32), backend="host")
+    assert time.monotonic() - t0 < 5.0
+    assert ei.value.site == "host_staged"
+
+
+def test_barrier_delay_and_drop_survive(fault_runtime):
+    fault_runtime([{"site": "runtime.barrier", "kind": "delay",
+                    "delay_s": 0.01},
+                   {"site": "runtime.barrier", "kind": "drop",
+                    "after": 1, "max_hits": 1}])
+    mpi.barrier()  # delayed
+    mpi.barrier()  # dropped once, retried
+    from torchmpi_tpu import faults
+
+    assert faults.plan().arrivals("runtime.barrier") == 3
+
+
+def test_ps_request_drop_retried(fault_runtime):
+    fault_runtime([{"site": "ps.request", "kind": "drop", "max_hits": 2}])
+    ps = mpi.parameterserver.init({"w": np.zeros((64,), np.float32)},
+                                  num_shards=2)
+    try:
+        ps.send({"w": np.ones((64,), np.float32)}, rule="add").wait()
+        got = ps.receive().wait()
+        np.testing.assert_allclose(got["w"], 1.0)
+    finally:
+        ps.shutdown()
+
+
+def test_ps_response_drop_retransmits(fault_runtime):
+    # A drop on the WAIT leg forces a whole-exchange retransmit; the
+    # receive must still return the correct values.
+    fault_runtime([{"site": "ps.response", "kind": "drop", "max_hits": 1}])
+    ps = mpi.parameterserver.init({"w": np.full((32,), 3.0, np.float32)},
+                                  num_shards=2)
+    try:
+        got = ps.receive().wait()
+        np.testing.assert_allclose(got["w"], 3.0)
+        from torchmpi_tpu import faults
+
+        assert all(h.state == "healthy" for h in faults.ledger().peers())
+    finally:
+        ps.shutdown()
+
+
+def test_aio_submit_drop_retried(fault_runtime, tmp_path):
+    from torchmpi_tpu.utils import aio
+
+    fault_runtime([{"site": "aio.submit", "kind": "drop", "max_hits": 1}])
+    path = str(tmp_path / "out.bin")
+    with aio.AsyncWriter() as w:
+        assert w.submit(path, b"payload").wait() == path
+    with open(path, "rb") as f:
+        assert f.read() == b"payload"
+    from torchmpi_tpu import faults
+
+    assert faults.plan().arrivals("aio.submit") == 2
+
+
+def test_fault_counters_and_flight_tail(fault_runtime, tmp_path):
+    fault_runtime([{"site": "host_staged.gather", "kind": "drop",
+                    "max_hits": 1},
+                   {"site": "host_staged.gather", "kind": "drop",
+                    "after": 2, "max_hits": -1}], obs="metrics",
+                  obs_dir=str(tmp_path / "obs"))
+    from torchmpi_tpu import obs
+
+    obs.reset()
+    try:
+        mpi.allreduce(np.ones((8, 2), np.float32), backend="host")
+        reg = obs.registry()
+        assert reg.counter("tm_fault_injected_total",
+                           site="host_staged.gather", kind="drop",
+                           peer="gang") == 1
+        assert reg.counter_total("tm_fault_retry_total") == 1
+        assert reg.counter_total("tm_fault_survived_total") == 1
+        # The injected site is a flight event blame can name.
+        assert any(e[2] == "fault" and e[3] == "host_staged.gather"
+                   for e in obs.recorder().events())
+        # And the tail rides a PeerTimeoutError.
+        from torchmpi_tpu import faults
+
+        mpi.set_config(fault_retries=0)
+        with pytest.raises(faults.PeerTimeoutError) as ei:
+            mpi.allreduce(np.ones((8, 2), np.float32), backend="host")
+        assert ei.value.flight_tail, "tail must carry the flight events"
+        assert ei.value.flight_tail[-1]["ev"] in ("fault", "eager")
+    finally:
+        obs.deactivate()
+        obs.reset()
+
+
+def test_set_config_faults_off_disarms(fault_runtime):
+    fault_runtime([{"site": "host_staged.gather", "kind": "fail",
+                    "max_hits": -1}])
+    from torchmpi_tpu import faults
+
+    with pytest.raises(faults.InjectedFailure):
+        mpi.allreduce(np.ones((8, 2), np.float32), backend="host")
+    mpi.set_config(faults="off")
+    assert not faults.active()
+    np.testing.assert_array_equal(
+        np.asarray(mpi.allreduce(np.ones((8, 2), np.float32),
+                                 backend="host"))[0],
+        np.full(2, 8.0, np.float32))
+
+
+def test_policy_mode_without_plan(fault_runtime):
+    mpi.stop()
+    mpi.init(mpi.Config(dcn_size=1, faults="policy"))
+    from torchmpi_tpu import faults
+
+    assert faults.active() and not faults.injecting()
+    # No injection: everything just works, sites pass through.
+    np.testing.assert_array_equal(
+        np.asarray(mpi.allreduce(np.ones((8, 2), np.float32),
+                                 backend="host"))[0],
+        np.full(2, 8.0, np.float32))
+    mpi.barrier()
+
+
+def test_corrupt_plan_raises_at_init(tmp_path):
+    bad = tmp_path / "bad.json"
+    bad.write_text("{")
+    mpi.stop()
+    with pytest.raises(ValueError):
+        mpi.init(mpi.Config(dcn_size=1, faults=str(bad)))
+    mpi.stop()
+
+
+def test_faults_env_reaches_explicit_config(tmp_path, monkeypatch):
+    plan = _write_plan(tmp_path / "env_plan.json",
+                       [{"site": "aio.submit", "kind": "delay"}])
+    monkeypatch.setenv("TORCHMPI_TPU_FAULTS", plan)
+    monkeypatch.setenv("TORCHMPI_TPU_FAULT_RETRIES", "7")
+    mpi.stop()
+    try:
+        mpi.init(mpi.Config(dcn_size=1))  # explicit Config, env pickup
+        from torchmpi_tpu import faults
+
+        assert faults.injecting()
+        assert faults.current_policy().retries == 7
+        assert mpi.runtime.config().faults == plan
+    finally:
+        from torchmpi_tpu import faults
+
+        faults.reset()
+        mpi.stop()
+
+
+# ---------------------------------------------------------------------------
+# ps_timeout_s satellite
+# ---------------------------------------------------------------------------
+
+
+def test_ps_timeout_config_and_env(monkeypatch):
+    from torchmpi_tpu.parallel import ps as psimpl
+
+    mpi.stop()
+    mpi.init(mpi.Config(dcn_size=1, ps_timeout_s=2.5))
+    assert psimpl._timeout_ms() == 2500
+    mpi.stop()
+    # Default defers to the env (any-config pickup in runtime.init).
+    monkeypatch.setenv("TORCHMPI_TPU_PS_TIMEOUT", "1.5")
+    mpi.init(mpi.Config(dcn_size=1))
+    assert mpi.runtime.config().ps_timeout_s == 1.5
+    assert psimpl._timeout_ms() == 1500
+    mpi.stop()
+    # Standalone (no runtime): env wins, legacy ms spelling honored.
+    assert psimpl._timeout_ms() == 1500
+    monkeypatch.delenv("TORCHMPI_TPU_PS_TIMEOUT")
+    monkeypatch.setenv("TORCHMPI_TPU_PS_TIMEOUT_MS", "750")
+    assert psimpl._timeout_ms() == 750
+    # The legacy env must survive init too (a pre-PR deployment
+    # exporting only _MS must not silently regress to 30 s).
+    mpi.init(mpi.Config(dcn_size=1))
+    assert mpi.runtime.config().ps_timeout_s == 0.75
+    assert psimpl._timeout_ms() == 750
+    # set_config validates like init: a negative timeout never reaches
+    # the native connect as an unbounded wait.
+    with pytest.raises(ValueError):
+        mpi.set_config(ps_timeout_s=-1)
+    mpi.set_config(ps_timeout_s="2")  # coerced like init
+    assert mpi.runtime.config().ps_timeout_s == 2.0
+    mpi.stop()
+
+
+# ---------------------------------------------------------------------------
+# restart driver integration
+# ---------------------------------------------------------------------------
+
+
+def test_restart_on_peer_timeout_path(tmp_path):
+    from torchmpi_tpu.utils import restart
+
+    hits = []
+
+    def flaky(state, i):
+        if i == 3 and not hits:
+            hits.append("raise")
+            raise fpolicy.PeerTimeoutError("ps.response", peer="p0",
+                                           deadline_s=1.0)
+        return {"w": state["w"] + (i + 1)}
+
+    seen = []
+    final, info = restart.run_with_restarts(
+        lambda: {"w": np.zeros((2,), np.float32)}, flaky, steps=5,
+        directory=str(tmp_path), save_every=2,
+        on_restart=lambda r, e: seen.append(("restart", r)),
+        on_peer_timeout=lambda r, e: seen.append(("peer", r)))
+    assert seen == [("peer", 1)], "peer timeouts take their own path"
+    assert info["restarts_used"] == 1 and info["recovered_step"] == 2
+    np.testing.assert_allclose(final["w"], 15.0)  # 1+2+3+4+5, exact replay
+
+
+# ---------------------------------------------------------------------------
+# chaos_tool
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_tool_gen_and_lint(tmp_path, capsys):
+    tool = _chaos_tool()
+    out = tmp_path / "plan.json"
+    rc = tool.main(["gen", "--out", str(out), "--seed", "5",
+                    "--rule", "ps.request:drop:0.5:3:0.01",
+                    "--rule", "host_staged.*:corrupt"])
+    assert rc == 0
+    plan = finject.FaultPlan.load(str(out))
+    assert plan.seed == 5 and len(plan.rules) == 2
+    assert plan.rules[0] == finject.FaultRule("ps.request", "drop",
+                                              prob=0.5, max_hits=3,
+                                              delay_s=0.01)
+    assert tool.main(["lint", str(out)]) == 0
+    bad = tmp_path / "bad.json"
+    _write_plan(bad, [{"site": "no.such.site", "kind": "drop"}])
+    assert tool.main(["lint", str(bad)]) == 1
+    assert "matches no instrumented site" in capsys.readouterr().out
+    garbled = tmp_path / "garbled.json"
+    garbled.write_text("{")
+    assert tool.main(["lint", str(garbled)]) == 2
+
+
+def test_chaos_tool_summarize(tmp_path, capsys):
+    tool = _chaos_tool()
+    m = tmp_path / "metrics_host0.jsonl"
+    with open(m, "w") as f:
+        f.write(json.dumps({"kind": "meta", "stream": "metrics",
+                            "host": 0, "mode": "metrics"}) + "\n")
+        f.write(json.dumps({"kind": "counter",
+                            "name": "tm_fault_injected_total",
+                            "labels": {"site": "ps.request",
+                                       "kind": "drop"},
+                            "value": 3}) + "\n")
+        f.write(json.dumps({"kind": "counter",
+                            "name": "tm_fault_survived_total",
+                            "labels": {"site": "ps.response"},
+                            "value": 3}) + "\n")
+        f.write(json.dumps({"kind": "counter", "name": "tm_other_total",
+                            "labels": {}, "value": 9}) + "\n")
+    rc = tool.main(["summarize", str(m)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "injected" in out and "ps.request" in out and "survived" in out
+    assert "tm_other_total" not in out
+    empty = tmp_path / "metrics_host1.jsonl"
+    with open(empty, "w") as f:
+        f.write(json.dumps({"kind": "meta", "stream": "metrics",
+                            "host": 1, "mode": "metrics"}) + "\n")
+    assert tool.main(["summarize", str(empty)]) == 1  # no fault counters
+
+
+# ---------------------------------------------------------------------------
+# Acceptance: off-mode import discipline + 2-process chaos run
+# ---------------------------------------------------------------------------
+
+
+def test_off_mode_never_imports_faults():
+    """Acceptance (c): with faults off (the default), torchmpi_tpu.faults
+    is never imported — one branch per call site is the whole cost.  The
+    probe drives every instrumented surface (staged eager collective,
+    barrier, PS exchange, aio write)."""
+    code = (
+        "import sys\n"
+        "import numpy as np\n"
+        "import torchmpi_tpu as mpi\n"
+        "from torchmpi_tpu.utils import aio\n"
+        "mpi.init(mpi.Config(dcn_size=1))\n"
+        "mpi.allreduce(np.ones((2, 4), np.float32), backend='host')\n"
+        "mpi.barrier()\n"
+        "ps = mpi.parameterserver.init({'w': np.zeros(8, np.float32)})\n"
+        "ps.send({'w': np.ones(8, np.float32)}).wait()\n"
+        "ps.receive().wait()\n"
+        "ps.shutdown()\n"
+        "w = aio.AsyncWriter()\n"
+        "w.submit('/tmp/_faults_off_probe.bin', b'x').wait()\n"
+        "w.close()\n"
+        "mpi.stop()\n"
+        "assert 'torchmpi_tpu.faults' not in sys.modules, 'imported!'\n"
+        "print('OFF-MODE-OK')\n"
+    )
+    env = dict(os.environ)
+    for k in ("TORCHMPI_TPU_FAULTS", "TORCHMPI_TPU_STAGED"):
+        env.pop(k, None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    out = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                         text=True, timeout=300, env=env, cwd=_REPO)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "OFF-MODE-OK" in out.stdout
+
+
+@pytest.mark.slow
+def test_two_process_chaos_acceptance(tmp_path):
+    """docs/FAULTS.md acceptance: a 2-process host-staged allreduce under
+    a seeded transient-drop plan (a) completes bit-identically to the
+    clean run via retry, and (b) with retries disabled converts the hang
+    into PeerTimeoutError within the site deadline on every rank."""
+    worker = os.path.join(os.path.dirname(__file__),
+                          "_faults_dcn_worker.py")
+    plan = _write_plan(tmp_path / "plan.json",
+                       [{"site": "host_staged.gather", "kind": "drop",
+                         "max_hits": 1, "delay_s": 0.01}])
+
+    def run_mode(mode):
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        procs = [subprocess.Popen(
+            [sys.executable, worker, str(i), "2", str(port), mode, plan],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env) for i in range(2)]
+        outs = []
+        try:
+            for p in procs:
+                out, _ = p.communicate(timeout=240)
+                outs.append(out)
+        except subprocess.TimeoutExpired:
+            for p in procs:
+                p.kill()
+            raise
+        for i, (p, out) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0, f"{mode} proc {i} failed:\n{out}"
+            assert f"CHECK rank={i} done" in out, out
+        return outs
+
+    def digests(outs):
+        return sorted(ln.split("digest=")[1].strip()
+                      for out in outs for ln in out.splitlines()
+                      if "digest=" in ln)
+
+    clean = digests(run_mode("clean"))
+    assert len(clean) == 2
+    retried = run_mode("retry")
+    assert digests(retried) == clean, "retry must be bit-identical"
+    for i, out in enumerate(retried):
+        assert f"CHECK rank={i} survived ok" in out, out
+    for i, out in enumerate(run_mode("noretry")):
+        assert f"CHECK rank={i} peer-timeout ok" in out, out

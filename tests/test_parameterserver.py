@@ -81,7 +81,7 @@ def test_wedged_server_bounded_failure(monkeypatch):
 
     th = threading.Thread(target=black_hole, daemon=True)
     th.start()
-    monkeypatch.setattr(psimpl, "PS_TIMEOUT_MS", 500)
+    monkeypatch.setattr(psimpl, "_timeout_ms", lambda: 500)
     client = PSClient({"w": np.zeros((8,), np.float32)}, [port],
                       [(0, 8)])
     try:

@@ -34,7 +34,8 @@ def test_uninterrupted_run(tmp_path):
     exp = _expected(7)
     np.testing.assert_array_equal(np.asarray(final["w"]),
                                   np.asarray(exp["w"]))
-    assert info == {"restarts": 0, "steps_run": 7}
+    assert info == {"restarts": 0, "restarts_used": 0, "steps_run": 7,
+                    "recovered_step": 0}
 
 
 @pytest.mark.parametrize("crash_at,save_every", [(5, 1), (5, 3), (1, 4)])
@@ -55,6 +56,10 @@ def test_crash_restores_and_matches(tmp_path, crash_at, save_every):
     np.testing.assert_array_equal(np.asarray(final["w"]),
                                   np.asarray(exp["w"]))
     assert info["restarts"] == 1 and crashed and seen == ["injected failure"]
+    assert info["restarts_used"] == 1
+    # The recovery settled on the newest checkpoint at or before the
+    # crash step (0 when the crash predates the first save).
+    assert info["recovered_step"] == (crash_at // save_every) * save_every
     # Replay cost: steps since the last save, never the whole run.
     assert info["steps_run"] <= 9 + save_every
 
@@ -87,6 +92,7 @@ def test_process_level_resume(tmp_path):
     np.testing.assert_array_equal(np.asarray(final["w"]),
                                   np.asarray(exp["w"]))
     assert calls == [6, 7, 8, 9]  # resumed, no replay of 0..5
+    assert info["recovered_step"] == 6  # which step the resume settled on
 
 
 def test_corrupt_latest_checkpoint_falls_back(tmp_path):

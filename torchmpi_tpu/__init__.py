@@ -88,14 +88,15 @@ from .utils.compilegate import (
 # TORCHMPI_TPU_COMPILE_GATE=0.
 _install_compile_gate()
 
-# The static analyzer and observability subpackages load lazily
-# (PEP 562): with Config.analysis="off" / Config.obs="off" — the
-# defaults — `import torchmpi_tpu` never imports them, keeping the
-# zero-added-cost claims literal (tests assert the modules are absent
-# from sys.modules).  Any access (`mpi.analysis`, `mpi.obs`,
+# The static analyzer, observability, and fault-layer subpackages load
+# lazily (PEP 562): with Config.analysis="off" / Config.obs="off" /
+# Config.faults="off" — the defaults — `import torchmpi_tpu` never
+# imports them, keeping the zero-added-cost claims literal (tests
+# assert the modules are absent from sys.modules).  Any access
+# (`mpi.analysis`, `mpi.obs`, `mpi.faults`,
 # `from torchmpi_tpu import obs`) imports on first touch.
 def __getattr__(name):
-    if name in ("analysis", "obs"):
+    if name in ("analysis", "obs", "faults"):
         # importlib, not ``from . import``: the from-import form does a
         # hasattr() probe on this package first, which would re-enter
         # this very function.
@@ -129,7 +130,7 @@ __all__ = [
     "current_mesh", "push_communicator", "pop_communicator", "communicator",
     "set_config", "config", "DCN_AXIS", "ICI_AXIS", "WORLD_AXES",
     "collectives", "fusion", "selector", "tuning", "analysis", "obs",
-    "parallel",
+    "faults", "parallel",
     "allreduce",
     "broadcast", "reduce",
     "allgather", "reduce_scatter", "sendreceive", "alltoall", "gather",

@@ -607,7 +607,16 @@ def _eager_collective(op_name: str, x, *, mesh: Optional[Mesh] = None,
     if backend == "host" or (backend is None
                              and cfg is not None and cfg.staged):
         _obs_record_eager(cfg, op_name, x, m)
-        out = _host_staged(op_name, np.asarray(x), n, **params)
+        if cfg is not None and cfg.faults != "off":
+            from . import faults
+
+            # Injection + retry policy around both staging legs
+            # (sites host_staged.gather/scatter — docs/FAULTS.md);
+            # off is one string compare, the module never imported.
+            out = faults.staged_exchange(op_name, x, n, params,
+                                         _host_staged)
+        else:
+            out = _host_staged(op_name, np.asarray(x), n, **params)
         return _place_rank_major(np.ascontiguousarray(out), m)
     # Online "auto" mode (config default, per-op table, or an explicit
     # backend="auto"): resolve against the persistent tuning plan.  The

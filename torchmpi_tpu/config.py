@@ -28,6 +28,11 @@ def _env_int(name: str, default: int) -> int:
     return int(v) if v is not None else default
 
 
+def _env_float(name: str, default: float) -> float:
+    v = os.environ.get(name)
+    return float(v) if v is not None else default
+
+
 def _env_str(name: str, default: str) -> str:
     return os.environ.get(name, default)
 
@@ -196,6 +201,29 @@ class Config:
     # Env: TORCHMPI_TPU_OBS_RING.
     obs_ring_size: int = 1024
 
+    # --- fault injection + resilient dispatch -------------------------------
+    # torchmpi_tpu.faults (docs/FAULTS.md): "off" (default — one string
+    # compare per cross-host call site, the module is never imported;
+    # same discipline as ``analysis``/``obs``), "policy" (resilience
+    # only: bounded retries + deadline budgets + per-peer health on the
+    # host-staged/PS/aio/barrier sites, nothing injected), or the path
+    # of a fault-plan JSON (chaos runs: deterministic seed+site-keyed
+    # injection, with the policy armed to survive it).  A corrupt or
+    # version-mismatched plan raises at init.  Env: TORCHMPI_TPU_FAULTS.
+    faults: str = "off"
+    # Re-attempts after the first try at a faulted site (0 disables
+    # retries: transient faults surface immediately, timeouts become
+    # PeerTimeoutError).  Env: TORCHMPI_TPU_FAULT_RETRIES.
+    fault_retries: int = 2
+    # First backoff between attempts; doubles per retry, deterministic
+    # jitter on top (policy.Policy).  Env: TORCHMPI_TPU_FAULT_BACKOFF.
+    fault_backoff_s: float = 0.05
+    # Per-site wall-clock budget: a site that makes no progress within
+    # this converts the hang into a typed PeerTimeoutError carrying the
+    # flight-recorder tail.  0 = unbounded (the pre-faults behavior).
+    # Env: TORCHMPI_TPU_FAULT_DEADLINE.
+    fault_deadline_s: float = 30.0
+
     # --- gradient synchronization ------------------------------------------
     # Number of buckets for bucketed/overlapped gradient allreduce.
     gradsync_buckets: int = 1
@@ -214,6 +242,14 @@ class Config:
     ps_port: int = 52312
     ps_host: str = "127.0.0.1"
     ps_num_threads: int = 2
+    # Socket timeout armed on every PS client connection (seconds): a
+    # wedged shard server surfaces as a failed future within this bound
+    # instead of hanging wait().  0 disables.  Normalized in
+    # ``runtime.init`` with the obs/analysis-style any-config env
+    # pickup.  Env: TORCHMPI_TPU_PS_TIMEOUT (seconds; the legacy
+    # TORCHMPI_TPU_PS_TIMEOUT_MS is still honored when the new knob is
+    # unset).
+    ps_timeout_s: float = 30.0
 
     # --- distributed bring-up ----------------------------------------------
     coordinator_address: Optional[str] = None
@@ -242,6 +278,11 @@ class Config:
             staged=_env_bool("TORCHMPI_TPU_STAGED", False),
             analysis=_env_str("TORCHMPI_TPU_ANALYSIS", "off"),
             obs=_env_str("TORCHMPI_TPU_OBS", "off"),
+            faults=_env_str("TORCHMPI_TPU_FAULTS", "off"),
+            fault_retries=_env_int("TORCHMPI_TPU_FAULT_RETRIES", 2),
+            fault_backoff_s=_env_float("TORCHMPI_TPU_FAULT_BACKOFF", 0.05),
+            fault_deadline_s=_env_float("TORCHMPI_TPU_FAULT_DEADLINE",
+                                        30.0),
             obs_dir=(os.environ.get("TORCHMPI_TPU_OBS_DIR") or None),
             obs_ring_size=_env_int("TORCHMPI_TPU_OBS_RING", 1024),
             fuse_max_bytes=_env_int("TORCHMPI_TPU_FUSE_MAX_BYTES",
@@ -256,6 +297,7 @@ class Config:
             ps_port=_env_int("TORCHMPI_TPU_PS_PORT", 52312),
             ps_host=_env_str("TORCHMPI_TPU_PS_HOST", "127.0.0.1"),
             ps_num_threads=_env_int("TORCHMPI_TPU_PS_THREADS", 2),
+            ps_timeout_s=_env_float("TORCHMPI_TPU_PS_TIMEOUT", 30.0),
         )
         ici = os.environ.get("TORCHMPI_TPU_ICI_SIZE")
         if ici is not None:

@@ -1,0 +1,359 @@
+"""Deterministic fault injection + resilient dispatch for the cross-host
+paths (docs/FAULTS.md).
+
+The reference assumed a benign MPI fabric; a production deployment
+cannot.  This package is the robustness layer over every surface that
+leaves the gang-scheduled SPMD world — the host-staged eager
+collectives, the DCN barrier, the parameter-server sockets, and the
+async host-IO executor — in two halves:
+
+- **Injection** (:mod:`~torchmpi_tpu.faults.inject`): a seed+site-keyed
+  :class:`FaultPlan` (versioned JSON; ``scripts/chaos_tool.py`` writes
+  and lints them) deterministically delays, drops, corrupts-then-heals,
+  or hard-fails named sites.
+- **Resilience** (:mod:`~torchmpi_tpu.faults.policy`,
+  :mod:`~torchmpi_tpu.faults.health`): bounded, jitter-backoff retries
+  for transient errors, per-site deadline budgets that turn unbounded
+  hangs into :class:`PeerTimeoutError` (carrying the obs flight-recorder
+  tail), and a per-peer health ledger feeding degrade-or-raise.
+
+Off by default and **never imported when off** — the ``analysis``/
+``obs`` import discipline: every call site guards its hook behind one
+``Config.faults != "off"`` string compare, so an un-opted-in build pays
+one branch per dispatch and zero import cost
+(``tests/test_faults.py::test_off_mode_never_imports_faults``).
+
+Enable via ``Config.faults`` / ``TORCHMPI_TPU_FAULTS``:
+
+- ``"policy"``       — resilience only: retries/deadlines/health armed,
+  nothing injected (the production setting).
+- ``<path.json>``    — a fault plan: injection AND resilience (chaos
+  runs).  A corrupt/mismatched plan raises — a chaos run that silently
+  tests nothing is worse than one that fails to start.
+
+Every injected and survived event emits ``tm_fault_*`` counters and
+flight-recorder events through :mod:`torchmpi_tpu.obs` (when that is
+active), so ``scripts/obs_tool.py blame`` can name the injected site.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, List, Optional
+
+from .health import HealthLedger, PeerHealth  # noqa: F401
+from .inject import (  # noqa: F401
+    FAULT_PLAN_VERSION,
+    KINDS,
+    SITES,
+    CorruptPayload,
+    DroppedPacket,
+    FaultError,
+    FaultPlan,
+    FaultRule,
+    InjectedFailure,
+    TransientFault,
+    corrupt_buffer,
+    lint_plan,
+)
+from . import policy as policy_mod  # bound BEFORE the policy() accessor
+#                                     shadows the submodule name below
+from .policy import (  # noqa: F401
+    PeerTimeoutError,
+    Policy,
+    RetriesExhaustedError,
+    bounded_call,
+    flight_tail,
+    is_transient,
+)
+
+_lock = threading.Lock()
+_plan: Optional[FaultPlan] = None
+_policy = Policy()
+_armed = False
+_ledger = HealthLedger(
+    on_transition=lambda peer, old, new: _emit(
+        "health", "ledger", kind=new, peer=peer))
+
+
+def active() -> bool:
+    return _armed
+
+
+def injecting() -> bool:
+    return _armed and _plan is not None
+
+
+def plan() -> Optional[FaultPlan]:
+    return _plan
+
+
+def current_policy() -> Policy:
+    # NOT named ``policy`` — that would shadow the submodule of the
+    # same name on the package object.
+    return _policy
+
+
+def ledger() -> HealthLedger:
+    return _ledger
+
+
+def activate(mode: str, *, retries: int = 2, backoff_s: float = 0.05,
+             deadline_s: float = 30.0) -> None:
+    """Arm the layer (``runtime.init``/``set_config`` call this whenever
+    ``Config.faults != "off"``).  ``mode`` is ``"policy"`` or a fault-
+    plan path; knobs come from ``Config.fault_*``.  Idempotent;
+    re-activation with the same plan path keeps its schedule counters
+    (an in-run ``set_config`` must not restart the fault schedule), a
+    different path reloads."""
+    global _plan, _policy, _armed
+    with _lock:
+        if mode == "policy":
+            new_plan = None
+        else:
+            if _plan is not None and getattr(_plan, "_path", None) == mode:
+                new_plan = _plan
+            else:
+                new_plan = FaultPlan.load(mode)
+                new_plan._path = mode  # type: ignore[attr-defined]
+        _plan = new_plan
+        _policy = Policy(retries=int(retries), backoff_s=float(backoff_s),
+                         deadline_s=float(deadline_s),
+                         seed=_plan.seed if _plan is not None else 0)
+        _armed = True
+
+
+def deactivate() -> None:
+    """Disarm; the health ledger's history stays readable."""
+    global _plan, _armed
+    with _lock:
+        _armed = False
+        _plan = None
+
+
+def reset() -> None:
+    """Disarm AND forget ledger history / plan schedule (tests)."""
+    deactivate()
+    _ledger.clear()
+
+
+# ---------------------------------------------------------------------------
+# Telemetry: tm_fault_* through obs, when obs itself is active.  A
+# faults-only session must not import obs, so the lookup goes through
+# sys.modules (the MetricsLogger-mirror pattern).
+# ---------------------------------------------------------------------------
+
+
+def _emit(action: str, site: str, *, kind: str = "", peer: str = "") -> None:
+    import sys
+
+    mod = sys.modules.get("torchmpi_tpu.obs")
+    try:
+        if mod is not None and mod.active():
+            mod.record_fault(action, site, kind=kind, peer=peer)
+    except Exception:  # noqa: BLE001 — telemetry never fails a step
+        pass
+
+
+# ---------------------------------------------------------------------------
+# The two primitives call sites compose: fire() (injection) and
+# run_site() (resilience).
+# ---------------------------------------------------------------------------
+
+
+def fire(site: str, payload=None, peer: str = "") -> None:
+    """One arrival at an instrumented site.  With a plan armed, applies
+    whatever the deterministic schedule says for this arrival: sleep
+    (delay), corrupt ``payload`` + raise (corrupt), raise transient
+    (drop) or hard (fail).  Without a plan (policy-only mode) this is a
+    no-op beyond the counter bump of an armed site."""
+    p = _plan
+    if not _armed or p is None:
+        return
+    decided = p.decide(site)
+    if decided is None:
+        return
+    rule, arrival = decided
+    _emit("injected", site, kind=rule.kind, peer=peer)
+    if rule.kind == "delay":
+        _sleep(rule.delay_s)
+        return
+    if rule.kind == "drop":
+        _sleep(rule.delay_s)
+        raise DroppedPacket(
+            f"injected drop at {site} (arrival {arrival}, peer silent "
+            f"{rule.delay_s:.3g}s)")
+    if rule.kind == "corrupt":
+        corrupt_buffer(payload, p.seed, arrival)
+        raise CorruptPayload(
+            f"injected payload corruption at {site} (integrity check "
+            f"failed)")
+    raise InjectedFailure(f"injected hard failure at {site}")
+
+
+def _sleep(seconds: float) -> None:
+    if seconds > 0:
+        import time
+
+        time.sleep(seconds)
+
+
+def run_site(site: str, attempt: Callable[[int], Any], *,
+             peer: str = "") -> Any:
+    """Execute ``attempt(try_index)`` under the armed retry policy,
+    recording per-peer health and emitting ``tm_fault_*`` events.  The
+    attempt callable is responsible for calling :func:`fire` at its
+    injection points, so a retry re-rolls the schedule (the next
+    arrival at the site)."""
+
+    def on_event(action: str, s: str) -> None:
+        _emit(action, s, peer=peer)
+
+    def tracked(i: int):
+        try:
+            out = attempt(i)
+        except BaseException as e:
+            if peer and is_transient(e):
+                _ledger.record(peer, ok=False)
+            raise
+        if peer:
+            _ledger.record(peer, ok=True)
+        return out
+
+    return policy_mod.run(site, tracked, policy=_policy, peer=peer,
+                          on_event=on_event)
+
+
+# ---------------------------------------------------------------------------
+# Site wrappers (one per instrumented surface, so the call sites stay a
+# single guarded line).
+# ---------------------------------------------------------------------------
+
+
+def staged_exchange(op_name: str, x_dev, n: int, params: dict,
+                    compute: Callable) -> Any:
+    """The host-staged eager collective under injection + policy: the
+    devices->host leg (``host_staged.gather``) and host->devices leg
+    (``host_staged.scatter``) each fire per attempt; transient faults
+    retry the WHOLE exchange (re-staging from the device buffers, which
+    the faults cannot touch — that is what makes corrupt-then-heal
+    converge back to the bit-identical result)."""
+    import numpy as np
+
+    def attempt(_i: int):
+        xs = np.asarray(x_dev)
+        fire("host_staged.gather", payload=xs, peer="gang")
+        out = compute(op_name, xs, n, **params)
+        fire("host_staged.scatter", payload=out, peer="gang")
+        return out
+
+    return run_site("host_staged", attempt, peer="gang")
+
+
+def guarded_barrier(name: str, sync: Callable[[], None]) -> None:
+    """``runtime.barrier`` under injection + policy: the site fires per
+    attempt, and the (genuinely blocking) gang sync runs under the
+    deadline budget so a wedged peer surfaces as ``PeerTimeoutError``
+    instead of an unbounded wait."""
+
+    def attempt(_i: int):
+        fire("runtime.barrier", peer="gang")
+        return bounded_call("runtime.barrier", sync,
+                            deadline_s=_policy.deadline_s, peer="gang")
+
+    return run_site("runtime.barrier", attempt, peer="gang")
+
+
+def aio_submit(submit: Callable[[], Any]) -> Any:
+    """One async-IO submission under injection + policy (site
+    ``aio.submit``; the submission is an enqueue, so retrying it is
+    cheap and safe — the native layer sees at most one accepted
+    submit)."""
+
+    def attempt(_i: int):
+        fire("aio.submit", peer="aio")
+        return submit()
+
+    return run_site("aio.submit", attempt, peer="aio")
+
+
+def ps_enqueue(peers: List[str], enqueue: Callable[[], Any]) -> Any:
+    """A PS client enqueue (send/receive) under injection + policy:
+    ``ps.request`` fires per attempt before the sockets are touched."""
+    peer = ",".join(peers)
+
+    def attempt(_i: int):
+        fire("ps.request", peer=peer)
+        return enqueue()
+
+    return run_site("ps.request", attempt, peer=peer)
+
+
+def ps_wait(peers: List[str], make_handle: Callable[[], Any],
+            first_handle: Any) -> Any:
+    """A PS exchange's wait leg under injection + policy.  The first
+    attempt waits on the already-enqueued ``first_handle`` (preserving
+    the async-overlap contract); a failed wait re-runs the WHOLE
+    exchange via ``make_handle`` — a retransmit, not a re-wait, because
+    the native future is consumed by its failure.  Peer health is
+    recorded per shard endpoint from the handle's failure index, and a
+    peer the ledger already calls dead stops the retransmit loop."""
+    state = {"handle": first_handle}
+    peer_all = ",".join(peers)
+
+    def attempt(i: int):
+        if i > 0:
+            # Dead peer: stop burning the budget — surface the loss as
+            # a peer timeout for the restart/elastic layer.
+            doomed = [p for p in peers if _ledger.decide(p) == "raise"]
+            if doomed:
+                raise PeerTimeoutError(
+                    "ps.response", peer=doomed[0],
+                    deadline_s=_policy.deadline_s,
+                    flight_tail=flight_tail())
+            fire("ps.request", peer=peer_all)
+            state["handle"] = make_handle()
+        fire("ps.response", peer=peer_all)
+        h = state["handle"]
+        try:
+            out = h.wait(timeout_ms=_wait_budget_ms())
+        except BaseException as e:
+            bad = getattr(h, "failed_index", None)
+            if bad is not None and 0 <= bad < len(peers):
+                _ledger.record(peers[bad], ok=False)
+            raise _as_transient(e)
+        for p in peers:
+            _ledger.record(p, ok=True)
+        return out
+
+    def on_event(action: str, s: str) -> None:
+        _emit(action, s, peer=peer_all)
+
+    return policy_mod.run("ps.response", attempt, policy=_policy,
+                          peer=peer_all, on_event=on_event)
+
+
+def _wait_budget_ms() -> int:
+    """Per-attempt native-wait bound derived from the site deadline (so
+    one wedged shard cannot eat the whole budget before the first
+    retransmit)."""
+    if _policy.deadline_s <= 0:
+        return 0
+    return max(1, int(_policy.deadline_s * 1000
+                      / (1 + max(0, _policy.retries))))
+
+
+def _as_transient(e: BaseException) -> BaseException:
+    """A failed PS wait is a transport failure (reset connection, wedged
+    shard, injected drop) — retryable by retransmit.  Injected faults
+    and socket/timeout errors already classify; the generic
+    RuntimeError the handle raises for a failed native future is
+    re-flagged transient here, at the one place that knows a retransmit
+    is available."""
+    if is_transient(e):
+        return e
+    if isinstance(e, RuntimeError):
+        t = TransientFault(str(e))
+        t.__cause__ = e
+        return t
+    return e

@@ -1,0 +1,285 @@
+"""Deterministic fault plans: versioned JSON, seed+site-keyed schedules.
+
+The injection half of ``torchmpi_tpu.faults`` (docs/FAULTS.md).  A plan
+is a list of rules, each naming a *site* — one of the cross-host
+dispatch points the library instruments (``SITES``) — and a fault
+*kind*.  Whether the k-th arrival at a site fires is a pure function of
+``(plan.seed, site, k)``: the schedule is fully determined by the plan,
+so a chaos run replays bit-identically (``tests/test_faults.py`` sweeps
+this), and two SPMD processes loading the same plan inject the same
+faults at the same per-site hit counts.
+
+Same versioned-schema discipline as the tuning plans
+(``tuning/plancache.py``) — a ``version`` field gates the parse — but
+the OPPOSITE failure posture: a corrupt or mismatched fault plan RAISES.
+A tuning cache silently degrades because losing it only costs speed;
+a fault plan that silently loads empty makes a chaos test silently test
+nothing.
+
+Kinds model the failures a benign-fabric port never had to survive:
+
+- ``delay``    — sleep ``delay_s`` at the site (slow link / GC pause).
+- ``drop``     — a lost packet: optional ``delay_s`` of peer silence,
+  then :class:`DroppedPacket` (transient + timeout-flavored — the
+  policy layer retries it, or converts it to ``PeerTimeoutError`` when
+  retries are off).
+- ``corrupt``  — flip bits in the staged payload (when the site carries
+  one), then :class:`CorruptPayload` ("checksum mismatch"): transient,
+  so a bounded ``max_hits`` makes it corrupt-then-heal.
+- ``fail``     — :class:`InjectedFailure`: a hard peer death.  NOT
+  transient; the policy never retries it.
+
+Dependency-free on purpose (no jax, no numpy at import): loaded by
+``scripts/chaos_tool.py`` standalone, and by the dump path of a dying
+process.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import fnmatch
+import hashlib
+import json
+import threading
+from typing import Dict, List, Optional, Tuple
+
+FAULT_PLAN_VERSION = 1
+
+# The instrumented dispatch points.  Rules may glob (``host_staged.*``);
+# chaos_tool lint flags patterns that match none of these.
+SITES = (
+    "host_staged.gather",   # eager staged path: devices -> host leg
+    "host_staged.scatter",  # eager staged path: host -> devices leg
+    "runtime.barrier",      # the DCN barrier
+    "ps.request",           # parameter-server client enqueue leg
+    "ps.response",          # parameter-server client wait leg
+    "aio.submit",           # async host-IO submission
+)
+
+KINDS = ("delay", "drop", "corrupt", "fail")
+
+
+class FaultError(RuntimeError):
+    """Base of every injected fault."""
+
+    transient = False
+    is_timeout = False
+
+
+class TransientFault(FaultError):
+    """Injected fault a retry can survive (the policy layer's cue)."""
+
+    transient = True
+
+
+class DroppedPacket(TransientFault):
+    """A dropped packet: the peer went silent and a timeout fired.
+    Timeout-flavored, so exhausting retries on it converts to
+    ``PeerTimeoutError`` rather than a bare retries-exhausted error."""
+
+    is_timeout = True
+
+
+class CorruptPayload(TransientFault):
+    """Payload failed its integrity check (bits were really flipped when
+    the site carries a buffer — a caller that swallows this error sees
+    the corruption)."""
+
+
+class InjectedFailure(FaultError):
+    """Hard failure: the peer is gone.  Never retried."""
+
+
+@dataclasses.dataclass
+class FaultRule:
+    """One scheduled fault at one site (pattern)."""
+
+    site: str                 # exact site name or fnmatch glob
+    kind: str                 # delay | drop | corrupt | fail
+    prob: float = 1.0         # per-hit firing probability
+    after: int = 0            # skip the first ``after`` arrivals
+    max_hits: int = 1         # fire at most this many times (0 = never,
+    #                           -1 = unbounded) — the "heal" knob
+    delay_s: float = 0.0      # sleep for delay/drop kinds
+
+    def validate(self) -> None:
+        if not self.site or not isinstance(self.site, str):
+            raise ValueError(f"rule has no site: {self!r}")
+        if self.kind not in KINDS:
+            raise ValueError(
+                f"rule kind {self.kind!r} not one of {KINDS}")
+        if not (0.0 <= float(self.prob) <= 1.0):
+            raise ValueError(f"rule prob {self.prob!r} outside [0, 1]")
+        if int(self.after) < 0:
+            raise ValueError(f"rule after {self.after!r} must be >= 0")
+        if int(self.max_hits) < -1:
+            raise ValueError(
+                f"rule max_hits {self.max_hits!r} must be >= -1")
+        if float(self.delay_s) < 0:
+            raise ValueError(f"rule delay_s {self.delay_s!r} must be >= 0")
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @staticmethod
+    def from_json(d: dict) -> "FaultRule":
+        if not isinstance(d, dict):
+            raise ValueError(f"fault rule is not an object: {d!r}")
+        fields = {f.name for f in dataclasses.fields(FaultRule)}
+        unknown = sorted(set(d) - fields)
+        if unknown:
+            raise ValueError(f"fault rule has unknown fields {unknown}")
+        rule = FaultRule(**d)
+        rule.validate()
+        return rule
+
+
+def decision(seed: int, site: str, hit: int) -> float:
+    """Uniform [0, 1) draw for the ``hit``-th arrival at ``site`` — a
+    pure hash of (seed, site, hit), the whole determinism story."""
+    h = hashlib.blake2b(f"{seed}:{site}:{hit}".encode(),
+                        digest_size=8).digest()
+    return int.from_bytes(h, "big") / float(1 << 64)
+
+
+@dataclasses.dataclass
+class FaultPlan:
+    """A versioned, seeded rule set plus the per-site hit counters that
+    realize its deterministic schedule."""
+
+    seed: int = 0
+    rules: List[FaultRule] = dataclasses.field(default_factory=list)
+    note: str = ""
+
+    def __post_init__(self) -> None:
+        self._lock = threading.Lock()
+        self._hits: Dict[str, int] = {}   # arrivals per site
+        self._fired: Dict[int, int] = {}  # fires per rule index —
+        # max_hits bounds a RULE's total fires across every site its
+        # pattern matches, not per site (a glob rule with max_hits=2
+        # firing 2x per matched site would silently exceed the retry
+        # budget the plan was written against)
+
+    # -- schedule --------------------------------------------------------
+
+    def arrivals(self, site: str) -> int:
+        return self._hits.get(site, 0)
+
+    def decide(self, site: str) -> Optional[Tuple[FaultRule, int]]:
+        """Register one arrival at ``site``; return ``(rule, arrival)``
+        for the rule that fires on it, if any (first matching rule
+        wins).  Deterministic in the per-site arrival ordinal — which is
+        why the ordinal is returned from under the lock: a caller
+        re-reading the counter afterwards would race other threads'
+        arrivals and report (or corrupt with) the wrong ordinal."""
+        with self._lock:
+            hit = self._hits.get(site, 0)
+            self._hits[site] = hit + 1
+            for i, rule in enumerate(self.rules):
+                if not fnmatch.fnmatchcase(site, rule.site):
+                    continue
+                if hit < rule.after:
+                    continue
+                fired = self._fired.get(i, 0)
+                if rule.max_hits >= 0 and fired >= rule.max_hits:
+                    continue
+                if decision(self.seed, site, hit) >= rule.prob:
+                    continue
+                self._fired[i] = fired + 1
+                return rule, hit
+            return None
+
+    def reset_schedule(self) -> None:
+        """Forget arrival/fire counters (a fresh run of the same plan)."""
+        with self._lock:
+            self._hits.clear()
+            self._fired.clear()
+
+    # -- persistence -----------------------------------------------------
+
+    def to_json(self) -> dict:
+        return {"version": FAULT_PLAN_VERSION, "seed": int(self.seed),
+                "note": self.note,
+                "rules": [r.to_json() for r in self.rules]}
+
+    @staticmethod
+    def from_json(data: dict) -> "FaultPlan":
+        if not isinstance(data, dict):
+            raise ValueError("fault plan is not a JSON object")
+        if data.get("version") != FAULT_PLAN_VERSION:
+            raise ValueError(
+                f"fault plan version {data.get('version')!r} != "
+                f"{FAULT_PLAN_VERSION}")
+        rules = data.get("rules")
+        if not isinstance(rules, list):
+            raise ValueError("fault plan has no rules list")
+        return FaultPlan(
+            seed=int(data.get("seed", 0)),
+            note=str(data.get("note", "")),
+            rules=[FaultRule.from_json(r) for r in rules])
+
+    @staticmethod
+    def load(path: str) -> "FaultPlan":
+        """Parse ``path``; raises (OSError/ValueError) on anything wrong
+        — see the module docstring for why this is NOT never-crash."""
+        with open(path) as f:
+            try:
+                data = json.load(f)
+            except ValueError as e:
+                raise ValueError(f"{path}: not JSON ({e})") from None
+        try:
+            return FaultPlan.from_json(data)
+        except ValueError as e:
+            raise ValueError(f"{path}: {e}") from None
+
+    def save(self, path: str) -> str:
+        with open(path, "w") as f:
+            json.dump(self.to_json(), f, indent=1, sort_keys=True)
+            f.write("\n")
+        return path
+
+
+def lint_plan(plan: FaultPlan) -> List[str]:
+    """Problems a schema-valid plan can still have (chaos_tool lint):
+    site patterns that match no instrumented site, rules shadowed into
+    dead code, corrupt rules on payload-free sites."""
+    problems: List[str] = []
+    for i, rule in enumerate(plan.rules):
+        matched = [s for s in SITES if fnmatch.fnmatchcase(s, rule.site)]
+        if not matched:
+            problems.append(
+                f"rule {i}: site {rule.site!r} matches no instrumented "
+                f"site (known: {', '.join(SITES)})")
+        if rule.max_hits == 0:
+            problems.append(f"rule {i}: max_hits=0 never fires")
+        if rule.kind == "corrupt" and matched and all(
+                s in ("runtime.barrier",) for s in matched):
+            problems.append(
+                f"rule {i}: corrupt at {matched} has no payload to flip "
+                f"(raises CorruptPayload without mutating anything)")
+    return problems
+
+
+def corrupt_buffer(buf, seed: int, hit: int) -> None:
+    """Flip one bit per 64 bytes of a writable numpy buffer, seeded by
+    the schedule draw so the corruption itself is deterministic.  No-op
+    for payload-free sites (``buf is None``)."""
+    if buf is None:
+        return
+    import numpy as np  # local: keep the module import dependency-free
+
+    flags = getattr(buf, "flags", None)
+    if flags is None or not flags.writeable:
+        return  # broadcast views etc. — the raise still happens
+    try:
+        flat = buf.view(np.uint8).reshape(-1)
+    except (ValueError, AttributeError):
+        return  # non-contiguous / exotic layout: raise-only corrupt
+    if flat.size == 0:
+        return
+    rng = int(decision(seed, "corrupt", hit) * (1 << 32))
+    # Vectorized: a multi-GB staged payload must corrupt in one numpy
+    # pass, not millions of Python-level element stores.
+    offs = rng + np.arange(0, flat.size, 64, dtype=np.int64)
+    np.bitwise_xor.at(flat, offs % flat.size,
+                      np.left_shift(1, (offs % 8)).astype(np.uint8))

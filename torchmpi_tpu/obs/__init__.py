@@ -351,3 +351,30 @@ def record_barrier(name: str) -> None:
     ``obs_tool.py blame``)."""
     _registry.counter_inc("tm_barriers_total")
     _recorder.append("barrier", name)
+
+
+def record_fault(action: str, site: str, *, kind: str = "",
+                 peer: str = "") -> None:
+    """One ``torchmpi_tpu.faults`` event: ``action`` is ``injected`` |
+    ``retry`` | ``survived`` | ``exhausted`` | ``deadline`` | ``health``
+    (counter ``tm_fault_<action>_total``).  Injected and
+    deadline/health events also land in the flight ring, so
+    ``obs_tool.py blame`` can name the injected site right next to the
+    collective it wounded (docs/FAULTS.md)."""
+    labels = {"site": site}
+    if kind:
+        labels["kind"] = kind
+    if peer:
+        labels["peer"] = peer
+    _registry.counter_inc(f"tm_fault_{action}_total", **labels)
+    if action in ("injected", "deadline", "health"):
+        _recorder.append("fault", site, 0, kind, action)
+
+
+def record_restart(event: str, step: int) -> None:
+    """One checkpoint-restart driver event (``utils/restart.py``):
+    ``recovered`` (settled on a checkpoint step), ``fresh_start`` (no
+    common restorable step), or ``peer_timeout`` (a detected-dead peer
+    routed through the restore path)."""
+    _registry.counter_inc("tm_restart_events_total", event=event)
+    _recorder.append("restart", event, int(step))

@@ -32,6 +32,7 @@ from typing import Any, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from .. import runtime
 from ..utils import native
 from ..utils import tree as tree_util
 
@@ -39,10 +40,29 @@ PyTree = Any
 
 RULES = {"copy": 0, "add": 1, "zero": 2, "axpy": 3, "elastic": 4}
 
-# Socket timeout armed on every client connection: a wedged shard server
-# surfaces as a failed future within this bound instead of hanging wait()
-# (ADVICE round 1).  0 disables.
-PS_TIMEOUT_MS = int(os.environ.get("TORCHMPI_TPU_PS_TIMEOUT_MS", "30000"))
+
+def _timeout_ms() -> int:
+    """Socket timeout armed on every client connection: a wedged shard
+    server surfaces as a failed future within this bound instead of
+    hanging wait() (ADVICE round 1).  0 disables.  Config-driven
+    (``Config.ps_timeout_s`` / ``TORCHMPI_TPU_PS_TIMEOUT``, normalized
+    in ``runtime.init``); standalone use (no init) falls back to the
+    env, including the legacy millisecond spelling."""
+    if runtime.is_initialized():
+        return int(runtime.config().ps_timeout_s * 1000)
+    v = os.environ.get("TORCHMPI_TPU_PS_TIMEOUT")
+    if v is not None:
+        return int(float(v) * 1000)
+    v = os.environ.get("TORCHMPI_TPU_PS_TIMEOUT_MS")
+    if v is not None:
+        return int(v)
+    return 30000
+
+
+def _faults_armed() -> bool:
+    """One string compare per call — ``torchmpi_tpu.faults`` is never
+    imported unless the config armed it (docs/FAULTS.md)."""
+    return runtime.effective_config().faults != "off"
 
 _LIB_LOCK = threading.Lock()
 _LIB: Optional[ctypes.CDLL] = None
@@ -116,25 +136,48 @@ class PSHandle:
                  buffers: List[np.ndarray], result_fn=None):
         self._lib = lib
         self._pending = list(future_ids)  # not yet waited/freed
+        self._n_futures = len(self._pending)
         self._buffers = buffers  # keep-alive
         self._result_fn = result_fn
         self._done = False
         self._failed = False
         self._result = None
+        # Shard index (enqueue order) of the first failed/timed-out
+        # future — how the fault layer's health ledger attributes a
+        # failed exchange to the right peer.  None = no failure seen.
+        self.failed_index: Optional[int] = None
 
-    def wait(self):
+    def wait(self, timeout_ms: int = 0):
+        """Block until every shard future resolves.  ``timeout_ms > 0``
+        bounds each PER-SHARD native wait; on expiry raises
+        ``TimeoutError`` with the future left live (the handle can be
+        waited again, or abandoned to the bounded destructor drain) —
+        the hook the resilient-dispatch layer uses to retransmit
+        instead of hanging."""
         if self._failed:
             raise RuntimeError("parameter-server op already failed")
         if not self._done:
             while self._pending:
                 fid = self._pending[0]
-                status = self._lib.tm_ps_wait(fid)  # frees the future
+                if timeout_ms and timeout_ms > 0:
+                    status = self._lib.tm_ps_wait_for(fid, int(timeout_ms))
+                    if status == -3:  # still in flight; future stays live
+                        self.failed_index = (self._n_futures
+                                             - len(self._pending))
+                        raise TimeoutError(
+                            f"parameter-server op still in flight after "
+                            f"{timeout_ms}ms (shard {self.failed_index})")
+                else:
+                    status = self._lib.tm_ps_wait(fid)  # frees the future
                 self._pending.pop(0)
                 if status != 1:
+                    self.failed_index = (self._n_futures
+                                         - len(self._pending) - 1)
                     self._failed = True
                     self._drain_pending()
                     raise RuntimeError(f"parameter-server op failed "
-                                       f"(status {status})")
+                                       f"(status {status}, shard "
+                                       f"{self.failed_index})")
             self._done = True
             self._result = (self._result_fn() if self._result_fn is not None
                             else None)
@@ -147,7 +190,8 @@ class PSHandle:
         drained with a bounded wait; if one is STILL in flight after the
         budget, its buffers are parked in _ORPHANED_BUFFERS rather than
         freed under a writing native thread."""
-        budget_ms = 2 * PS_TIMEOUT_MS if PS_TIMEOUT_MS > 0 else 0
+        t_ms = _timeout_ms()
+        budget_ms = 2 * t_ms if t_ms > 0 else 0
         for rest in self._pending:
             if self._result_fn is None:
                 self._lib.tm_ps_forget(rest)
@@ -257,8 +301,6 @@ class ShardedParameterServer:
             "apply_s": float(tot[5]) / 1e9,
             "send_s": float(tot[6]) / 1e9,
         }
-        from .. import runtime
-
         if runtime.effective_config().obs != "off":
             from .. import obs
 
@@ -278,8 +320,43 @@ class ShardedParameterServer:
             pass
 
 
+class _ResilientPSHandle:
+    """PSHandle facade returned when ``Config.faults`` is armed: the
+    exchange is already enqueued (async overlap preserved); ``wait()``
+    runs under the retry policy, retransmitting the WHOLE exchange on a
+    transient failure and recording per-shard peer health — see
+    ``faults.ps_wait``.  ``done`` reflects the currently-enqueued
+    attempt."""
+
+    def __init__(self, inner: PSHandle, make_handle, peers: List[str]):
+        self._inner = inner
+        self._make = make_handle
+        self._peers = peers
+        self._result = None
+        self._waited = False
+
+    def wait(self):
+        if not self._waited:
+            from .. import faults
+
+            self._result = faults.ps_wait(self._peers, self._make,
+                                          self._inner)
+            self._waited = True
+        return self._result
+
+    @property
+    def done(self) -> bool:
+        return self._waited or self._inner.done
+
+
 class PSClient:
-    """Client-side: async send/receive against the shard servers."""
+    """Client-side: async send/receive against the shard servers.
+
+    With ``Config.faults`` armed, ``send``/``receive`` return handles
+    whose ``wait()`` retries the exchange under the fault policy (sites
+    ``ps.request``/``ps.response``) and feeds the per-peer health
+    ledger; with the default ``faults="off"`` nothing here changes and
+    ``torchmpi_tpu.faults`` is never imported."""
 
     def __init__(self, template: PyTree,
                  ports: Sequence[int],
@@ -290,9 +367,10 @@ class PSClient:
         self.total = self.spec.total
         self.shard_bounds = list(shard_bounds)
         self.client_ids: List[int] = []
+        self.peers: List[str] = [f"{host}:{int(p)}" for p in ports]
         for port in ports:
             cid = self._lib.tm_ps_client_connect(host.encode(), int(port),
-                                                 PS_TIMEOUT_MS)
+                                                 _timeout_ms())
             if cid < 0:
                 raise RuntimeError(f"failed to connect to PS at "
                                    f"{host}:{port}")
@@ -310,6 +388,16 @@ class PSClient:
 
         For ``rule="elastic"`` the handle's ``wait()`` returns the elastic
         delta pytree (subtract it from the local params — EASGD)."""
+        if _faults_armed():
+            from .. import faults
+
+            make = lambda: self._send_once(tree, rule, alpha)  # noqa: E731
+            return _ResilientPSHandle(
+                faults.ps_enqueue(self.peers, make), make, self.peers)
+        return self._send_once(tree, rule, alpha)
+
+    def _send_once(self, tree: PyTree, rule: str,
+                   alpha: float) -> PSHandle:
         rid = RULES[rule]
         flat, _ = tree_util.flatten_f32(tree)
         if flat.shape[0] != self.total:
@@ -337,6 +425,15 @@ class PSClient:
     def receive(self) -> PSHandle:
         """Async pull of the full parameter vector (prefetch pattern);
         ``wait()`` returns the pytree."""
+        if _faults_armed():
+            from .. import faults
+
+            return _ResilientPSHandle(
+                faults.ps_enqueue(self.peers, self._receive_once),
+                self._receive_once, self.peers)
+        return self._receive_once()
+
+    def _receive_once(self) -> PSHandle:
         out = np.zeros((self.total,), np.float32)
         fids, bufs = [], []
         for cid, lo, hi, _ in self._per_shard(out):
@@ -365,6 +462,13 @@ class PSClient:
                 alive.append(True)
             except RuntimeError:
                 alive.append(False)
+        if _faults_armed():
+            from .. import faults
+
+            # Liveness probes feed the same per-peer ledger the
+            # resilient exchanges use (degrade-or-raise input).
+            for peer, ok in zip(self.peers, alive):
+                faults.ledger().record(peer, ok)
         return alive
 
     def shutdown(self) -> None:

@@ -152,6 +152,59 @@ def _normalize_obs(value) -> Optional[str]:
     return None
 
 
+def _normalize_faults(value) -> str:
+    """Canonical faults mode for a config/env value: "off", "policy",
+    or a fault-plan path (kept verbatim).  Boolean-ish spellings map to
+    the two modes ("0"/"false"/"no" -> off, "1"/"true"/"yes"/"on" ->
+    policy); anything else is treated as a path — a typo'd path fails
+    loudly when the plan loads, which is the posture a chaos knob
+    wants."""
+    v = str(value).strip()
+    low = v.lower()
+    if low in ("off", "0", "false", "no", "none", ""):
+        return "off"
+    if low in ("policy", "on", "1", "true", "yes"):
+        return "policy"
+    return v
+
+
+def _env_default_pickup(cfg: Config, field: str, env: str, cast) -> None:
+    """Obs-ring-style any-config env pickup for a numeric knob: a field
+    left at its dataclass default defers to the environment, an explicit
+    non-default value wins."""
+    import dataclasses as _dc
+
+    raw = os.environ.get(env)
+    if not raw:
+        return
+    default = next(f.default for f in _dc.fields(Config)
+                   if f.name == field)
+    if getattr(cfg, field) == default:
+        setattr(cfg, field, cast(raw))
+
+
+def _faults_activate(cfg: Config) -> None:
+    """Import and arm the fault layer (only ever called with
+    ``cfg.faults != "off"`` — the off path never imports the module).
+    Raises on an unreadable/corrupt plan path: a chaos run that
+    silently injects nothing is worse than one that fails to start."""
+    from . import faults
+
+    faults.activate(cfg.faults, retries=cfg.fault_retries,
+                    backoff_s=cfg.fault_backoff_s,
+                    deadline_s=cfg.fault_deadline_s)
+
+
+def _faults_deactivate_stale() -> None:
+    """Disarm a previous session's fault layer without importing it
+    (sys.modules only — turning faults off never imports the module)."""
+    import sys
+
+    mod = sys.modules.get(__package__ + ".faults")
+    if mod is not None and mod.active():
+        mod.deactivate()
+
+
 def _obs_activate(cfg: Config) -> None:
     """Import and arm the telemetry layer (only ever called with
     ``cfg.obs != "off"`` — the off path never imports the module).
@@ -230,6 +283,34 @@ def init(config: Optional[Config] = None, **overrides) -> Mesh:
                 "config.obs (or TORCHMPI_TPU_OBS) must be "
                 "off|metrics|trace")
 
+        # Same any-config rule for the fault layer (TORCHMPI_TPU_FAULTS
+        # + the numeric policy/timeout knobs): an explicit non-default
+        # field wins, env fills the defaults — so `TORCHMPI_TPU_FAULTS=
+        # plan.json python train.py` reaches scripts that build their
+        # Config explicitly (the chaos-smoke CI job relies on this).
+        if _normalize_faults(cfg.faults) == "off":
+            cfg.faults = os.environ.get("TORCHMPI_TPU_FAULTS", "off")
+        cfg.faults = _normalize_faults(cfg.faults)
+        _env_default_pickup(cfg, "fault_retries",
+                            "TORCHMPI_TPU_FAULT_RETRIES", int)
+        _env_default_pickup(cfg, "fault_backoff_s",
+                            "TORCHMPI_TPU_FAULT_BACKOFF", float)
+        _env_default_pickup(cfg, "fault_deadline_s",
+                            "TORCHMPI_TPU_FAULT_DEADLINE", float)
+        _env_default_pickup(cfg, "ps_timeout_s",
+                            "TORCHMPI_TPU_PS_TIMEOUT", float)
+        if (os.environ.get("TORCHMPI_TPU_PS_TIMEOUT") is None
+                and os.environ.get("TORCHMPI_TPU_PS_TIMEOUT_MS")):
+            # Legacy millisecond spelling (pre-Config knob): honored
+            # when the new env is unset, as config.py promises.
+            _env_default_pickup(cfg, "ps_timeout_s",
+                                "TORCHMPI_TPU_PS_TIMEOUT_MS",
+                                lambda v: float(v) / 1000.0)
+        if cfg.ps_timeout_s < 0:
+            raise ValueError(
+                f"config.ps_timeout_s must be >= 0 (0 disables), got "
+                f"{cfg.ps_timeout_s}")
+
         if cfg.coordinator_address is None:
             coord = os.environ.get("TORCHMPI_TPU_COORDINATOR")
             if coord:
@@ -261,6 +342,16 @@ def init(config: Optional[Config] = None, **overrides) -> Mesh:
         from .utils import compilegate
 
         compilegate.install()
+
+        # Arm (or disarm a stale) fault layer BEFORE the runtime marks
+        # itself initialized: a corrupt/missing fault plan must fail
+        # init outright — never leave a half-armed runtime behind a
+        # chaos knob that silently injects nothing.  Off (the default)
+        # never imports torchmpi_tpu.faults.
+        if cfg.faults != "off":
+            _faults_activate(cfg)
+        else:
+            _faults_deactivate_stale()
 
         _state.config = cfg
         _state.devices = list(jax.devices())
@@ -407,7 +498,24 @@ def set_config(**kw) -> None:
             v = _normalize_obs(v)
             if v is None:
                 raise ValueError("config.obs must be off|metrics|trace")
+        if k == "faults":
+            v = _normalize_faults(v)
+        if k == "ps_timeout_s":
+            v = float(v)
+            if v < 0:
+                raise ValueError(
+                    "config.ps_timeout_s must be >= 0 (0 disables)")
+        if k == "fault_retries":
+            v = int(v)
+        if k in ("fault_backoff_s", "fault_deadline_s"):
+            v = float(v)
         setattr(_state.config, k, v)
+    if ("faults" in kw or "fault_retries" in kw or "fault_backoff_s" in kw
+            or "fault_deadline_s" in kw):
+        if _state.config.faults != "off":
+            _faults_activate(_state.config)
+        else:
+            _faults_deactivate_stale()
     if "obs" in kw or "obs_dir" in kw or "obs_ring_size" in kw:
         if _state.config.obs != "off":
             _obs_activate(_state.config)
@@ -488,12 +596,24 @@ def barrier(name: str = "torchmpi_tpu_barrier") -> None:
         # Recorded BEFORE the wait: a host stuck in this barrier shows
         # it as the last flight event (obs_tool.py blame anchor).
         obs.record_barrier(name)
-    if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
 
-        multihost_utils.sync_global_devices(name)
+    def _sync():
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices(name)
+        else:
+            jax.block_until_ready(jax.device_put(np.zeros(())))
+
+    if _state.config.faults != "off":
+        from . import faults
+
+        # Injection fires per attempt and the gang sync runs under the
+        # site deadline: a wedged peer becomes PeerTimeoutError instead
+        # of an unbounded wait (docs/FAULTS.md).
+        faults.guarded_barrier(name, _sync)
     else:
-        jax.block_until_ready(jax.device_put(np.zeros(())))
+        _sync()
 
 
 # --- communicator (mesh) stack ---------------------------------------------

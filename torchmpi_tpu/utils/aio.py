@@ -132,7 +132,22 @@ class AsyncWriter:
     def submit(self, path: str, data, *, durable: bool = True) -> WriteHandle:
         """Queue an atomic write of ``data`` (bytes-like) to ``path``.
         Zero-copy: the buffer is pinned on the returned handle, not copied
-        (embedded NULs are fine — the native side writes ``len`` bytes)."""
+        (embedded NULs are fine — the native side writes ``len`` bytes).
+
+        With ``Config.faults`` armed, the submission runs under the
+        fault layer (site ``aio.submit``: injected delays/drops, retried
+        enqueue — docs/FAULTS.md); off is one string compare and the
+        module is never imported."""
+        from .. import runtime
+
+        if runtime.effective_config().faults != "off":
+            from .. import faults
+
+            return faults.aio_submit(
+                lambda: self._submit_once(path, data, durable))
+        return self._submit_once(path, data, durable)
+
+    def _submit_once(self, path: str, data, durable: bool) -> WriteHandle:
         if isinstance(data, bytes):
             n, ptr, pin = len(data), data, (data,)
         else:

@@ -12,10 +12,20 @@ steps, the SPMD common case).
 Complements the PS side's live elasticity (heartbeats + worker loss,
 ``examples/downpour_elastic.py``), which is where surviving failure
 WITHOUT a restart is actually possible.
+
+Fault-layer integration (docs/FAULTS.md): a ``PeerTimeoutError`` from
+``torchmpi_tpu.faults`` — a peer the resilient-dispatch layer detected
+dead within its site deadline — routes through the ``on_peer_timeout``
+callback and the same restore path, so a wedged gang checkpoint-restores
+instead of waiting for a watchdog kill.  The check is by type identity
+through ``sys.modules``: this module never imports ``faults`` (the
+off-mode import discipline).
 """
 
 from __future__ import annotations
 
+import os
+import sys
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
@@ -23,6 +33,47 @@ import jax
 from . import checkpoint
 
 PyTree = Any
+
+
+def _is_peer_timeout(e: BaseException) -> bool:
+    """Is ``e`` a ``faults.PeerTimeoutError``?  Checked via sys.modules:
+    if the fault layer was never armed, the class does not exist and no
+    exception can be one."""
+    mod = sys.modules.get("torchmpi_tpu.faults.policy")
+    return mod is not None and isinstance(e, mod.PeerTimeoutError)
+
+
+def _obs_record(event: str, step: int) -> None:
+    """Log a recovery decision through obs when it is active (sys.modules
+    lookup — recovery must not import the telemetry it reports to)."""
+    mod = sys.modules.get("torchmpi_tpu.obs")
+    try:
+        if mod is not None and mod.active():
+            mod.record_restart(event, step)
+    except Exception:  # noqa: BLE001 — telemetry never blocks recovery
+        pass
+
+
+def _fsync_verify(directory: str, step: int) -> None:
+    """Durability check on the step recovery settled on: re-open the
+    local npz read-only (it must still be readable AFTER the restore
+    that just parsed it — a disappearing file means the directory is
+    lying to us) and fsync the directory so the atomic rename that
+    produced the file is itself durable before training resumes on top
+    of it.  Best-effort on filesystems without directory fsync."""
+    path = os.path.join(directory,
+                        f"ckpt_{step}_p{jax.process_index()}.npz")
+    with open(path, "rb") as f:
+        if not f.read(1):
+            raise OSError(f"checkpoint {path} is empty after restore")
+    try:
+        fd = os.open(directory, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+    except OSError:
+        pass
 
 
 def run_with_restarts(
@@ -34,6 +85,7 @@ def run_with_restarts(
     save_every: int = 10,
     max_restarts: int = 3,
     on_restart: Optional[Callable[[int, BaseException], None]] = None,
+    on_peer_timeout: Optional[Callable[[int, BaseException], None]] = None,
 ) -> Tuple[PyTree, Dict[str, int]]:
     """Run ``steps`` calls of ``step_fn(state, i) -> state`` with
     checkpoint-restart recovery.
@@ -47,9 +99,19 @@ def run_with_restarts(
     after a fatal crash also resumes (process-level restart, the
     gang-scheduled recovery path).
 
+    A ``faults.PeerTimeoutError`` (detected-dead peer) takes the same
+    restore path but notifies ``on_peer_timeout`` instead of
+    ``on_restart`` — the hook where an orchestrator re-admits or
+    replaces the peer before the replay resumes.
+
     Returns ``(final_state, info)`` with ``info = {"restarts": r,
-    "steps_run": n}`` (``steps_run`` counts executed step calls including
-    replays).
+    "restarts_used": r, "steps_run": n, "recovered_step": s}``:
+    ``steps_run`` counts executed step calls including replays,
+    ``restarts_used`` is the restart budget consumed (assertable by
+    chaos tests; ``"restarts"`` is the same number under its legacy
+    name, kept for existing callers), ``recovered_step`` the step the
+    LAST recovery settled on (0 when none, or when recovery fell back
+    to a fresh start).
     """
     if steps < 0:
         raise ValueError(f"steps must be >= 0, got {steps}")
@@ -61,7 +123,9 @@ def run_with_restarts(
         Single-process: the newest locally-restorable step, walking
         backwards past unreadable ones (atomic saves make those rare, but
         an older good step must win over a bad newer file — never a hard
-        stop).
+        stop).  The settled-on step is fsync-verified and logged (via obs
+        when active) so post-mortems can see WHICH step a recovery
+        resumed from, not just that one happened.
 
         Multi-host (the gang-scheduled restart path): a crash between
         per-process ``save()`` calls can land step N on some hosts only,
@@ -78,23 +142,30 @@ def run_with_restarts(
         this module documents (an SPMD failure fails the slice as a
         unit); a failure on only a subset of hosts is not survivable by
         any in-band protocol.  Returns (state, next_step)."""
+
+        def settled(state, step):
+            if step > 0:
+                _fsync_verify(directory, step)
+            _obs_record("recovered" if step > 0 else "fresh_start", step)
+            return state, step
+
         steps_avail = [s for s in checkpoint.available_steps(directory)
                        if s > 0]
         if jax.process_count() <= 1:
             for step in reversed(steps_avail):
                 try:
-                    return checkpoint.restore(directory, template,
-                                              step=step), step
+                    return settled(checkpoint.restore(directory, template,
+                                                      step=step), step)
                 except Exception:  # noqa: BLE001 — fall back to older
                     continue
-            return init_fn(), 0
+            return settled(init_fn(), 0)
         ceiling = None
         while True:
             cand = next((s for s in reversed(steps_avail)
                          if ceiling is None or s <= ceiling), 0)
             agreed = checkpoint.agree_min_step(cand)
             if agreed <= 0:
-                return init_fn(), 0  # collectively: nothing in common
+                return settled(init_fn(), 0)  # collectively: nothing common
             state, ok = None, 1
             try:
                 state = checkpoint.restore(directory, template,
@@ -102,10 +173,11 @@ def run_with_restarts(
             except Exception:  # noqa: BLE001 — resolved collectively
                 ok = 0
             if checkpoint.agree_min_step(ok):
-                return state, agreed
+                return settled(state, agreed)
             ceiling = agreed - 1  # someone failed: walk back TOGETHER
 
     state, i = recover()
+    recovered_step = i
     restarts = 0
     steps_run = 0
     while i < steps:
@@ -120,9 +192,19 @@ def run_with_restarts(
         except BaseException as e:  # noqa: BLE001 — the restart loop IS
             # the handler: restore-and-replay or re-raise after budget.
             restarts += 1
-            if on_restart is not None:
+            if _is_peer_timeout(e):
+                # Detected-dead peer: checkpoint-restore instead of a
+                # watchdog kill.  Consumes restart budget like any other
+                # failure (a peer that stays dead must not loop forever).
+                _obs_record("peer_timeout", i)
+                if on_peer_timeout is not None:
+                    on_peer_timeout(restarts, e)
+            elif on_restart is not None:
                 on_restart(restarts, e)
             if restarts > max_restarts:
                 raise
             state, i = recover()
-    return state, {"restarts": restarts, "steps_run": steps_run}
+            recovered_step = i
+    return state, {"restarts": restarts, "restarts_used": restarts,
+                   "steps_run": steps_run,
+                   "recovered_step": recovered_step}
