@@ -21,8 +21,17 @@ torchmpi_tpu/fusion.py), reporting collective launches/step from the
 lowered HLO alongside wall time — the launch-count half is the
 statically verifiable win, on CPU or TPU alike.
 
+``--overlap-compare`` measures the gradsync *schedule*: the same
+mixed-dtype MLP step with the post-backward sync vs the
+backprop-overlapped schedule (``gradsync.make_overlapped_grad_fn`` —
+docs/OVERLAP.md), reporting launches/step from the lowered HLO, wall
+time, and a gradients-bitwise-equal check — the capturable evidence the
+ROADMAP bench watch-item requires for a perf claim (the wall-clock win
+itself is hardware-only on the CPU sim).
+
 Run: ``python benchmarks/collectives_bench.py --devices 8 [--dcn 2]``
 Or:  ``python benchmarks/collectives_bench.py --devices 8 --pytree``
+Or:  ``python benchmarks/collectives_bench.py --devices 8 --overlap-compare``
 """
 
 import argparse
@@ -167,6 +176,82 @@ def _faults_compare_mode(args, mpi, n):
           file=sys.stderr)
 
 
+def _overlap_compare_mode(args, mpi, mesh):
+    """Sync vs backprop-overlapped gradient dispatch (docs/OVERLAP.md)
+    on the same mixed fp32/bf16 MLP: per-step wall time, all-reduce
+    launches from the lowered HLO, and a bitwise-equality check of the
+    gradients — the statically verifiable halves of the overlap claim
+    on CPU or TPU alike (the wall-clock win itself is hardware-only,
+    like overlap_trace.py's timing)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from torchmpi_tpu.parallel import gradsync
+
+    axes = tuple(mesh.axis_names)
+    key = jax.random.PRNGKey(0)
+    dim = args.overlap_dim
+    params = {}
+    for i in range(args.overlap_layers):
+        dt = jnp.float32 if i % 2 == 0 else jnp.bfloat16
+        params[f"l{i:02d}"] = {
+            "w": jax.random.normal(key, (dim, dim)).astype(dt)}
+
+    def loss_fn(p, x, y):
+        h = x
+        for i in range(args.overlap_layers):
+            w = p[f"l{i:02d}"]["w"]
+            h = jnp.tanh(h.astype(w.dtype) @ w)
+        return jnp.mean((h.astype(jnp.float32) - y) ** 2)
+
+    X = np.random.RandomState(0).rand(64, dim).astype(np.float32)
+    Y = np.random.RandomState(1).rand(64, dim).astype(np.float32)
+    per_bucket = dim * dim * 4  # one fp32 layer per bucket
+
+    def step_sync(p, x, y):
+        loss, grads = jax.value_and_grad(loss_fn)(p, x, y)
+        return loss, gradsync.synchronize_gradients(grads, axes)
+
+    def step_overlap(p, x, y):
+        return gradsync.make_overlapped_grad_fn(
+            loss_fn, p, axes, max_bytes=per_bucket)(p, x, y)
+
+    rows = []
+    for mode, step in (("sync", step_sync), ("overlapped", step_overlap)):
+        fn = jax.jit(shard_map(step, mesh=mesh,
+                               in_specs=(P(), P(axes), P(axes)),
+                               out_specs=(P(), P()), check_vma=False))
+        launches = fn.lower(params, X, Y).as_text().count(
+            "stablehlo.all_reduce")
+        out = fn(params, X, Y)  # compile
+        jax.block_until_ready(out)
+        t0 = time.time()
+        for _ in range(args.iters):
+            out = fn(params, X, Y)
+        jax.block_until_ready(out)
+        dt = (time.time() - t0) / args.iters
+        rows.append((mode, launches, dt, out[1]))
+        line = {"op": "gradsync", "mode": mode,
+                "layers": args.overlap_layers, "launches": launches,
+                "ms": round(dt * 1e3, 3)}
+        print(json.dumps(line) if args.json else
+              f"gradsync {mode:10s} {args.overlap_layers:3d} layers  "
+              f"{launches:3d} launches/step  {dt * 1e3:8.2f} ms")
+    (_, l0, t0_, g0), (_, l1, t1_, g1) = rows
+    bitwise = all(
+        np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)))
+    print(f"# overlapped-vs-sync: grads bitwise equal: {bitwise}; "
+          f"{l0} -> {l1} launches; {t0_ / max(t1_, 1e-12):.2f}x wall-time "
+          f"ratio (sync/overlapped — dispatch-structure evidence on "
+          f"cpu-sim, wall-clock win is hardware-only)", file=sys.stderr)
+    if not bitwise:
+        raise SystemExit("overlap-compare: gradients diverged")
+
+
 def main():
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--devices", type=int, default=0,
@@ -197,6 +282,16 @@ def main():
                    help="fault-layer overhead mode: the same small "
                         "staged allreduce under faults=off/policy "
                         "(docs/FAULTS.md)")
+    p.add_argument("--overlap-compare", action="store_true",
+                   help="gradsync schedule mode: sync vs "
+                        "backprop-overlapped dispatch on a mixed-dtype "
+                        "MLP, with launches/step from the lowered HLO "
+                        "and a grads bitwise check (docs/OVERLAP.md)")
+    p.add_argument("--overlap-layers", type=int, default=8,
+                   help="overlap mode: MLP depth (alternating "
+                        "fp32/bf16 layers)")
+    p.add_argument("--overlap-dim", type=int, default=128,
+                   help="overlap mode: layer width")
     args = p.parse_args()
     if args.devices:
         from torchmpi_tpu.utils.simulation import force_cpu_devices
@@ -230,6 +325,11 @@ def main():
 
     if args.faults_compare:
         _faults_compare_mode(args, mpi, n)
+        mpi.stop()
+        return
+
+    if args.overlap_compare:
+        _overlap_compare_mode(args, mpi, mesh)
         mpi.stop()
         return
 

@@ -1,19 +1,26 @@
 #!/usr/bin/env python
-"""Analyze the bucketed-gradsync overlap: trace events + HLO evidence.
+"""Analyze gradient-sync overlap: trace events + HLO evidence.
 
-Companion to ``overlap_trace.py`` (SURVEY.md §8.4.3): given a captured
-profiler trace, summarize how the per-bucket gradient all-reduces
-interleave with backward compute; independently, lower the bucketed DP
-step at several ``n_buckets`` settings and count collective ops
-pre-optimization vs in the compiled executable — the direct evidence of
-whether XLA's all-reduce combiner preserved or merged the configured
-buckets on this platform (it merges below its combine threshold, which
-is the scheduling fact any bucket-count default must be justified
-against).
+Companion to ``overlap_trace.py`` (SURVEY.md §8.4.3 / ROADMAP item 1):
+given a captured profiler trace, summarize how the per-bucket gradient
+all-reduces interleave with backward compute; independently, lower the
+bucketed DP step at several ``n_buckets`` settings — plus the
+**backprop-overlapped schedule** (``Config.gradsync_overlap="auto"``,
+docs/OVERLAP.md), whose per-bucket all-reduces are anchored inside the
+backward by ``custom_vjp`` hooks and barrier-chained — and count
+collective ops pre-optimization vs in the compiled executable: the
+direct evidence of whether XLA's all-reduce combiner preserved or
+merged the configured buckets on this platform (it merges below its
+combine threshold, which is the scheduling fact any bucket-count
+default must be justified against; the overlapped schedule's barrier
+chain is specifically built to survive it).
 
 Run: ``python benchmarks/overlap_analyze.py [--devices 8]
 [--trace path/to/*.trace.json.gz] [--buckets 1,4,8]``
-Emits one JSON line per measurement and a final ``summary`` line.
+Emits one JSON line per measurement (``schedule`` names bucketed vs
+overlapped rows) and a final ``summary`` line whose
+``overlap_buckets_survive`` field is the assertable verdict for the
+overlapped rows.
 """
 
 import argparse
@@ -60,12 +67,17 @@ def analyze_trace(path):
             "interleaved": len(interleaved)}
 
 
-def bucket_hlo_counts(n_buckets, mesh, model_ctor, tx, barrier=False):
+def bucket_hlo_counts(n_buckets, mesh, model_ctor, tx, barrier=False,
+                      overlap=False):
     """Count all_reduce ops pre-optimization vs compiled for one bucket
     setting of the standard BN DP train step.  ``barrier=True`` chains
     buckets through optimization barriers (``Config.gradsync_barrier``)
     — the compiled count then shows whether THIS platform's combiner
-    respects them (TPU does; the CPU pipeline expands them first)."""
+    respects them (TPU does; the CPU pipeline expands them first).
+    ``overlap=True`` lowers the backprop-overlapped schedule instead
+    (``gradsync_overlap="auto"``, ~``n_buckets`` buckets via the
+    overlap byte bound), whose barrier token chain should keep every
+    bucket distinct."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -74,13 +86,20 @@ def bucket_hlo_counts(n_buckets, mesh, model_ctor, tx, barrier=False):
     import torchmpi_tpu as mpi
 
     prev_barrier = mpi.config().gradsync_barrier
+    prev_ob = mpi.config().gradsync_overlap_bytes
     mpi.set_config(gradsync_barrier=barrier)
     model = model_ctor()
     v = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)),
                    train=False)
     params, bs = v["params"], v["batch_stats"]
-    step = mpi.recipes.make_bn_dp_train_step(model, tx, mesh=mesh,
-                                             n_buckets=n_buckets)
+    if overlap:
+        total = sum(int(np.prod(l.shape)) * l.dtype.itemsize
+                    for l in jax.tree.leaves(params))
+        mpi.set_config(gradsync_overlap_bytes=max(
+            1, -(-total // max(1, n_buckets))))
+    step = mpi.recipes.make_bn_dp_train_step(
+        model, tx, mesh=mesh, n_buckets=n_buckets,
+        overlap="auto" if overlap else "off")
     p2, o2, b2 = mpi.recipes.replicate_bn_state(params, tx.init(params),
                                                 bs, mesh=mesh)
     sh = NamedSharding(mesh, P(mesh.axis_names))
@@ -91,12 +110,15 @@ def bucket_hlo_counts(n_buckets, mesh, model_ctor, tx, barrier=False):
     low = step.jitted.lower(p2, o2, b2, X, Y)
     pre = low.as_text().count("stablehlo.all_reduce")
     txt = low.compile().as_text()
-    mpi.set_config(gradsync_barrier=prev_barrier)  # no config leakage
+    # no config leakage
+    mpi.set_config(gradsync_barrier=prev_barrier,
+                   gradsync_overlap_bytes=prev_ob)
     # TPU's latency-hiding scheduler emits overlapped collectives as
     # paired all-reduce-start/done ops; count starts OR the sync form,
     # never both (a start is never also spelled "all-reduce(").
     post = txt.count("all-reduce-start(") or txt.count("all-reduce(")
-    return {"n_buckets": n_buckets, "barrier": barrier,
+    return {"schedule": "overlapped" if overlap else "bucketed",
+            "n_buckets": n_buckets, "barrier": barrier,
             "all_reduce_pre_opt": pre,
             "all_reduce_compiled": post,
             "async_form": bool(txt.count("all-reduce-start("))}
@@ -133,7 +155,8 @@ def main():
     mesh = mpi.init()
     platform = list(mesh.devices.flat)[0].platform
     rows = []
-    for nb in [int(b) for b in args.buckets.split(",")]:
+    bucket_list = [int(b) for b in args.buckets.split(",")]
+    for nb in bucket_list:
         for barrier in ((False, True) if nb > 1 else (False,)):
             row = bucket_hlo_counts(nb, mesh,
                                     lambda: ResNet20(num_classes=10),
@@ -141,6 +164,14 @@ def main():
             row["platform"] = platform
             rows.append(row)
             print(json.dumps(row))
+    # The backprop-overlapped schedule at the largest bucket count: its
+    # custom_vjp anchoring + barrier token chain should keep every
+    # bucket's all-reduce distinct through compilation.
+    over = bucket_hlo_counts(max(bucket_list), mesh,
+                             lambda: ResNet20(num_classes=10),
+                             optax.sgd(0.1), overlap=True)
+    over["platform"] = platform
+    print(json.dumps(over))
     # Verdict over the DEFAULT (barrier=False) rows only: barrier rows
     # are the control lever, not the default behavior being judged.
     plain_rows = [r for r in rows if not r["barrier"]]
@@ -150,6 +181,12 @@ def main():
         "summary": "combiner_merged_buckets" if merged
         else "buckets_survive_compilation",
         "platform": platform,
+        "overlap_buckets_survive":
+            over["all_reduce_compiled"] >= over["all_reduce_pre_opt"]
+            and over["all_reduce_pre_opt"] > 1,
+        "overlap_all_reduce": {
+            "pre_opt": over["all_reduce_pre_opt"],
+            "compiled": over["all_reduce_compiled"]},
         "note": ("XLA's all-reduce combiner merged the configured buckets "
                  "into one compiled collective at this model scale — "
                  "bucket-count tuning only matters above the combine "

@@ -1,12 +1,20 @@
-"""MNIST LeNet, data-parallel SGD with overlapped (bucketed) gradient sync.
+"""MNIST LeNet, data-parallel SGD with overlapped gradient sync.
 
 Reference analog: ``examples/mnist_allreduce_async.lua`` [MED] (reconstructed
 — reference mount empty, SURVEY.md §0/§4.3): per-layer async allreduce hooks
-fired during backward, synced before the optimizer step.  On TPU the overlap
-is expressed as K bucketed collectives inside one jit — XLA's scheduler
-overlaps bucket transfers with remaining computation (SURVEY §8.4.3).
+fired during backward, synced before the optimizer step.  Two TPU-native
+expressions of that overlap:
+
+- default: K bucketed collectives inside one jit — XLA's scheduler
+  overlaps bucket transfers with remaining computation (SURVEY §8.4.3).
+- ``TORCHMPI_TPU_GRADSYNC_OVERLAP=1``: the first-class backprop-overlapped
+  schedule (docs/OVERLAP.md) — ``gradsync.make_overlapped_grad_fn``
+  fires each reverse-parameter-order bucket's allreduce INSIDE the
+  backward pass as its cotangents materialize, the literal analog of
+  the reference's per-layer hooks.  Bit-identical gradients either way.
 
 Run: ``python examples/mnist_async_allreduce.py --devices 8 --buckets 4``
+Or:  ``TORCHMPI_TPU_GRADSYNC_OVERLAP=1 python examples/mnist_async_allreduce.py --devices 8``
 """
 
 import common
@@ -29,11 +37,21 @@ def main():
     params, tx, opt_state, local_loss = common.make_train_tools(
         model, (1, 28, 28, 1), args.lr, args.momentum, args.seed)
 
+    overlap = mpi.config().gradsync_overlap == "auto"
+
     def step(params, opt_state, images, labels):
-        loss, grads = jax.value_and_grad(local_loss)(params, images, labels)
-        # n_buckets comes from config; each bucket is an independent
-        # collective XLA may overlap (the async-hooks analog).
-        grads = mpi.nn.synchronize_gradients(grads)
+        if overlap:
+            # Backprop-overlapped schedule: bucket allreduces fire in
+            # the backward pass itself; grads return already reduced.
+            loss, grads = mpi.nn.make_overlapped_grad_fn(
+                local_loss, params, mesh.axis_names)(params, images,
+                                                     labels)
+        else:
+            loss, grads = jax.value_and_grad(local_loss)(params, images,
+                                                         labels)
+            # n_buckets comes from config; each bucket is an independent
+            # collective XLA may overlap (the async-hooks analog).
+            grads = mpi.nn.synchronize_gradients(grads)
         loss = mpi.collectives.allreduce_in_axis(loss, mesh.axis_names,
                                                  op="mean")
         updates, opt_state = tx.update(grads, opt_state, params)

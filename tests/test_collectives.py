@@ -265,6 +265,111 @@ def test_async_many_inflight(flat_runtime):
         np.testing.assert_allclose(np.asarray(h.wait())[0], x.sum(axis=0))
 
 
+def test_async_staged_matches_sync_bitwise(flat_runtime):
+    # The staged-host handle dispatches on the background worker; its
+    # result must equal the synchronous staged exchange bit-for-bit.
+    for op_fn, sync_fn in [
+        (mpi.async_.allreduce, mpi.allreduce),
+        (mpi.async_.broadcast, mpi.broadcast),
+        (mpi.async_.reduce_scatter, mpi.reduce_scatter),
+    ]:
+        x = rank_data(1000, np.float32)
+        h = op_fn(x, backend="host")
+        assert isinstance(h, mpi.AsyncHandle)
+        out = np.asarray(h.wait())
+        ref = np.asarray(sync_fn(x, backend="host"))
+        assert np.array_equal(out, ref)
+        assert h.done and h.error is None
+
+
+def test_async_direct_matches_sync_bitwise(flat_runtime):
+    x = rank_data(512, np.float32)
+    out = np.asarray(mpi.async_.allreduce(x).wait())
+    assert np.array_equal(out, np.asarray(mpi.allreduce(x)))
+
+
+def test_wait_all_returns_input_order(flat_runtime):
+    # Mixed direct + staged handles; the staged ones complete on the
+    # worker in FIFO order, but wait_all must return results in INPUT
+    # order regardless of completion order.
+    xs = [rank_data(64, np.float32) + i for i in range(5)]
+    handles = [mpi.async_.allreduce(x, backend="host" if i % 2 else None)
+               for i, x in enumerate(xs)]
+    outs = mpi.wait_all(handles)
+    assert len(outs) == len(xs)
+    for x, o in zip(xs, outs):
+        np.testing.assert_allclose(np.asarray(o)[0], x.sum(axis=0))
+    assert all(h.done for h in handles)
+
+
+def test_wait_all_surfaces_first_error(flat_runtime):
+    good = rank_data(64, np.float32)
+    bad = rank_data(3, np.float32).reshape(N, 3)  # 3 % 8 != 0
+    hs = [mpi.async_.allreduce(good, backend="host"),
+          mpi.async_.scatter(bad, backend="host"),
+          mpi.async_.allreduce(good, backend="host")]
+    with pytest.raises(ValueError, match="divisible"):
+        mpi.wait_all(hs)
+    # The batch was still driven to completion: the good handles hold
+    # usable results, the bad one keeps its error.
+    assert all(h.done for h in hs)
+    assert hs[1].error is not None
+    np.testing.assert_allclose(np.asarray(hs[0].wait())[0],
+                               good.sum(axis=0))
+
+
+def test_async_done_surfaces_error(flat_runtime):
+    # A FAILED computation polls done=True with its error exposed —
+    # never the old never-done-forever masking — and wait() re-raises.
+    import time
+
+    bad = rank_data(3, np.float32).reshape(N, 3)
+    h = mpi.async_.scatter(bad, backend="host")
+    for _ in range(500):
+        if h.done:
+            break
+        time.sleep(0.01)
+    assert h.done
+    assert isinstance(h.error, ValueError)
+    with pytest.raises(ValueError, match="divisible"):
+        h.wait()
+    with pytest.raises(ValueError, match="divisible"):
+        h.wait()  # every wait re-raises; no half-initialized buffers
+
+
+def test_async_staged_donate_releases_input(flat_runtime):
+    import jax
+
+    x = jax.device_put(rank_data(256, np.float32))
+    ref = np.asarray(mpi.allreduce(np.asarray(x), backend="host"))
+    h = mpi.async_.allreduce(x, backend="host", donate=True)
+    out = np.asarray(h.wait())
+    assert np.array_equal(out, ref)
+    assert x.is_deleted()  # the staged worker consumed the device buffer
+
+
+def test_async_in_axis_deferred_wait(flat_runtime):
+    # Handle-returning in-axis verb inside shard_map: dispatch at the
+    # call, data dependency deferred to wait() — the overlap window.
+    import jax
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    mesh = mpi.world_mesh()
+    axes = tuple(mesh.axis_names)
+
+    def body(x):
+        h = mpi.async_in_axis.allreduce(x, axes, op="sum")
+        y = x * 3.0  # compute issued between dispatch and wait
+        return h.wait() + y
+
+    fn = jax.jit(shard_map(body, mesh=mesh, in_specs=(P(axes),),
+                           out_specs=P(axes), check_vma=False))
+    X = rank_data(16, np.float32)
+    out = np.asarray(fn(X))
+    np.testing.assert_allclose(out, X.sum(axis=0) + X * 3.0, rtol=1e-6)
+
+
 # ---------------------------------------------------------------------------
 # Hierarchical backend on the 2x4 mesh (reference: custom hierarchical path)
 # ---------------------------------------------------------------------------

@@ -276,3 +276,243 @@ def test_accumulate_gradients_matches_full_batch(flat_runtime):
     # n_accum=1 short-circuits to plain value_and_grad.
     l1, g1 = accumulate_gradients(loss_fn, params, X, Y, n_accum=1)
     np.testing.assert_allclose(float(l1), float(full_loss), rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# Backprop-overlapped gradient sync (docs/OVERLAP.md): per-bucket
+# allreduces fired inside the backward pass via custom_vjp hooks.
+# ---------------------------------------------------------------------------
+
+
+def _mixed_tree_tools():
+    """A small mixed fp32/bf16 MLP: enough leaves/dtypes to force
+    several overlap buckets at a tiny byte bound."""
+    key = jax.random.PRNGKey(0)
+    params = {
+        "l1": {"w": jax.random.normal(key, (8, 32), jnp.float32),
+               "b": jnp.zeros((32,), jnp.float32)},
+        "l2": {"w": jax.random.normal(key, (32, 32)).astype(jnp.bfloat16)},
+        "l3": {"w": jax.random.normal(key, (32, 4), jnp.float32)},
+    }
+
+    def loss_fn(p, x, y):
+        h = jnp.tanh(x @ p["l1"]["w"] + p["l1"]["b"])
+        h = jnp.tanh(h.astype(jnp.bfloat16) @ p["l2"]["w"])
+        out = h.astype(jnp.float32) @ p["l3"]["w"]
+        return jnp.mean((out - y) ** 2)
+
+    X = np.random.RandomState(0).rand(64, 8).astype(np.float32)
+    Y = np.random.RandomState(1).rand(64, 4).astype(np.float32)
+    return params, loss_fn, X, Y
+
+
+def test_overlap_bucket_assignment():
+    # Reverse parameter order, dtype-pure buckets, byte bound honored.
+    leaves = [
+        jnp.zeros((100,), jnp.float32),   # 400 B
+        jnp.zeros((10,), jnp.float32),    # 40 B
+        jnp.zeros((50,), jnp.bfloat16),   # 100 B
+        jnp.zeros((5,), jnp.float32),     # 20 B
+    ]
+    buckets = gradsync.assign_overlap_buckets(leaves, 256)
+    flat = [i for b in buckets for i in b]
+    assert flat == [3, 2, 1, 0]  # last leaf fires first
+    for b in buckets:
+        dts = {str(leaves[i].dtype) for i in b}
+        assert len(dts) == 1  # never mixes dtypes in one bucket
+    # leaf 0 (400 B > bound) sits alone; leaf 2's dtype break isolates it
+    assert [len(b) for b in buckets] == [1, 1, 1, 1]
+    # A generous bound merges same-dtype neighbors but never dtypes.
+    buckets = gradsync.assign_overlap_buckets(leaves, 1 << 20)
+    assert buckets == [[3], [2], [1, 0]]
+
+
+def test_overlap_matches_sync_bitwise_mixed_dtypes(flat_runtime):
+    """Acceptance: the overlapped schedule's gradients equal
+    synchronize_gradients BIT-FOR-BIT on a mixed fp32/bf16 tree, and
+    the lowered HLO carries one all-reduce per bucket."""
+    mesh = mpi.world_mesh()
+    axes = tuple(mesh.axis_names)
+    params, loss_fn, X, Y = _mixed_tree_tools()
+
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    def step_overlap(p, x, y):
+        vag = gradsync.make_overlapped_grad_fn(loss_fn, p, axes,
+                                               max_bytes=1024)
+        return vag(p, x, y)
+
+    def step_sync(p, x, y):
+        loss, grads = jax.value_and_grad(loss_fn)(p, x, y)
+        return loss, gradsync.synchronize_gradients(grads, axes)
+
+    specs = dict(mesh=mesh, in_specs=(P(), P(axes), P(axes)),
+                 out_specs=(P(), P()), check_vma=False)
+    fo = jax.jit(shard_map(step_overlap, **specs))
+    fs = jax.jit(shard_map(step_sync, **specs))
+    lo, go = fo(params, X, Y)
+    ls, gs = fs(params, X, Y)
+    assert float(lo) == float(ls)
+    for a, b in zip(jax.tree.leaves(go), jax.tree.leaves(gs)):
+        assert a.dtype == b.dtype
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    # One collective per bucket survives lowering (4 buckets at 1 KiB:
+    # l3.w | l2.w (bf16) | l1.b | l1.w — dtype breaks + byte bound).
+    n_buckets = len(gradsync.assign_overlap_buckets(
+        jax.tree.leaves(params), 1024))
+    assert fo.lower(params, X, Y).as_text().count(
+        "stablehlo.all_reduce") == n_buckets
+
+
+def test_overlap_dp_step_matches_plain(flat_runtime):
+    """End-to-end LeNet DP step: overlapped grads drive the optimizer
+    to bit-identical parameters."""
+    mesh = mpi.world_mesh()
+    axes = tuple(mesh.axis_names)
+    model, params, tx, opt_state, local_loss = _tools()
+    X, Y = dutil.synthetic_mnist(64, seed=3)
+
+    def dp_plain(p, o, xb, yb):
+        loss, grads = jax.value_and_grad(local_loss)(p, xb, yb)
+        grads = gradsync.synchronize_gradients(grads, axes)
+        u, o = tx.update(grads, o, p)
+        return optax.apply_updates(p, u), o, loss
+
+    def dp_over(p, o, xb, yb):
+        loss, grads = gradsync.make_overlapped_grad_fn(
+            local_loss, p, axes)(p, xb, yb)
+        u, o = tx.update(grads, o, p)
+        return optax.apply_updates(p, u), o, loss
+
+    outs = []
+    for fn in (dp_plain, dp_over):
+        dp = gradsync.data_parallel_step(fn, batch_argnums=(2, 3),
+                                         donate_argnums=())
+        p2, _, loss = dp(gradsync.synchronize_parameters(params),
+                         gradsync.synchronize_parameters(opt_state), X, Y)
+        outs.append((p2, float(loss)))
+    (p_ref, l_ref), (p_over, l_over) = outs
+    assert l_ref == l_over
+    for a, b in zip(jax.tree.leaves(p_ref), jax.tree.leaves(p_over)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_overlap_zero1_presynced_matches(flat_runtime):
+    """ZeRO-1 with overlap: the already-reduced grads reach the
+    optimizer through a local shard slice (update(presynced=True));
+    resulting params match the reduce_scatter path.  Tight allclose,
+    not bitwise: psum and psum_scatter may order the cross-device sum
+    differently."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from torchmpi_tpu.parallel import zero as pzero
+
+    mesh = mpi.world_mesh()
+    axes = tuple(mesh.axis_names)
+    model, params, tx, _, local_loss = _tools()
+    X, Y = dutil.synthetic_mnist(64, seed=4)
+    opt_state = pzero.init(params, tx, axes, mesh=mesh)
+    sspecs = pzero.specs_like(opt_state, axes)
+
+    def z_plain(p, o, xb, yb):
+        loss, grads = jax.value_and_grad(local_loss)(p, xb, yb)
+        p2, o2 = pzero.update(p, grads, o, tx, axes)
+        return p2, o2, loss
+
+    def z_over(p, o, xb, yb):
+        loss, grads = gradsync.make_overlapped_grad_fn(
+            local_loss, p, axes)(p, xb, yb)
+        p2, o2 = pzero.update(p, grads, o, tx, axes, presynced=True)
+        return p2, o2, loss
+
+    outs = []
+    for fn in (z_plain, z_over):
+        f = jax.jit(shard_map(
+            fn, mesh=mesh, in_specs=(P(), sspecs, P(axes), P(axes)),
+            out_specs=(P(), sspecs, P()), check_vma=False))
+        p2, _, loss = f(gradsync.synchronize_parameters(params),
+                        opt_state, X, Y)
+        outs.append((p2, float(loss)))
+    (p_ref, l_ref), (p_over, l_over) = outs
+    np.testing.assert_allclose(l_ref, l_over, rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(p_ref), jax.tree.leaves(p_over)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)
+
+
+def test_overlap_flight_recorder_ordering(flat_runtime):
+    """The CPU-sim-checkable overlap invariant: the FIRST-FIRED
+    bucket's collective launch lands in the flight ring BEFORE the
+    LAST-FIRED bucket's cotangents exist — i.e. communication starts
+    while backward compute is still producing gradients."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    mesh = mpi.world_mesh()
+    axes = tuple(mesh.axis_names)
+    params, loss_fn, X, Y = _mixed_tree_tools()
+    mpi.set_config(obs="metrics")
+    try:
+        from torchmpi_tpu import obs
+
+        obs.reset()
+
+        def step(p, x, y):
+            return gradsync.make_overlapped_grad_fn(
+                loss_fn, p, axes, max_bytes=1024)(p, x, y)
+
+        f = jax.jit(shard_map(step, mesh=mesh,
+                              in_specs=(P(), P(axes), P(axes)),
+                              out_specs=(P(), P()), check_vma=False))
+        out = f(params, X, Y)
+        jax.block_until_ready(out)
+        ov = [(e[0], e[3], e[4]) for e in obs.recorder().events()
+              if e[2] == "overlap"]  # (seq, stage, bucket)
+        assert ov, "no overlap events recorded"
+        first_launch = {}
+        first_grads = {}
+        for seq, stage, bucket in ov:
+            d = first_launch if stage == "launch" else first_grads
+            d.setdefault(bucket, seq)
+        last = max(b for _, _, b in ov)
+        assert last >= 1  # multiple buckets, or there is nothing to hide
+        # bucket 0 (deepest layers) launches before bucket `last`
+        # (shallowest layers) even has gradients.
+        assert first_launch[0] < first_grads[last], (
+            f"launch[0]@{first_launch[0]} not before "
+            f"grads[{last}]@{first_grads[last]}")
+        # and every bucket's grads precede its own launch (the barrier
+        # chain orders dispatch after materialization, never before).
+        for b, seq in first_launch.items():
+            assert first_grads[b] < seq
+    finally:
+        mpi.set_config(obs="off")
+
+
+def test_overlap_bucket_bytes_from_tuning_plan(flat_runtime, tmp_path):
+    """Bucket sizing derives from the tuning-plan size buckets: with a
+    plan holding measured allreduce entries for this mesh, the bound
+    snaps to the largest measured bucket <= fuse_max_bytes; without
+    one it is fuse_max_bytes rounded down to a bucket edge."""
+    from torchmpi_tpu import tuning
+
+    mesh = mpi.world_mesh()
+    # No plan active: fuse_max_bytes (32 MiB default) -> its own edge.
+    assert gradsync.overlap_bucket_bytes(mesh) == 1 << 25
+    # Explicit override wins outright.
+    mpi.set_config(gradsync_overlap_bytes=12345)
+    assert gradsync.overlap_bucket_bytes(mesh) == 12345
+    mpi.set_config(gradsync_overlap_bytes=0)
+    # Seed a plan with a measured 1 MiB-bucket allreduce entry.
+    path = str(tmp_path / "plan.json")
+    cache = tuning.PlanCache(path)
+    key = tuning.make_fingerprint("allreduce", 1 << 20, np.float32, mesh)
+    cache.put(key, tuning.PlanEntry(backend="xla", source="measured"))
+    cache.save()
+    tuning.configure(path, auto_active=False)
+    try:
+        assert gradsync.overlap_bucket_bytes(mesh) == 1 << 20
+    finally:
+        tuning.reset()

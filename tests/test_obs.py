@@ -517,3 +517,25 @@ def test_two_process_blame_identifies_injected_divergence(tmp_path):
     tot = sum(r["value"] for r in agg
               if r["name"] == "tm_collectives_total")
     assert tot == 7  # 3 allreduce x 2 hosts + 1 injected broadcast
+
+
+def test_async_handle_records_wait_hist(obs_runtime):
+    """AsyncHandle lifecycle telemetry: creates/waits counted, wait
+    time on the tm_async_wait_seconds histogram, and the handle events
+    land in the flight ring next to the collectives they wrap."""
+    mesh, obs, _ = obs_runtime
+    x = np.ones((8, 64), np.float32)
+    h = mpi.async_.allreduce(x, backend="host")
+    h.wait()
+    hs = [mpi.async_.allreduce(x) for _ in range(2)]
+    mpi.wait_all(hs)
+    reg = obs.registry()
+    assert reg.counter_total("tm_async_handles_total") >= 6  # 3c + 3w
+    snap = reg.snapshot()
+    hist = [r for r in snap if r.get("name") == "tm_async_wait_seconds"]
+    # One observation per BLOCKING CALL: h.wait() + one for the whole
+    # wait_all batch (never one per handle — that would inflate sum).
+    assert hist and sum(r["count"] for r in hist) == 2
+    assert any(r["labels"].get("op") == "wait_all" for r in hist)
+    evs = [e for e in obs.recorder().events() if e[2] == "async"]
+    assert {e[6] for e in evs} == {"create", "wait"}

@@ -72,7 +72,9 @@ from .collectives import (
     gather,
     scatter,
     async_,
+    async_in_axis,
     sync_handle,
+    wait_all,
     AsyncHandle,
 )
 from .utils.compilegate import (

@@ -19,17 +19,25 @@ Two usage modes:
    rank's output buffer.  This mirrors TorchMPI's per-rank tensor semantics
    exactly and is what the correctness tests sweep (SURVEY.md §5).
 
-Async: XLA dispatch is already asynchronous — an eager collective returns as
-soon as the computation is enqueued.  ``async_*`` therefore returns an
-:class:`AsyncHandle` immediately; ``sync_handle`` blocks (the analog of the
-reference's thread-pool handles + ``torchmpi_sync_handle``).  Ordering of two
-async collectives touching the same buffer is preserved by JAX data
-dependencies (the reference had to enforce this manually across streams —
-SURVEY.md §4.4).
+Async: ``async_.*`` returns a first-class :class:`AsyncHandle` — on the
+direct path XLA dispatch is already asynchronous and the handle wraps the
+enqueued buffers; on the staged-host path the whole exchange runs on a
+background worker (the analog of the reference's collective thread pool),
+optionally donating the input's device buffers once staged.  ``sync_handle``
+/ ``AsyncHandle.wait`` block; ``wait_all`` batches; ``done`` polls without
+blocking and a FAILED computation polls done with its error surfaced.
+``async_in_axis.*`` are the trace-time equivalents for code inside
+shard_map/jit: dispatch at the call, data dependency deferred to ``wait()``
+— the overlap window the latency-hiding scheduler fills (SURVEY.md §4.4).
+Ordering of two async collectives touching the same buffer is preserved by
+JAX data dependencies on the direct path and by the single FIFO staged
+worker on the host path.
 """
 
 from __future__ import annotations
 
+import threading
+import time
 from typing import Any, Dict, Optional, Tuple, Union
 
 import jax
@@ -587,36 +595,58 @@ def _obs_record_eager(cfg, op_name: str, x, m: Mesh, impl=None) -> None:
                      backend, m, dtype=x.dtype)
 
 
+def _staged_leaf(cfg, op_name: str, x, n: int, params: dict):
+    """One leaf's host-staged exchange: the faults-instrumented (sites
+    ``host_staged.gather``/``scatter``) or plain host compute, shared by
+    the synchronous eager path and the async handle dispatch.  ``x`` may
+    be a device array (retries re-stage from it) or, on the async
+    worker, an already-staged host master wrapped in
+    :class:`_RestageView` so each fault-layer attempt still re-stages a
+    fresh writable copy."""
+    if cfg is not None and cfg.faults != "off":
+        from . import faults
+
+        # Injection + retry policy around both staging legs
+        # (sites host_staged.gather/scatter — docs/FAULTS.md);
+        # off is one string compare, the module never imported.
+        return faults.staged_exchange(op_name, x, n, params, _host_staged)
+    return _host_staged(op_name, np.asarray(x), n, **params)
+
+
+def _staged_requested(cfg, backend: Optional[str]) -> bool:
+    """Whether this dispatch takes the staged-host path (config.staged /
+    backend="host"): ONE definition shared by the sync and async eager
+    dispatchers, so they can never disagree about which side of the
+    device/host boundary a call runs on.  An explicit non-host backend
+    argument still forces the direct path, mirroring how per-call
+    selector choices overrode the global staged flag."""
+    return backend == "host" or (backend is None
+                                 and cfg is not None and cfg.staged)
+
+
+def _check_rank_axis(op_name: str, shape, n: int) -> None:
+    """Validate the rank-major leading axis (shared sync/async)."""
+    if len(shape) < 1 or shape[0] != n:
+        raise ValueError(
+            f"{op_name}: leading (rank) axis must have length {n} "
+            f"(the current communicator size); got shape {tuple(shape)}"
+        )
+
+
 def _eager_collective(op_name: str, x, *, mesh: Optional[Mesh] = None,
                       backend: Optional[str] = None, **params):
     m, n = _mesh_and_n(mesh)
     x = jnp.asarray(x)
-    if x.ndim < 1 or x.shape[0] != n:
-        raise ValueError(
-            f"{op_name}: leading (rank) axis must have length {n} "
-            f"(the current communicator size); got shape {x.shape}"
-        )
+    _check_rank_axis(op_name, x.shape, n)
     # ONE config read per dispatch (it feeds the staged check, the
     # "auto" trigger, and _pick's cutover below — re-reading it three
     # times was measurable Python overhead on the eager hot path).
     cfg = runtime.config() if runtime.is_initialized() else None
-    # Staged mode (config.staged / backend="host"): devices -> host ->
-    # compute -> devices, the reference's staged data path.  An explicit
-    # non-host backend argument still forces the direct path, mirroring
-    # how per-call selector choices overrode the global staged flag.
-    if backend == "host" or (backend is None
-                             and cfg is not None and cfg.staged):
+    # Staged mode: devices -> host -> compute -> devices, the
+    # reference's staged data path.
+    if _staged_requested(cfg, backend):
         _obs_record_eager(cfg, op_name, x, m)
-        if cfg is not None and cfg.faults != "off":
-            from . import faults
-
-            # Injection + retry policy around both staging legs
-            # (sites host_staged.gather/scatter — docs/FAULTS.md);
-            # off is one string compare, the module never imported.
-            out = faults.staged_exchange(op_name, x, n, params,
-                                         _host_staged)
-        else:
-            out = _host_staged(op_name, np.asarray(x), n, **params)
+        out = _staged_leaf(cfg, op_name, x, n, params)
         return _place_rank_major(np.ascontiguousarray(out), m)
     # Online "auto" mode (config default, per-op table, or an explicit
     # backend="auto"): resolve against the persistent tuning plan.  The
@@ -782,39 +812,111 @@ def to_local(x):
 
 
 class AsyncHandle:
-    """Opaque handle for an in-flight collective.
+    """First-class handle for an in-flight collective.
 
-    JAX has already enqueued the computation; ``wait()`` blocks until device
-    buffers are ready and returns them.  Mirrors the reference's future
-    indices returned by ``torchmpi_async_*``.
+    Three flavors, one contract (``wait()`` / ``done`` / ``error``):
+
+    - **direct eager** — XLA has already enqueued the computation;
+      ``wait()`` blocks until device buffers are ready and returns them
+      (the analog of the reference's future indices from
+      ``torchmpi_async_*``).
+    - **staged-host** — the devices->host->devices exchange runs on a
+      background worker (the reference's collective thread pool);
+      the handle owns a future and ``wait()`` joins it, then blocks on
+      the placement.  With ``donate=True`` the input's device buffers
+      are released as soon as they are staged to host.
+    - **trace-time** (the ``async_in_axis`` verbs) — the collective is
+      already part of the surrounding jit program; the handle defers
+      the *data dependency* until ``wait()``, which is what lets the
+      latency-hiding scheduler overlap it with compute issued in
+      between (the gradsync overlap schedule builds on the same idea).
+
+    A failed computation is **done** (``done`` -> True) and its error
+    is surfaced: ``wait()`` re-raises it and ``error`` exposes it —
+    never the old poll-as-never-done masking.
     """
 
-    __slots__ = ("_value", "_done")
+    __slots__ = ("_value", "_future", "_done", "_error", "_op", "_trace")
 
-    def __init__(self, value):
+    def __init__(self, value=None, *, future=None, op: str = "",
+                 trace: bool = False):
         self._value = value
-        self._done = False
+        self._future = future
+        self._done = trace  # a traced value has no runtime to wait on
+        self._error: Optional[BaseException] = None
+        self._op = op
+        self._trace = trace
+
+    @property
+    def op(self) -> str:
+        return self._op
+
+    @property
+    def error(self) -> Optional[BaseException]:
+        """The failure of a completed-with-error handle (else None)."""
+        return self._error
+
+    def _resolve_future(self) -> None:
+        """Exchange a completed staged future for its placed value (or
+        its error)."""
+        if self._future is None:
+            return
+        fut, self._future = self._future, None
+        try:
+            self._value = fut.result()
+        except Exception as e:  # noqa: BLE001 — carried to wait()/done
+            self._error = e
 
     def wait(self):
-        if not self._done:
-            jax.block_until_ready(self._value)
-            self._done = True
+        """Block until the collective completes; return its result.
+
+        Re-raises the underlying error if the computation failed — on
+        every call, so a handle waited twice fails twice rather than
+        handing out half-initialized buffers."""
+        if self._done:
+            if self._error is not None:
+                raise self._error
+            return self._value
+        t0 = time.monotonic()
+        self._resolve_future()
+        if self._error is None:
+            try:
+                jax.block_until_ready(self._value)
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                self._error = e
+        self._done = True
+        _obs_async("wait", self._op, time.monotonic() - t0)
+        if self._error is not None:
+            raise self._error
         return self._value
 
     @property
     def done(self) -> bool:
+        """Non-blocking poll.  True also when the computation FAILED —
+        the error then raises from ``wait()`` (and shows on ``error``);
+        only a genuinely still-in-flight computation polls False."""
         if self._done:
             return True
-        try:
-            ready = all(
-                leaf.is_ready() if hasattr(leaf, "is_ready") else True
-                for leaf in jax.tree.leaves(self._value)
-            )
-        except Exception:
-            ready = False
-        if ready:
-            self._done = True
-        return self._done
+        if self._future is not None:
+            if not self._future.done():
+                return False
+            self._resolve_future()
+        if self._error is None:
+            try:
+                ready = all(
+                    leaf.is_ready() if hasattr(leaf, "is_ready") else True
+                    for leaf in jax.tree.leaves(self._value)
+                )
+            except Exception as e:  # noqa: BLE001 — a poll error IS
+                # completion: the async computation failed.  The old
+                # blanket ``ready = False`` here made failed handles
+                # poll as never-done forever.
+                self._error = e
+                ready = True
+            if not ready:
+                return False
+        self._done = True
+        return True
 
 
 def sync_handle(handle: AsyncHandle):
@@ -822,45 +924,280 @@ def sync_handle(handle: AsyncHandle):
     return handle.wait()
 
 
+def wait_all(handles):
+    """Batched ``wait()``: block until EVERY handle completes, then
+    return their results **in input order** (completion order does not
+    reorder anything).  One ``jax.block_until_ready`` spans all device
+    values, so a mixed batch synchronizes in a single readiness sweep
+    instead of one blocking call per handle.  If any handle failed, the
+    first (in input order) error re-raises — after all handles have
+    been driven to completion, so no work is silently left in flight.
+    """
+    hs = list(handles)
+    t0 = time.monotonic()
+    pending = []
+    for h in hs:
+        if not h._done:
+            h._resolve_future()
+            if h._error is None:
+                pending.append(h)
+    try:
+        jax.block_until_ready([h._value for h in pending])
+    except Exception:  # noqa: BLE001 — attribute per handle below
+        # One of the batch failed; fall back to per-handle blocking so
+        # the error lands on the handle that owns it.
+        for h in pending:
+            try:
+                jax.block_until_ready(h._value)
+            except Exception as e:  # noqa: BLE001
+                h._error = e
+    dt = time.monotonic() - t0
+    waited = False
+    for h in hs:
+        if not h._done:
+            h._done = True
+            waited = True
+            # Counter + flight event per handle; the blocked time is
+            # recorded ONCE below — attributing the whole batch elapsed
+            # to every handle would inflate the histogram sum N-fold.
+            _obs_async("wait", h._op)
+    if waited:
+        _obs_async("wait", "wait_all", dt)
+    for h in hs:
+        if h._error is not None:
+            raise h._error
+    return [h._value for h in hs]
+
+
+def _obs_async(event: str, op: str, wait_s: Optional[float] = None,
+               x=None) -> None:
+    """Handle-lifecycle telemetry (``tm_async_wait_seconds`` + flight
+    events) — one string compare when obs is off, module never
+    imported (the ``torchmpi_tpu.obs`` discipline).  ``x`` is the raw
+    payload; its nbytes walk runs only AFTER the off-gate, so the off
+    path never pays a pytree traversal."""
+    if runtime.effective_config().obs == "off":
+        return
+    from . import obs
+
+    obs.record_async(event, op, wait_s=wait_s,
+                     nbytes=selector.nbytes_of(x) if x is not None else 0)
+
+
+# One staged-dispatch worker on purpose: the reference's collective
+# thread pool sequenced collectives per communicator, and FIFO
+# completion is what makes two async staged collectives on the same
+# logical buffer well-ordered without user-side fences.
+_staged_pool = None
+_staged_pool_lock = threading.Lock()
+
+
+def _staged_executor():
+    global _staged_pool
+    if _staged_pool is None:
+        with _staged_pool_lock:
+            if _staged_pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                _staged_pool = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="tm-async-staged")
+    return _staged_pool
+
+
+class _RestageView:
+    """Host-staged master buffer presented to the fault layer with the
+    device-buffer re-stage contract: each ``np.asarray()`` (one per
+    attempt in ``faults.staged_exchange``) returns a FRESH writable
+    copy, so an injected corrupt flips real bits in that attempt's
+    staging copy while the retry re-stages bit-identical from the
+    untouched master — exactly how retries re-stage from real device
+    buffers on the synchronous path."""
+
+    __slots__ = ("_master",)
+
+    def __init__(self, master: np.ndarray) -> None:
+        self._master = master
+
+    def __array__(self, dtype=None):
+        return np.array(self._master, dtype=dtype, copy=True)
+
+
+def _staged_async_work(op_name: str, leaves, treedef, n: int, m: Mesh,
+                       params: dict, cfg, donate: bool):
+    """Worker-side staged exchange for one async handle: stage each
+    leaf to host (releasing the device buffer immediately when donated),
+    run the host compute (faults-instrumented when armed), and place
+    the results back rank-major.  Runs on the single staged worker, so
+    handles complete in dispatch order."""
+    outs = []
+    sharding = _rank_major_sharding(m)
+    faults_on = cfg is not None and cfg.faults != "off"
+    for v in leaves:
+        _obs_record_eager(cfg, op_name, v, m)
+        if donate and isinstance(v, jax.Array):
+            # np.asarray of a CPU jax array can alias the device
+            # buffer; the donated buffer is deleted below, so the
+            # staged copy must own its memory.
+            hx = np.array(v, copy=True)
+            v.delete()
+        else:
+            hx = np.asarray(v)
+        if faults_on:
+            # Give the fault layer the device-buffer contract its
+            # retries assume: every np.asarray() re-stage yields a
+            # FRESH writable attempt copy, so corrupt-then-heal flips
+            # real bits in the attempt's staging copy and the retry
+            # still re-stages clean from the untouched master.
+            hx = _RestageView(hx)
+        out = _staged_leaf(cfg, op_name, hx, n, params)
+        outs.append(_place_rank_major(np.ascontiguousarray(out), m,
+                                      sharding))
+    return jax.tree.unflatten(treedef, outs)
+
+
+def _async_eager(op_name: str, x, *, mesh: Optional[Mesh] = None,
+                 backend: Optional[str] = None, donate: bool = False,
+                 **params) -> AsyncHandle:
+    """Dispatch an eager collective and return an in-flight handle.
+
+    Direct path: XLA dispatch is already asynchronous — the handle
+    wraps the enqueued values.  Staged-host path: the whole exchange
+    (readback, host compute, placement) moves to the staged worker so
+    the caller never blocks; ``donate=True`` releases each input leaf's
+    device buffers the moment it is staged (the ``donate_argnums``
+    analog for a path that leaves the XLA program — the buffer is
+    consumed by the transfer exactly as a donated jit argument is).
+    """
+    m, n = _mesh_and_n(mesh)
+    cfg = runtime.config() if runtime.is_initialized() else None
+    staged = _staged_requested(cfg, backend)
+    if not staged:
+        value = jax.tree.map(
+            lambda v: _eager_collective(op_name, v, mesh=m,
+                                        backend=backend, **params), x)
+        h = AsyncHandle(value, op=op_name)
+        _obs_async("create", op_name, x=x)
+        return h
+    leaves, treedef = jax.tree.flatten(jax.tree.map(jnp.asarray, x))
+    for v in leaves:
+        _check_rank_axis(op_name, v.shape, n)
+    fut = _staged_executor().submit(
+        _staged_async_work, op_name, leaves, treedef, n, m, dict(params),
+        cfg, donate)
+    h = AsyncHandle(future=fut, op=op_name)
+    _obs_async("create", op_name, x=x)
+    return h
+
+
 class _AsyncNamespace:
     """``collectives.async_.allreduce(x)`` -> AsyncHandle (reference:
-    ``mpi.async.allreduceTensor``)."""
+    ``mpi.async.allreduceTensor``).  Each verb dispatches WITHOUT
+    synchronizing — the staged-host path runs on a background worker —
+    and accepts ``donate=True`` to release the input's device buffers
+    once staged (staged path only; the direct path's buffers belong to
+    XLA's ordinary lifetime)."""
 
     @staticmethod
     def allreduce(x, **kw) -> AsyncHandle:
-        return AsyncHandle(allreduce(x, **kw))
+        return _async_eager("allreduce", x,
+                            **{"op": kw.pop("op", "sum"), **kw})
 
     @staticmethod
     def broadcast(x, **kw) -> AsyncHandle:
-        return AsyncHandle(broadcast(x, **kw))
+        return _async_eager("broadcast", x,
+                            **{"root": kw.pop("root", 0), **kw})
 
     @staticmethod
     def reduce(x, **kw) -> AsyncHandle:
-        return AsyncHandle(reduce(x, **kw))
+        return _async_eager("reduce", x, **{"root": kw.pop("root", 0),
+                                            "op": kw.pop("op", "sum"), **kw})
 
     @staticmethod
     def allgather(x, **kw) -> AsyncHandle:
-        return AsyncHandle(allgather(x, **kw))
+        return _async_eager("allgather", x, **kw)
 
     @staticmethod
     def reduce_scatter(x, **kw) -> AsyncHandle:
-        return AsyncHandle(reduce_scatter(x, **kw))
+        return _async_eager("reduce_scatter", x, **kw)
 
     @staticmethod
     def gather(x, **kw) -> AsyncHandle:
-        return AsyncHandle(gather(x, **kw))
+        return _async_eager("gather", x, **{"root": kw.pop("root", 0), **kw})
 
     @staticmethod
     def scatter(x, **kw) -> AsyncHandle:
-        return AsyncHandle(scatter(x, **kw))
+        return _async_eager("scatter", x, **{"root": kw.pop("root", 0), **kw})
 
     @staticmethod
-    def sendreceive(x, **kw) -> AsyncHandle:
-        return AsyncHandle(sendreceive(x, **kw))
+    def sendreceive(x, *, src: int, dst: int, **kw) -> AsyncHandle:
+        return _async_eager("sendreceive", x, src=src, dst=dst, **kw)
 
     @staticmethod
     def alltoall(x, **kw) -> AsyncHandle:
-        return AsyncHandle(alltoall(x, **kw))
+        return _async_eager("alltoall", x, split_axis=0, concat_axis=0,
+                            **kw)
 
 
 async_ = _AsyncNamespace()
+
+
+class _AsyncInAxisNamespace:
+    """Handle-returning variants of the nine ``*_in_axis`` verbs, for
+    use INSIDE shard_map/jit: the collective is issued (traced) at the
+    call — riding the same fusion/selector/tuning-plan routing as the
+    synchronous verbs — and the handle defers the *data dependency* to
+    ``wait()``/``wait_all``.  Everything the program computes between
+    dispatch and wait is overlap the latency-hiding scheduler can
+    exploit (the reference's ``mpi.async.*`` inside the training loop;
+    the gradsync overlap schedule automates the same pattern per
+    gradient bucket)."""
+
+    @staticmethod
+    def allreduce(x, axis_names: AxisNames, **kw) -> AsyncHandle:
+        return AsyncHandle(allreduce_in_axis(x, axis_names, **kw),
+                           op="allreduce", trace=True)
+
+    @staticmethod
+    def broadcast(x, axis_names: AxisNames, **kw) -> AsyncHandle:
+        return AsyncHandle(broadcast_in_axis(x, axis_names, **kw),
+                           op="broadcast", trace=True)
+
+    @staticmethod
+    def reduce(x, axis_names: AxisNames, **kw) -> AsyncHandle:
+        return AsyncHandle(reduce_in_axis(x, axis_names, **kw),
+                           op="reduce", trace=True)
+
+    @staticmethod
+    def allgather(x, axis_names: AxisNames, **kw) -> AsyncHandle:
+        return AsyncHandle(allgather_in_axis(x, axis_names, **kw),
+                           op="allgather", trace=True)
+
+    @staticmethod
+    def reduce_scatter(x, axis_names: AxisNames, **kw) -> AsyncHandle:
+        return AsyncHandle(reduce_scatter_in_axis(x, axis_names, **kw),
+                           op="reduce_scatter", trace=True)
+
+    @staticmethod
+    def gather(x, axis_names: AxisNames, **kw) -> AsyncHandle:
+        return AsyncHandle(gather_in_axis(x, axis_names, **kw),
+                           op="gather", trace=True)
+
+    @staticmethod
+    def scatter(x, axis_names: AxisNames, **kw) -> AsyncHandle:
+        return AsyncHandle(scatter_in_axis(x, axis_names, **kw),
+                           op="scatter", trace=True)
+
+    @staticmethod
+    def sendreceive(x, axis_names: AxisNames, *, src: int, dst: int,
+                    **kw) -> AsyncHandle:
+        return AsyncHandle(
+            sendreceive_in_axis(x, axis_names, src=src, dst=dst, **kw),
+            op="sendreceive", trace=True)
+
+    @staticmethod
+    def alltoall(x, axis_names: AxisNames, **kw) -> AsyncHandle:
+        return AsyncHandle(alltoall_in_axis(x, axis_names, **kw),
+                           op="alltoall", trace=True)
+
+
+async_in_axis = _AsyncInAxisNamespace()

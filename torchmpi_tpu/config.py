@@ -233,6 +233,24 @@ class Config:
     # docs/artifacts/overlap_summary.md).  Off by default: one fused
     # all-reduce is usually fastest below the combine threshold.
     gradsync_barrier: bool = False
+    # Backprop-overlapped gradient sync (docs/OVERLAP.md): "off"
+    # (default — the step builders run the post-backward
+    # synchronize_gradients path byte-for-byte as before) or "auto"
+    # (recipes' step builders compute gradients through
+    # gradsync.make_overlapped_grad_fn: per-bucket allreduces fire
+    # INSIDE the backward pass as each bucket's cotangents materialize
+    # — reverse-parameter-order buckets, optimization-barrier chained,
+    # so bucket i's communication hides under bucket i+1's backward
+    # compute.  Bit-identical gradients to the synchronous path).
+    # Env: TORCHMPI_TPU_GRADSYNC_OVERLAP.
+    gradsync_overlap: str = "off"
+    # Byte bound on one overlap bucket.  0 (default) derives it from
+    # the tuning-plan size buckets: the largest measured allreduce
+    # bucket for this mesh when a plan is active, else fuse_max_bytes,
+    # rounded down to a plan bucket edge so every fired bucket lands on
+    # a (potentially measured) plan key.
+    # Env: TORCHMPI_TPU_GRADSYNC_OVERLAP_BYTES.
+    gradsync_overlap_bytes: int = 0
     # Average (pmean) instead of sum (psum) in synchronize_gradients.
     gradsync_average: bool = True
     # Optional on-the-wire gradient compression: None or "bf16".
@@ -289,6 +307,10 @@ class Config:
                                     32 * 1024 * 1024),
             flash_prescale=_env_bool("TORCHMPI_TPU_FLASH_PRESCALE", False),
             gradsync_buckets=_env_int("TORCHMPI_TPU_GRADSYNC_BUCKETS", 1),
+            gradsync_overlap=_env_str("TORCHMPI_TPU_GRADSYNC_OVERLAP",
+                                      "off"),
+            gradsync_overlap_bytes=_env_int(
+                "TORCHMPI_TPU_GRADSYNC_OVERLAP_BYTES", 0),
             gradsync_barrier=_env_bool("TORCHMPI_TPU_GRADSYNC_BARRIER",
                                        False),
             gradsync_average=_env_bool("TORCHMPI_TPU_GRADSYNC_AVERAGE", True),

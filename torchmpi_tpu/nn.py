@@ -17,6 +17,7 @@ from .parallel.gradsync import (  # noqa: F401
     synchronize_parameters,
     resynchronize_parameters_in_axis,
     synchronize_gradients,
+    make_overlapped_grad_fn,
     accumulate_gradients,
     data_parallel_step,
 )
@@ -25,6 +26,7 @@ __all__ = [
     "synchronize_parameters",
     "resynchronize_parameters_in_axis",
     "synchronize_gradients",
+    "make_overlapped_grad_fn",
     "accumulate_gradients",
     "data_parallel_step",
 ]

@@ -371,6 +371,36 @@ def record_fault(action: str, site: str, *, kind: str = "",
         _recorder.append("fault", site, 0, kind, action)
 
 
+def record_async(event: str, op: str, *, wait_s: Optional[float] = None,
+                 nbytes: int = 0) -> None:
+    """One :class:`~torchmpi_tpu.collectives.AsyncHandle` lifecycle
+    event: ``event`` is ``create`` | ``wait``.  ``wait_s`` lands on the
+    ``tm_async_wait_seconds`` histogram — ONE observation per blocking
+    call (``wait_all`` records its batch elapsed once under
+    ``op="wait_all"``, never once per handle), so sum/count give the
+    exact mean time blocked per call.  All events land in the flight
+    ring, so a gang wedged inside a handle wait shows the handle as
+    its last event."""
+    _registry.counter_inc("tm_async_handles_total", event=event, op=op)
+    if wait_s is not None:
+        _registry.hist_observe("tm_async_wait_seconds", wait_s, op=op)
+    _recorder.append("async", op, int(nbytes), "", event)
+
+
+def record_overlap(stage: str, bucket: int, total: int) -> None:
+    """One overlapped-gradsync schedule event, fired at RUNTIME from a
+    debug callback inside the backward pass (docs/OVERLAP.md):
+    ``stage`` is ``grads`` (bucket ``bucket``'s cotangents just
+    materialized) or ``launch`` (its allreduce is being handed to the
+    scheduler).  The flight-ring interleaving of these events is the
+    CPU-sim-checkable overlap invariant — bucket *i*'s ``launch``
+    recorded before bucket *i+1*'s ``grads`` — that
+    ``benchmarks/overlap_trace.py`` and the gradsync tests assert."""
+    _registry.counter_inc("tm_overlap_events_total", stage=stage)
+    _recorder.append("overlap", stage, int(bucket), "",
+                     f"bucket {bucket}/{total}")
+
+
 def record_restart(event: str, step: int) -> None:
     """One checkpoint-restart driver event (``utils/restart.py``):
     ``recovered`` (settled on a checkpoint step), ``fresh_start`` (no

@@ -21,12 +21,12 @@ TPU-native mapping:
 
 from __future__ import annotations
 
-from typing import Any, Callable, Optional, Sequence, Tuple, Union
+from typing import Any, Callable, List, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+from jax import lax, shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import collectives, fusion, runtime
@@ -192,6 +192,211 @@ def synchronize_gradients(grads: PyTree, axis_names: Optional[AxisNames] = None,
     if orig_dtypes is not None:
         out = jax.tree.map(lambda g, d: g.astype(d), out, orig_dtypes)
     return out
+
+
+# ---------------------------------------------------------------------------
+# Backprop-overlapped gradient sync (docs/OVERLAP.md).  The reference's
+# async per-layer hooks fired an allreduce per module as its gradParams
+# arrived during backward; the TPU-native equivalent wraps each gradient
+# BUCKET's parameters in a custom_vjp whose backward rule IS the
+# bucket's allreduce — the collective then sits in the backward graph at
+# exactly the point where that bucket's cotangents are complete, and the
+# latency-hiding scheduler hides it under the remaining backward
+# compute.  An optimization-barrier token chain (the gradsync_barrier
+# machinery, threaded through the custom_vjp rules) keeps the buckets
+# distinct through XLA's all-reduce combiner and issues them in
+# materialization order.
+# ---------------------------------------------------------------------------
+
+
+def overlap_bucket_bytes(mesh: Optional[Mesh] = None) -> int:
+    """Byte bound for one overlap bucket: ``config.
+    gradsync_overlap_bytes`` when set, else the tuning-plan-aligned
+    bound (:func:`torchmpi_tpu.tuning.plan_bucket_bytes`) — the largest
+    measured allreduce size bucket for this mesh when a plan is active,
+    else ``fuse_max_bytes`` rounded down to a plan bucket edge.  Sizing
+    from the plan's log2 buckets (instead of a fixed ``n_buckets``)
+    keys every fired bucket to a collective size somebody measured."""
+    cfg = runtime.effective_config()
+    if cfg.gradsync_overlap_bytes > 0:
+        return int(cfg.gradsync_overlap_bytes)
+    from .. import tuning
+
+    m = _default_mesh(mesh)
+    return tuning.plan_bucket_bytes("allreduce", m,
+                                    cfg.fuse_max_bytes or 32 * 1024 * 1024)
+
+
+def assign_overlap_buckets(leaves: Sequence, max_bytes: int
+                           ) -> List[List[int]]:
+    """Reverse-parameter-order bucket assignment: walk the flattened
+    tree's leaves LAST to FIRST — the order their cotangents
+    materialize during backprop — starting a new bucket when the byte
+    bound fills or the dtype changes (buckets stay dtype-pure, the
+    fusion discipline: a mixed fp32/bf16 tree never promotes on the
+    wire).  Returns buckets of leaf indices in FIRING order: bucket 0
+    (the deepest layers) launches first."""
+    max_bytes = max(1, int(max_bytes))
+    buckets: List[List[int]] = []
+    acc = 0
+    cur_dt = None
+    for i in range(len(leaves) - 1, -1, -1):
+        leaf = leaves[i]
+        b = int(np.prod(leaf.shape)) * np.dtype(leaf.dtype).itemsize
+        if (not buckets or np.dtype(leaf.dtype) != cur_dt
+                or acc + b > max_bytes):
+            buckets.append([])
+            acc = 0
+            cur_dt = np.dtype(leaf.dtype)
+        buckets[-1].append(i)
+        acc += b
+    return buckets
+
+
+def _make_bucket_sync(idx: int, total: int, axes: Tuple[str, ...],
+                      op: str, backend: Optional[str],
+                      compress: Optional[str]):
+    """One bucket's sync op: identity in forward, THE bucket's
+    allreduce in backward.  ``token`` threads the optimization-barrier
+    chain across buckets: the backward rule barriers its allreduce
+    input on the incoming token (the previous-fired bucket's launch)
+    and derives its outgoing token from the allreduce result — so the
+    collectives stay distinct through the combiner and issue in firing
+    order, each eligible the moment its cotangents exist."""
+
+    @jax.custom_vjp
+    def sync(xs, token):
+        return xs, token
+
+    def fwd(xs, token):
+        return (xs, token), None
+
+    def bwd(_, cts):
+        g, tok = cts
+        shapes = [x.shape for x in g]
+        sizes = [int(np.prod(s)) for s in shapes]
+        obs_on = runtime.effective_config().obs != "off"
+        if obs_on:
+            from .. import obs
+
+            # Runtime evidence, not trace-time: the callback fires when
+            # this bucket's cotangents materialize on each device — the
+            # flight-ring ordering of grads/launch events across
+            # buckets is the CPU-sim-checkable overlap invariant.
+            jax.debug.callback(
+                lambda *_a, _o=obs, _k=idx, _t=total:
+                _o.record_overlap("grads", _k, _t),
+                g[0].reshape(-1)[:1])
+        flat = (g[0].reshape(-1) if len(g) == 1
+                else jnp.concatenate([x.reshape(-1) for x in g]))
+        orig_dtype = flat.dtype
+        if compress == "bf16":
+            flat = flat.astype(jnp.bfloat16)
+        flat, _ = lax.optimization_barrier((flat, tok))
+        if obs_on:
+            from .. import obs
+
+            jax.debug.callback(
+                lambda *_a, _o=obs, _k=idx, _t=total:
+                _o.record_overlap("launch", _k, _t),
+                flat[:1])
+        impl = collectives._pick(  # noqa: SLF001 — shared selector route
+            "allreduce", flat, backend, axes)
+        red = impl(flat, axes, op=op)
+        if compress == "bf16":
+            red = red.astype(orig_dtype)
+        anchor = red[0] if sum(sizes) else tok
+        tok_out, _ = lax.optimization_barrier((tok, anchor))
+        out, off = [], 0
+        for s, sz in zip(shapes, sizes):
+            out.append(red[off:off + sz].reshape(s))
+            off += sz
+        return (tuple(out), tok_out)
+
+    sync.defvjp(fwd, bwd)
+    return sync
+
+
+def make_overlapped_grad_fn(loss_fn: Callable, params_template: PyTree,
+                            axis_names: Optional[AxisNames] = None, *,
+                            mesh: Optional[Mesh] = None,
+                            op: Optional[str] = None,
+                            backend: Optional[str] = None,
+                            compress: Optional[str] = None,
+                            has_aux: bool = False,
+                            max_bytes: Optional[int] = None) -> Callable:
+    """Build a ``value_and_grad`` whose gradients come back ALREADY
+    allreduced, with each bucket's collective fired inside the backward
+    pass as its cotangents materialize (the DDP overlap schedule; the
+    reference's async per-layer hooks).
+
+    For use INSIDE a shard_map'd/jitted train step, where
+    ``synchronize_gradients`` would otherwise run after the full
+    backward::
+
+        vag = gradsync.make_overlapped_grad_fn(loss_fn, params, axes)
+        loss, grads = vag(params, batch)      # grads are synced
+
+    ``params_template`` supplies leaf shapes/dtypes for the bucket
+    assignment — the traced ``params`` themselves work (the recipes
+    step builders do exactly that), as does an ``eval_shape`` tree.
+    Buckets are assigned in reverse parameter order (:func:
+    `assign_overlap_buckets`) and sized from the tuning-plan size
+    buckets (:func:`overlap_bucket_bytes`) unless ``max_bytes`` is
+    given.  Defaults: ``op`` from ``config.gradsync_average``,
+    ``compress`` from ``config.gradsync_compress`` — exactly
+    :func:`synchronize_gradients`'s, and the results are bit-identical
+    to it (test-asserted; the fused reductions are elementwise over
+    the same cross-device order).
+
+    Extra positional args flow through: ``vag(params, *batch)`` calls
+    ``loss_fn(params, *batch)``.  ``has_aux`` follows
+    ``jax.value_and_grad``.
+    """
+    if axis_names is None:
+        axis_names = _all_axes(_default_mesh(mesh))
+    axes = (axis_names,) if isinstance(axis_names, str) \
+        else tuple(axis_names)
+    cfg = runtime.config() if runtime.is_initialized() else None
+    if op is None:
+        op = "mean" if (cfg is None or cfg.gradsync_average) else "sum"
+    if compress is None and cfg is not None:
+        compress = cfg.gradsync_compress
+    if compress not in (None, "none", "bf16"):
+        raise ValueError(f"unknown gradient compression {compress!r}")
+    template_leaves, template_def = jax.tree.flatten(params_template)
+    if not template_leaves:
+        raise ValueError("make_overlapped_grad_fn: empty parameter tree")
+    if max_bytes is None:
+        max_bytes = overlap_bucket_bytes(mesh)
+    firing = assign_overlap_buckets(template_leaves, max_bytes)
+    total = len(firing)
+    syncs = [_make_bucket_sync(k, total, axes, op, backend, compress)
+             for k in range(total)]
+    if cfg is not None and cfg.obs != "off":
+        from .. import obs
+
+        obs.record_gradsync(total, op, compress == "bf16")
+
+    def wrapped_loss(params, *args):
+        leaves, treedef = jax.tree.flatten(params)
+        if len(leaves) != len(template_leaves):
+            raise ValueError(
+                f"make_overlapped_grad_fn: params tree has {len(leaves)} "
+                f"leaves, template had {len(template_leaves)}")
+        token = jnp.zeros((), jnp.float32)
+        new = list(leaves)
+        # Forward chain order is REVERSE firing order: AD traverses the
+        # token chain backwards, so the bucket applied last — bucket 0,
+        # the deepest layers — fires first.
+        for k in range(total - 1, -1, -1):
+            xs = tuple(leaves[i] for i in firing[k])
+            xs, token = syncs[k](xs, token)
+            for i, v in zip(firing[k], xs):
+                new[i] = v
+        return loss_fn(jax.tree.unflatten(treedef, new), *args)
+
+    return jax.value_and_grad(wrapped_loss, has_aux=has_aux)
 
 
 def accumulate_gradients(loss_fn: Callable, params: PyTree, *batch: Any,

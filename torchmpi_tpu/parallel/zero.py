@@ -159,7 +159,8 @@ def update(params: PyTree, grads: PyTree, opt_state: PyTree,
            axis_names: Optional[AxisNames] = None, *,
            op: Optional[str] = None,
            backend: Optional[str] = None,
-           compress: Optional[str] = None) -> Tuple[PyTree, PyTree]:
+           compress: Optional[str] = None,
+           presynced: bool = False) -> Tuple[PyTree, PyTree]:
     """One ZeRO-1 step, for use INSIDE a shard_map'd train step.
 
     reduce_scatter the flat gradients over ``axis_names`` (selector-routed,
@@ -175,13 +176,25 @@ def update(params: PyTree, grads: PyTree, opt_state: PyTree,
     Returns ``(new_params, new_opt_state)`` — numerically identical to
     allreduce-then-update replicated DP (test_zero.py proves it against
     both that and the single-device oracle).
+
+    ``presynced=True`` is the backprop-overlap mode (docs/OVERLAP.md):
+    ``grads`` are ALREADY reduced across ``axis_names`` (by
+    ``gradsync.make_overlapped_grad_fn``, op/compress applied there),
+    so the reduce_scatter leg is replaced by a local slice of this
+    device's shard — the communication already happened, overlapped
+    under the backward pass.
     """
     if axis_names is None:
         axis_names = tuple(runtime.current_mesh().axis_names)
     axes = _axes_tuple(axis_names)
-    g_shard, spec = _reduce_scatter_grads(grads, axes, spec=None,
-                                          params=params, op=op,
-                                          backend=backend, compress=compress)
+    if presynced:
+        spec = _FlatSpec(params, int(_axis_size(axes)))
+        g_shard = _local_shard(grads, spec, axes)
+    else:
+        g_shard, spec = _reduce_scatter_grads(grads, axes, spec=None,
+                                              params=params, op=op,
+                                              backend=backend,
+                                              compress=compress)
     p_shard = _local_shard(params, spec, axes)
     updates, new_state = tx.update(g_shard, opt_state, p_shard)
     p_shard = optax.apply_updates(p_shard, updates)
@@ -303,7 +316,8 @@ def update3(p_shard: jax.Array, grads: PyTree, opt_state: PyTree,
             axis_names: AxisNames, *, spec: _FlatSpec,
             op: Optional[str] = None,
             backend: Optional[str] = None,
-            compress: Optional[str] = None
+            compress: Optional[str] = None,
+            presynced: bool = False
             ) -> Tuple[jax.Array, PyTree]:
     """One ZeRO-3 step, for use INSIDE a shard_map'd train step.
 
@@ -315,11 +329,19 @@ def update3(p_shard: jax.Array, grads: PyTree, opt_state: PyTree,
 
     Returns ``(new_p_shard, new_opt_state)`` — numerically identical to
     allreduce-then-update replicated DP (test_zero.py proves it).
+
+    ``presynced=True`` as in :func:`update`: ``grads`` arrived already
+    reduced (the overlap schedule) and this device slices its shard
+    locally instead of re-communicating.
     """
     axes = _axes_tuple(axis_names)
-    g_shard, _ = _reduce_scatter_grads(grads, axes, spec=spec, params=None,
-                                       op=op, backend=backend,
-                                       compress=compress)
+    if presynced:
+        g_shard = _local_shard(grads, spec, axes)
+    else:
+        g_shard, _ = _reduce_scatter_grads(grads, axes, spec=spec,
+                                           params=None, op=op,
+                                           backend=backend,
+                                           compress=compress)
     updates, new_state = tx.update(g_shard, opt_state, p_shard)
     return optax.apply_updates(p_shard, updates), new_state
 

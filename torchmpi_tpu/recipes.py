@@ -32,6 +32,7 @@ def make_bn_dp_train_step(
     remat: bool = False,
     zero: int = 0,
     params_template: Any = None,
+    overlap: Optional[str] = None,
 ) -> Callable:
     """Build the canonical data-parallel SGD step for a flax model carrying a
     ``batch_stats`` (BatchNorm) collection.
@@ -50,6 +51,17 @@ def make_bn_dp_train_step(
     fused collective); ``Config(gradsync_compress="bf16")`` is honored on
     the gradient reduce_scatter exactly like the replicated path.
 
+    ``overlap`` (default: ``config.gradsync_overlap``) switches the
+    gradient computation to the backprop-overlapped schedule
+    (``gradsync.make_overlapped_grad_fn`` — docs/OVERLAP.md): each
+    reverse-parameter-order bucket's allreduce fires inside the
+    backward pass as its cotangents materialize, bit-identical
+    gradients to the post-backward path.  With ``zero=1``/``zero=3``
+    the overlapped (already-reduced) gradients reach the optimizer
+    through a local shard slice (``zero.update(presynced=True)``)
+    instead of a second reduce_scatter.  ``"off"`` (the default
+    default) leaves the dispatch byte-for-byte as before.
+
     ``zero=3`` additionally stores the PARAMETERS sharded between steps:
     the step's ``params`` argument is the flat shard from
     ``zero.shard_params(params, mesh=mesh)``, all-gathered transiently at
@@ -63,6 +75,12 @@ def make_bn_dp_train_step(
         raise ValueError(f"zero must be 0, 1, or 3, got {zero}")
     m = mesh if mesh is not None else runtime.current_mesh()
     axes = tuple(m.axis_names)
+    if overlap is None:
+        cfg0 = runtime.config() if runtime.is_initialized() else None
+        overlap = cfg0.gradsync_overlap if cfg0 is not None else "off"
+    if overlap not in ("off", "auto"):
+        raise ValueError(f"overlap must be off|auto, got {overlap!r}")
+    overlap_on = overlap == "auto"
     spec3 = None
     if zero == 3:
         if params_template is None:
@@ -96,18 +114,29 @@ def make_bn_dp_train_step(
                 logits, labels).mean()
             return loss, updated["batch_stats"]
 
-        (loss, new_stats), grads = jax.value_and_grad(
-            loss_fn, has_aux=True)(full)
+        if overlap_on:
+            # Backprop-overlapped schedule: the bucketed allreduces
+            # fire inside this value_and_grad's backward pass, so the
+            # grads come back already reduced (docs/OVERLAP.md).
+            (loss, new_stats), grads = _gradsync.make_overlapped_grad_fn(
+                loss_fn, full, axes, mesh=m, backend=backend,
+                has_aux=True)(full)
+        else:
+            (loss, new_stats), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(full)
         if zero == 3:
             params, opt_state = parallel_zero.update3(
                 params, grads, opt_state, tx, axes, spec=spec3,
-                backend=backend)
+                backend=backend, presynced=overlap_on)
         elif zero == 1:
             params, opt_state = parallel_zero.update(
-                full, grads, opt_state, tx, axes, backend=backend)
+                full, grads, opt_state, tx, axes, backend=backend,
+                presynced=overlap_on)
         else:
-            grads = nn.synchronize_gradients(grads, axes, backend=backend,
-                                             n_buckets=n_buckets)
+            if not overlap_on:
+                grads = nn.synchronize_gradients(grads, axes,
+                                                 backend=backend,
+                                                 n_buckets=n_buckets)
             updates, opt_state = tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
         new_stats = collectives.allreduce_in_axis(new_stats, axes, op="mean",

@@ -152,6 +152,19 @@ def _normalize_obs(value) -> Optional[str]:
     return None
 
 
+def _normalize_overlap(value) -> Optional[str]:
+    """Canonical gradsync_overlap mode for a config/env value:
+    "off"|"auto", with boolean-ish spellings accepted ("1"/"true"/
+    "yes"/"on" mean "auto", "0"/"false"/"no"/"" mean "off").  None =
+    unrecognized (the caller raises)."""
+    v = str(value).strip().lower()
+    if v in ("off", "0", "false", "no", "none", ""):
+        return "off"
+    if v in ("auto", "on", "1", "true", "yes"):
+        return "auto"
+    return None
+
+
 def _normalize_faults(value) -> str:
     """Canonical faults mode for a config/env value: "off", "policy",
     or a fault-plan path (kept verbatim).  Boolean-ish spellings map to
@@ -310,6 +323,23 @@ def init(config: Optional[Config] = None, **overrides) -> Mesh:
             raise ValueError(
                 f"config.ps_timeout_s must be >= 0 (0 disables), got "
                 f"{cfg.ps_timeout_s}")
+
+        # Backprop-overlapped gradient sync (docs/OVERLAP.md): same
+        # any-config env pickup + normalization as analysis/obs/faults.
+        if _normalize_overlap(cfg.gradsync_overlap) == "off":
+            cfg.gradsync_overlap = os.environ.get(
+                "TORCHMPI_TPU_GRADSYNC_OVERLAP", "off")
+        cfg.gradsync_overlap = _normalize_overlap(cfg.gradsync_overlap)
+        if cfg.gradsync_overlap is None:
+            raise ValueError(
+                "config.gradsync_overlap (or TORCHMPI_TPU_GRADSYNC_OVERLAP)"
+                " must be off|auto")
+        _env_default_pickup(cfg, "gradsync_overlap_bytes",
+                            "TORCHMPI_TPU_GRADSYNC_OVERLAP_BYTES", int)
+        if cfg.gradsync_overlap_bytes < 0:
+            raise ValueError(
+                f"config.gradsync_overlap_bytes must be >= 0 (0 = derive "
+                f"from the tuning plan), got {cfg.gradsync_overlap_bytes}")
 
         if cfg.coordinator_address is None:
             coord = os.environ.get("TORCHMPI_TPU_COORDINATOR")
@@ -500,6 +530,15 @@ def set_config(**kw) -> None:
                 raise ValueError("config.obs must be off|metrics|trace")
         if k == "faults":
             v = _normalize_faults(v)
+        if k == "gradsync_overlap":
+            v = _normalize_overlap(v)
+            if v is None:
+                raise ValueError("config.gradsync_overlap must be off|auto")
+        if k == "gradsync_overlap_bytes":
+            v = int(v)
+            if v < 0:
+                raise ValueError(
+                    "config.gradsync_overlap_bytes must be >= 0")
         if k == "ps_timeout_s":
             v = float(v)
             if v < 0:

@@ -35,6 +35,7 @@ from .autoselect import (  # noqa: F401
     plan,
     plan_lookup,
     resolve_eager,
+    plan_bucket_bytes,
     decisions,
     set_decision_logger,
     measurement_count,
@@ -48,6 +49,7 @@ __all__ = [
     "PLAN_VERSION", "DEFAULT_PLAN_PATH", "PlanCache", "PlanEntry",
     "resolve_plan_path", "measure_step", "noise_gate",
     "configure", "reset", "is_active", "plan", "plan_lookup",
-    "resolve_eager", "decisions", "set_decision_logger",
+    "resolve_eager", "plan_bucket_bytes", "decisions",
+    "set_decision_logger",
     "measurement_count", "reset_measurement_count", "DEFAULT_BACKEND",
 ]

@@ -305,3 +305,39 @@ def resolve_eager(op: str, nbytes: int, dtype, mesh,
         return new.backend
     finally:
         st.measuring = False
+
+
+def plan_bucket_bytes(op: str, mesh, fallback_bytes: int) -> int:
+    """Bucket byte bound for the gradsync overlap schedule, aligned to
+    the plan database's log2 size buckets (docs/OVERLAP.md).
+
+    The overlap schedule sizes its gradient buckets from the tuning
+    plan instead of a fixed ``n_buckets``: when the active plan holds
+    measured ``op`` entries for this platform+mesh, the bound is the
+    byte size of the LARGEST measured bucket not above
+    ``fallback_bytes`` — every fired bucket then keys to a plan entry
+    somebody actually measured.  With no plan (or no matching entries)
+    the bound is ``fallback_bytes`` rounded down to a bucket edge, so
+    the buckets still land on plan keys a future ``backend="auto"`` run
+    can fill in.
+    """
+    fallback_bytes = max(1, int(fallback_bytes))
+    edge = fingerprint.bucket_bytes(fingerprint.size_bucket(fallback_bytes))
+    cache = _state.cache
+    if cache is None:
+        return edge
+    prefix = (f"{fingerprint.platform_of(mesh)}|"
+              f"{fingerprint.mesh_key(mesh)}|{op}|")
+    best = None
+    for key in cache.entries:
+        if not key.startswith(prefix):
+            continue
+        _, _, tail = key.rpartition("|b")
+        try:
+            b = int(tail)
+        except ValueError:
+            continue
+        nbytes = fingerprint.bucket_bytes(b)
+        if nbytes <= edge and (best is None or nbytes > best):
+            best = nbytes
+    return best if best is not None else edge
