@@ -9,11 +9,19 @@ machine; fleets accumulate several (one per topology, or per job's
     python scripts/plan_tool.py prune plans.json --older-than-days 30
     python scripts/plan_tool.py prune plans.json --drop-match "ici:4"
     python scripts/plan_tool.py lint  a.json [b.json ...] [--json]
+    python scripts/plan_tool.py dump-live [--devices 8] [--exec f.py]
 
 ``show`` prints one line per entry (key, backend, evidence medians).
 ``merge`` unions entries (newer timestamp wins a key conflict) into OUT.
 ``prune`` drops entries by age and/or key substring, atomically
-rewriting the file.  ``lint`` validates plan files for cross-host
+rewriting the file.  ``dump-live`` prints the IN-PROCESS CollectivePlan
+table (``torchmpi_tpu/planner.py`` — the dispatch-path decision cache,
+distinct from the on-disk tuning-plan DB the other commands manage):
+it initializes a runtime, runs either ``--exec SCRIPT`` in-process or a
+small built-in warmup, and prints one line per live plan plus the
+hit/miss stats — the debugging surface for "is my hot path replaying
+or re-planning?".  Library code can call
+``torchmpi_tpu.planner.describe()`` directly for the same rows.  ``lint`` validates plan files for cross-host
 divergence hazards (the same fingerprint resolved to DIFFERENT backends
 in different files — two hosts of one job would pick different
 implementations for the same collective and deadlock; rule PL1, error)
@@ -173,6 +181,55 @@ def cmd_lint(args) -> int:
     return 1 if analysis.has_errors(findings) else 0
 
 
+def cmd_dump_live(args) -> int:
+    import json
+
+    if args.devices:
+        from torchmpi_tpu.utils.simulation import force_cpu_devices
+
+        force_cpu_devices(args.devices)
+    import numpy as np
+
+    import torchmpi_tpu as mpi
+    from torchmpi_tpu import planner
+
+    if args.exec_path:
+        import runpy
+
+        # Run the user's entry point in-process so its plans populate
+        # THIS interpreter's table (init() inside the script is fine —
+        # init is idempotent and dump-live adds none of its own).
+        runpy.run_path(args.exec_path, run_name="__main__")
+    else:
+        # Built-in warmup: a few representative eager dispatches (each
+        # second call is a hit, so the stats line shows replay working).
+        mpi.init()
+        n = mpi.device_count()
+        x = np.arange(n * 64, dtype=np.float32).reshape(n, 64)
+        for _ in range(2):
+            mpi.allreduce(x)
+            mpi.broadcast(x, root=0)
+            mpi.allreduce(x.astype(np.float16), op="sum")
+    rows = planner.describe()
+    st = planner.stats()
+    if args.json:
+        print(json.dumps({"stats": st, "plans": rows}, indent=1))
+    else:
+        for r in rows:
+            print(f"{r['kind']:13s} {r['op']:14s} "
+                  f"backend={r['backend'] or '-':13s} "
+                  f"{r['nbytes']:>10d} B  {r['launches']:3d} launches  "
+                  f"epoch={r['epoch']}  hits={r['hits']}  "
+                  f"build={r['build_ms']:.2f}ms"
+                  + ("  staged" if r["staged"] else "")
+                  + (f"  analysis={r['analysis']}"
+                     if r["analysis"] != "off" else ""))
+        print(f"{len(rows)} live plan(s); {st['hits']} hits / "
+              f"{st['misses']} misses / {st['invalidations']} "
+              f"invalidations this process")
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -202,6 +259,19 @@ def main(argv=None) -> int:
     s.add_argument("--json", action="store_true",
                    help="emit findings as JSON")
     s.set_defaults(fn=cmd_lint)
+
+    s = sub.add_parser("dump-live",
+                       help="print the in-process CollectivePlan table "
+                            "(runs --exec SCRIPT or a built-in warmup "
+                            "to populate it)")
+    s.add_argument("--devices", type=int, default=0,
+                   help="force N simulated CPU devices before init")
+    s.add_argument("--exec", dest="exec_path", default=None,
+                   help="python entry point to run in-process before "
+                        "dumping (its plans populate the table)")
+    s.add_argument("--json", action="store_true",
+                   help="emit the table as JSON")
+    s.set_defaults(fn=cmd_dump_live)
 
     args = p.parse_args(argv)
     return args.fn(args)

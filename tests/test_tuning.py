@@ -340,7 +340,10 @@ def test_auto_stable_across_runs_via_noise_gate(auto_runtime, monkeypatch):
         np.asarray(mpi.allreduce(rank_major()))
         key = list(tuning.plan().entries)[0]
         winners.append(tuning.plan().get(key).backend)
-        tuning.plan().entries.clear()  # force re-measure next run
+        tuning.plan().entries.clear()  # force re-measure next run...
+        mpi.collectives.clear_cache()  # ...incl. the CollectivePlan that
+        # would otherwise replay the first measurement (plan once,
+        # replay forever — docs/PLANNER.md)
     assert winners == ["xla", "xla"]
 
 

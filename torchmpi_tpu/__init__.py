@@ -54,6 +54,7 @@ from .runtime import (
 )
 from . import collectives
 from . import fusion
+from . import planner
 from . import selector
 from . import tuning
 from . import parallel
@@ -131,8 +132,8 @@ __all__ = [
     "device_count", "local_device_count", "barrier", "world_mesh",
     "current_mesh", "push_communicator", "pop_communicator", "communicator",
     "set_config", "config", "DCN_AXIS", "ICI_AXIS", "WORLD_AXES",
-    "collectives", "fusion", "selector", "tuning", "analysis", "obs",
-    "faults", "parallel",
+    "collectives", "fusion", "planner", "selector", "tuning", "analysis",
+    "obs", "faults", "parallel",
     "allreduce",
     "broadcast", "reduce",
     "allgather", "reduce_scatter", "sendreceive", "alltoall", "gather",

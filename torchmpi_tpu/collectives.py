@@ -47,7 +47,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
-from . import fusion, runtime, selector
+from . import fusion, planner, runtime, selector
 
 AxisNames = Union[str, Tuple[str, ...]]
 
@@ -362,6 +362,31 @@ def _obs_in_axis(op_name: str, x, axes: Tuple[str, ...]) -> None:
         obs.record_in_axis(op_name, selector.nbytes_of(x), axes)
 
 
+def _in_axis(op_name: str, x, axes: Tuple[str, ...],
+             backend: Optional[str], params: dict):
+    """Shared dispatch for the nine in-axis verbs: replay a cached
+    :class:`~torchmpi_tpu.planner.CollectivePlan` (one table lookup —
+    fusion bucketing, per-bucket/per-leaf backend choice, and obs
+    enablement all pre-resolved), or fall back to the legacy per-call
+    derivation for unplannable trees / a disabled planner."""
+    plan = planner.plan_in_axis(op_name, x, axes, backend, params)
+    if plan is not None:
+        return plan.replay(x)
+    _obs_in_axis(op_name, x, axes)
+    if op_name in fusion.ELEMENTWISE_OPS:
+        fused = fusion.maybe_fuse(op_name, x, axes, backend=backend,
+                                  **params)
+        if fused is not None:
+            return fused
+    elif op_name == "reduce_scatter":
+        fused = fusion.maybe_fuse_reduce_scatter(x, axes, backend=backend,
+                                                 **params)
+        if fused is not None:
+            return fused
+    return jax.tree.map(lambda v: _pick(op_name, v, backend, axes)(
+        v, axes, **params), x)
+
+
 def allreduce_in_axis(x, axis_names: AxisNames, *, op: str = "sum",
                       backend: Optional[str] = None):
     """Allreduce across mesh axes; for use inside shard_map (hot path).
@@ -369,116 +394,91 @@ def allreduce_in_axis(x, axis_names: AxisNames, *, op: str = "sum",
     Multi-leaf pytrees coalesce into dtype-grouped, size-bucketed flat
     transfers (``config.fuse_max_bytes``; one selector-routed collective
     per bucket, bit-identical results) instead of one launch per leaf —
-    see :mod:`torchmpi_tpu.fusion`."""
-    axes = _axes_tuple(axis_names)
-    _obs_in_axis("allreduce", x, axes)
-    fused = fusion.maybe_fuse("allreduce", x, axes, backend=backend, op=op)
-    if fused is not None:
-        return fused
-    return jax.tree.map(lambda v: _pick("allreduce", v, backend, axes)(
-        v, axes, op=op), x)
+    see :mod:`torchmpi_tpu.fusion`.  The whole decision (bucketing,
+    per-bucket backend, obs) is planned once per tree structure and
+    replayed (:mod:`torchmpi_tpu.planner`)."""
+    return _in_axis("allreduce", x, _axes_tuple(axis_names), backend,
+                    {"op": op})
 
 
 def broadcast_in_axis(x, axis_names: AxisNames, *, root: int = 0,
                       backend: Optional[str] = None):
-    axes = _axes_tuple(axis_names)
-    _obs_in_axis("broadcast", x, axes)
-    fused = fusion.maybe_fuse("broadcast", x, axes, backend=backend,
-                              root=root)
-    if fused is not None:
-        return fused
-    return jax.tree.map(lambda v: _pick("broadcast", v, backend, axes)(
-        v, axes, root=root), x)
+    return _in_axis("broadcast", x, _axes_tuple(axis_names), backend,
+                    {"root": root})
 
 
 def reduce_in_axis(x, axis_names: AxisNames, *, root: int = 0, op: str = "sum",
                    backend: Optional[str] = None):
-    axes = _axes_tuple(axis_names)
-    _obs_in_axis("reduce", x, axes)
-    fused = fusion.maybe_fuse("reduce", x, axes, backend=backend,
-                              root=root, op=op)
-    if fused is not None:
-        return fused
-    return jax.tree.map(lambda v: _pick("reduce", v, backend, axes)(
-        v, axes, root=root, op=op), x)
+    return _in_axis("reduce", x, _axes_tuple(axis_names), backend,
+                    {"root": root, "op": op})
 
 
 def allgather_in_axis(x, axis_names: AxisNames, *,
                       backend: Optional[str] = None):
-    axes = _axes_tuple(axis_names)
-    _obs_in_axis("allgather", x, axes)
-    return jax.tree.map(lambda v: _pick("allgather", v, backend, axes)(
-        v, axes), x)
+    return _in_axis("allgather", x, _axes_tuple(axis_names), backend, {})
 
 
 def reduce_scatter_in_axis(x, axis_names: AxisNames, *, op: str = "sum",
                            backend: Optional[str] = None):
-    axes = _axes_tuple(axis_names)
-    _obs_in_axis("reduce_scatter", x, axes)
-    fused = fusion.maybe_fuse_reduce_scatter(x, axes, backend=backend,
-                                             op=op)
-    if fused is not None:
-        return fused
-    return jax.tree.map(lambda v: _pick("reduce_scatter", v, backend, axes)(
-        v, axes, op=op), x)
+    return _in_axis("reduce_scatter", x, _axes_tuple(axis_names), backend,
+                    {"op": op})
 
 
 def gather_in_axis(x, axis_names: AxisNames, *, root: int = 0,
                    backend: Optional[str] = None):
-    axes = _axes_tuple(axis_names)
-    _obs_in_axis("gather", x, axes)
-    return jax.tree.map(lambda v: _pick("gather", v, backend, axes)(
-        v, axes, root=root), x)
+    return _in_axis("gather", x, _axes_tuple(axis_names), backend,
+                    {"root": root})
 
 
 def scatter_in_axis(x, axis_names: AxisNames, *, root: int = 0,
                     backend: Optional[str] = None):
-    axes = _axes_tuple(axis_names)
-    _obs_in_axis("scatter", x, axes)
-    return jax.tree.map(lambda v: _pick("scatter", v, backend, axes)(
-        v, axes, root=root), x)
+    return _in_axis("scatter", x, _axes_tuple(axis_names), backend,
+                    {"root": root})
 
 
 def sendreceive_in_axis(x, axis_names: AxisNames, *, src: int, dst: int,
                         backend: Optional[str] = None):
-    axes = _axes_tuple(axis_names)
-    _obs_in_axis("sendreceive", x, axes)
-    return jax.tree.map(lambda v: _pick("sendreceive", v, backend, axes)(
-        v, axes, src=src, dst=dst), x)
+    return _in_axis("sendreceive", x, _axes_tuple(axis_names), backend,
+                    {"src": src, "dst": dst})
 
 
 def alltoall_in_axis(x, axis_names: AxisNames, *, split_axis: int = 0,
                      concat_axis: int = 0, backend: Optional[str] = None):
-    axes = _axes_tuple(axis_names)
-    _obs_in_axis("alltoall", x, axes)
-    return jax.tree.map(lambda v: _pick("alltoall", v, backend, axes)(
-        v, axes, split_axis=split_axis, concat_axis=concat_axis), x)
+    return _in_axis("alltoall", x, _axes_tuple(axis_names), backend,
+                    {"split_axis": split_axis, "concat_axis": concat_axis})
 
 
 # ---------------------------------------------------------------------------
 # Eager rank-major mode (TorchMPI tensor semantics; tests + micro-bench).
-# Compiled executables are cached per (op, mesh, backend, shape, dtype,
-# params) — the analog of the reference's resource cache (SURVEY §8.4.5).
+# The analog of the reference's resource cache (SURVEY §8.4.5) is now
+# the CollectivePlan table (torchmpi_tpu/planner.py): one immutable
+# plan per (op, avals, mesh, backend, params, config-epoch) holding the
+# resolved implementation, compiled executable, cached rank-major
+# sharding, and pre-resolved obs/faults enablement.  The module-level
+# names below are compatibility aliases into that table.
 # ---------------------------------------------------------------------------
 
-_jit_cache: Dict[Any, Any] = {}
+_jit_cache: Dict[Any, Any] = planner._table  # alias: THE plan table
 
-# Rank-major NamedSharding per mesh: building one costs Python-side
-# work on EVERY eager dispatch (the hot path of the rank-major mode);
-# meshes are few and hashable, so it is cached like the executables.
-_sharding_cache: Dict[Mesh, NamedSharding] = {}
+# Rank-major NamedSharding per mesh, cached in the planner (building
+# one costs Python-side work on EVERY eager dispatch).
+_sharding_cache: Dict[Mesh, NamedSharding] = planner._shardings
+
+# Executables of the pre-planner dispatch path (kept for
+# `planner.set_enabled(False)` — the --plan-compare bench baseline and
+# the bit-identity tests).
+_legacy_jit_cache: Dict[Any, Any] = {}
 
 
 def clear_cache() -> None:
-    _jit_cache.clear()
-    _sharding_cache.clear()
+    """Drop every cached collective plan (and legacy executable) — the
+    single invalidation point (``planner.invalidate``)."""
+    planner.invalidate()
+    _legacy_jit_cache.clear()
 
 
 def _rank_major_sharding(m: Mesh) -> NamedSharding:
-    s = _sharding_cache.get(m)
-    if s is None:
-        s = _sharding_cache[m] = NamedSharding(m, P(m.axis_names))
-    return s
+    return planner.rank_major_sharding(m)
 
 
 def _mesh_and_n(mesh: Optional[Mesh]) -> Tuple[Mesh, int]:
@@ -638,6 +638,22 @@ def _eager_collective(op_name: str, x, *, mesh: Optional[Mesh] = None,
     m, n = _mesh_and_n(mesh)
     x = jnp.asarray(x)
     _check_rank_axis(op_name, x.shape, n)
+    if planner.enabled():
+        # The steady-state hot path: one plan-table lookup, then the
+        # pre-bound replay (impl/executable/sharding/obs/faults all
+        # resolved at build — docs/PLANNER.md).
+        return planner.plan_for(op_name, x, m, n, backend, params).replay(x)
+    return _eager_collective_unplanned(op_name, x, m, n, backend=backend,
+                                       **params)
+
+
+def _eager_collective_unplanned(op_name: str, x, m: Mesh, n: int, *,
+                                backend: Optional[str] = None, **params):
+    """The pre-planner dispatch path, preserved verbatim: every call
+    re-derives staged/auto/selector/obs decisions in sequence and only
+    the compiled executable is memoized.  Runs only under
+    ``planner.set_enabled(False)`` — the ``--plan-compare`` baseline
+    and the planned-vs-unplanned bit-identity tests."""
     # ONE config read per dispatch (it feeds the staged check, the
     # "auto" trigger, and _pick's cutover below — re-reading it three
     # times was measurable Python overhead on the eager hot path).
@@ -678,7 +694,7 @@ def _eager_collective(op_name: str, x, *, mesh: Optional[Mesh] = None,
     _obs_record_eager(cfg, op_name, x, m, impl=impl)
     key = (op_name, m, impl, x.shape, x.dtype.name,
            tuple(sorted(params.items())))
-    entry = _jit_cache.get(key)
+    entry = _legacy_jit_cache.get(key)
     if entry is None:
 
         def body(xs):
@@ -707,7 +723,7 @@ def _eager_collective(op_name: str, x, *, mesh: Optional[Mesh] = None,
                 f"eager {op_name}", shmapped,
                 jax.ShapeDtypeStruct(x.shape, x.dtype), mode=mode)
         entry = (jax.jit(shmapped), _rank_major_sharding(m))
-        _jit_cache[key] = entry
+        _legacy_jit_cache[key] = entry
     fn, sharding = entry
     return fn(_place_rank_major(x, m, sharding))
 

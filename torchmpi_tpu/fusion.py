@@ -243,7 +243,8 @@ def _unpack_group(flat, g: _DtypeGroup, out_leaves: List) -> None:
 
 def fuse_tree(op_name: str, tree: PyTree, axes: Tuple[str, ...], *,
               backend: Optional[str] = None, barrier: bool = False,
-              spec: Optional[FusedSpec] = None, **params) -> PyTree:
+              spec: Optional[FusedSpec] = None,
+              impls: Optional[Sequence] = None, **params) -> PyTree:
     """One selector-routed collective per (dtype group x bucket).
 
     ``barrier=True`` chains each bucket's input on the previous bucket's
@@ -254,6 +255,11 @@ def fuse_tree(op_name: str, tree: PyTree, axes: Tuple[str, ...], *,
     the previous group's last), so ALL buckets stay distinct through
     XLA's all-reduce combiner, exactly as the old single-concat chain
     kept them.
+
+    ``impls`` is the planner's replay mode (torchmpi_tpu/planner.py):
+    one pre-picked implementation per bucket, in this function's
+    iteration order (group-major, then bucket order) — the per-bucket
+    ``_pick`` is then skipped entirely.
     """
     from .collectives import _pick  # lazy: collectives imports us
 
@@ -263,6 +269,7 @@ def fuse_tree(op_name: str, tree: PyTree, axes: Tuple[str, ...], *,
     out_leaves: List = [None] * spec.n_leaves
     prev = None
     links = 0
+    launch = 0
     for g in spec.groups:
         flat = group_flat(leaves, g)
         parts = []
@@ -271,7 +278,9 @@ def fuse_tree(op_name: str, tree: PyTree, axes: Tuple[str, ...], *,
             if barrier and prev is not None:
                 part, _ = lax.optimization_barrier((part, prev))
                 links += 1
-            impl = _pick(op_name, part, backend, axes)
+            impl = (impls[launch] if impls is not None
+                    else _pick(op_name, part, backend, axes))
+            launch += 1
             prev = impl(part, axes, **params)
             parts.append(prev)
         gout = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
@@ -345,8 +354,6 @@ def maybe_fuse_reduce_scatter(tree: PyTree, axes: Tuple[str, ...], *,
     same precondition the per-leaf tiled scatter imposes); trees that
     do not satisfy it fall back per-leaf.
     """
-    from .collectives import _pick  # lazy: collectives imports us
-
     max_bytes = runtime.effective_config().fuse_max_bytes
     if max_bytes <= 0:
         return None
@@ -365,14 +372,34 @@ def maybe_fuse_reduce_scatter(tree: PyTree, axes: Tuple[str, ...], *,
     n_launches = sum(len(g.leaf_buckets) for g in spec.groups)
     if n_launches >= spec.n_leaves:
         return None
+    return fused_reduce_scatter(tree, axes, spec=spec, n=n,
+                                backend=backend, op=op)
+
+
+def fused_reduce_scatter(tree: PyTree, axes: Tuple[str, ...], *,
+                         spec: FusedSpec, n: int,
+                         backend: Optional[str] = None,
+                         impls: Optional[Sequence] = None,
+                         op: str = "sum") -> PyTree:
+    """Execute the fused tile-interleaved reduce_scatter for a tree
+    whose layout decision (``spec``, and optionally the per-bucket
+    ``impls`` in group-major leaf-bucket order — the planner's replay
+    mode) was already taken; ``n`` is the spanned axis-size product the
+    tiling divides by."""
+    from .collectives import _pick  # lazy: collectives imports us
+
+    leaves = jax.tree.leaves(tree)
     out_leaves: List = [None] * spec.n_leaves
+    launch = 0
     for g in spec.groups:
         for bucket in g.leaf_buckets:
             tiles = [leaves[g.indices[pos]].reshape(n, -1)
                      for pos in bucket]
             flat = (tiles[0] if len(tiles) == 1
                     else jnp.concatenate(tiles, axis=1)).reshape(-1)
-            impl = _pick("reduce_scatter", flat, backend, axes)
+            impl = (impls[launch] if impls is not None
+                    else _pick("reduce_scatter", flat, backend, axes))
+            launch += 1
             shard = impl(flat, axes, op=op)
             off = 0
             for pos in bucket:

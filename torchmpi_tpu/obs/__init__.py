@@ -278,6 +278,50 @@ def record_eager(op: str, nbytes: int, backend: str, mesh,
     _recorder.append("eager", op, nbytes, backend, detail)
 
 
+def eager_recorder(op: str, nbytes: int, backend: str, mesh, dtype):
+    """Pre-bound per-dispatch recorder for one eager CollectivePlan
+    (torchmpi_tpu/planner.py): label-equivalent to :func:`record_eager`
+    but with the label keys resolved ONCE at plan build, so the
+    replay-path cost is three pre-keyed registry updates plus the
+    flight-ring append.  The recorder reads the module-level mode/ring
+    per call, so trace-mode attribution and ring resizes stay live."""
+    mk = mesh_label(mesh)
+    labels = dict(op=op, backend=backend, mesh=mk,
+                  dtype=str(dtype) if dtype is not None else "",
+                  nbytes_bucket=f"b{log2_bucket(nbytes)}")
+    inc_calls = _registry.counter_handle("tm_collectives_total", **labels)
+    inc_bytes = _registry.counter_handle("tm_collective_bytes_total",
+                                         **labels)
+    obs_bytes = _registry.hist_handle("tm_collective_nbytes", op=op,
+                                      backend=backend, mesh=mk)
+
+    def record() -> None:
+        inc_calls()
+        inc_bytes(nbytes)
+        obs_bytes(nbytes)
+        detail = f"{mk} @{_call_site()}" if _mode == "trace" else mk
+        _recorder.append("eager", op, nbytes, backend, detail)
+
+    return record
+
+
+def record_plan(event: str, op: str, kind: str = "",
+                build_s: Optional[float] = None) -> None:
+    """One CollectivePlan table event (docs/PLANNER.md): ``event`` is
+    ``hit`` | ``miss`` (counter ``tm_plan_<event>_total``, labeled by
+    op and plan kind).  A miss — a plan build — also lands its build
+    latency on the ``tm_plan_build_seconds`` histogram and a ``plan``
+    flight-ring event, so post-mortems can see re-planning churn right
+    next to the collectives it delayed.  (Steady-state hits are counted
+    through per-plan pre-bound handles; this function is the build-side
+    and tooling entry point.)"""
+    _registry.counter_inc(f"tm_plan_{event}_total", op=op, kind=kind)
+    if build_s is not None:
+        _registry.hist_observe("tm_plan_build_seconds", build_s, op=op)
+    if event == "miss":
+        _recorder.append("plan", op, 0, kind, "build")
+
+
 def record_in_axis(op: str, nbytes: int, axes) -> None:
     """One in-axis collective call (trace-time: counts program builds,
     not steady-state executions — jit replays don't re-enter)."""

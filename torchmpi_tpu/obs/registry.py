@@ -77,6 +77,36 @@ class Registry:
                 h = self._hists[key] = _Hist()
             h.observe(value)
 
+    def counter_handle(self, name: str, **labels):
+        """Pre-resolved increment handle for ONE counter series: the
+        (name, labels) key is built once, so the per-event cost is a
+        lock + dict update.  The planner's replay-path discipline —
+        every label a CollectivePlan emits is static per plan, so the
+        key resolution moves to plan-build time."""
+        key = (name, _label_key(labels))
+        lock, counters = self._lock, self._counters
+
+        def inc(value: float = 1) -> None:
+            with lock:
+                counters[key] = counters.get(key, 0) + value
+
+        return inc
+
+    def hist_handle(self, name: str, **labels):
+        """Pre-resolved observe handle for ONE histogram series (the
+        histogram sibling of :meth:`counter_handle`)."""
+        key = (name, _label_key(labels))
+        lock, hists = self._lock, self._hists
+
+        def observe(value: float) -> None:
+            with lock:
+                h = hists.get(key)
+                if h is None:
+                    h = hists[key] = _Hist()
+                h.observe(value)
+
+        return observe
+
     def clear(self) -> None:
         with self._lock:
             self._counters.clear()

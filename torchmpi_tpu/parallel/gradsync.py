@@ -29,7 +29,7 @@ import numpy as np
 from jax import lax, shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .. import collectives, fusion, runtime
+from .. import collectives, fusion, planner, runtime
 
 PyTree = Any
 AxisNames = Union[str, Tuple[str, ...]]
@@ -127,9 +127,17 @@ def _bucketed_allreduce(grads: PyTree, axes: Tuple[str, ...], *, op: str,
     bucket i+1's collective.  The cost is serialization of the
     collectives themselves; leave it off when one fused all-reduce is
     fastest (small models).
+
+    The bucketing spec and per-bucket backend choices are planned once
+    per gradient-tree structure and replayed across step builds
+    (:func:`torchmpi_tpu.planner.plan_gradsync`).
     """
     if not jax.tree.leaves(grads):
         return grads
+    plan = planner.plan_gradsync(grads, axes, op=op, n_buckets=n_buckets,
+                                 backend=backend, barrier=barrier)
+    if plan is not None:
+        return plan.replay(grads)
     spec = fusion.FusedSpec(grads, n_buckets=n_buckets)
     return fusion.fuse_tree("allreduce", grads, axes, backend=backend,
                             barrier=barrier, spec=spec, op=op)
@@ -255,14 +263,17 @@ def assign_overlap_buckets(leaves: Sequence, max_bytes: int
 
 def _make_bucket_sync(idx: int, total: int, axes: Tuple[str, ...],
                       op: str, backend: Optional[str],
-                      compress: Optional[str]):
+                      compress: Optional[str],
+                      impl: Optional[Callable] = None):
     """One bucket's sync op: identity in forward, THE bucket's
     allreduce in backward.  ``token`` threads the optimization-barrier
     chain across buckets: the backward rule barriers its allreduce
     input on the incoming token (the previous-fired bucket's launch)
     and derives its outgoing token from the allreduce result — so the
     collectives stay distinct through the combiner and issue in firing
-    order, each eligible the moment its cotangents exist."""
+    order, each eligible the moment its cotangents exist.  ``impl`` is
+    the planner's pre-picked allreduce implementation for this bucket
+    (None falls back to a per-trace selector pick)."""
 
     @jax.custom_vjp
     def sync(xs, token):
@@ -300,9 +311,11 @@ def _make_bucket_sync(idx: int, total: int, axes: Tuple[str, ...],
                 lambda *_a, _o=obs, _k=idx, _t=total:
                 _o.record_overlap("launch", _k, _t),
                 flat[:1])
-        impl = collectives._pick(  # noqa: SLF001 — shared selector route
-            "allreduce", flat, backend, axes)
-        red = impl(flat, axes, op=op)
+        bucket_impl = impl
+        if bucket_impl is None:
+            bucket_impl = collectives._pick(  # noqa: SLF001 — shared route
+                "allreduce", flat, backend, axes)
+        red = bucket_impl(flat, axes, op=op)
         if compress == "bf16":
             red = red.astype(orig_dtype)
         anchor = red[0] if sum(sizes) else tok
@@ -369,9 +382,21 @@ def make_overlapped_grad_fn(loss_fn: Callable, params_template: PyTree,
         raise ValueError("make_overlapped_grad_fn: empty parameter tree")
     if max_bytes is None:
         max_bytes = overlap_bucket_bytes(mesh)
-    firing = assign_overlap_buckets(template_leaves, max_bytes)
+    # Bucket assignment + per-bucket backend choice, planned once per
+    # (template avals, axes, knobs) and replayed across builder calls
+    # (torchmpi_tpu/planner.py — a decision-only plan).
+    oplan = planner.plan_overlap(template_leaves, axes, op=op,
+                                 backend=backend, compress=compress,
+                                 max_bytes=max_bytes)
+    if oplan is not None:
+        firing = oplan.extra["firing"]
+        bucket_impls: Sequence[Optional[Callable]] = oplan.impls
+    else:
+        firing = assign_overlap_buckets(template_leaves, max_bytes)
+        bucket_impls = [None] * len(firing)
     total = len(firing)
-    syncs = [_make_bucket_sync(k, total, axes, op, backend, compress)
+    syncs = [_make_bucket_sync(k, total, axes, op, backend, compress,
+                               impl=bucket_impls[k])
              for k in range(total)]
     if cfg is not None and cfg.obs != "off":
         from .. import obs

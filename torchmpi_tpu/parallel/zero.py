@@ -53,7 +53,7 @@ import optax
 from jax import lax, shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
-from .. import collectives, fusion, runtime
+from .. import collectives, fusion, planner, runtime
 
 PyTree = Any
 AxisNames = Union[str, Tuple[str, ...]]
@@ -82,8 +82,15 @@ def _axis_index(axes: Tuple[str, ...]):
 
 # The flatten/pad/shard machinery is the fusion layer's FusedSpec — one
 # definition shared with the fused in-axis collectives and the bucketed
-# allreduce (torchmpi_tpu/fusion.py).
+# allreduce (torchmpi_tpu/fusion.py).  Construction goes through the
+# planner's structure-keyed cache: every step build of the same
+# parameter tree replays one FusedSpec instead of re-deriving the
+# group/pad/shard layout per trace (torchmpi_tpu/planner.py).
 _FlatSpec = fusion.FusedSpec
+
+
+def _spec_for(tree, n_shards: int) -> fusion.FusedSpec:
+    return planner.flat_spec_for(tree, int(n_shards))
 
 
 def _local_shard(params: PyTree, spec: _FlatSpec,
@@ -126,7 +133,7 @@ def state_specs(params: PyTree, tx: optax.GradientTransformation,
     replicated.  Shared by :func:`init` and step builders that thread the
     state through their own shard_map."""
     m, axes, n = _resolve(axis_names, mesh)
-    spec = _FlatSpec(params, n)
+    spec = _spec_for(params, n)
     shard_shape = jax.ShapeDtypeStruct((spec.shard,), spec.dtype)
     state_shapes = jax.eval_shape(tx.init, shard_shape)
     return specs_like(state_shapes, axes)
@@ -143,7 +150,7 @@ def init(params: PyTree, tx: optax.GradientTransformation,
     train step.
     """
     m, axes, n = _resolve(axis_names, mesh)
-    spec = _FlatSpec(params, n)
+    spec = _spec_for(params, n)
     specs = state_specs(params, tx, axes, mesh=m)
 
     def body(params):
@@ -188,7 +195,7 @@ def update(params: PyTree, grads: PyTree, opt_state: PyTree,
         axis_names = tuple(runtime.current_mesh().axis_names)
     axes = _axes_tuple(axis_names)
     if presynced:
-        spec = _FlatSpec(params, int(_axis_size(axes)))
+        spec = _spec_for(params, int(_axis_size(axes)))
         g_shard = _local_shard(grads, spec, axes)
     else:
         g_shard, spec = _reduce_scatter_grads(grads, axes, spec=None,
@@ -229,7 +236,7 @@ def _reduce_scatter_grads(grads: PyTree, axes: Tuple[str, ...], *,
 
     n = _axis_size(axes)
     if spec is None:
-        spec = _FlatSpec(params, int(n))
+        spec = _spec_for(params, int(n))
     if cfg is not None and cfg.obs != "off":
         from .. import obs
 
@@ -279,7 +286,7 @@ def flat_spec(params: PyTree, axis_names: Optional[AxisNames] = None, *,
     between the flat shard and the structured pytree.  Build it OUTSIDE
     jit from the real (or eval_shape'd) parameter pytree."""
     _, _, n = _resolve(axis_names, mesh)
-    return _FlatSpec(params, n)
+    return _spec_for(params, n)
 
 
 def shard_params(params: PyTree, axis_names: Optional[AxisNames] = None, *,

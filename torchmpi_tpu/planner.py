@@ -1,0 +1,669 @@
+"""CollectivePlan: one cached planner for the whole dispatch path.
+
+TorchMPI's core performance trick was a *resource cache* (SURVEY.md
+§8.4.5): plan a collective once — buffers, communicator, algorithm —
+and replay the plan on every later call.  Five subsystems grew around
+this library's dispatch path (tuning, fusion, analysis, obs, faults,
+overlap) and each call used to re-derive its decisions from all of them
+in sequence: fusion grouping, ``selector.nbytes_of`` tree walks,
+tuning-plan lookups, the static cutover, then per-site obs/faults
+string compares — with only the compiled executable memoized ad hoc.
+
+This module lifts the full decision record into an explicit, immutable
+:class:`CollectivePlan`, computed once per key and replayed thereafter:
+
+- **key** — ``(kind, op, pytree structure + leaf avals, mesh, backend,
+  static params, config epoch)``.  Two calls with the same tree
+  *structure* but different values share a plan; a different mesh, a
+  pushed communicator, or any :func:`runtime.set_config` (which bumps
+  the epoch) misses and re-plans.
+- **record** — the dtype-grouped fusion buckets with precomputed nbytes
+  and layouts (:class:`~torchmpi_tpu.fusion.FusedSpec`), the selector/
+  tuning backend choice *per bucket*, the cached rank-major sharding,
+  the compiled executable (eager mode), the static-analysis verdict,
+  and pre-resolved obs/faults enablement — so "off" costs zero
+  branches at replay (one ``is None`` check), not one string compare
+  per layer per site.
+- **replay** — the minimal residual work: one table lookup, then the
+  pre-bound closure.
+
+Consumers: ``collectives._eager_collective`` and the nine ``*_in_axis``
+verbs (hence ``async_`` / ``async_in_axis`` on top of them),
+``gradsync.synchronize_gradients`` / ``make_overlapped_grad_fn``, and
+the ZeRO flatten/reduce-scatter leg.  Invalidation has ONE point:
+:func:`invalidate` (``collectives.clear_cache`` and ``runtime.stop``
+route here; ``set_config`` bumps the epoch *and* routes here) — the
+seam serving, elasticity, and cross-slice topology (ROADMAP items 2-4)
+hang their lifecycle off.  See docs/PLANNER.md.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+from jax import lax, shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from . import fusion, runtime, selector
+
+# ---------------------------------------------------------------------------
+# The plan table: the ONE cache behind the dispatch path (it subsumes
+# the old ad-hoc collectives._jit_cache / _sharding_cache pair).  Reads
+# are lock-free dict gets (GIL-atomic); builds run under an RLock —
+# re-entrant because building an eager backend="auto" plan measures
+# candidates by dispatching them, which plans recursively.
+# ---------------------------------------------------------------------------
+
+_lock = threading.RLock()
+_table: Dict[tuple, "CollectivePlan"] = {}
+_shardings: Dict[Mesh, NamedSharding] = {}
+_enabled = True
+_stats = {"hits": 0, "misses": 0, "invalidations": 0}
+
+
+class CollectivePlan:
+    """Immutable decision record for one collective dispatch site.
+
+    Built once by the ``plan_*`` functions below, then replayed: the
+    fields are assigned at construction and never mutated afterwards
+    (``hits`` is the one bookkeeping exception).  ``replay`` runs the
+    pre-bound execution closure; decision-only plans (kinds
+    ``overlap`` / ``flatspec``) carry no closure and are consumed via
+    ``spec`` / ``impls`` / ``extra`` instead.
+    """
+
+    __slots__ = ("key", "kind", "op", "backend", "nbytes", "spec", "impls",
+                 "extra", "staged", "obs", "faults", "analysis", "epoch",
+                 "build_seconds", "hits", "_replay", "_obs_hit")
+
+    def __init__(self, key: tuple, kind: str, op: str, *,
+                 backend: str = "", nbytes: int = 0,
+                 spec: Optional[fusion.FusedSpec] = None,
+                 impls: Optional[List[Callable]] = None,
+                 extra: Optional[dict] = None,
+                 staged: bool = False, obs: bool = False,
+                 faults: bool = False, analysis: str = "off",
+                 replay: Optional[Callable] = None) -> None:
+        self.key = key
+        self.kind = kind
+        self.op = op
+        self.backend = backend
+        self.nbytes = int(nbytes)
+        self.spec = spec
+        self.impls = impls
+        self.extra = extra or {}
+        self.staged = bool(staged)
+        self.obs = bool(obs)
+        self.faults = bool(faults)
+        self.analysis = analysis
+        self.epoch = runtime.config_epoch()
+        self.build_seconds = 0.0
+        self.hits = 0
+        self._replay = replay
+        # Pre-bound hit counter (one dict op per replay when obs is on,
+        # nothing at all when off — resolved at build, like every other
+        # decision in the record).
+        self._obs_hit: Optional[Callable] = None
+        if self.obs:
+            from . import obs as _obs
+
+            self._obs_hit = _obs.registry().counter_handle(
+                "tm_plan_hit_total", op=op, kind=kind)
+
+    def replay(self, x):
+        """Execute the planned dispatch for one same-structure input."""
+        return self._replay(x)
+
+    def describe(self) -> dict:
+        """JSON-ready row for ``plan_tool.py dump-live`` / debugging."""
+        return {
+            "kind": self.kind, "op": self.op, "backend": self.backend,
+            "nbytes": self.nbytes,
+            "launches": (len(self.impls) if self.impls
+                         else (self.spec.n_launches
+                               if self.spec is not None else 1)),
+            "staged": self.staged, "obs": self.obs, "faults": self.faults,
+            "analysis": self.analysis, "epoch": self.epoch,
+            "build_ms": round(self.build_seconds * 1e3, 3),
+            "hits": self.hits,
+        }
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def set_enabled(flag: bool) -> bool:
+    """Switch the planner off (the pre-planner dispatch path runs
+    instead) or back on.  Exists for the ``--plan-compare`` bench mode
+    and the bit-identity tests; production code leaves it on.  Returns
+    the previous value."""
+    global _enabled
+    prev, _enabled = _enabled, bool(flag)
+    return prev
+
+
+def invalidate() -> None:
+    """THE invalidation point: drop every plan and cached sharding.
+
+    ``collectives.clear_cache()`` and ``runtime.stop()`` route here, as
+    does ``runtime.set_config`` (via clear_cache, after bumping the
+    config epoch).  Mesh identity changes need no explicit call — the
+    mesh object is part of every key — but a caller tearing down a mesh
+    can invalidate() to release the plans pinned to it.  Clears IN
+    PLACE so module-level aliases of the table stay live."""
+    with _lock:
+        _table.clear()
+        _shardings.clear()
+        _stats["invalidations"] += 1
+    # The preserved pre-planner executables (collectives._legacy_jit_cache)
+    # pin compiled programs + mesh references too; a lifecycle caller
+    # invoking invalidate() directly (docs/PLANNER.md) must drop them as
+    # well.  sys.modules lookup, not an import: no cycle with collectives.
+    import sys
+
+    mod = sys.modules.get(__package__ + ".collectives")
+    if mod is not None:
+        mod._legacy_jit_cache.clear()
+
+
+def stats() -> dict:
+    """Cumulative table stats: ``hits`` / ``misses`` / ``entries`` /
+    ``invalidations`` (process-level; survive invalidate())."""
+    return dict(_stats, entries=len(_table))
+
+
+def reset_stats() -> None:
+    _stats["hits"] = 0
+    _stats["misses"] = 0
+    _stats["invalidations"] = 0
+
+
+def describe() -> List[dict]:
+    """One JSON-ready row per live plan (``plan_tool.py dump-live``)."""
+    with _lock:
+        return [p.describe() for p in _table.values()]
+
+
+def rank_major_sharding(m: Mesh) -> NamedSharding:
+    """Cached rank-major NamedSharding per mesh (part of every eager
+    plan; also consulted by the staged/async placement paths)."""
+    s = _shardings.get(m)
+    if s is None:
+        s = _shardings[m] = NamedSharding(m, P(m.axis_names))
+    return s
+
+
+# ---------------------------------------------------------------------------
+# Shared lookup/build plumbing
+# ---------------------------------------------------------------------------
+
+
+def _lookup(key: tuple) -> Optional[CollectivePlan]:
+    plan = _table.get(key)
+    if plan is not None:
+        _stats["hits"] += 1
+        plan.hits += 1
+        if plan._obs_hit is not None:
+            plan._obs_hit()
+    return plan
+
+
+def _get_or_build(key: tuple, builder: Callable[[], CollectivePlan]
+                  ) -> CollectivePlan:
+    """Lock-free hit, else build-and-insert under the planner lock.
+
+    Builds are deliberately serialized (one at a time, lock held across
+    the builder): a build can run a tuning backend="auto" measurement,
+    and a concurrent build racing past tuning's ``measuring`` flag
+    would freeze a statically-resolved backend into an auto plan and
+    replay it forever.  The cost — a cold dispatch on another thread
+    waits for an in-flight build — is a cold-start-only stall; the
+    steady state never takes this lock.
+    """
+    plan = _lookup(key)
+    if plan is not None:
+        return plan
+    with _lock:
+        plan = _lookup(key)  # double-check: lost the build race
+        if plan is not None:
+            return plan
+        t0 = time.monotonic()
+        plan = builder()
+        plan.build_seconds = time.monotonic() - t0
+        _table[key] = plan
+    _stats["misses"] += 1
+    if plan.obs:
+        from . import obs
+
+        obs.record_plan("miss", plan.op, kind=plan.kind,
+                        build_s=plan.build_seconds)
+    return plan
+
+
+def _epoch() -> tuple:
+    """The staleness component of every plan key: the config epoch
+    (init/set_config/stop bumps) plus the selector registry generation
+    (a runtime re-register strands plans that resolved the old impl —
+    the planner analog of the legacy cache keying on the impl object)."""
+    return (runtime.config_epoch(), selector.generation())
+
+
+def _cfg():
+    return runtime.config() if runtime.is_initialized() else None
+
+
+def _avals(leaves) -> Optional[tuple]:
+    """Hashable (shape, dtype) signature of a leaf list; None when some
+    leaf is not array-like (python scalars) — unplannable, the caller
+    falls back to the legacy path."""
+    out = []
+    for leaf in leaves:
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is None or dtype is None:
+            return None
+        try:
+            out.append((tuple(int(d) for d in shape), np.dtype(dtype).name))
+        except (TypeError, ValueError):
+            return None  # polymorphic/abstract dims
+    return tuple(out)
+
+
+def _axis_sizes(axes: Tuple[str, ...]) -> Optional[Tuple[int, ...]]:
+    """The bound sizes of ``axes`` in the current trace context, or
+    None outside any binding.  Part of every in-axis key: the same axis
+    NAMES can be bound to different sizes by different user meshes, and
+    a fused layout planned for one must never replay for the other."""
+    try:
+        return tuple(int(lax.axis_size(a)) for a in axes)
+    except Exception:  # noqa: BLE001 — outside an axis binding
+        return None
+
+
+def _in_axis_recorder(cfg, op: str, nbytes: int, axes) -> Optional[Callable]:
+    """Pre-resolved in-axis obs hook: None when obs is off (the replay
+    then pays one ``is None`` check), else a bound recorder."""
+    if cfg is None or cfg.obs == "off":
+        return None
+    import functools
+
+    from . import obs
+
+    return functools.partial(obs.record_in_axis, op, nbytes, axes)
+
+
+# ---------------------------------------------------------------------------
+# Eager rank-major plans (collectives._eager_collective)
+# ---------------------------------------------------------------------------
+
+
+def plan_for(op: str, x, m: Mesh, n: int, backend: Optional[str],
+             params: dict) -> CollectivePlan:
+    """Plan (or replay-hit) one eager rank-major collective dispatch.
+
+    ``x`` is the rank-major array (leading axis already validated),
+    ``params`` the op's static keyword arguments.  The returned plan's
+    ``replay(x)`` accepts any same-shape/dtype array.
+    """
+    key = ("eager", op, m, x.shape, x.dtype.name, backend,
+           tuple(sorted(params.items())), _epoch())
+    return _get_or_build(
+        key, lambda: _build_eager(key, op, x, m, n, backend, params))
+
+
+def _build_eager(key: tuple, op: str, x, m: Mesh, n: int,
+                 backend_arg: Optional[str], params: dict) -> CollectivePlan:
+    from . import collectives as C
+
+    cfg = _cfg()
+    obs_on = cfg is not None and cfg.obs != "off"
+    nbytes = int(np.prod(x.shape[1:])) * x.dtype.itemsize
+    sharding = rank_major_sharding(m)
+    pd = dict(params)
+
+    if C._staged_requested(cfg, backend_arg):
+        # Host-staged mode (the reference's staged data path): the
+        # faults enablement is resolved HERE — the replay carries no
+        # Config.faults compare (injection/retry decisions inside an
+        # armed fault layer remain per-attempt, as they must).
+        faults_on = cfg is not None and cfg.faults != "off"
+        rec = None
+        if obs_on:
+            from . import obs
+
+            rec = obs.eager_recorder(op, nbytes, "host", m, x.dtype)
+        if faults_on:
+            from . import faults
+
+            def _replay(x, _faults=faults):
+                if rec is not None:
+                    rec()
+                out = _faults.staged_exchange(op, x, n, pd, C._host_staged)
+                return C._place_rank_major(np.ascontiguousarray(out), m,
+                                           sharding)
+        else:
+
+            def _replay(x):
+                if rec is not None:
+                    rec()
+                out = C._host_staged(op, np.asarray(x), n, **pd)
+                return C._place_rank_major(np.ascontiguousarray(out), m,
+                                           sharding)
+
+        return CollectivePlan(key, "eager-staged", op, backend="host",
+                              nbytes=nbytes, staged=True, obs=obs_on,
+                              faults=faults_on, replay=_replay)
+
+    # Direct mode.  Resolve backend="auto" against the persistent tuning
+    # plan ONCE at build: the first uncached (op, size bucket, mesh,
+    # platform) key measures candidates and persists the winner; the
+    # plan then replays the measured decision with zero per-call lookups
+    # (torchmpi_tpu/tuning/ — the per-call fingerprint/DB consults the
+    # pre-planner path paid on EVERY dispatch).
+    eff = backend_arg
+    if eff is None and cfg is not None:
+        eff, _ = C._config_backend(op, cfg)
+    resolved = backend_arg
+    if eff == "auto":
+        from . import tuning
+
+        measured = tuning.resolve_eager(
+            op, nbytes, x.dtype, m,
+            lambda b: C._eager_collective(op, x, mesh=m, backend=b, **pd))
+        if measured is not None:
+            # A measured decision carries per-call-backend authority
+            # (bypasses the size cutover; topology fallback still
+            # applies in the selector).
+            resolved = measured
+    axes = m.axis_names
+    aval = jax.ShapeDtypeStruct(x.shape[1:], x.dtype)
+    impl = C._pick(op, aval, resolved, axes, mesh=m, cfg=cfg)
+
+    def body(xs):
+        return impl(xs[0], axes, **pd)[None]
+
+    lead = P(axes)
+    # check_vma=False: the rank-major eager mode states its shardings
+    # fully explicitly, and custom (pallas) backends cannot express vma
+    # through pallas_call uniformly.
+    shmapped = shard_map(body, mesh=m, in_specs=(lead,), out_specs=lead,
+                         check_vma=False)
+    # Opt-in static analysis, once per plan (Config.analysis;
+    # docs/ANALYSIS.md).  An error-severity finding in "error" mode
+    # raises BEFORE the plan enters the table, so the next call
+    # re-checks — the retry contract the hook tests assert.
+    verdict = "off"
+    mode = getattr(cfg, "analysis", "off") if cfg is not None else "off"
+    if mode in ("warn", "error"):
+        from . import analysis
+
+        findings = analysis.check_once(
+            f"eager {op}", shmapped,
+            jax.ShapeDtypeStruct(x.shape, x.dtype), mode=mode)
+        verdict = "clean" if not findings else f"findings:{len(findings)}"
+    fn = jax.jit(shmapped)
+    backend_name = selector.name_of(op, impl)
+    rec = None
+    if obs_on:
+        from . import obs
+
+        rec = obs.eager_recorder(op, nbytes, backend_name, m, x.dtype)
+
+    def _replay(x):
+        if rec is not None:
+            rec()
+        return fn(C._place_rank_major(x, m, sharding))
+
+    return CollectivePlan(key, "eager", op, backend=backend_name,
+                          nbytes=nbytes, obs=obs_on, analysis=verdict,
+                          extra={"executable": fn}, replay=_replay)
+
+
+# ---------------------------------------------------------------------------
+# In-axis plans (the nine *_in_axis verbs; async_in_axis rides them)
+# ---------------------------------------------------------------------------
+
+
+def plan_in_axis(op: str, tree, axes: Tuple[str, ...],
+                 backend: Optional[str],
+                 params: dict) -> Optional[CollectivePlan]:
+    """Plan (or replay-hit) one in-axis pytree collective, or None for
+    an unplannable tree (non-array leaves) / a disabled planner —
+    the verb then runs its legacy per-call derivation.
+
+    Called at trace time; the plan replays across retraces, re-jits,
+    and repeated step builds of the same tree structure."""
+    if not _enabled:
+        return None
+    leaves, treedef = jax.tree.flatten(tree)
+    if not leaves:
+        return None
+    avals = _avals(leaves)
+    if avals is None:
+        return None
+    mesh = runtime.current_mesh() if runtime.is_initialized() else None
+    key = ("in_axis", op, treedef, avals, axes, _axis_sizes(axes), backend,
+           tuple(sorted(params.items())), mesh, _epoch())
+    return _get_or_build(
+        key, lambda: _build_in_axis(key, op, tree, leaves, treedef, avals,
+                                    axes, backend, params, mesh))
+
+
+def _bucket_impls(op: str, spec: fusion.FusedSpec, backend, axes, mesh,
+                  cfg) -> List[Callable]:
+    """The selector/tuning backend choice per fused bucket, resolved
+    from each bucket's true nbytes (iteration order == fuse_tree's)."""
+    from . import collectives as C
+
+    return [
+        C._pick(op, jax.ShapeDtypeStruct((hi - lo,), g.dtype), backend,
+                axes, mesh=mesh, cfg=cfg)
+        for g in spec.groups for (lo, hi) in g.bounds
+    ]
+
+
+def _build_in_axis(key: tuple, op: str, tree, leaves, treedef, avals,
+                   axes: Tuple[str, ...], backend: Optional[str],
+                   params: dict, mesh) -> CollectivePlan:
+    from . import collectives as C
+
+    cfg = _cfg()
+    eff = runtime.effective_config()
+    obs_on = eff.obs != "off"
+    nbytes = sum(int(np.prod(s)) * np.dtype(d).itemsize for s, d in avals)
+    rec = _in_axis_recorder(eff, op, nbytes, axes)
+    pd = dict(params)
+    max_bytes = eff.fuse_max_bytes
+
+    # Fused elementwise (allreduce/reduce/broadcast): the maybe_fuse
+    # decision, taken once.
+    if (op in fusion.ELEMENTWISE_OPS and max_bytes > 0 and len(leaves) >= 2):
+        spec = fusion.FusedSpec(tree, max_bytes=max_bytes)
+        if spec.n_launches < spec.n_leaves:
+            impls = _bucket_impls(op, spec, backend, axes, mesh, cfg)
+
+            def _replay(tree):
+                if rec is not None:
+                    rec()
+                return fusion.fuse_tree(op, tree, axes, backend=backend,
+                                        spec=spec, impls=impls, **pd)
+
+            return CollectivePlan(key, "in_axis-fused", op,
+                                  backend=backend or "", nbytes=nbytes,
+                                  spec=spec, impls=impls, obs=obs_on,
+                                  replay=_replay)
+
+    # Fused reduce_scatter: tile-interleaved layout, leaf-granularity
+    # buckets (the maybe_fuse_reduce_scatter decision, taken once).
+    if op == "reduce_scatter" and max_bytes > 0 and len(leaves) >= 2:
+        sizes = _axis_sizes(axes)
+        n = int(np.prod(sizes)) if sizes else 0
+        if (n > 0 and all(len(s) >= 1 and s[0] % n == 0
+                          for s, _ in avals)):
+            spec = fusion.FusedSpec(tree, max_bytes=max_bytes)
+            n_launches = sum(len(g.leaf_buckets) for g in spec.groups)
+            if n_launches < spec.n_leaves:
+                impls = [
+                    C._pick("reduce_scatter",
+                            jax.ShapeDtypeStruct(
+                                (sum(g.sizes[pos] for pos in bucket),),
+                                g.dtype),
+                            backend, axes, mesh=mesh, cfg=cfg)
+                    for g in spec.groups for bucket in g.leaf_buckets
+                ]
+
+                def _replay(tree):
+                    if rec is not None:
+                        rec()
+                    return fusion.fused_reduce_scatter(
+                        tree, axes, spec=spec, impls=impls, n=n, **pd)
+
+                return CollectivePlan(key, "in_axis-fused", op,
+                                      backend=backend or "",
+                                      nbytes=nbytes, spec=spec,
+                                      impls=impls, obs=obs_on,
+                                      replay=_replay)
+
+    # Per-leaf: one pre-picked implementation per leaf (the tree.map
+    # path, minus the per-call config/selector/nbytes work).
+    impls = [
+        C._pick(op, jax.ShapeDtypeStruct(s, d), backend, axes, mesh=mesh,
+                cfg=cfg)
+        for s, d in avals
+    ]
+
+    def _replay(tree):
+        if rec is not None:
+            rec()
+        ls = jax.tree.leaves(tree)
+        return jax.tree.unflatten(
+            treedef, [f(v, axes, **pd) for f, v in zip(impls, ls)])
+
+    return CollectivePlan(key, "in_axis", op, backend=backend or "",
+                          nbytes=nbytes, impls=impls, obs=obs_on,
+                          replay=_replay)
+
+
+# ---------------------------------------------------------------------------
+# Gradient-sync plans (gradsync._bucketed_allreduce / the overlap
+# schedule's bucket assignment + per-bucket backend choice)
+# ---------------------------------------------------------------------------
+
+
+def plan_gradsync(grads, axes: Tuple[str, ...], *, op: str, n_buckets: int,
+                  backend: Optional[str],
+                  barrier: bool) -> Optional[CollectivePlan]:
+    """Plan the bucketed gradient allreduce: FusedSpec with the
+    count-driven (``gradsync_buckets``) bucketing plus per-bucket
+    backend choices, replayed across step builds."""
+    if not _enabled:
+        return None
+    leaves, treedef = jax.tree.flatten(grads)
+    if not leaves:
+        return None
+    avals = _avals(leaves)
+    if avals is None:
+        return None
+    mesh = runtime.current_mesh() if runtime.is_initialized() else None
+    key = ("gradsync", treedef, avals, axes, _axis_sizes(axes), op,
+           int(n_buckets), backend, bool(barrier), mesh, _epoch())
+
+    def build():
+        cfg = _cfg()
+        eff = runtime.effective_config()
+        spec = fusion.FusedSpec(grads, n_buckets=n_buckets)
+        impls = _bucket_impls("allreduce", spec, backend, axes, mesh, cfg)
+        nbytes = sum(int(np.prod(s)) * np.dtype(d).itemsize
+                     for s, d in avals)
+
+        def _replay(tree):
+            return fusion.fuse_tree("allreduce", tree, axes,
+                                    backend=backend, barrier=barrier,
+                                    spec=spec, impls=impls, op=op)
+
+        return CollectivePlan(key, "gradsync", "allreduce",
+                              backend=backend or "", nbytes=nbytes,
+                              spec=spec, impls=impls,
+                              obs=eff.obs != "off", replay=_replay)
+
+    return _get_or_build(key, build)
+
+
+def plan_overlap(template_leaves, axes: Tuple[str, ...], *, op: str,
+                 backend: Optional[str], compress: Optional[str],
+                 max_bytes: int) -> Optional[CollectivePlan]:
+    """Decision-only plan for the backprop-overlap schedule: the
+    reverse-order bucket assignment (``extra["firing"]``) and each
+    bucket's pre-picked allreduce implementation (``impls``, indexed in
+    firing order).  ``gradsync.make_overlapped_grad_fn`` consumes both
+    when building its custom_vjp chain."""
+    if not _enabled:
+        return None
+    avals = _avals(template_leaves)
+    if avals is None:
+        return None
+    mesh = runtime.current_mesh() if runtime.is_initialized() else None
+    key = ("overlap", avals, axes, op, backend, compress, int(max_bytes),
+           mesh, _epoch())
+
+    def build():
+        from . import collectives as C
+        from .parallel import gradsync
+
+        cfg = _cfg()
+        eff = runtime.effective_config()
+        firing = gradsync.assign_overlap_buckets(template_leaves, max_bytes)
+        impls = []
+        for bucket in firing:
+            total = sum(int(np.prod(avals[i][0])) for i in bucket)
+            wire_dt = (np.dtype("bfloat16") if compress == "bf16"
+                       else np.dtype(avals[bucket[0]][1]))
+            impls.append(C._pick(
+                "allreduce", jax.ShapeDtypeStruct((total,), wire_dt),
+                backend, axes, mesh=mesh, cfg=cfg))
+        nbytes = sum(int(np.prod(s)) * np.dtype(d).itemsize
+                     for s, d in avals)
+        return CollectivePlan(key, "overlap", "allreduce",
+                              backend=backend or "", nbytes=nbytes,
+                              impls=impls, obs=eff.obs != "off",
+                              extra={"firing": firing,
+                                     "max_bytes": int(max_bytes)})
+
+    return _get_or_build(key, build)
+
+
+# ---------------------------------------------------------------------------
+# Shared flatten/shard metadata (the ZeRO leg + gradsync FlatSpec users)
+# ---------------------------------------------------------------------------
+
+
+def flat_spec_for(tree, n_shards: int) -> fusion.FusedSpec:
+    """Cached :class:`~torchmpi_tpu.fusion.FusedSpec` for ``(tree
+    structure, n_shards)`` — the static flatten/pad/shard metadata the
+    ZeRO update legs and ``zero.flat_spec`` used to rebuild on every
+    trace.  Config-independent (no epoch in the key): the layout is a
+    pure function of the avals and the shard count."""
+    if not _enabled:
+        return fusion.FusedSpec(tree, int(n_shards))
+    leaves, treedef = jax.tree.flatten(tree)
+    avals = _avals(leaves)
+    if avals is None:
+        return fusion.FusedSpec(tree, int(n_shards))
+    key = ("flatspec", treedef, avals, int(n_shards))
+
+    def build():
+        spec = fusion.FusedSpec(tree, int(n_shards))
+        nbytes = sum(int(np.prod(s)) * np.dtype(d).itemsize
+                     for s, d in avals)
+        eff = runtime.effective_config()
+        return CollectivePlan(key, "flatspec", "flatten",
+                              nbytes=nbytes, spec=spec,
+                              obs=eff.obs != "off",
+                              extra={"n_shards": int(n_shards)})
+
+    return _get_or_build(key, build).spec

@@ -44,6 +44,12 @@ class _State:
         self.lock = threading.Lock()
         self.initialized = False
         self.config: Config = Config()
+        # Monotonic configuration-change counter: bumped by init(),
+        # set_config(), and stop().  Every CollectivePlan key embeds it
+        # (torchmpi_tpu/planner.py), so a live config switch makes every
+        # previously-built plan unreachable without any cache walking —
+        # the single staleness mechanism for all planner-backed caches.
+        self.config_epoch = 0
         self.devices: List[jax.Device] = []
         # Stack of (name, Mesh); bottom is always ("world", world_mesh).
         self.mesh_stack: List[Tuple[str, Mesh]] = []
@@ -389,6 +395,7 @@ def init(config: Optional[Config] = None, **overrides) -> Mesh:
         _state.mesh_stack = [("world", world)]
         _state.mesh_cache = {"world": world}
         _state.initialized = True
+        _state.config_epoch += 1
     # Outside the lock: tuning.configure reads runtime state via the
     # public accessors.  Loads the persistent collective plan DB and
     # registers the selector's plan provider when the config opts into
@@ -428,6 +435,7 @@ def stop() -> None:
         _state.initialized = False
         _state.mesh_stack = []
         _state.mesh_cache = {}
+        _state.config_epoch += 1
     from . import collectives, tuning
 
     collectives.clear_cache()
@@ -448,6 +456,17 @@ def _require_init() -> None:
 
 def config() -> Config:
     return _state.config
+
+
+def config_epoch() -> int:
+    """Monotonic counter of configuration changes (init / set_config /
+    stop each bump it).  ``torchmpi_tpu.planner`` embeds the current
+    value in every plan key, so a live knob switch invalidates every
+    cached :class:`~torchmpi_tpu.planner.CollectivePlan` by making it
+    unreachable — mutate the active config only through
+    :func:`set_config` (direct writes to the :func:`config` object
+    bypass the epoch and can replay stale plans)."""
+    return _state.config_epoch
 
 
 def effective_config() -> Config:
@@ -511,12 +530,14 @@ def _tuning_opted_in(cfg: Config) -> bool:
 def set_config(**kw) -> None:
     """Runtime-switch knobs (reference: the torchmpi_set_* FFI setters).
 
-    Clears the eager-collective executable cache: knobs like
-    ``pallas_bidirectional`` or ``chunk_bytes`` are read at trace time, so a
-    cached executable compiled under the old setting must not be reused (the
-    reference's setters likewise took effect immediately).  In-axis
-    collectives inside a USER's jit are cached by jax itself and keep their
-    traced-time settings until the user retraces.
+    Bumps the config epoch and clears the collective plan table
+    (``torchmpi_tpu/planner.py``): every planned decision — compiled
+    executables, fusion bucketing, selector/tuning backend choices,
+    obs/faults enablement — was resolved under the old config and must
+    not be replayed (the reference's setters likewise took effect
+    immediately).  In-axis collectives inside a USER's jit are cached by
+    jax itself and keep their traced-time settings until the user
+    retraces.
     """
     _require_init()
     for k, v in kw.items():
@@ -549,6 +570,10 @@ def set_config(**kw) -> None:
         if k in ("fault_backoff_s", "fault_deadline_s"):
             v = float(v)
         setattr(_state.config, k, v)
+    # Every plan key embeds the epoch (torchmpi_tpu/planner.py), so the
+    # bump alone already strands every stale CollectivePlan; the
+    # clear_cache() below additionally releases their memory.
+    _state.config_epoch += 1
     if ("faults" in kw or "fault_retries" in kw or "fault_backoff_s" in kw
             or "fault_deadline_s" in kw):
         if _state.config.faults != "off":

@@ -36,9 +36,21 @@ import numpy as np
 # op-specific; see collectives.py _IN_AXIS_OPS.
 _REGISTRY: Dict[str, Dict[str, Callable]] = {}
 
+# Bumped by every register(): part of each CollectivePlan key
+# (torchmpi_tpu/planner.py), so re-registering an implementation at
+# runtime strands the plans that resolved the old one — the planner's
+# analog of the legacy jit-cache keying on the resolved impl object.
+_generation = 0
+
+
+def generation() -> int:
+    return _generation
+
 
 def register(op: str, backend: str, fn: Callable) -> None:
+    global _generation
     _REGISTRY.setdefault(op, {})[backend] = fn
+    _generation += 1
 
 
 def available(op: Optional[str] = None) -> Dict:
