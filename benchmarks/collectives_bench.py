@@ -360,6 +360,179 @@ def _overlap_compare_mode(args, mpi, mesh):
         raise SystemExit("overlap-compare: gradients diverged")
 
 
+def _dcn_compare_mode(args, mpi, mesh):
+    """Flat vs two-level vs two-level+codec allreduce on a simulated
+    ``(dcn, ici)`` mesh (docs/HIERARCHICAL.md; ROADMAP item 4).
+
+    The wall-clock win is hardware-only (cpu-sim has no bandwidth cliff
+    between the emulated slices), so the CPU-assertable evidence is the
+    DCN-leg **wire bytes** from the obs counters
+    (``tm_dcn_wire_bytes_total`` — what one device actually puts on the
+    inter-slice links): two-level moves ``1/ici_n`` of the flat payload,
+    the int8 codec another ~1/4 of that.  Also asserted, and emitted as
+    a ``DCN-SUMMARY`` JSON line for CI: chunked == unchunked bitwise,
+    every mode allclose vs flat, the error-feedback running mean
+    converging where single-shot quantization stays biased, and zero
+    steady-state re-plans with topology-keyed plan entries.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from torchmpi_tpu import obs, planner
+    from torchmpi_tpu.parallel import gradsync
+    from torchmpi_tpu.utils.metrics import fence
+
+    axes = tuple(mesh.axis_names)
+    n_dcn = int(mesh.shape[axes[0]])
+    n_ici = int(mesh.shape[axes[1]])
+    if n_dcn <= 1:
+        raise SystemExit("--dcn-compare needs a two-level mesh "
+                         "(run with --dcn 2)")
+    n = n_dcn * n_ici
+    nbytes = args.dcn_bytes
+    n_elems = nbytes // 4
+    x = np.random.RandomState(0).rand(n, n_elems).astype(np.float32)
+    mpi.set_config(obs="metrics", custom_min_bytes=0)
+
+    def _wire(codec):
+        snap = obs.registry().snapshot()
+        return sum(c["value"] for c in snap
+                   if c["name"] == "tm_dcn_wire_bytes_total"
+                   and (codec is None or c["labels"].get("codec") == codec))
+
+    rows = {}
+    flat = None
+    modes = [("flat", "xla", "off"), ("two-level", "hierarchical", "off"),
+             ("two-level+bf16", "hierarchical", "bf16"),
+             ("two-level+int8", "hierarchical", "int8")]
+    for tag, backend, codec in modes:
+        mpi.set_config(dcn_compress=codec, dcn_compress_min_bytes=0)
+        label = codec if codec != "off" else (
+            "none" if backend == "hierarchical" else None)
+        before = _wire(label) if backend == "hierarchical" else 0
+        out = np.asarray(mpi.allreduce(x, backend=backend))  # compile
+        t0 = time.time()
+        for _ in range(args.iters):
+            # Per-iteration fence: overlapping in-flight hierarchical
+            # programs can interleave their sibling collectives'
+            # blocking rendezvous on the CPU sim (same hazard the
+            # steady-state loop below fences; we report per-iteration
+            # averages, so the fence costs nothing we measure).
+            fence(mpi.allreduce(x, backend=backend))
+        dt = (time.time() - t0) / max(1, args.iters)
+        # Trace-time counters: the delta across the compile is the
+        # per-step DCN wire bytes one device sends (flat has no DCN
+        # staging — its whole payload crosses the cliff; analytic).
+        wire = (_wire(label) - before if backend == "hierarchical"
+                else nbytes)
+        if flat is None:
+            flat = out
+        rel = float(np.max(np.abs(out - flat))
+                    / max(1e-12, float(np.max(np.abs(flat)))))
+        rows[tag] = dict(wire_bytes=int(wire), ms=round(dt * 1e3, 3),
+                         rel_err=rel)
+        line = {"mode": tag, "bytes": nbytes, "dcn_wire_bytes": int(wire),
+                "ms": round(dt * 1e3, 3), "rel_err_vs_flat": rel}
+        print(json.dumps(line) if args.json else
+              f"{tag:15s} {nbytes:>10d} B payload  "
+              f"{int(wire):>10d} B across dcn  {dt * 1e3:8.2f} ms  "
+              f"rel-err vs flat {rel:.2e}")
+
+    # Chunk pipelining: bitwise vs the unchunked schedule.
+    mpi.set_config(dcn_compress="off", dcn_chunk_bytes=0)
+    base = np.asarray(mpi.allreduce(x, backend="hierarchical"))
+    mpi.set_config(dcn_chunk_bytes=max(1, nbytes // n_ici // 4))
+    chunked = np.asarray(mpi.allreduce(x, backend="hierarchical"))
+    chunk_bitwise = bool(np.array_equal(base, chunked))
+    mpi.set_config(dcn_chunk_bytes=4 * 1024 * 1024)
+
+    # Error-feedback residual convergence: running mean of EF-quantized
+    # syncs approaches the exact mean; single-shot quantization stays
+    # biased (the deep-gradient-compression trade, checkable on cpu-sim).
+    mpi.set_config(dcn_compress="int8", dcn_compress_min_bytes=0)
+    r = np.random.RandomState(1)
+    gvals = r.randn(4096).astype(np.float32)
+    gvals[:8] *= 100.0  # outliers -> coarse scale -> visible bias
+    grads = {"g": jnp.asarray(gvals)}
+    exact = np.asarray(jax.jit(shard_map(
+        lambda g: gradsync.synchronize_gradients(g, axes, op="mean"),
+        mesh=mesh, in_specs=P(), out_specs=P(), check_vma=False))(
+        grads)["g"])
+    ef = jax.jit(shard_map(
+        lambda g, rs: gradsync.synchronize_gradients(
+            g, axes, op="mean", residuals=rs),
+        mesh=mesh, in_specs=(P(), P(axes)), out_specs=(P(), P(axes)),
+        check_vma=False))
+    res = gradsync.init_dcn_residuals(grads, axes)
+    res0 = gradsync.init_dcn_residuals(grads, axes)
+    ef_acc = ss_acc = None
+    steps = 6
+    for _ in range(steps):
+        out_ef, res = ef(grads, res)
+        out_ss, _ = ef(grads, res0)
+        ef_acc = out_ef["g"] if ef_acc is None else ef_acc + out_ef["g"]
+        ss_acc = out_ss["g"] if ss_acc is None else ss_acc + out_ss["g"]
+    ef_err = float(jnp.mean(jnp.abs(ef_acc / steps - exact)))
+    ss_err = float(jnp.mean(jnp.abs(ss_acc / steps - exact)))
+    residual_ok = ef_err < ss_err
+
+    # Steady state: two-level+int8 eager dispatches must all be plan
+    # hits (0 re-plans) with topology-keyed entries.  Every iteration is
+    # fenced: the hierarchical program runs several subset collectives
+    # per execution, and letting async dispatch skew the simulated
+    # devices across many in-flight executions deadlocks XLA:CPU's
+    # collective rendezvous on small hosts (the loop counts plan hits,
+    # not wall time, so the fence costs nothing we report).
+    fence(mpi.allreduce(x, backend="hierarchical"))  # warm under int8
+    planner.reset_stats()
+    for _ in range(args.steady):
+        fence(mpi.allreduce(x, backend="hierarchical"))
+    st = planner.stats()
+    topologies = {row["topology"] for row in planner.describe()}
+
+    wire_none = rows["two-level"]["wire_bytes"]
+    wire_int8 = rows["two-level+int8"]["wire_bytes"]
+    # The acceptance ratio: int8 moves <= 1/ici_n * ~1/4 of the flat
+    # bytes (scale overhead gets a little slack).
+    bound = nbytes / n_ici / 4 * 1.05
+    summary = {
+        "payload_bytes": nbytes, "n_dcn": n_dcn, "n_ici": n_ici,
+        "flat_dcn_bytes": nbytes, "two_level_dcn_bytes": wire_none,
+        "int8_dcn_bytes": wire_int8,
+        "compressed_lt_uncompressed": bool(wire_int8 < wire_none
+                                           and wire_none < nbytes),
+        "int8_within_bound": bool(wire_int8 <= bound),
+        "chunked_bitwise": chunk_bitwise,
+        "allclose_vs_flat": bool(
+            rows["two-level"]["rel_err"] < 1e-5
+            and rows["two-level+bf16"]["rel_err"] < 2e-2
+            and rows["two-level+int8"]["rel_err"] < 2e-2),
+        "residual_convergence_ok": residual_ok,
+        "ef_mean_err": round(ef_err, 6), "ss_mean_err": round(ss_err, 6),
+        "steady_steps": args.steady, "hits": st["hits"],
+        "misses": st["misses"], "topologies": sorted(topologies),
+    }
+    print("DCN-SUMMARY " + json.dumps(summary))
+    print(f"# dcn-compare: flat {nbytes} B vs two-level {wire_none} B "
+          f"(1/{n_ici}) vs int8 {wire_int8} B across dcn; chunked "
+          f"bitwise={chunk_bitwise}; EF mean-err {ef_err:.4g} vs "
+          f"single-shot {ss_err:.4g}; steady {st['hits']} hits / "
+          f"{st['misses']} re-plans", file=sys.stderr)
+    mpi.set_config(obs="off", dcn_compress="off")
+    failures = [k for k in ("compressed_lt_uncompressed",
+                            "int8_within_bound", "chunked_bitwise",
+                            "allclose_vs_flat", "residual_convergence_ok")
+                if not summary[k]]
+    if failures:
+        raise SystemExit(f"dcn-compare failed: {failures}")
+    if st["misses"]:
+        raise SystemExit(f"dcn-compare: {st['misses']} steady-state "
+                         f"re-plans (expected zero)")
+
+
 def main():
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--devices", type=int, default=0,
@@ -405,6 +578,15 @@ def main():
                         "backprop-overlapped dispatch on a mixed-dtype "
                         "MLP, with launches/step from the lowered HLO "
                         "and a grads bitwise check (docs/OVERLAP.md)")
+    p.add_argument("--dcn-compare", action="store_true",
+                   help="two-level mode: flat vs hierarchical vs "
+                        "hierarchical+codec on a (dcn, ici) mesh — "
+                        "DCN-leg wire bytes from obs counters, "
+                        "bitwise/allclose verdicts, error-feedback "
+                        "residual convergence, steady-state plan hits "
+                        "(docs/HIERARCHICAL.md; needs --dcn >= 2)")
+    p.add_argument("--dcn-bytes", type=int, default=1 << 20,
+                   help="dcn-compare mode: per-device payload bytes")
     p.add_argument("--overlap-layers", type=int, default=8,
                    help="overlap mode: MLP depth (alternating "
                         "fp32/bf16 layers)")
@@ -453,6 +635,11 @@ def main():
 
     if args.overlap_compare:
         _overlap_compare_mode(args, mpi, mesh)
+        mpi.stop()
+        return
+
+    if args.dcn_compare:
+        _dcn_compare_mode(args, mpi, mesh)
         mpi.stop()
         return
 

@@ -218,6 +218,7 @@ def cmd_dump_live(args) -> int:
         for r in rows:
             print(f"{r['kind']:13s} {r['op']:14s} "
                   f"backend={r['backend'] or '-':13s} "
+                  f"topo={r.get('topology') or '-':7s} "
                   f"{r['nbytes']:>10d} B  {r['launches']:3d} launches  "
                   f"epoch={r['epoch']}  hits={r['hits']}  "
                   f"build={r['build_ms']:.2f}ms"

@@ -244,7 +244,7 @@ def _zero_rs_step(mesh, spec):
 
     def inner(p):
         g = jax.tree.map(jnp.ones_like, p)
-        g_shard, _ = zero._reduce_scatter_grads(
+        g_shard, _, _ = zero._reduce_scatter_grads(
             g, ("dcn", "ici"), spec=spec, params=None, op="sum",
             backend=None, compress=None)
         return g_shard
@@ -316,6 +316,139 @@ def test_c1_gradsync_barrier_chain_is_complete(flat_runtime):
 
     found = analysis.check(step, grads, rules=("C1",))
     assert found == []
+
+
+# ---------------------------------------------------------------------------
+# C2: DCN compression / layout consistency (ISSUE 8; docs/HIERARCHICAL.md)
+# ---------------------------------------------------------------------------
+
+
+def _hier_step(mesh, op):
+    from torchmpi_tpu.parallel import hierarchical as H
+
+    def step(x):
+        return shard_map(lambda v: H.hier_allreduce(v, ("dcn", "ici"),
+                                                    op=op),
+                         mesh=mesh, in_specs=P(), out_specs=P(),
+                         check_vma=False)(x)
+
+    return step
+
+
+def test_c2_fires_on_non_sum_compressed_op(hier_runtime):
+    # dcn_compress with a max reduction: the leg silently runs
+    # uncompressed — C2 names it with provenance.
+    mpi.set_config(dcn_compress="int8", dcn_compress_min_bytes=0)
+    try:
+        x = jnp.ones((4096,), jnp.float32)
+        found = analysis.check(_hier_step(hier_runtime, "max"), x,
+                               rules=("C2",))
+        assert _rules(found) == ["C2"]
+        assert found[0].severity == analysis.ERROR
+        assert "non-sum" in found[0].message
+    finally:
+        mpi.set_config(dcn_compress="off")
+
+
+def test_c2_info_on_below_floor_payload(hier_runtime):
+    mpi.set_config(dcn_compress="int8", dcn_compress_min_bytes=1 << 20)
+    try:
+        x = jnp.ones((4096,), jnp.float32)  # 16 KB < 1 MB floor
+        found = analysis.check(_hier_step(hier_runtime, "sum"), x,
+                               rules=("C2",))
+        assert _rules(found) == ["C2"]
+        assert found[0].severity == analysis.INFO
+        assert "dcn_compress_min_bytes" in found[0].message
+    finally:
+        mpi.set_config(dcn_compress="off")
+
+
+def test_c2_info_on_below_floor_ef_leg(hier_runtime):
+    # The error-feedback paths honor the same floor as the plain
+    # hierarchical leg — a sub-floor EF sync leaves the same C2 INFO
+    # evidence (the leg ran uncompressed, residuals untouched).
+    mesh = hier_runtime
+    mpi.set_config(dcn_compress="int8", dcn_compress_min_bytes=1 << 20)
+    try:
+        from torchmpi_tpu.parallel import gradsync
+
+        grads = {"w": jnp.ones((64, 32), jnp.float32)}
+        res = gradsync.init_dcn_residuals(grads, ("dcn", "ici"))
+
+        def step(g, rs):
+            def inner(gt, rl):
+                return mpi.nn.synchronize_gradients(
+                    gt, ("dcn", "ici"), residuals=rl)
+
+            return shard_map(inner, mesh=mesh,
+                             in_specs=(P(), P(("dcn", "ici"))),
+                             out_specs=(P(), P(("dcn", "ici"))),
+                             check_vma=False)(g, rs)
+
+        found = analysis.check(step, grads, res, rules=("C2",))
+        assert _rules(found) == ["C2"]
+        assert found[0].severity == analysis.INFO
+        assert "dcn_compress_min_bytes" in found[0].message
+    finally:
+        mpi.set_config(dcn_compress="off")
+
+
+def test_c2_near_miss_clean_compressed_leg(hier_runtime):
+    mpi.set_config(dcn_compress="int8", dcn_compress_min_bytes=0)
+    try:
+        x = jnp.ones((4096,), jnp.float32)
+        assert analysis.check(_hier_step(hier_runtime, "sum"), x,
+                              rules=("C2",)) == []
+    finally:
+        mpi.set_config(dcn_compress="off")
+
+
+def test_c2_fires_on_residual_structure_mismatch(hier_runtime):
+    # The EF gradsync raises on a wrong residual layout; the analyzer
+    # must still produce the C2 finding (record emitted pre-raise).
+    mesh = hier_runtime
+    mpi.set_config(dcn_compress="int8", dcn_compress_min_bytes=0)
+    try:
+        grads = {"w": jnp.ones((64, 32), jnp.float32)}
+        bad_res = [jnp.zeros((8, 4), jnp.float32)] * 2  # wrong count+shape
+
+        def step(g, rs):
+            def inner(gt, rl):
+                return mpi.nn.synchronize_gradients(
+                    gt, ("dcn", "ici"), residuals=rl)
+
+            return shard_map(inner, mesh=mesh, in_specs=(P(), P()),
+                             out_specs=(P(), P()), check_vma=False)(g, rs)
+
+        found = analysis.check(step, grads, bad_res, rules=("C2",))
+        assert _rules(found) == ["C2"]
+        assert found[0].severity == analysis.ERROR
+        assert "residual" in found[0].message
+    finally:
+        mpi.set_config(dcn_compress="off")
+
+
+def test_c2_near_miss_correct_residual_state(hier_runtime):
+    from torchmpi_tpu.parallel import gradsync
+
+    mesh = hier_runtime
+    mpi.set_config(dcn_compress="int8", dcn_compress_min_bytes=0)
+    try:
+        grads = {"w": jnp.ones((64, 32), jnp.float32)}
+        res = gradsync.init_dcn_residuals(grads, ("dcn", "ici"))
+
+        def step(g, rs):
+            def inner(gt, rl):
+                return mpi.nn.synchronize_gradients(
+                    gt, ("dcn", "ici"), residuals=rl)
+
+            return shard_map(inner, mesh=mesh, in_specs=(P(), P(("dcn", "ici"))),
+                             out_specs=(P(), P(("dcn", "ici"))),
+                             check_vma=False)(g, rs)
+
+        assert analysis.check(step, grads, res, rules=("C2",)) == []
+    finally:
+        mpi.set_config(dcn_compress="off")
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,661 @@
+"""DCN wire codecs + error-feedback residuals (torchmpi_tpu/compress.py,
+ISSUE 8; docs/HIERARCHICAL.md).
+
+Covers: the shared wire-compression validation helper (the one home for
+what gradsync.py and zero.py used to each hand-roll), codec round-trips,
+the error-feedback gradient-sync paths (synchronize_gradients, the
+overlap schedule, ZeRO-1/3) allclose vs their uncompressed siblings, the
+EF convergence property (averaged quantized syncs approach the exact
+value — single-shot quantization does not), flat-mesh degradation, the
+obs codec labels/wire-byte counters, and the off-mode import discipline
+(dcn_compress="off" NEVER imports the codec module — subprocess-checked
+like analysis/obs/faults).
+"""
+
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+import torchmpi_tpu as mpi
+from torchmpi_tpu import compress
+from torchmpi_tpu.parallel import gradsync, zero
+
+AXES = ("dcn", "ici")
+
+
+def _rng(seed=0):
+    return np.random.RandomState(seed)
+
+
+# ---------------------------------------------------------------------------
+# validate_wire: the ONE validation home (ISSUE 8 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_validate_wire_canonicalization():
+    for off in (None, "none", "off", ""):
+        assert compress.validate_wire(off) is None
+    assert compress.validate_wire("INT8") == "int8"
+    assert compress.validate_wire("bf16") == "bf16"
+    with pytest.raises(ValueError, match="unknown compression"):
+        compress.validate_wire("int3")
+    with pytest.raises(ValueError, match="gradsync"):
+        compress.validate_wire("int8", allowed=("bf16",), site="gradsync")
+
+
+def test_gradsync_and_zero_share_validation(flat_runtime):
+    # Both legacy call sites now reject through the shared helper with
+    # their own site names — no more hand-rolled membership checks.
+    mesh = mpi.world_mesh()
+    g = _rng().randn(8, 64).astype(np.float32)
+
+    def sync(x):
+        return gradsync.synchronize_gradients(x, mesh.axis_names,
+                                              compress="int3")
+
+    with pytest.raises(ValueError, match="synchronize_gradients"):
+        jax.jit(shard_map(sync, mesh=mesh, in_specs=P(mesh.axis_names),
+                          out_specs=P(), check_vma=False))(g)
+
+    params = {"w": jnp.ones((64,), jnp.float32)}
+    tx = optax.sgd(0.1)
+    opt = zero.init(params, tx)
+
+    def zstep(p, gr, s):
+        return zero.update(p, gr, s, tx, compress="int3")
+
+    with pytest.raises(ValueError, match="zero update"):
+        jax.jit(shard_map(
+            zstep, mesh=mesh, in_specs=(P(), P(), P(mesh.axis_names)),
+            out_specs=(P(), P(mesh.axis_names)), check_vma=False))(
+            params, params, opt)
+
+
+# ---------------------------------------------------------------------------
+# Codec round-trips
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("codec,tol", [("bf16", 8e-3), ("int8", 1e-2),
+                                       ("fp8", 7e-2)])
+def test_encode_decode_roundtrip(codec, tol):
+    x = jnp.asarray(_rng(1).randn(1024), jnp.float32)
+    payload, scale = compress.encode(x, codec)
+    assert payload.dtype == compress._WIRE_DTYPES[codec]
+    y = compress.decode(payload, scale)
+    rel = float(jnp.max(jnp.abs(y - x)) / jnp.max(jnp.abs(x)))
+    assert rel < tol, rel
+
+
+@pytest.mark.parametrize("codec", ["int8", "fp8"])
+def test_encode_all_zero_bucket(codec):
+    z = jnp.zeros((64,), jnp.float32)
+    payload, scale = compress.encode(z, codec)
+    np.testing.assert_array_equal(np.asarray(compress.decode(payload, scale)),
+                                  np.zeros(64, np.float32))
+
+
+def test_wire_nbytes_of():
+    assert compress.wire_nbytes_of(1000, "bf16") == 2000
+    assert compress.wire_nbytes_of(1000, "int8") == 1004  # +f32 scale
+    assert compress.wire_nbytes_of(1000, "fp8") == 1004
+
+
+# ---------------------------------------------------------------------------
+# EF synchronize_gradients
+# ---------------------------------------------------------------------------
+
+
+def _ef_sync(mesh, grads, res, codec="int8", op="mean"):
+    def step(g, rs):
+        return gradsync.synchronize_gradients(g, AXES, op=op, residuals=rs,
+                                              dcn_compress=codec)
+
+    return jax.jit(shard_map(
+        step, mesh=mesh, in_specs=(P(), P(AXES)),
+        out_specs=(P(), P(AXES)), check_vma=False))(grads, res)
+
+
+def _plain_sync(mesh, grads, op="mean"):
+    return jax.jit(shard_map(
+        lambda g: gradsync.synchronize_gradients(g, AXES, op=op),
+        mesh=mesh, in_specs=P(), out_specs=P(), check_vma=False))(grads)
+
+
+def test_ef_gradsync_allclose_and_residuals_update(hier_runtime):
+    mesh = hier_runtime
+    mpi.set_config(dcn_compress="int8", dcn_compress_min_bytes=0)
+    r = _rng(2)
+    grads = {"w": jnp.asarray(r.randn(64, 32), jnp.float32),
+             "b": jnp.asarray(r.randn(32), jnp.float32)}
+    res = gradsync.init_dcn_residuals(grads, AXES)
+    synced, new_res = _ef_sync(mesh, grads, res)
+    plain = _plain_sync(mesh, grads)
+    for k in grads:
+        np.testing.assert_allclose(np.asarray(synced[k]),
+                                   np.asarray(plain[k]),
+                                   rtol=2e-2, atol=2e-2)
+    # the quantization error landed in the residual state
+    assert any(float(jnp.abs(nr).max()) > 0 for nr in new_res)
+    assert all(nr.shape == r0.shape for nr, r0 in zip(new_res, res))
+
+
+def test_ef_gradsync_wrong_state_raises(hier_runtime):
+    mesh = hier_runtime
+    mpi.set_config(dcn_compress="int8", dcn_compress_min_bytes=0)
+    grads = {"w": jnp.ones((64, 32), jnp.float32)}
+    bad = [jnp.zeros((8, 4), jnp.float32)] * 3
+    with pytest.raises(ValueError, match="bucket layout"):
+        _ef_sync(mesh, grads, bad)
+
+
+def test_ef_gradsync_requires_codec(hier_runtime):
+    mpi.set_config(dcn_compress="off")
+    grads = {"w": jnp.ones((64,), jnp.float32)}
+    res = gradsync.init_dcn_residuals(grads, AXES)
+    with pytest.raises(ValueError, match="no DCN codec"):
+        _ef_sync(hier_runtime, grads, res, codec=None)
+
+
+def test_ef_gradsync_flat_mesh_degrades(flat_runtime):
+    # n_dcn == 1: no DCN crossing — plain sync result, residuals
+    # returned unchanged, the selector fallback counter notes it.
+    from torchmpi_tpu import selector
+
+    mesh = flat_runtime
+    selector._warned_fallbacks.clear()
+    mpi.set_config(dcn_compress="int8", dcn_compress_min_bytes=0)
+    grads = {"w": jnp.asarray(_rng(3).randn(64, 8), jnp.float32)}
+    res = gradsync.init_dcn_residuals(grads, AXES, mesh=mesh)
+    synced, res_out = _ef_sync(mesh, grads, res)
+    plain = _plain_sync(mesh, grads)
+    np.testing.assert_array_equal(np.asarray(synced["w"]),
+                                  np.asarray(plain["w"]))
+    for a, b in zip(res_out, res):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_ef_gradsync_sub_floor_crosses_uncompressed(hier_runtime):
+    # A DCN shard below dcn_compress_min_bytes crosses uncompressed —
+    # the same floor the plain hierarchical path applies: result still
+    # correct, residual state passed through UNCHANGED (no quantization
+    # error was made).
+    mesh = hier_runtime
+    mpi.set_config(dcn_compress="int8", dcn_compress_min_bytes=1 << 20)
+    grads = {"w": jnp.asarray(_rng(6).randn(64, 32), jnp.float32)}
+    res = gradsync.init_dcn_residuals(grads, AXES)
+    synced, res_out = _ef_sync(mesh, grads, res)
+    plain = _plain_sync(mesh, grads)
+    np.testing.assert_allclose(np.asarray(synced["w"]),
+                               np.asarray(plain["w"]),
+                               rtol=1e-6, atol=1e-6)
+    for a, b in zip(res_out, res):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_ef_gradsync_multibucket_mixed_dtype(hier_runtime):
+    # Two dtype groups -> two EF bucket chains in one program (on the
+    # CPU sim the buckets are barrier-chained — unordered sibling
+    # collective chains would deadlock the blocking rendezvous, see
+    # hierarchical._serialize_collectives).
+    mesh = hier_runtime
+    mpi.set_config(dcn_compress="int8", dcn_compress_min_bytes=0)
+    r = _rng(7)
+    grads = {"w": jnp.asarray(r.randn(64, 32), jnp.float32),
+             "h": jnp.asarray(r.randn(128), jnp.bfloat16)}
+    res = gradsync.init_dcn_residuals(grads, AXES)
+    assert len(res) == 2  # one residual buffer per dtype-group bucket
+    synced, new_res = _ef_sync(mesh, grads, res)
+    plain = _plain_sync(mesh, grads)
+    np.testing.assert_allclose(np.asarray(synced["w"]),
+                               np.asarray(plain["w"]),
+                               rtol=2e-2, atol=2e-2)
+    np.testing.assert_allclose(
+        np.asarray(synced["h"], np.float32),
+        np.asarray(plain["h"], np.float32), rtol=5e-2, atol=5e-2)
+    assert all(nr.shape == r0.shape for nr, r0 in zip(new_res, res))
+
+
+def test_ef_convergence_beats_single_shot(hier_runtime):
+    # THE error-feedback property: with the residual carried across
+    # steps, the RUNNING MEAN of quantized syncs converges to the exact
+    # value; repeating single-shot quantization (residual zeroed) keeps
+    # the same bias forever.  A coarse codec on a skewed tensor makes
+    # the gap unambiguous.
+    mesh = hier_runtime
+    mpi.set_config(dcn_compress="int8", dcn_compress_min_bytes=0)
+    r = _rng(4)
+    base = r.randn(256).astype(np.float32)
+    base[:4] *= 100.0  # big outliers -> coarse int8 scale
+    grads = {"w": jnp.asarray(base)}
+    exact = np.asarray(_plain_sync(mesh, grads)["w"])
+
+    res = gradsync.init_dcn_residuals(grads, AXES)
+    zero_res = gradsync.init_dcn_residuals(grads, AXES)
+    ef_acc, ss_acc = None, None
+    steps = 6
+    for _ in range(steps):
+        out_ef, res = _ef_sync(mesh, grads, res)
+        out_ss, _ = _ef_sync(mesh, grads, zero_res)  # residual never kept
+        ef_acc = out_ef["w"] if ef_acc is None else ef_acc + out_ef["w"]
+        ss_acc = out_ss["w"] if ss_acc is None else ss_acc + out_ss["w"]
+    ef_err = float(jnp.mean(jnp.abs(ef_acc / steps - exact)))
+    ss_err = float(jnp.mean(jnp.abs(ss_acc / steps - exact)))
+    assert ef_err < 0.5 * ss_err, (ef_err, ss_err)
+
+
+# ---------------------------------------------------------------------------
+# EF overlap schedule
+# ---------------------------------------------------------------------------
+
+
+def test_ef_overlap_matches_plain_overlap(hier_runtime):
+    mesh = hier_runtime
+    mpi.set_config(dcn_compress="int8", dcn_compress_min_bytes=0)
+    r = _rng(5)
+    params = {"w1": jnp.asarray(r.randn(32, 16), jnp.float32),
+              "b1": jnp.asarray(r.randn(16), jnp.float32),
+              "w2": jnp.asarray(r.randn(16, 4), jnp.float32)}
+    xb = jnp.asarray(r.randn(8, 16, 32), jnp.float32)
+    yb = jnp.asarray(r.randn(8, 16, 4), jnp.float32)
+
+    def loss_fn(p, x, y):
+        h = jnp.tanh(x @ p["w1"] + p["b1"])
+        return jnp.mean((h @ p["w2"] - y) ** 2)
+
+    vag = gradsync.make_overlapped_grad_fn(loss_fn, params, AXES,
+                                           residuals=True, max_bytes=1024)
+    res = gradsync.init_overlap_dcn_residuals(params, AXES, max_bytes=1024)
+    f = jax.jit(shard_map(
+        lambda p, rs, x, y: vag(p, rs, x, y), mesh=mesh,
+        in_specs=(P(), P(AXES), P(AXES), P(AXES)),
+        out_specs=(P(), (P(), P(AXES))), check_vma=False))
+    loss, (g, new_res) = f(params, res, xb, yb)
+
+    vag0 = gradsync.make_overlapped_grad_fn(loss_fn, params, AXES,
+                                            max_bytes=1024)
+    f0 = jax.jit(shard_map(
+        lambda p, x, y: vag0(p, x, y), mesh=mesh,
+        in_specs=(P(), P(AXES), P(AXES)), out_specs=(P(), P()),
+        check_vma=False))
+    loss0, g0 = f0(params, xb, yb)
+    np.testing.assert_allclose(float(loss), float(loss0), rtol=1e-5)
+    for k in params:
+        np.testing.assert_allclose(np.asarray(g[k]), np.asarray(g0[k]),
+                                   rtol=3e-2, atol=3e-2)
+    assert len(new_res) == len(res)
+    assert any(float(jnp.abs(nr).max()) > 0 for nr in new_res)
+
+
+def test_ef_overlap_wrong_state_raises(hier_runtime):
+    mpi.set_config(dcn_compress="int8", dcn_compress_min_bytes=0)
+    params = {"w": jnp.ones((64, 8), jnp.float32)}
+
+    def loss_fn(p, x):
+        return jnp.sum(x @ p["w"])
+
+    vag = gradsync.make_overlapped_grad_fn(loss_fn, params, AXES,
+                                           residuals=True, max_bytes=1024)
+    with pytest.raises(ValueError, match="overlap bucket"):
+        vag(params, [jnp.zeros((8, 1))] * 7, jnp.ones((4, 64)))
+
+
+def test_ef_overlap_flat_mesh_degrades(flat_runtime):
+    # n_dcn == 1: the builder degrades to the PLAIN overlap schedule at
+    # build time (no pointless quantization) while keeping the EF
+    # calling convention — grads bitwise vs the plain builder,
+    # residuals handed back unchanged.
+    mesh = flat_runtime
+    mpi.set_config(dcn_compress="int8", dcn_compress_min_bytes=0)
+    r = _rng(8)
+    params = {"w": jnp.asarray(r.randn(32, 8), jnp.float32)}
+    x = jnp.asarray(r.randn(8, 16, 32), jnp.float32)
+
+    def loss_fn(p, xb):
+        return jnp.mean((xb @ p["w"]) ** 2)
+
+    vag = gradsync.make_overlapped_grad_fn(loss_fn, params, AXES,
+                                           residuals=True, max_bytes=1024)
+    res = gradsync.init_overlap_dcn_residuals(params, AXES,
+                                              max_bytes=1024)
+    f = jax.jit(shard_map(
+        lambda p, rs, xb: vag(p, rs, xb), mesh=mesh,
+        in_specs=(P(), P(AXES), P(AXES)),
+        out_specs=(P(), (P(), P(AXES))), check_vma=False))
+    loss, (g, res_out) = f(params, res, x)
+
+    vag0 = gradsync.make_overlapped_grad_fn(loss_fn, params, AXES,
+                                            max_bytes=1024)
+    f0 = jax.jit(shard_map(
+        lambda p, xb: vag0(p, xb), mesh=mesh,
+        in_specs=(P(), P(AXES)), out_specs=(P(), P()), check_vma=False))
+    loss0, g0 = f0(params, x)
+    np.testing.assert_array_equal(np.asarray(loss), np.asarray(loss0))
+    np.testing.assert_array_equal(np.asarray(g["w"]), np.asarray(g0["w"]))
+    for a, b in zip(res_out, res):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_ef_explicit_backend_or_compress_raise(hier_runtime):
+    # The EF path runs a FIXED two-level schedule: explicit backend/
+    # compress requests raise instead of being silently dropped.
+    mpi.set_config(dcn_compress="int8", dcn_compress_min_bytes=0)
+    grads = {"w": jnp.ones((64,), jnp.float32)}
+    res = gradsync.init_dcn_residuals(grads, AXES)
+    with pytest.raises(ValueError, match="backend"):
+        gradsync.synchronize_gradients(grads, AXES, residuals=res,
+                                       backend="xla")
+    with pytest.raises(ValueError, match="compress"):
+        gradsync.synchronize_gradients(grads, AXES, residuals=res,
+                                       compress="bf16")
+    with pytest.raises(ValueError, match="barrier"):
+        gradsync.synchronize_gradients(grads, AXES, residuals=res,
+                                       barrier=True)
+
+    def loss_fn(p, x):
+        return jnp.sum(x @ p["w"])
+
+    with pytest.raises(ValueError, match="backend"):
+        gradsync.make_overlapped_grad_fn(loss_fn, grads, AXES,
+                                         residuals=True, backend="xla")
+    with pytest.raises(ValueError, match="compress"):
+        gradsync.make_overlapped_grad_fn(loss_fn, grads, AXES,
+                                         residuals=True, compress="bf16")
+
+    tx = optax.sgd(0.1)
+    opt = zero.init(grads, tx, AXES)
+    zres = zero.init_dcn_residuals(grads, AXES)
+    with pytest.raises(ValueError, match="compress"):
+        zero.update(grads, grads, opt, tx, AXES, compress="bf16",
+                    dcn_residuals=zres)
+
+
+def test_ef_wrong_size_residuals_raise(hier_runtime):
+    # Right buffer COUNT but wrong per-buffer sizes: the ZeRO and
+    # overlap EF paths must fail with the init_*_residuals pointer,
+    # not a raw reshape error deep in the codec.
+    mesh = hier_runtime
+    mpi.set_config(dcn_compress="int8", dcn_compress_min_bytes=0)
+    params = {"w": jnp.ones((64, 8), jnp.float32)}
+    grads = jax.tree.map(lambda p: p * 0.1, params)
+    tx = optax.sgd(0.1)
+    opt = zero.init(params, tx, AXES)
+    bad = tuple(jnp.zeros((r.shape[0], r.shape[1] + 1), jnp.float32)
+                for r in zero.init_dcn_residuals(params, AXES))
+    f = jax.jit(shard_map(
+        lambda p, g, s, rs: zero.update(p, g, s, tx, AXES,
+                                        dcn_residuals=rs),
+        mesh=mesh, in_specs=(P(), P(), P(AXES), P(AXES)),
+        out_specs=(P(), P(AXES), P(AXES)), check_vma=False))
+    with pytest.raises(ValueError, match="init_dcn_residuals"):
+        f(params, grads, opt, bad)
+
+    def loss_fn(p, x):
+        return jnp.sum(x @ p["w"])
+
+    vag = gradsync.make_overlapped_grad_fn(loss_fn, params, AXES,
+                                           residuals=True, max_bytes=1024)
+    good = gradsync.init_overlap_dcn_residuals(params, AXES,
+                                               max_bytes=1024)
+    badov = [jnp.zeros((r.shape[0], r.shape[1] + 1), jnp.float32)
+             for r in good]
+    fo = jax.jit(shard_map(
+        lambda p, rs, x: vag(p, rs, x), mesh=mesh,
+        in_specs=(P(), P(AXES), P(AXES)),
+        out_specs=(P(), (P(), P(AXES))), check_vma=False))
+    with pytest.raises(ValueError, match="init_overlap_dcn_residuals"):
+        fo(params, badov, jnp.ones((8, 4, 64), jnp.float32))
+
+
+# ---------------------------------------------------------------------------
+# EF ZeRO legs
+# ---------------------------------------------------------------------------
+
+
+def test_ef_zero1_allclose(hier_runtime):
+    mesh = hier_runtime
+    mpi.set_config(dcn_compress="int8", dcn_compress_min_bytes=0)
+    r = _rng(6)
+    params = {"w": jnp.asarray(r.randn(64, 32), jnp.float32),
+              "b": jnp.asarray(r.randn(32), jnp.float32)}
+    grads = jax.tree.map(lambda p: p * 0.1, params)
+    tx = optax.sgd(0.1)
+    opt = zero.init(params, tx, AXES)
+    res = zero.init_dcn_residuals(params, AXES)
+
+    f = jax.jit(shard_map(
+        lambda p, g, s, rs: zero.update(p, g, s, tx, AXES,
+                                        dcn_residuals=rs),
+        mesh=mesh, in_specs=(P(), P(), P(AXES), P(AXES)),
+        out_specs=(P(), P(AXES), P(AXES)), check_vma=False))
+    new_p, new_s, new_res = f(params, grads, opt, res)
+
+    f0 = jax.jit(shard_map(
+        lambda p, g, s: zero.update(p, g, s, tx, AXES),
+        mesh=mesh, in_specs=(P(), P(), P(AXES)),
+        out_specs=(P(), P(AXES)), check_vma=False))
+    p0, _ = f0(params, grads, opt)
+    for k in params:
+        np.testing.assert_allclose(np.asarray(new_p[k]), np.asarray(p0[k]),
+                                   rtol=2e-3, atol=2e-3)
+    assert len(new_res) == len(res)
+
+
+def test_ef_zero1_presynced_residual_passthrough(hier_runtime):
+    # presynced=True means the communication (and any EF) happened in
+    # the overlap schedule: the zero leg must hand dcn_residuals back
+    # unchanged, not clobber the caller's state with None.
+    mesh = hier_runtime
+    mpi.set_config(dcn_compress="int8", dcn_compress_min_bytes=0)
+    r = _rng(9)
+    params = {"w": jnp.asarray(r.randn(64, 8), jnp.float32)}
+    grads = jax.tree.map(lambda p: p * 0.1, params)
+    tx = optax.sgd(0.1)
+    opt = zero.init(params, tx, AXES)
+    res = zero.init_dcn_residuals(params, AXES)
+    marked = tuple(r0 + 3.0 for r0 in res)  # nonzero so loss is visible
+
+    f = jax.jit(shard_map(
+        lambda p, g, s, rs: zero.update(p, g, s, tx, AXES,
+                                        presynced=True, dcn_residuals=rs),
+        mesh=mesh, in_specs=(P(), P(), P(AXES), P(AXES)),
+        out_specs=(P(), P(AXES), P(AXES)), check_vma=False))
+    _, _, res_out = f(params, grads, opt, marked)
+    assert res_out is not None
+    for a, b in zip(res_out, marked):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_ef_zero3_allclose(hier_runtime):
+    mesh = hier_runtime
+    mpi.set_config(dcn_compress="int8", dcn_compress_min_bytes=0)
+    r = _rng(7)
+    params = {"w": jnp.asarray(r.randn(64, 32), jnp.float32)}
+    grads = jax.tree.map(lambda p: p * 0.1, params)
+    tx = optax.sgd(0.1)
+    spec = zero.flat_spec(params, AXES)
+    res = zero.init_dcn_residuals(params, AXES)
+
+    def shard3(p):
+        return zero.shard_params(p, AXES)
+
+    p_shard = shard3(params)
+    opt = jax.jit(shard_map(
+        lambda ps: tx.init(ps), mesh=mesh, in_specs=P(AXES),
+        out_specs=P(AXES), check_vma=False))(p_shard)
+
+    f = jax.jit(shard_map(
+        lambda ps, g, s, rs: zero.update3(ps, g, s, tx, AXES, spec=spec,
+                                          dcn_residuals=rs),
+        mesh=mesh, in_specs=(P(AXES), P(), P(AXES), P(AXES)),
+        out_specs=(P(AXES), P(AXES), P(AXES)), check_vma=False))
+    new_ps, _, new_res = f(p_shard, grads, opt, res)
+
+    f0 = jax.jit(shard_map(
+        lambda ps, g, s: zero.update3(ps, g, s, tx, AXES, spec=spec),
+        mesh=mesh, in_specs=(P(AXES), P(), P(AXES)),
+        out_specs=(P(AXES), P(AXES)), check_vma=False))
+    ps0, _ = f0(p_shard, grads, opt)
+    np.testing.assert_allclose(np.asarray(new_ps), np.asarray(ps0),
+                               rtol=2e-3, atol=2e-3)
+    assert len(new_res) == len(res)
+
+
+# ---------------------------------------------------------------------------
+# LeNet DP recipe: EF training loss matches uncompressed within tolerance
+# (the ISSUE 8 acceptance criterion)
+# ---------------------------------------------------------------------------
+
+
+def test_ef_lenet_dp_loss_matches_uncompressed(hier_runtime):
+    from torchmpi_tpu.models import LeNet
+    from torchmpi_tpu.utils import data as dutil
+
+    mesh = hier_runtime
+    mpi.set_config(dcn_compress="int8", dcn_compress_min_bytes=0)
+    model = LeNet()
+    params0 = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 28, 28, 1)))
+    tx = optax.sgd(0.05)
+
+    def local_loss(p, images, labels):
+        logits = model.apply(p, images)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels).mean()
+
+    X, Y = dutil.synthetic_mnist(320, seed=1)
+    res0 = gradsync.init_dcn_residuals(params0, AXES)
+
+    def run(ef: bool, steps=5):
+        params, opt = params0, tx.init(params0)
+        res = res0
+        losses = []
+
+        def step_ef(p, s, rs, xb, yb):
+            loss, g = jax.value_and_grad(local_loss)(p, xb, yb)
+            g, rs = gradsync.synchronize_gradients(g, AXES, op="mean",
+                                                   residuals=rs)
+            loss = mpi.collectives.allreduce_in_axis(loss, AXES, op="mean")
+            up, s = tx.update(g, s, p)
+            return optax.apply_updates(p, up), s, rs, loss
+
+        def step_plain(p, s, xb, yb):
+            loss, g = jax.value_and_grad(local_loss)(p, xb, yb)
+            g = gradsync.synchronize_gradients(g, AXES, op="mean")
+            loss = mpi.collectives.allreduce_in_axis(loss, AXES, op="mean")
+            up, s = tx.update(g, s, p)
+            return optax.apply_updates(p, up), s, loss
+
+        if ef:
+            f = jax.jit(shard_map(
+                step_ef, mesh=mesh,
+                in_specs=(P(), P(), P(AXES), P(AXES), P(AXES)),
+                out_specs=(P(), P(), P(AXES), P()), check_vma=False))
+        else:
+            f = jax.jit(shard_map(
+                step_plain, mesh=mesh,
+                in_specs=(P(), P(), P(AXES), P(AXES)),
+                out_specs=(P(), P(), P()), check_vma=False))
+        for xb, yb in dutil.batches(X, Y, 64, steps=steps):
+            xb, yb = jnp.asarray(xb), jnp.asarray(yb)
+            if ef:
+                params, opt, res, loss = f(params, opt, res, xb, yb)
+            else:
+                params, opt, loss = f(params, opt, xb, yb)
+            losses.append(float(loss))
+        return losses
+
+    ef_losses = run(True)
+    plain_losses = run(False)
+    # Same trajectory within the codec's noise: the EF-compressed DCN
+    # leg must not change what the model learns.
+    np.testing.assert_allclose(ef_losses, plain_losses, rtol=0.08,
+                               atol=0.08)
+    assert ef_losses[-1] < ef_losses[0]  # and it is actually learning
+
+
+# ---------------------------------------------------------------------------
+# Obs: codec labels + wire-byte counters (ISSUE 8 satellites)
+# ---------------------------------------------------------------------------
+
+
+def test_obs_gradsync_codec_label_and_dcn_counters(hier_runtime):
+    from torchmpi_tpu import obs
+
+    mesh = hier_runtime
+    mpi.set_config(obs="metrics", dcn_compress="int8",
+                   dcn_compress_min_bytes=0)
+    try:
+        grads = {"w": jnp.ones((64, 32), jnp.float32)}
+        res = gradsync.init_dcn_residuals(grads, AXES)
+        _ = _ef_sync(mesh, grads, res)
+        g2 = {"w": jnp.ones((256,), jnp.float32)}
+        _ = jax.jit(shard_map(
+            lambda g: gradsync.synchronize_gradients(g, AXES,
+                                                     compress="bf16"),
+            mesh=mesh, in_specs=P(), out_specs=P(), check_vma=False))(g2)
+        snap = obs.registry().snapshot()
+        rounds = {c["labels"].get("compressed") for c in snap
+                  if c["name"] == "tm_gradsync_rounds_total"}
+        # actual codec names, not a boolean: dcn-int8 vs legacy bf16
+        assert "dcn-int8" in rounds and "bf16" in rounds
+        wire = [c for c in snap if c["name"] == "tm_dcn_wire_bytes_total"
+                and c["labels"].get("codec") == "int8"]
+        payload = [c for c in snap
+                   if c["name"] == "tm_dcn_payload_bytes_total"
+                   and c["labels"].get("codec") == "int8"]
+        assert wire and payload
+        assert wire[0]["value"] < payload[0]["value"] / 2  # ~4x narrower
+    finally:
+        mpi.set_config(obs="off", dcn_compress="off")
+
+
+# ---------------------------------------------------------------------------
+# Off-mode import discipline (the analysis/obs/faults contract)
+# ---------------------------------------------------------------------------
+
+
+def test_off_mode_never_imports_compress():
+    # dcn_compress="off" (default): hierarchical allreduce, gradsync,
+    # ZeRO, and the eager verbs must all dispatch without EVER importing
+    # torchmpi_tpu.compress.
+    code = (
+        "from torchmpi_tpu.utils.simulation import force_cpu_devices\n"
+        "force_cpu_devices(8)\n"
+        "import sys, jax, numpy as np\n"
+        "import jax.numpy as jnp\n"
+        "import optax\n"
+        "from jax.sharding import PartitionSpec as P\n"
+        "import torchmpi_tpu as mpi\n"
+        "from torchmpi_tpu.parallel import gradsync, zero\n"
+        "from torchmpi_tpu.parallel import hierarchical as H\n"
+        "mesh = mpi.init(mpi.Config(dcn_size=2))\n"
+        "axes = tuple(mesh.axis_names)\n"
+        "x = np.ones((8, 64), np.float32)\n"
+        "mpi.allreduce(x, backend='hierarchical')\n"
+        "g = {'w': jnp.ones((64, 8), jnp.float32)}\n"
+        "jax.jit(jax.shard_map(\n"
+        "    lambda t: gradsync.synchronize_gradients(t, axes),\n"
+        "    mesh=mesh, in_specs=P(), out_specs=P(), check_vma=False))(g)\n"
+        "tx = optax.sgd(0.1)\n"
+        "s = zero.init(g, tx, axes)\n"
+        "jax.jit(jax.shard_map(\n"
+        "    lambda p, gr, st: zero.update(p, gr, st, tx, axes),\n"
+        "    mesh=mesh, in_specs=(P(), P(), P(axes)),\n"
+        "    out_specs=(P(), P(axes)), check_vma=False))(g, g, s)\n"
+        "assert 'torchmpi_tpu.compress' not in sys.modules, \\\n"
+        "    'compress imported on the off path!'\n"
+        "print('CLEAN')\n"
+    )
+    out = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                         text=True, timeout=300,
+                         env={**__import__('os').environ,
+                              "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "CLEAN" in out.stdout

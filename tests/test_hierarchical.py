@@ -1,0 +1,265 @@
+"""Two-level (DCN) collective coverage: the hierarchical chain
+algorithms bitwise vs the flat verbs, the chunk-pipelined allreduce, the
+topology-aware autotune, and the selector fallback observability
+(ISSUE 8; docs/HIERARCHICAL.md).
+
+The chain algorithms move data without reducing it, so gather/scatter/
+allgather must match the flat verbs BITWISE — any reordering is a layout
+bug, not rounding.  The allreduce tests assert bitwise equality between
+the chunked and unchunked schedules (same reduction order) and allclose
+vs the flat psum (different order, same value).
+"""
+
+import numpy as np
+import pytest
+
+import torchmpi_tpu as mpi
+from torchmpi_tpu import planner, selector
+
+N = 8
+
+
+def rank_data(size, dtype=np.float32, n=N):
+    base = np.arange(size, dtype=dtype) % 13
+    return np.stack([(base + r).astype(dtype) for r in range(n)])
+
+
+# ---------------------------------------------------------------------------
+# Chain algorithms bitwise vs the flat verbs (the tentpole's safety net)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("root", [0, 3, 5])
+@pytest.mark.parametrize("size", [16, 4096])
+def test_hier_gather_bitwise_vs_flat(hier_runtime, root, size):
+    # Convergecast chain (large) and allgather+mask (small): pure data
+    # movement, so the result must equal the flat gather bit for bit.
+    mpi.set_config(chunk_bytes=1024)
+    x = rank_data(size)
+    flat = np.asarray(mpi.gather(x, root=root, backend="xla"))
+    hier = np.asarray(mpi.gather(x, root=root, backend="hierarchical"))
+    np.testing.assert_array_equal(hier, flat)
+
+
+@pytest.mark.parametrize("root", [0, 5, 7])
+@pytest.mark.parametrize("size", [16 * N, 1024 * N])
+def test_hier_scatter_bitwise_vs_flat(hier_runtime, root, size):
+    # dcn chain delivers slice blocks, ici chain splits within — every
+    # rank must land exactly the flat scatter's chunk.
+    mpi.set_config(chunk_bytes=1024)
+    x = rank_data(size)
+    flat = np.asarray(mpi.scatter(x, root=root, backend="xla"))
+    hier = np.asarray(mpi.scatter(x, root=root, backend="hierarchical"))
+    np.testing.assert_array_equal(hier, flat)
+
+
+@pytest.mark.parametrize("size", [1, 12, 1000])
+def test_hier_allgather_bitwise_vs_flat(hier_runtime, size):
+    # dcn-major ordering: the two-level gather must reproduce the flat
+    # rank order exactly (outer*n_inner + inner == global rank).
+    x = rank_data(size)
+    flat = np.asarray(mpi.allgather(x, backend="xla"))
+    hier = np.asarray(mpi.allgather(x, backend="hierarchical"))
+    np.testing.assert_array_equal(hier, flat)
+
+
+# ---------------------------------------------------------------------------
+# Chunk-pipelined allreduce (config.dcn_chunk_bytes)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("size", [64, 1000, 4096])
+def test_hier_allreduce_chunked_bitwise(hier_runtime, size):
+    # Chunking is a pure schedule change: the per-element reduction
+    # order is identical, so chunked == unchunked bitwise.
+    x = rank_data(size)
+    mpi.set_config(dcn_chunk_bytes=0)  # one shard, no chunking
+    base = np.asarray(mpi.allreduce(x, backend="hierarchical"))
+    mpi.set_config(dcn_chunk_bytes=256)  # force several chunks
+    chunked = np.asarray(mpi.allreduce(x, backend="hierarchical"))
+    np.testing.assert_array_equal(chunked, base)
+    flat = np.asarray(mpi.allreduce(x, backend="xla"))
+    np.testing.assert_allclose(chunked, flat, rtol=1e-6)
+
+
+def test_hier_allreduce_chunked_launch_count(hier_runtime):
+    # The pipelined schedule must keep per-chunk collectives distinct
+    # through XLA's combiner: k chunks -> k reduce-scatters in the HLO.
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from torchmpi_tpu.parallel import hierarchical as H
+
+    mesh = hier_runtime
+    axes = tuple(mesh.axis_names)
+    mpi.set_config(dcn_chunk_bytes=1024)
+    x = np.arange(8192, dtype=np.float32)  # shard 8 KiB > 1 KiB -> 8 chunks
+    f = jax.jit(jax.shard_map(
+        lambda v: H.hier_allreduce(v, axes), mesh=mesh,
+        in_specs=P(), out_specs=P(), check_vma=False))
+    txt = f.lower(x).as_text()
+    assert txt.count("reduce_scatter") >= 4, txt.count("reduce_scatter")
+
+
+def test_chunk_count_clamped_to_codec_floor(hier_runtime):
+    # With a codec on, each chunk's DCN leg pays its own scale
+    # bookkeeping — chunking may not split a floor-passing shard into
+    # sub-floor legs.  shard 8 KiB, chunk_bytes 1 KiB would give 8
+    # chunks, but a 4 KiB floor allows at most 2.
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from torchmpi_tpu.parallel import hierarchical as H
+
+    mesh = hier_runtime
+    axes = tuple(mesh.axis_names)
+    mpi.set_config(dcn_chunk_bytes=1024, dcn_compress="int8",
+                   dcn_compress_min_bytes=4096)
+    try:
+        x = np.arange(8192, dtype=np.float32)  # shard 8 KiB
+        f = jax.jit(jax.shard_map(
+            lambda v: H.hier_allreduce(v, axes), mesh=mesh,
+            in_specs=P(), out_specs=P(), check_vma=False))
+        txt = f.lower(x).as_text()
+        n_rs = txt.count("reduce_scatter")
+        assert n_rs <= 3, n_rs  # 2 chunks (+ HLO-text slack), not 8
+    finally:
+        mpi.set_config(dcn_chunk_bytes=4 * 1024 * 1024,
+                       dcn_compress="off")
+
+
+# ---------------------------------------------------------------------------
+# Topology-aware autotune: flat-vs-hierarchical measured per
+# (op, size bucket, topology), learned not hardcoded
+# ---------------------------------------------------------------------------
+
+
+def test_auto_measures_hierarchical_candidate(tmp_path):
+    # backend="auto" on a two-level mesh must MEASURE the hierarchical
+    # backend (not just xla) and key the decision to this topology.
+    from torchmpi_tpu import tuning
+
+    mpi.stop()
+    try:
+        mpi.init(mpi.Config(dcn_size=2, backend="auto",
+                            tuning_plan_path=str(tmp_path / "plan.json")))
+        x = rank_data(4096)
+        mpi.allreduce(x)
+        decs = [d for d in tuning.decisions()
+                if d.get("event") == "tuning_decision"
+                and d.get("source") == "measured"]
+        assert decs, "no online measurement happened"
+        key = decs[-1]["key"]
+        assert "dcn:2,ici:4" in key  # topology-keyed
+        entry = tuning.plan().get(key)
+        assert "hierarchical" in entry.median_ms  # flat vs two-level measured
+        assert "xla" in entry.median_ms
+    finally:
+        mpi.stop()
+
+
+def test_seeded_hierarchical_plan_drives_in_axis(tmp_path):
+    # A plan entry naming "hierarchical" at one size bucket must switch
+    # the in-axis dispatch to the two-level schedule at that bucket ONLY
+    # — the learned cutover, visible in the lowered HLO and the plan
+    # table's topology-keyed rows.
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from torchmpi_tpu import collectives
+    from torchmpi_tpu.tuning import fingerprint, plancache
+
+    mpi.stop()
+    try:
+        mesh = mpi.init(mpi.Config(dcn_size=2))
+        path = str(tmp_path / "plan.json")
+        cache = plancache.PlanCache(path)
+        key = fingerprint.fingerprint("allreduce", 4096 * 4, np.float32,
+                                      mesh)
+        cache.put(key, plancache.PlanEntry(backend="hierarchical",
+                                           source="seeded"))
+        cache.save()
+        mpi.stop()
+
+        mesh = mpi.init(mpi.Config(dcn_size=2, backend="auto",
+                                   tuning_plan_path=path))
+        axes = tuple(mesh.axis_names)
+
+        def lower(v):
+            f = jax.jit(jax.shard_map(
+                lambda u: collectives.allreduce_in_axis(u, axes),
+                mesh=mesh, in_specs=P(), out_specs=P(), check_vma=False))
+            _ = f(v)
+            return f.lower(v).as_text()
+
+        planned = lower(np.zeros(4096, np.float32))
+        assert "reduce-scatter" in planned or "reduce_scatter" in planned
+        other = lower(np.zeros(64, np.float32))
+        assert "reduce-scatter" not in other and \
+            "reduce_scatter" not in other
+        rows = {(r["backend"], r["topology"]) for r in planner.describe()
+                if r["kind"].startswith("in_axis")}
+        assert ("hierarchical", "2x4") in rows
+        assert ("xla", "2x4") in rows
+    finally:
+        mpi.stop()
+
+
+def test_plan_rows_carry_topology(hier_runtime):
+    x = rank_data(64)
+    mpi.allreduce(x)
+    rows = planner.describe()
+    assert rows and all(r["topology"] == "2x4" for r in rows)
+
+
+def test_topology_helper_shared():
+    # planner.topology_of and tuning.fingerprint.topology are one home.
+    from torchmpi_tpu.tuning import fingerprint
+
+    assert planner.topology_of(sizes=(2, 4)) == "2x4"
+    assert fingerprint.topology(sizes=(8,)) == "8"
+    mesh = mpi.init(mpi.Config(dcn_size=2))
+    assert planner.topology_of(mesh) == fingerprint.topology(mesh) == "2x4"
+
+
+# ---------------------------------------------------------------------------
+# Selector flat-mesh fallback observability (ISSUE 8 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_selector_fallback_warns_once_and_counts(flat_runtime):
+    import warnings
+
+    from torchmpi_tpu import obs
+
+    selector._warned_fallbacks.clear()
+    mpi.set_config(obs="metrics")
+    try:
+        x = rank_data(64)
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            mpi.allreduce(x, backend="hierarchical")
+            mpi.allreduce(x + 1, backend="hierarchical")
+        msgs = [str(m.message) for m in w
+                if issubclass(m.category, RuntimeWarning)
+                and "degraded to 'xla'" in str(m.message)]
+        assert len(msgs) == 1  # one-time per (op, backend)
+        snap = obs.registry().snapshot()
+        hits = [c for c in snap
+                if c["name"] == "tm_selector_fallback_total"
+                and c["labels"].get("backend") == "hierarchical"]
+        assert hits and hits[0]["value"] >= 1
+    finally:
+        mpi.set_config(obs="off")
+        selector._warned_fallbacks.clear()
+
+
+def test_selector_no_fallback_warning_on_two_level(hier_runtime):
+    import warnings
+
+    selector._warned_fallbacks.clear()
+    x = rank_data(64)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        mpi.allreduce(x, backend="hierarchical")
+    assert not [m for m in w if "degraded" in str(m.message)]

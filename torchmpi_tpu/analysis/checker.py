@@ -13,6 +13,7 @@ nothing here ever runs device code or touches the step's runtime cost.
 
 from __future__ import annotations
 
+import sys
 from typing import Any, List, Optional, Sequence, Tuple
 
 from .events import trace_events
@@ -40,16 +41,20 @@ def _capture_records(records: List[dict]):
 
 
 def trace_fn(fn, *args, axis_env: Optional[AxisEnv] = None,
+             _records_out: Optional[List[dict]] = None,
              **kwargs) -> Tuple[Any, List[dict]]:
     """Trace ``fn`` to a ClosedJaxpr, collecting fusion/ZeRO records.
 
     Raises whatever tracing raises — ``check`` is the surface that
-    converts unbound-axis failures into findings."""
+    converts unbound-axis failures into findings.  ``_records_out``
+    (internal) receives the records captured BEFORE a trace failure, so
+    ``check`` can still report record-only rules (C2's residual
+    mismatch emits its record and then raises)."""
     import jax
 
     from .. import fusion
 
-    records: List[dict] = []
+    records: List[dict] = [] if _records_out is None else _records_out
     prev = fusion.set_trace_listener(_capture_records(records))
     try:
         closed = jax.make_jaxpr(
@@ -84,8 +89,10 @@ def check(fn, *args, rules: Optional[Sequence[str]] = None,
     converted into the D2 finding it really is; other trace errors
     propagate.
     """
+    partial: List[dict] = []
     try:
-        closed, records = trace_fn(fn, *args, axis_env=axis_env, **kwargs)
+        closed, records = trace_fn(fn, *args, axis_env=axis_env,
+                                   _records_out=partial, **kwargs)
     except NameError as e:
         # Convert only when the caller selected D2 (or ran all rules):
         # with D2 excluded, fabricating the finding would sneak an
@@ -93,6 +100,30 @@ def check(fn, *args, rules: Optional[Sequence[str]] = None,
         # also keeps the trace failure loud rather than hidden.
         if _is_unbound_axis_error(e) and (rules is None or "D2" in rules):
             return [unbound_axis_finding(e, label)]
+        raise
+    except ValueError as e:
+        # A structural-validation raise mid-trace: the EF residual
+        # mismatch (gradsync/zero — docs/HIERARCHICAL.md) emits its C2
+        # record BEFORE raising, so the captured records can still name
+        # the site with provenance the bare exception lacks.  Only that
+        # exact raise converts (compress.ResidualMismatchError, looked
+        # up via sys.modules so analysis never imports the codec
+        # module): a generic ValueError later in a trace that earlier
+        # caught-and-survived a mismatch must propagate loud, not be
+        # masked by the stale record.
+        _codec = sys.modules.get("torchmpi_tpu.compress")
+        if (_codec is not None
+                and isinstance(e, _codec.ResidualMismatchError)
+                and (rules is None or "C2" in rules)):
+            ctx = RuleContext(
+                events=(),
+                records=[r for r in partial
+                         if r.get("kind") == "dcn_residual"],
+                config=_effective_config(config), label=label)
+            found = [f for f in run_rules(ctx, ("C2",))
+                     if f.severity == ERROR]
+            if found:
+                return sort_findings(found)
         raise
     bound = [a for a, _ in (axis_env or ())]
     return check_jaxpr(closed, records=records, bound_axes=bound,

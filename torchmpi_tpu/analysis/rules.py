@@ -28,6 +28,15 @@ Deadlock / correctness (error severity):
   chain must span ALL dtype-group buckets, and a ZeRO reduce-scatter's
   shard layout (``n_shards``, per-group padding) must agree with the
   axes it actually spans.
+- **C2** — DCN compression / layout consistency
+  (``config.dcn_compress`` — docs/HIERARCHICAL.md): a codec requested
+  for a reduction that cannot ride the quantized sum path (max/min,
+  integer payloads) is an error (the leg silently ran uncompressed); an
+  error-feedback residual state whose structure does not match the
+  gradient bucket layout is an error (the runtime raise carries no
+  provenance; this finding does); a quantized leg on a payload below
+  ``dcn_compress_min_bytes`` is informational (the floor did its job —
+  but a config expecting compression savings should know).
 
 Hazards / performance (warning or info severity):
 
@@ -352,6 +361,63 @@ def _rule_c1(ctx: RuleContext) -> List[Finding]:
                                  f"n_shards={n_shards}: group-major "
                                  f"shard extents misalign"),
                         source=src, axes=tuple(rec.get("axes", ()))))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# C2: DCN compression / layout consistency (from trace-time records —
+# compress.note_leg / compress.residual_note / hierarchical._dcn_codec)
+# ---------------------------------------------------------------------------
+
+
+@register_rule("C2", ERROR,
+               "DCN compression consistency: codec vs reduce op, "
+               "error-feedback residual structure vs the gradient bucket "
+               "layout, quantized legs below the size floor")
+def _rule_c2(ctx: RuleContext) -> List[Finding]:
+    out = []
+    for rec in ctx.records:
+        kind = rec.get("kind")
+        src = rec.get("source", "")
+        if kind == "dcn_compress":
+            op = str(rec.get("op", ""))
+            codec = str(rec.get("codec", ""))
+            if rec.get("incompatible"):
+                out.append(Finding(
+                    rule="C2", severity=ERROR,
+                    message=(f"dcn_compress={codec!r} requested but this "
+                             f"two-level {op} cannot quantize its DCN leg "
+                             f"(non-sum reduction or non-float payload): "
+                             f"the leg silently ran uncompressed — drop "
+                             f"the codec for this op or route it "
+                             f"separately"),
+                    source=src, op=op, axes=tuple(rec.get("axes", ())),
+                    nbytes=int(rec.get("nbytes", 0))))
+            elif (int(rec.get("nbytes", 0))
+                    < int(rec.get("min_bytes", 0))
+                    and int(rec.get("wire_nbytes", 0))
+                    == int(rec.get("nbytes", 0))):
+                out.append(Finding(
+                    rule="C2", severity=INFO,
+                    message=(f"dcn_compress={codec!r} is on but this "
+                             f"{op}'s DCN shard ({rec.get('nbytes')} "
+                             f"bytes) is below dcn_compress_min_bytes="
+                             f"{rec.get('min_bytes')}: it crossed DCN "
+                             f"uncompressed (the floor working as "
+                             f"designed — raise it deliberately or fuse "
+                             f"the payload if savings were expected)"),
+                    source=src, op=op, axes=tuple(rec.get("axes", ())),
+                    nbytes=int(rec.get("nbytes", 0))))
+        elif kind == "dcn_residual" and not rec.get("ok", True):
+            out.append(Finding(
+                rule="C2", severity=ERROR,
+                message=(f"error-feedback residual state does not match "
+                         f"the gradient bucket layout: {rec.get('got')} "
+                         f"residual buffer(s) threaded for "
+                         f"{rec.get('expected')} bucket(s) — build the "
+                         f"state with init_dcn_residuals(...) from the "
+                         f"SAME template/n_buckets/max_bytes as the sync"),
+                source=src, axes=tuple(rec.get("axes", ()))))
     return out
 
 

@@ -171,6 +171,34 @@ class Config:
     # (per-leaf launches).  Env: TORCHMPI_TPU_FUSE_MAX_BYTES.
     fuse_max_bytes: int = 32 * 1024 * 1024
 
+    # --- two-level (DCN) collective staging ---------------------------------
+    # Chunk bound (bytes) for the pipelined hierarchical allreduce
+    # (parallel/hierarchical.py): when the ICI-scattered shard exceeds
+    # this, the tensor splits into chunks so the DCN transfer of chunk i
+    # overlaps the ICI reduce/gather work of chunk i+1 (the reference's
+    # hand-rolled chunk pipelining, two-level edition).  0 disables
+    # chunking (one shard, the pre-chunking schedule — results are
+    # bit-identical either way).  Env: TORCHMPI_TPU_DCN_CHUNK_BYTES.
+    dcn_chunk_bytes: int = 4 * 1024 * 1024
+    # Wire codec for the inter-slice (DCN) leg of two-level collectives
+    # (torchmpi_tpu/compress.py — docs/HIERARCHICAL.md): "off" (default
+    # — the module is never imported, dispatch is bit-identical to the
+    # uncompressed path), "bf16", "int8", or "fp8".  Only the small
+    # post-reduce_scatter shard crossing DCN is quantized; the ICI legs
+    # always run full precision.  The gradient-sync paths additionally
+    # support error-feedback residuals (the deep-gradient-compression
+    # trade) via explicit residual state.  Resolved at trace/plan-build
+    # time like analysis/obs/faults, so "off" costs zero runtime
+    # branches.  Env: TORCHMPI_TPU_DCN_COMPRESS.
+    dcn_compress: str = "off"
+    # DCN legs below this stay uncompressed even when dcn_compress is
+    # on — compared against the post-reduce_scatter shard (1/ici_n of
+    # the tensor), the bytes that would actually be quantized (the
+    # quantization + scale bookkeeping costs more than it saves on tiny
+    # shards — the same latency/bandwidth cutover shape as
+    # custom_min_bytes).  Env: TORCHMPI_TPU_DCN_COMPRESS_MIN_BYTES.
+    dcn_compress_min_bytes: int = 64 * 1024
+
     # --- static collective-consistency analysis ----------------------------
     # Opt-in runtime hook for torchmpi_tpu.analysis (the SPMD
     # collective-consistency checker — docs/ANALYSIS.md): "off" (default,
@@ -305,6 +333,11 @@ class Config:
             obs_ring_size=_env_int("TORCHMPI_TPU_OBS_RING", 1024),
             fuse_max_bytes=_env_int("TORCHMPI_TPU_FUSE_MAX_BYTES",
                                     32 * 1024 * 1024),
+            dcn_chunk_bytes=_env_int("TORCHMPI_TPU_DCN_CHUNK_BYTES",
+                                     4 * 1024 * 1024),
+            dcn_compress=_env_str("TORCHMPI_TPU_DCN_COMPRESS", "off"),
+            dcn_compress_min_bytes=_env_int(
+                "TORCHMPI_TPU_DCN_COMPRESS_MIN_BYTES", 64 * 1024),
             flash_prescale=_env_bool("TORCHMPI_TPU_FLASH_PRESCALE", False),
             gradsync_buckets=_env_int("TORCHMPI_TPU_GRADSYNC_BUCKETS", 1),
             gradsync_overlap=_env_str("TORCHMPI_TPU_GRADSYNC_OVERLAP",

@@ -340,10 +340,18 @@ def record_fusion(op: str, n_leaves: int, n_launches: int,
     _registry.counter_inc("tm_fusion_bytes_saved_total", saved_bytes, op=op)
 
 
-def record_gradsync(n_buckets: int, op: str, compressed: bool) -> None:
-    """One ``synchronize_gradients`` round (trace-time)."""
+def record_gradsync(n_buckets: int, op: str, compress) -> None:
+    """One ``synchronize_gradients`` round (trace-time).  ``compress``
+    is the wire codec NAME ("bf16", "dcn-int8", ... — "none" when
+    uncompressed), so dumps distinguish the legacy bf16 cast from the
+    quantized DCN codecs; boolean spellings from older callers keep
+    their meaning (True == the legacy bf16 wire)."""
+    if isinstance(compress, bool):
+        name = "bf16" if compress else "none"
+    else:
+        name = str(compress) if compress else "none"
     _registry.counter_inc("tm_gradsync_rounds_total", op=op,
-                          compressed=str(bool(compressed)).lower())
+                          compressed=name)
     _registry.counter_inc("tm_gradsync_buckets_total", max(1, n_buckets))
 
 
@@ -352,6 +360,30 @@ def record_zero(kind: str, n_groups: int, n_shards: int) -> None:
     _registry.counter_inc("tm_zero_sync_rounds_total", kind=kind,
                           n_shards=str(n_shards))
     _registry.counter_inc("tm_zero_groups_total", n_groups, kind=kind)
+
+
+def record_dcn(op: str, codec: str, wire_bytes: int,
+               payload_bytes: int) -> None:
+    """One inter-slice (DCN) leg of a two-level collective
+    (trace-time; docs/HIERARCHICAL.md): ``wire_bytes`` is what one
+    device actually puts on the DCN links (quantized payload + scale),
+    ``payload_bytes`` the uncompressed shard it represents — the ratio
+    is the codec's measured win, the counter
+    ``collectives_bench.py --dcn-compare`` asserts on."""
+    _registry.counter_inc("tm_dcn_legs_total", op=op, codec=codec)
+    _registry.counter_inc("tm_dcn_wire_bytes_total", wire_bytes,
+                          op=op, codec=codec)
+    _registry.counter_inc("tm_dcn_payload_bytes_total", payload_bytes,
+                          op=op, codec=codec)
+
+
+def record_selector_fallback(op: str, backend: str) -> None:
+    """One selector topology/availability degradation (a requested
+    backend silently replaced by "xla" — e.g. "hierarchical" on an
+    ``n_dcn <= 1`` mesh), so misconfigured topologies show up in dumps
+    instead of only as a missing perf win."""
+    _registry.counter_inc("tm_selector_fallback_total", op=op,
+                          backend=backend)
 
 
 def record_tuning_plan(event: str, op: str = "") -> None:

@@ -104,6 +104,103 @@ flatten_tree = fusion.flatten_tree
 unflatten_tree = fusion.unflatten_tree
 
 
+def _wire_compress(compress, *, allowed=("bf16",), site: str):
+    """Resolve/validate a wire-compression knob through the ONE shared
+    helper (``torchmpi_tpu.compress.validate_wire`` — gradsync and zero
+    used to each hand-roll the membership check).  The uncompressed
+    fast path never imports the codec module."""
+    if compress is None or compress in ("none", "off", ""):
+        return None
+    from .. import compress as _codec
+
+    return _codec.validate_wire(compress, allowed=allowed, site=site)
+
+
+def init_dcn_residuals(params_template: PyTree,
+                       axis_names: Optional[AxisNames] = None, *,
+                       mesh: Optional[Mesh] = None,
+                       n_buckets: Optional[int] = None) -> List[jax.Array]:
+    """Zero-initialized error-feedback residual state for
+    :func:`synchronize_gradients` with a quantized DCN leg
+    (docs/HIERARCHICAL.md): one f32 accumulator per gradient bucket,
+    shaped ``[n_devices, shard]`` where ``shard`` is the bucket's
+    ICI-scattered extent (the point where quantization happens).  Pass
+    it through the train step sharded ``P(axes)`` on the leading axis
+    and thread the returned state back in — the residual is persistent
+    per-(site, bucket) state, exactly like optimizer state."""
+    from .. import compress as _codec
+
+    m = _default_mesh(mesh)
+    if axis_names is None:
+        axis_names = _all_axes(m)
+    axes = _codec.ef_axes(axis_names)
+    n_inner = int(m.shape[axes[1]])
+    n_dev = int(np.prod([m.shape[a] for a in axes]))
+    cfg = runtime.config() if runtime.is_initialized() else None
+    if n_buckets is None:
+        n_buckets = cfg.gradsync_buckets if cfg is not None else 1
+    spec = fusion.FusedSpec(params_template, n_buckets=max(1, n_buckets))
+    return _codec.init_residuals(
+        _codec.expected_shards(
+            [hi - lo for g in spec.groups for (lo, hi) in g.bounds],
+            n_inner), n_dev)
+
+
+def _dcn_ef_allreduce(grads: PyTree, axes: Tuple[str, ...], *, op: str,
+                      n_buckets: int, codec: str, residuals
+                      ) -> Tuple[PyTree, List]:
+    """The error-feedback two-level gradient sync: per dtype-group
+    bucket, reduce_scatter(ici) -> residual-corrected quantized
+    allreduce(dcn) -> all_gather(ici) (``compress.ef_bucket_allreduce``
+    — docs/HIERARCHICAL.md).  ``residuals`` is the per-bucket f32 state
+    from :func:`init_dcn_residuals`; returns ``(synced, new_residuals)``
+    with the new state in the old state's shapes."""
+    from .. import compress
+
+    outer, inner = axes
+    spec = fusion.FusedSpec(grads, n_buckets=max(1, n_buckets))
+    leaves = jax.tree.leaves(grads)
+    launches = sum(len(g.bounds) for g in spec.groups)
+    n_inner = lax.axis_size(inner)
+    shard_lens = compress.expected_shards(
+        [hi - lo for g in spec.groups for (lo, hi) in g.bounds], n_inner)
+    res_list = compress.check_residuals(
+        residuals, shard_lens, axes, site="synchronize_gradients",
+        layout="the gradient bucket layout",
+        init_hint="gradsync.init_dcn_residuals(params, ...) using the "
+                  "SAME n_buckets/tree")
+    from . import hierarchical
+
+    min_bytes = runtime.effective_config().dcn_compress_min_bytes
+    serialize = launches > 1 and hierarchical._serialize_collectives()
+    out_leaves: List = [None] * spec.n_leaves
+    new_res: List = []
+    prev = None
+    k = 0
+    for g in spec.groups:
+        flat = fusion.group_flat(leaves, g)
+        parts = []
+        for lo, hi in g.bounds:
+            seg = flat[lo:hi]
+            if serialize and prev is not None:
+                # Each bucket is a psum_scatter/allreduce/all_gather
+                # chain; unordered sibling chains deadlock the CPU
+                # sim's blocking rendezvous (see
+                # hierarchical._serialize_collectives) — chain bucket
+                # i's input on bucket i-1's result there.
+                seg, _ = lax.optimization_barrier((seg, prev))
+            red, nr = compress.ef_bucket_allreduce(
+                seg, outer, inner, codec, res_list[k], op=op,
+                min_bytes=min_bytes)
+            prev = red
+            k += 1
+            parts.append(red)
+            new_res.append(nr)
+        gout = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+        fusion._unpack_group(gout, g, out_leaves)
+    return jax.tree.unflatten(spec.treedef, out_leaves), new_res
+
+
 def _bucketed_allreduce(grads: PyTree, axes: Tuple[str, ...], *, op: str,
                         n_buckets: int, backend: Optional[str],
                         barrier: bool = False) -> PyTree:
@@ -148,7 +245,9 @@ def synchronize_gradients(grads: PyTree, axis_names: Optional[AxisNames] = None,
                           n_buckets: Optional[int] = None,
                           backend: Optional[str] = None,
                           compress: Optional[str] = None,
-                          barrier: Optional[bool] = None) -> PyTree:
+                          barrier: Optional[bool] = None,
+                          residuals=None,
+                          dcn_compress: Optional[str] = None) -> PyTree:
     """Allreduce a gradient pytree across the data-parallel axes.
 
     For use inside a shard_map'd/jitted train step (the hot path).  Defaults:
@@ -168,6 +267,23 @@ def synchronize_gradients(grads: PyTree, axis_names: Optional[AxisNames] = None,
     With ``n_buckets <= 1`` the tree rides the fused in-axis allreduce
     (``config.fuse_max_bytes``): dtype-grouped coalescing, O(dtypes x
     buckets) launches instead of one per leaf, bit-identical results.
+
+    ``residuals`` (state from :func:`init_dcn_residuals`) switches to
+    the **error-feedback quantized DCN path** on a two-level mesh
+    (docs/HIERARCHICAL.md): per-bucket reduce_scatter over ICI, the
+    small shard crossing DCN quantized with ``dcn_compress`` (default
+    ``config.dcn_compress`` — must not be off) after adding back the
+    persistent residual, and the new quantization error returned as the
+    next step's state: ``(synced_grads, new_residuals)``.  On a flat
+    (``n_dcn <= 1``) span there is no DCN leg — the call degrades to
+    the plain path and returns the residuals unchanged (the selector's
+    topology-fallback counter notes it; being the plain path, it honors
+    the config-level ``gradsync_compress``/``gradsync_barrier`` knobs
+    exactly as a residual-free call would).  The two-level EF schedule
+    itself is fixed: explicit ``backend=``/``compress=``/
+    ``barrier=True`` raise, and config-level ``gradsync_compress``/
+    ``gradsync_barrier`` do not apply to it (the DCN codec is the wire
+    compression; the schedule orders its own DCN legs).
     """
     if axis_names is None:
         axis_names = _all_axes(runtime.current_mesh())
@@ -177,20 +293,71 @@ def synchronize_gradients(grads: PyTree, axis_names: Optional[AxisNames] = None,
         op = "mean" if (cfg is None or cfg.gradsync_average) else "sum"
     if n_buckets is None:
         n_buckets = cfg.gradsync_buckets if cfg is not None else 1
+    explicit_compress = compress is not None
     if compress is None and cfg is not None:
         compress = cfg.gradsync_compress
+    compress = _wire_compress(compress, site="synchronize_gradients")
+    explicit_barrier = barrier is not None
     if barrier is None:
         barrier = cfg.gradsync_barrier if cfg is not None else False
+    if residuals is not None:
+        if explicit_barrier and barrier:
+            # Same contract as the resolve_ef backend=/compress=
+            # policing: the EF collective is a fixed two-level schedule
+            # that orders its own legs — silently dropping the knob
+            # would be invisible to the caller.
+            raise ValueError(
+                "synchronize_gradients: barrier= does not combine with "
+                "error-feedback residuals — the EF schedule orders its "
+                "own collectives (the config-level gradsync_barrier "
+                "knob is what the flat-span degradation honors)")
+        # One shared activation gate (compress.resolve_ef): codec
+        # required, explicit backend=/compress= raise — the EF path
+        # dispatches a FIXED two-level schedule (config-level
+        # gradsync_compress/gradsync_barrier do not apply to it; the
+        # flat-span degradation below is the plain path and honors
+        # them as usual — see the docstring).
+        from .. import compress as _codec_mod
+
+        codec = _codec_mod.resolve_ef(
+            dcn_compress, cfg, site="synchronize_gradients",
+            backend=backend, explicit_compress=explicit_compress,
+            compress=compress)
+        _codec_mod.ef_axes(axes)
+        if lax.axis_size(axes[0]) <= 1:
+            # Flat span: no DCN crossing to compress.  Same graceful
+            # degradation as the selector's hierarchical fallback.  The
+            # recursive plain-path call records the round under its own
+            # (uncompressed) label — recording "dcn-<codec>" here would
+            # double-count the round and claim a codec that never ran.
+            # The resolved compress is passed through EXPLICITLY
+            # ("none" when uncompressed) so an explicit compress="none"
+            # opt-out is not re-resolved from config by the inner call.
+            from .. import selector as _sel
+
+            _sel._note_fallback("allreduce", "dcn-" + codec,
+                                "flat mesh (n_dcn <= 1)",
+                                target="the plain sync path")
+            out = synchronize_gradients(grads, axes, op=op,
+                                        n_buckets=n_buckets,
+                                        backend=backend,
+                                        compress=compress or "none",
+                                        barrier=barrier)
+            return out, residuals
+        if cfg is not None and cfg.obs != "off":
+            from .. import obs
+
+            obs.record_gradsync(max(1, n_buckets), op, f"dcn-{codec}")
+        return _dcn_ef_allreduce(grads, axes, op=op, n_buckets=n_buckets,
+                                 codec=codec, residuals=residuals)
     if cfg is not None and cfg.obs != "off":
         from .. import obs
 
-        obs.record_gradsync(n_buckets, op, compress == "bf16")
+        obs.record_gradsync(n_buckets, op, compress)
     orig_dtypes = None
     if compress == "bf16":
         orig_dtypes = jax.tree.map(lambda g: g.dtype, grads)
         grads = jax.tree.map(lambda g: g.astype(jnp.bfloat16), grads)
-    elif compress not in (None, "none"):
-        raise ValueError(f"unknown gradient compression {compress!r}")
     if n_buckets <= 1:
         out = collectives.allreduce_in_axis(grads, axes, op=op,
                                             backend=backend)
@@ -264,7 +431,8 @@ def assign_overlap_buckets(leaves: Sequence, max_bytes: int
 def _make_bucket_sync(idx: int, total: int, axes: Tuple[str, ...],
                       op: str, backend: Optional[str],
                       compress: Optional[str],
-                      impl: Optional[Callable] = None):
+                      impl: Optional[Callable] = None,
+                      dcn_codec: Optional[str] = None):
     """One bucket's sync op: identity in forward, THE bucket's
     allreduce in backward.  ``token`` threads the optimization-barrier
     chain across buckets: the backward rule barriers its allreduce
@@ -273,17 +441,18 @@ def _make_bucket_sync(idx: int, total: int, axes: Tuple[str, ...],
     collectives stay distinct through the combiner and issue in firing
     order, each eligible the moment its cotangents exist.  ``impl`` is
     the planner's pre-picked allreduce implementation for this bucket
-    (None falls back to a per-trace selector pick)."""
+    (None falls back to a per-trace selector pick).
 
-    @jax.custom_vjp
-    def sync(xs, token):
-        return xs, token
+    ``dcn_codec`` switches the backward rule to the error-feedback
+    two-level allreduce (``compress.ef_bucket_allreduce``): the sync
+    then takes a third ``res`` argument (this bucket's persistent f32
+    residual) whose "cotangent" slot carries the NEW residual out —
+    the state rides the AD graph, so it updates exactly when the
+    bucket's collective fires, inside the backward pass."""
 
-    def fwd(xs, token):
-        return (xs, token), None
-
-    def bwd(_, cts):
-        g, tok = cts
+    def _pre(g, tok):
+        """Shared bwd prologue: obs grads event, concat, barrier on the
+        previous bucket's launch, obs launch event."""
         shapes = [x.shape for x in g]
         sizes = [int(np.prod(s)) for s in shapes]
         obs_on = runtime.effective_config().obs != "off"
@@ -300,9 +469,6 @@ def _make_bucket_sync(idx: int, total: int, axes: Tuple[str, ...],
                 g[0].reshape(-1)[:1])
         flat = (g[0].reshape(-1) if len(g) == 1
                 else jnp.concatenate([x.reshape(-1) for x in g]))
-        orig_dtype = flat.dtype
-        if compress == "bf16":
-            flat = flat.astype(jnp.bfloat16)
         flat, _ = lax.optimization_barrier((flat, tok))
         if obs_on:
             from .. import obs
@@ -311,6 +477,57 @@ def _make_bucket_sync(idx: int, total: int, axes: Tuple[str, ...],
                 lambda *_a, _o=obs, _k=idx, _t=total:
                 _o.record_overlap("launch", _k, _t),
                 flat[:1])
+        return flat, shapes, sizes
+
+    def _post(red, tok, shapes, sizes):
+        """Shared bwd epilogue: outgoing token + per-leaf unflatten."""
+        anchor = red[0] if sum(sizes) else tok
+        tok_out, _ = lax.optimization_barrier((tok, anchor))
+        out, off = [], 0
+        for s, sz in zip(shapes, sizes):
+            out.append(red[off:off + sz].reshape(s))
+            off += sz
+        return tuple(out), tok_out
+
+    if dcn_codec is not None:
+        outer, inner = axes
+
+        @jax.custom_vjp
+        def sync_ef(xs, token, res):
+            return xs, token
+
+        def fwd_ef(xs, token, res):
+            return (xs, token), res
+
+        def bwd_ef(res, cts):
+            from .. import compress as _codec
+
+            g, tok = cts
+            flat, shapes, sizes = _pre(g, tok)
+            red, new_res = _codec.ef_bucket_allreduce(
+                flat, outer, inner, dcn_codec, res, op=op,
+                min_bytes=runtime.effective_config()
+                .dcn_compress_min_bytes)
+            out, tok_out = _post(red.astype(flat.dtype), tok, shapes,
+                                 sizes)
+            return (out, tok_out, new_res)
+
+        sync_ef.defvjp(fwd_ef, bwd_ef)
+        return sync_ef
+
+    @jax.custom_vjp
+    def sync(xs, token):
+        return xs, token
+
+    def fwd(xs, token):
+        return (xs, token), None
+
+    def bwd(_, cts):
+        g, tok = cts
+        flat, shapes, sizes = _pre(g, tok)
+        orig_dtype = flat.dtype
+        if compress == "bf16":
+            flat = flat.astype(jnp.bfloat16)
         bucket_impl = impl
         if bucket_impl is None:
             bucket_impl = collectives._pick(  # noqa: SLF001 — shared route
@@ -318,16 +535,39 @@ def _make_bucket_sync(idx: int, total: int, axes: Tuple[str, ...],
         red = bucket_impl(flat, axes, op=op)
         if compress == "bf16":
             red = red.astype(orig_dtype)
-        anchor = red[0] if sum(sizes) else tok
-        tok_out, _ = lax.optimization_barrier((tok, anchor))
-        out, off = [], 0
-        for s, sz in zip(shapes, sizes):
-            out.append(red[off:off + sz].reshape(s))
-            off += sz
-        return (tuple(out), tok_out)
+        out, tok_out = _post(red, tok, shapes, sizes)
+        return (out, tok_out)
 
     sync.defvjp(fwd, bwd)
     return sync
+
+
+def init_overlap_dcn_residuals(params_template: PyTree,
+                               axis_names: Optional[AxisNames] = None, *,
+                               mesh: Optional[Mesh] = None,
+                               max_bytes: Optional[int] = None
+                               ) -> List[jax.Array]:
+    """Zero-initialized error-feedback residual state for
+    :func:`make_overlapped_grad_fn` with a quantized DCN leg: one f32
+    accumulator per FIRING-ORDER overlap bucket (the reverse-parameter
+    ``assign_overlap_buckets`` layout), shaped ``[n_devices, shard]``
+    like :func:`init_dcn_residuals`."""
+    from .. import compress as _codec
+
+    m = _default_mesh(mesh)
+    if axis_names is None:
+        axis_names = _all_axes(m)
+    axes = _codec.ef_axes(axis_names)
+    n_inner = int(m.shape[axes[1]])
+    n_dev = int(np.prod([m.shape[a] for a in axes]))
+    leaves = jax.tree.leaves(params_template)
+    if max_bytes is None:
+        max_bytes = overlap_bucket_bytes(m)
+    firing = assign_overlap_buckets(leaves, max_bytes)
+    return _codec.init_residuals(
+        _codec.expected_shards(
+            [sum(int(np.prod(leaves[i].shape)) for i in bucket)
+             for bucket in firing], n_inner), n_dev)
 
 
 def make_overlapped_grad_fn(loss_fn: Callable, params_template: PyTree,
@@ -337,7 +577,9 @@ def make_overlapped_grad_fn(loss_fn: Callable, params_template: PyTree,
                             backend: Optional[str] = None,
                             compress: Optional[str] = None,
                             has_aux: bool = False,
-                            max_bytes: Optional[int] = None) -> Callable:
+                            max_bytes: Optional[int] = None,
+                            residuals: bool = False,
+                            dcn_compress: Optional[str] = None) -> Callable:
     """Build a ``value_and_grad`` whose gradients come back ALREADY
     allreduced, with each bucket's collective fired inside the backward
     pass as its cotangents materialize (the DDP overlap schedule; the
@@ -365,6 +607,23 @@ def make_overlapped_grad_fn(loss_fn: Callable, params_template: PyTree,
     Extra positional args flow through: ``vag(params, *batch)`` calls
     ``loss_fn(params, *batch)``.  ``has_aux`` follows
     ``jax.value_and_grad``.
+
+    ``residuals=True`` arms the **error-feedback quantized DCN leg**
+    (``dcn_compress``, default ``config.dcn_compress`` — must not be
+    off; docs/HIERARCHICAL.md): each bucket's backward-pass collective
+    becomes the two-level EF allreduce, and the returned callable takes
+    the residual state as its SECOND argument —
+    ``vag(params, residuals, *batch) -> (loss, (grads,
+    new_residuals))`` — with the new state emerging through the
+    residual slot of ``value_and_grad`` (the state update happens
+    inside the backward pass, exactly when the bucket fires).  Build
+    the state with :func:`init_overlap_dcn_residuals` using the same
+    template/``max_bytes``.  On a flat (``n_dcn <= 1``) mesh the
+    builder degrades to the plain overlap schedule — same calling
+    convention, residuals handed back unchanged, the selector's
+    topology-fallback counter notes it.  Explicit ``backend=``/
+    ``compress=`` raise with ``residuals=True`` (the EF buckets run a
+    fixed two-level schedule).
     """
     if axis_names is None:
         axis_names = _all_axes(_default_mesh(mesh))
@@ -373,10 +632,37 @@ def make_overlapped_grad_fn(loss_fn: Callable, params_template: PyTree,
     cfg = runtime.config() if runtime.is_initialized() else None
     if op is None:
         op = "mean" if (cfg is None or cfg.gradsync_average) else "sum"
+    explicit_compress = compress is not None
     if compress is None and cfg is not None:
         compress = cfg.gradsync_compress
-    if compress not in (None, "none", "bf16"):
-        raise ValueError(f"unknown gradient compression {compress!r}")
+    compress = _wire_compress(compress, site="make_overlapped_grad_fn")
+    codec = None
+    ef_passthrough = False
+    if residuals:
+        # Same shared activation gate as synchronize_gradients
+        # (compress.resolve_ef): codec required, explicit
+        # backend=/compress= raise — the EF buckets run a FIXED
+        # two-level schedule.
+        from .. import compress as _codec_mod
+
+        codec = _codec_mod.resolve_ef(
+            dcn_compress, cfg, site="make_overlapped_grad_fn",
+            backend=backend, explicit_compress=explicit_compress,
+            compress=compress)
+        _codec_mod.ef_axes(axes)
+        if int(_default_mesh(mesh).shape[axes[0]]) <= 1:
+            # Flat span: no DCN crossing to compress.  Degrade AT BUILD
+            # TIME to the plain overlap schedule (bit-identical grads,
+            # no pointless quantization) and thread the residual state
+            # through unchanged — the same graceful fallback as
+            # synchronize_gradients/zero, counted the same way.
+            from .. import selector as _sel
+
+            _sel._note_fallback("allreduce", "dcn-" + codec,
+                                "flat mesh (n_dcn <= 1)",
+                                target="the plain overlap schedule")
+            codec = None
+            ef_passthrough = True
     template_leaves, template_def = jax.tree.flatten(params_template)
     if not template_leaves:
         raise ValueError("make_overlapped_grad_fn: empty parameter tree")
@@ -384,10 +670,12 @@ def make_overlapped_grad_fn(loss_fn: Callable, params_template: PyTree,
         max_bytes = overlap_bucket_bytes(mesh)
     # Bucket assignment + per-bucket backend choice, planned once per
     # (template avals, axes, knobs) and replayed across builder calls
-    # (torchmpi_tpu/planner.py — a decision-only plan).
+    # (torchmpi_tpu/planner.py — a decision-only plan).  The EF path
+    # uses the firing assignment only: its collective is the fixed
+    # two-level schedule, not a selector pick.
     oplan = planner.plan_overlap(template_leaves, axes, op=op,
                                  backend=backend, compress=compress,
-                                 max_bytes=max_bytes)
+                                 max_bytes=max_bytes, dcn_codec=codec)
     if oplan is not None:
         firing = oplan.extra["firing"]
         bucket_impls: Sequence[Optional[Callable]] = oplan.impls
@@ -396,14 +684,15 @@ def make_overlapped_grad_fn(loss_fn: Callable, params_template: PyTree,
         bucket_impls = [None] * len(firing)
     total = len(firing)
     syncs = [_make_bucket_sync(k, total, axes, op, backend, compress,
-                               impl=bucket_impls[k])
+                               impl=bucket_impls[k], dcn_codec=codec)
              for k in range(total)]
     if cfg is not None and cfg.obs != "off":
         from .. import obs
 
-        obs.record_gradsync(total, op, compress == "bf16")
+        obs.record_gradsync(total, op,
+                            f"dcn-{codec}" if codec else compress)
 
-    def wrapped_loss(params, *args):
+    def _chain(params, res_list, *args):
         leaves, treedef = jax.tree.flatten(params)
         if len(leaves) != len(template_leaves):
             raise ValueError(
@@ -416,12 +705,56 @@ def make_overlapped_grad_fn(loss_fn: Callable, params_template: PyTree,
         # the deepest layers — fires first.
         for k in range(total - 1, -1, -1):
             xs = tuple(leaves[i] for i in firing[k])
-            xs, token = syncs[k](xs, token)
+            if res_list is None:
+                xs, token = syncs[k](xs, token)
+            else:
+                xs, token = syncs[k](xs, token, res_list[k])
             for i, v in zip(firing[k], xs):
                 new[i] = v
         return loss_fn(jax.tree.unflatten(treedef, new), *args)
 
-    return jax.value_and_grad(wrapped_loss, has_aux=has_aux)
+    if codec is None:
+        def wrapped_loss(params, *args):
+            return _chain(params, None, *args)
+
+        plain = jax.value_and_grad(wrapped_loss, has_aux=has_aux)
+        if not ef_passthrough:
+            return plain
+
+        def degraded_ef(params, residual_state, *args):
+            # Flat-span EF degradation: plain overlapped grads, the
+            # caller's residual state handed back unchanged in the EF
+            # calling convention ((loss, (grads, residuals))).
+            out, grads = plain(params, *args)
+            return out, (grads, residual_state)
+
+        return degraded_ef
+
+    from .. import compress as _codec_mod
+
+    # Expected per-bucket residual extents (the shared
+    # compress.expected_shards formula init_overlap_dcn_residuals
+    # builds with), so a wrong-SIZE state fails here with provenance
+    # instead of as a raw reshape error deep in the backward pass.
+    _ef_n_inner = int(_default_mesh(mesh).shape[axes[1]])
+    _ef_shards = _codec_mod.expected_shards(
+        [sum(int(np.prod(template_leaves[i].shape)) for i in bucket)
+         for bucket in firing], _ef_n_inner)
+
+    def wrapped_loss_ef(params, residual_state, *args):
+        res_list = _codec_mod.check_residuals(
+            residual_state, _ef_shards, axes,
+            site="make_overlapped_grad_fn",
+            layout="the overlap bucket layout",
+            init_hint="gradsync.init_overlap_dcn_residuals(template, "
+                      "...) using the SAME template/max_bytes")
+        return _chain(params, res_list, *args)
+
+    # The residual argnum rides value_and_grad: its "gradient" IS the
+    # new residual state (fabricated by the custom_vjp bwd rules), so
+    # callers get (loss, (grads, new_residuals)) from one call.
+    return jax.value_and_grad(wrapped_loss_ef, argnums=(0, 1),
+                              has_aux=has_aux)
 
 
 def accumulate_gradients(loss_fn: Callable, params: PyTree, *batch: Any,

@@ -12,9 +12,20 @@ The bandwidth-optimal staging on TPU is:
     ->  all_gather over ICI
 
 which sends only ``1/ici_n`` of the tensor over the slow DCN links per chip —
-the same reason the reference reduced intra-node first.  XLA overlaps the
-per-shard DCN transfer with ICI work where it can, playing the role of the
-reference's hand-rolled chunk pipelining.
+the same reason the reference reduced intra-node first.  The allreduce is
+additionally **chunk-pipelined** (``config.dcn_chunk_bytes``): when the
+ICI-scattered shard exceeds the chunk bound, the tensor splits into chunks
+whose DCN legs are ordered through an optimization-barrier chain while the
+ICI legs stay independent — the DCN transfer of chunk *i* overlaps the ICI
+reduce/gather work of chunk *i+1*, the reference's hand-rolled chunk
+pipelining made explicit instead of left to XLA's scheduler.  Results are
+bit-identical chunked or not (the reduction is elementwise).
+
+The DCN leg can also run on a **quantized wire** (``config.dcn_compress``:
+bf16/int8/fp8 — ``torchmpi_tpu/compress.py``, docs/HIERARCHICAL.md): only
+the small post-reduce_scatter shard crossing the slow links is narrowed,
+never the ICI legs.  Off (the default) never imports the codec module and
+dispatches bit-identically to the uncompressed schedule.
 
 These functions register with the selector as backend ``"hierarchical"`` and
 expect exactly two mesh axes ``(outer/dcn, inner/ici)``.
@@ -22,7 +33,7 @@ expect exactly two mesh axes ``(outer/dcn, inner/ici)``.
 
 from __future__ import annotations
 
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax.numpy as jnp
 from jax import lax
@@ -31,6 +42,26 @@ from .. import selector
 
 _REDUCERS = {"sum": lax.psum, "mean": lax.pmean, "max": lax.pmax,
              "min": lax.pmin}
+
+# Chunk-count ceiling for the pipelined schedule: the chunks are
+# trace-time unrolled (each is an independent psum_scatter/psum/
+# all_gather triple), so the pipeline depth is capped — past a handful
+# of in-flight chunks the overlap is already saturated and more chunks
+# only grow the HLO.
+_MAX_CHUNKS = 16
+
+
+def _serialize_collectives() -> bool:
+    """XLA:CPU's thunk executor runs a device's independent thunks
+    concurrently, and every CPU collective blocks its thread at a
+    rendezvous — so two collectives left unordered in the program can
+    be entered in opposite orders by different devices and deadlock
+    the simulated mesh.  On CPU the chunk pipeline is therefore fully
+    serialized (its overlap win is hardware-only anyway); TPU keeps
+    only the DCN-leg chain and lets ICI work overlap."""
+    import jax
+
+    return jax.default_backend() == "cpu"
 
 
 def _check_axes(axis_names) -> Tuple[str, str]:
@@ -46,26 +77,135 @@ def _global_rank(outer: str, inner: str):
     return lax.axis_index(outer) * lax.axis_size(inner) + lax.axis_index(inner)
 
 
+def _dcn_codec(x, op: str, axes: Tuple[str, str]) -> Optional[str]:
+    """Resolve the DCN wire codec for this allreduce at trace time
+    (docs/HIERARCHICAL.md): ``config.dcn_compress`` when the payload is
+    floating point, the op reduces through the staged sum path, and the
+    DCN leg — the post-reduce_scatter shard, ``1/ici_n`` of the tensor
+    — clears ``dcn_compress_min_bytes``.  "off" is one string
+    compare and NEVER imports the codec module — the analysis/obs/
+    faults discipline.  Incompatible or sub-floor requests emit the C2
+    trace record so the static analyzer can report what silently ran
+    uncompressed."""
+    from .. import fusion, runtime
+
+    cfg = runtime.effective_config()
+    if cfg.dcn_compress == "off":
+        return None
+    nbytes = selector.nbytes_of(x)
+    if op not in ("sum", "mean") or not jnp.issubdtype(
+            getattr(x, "dtype", jnp.float32), jnp.inexact):
+        # Quantizing a max/min (or integer) reduction would change its
+        # semantics, not just its precision — run uncompressed and
+        # leave the C2 evidence for the analyzer.  Record the bytes of
+        # the leg that actually crosses DCN so the field is comparable
+        # across records: max/min sends the FULL tensor over dcn
+        # (no reduce_scatter staging); an integer sum still stages, so
+        # its DCN leg is the 1/ici_n shard.
+        if fusion._trace_listener is not None:
+            from .. import compress
+
+            leg_nbytes = int(nbytes)
+            if op in ("sum", "mean"):
+                leg_nbytes = -(-leg_nbytes
+                               // max(1, int(lax.axis_size(axes[1]))))
+            compress.note_skipped(
+                op, cfg.dcn_compress, leg_nbytes, axes,
+                min_bytes=cfg.dcn_compress_min_bytes, incompatible=True)
+        return None
+    # The floor applies to what would actually be quantized: the DCN
+    # shard (1/ici_n of the tensor), not the whole payload.
+    shard_nbytes = -(-int(nbytes) // max(1, int(lax.axis_size(axes[1]))))
+    if shard_nbytes < cfg.dcn_compress_min_bytes:
+        if fusion._trace_listener is not None:
+            from .. import compress
+
+            compress.note_skipped(
+                op, cfg.dcn_compress, shard_nbytes, axes,
+                min_bytes=cfg.dcn_compress_min_bytes)
+        return None
+    from .. import compress
+
+    return compress.resolve_dcn(cfg)
+
+
 def hier_allreduce(x, axis_names, *, op: str = "sum"):
-    """reduce_scatter(ici) -> allreduce(dcn) -> all_gather(ici)."""
+    """reduce_scatter(ici) -> allreduce(dcn) -> all_gather(ici),
+    chunk-pipelined (``config.dcn_chunk_bytes``) with an optionally
+    quantized DCN leg (``config.dcn_compress``)."""
     outer, inner = _check_axes(axis_names)
     if op in ("max", "min"):
+        _dcn_codec(x, op, (outer, inner))  # C2 evidence only
         f = _REDUCERS[op]
         return f(f(x, inner), outer)
     if op not in ("sum", "mean"):
         raise KeyError(f"hierarchical allreduce does not support op {op!r}")
+    from .. import runtime
+
+    codec = _dcn_codec(x, op, (outer, inner))
     n_inner = lax.axis_size(inner)
     shape = x.shape
     flat = x.reshape(-1)
-    pad = (-flat.shape[0]) % n_inner
+    # Chunk count: split so each chunk's ICI-scattered shard is at most
+    # ~dcn_chunk_bytes, bounded by _MAX_CHUNKS (trace-time unroll).
+    chunk_bytes = runtime.effective_config().dcn_chunk_bytes
+    shard_bytes = (flat.shape[0] * flat.dtype.itemsize) // max(1, n_inner)
+    k = 1
+    if chunk_bytes > 0 and shard_bytes > chunk_bytes:
+        k = min(_MAX_CHUNKS, -(-shard_bytes // chunk_bytes))
+    if codec is not None and k > 1:
+        # The floor is paid PER LEG (each chunk's DCN crossing carries
+        # its own scale bookkeeping), so chunking may not split a
+        # passing shard into sub-floor legs — clamp the chunk count so
+        # every leg still clears dcn_compress_min_bytes.
+        min_bytes = runtime.effective_config().dcn_compress_min_bytes
+        if min_bytes > 0:
+            k = max(1, min(k, shard_bytes // min_bytes))
+    pad = (-flat.shape[0]) % (n_inner * k)
     if pad:
         flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
-    # Stage 1: each ICI neighbor ends with its 1/n_inner shard of the ICI sum.
-    shard = lax.psum_scatter(flat, inner, scatter_dimension=0, tiled=True)
-    # Stage 2: allreduce the small shard across slices over DCN.
-    shard = lax.psum(shard, outer)
-    # Stage 3: regather the full tensor over ICI.
-    full = lax.all_gather(shard, inner, axis=0, tiled=True)
+    chunks = flat.reshape(k, -1)
+    outs = []
+    prev = None
+    serialize = k > 1 and _serialize_collectives()
+    for i in range(k):
+        # Stage 1 (ICI): each neighbor ends with its 1/n_inner shard of
+        # this chunk's ICI sum.  Independent across chunks — chunk
+        # i+1's scatter can run while chunk i's DCN leg is in flight
+        # (on CPU sim the chunks are chained instead; see
+        # _serialize_collectives).
+        cin = chunks[i]
+        if serialize and outs:
+            cin, _ = lax.optimization_barrier((cin, outs[-1]))
+        shard = lax.psum_scatter(cin, inner, scatter_dimension=0,
+                                 tiled=True)
+        if prev is not None:
+            # Pipeline order: chunk i's DCN transfer issues after chunk
+            # i-1's (the barrier also keeps the per-chunk collectives
+            # distinct through XLA's combiner, which would otherwise
+            # re-merge them into the unchunked schedule).
+            shard, _ = lax.optimization_barrier((shard, prev))
+        # Stage 2 (DCN): allreduce the small shard across slices.
+        if codec is not None:
+            from .. import compress
+
+            compress.note_leg(
+                "allreduce", codec,
+                shard.size * shard.dtype.itemsize,
+                compress.wire_nbytes_of(shard.size, codec), (outer, inner))
+            shard, _ = compress.dcn_allreduce(shard, outer, codec)
+        else:
+            if runtime.effective_config().obs != "off":
+                from .. import obs
+
+                obs.record_dcn("allreduce", "none",
+                               shard.size * shard.dtype.itemsize,
+                               shard.size * shard.dtype.itemsize)
+            shard = lax.psum(shard, outer)
+        prev = shard
+        # Stage 3 (ICI): regather this chunk.
+        outs.append(lax.all_gather(shard, inner, axis=0, tiled=True))
+    full = outs[0] if k == 1 else jnp.concatenate(outs)
     if pad:
         full = full[: full.shape[0] - pad]
     out = full.reshape(shape)

@@ -54,6 +54,7 @@ from jax import lax, shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from .. import collectives, fusion, planner, runtime
+from .gradsync import _wire_compress
 
 PyTree = Any
 AxisNames = Union[str, Tuple[str, ...]]
@@ -161,13 +162,35 @@ def init(params: PyTree, tx: optax.GradientTransformation,
         check_vma=False))(params)
 
 
+def init_dcn_residuals(params: PyTree,
+                       axis_names: Optional[AxisNames] = None, *,
+                       mesh: Optional[Mesh] = None) -> Tuple[jax.Array, ...]:
+    """Zero-initialized error-feedback residual state for the ZeRO
+    gradient leg with a quantized DCN crossing (docs/HIERARCHICAL.md):
+    one f32 accumulator per dtype group, shaped ``[n_devices, padded /
+    ici_n]`` — the group's ICI-scattered intermediate, where the
+    quantization happens.  Thread it through the step sharded
+    ``P(axes)`` on the leading axis, like the optimizer state."""
+    from .. import compress as _codec
+
+    m, axes, n = _resolve(axis_names, mesh)
+    _codec.ef_axes(axes)
+    n_inner = int(m.shape[axes[1]])
+    spec = _spec_for(params, n)
+    return tuple(_codec.init_residuals(
+        _codec.expected_shards([g.padded for g in spec.groups],
+                               n_inner), n))
+
+
 def update(params: PyTree, grads: PyTree, opt_state: PyTree,
            tx: optax.GradientTransformation,
            axis_names: Optional[AxisNames] = None, *,
            op: Optional[str] = None,
            backend: Optional[str] = None,
            compress: Optional[str] = None,
-           presynced: bool = False) -> Tuple[PyTree, PyTree]:
+           presynced: bool = False,
+           dcn_residuals=None,
+           dcn_compress: Optional[str] = None):
     """One ZeRO-1 step, for use INSIDE a shard_map'd train step.
 
     reduce_scatter the flat gradients over ``axis_names`` (selector-routed,
@@ -190,24 +213,44 @@ def update(params: PyTree, grads: PyTree, opt_state: PyTree,
     so the reduce_scatter leg is replaced by a local slice of this
     device's shard — the communication already happened, overlapped
     under the backward pass.
+
+    ``dcn_residuals`` (state from :func:`init_dcn_residuals`) switches
+    the gradient leg to the **error-feedback quantized DCN path** on a
+    two-level mesh (docs/HIERARCHICAL.md): reduce_scatter over ICI in
+    each group's native dtype, the small shard crossing DCN quantized
+    with ``dcn_compress`` (default ``config.dcn_compress``), the new
+    quantization error returned as next step's state — the return then
+    becomes ``(new_params, new_opt_state, new_residuals)``.  On this
+    path an explicit ``compress=`` raises (the DCN codec IS the wire
+    compression) and ``backend=`` routes only the parameter
+    all_gather — the gradient leg is the fixed two-level schedule.
     """
     if axis_names is None:
         axis_names = tuple(runtime.current_mesh().axis_names)
     axes = _axes_tuple(axis_names)
+    new_res = None
     if presynced:
         spec = _spec_for(params, int(_axis_size(axes)))
         g_shard = _local_shard(grads, spec, axes)
+        # Presynced grads already communicated (EF, if any, happened in
+        # the overlap schedule, which owns its own residual state) —
+        # hand the zero-leg residuals back unchanged instead of
+        # clobbering the caller's state with None.
+        new_res = dcn_residuals
     else:
-        g_shard, spec = _reduce_scatter_grads(grads, axes, spec=None,
-                                              params=params, op=op,
-                                              backend=backend,
-                                              compress=compress)
+        g_shard, spec, new_res = _reduce_scatter_grads(
+            grads, axes, spec=None, params=params, op=op,
+            backend=backend, compress=compress,
+            dcn_residuals=dcn_residuals, dcn_compress=dcn_compress)
     p_shard = _local_shard(params, spec, axes)
     updates, new_state = tx.update(g_shard, opt_state, p_shard)
     p_shard = optax.apply_updates(p_shard, updates)
     p_flat = collectives.allgather_in_axis(p_shard, axes,
                                            backend=backend).reshape(-1)
-    return fusion.unflatten_shards(p_flat, spec), new_state
+    new_params = fusion.unflatten_shards(p_flat, spec)
+    if dcn_residuals is not None:
+        return new_params, new_state, new_res
+    return new_params, new_state
 
 
 def _reduce_scatter_grads(grads: PyTree, axes: Tuple[str, ...], *,
@@ -215,24 +258,42 @@ def _reduce_scatter_grads(grads: PyTree, axes: Tuple[str, ...], *,
                           params: Optional[PyTree],
                           op: Optional[str],
                           backend: Optional[str],
-                          compress: Optional[str]
-                          ) -> Tuple[jax.Array, _FlatSpec]:
+                          compress: Optional[str],
+                          dcn_residuals=None,
+                          dcn_compress: Optional[str] = None
+                          ) -> Tuple[jax.Array, _FlatSpec, Optional[tuple]]:
     """The shared ZeRO gradient leg (ZeRO-1 :func:`update` and ZeRO-3
     :func:`update3`): resolve op/compress defaults from config (validated
     BEFORE any axis/tracing use, so bad arguments raise eagerly outside
     shard_map too), flatten, optionally bf16-compress the wire,
     reduce_scatter over ``axes``, restore dtype, apply mean scaling.
     Pass either a prebuilt ``spec`` (ZeRO-3) or ``params`` to derive one
-    (ZeRO-1).  Returns ``(flat gradient shard, spec)``."""
+    (ZeRO-1).  Returns ``(flat gradient shard, spec, new_residuals)``
+    — ``new_residuals`` is None unless the error-feedback DCN path ran
+    (``dcn_residuals`` given on a two-level span)."""
     cfg = runtime.config() if runtime.is_initialized() else None
     if op is None:
         op = "mean" if (cfg is None or cfg.gradsync_average) else "sum"
     if op not in ("mean", "sum"):
         raise ValueError(f"zero update op must be mean|sum, got {op!r}")
+    explicit_compress = compress is not None
     if compress is None and cfg is not None:
         compress = cfg.gradsync_compress
-    if compress not in (None, "none", "bf16"):
-        raise ValueError(f"unknown gradient compression {compress!r}")
+    compress = _wire_compress(compress, site="zero update")
+    codec = None
+    if dcn_residuals is not None:
+        from .. import compress as _codec
+
+        # One shared activation gate (compress.resolve_ef): codec
+        # required, explicit compress= raises rather than being
+        # silently dropped.  ``backend=`` stays legal here
+        # (allow_backend) — it still routes the parameter all_gather,
+        # while the gradient leg is the fixed two-level schedule.
+        codec = _codec.resolve_ef(
+            dcn_compress, cfg, site="zero update", backend=backend,
+            explicit_compress=explicit_compress, compress=compress,
+            allow_backend=True)
+        _codec.ef_axes(axes)
 
     n = _axis_size(axes)
     if spec is None:
@@ -261,18 +322,69 @@ def _reduce_scatter_grads(grads: PyTree, axes: Tuple[str, ...], *,
     # parameter extents.  ``compress="bf16"`` still narrows wider
     # groups on top.
     g_leaves = jax.tree.leaves(grads)
-    parts = []
-    for g in spec.groups:
-        g_flat = fusion.group_flat(g_leaves, g, pad=True)
-        if compress == "bf16":
-            g_flat = g_flat.astype(jnp.bfloat16)
-        shard = collectives.reduce_scatter_in_axis(g_flat, axes,
-                                                   backend=backend)
-        parts.append(shard.astype(spec.dtype))
+    new_res = None
+    if codec is not None and int(_axis_size(axes[:1])) > 1:
+        # Error-feedback quantized DCN path: reduce_scatter over ICI in
+        # each group's native dtype, residual-corrected quantized
+        # crossing over DCN, pre-permuted so every device still lands
+        # on its dcn-major _local_shard extent
+        # (compress.ef_group_reduce_scatter — docs/HIERARCHICAL.md).
+        from .. import compress as _codec_mod
+
+        n_i = int(_axis_size(axes[1:]))
+        want = _codec_mod.expected_shards(
+            [g.padded for g in spec.groups], n_i)
+        res_list = _codec_mod.check_residuals(
+            dcn_residuals, want, axes, site="zero update",
+            layout="the dtype-group bucket layout",
+            init_hint="zero.init_dcn_residuals(params, ...) from the "
+                      "SAME params/axes")
+        from . import hierarchical
+
+        min_bytes = (cfg.dcn_compress_min_bytes if cfg is not None else 0)
+        serialize = (len(spec.groups) > 1
+                     and hierarchical._serialize_collectives())
+        parts, new_parts = [], []
+        prev = None
+        for g, r in zip(spec.groups, res_list):
+            g_flat = fusion.group_flat(g_leaves, g, pad=True)
+            if serialize and prev is not None:
+                # Unordered sibling psum_scatter/allreduce chains
+                # deadlock the CPU sim's blocking rendezvous (see
+                # hierarchical._serialize_collectives) — chain group
+                # i's input on group i-1's shard there.
+                g_flat, _ = lax.optimization_barrier((g_flat, prev))
+            shard, nr = _codec_mod.ef_group_reduce_scatter(
+                g_flat, axes[0], axes[1], codec, r,
+                min_bytes=min_bytes)
+            prev = shard
+            parts.append(shard.astype(spec.dtype))
+            new_parts.append(nr)
+        new_res = tuple(new_parts)
+    else:
+        if codec is not None:
+            # Flat span: no DCN crossing — plain path, residuals
+            # unchanged.
+            from .. import selector as _sel
+
+            _sel._note_fallback("reduce_scatter", "dcn-" + codec,
+                                "flat mesh (n_dcn <= 1)",
+                                target="the plain reduce_scatter leg")
+            new_res = tuple(dcn_residuals) \
+                if isinstance(dcn_residuals, (list, tuple)) \
+                else dcn_residuals
+        parts = []
+        for g in spec.groups:
+            g_flat = fusion.group_flat(g_leaves, g, pad=True)
+            if compress == "bf16":
+                g_flat = g_flat.astype(jnp.bfloat16)
+            shard = collectives.reduce_scatter_in_axis(g_flat, axes,
+                                                       backend=backend)
+            parts.append(shard.astype(spec.dtype))
     g_shard = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
     if op == "mean":
         g_shard = g_shard / n
-    return g_shard, spec
+    return g_shard, spec, new_res
 
 
 # --------------------------------------------------------------------------
@@ -324,8 +436,9 @@ def update3(p_shard: jax.Array, grads: PyTree, opt_state: PyTree,
             op: Optional[str] = None,
             backend: Optional[str] = None,
             compress: Optional[str] = None,
-            presynced: bool = False
-            ) -> Tuple[jax.Array, PyTree]:
+            presynced: bool = False,
+            dcn_residuals=None,
+            dcn_compress: Optional[str] = None):
     """One ZeRO-3 step, for use INSIDE a shard_map'd train step.
 
     reduce_scatter the flat gradients over ``axis_names``, apply ``tx`` on
@@ -339,18 +452,27 @@ def update3(p_shard: jax.Array, grads: PyTree, opt_state: PyTree,
 
     ``presynced=True`` as in :func:`update`: ``grads`` arrived already
     reduced (the overlap schedule) and this device slices its shard
-    locally instead of re-communicating.
+    locally instead of re-communicating.  ``dcn_residuals`` as in
+    :func:`update`: the error-feedback quantized DCN leg, returning
+    ``(new_p_shard, new_opt_state, new_residuals)``.
     """
     axes = _axes_tuple(axis_names)
+    new_res = None
     if presynced:
         g_shard = _local_shard(grads, spec, axes)
+        # Same passthrough as :func:`update`: presynced EF state lives
+        # in the overlap schedule, not this leg.
+        new_res = dcn_residuals
     else:
-        g_shard, _ = _reduce_scatter_grads(grads, axes, spec=spec,
-                                           params=None, op=op,
-                                           backend=backend,
-                                           compress=compress)
+        g_shard, _, new_res = _reduce_scatter_grads(
+            grads, axes, spec=spec, params=None, op=op, backend=backend,
+            compress=compress, dcn_residuals=dcn_residuals,
+            dcn_compress=dcn_compress)
     updates, new_state = tx.update(g_shard, opt_state, p_shard)
-    return optax.apply_updates(p_shard, updates), new_state
+    new_shard = optax.apply_updates(p_shard, updates)
+    if dcn_residuals is not None:
+        return new_shard, new_state, new_res
+    return new_shard, new_state
 
 
 def unshard_params(p_shard: jax.Array, params_template: PyTree,

@@ -78,7 +78,7 @@ class CollectivePlan:
 
     __slots__ = ("key", "kind", "op", "backend", "nbytes", "spec", "impls",
                  "extra", "staged", "obs", "faults", "analysis", "epoch",
-                 "build_seconds", "hits", "_replay", "_obs_hit")
+                 "topology", "build_seconds", "hits", "_replay", "_obs_hit")
 
     def __init__(self, key: tuple, kind: str, op: str, *,
                  backend: str = "", nbytes: int = 0,
@@ -87,12 +87,18 @@ class CollectivePlan:
                  extra: Optional[dict] = None,
                  staged: bool = False, obs: bool = False,
                  faults: bool = False, analysis: str = "off",
+                 topology: str = "",
                  replay: Optional[Callable] = None) -> None:
         self.key = key
         self.kind = kind
         self.op = op
         self.backend = backend
         self.nbytes = int(nbytes)
+        # Topology fingerprint ("n_dcn x n_ici ..." as "2x4"): the mesh
+        # extents the plan's dispatch spans — what makes a flat-vs-
+        # hierarchical decision visible per topology in dump-live
+        # (ROADMAP item 4; docs/HIERARCHICAL.md).
+        self.topology = topology
         self.spec = spec
         self.impls = impls
         self.extra = extra or {}
@@ -128,6 +134,7 @@ class CollectivePlan:
                                if self.spec is not None else 1)),
             "staged": self.staged, "obs": self.obs, "faults": self.faults,
             "analysis": self.analysis, "epoch": self.epoch,
+            "topology": self.topology,
             "build_ms": round(self.build_seconds * 1e3, 3),
             "hits": self.hits,
         }
@@ -274,6 +281,39 @@ def _avals(leaves) -> Optional[tuple]:
     return tuple(out)
 
 
+def topology_of(mesh=None, sizes=None) -> str:
+    """The ``n_dcn x n_ici``-style topology fingerprint of a dispatch
+    ("2x4" two-level; "8" flat), stored on every :class:`CollectivePlan`
+    (and shown by ``plan_tool.py dump-live``) so a flat-vs-hierarchical
+    choice reads as a per-topology decision, not an opaque cache row.
+    ONE home: :func:`torchmpi_tpu.tuning.fingerprint.topology`, the same
+    extents the tuning-plan keys carry via ``mesh_key`` — the planner's
+    fingerprint and the plan DB's can never drift apart."""
+    from .tuning import fingerprint
+
+    return fingerprint.topology(mesh=mesh, sizes=sizes)
+
+
+def _topo_sizes(mesh, axes: Tuple[str, ...]) -> Optional[Tuple[int, ...]]:
+    """Trace-bound axis extents reordered to MESH order for the
+    topology label: ``("ici", "dcn")`` and ``("dcn", "ici")`` calls
+    over one device span must read as ONE topology (the same
+    normalization :func:`fingerprint.mesh_key` applies to the plan-DB
+    keys).  Axes not named by the mesh (a different user mesh) keep
+    their caller order — the trace-context sizes are still correct."""
+    sizes = _axis_sizes(axes)
+    if mesh is None or sizes is None:
+        return sizes
+    try:
+        if all(a in mesh.shape for a in axes):
+            order = {a: i for i, a in enumerate(mesh.shape)}
+            return tuple(s for _, s in sorted(
+                zip(axes, sizes), key=lambda p: order[p[0]]))
+    except Exception:  # noqa: BLE001 — a label must never fail a plan
+        pass
+    return sizes
+
+
 def _axis_sizes(axes: Tuple[str, ...]) -> Optional[Tuple[int, ...]]:
     """The bound sizes of ``axes`` in the current trace context, or
     None outside any binding.  Part of every in-axis key: the same axis
@@ -357,7 +397,8 @@ def _build_eager(key: tuple, op: str, x, m: Mesh, n: int,
 
         return CollectivePlan(key, "eager-staged", op, backend="host",
                               nbytes=nbytes, staged=True, obs=obs_on,
-                              faults=faults_on, replay=_replay)
+                              faults=faults_on, topology=topology_of(m),
+                              replay=_replay)
 
     # Direct mode.  Resolve backend="auto" against the persistent tuning
     # plan ONCE at build: the first uncached (op, size bucket, mesh,
@@ -421,6 +462,7 @@ def _build_eager(key: tuple, op: str, x, m: Mesh, n: int,
 
     return CollectivePlan(key, "eager", op, backend=backend_name,
                           nbytes=nbytes, obs=obs_on, analysis=verdict,
+                          topology=topology_of(m),
                           extra={"executable": fn}, replay=_replay)
 
 
@@ -467,6 +509,22 @@ def _bucket_impls(op: str, spec: fusion.FusedSpec, backend, axes, mesh,
     ]
 
 
+def _resolved_backend(op: str, backend: Optional[str],
+                      impls: List[Callable]) -> str:
+    """The backend name a plan row reports: the explicit argument when
+    one was given, else the name the selector actually resolved for the
+    (first) bucket — so ``dump-live`` shows a plan-driven
+    "hierarchical" pick instead of an empty config default (build-time
+    only; mixed per-bucket picks report the first + "+")."""
+    if backend:
+        return backend
+    if not impls:
+        return ""
+    names = {selector.name_of(op, f) for f in impls}
+    first = selector.name_of(op, impls[0])
+    return first if len(names) == 1 else first + "+"
+
+
 def _build_in_axis(key: tuple, op: str, tree, leaves, treedef, avals,
                    axes: Tuple[str, ...], backend: Optional[str],
                    params: dict, mesh) -> CollectivePlan:
@@ -494,8 +552,12 @@ def _build_in_axis(key: tuple, op: str, tree, leaves, treedef, avals,
                                         spec=spec, impls=impls, **pd)
 
             return CollectivePlan(key, "in_axis-fused", op,
-                                  backend=backend or "", nbytes=nbytes,
+                                  backend=_resolved_backend(
+                                      op, backend, impls),
+                                  nbytes=nbytes,
                                   spec=spec, impls=impls, obs=obs_on,
+                                  topology=topology_of(
+                                      mesh, _topo_sizes(mesh, axes)),
                                   replay=_replay)
 
     # Fused reduce_scatter: tile-interleaved layout, leaf-granularity
@@ -524,9 +586,12 @@ def _build_in_axis(key: tuple, op: str, tree, leaves, treedef, avals,
                         tree, axes, spec=spec, impls=impls, n=n, **pd)
 
                 return CollectivePlan(key, "in_axis-fused", op,
-                                      backend=backend or "",
+                                      backend=_resolved_backend(
+                                          op, backend, impls),
                                       nbytes=nbytes, spec=spec,
                                       impls=impls, obs=obs_on,
+                                      topology=topology_of(
+                                          mesh, _topo_sizes(mesh, axes)),
                                       replay=_replay)
 
     # Per-leaf: one pre-picked implementation per leaf (the tree.map
@@ -544,8 +609,10 @@ def _build_in_axis(key: tuple, op: str, tree, leaves, treedef, avals,
         return jax.tree.unflatten(
             treedef, [f(v, axes, **pd) for f, v in zip(impls, ls)])
 
-    return CollectivePlan(key, "in_axis", op, backend=backend or "",
+    return CollectivePlan(key, "in_axis", op,
+                          backend=_resolved_backend(op, backend, impls),
                           nbytes=nbytes, impls=impls, obs=obs_on,
+                          topology=topology_of(mesh, _topo_sizes(mesh, axes)),
                           replay=_replay)
 
 
@@ -589,6 +656,8 @@ def plan_gradsync(grads, axes: Tuple[str, ...], *, op: str, n_buckets: int,
         return CollectivePlan(key, "gradsync", "allreduce",
                               backend=backend or "", nbytes=nbytes,
                               spec=spec, impls=impls,
+                              topology=topology_of(mesh,
+                                                   _topo_sizes(mesh, axes)),
                               obs=eff.obs != "off", replay=_replay)
 
     return _get_or_build(key, build)
@@ -596,12 +665,16 @@ def plan_gradsync(grads, axes: Tuple[str, ...], *, op: str, n_buckets: int,
 
 def plan_overlap(template_leaves, axes: Tuple[str, ...], *, op: str,
                  backend: Optional[str], compress: Optional[str],
-                 max_bytes: int) -> Optional[CollectivePlan]:
+                 max_bytes: int,
+                 dcn_codec: Optional[str] = None) -> Optional[CollectivePlan]:
     """Decision-only plan for the backprop-overlap schedule: the
     reverse-order bucket assignment (``extra["firing"]``) and each
     bucket's pre-picked allreduce implementation (``impls``, indexed in
     firing order).  ``gradsync.make_overlapped_grad_fn`` consumes both
-    when building its custom_vjp chain."""
+    when building its custom_vjp chain.  With ``dcn_codec`` (the
+    error-feedback path) the buckets dispatch the FIXED two-level
+    schedule — no selector picks are made and the plan row reports the
+    codec, not a backend that never runs."""
     if not _enabled:
         return None
     avals = _avals(template_leaves)
@@ -609,7 +682,7 @@ def plan_overlap(template_leaves, axes: Tuple[str, ...], *, op: str,
         return None
     mesh = runtime.current_mesh() if runtime.is_initialized() else None
     key = ("overlap", avals, axes, op, backend, compress, int(max_bytes),
-           mesh, _epoch())
+           dcn_codec, mesh, _epoch())
 
     def build():
         from . import collectives as C
@@ -618,19 +691,26 @@ def plan_overlap(template_leaves, axes: Tuple[str, ...], *, op: str,
         cfg = _cfg()
         eff = runtime.effective_config()
         firing = gradsync.assign_overlap_buckets(template_leaves, max_bytes)
-        impls = []
-        for bucket in firing:
-            total = sum(int(np.prod(avals[i][0])) for i in bucket)
-            wire_dt = (np.dtype("bfloat16") if compress == "bf16"
-                       else np.dtype(avals[bucket[0]][1]))
-            impls.append(C._pick(
-                "allreduce", jax.ShapeDtypeStruct((total,), wire_dt),
-                backend, axes, mesh=mesh, cfg=cfg))
+        if dcn_codec is not None:
+            impls = [None] * len(firing)
+            label = f"dcn-{dcn_codec}"
+        else:
+            impls = []
+            for bucket in firing:
+                total = sum(int(np.prod(avals[i][0])) for i in bucket)
+                wire_dt = (np.dtype("bfloat16") if compress == "bf16"
+                           else np.dtype(avals[bucket[0]][1]))
+                impls.append(C._pick(
+                    "allreduce", jax.ShapeDtypeStruct((total,), wire_dt),
+                    backend, axes, mesh=mesh, cfg=cfg))
+            label = backend or ""
         nbytes = sum(int(np.prod(s)) * np.dtype(d).itemsize
                      for s, d in avals)
         return CollectivePlan(key, "overlap", "allreduce",
-                              backend=backend or "", nbytes=nbytes,
+                              backend=label, nbytes=nbytes,
                               impls=impls, obs=eff.obs != "off",
+                              topology=topology_of(mesh,
+                                                   _topo_sizes(mesh, axes)),
                               extra={"firing": firing,
                                      "max_bytes": int(max_bytes)})
 

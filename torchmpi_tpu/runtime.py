@@ -171,6 +171,18 @@ def _normalize_overlap(value) -> Optional[str]:
     return None
 
 
+def _normalize_dcn_compress(value) -> Optional[str]:
+    """Canonical dcn_compress codec for a config/env value:
+    "off"|"bf16"|"int8"|"fp8" (case-insensitive; boolean-ish off
+    spellings accepted).  None = unrecognized (the caller raises)."""
+    v = str(value).strip().lower()
+    if v in ("off", "0", "false", "no", "none", ""):
+        return "off"
+    if v in ("bf16", "int8", "fp8"):
+        return v
+    return None
+
+
 def _normalize_faults(value) -> str:
     """Canonical faults mode for a config/env value: "off", "policy",
     or a fault-plan path (kept verbatim).  Boolean-ish spellings map to
@@ -346,6 +358,27 @@ def init(config: Optional[Config] = None, **overrides) -> Mesh:
             raise ValueError(
                 f"config.gradsync_overlap_bytes must be >= 0 (0 = derive "
                 f"from the tuning plan), got {cfg.gradsync_overlap_bytes}")
+
+        # Two-level DCN staging knobs (docs/HIERARCHICAL.md): same
+        # any-config env pickup + one-home normalization as the layers
+        # above.  The codec itself is resolved at trace/plan-build time
+        # — "off" never imports torchmpi_tpu.compress.
+        if _normalize_dcn_compress(cfg.dcn_compress) == "off":
+            cfg.dcn_compress = os.environ.get("TORCHMPI_TPU_DCN_COMPRESS",
+                                              "off")
+        cfg.dcn_compress = _normalize_dcn_compress(cfg.dcn_compress)
+        if cfg.dcn_compress is None:
+            raise ValueError(
+                "config.dcn_compress (or TORCHMPI_TPU_DCN_COMPRESS) must "
+                "be off|bf16|int8|fp8")
+        _env_default_pickup(cfg, "dcn_compress_min_bytes",
+                            "TORCHMPI_TPU_DCN_COMPRESS_MIN_BYTES", int)
+        _env_default_pickup(cfg, "dcn_chunk_bytes",
+                            "TORCHMPI_TPU_DCN_CHUNK_BYTES", int)
+        if cfg.dcn_compress_min_bytes < 0 or cfg.dcn_chunk_bytes < 0:
+            raise ValueError(
+                "config.dcn_compress_min_bytes and dcn_chunk_bytes must "
+                "be >= 0 (0 = no floor / no chunking)")
 
         if cfg.coordinator_address is None:
             coord = os.environ.get("TORCHMPI_TPU_COORDINATOR")
@@ -560,6 +593,15 @@ def set_config(**kw) -> None:
             if v < 0:
                 raise ValueError(
                     "config.gradsync_overlap_bytes must be >= 0")
+        if k == "dcn_compress":
+            v = _normalize_dcn_compress(v)
+            if v is None:
+                raise ValueError(
+                    "config.dcn_compress must be off|bf16|int8|fp8")
+        if k in ("dcn_compress_min_bytes", "dcn_chunk_bytes"):
+            v = int(v)
+            if v < 0:
+                raise ValueError(f"config.{k} must be >= 0")
         if k == "ps_timeout_s":
             v = float(v)
             if v < 0:

@@ -28,7 +28,8 @@ below ``custom_min_bytes`` and key plan entries at sizes nobody measured.
 
 from __future__ import annotations
 
-from typing import Callable, Dict, Optional
+import warnings
+from typing import Callable, Dict, Optional, Tuple
 
 import numpy as np
 
@@ -133,6 +134,11 @@ def select(
                 and nbytes < custom_min_bytes):
             name = "xla"
         elif name == "hierarchical" and n_dcn <= 1:
+            # Topology degradation must be VISIBLE: a requested
+            # two-level backend silently running flat is exactly the
+            # misconfiguration (wrong dcn_size, collapsed mesh) that
+            # otherwise only shows up as a missing perf win.
+            _note_fallback(op, name, "flat mesh (n_dcn <= 1)")
             name = "xla"
         elif name not in impls:
             name = "xla"
@@ -142,6 +148,39 @@ def select(
             f"(available: {sorted(impls)})"
         )
     return impls[name]
+
+
+# (op, backend) pairs already warned about this process: the warning is
+# one-time per pair (a hot loop degrading every dispatch must not spam),
+# while the obs counter counts every degradation.
+_warned_fallbacks: set = set()
+
+
+def _note_fallback(op: str, backend: str, reason: str, *,
+                   target: str = "'xla'") -> None:
+    """Surface a topology/availability degradation: a one-time
+    ``RuntimeWarning`` per (op, backend) plus the
+    ``tm_selector_fallback_total`` counter when obs is on — so
+    ``obs_tool`` dumps show a requested "hierarchical" that silently
+    ran flat (ISSUE 8 satellite; docs/HIERARCHICAL.md).  ``target``
+    names what actually ran: :func:`select` degrades to the stock
+    'xla' impl, while the error-feedback flat-span callers degrade to
+    the plain uncompressed sync path (which routes through the
+    selector as usual)."""
+    key: Tuple[str, str] = (op, backend)
+    if key not in _warned_fallbacks:
+        _warned_fallbacks.add(key)
+        warnings.warn(
+            f"collective {op!r}: {backend!r} requested but degraded "
+            f"to {target} ({reason}); check dcn_size/mesh_shape "
+            f"if a two-level topology was intended",
+            RuntimeWarning, stacklevel=4)
+    from . import runtime
+
+    if runtime.effective_config().obs != "off":
+        from . import obs
+
+        obs.record_selector_fallback(op, backend)
 
 
 def name_of(op: str, impl: Callable) -> str:

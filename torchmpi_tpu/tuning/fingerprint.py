@@ -47,6 +47,33 @@ def mesh_key(mesh, axes=None) -> str:
                     if a in sel)
 
 
+def topology(mesh=None, sizes=None, axes=None) -> str:
+    """The ``n_dcn x n_ici``-style topology fingerprint of a dispatch:
+    the spanned axis extents joined major-to-minor ("2x4" on the classic
+    two-level world; "8" flat; "2x2x2" N-D).  This is the compact
+    rendering of the same information :func:`mesh_key` puts in every
+    plan-database key (axis sizes in mesh order) — the plan DB has been
+    topology-keyed since PR 1, and this helper is what makes the
+    flat-vs-hierarchical cutover READ as a per-topology decision: it is
+    stored on every ``CollectivePlan`` and shown by ``plan_tool.py
+    dump-live`` (docs/HIERARCHICAL.md).
+
+    ``sizes`` (explicit extents) wins over ``mesh``; ``axes`` restricts
+    a mesh to the spanned subset like :func:`mesh_key`."""
+    if sizes:
+        return "x".join(str(int(s)) for s in sizes)
+    if mesh is not None:
+        try:
+            if axes is not None:
+                sel = set(axes)
+                return "x".join(str(int(s)) for a, s in mesh.shape.items()
+                                if a in sel)
+            return "x".join(str(int(s)) for s in mesh.devices.shape)
+        except Exception:  # noqa: BLE001 — a label must never fail a plan
+            return ""
+    return ""
+
+
 def platform_of(mesh) -> str:
     try:
         # flatiter indexing: O(1), no device-list materialization on the
