@@ -1,0 +1,291 @@
+"""Continuous-batching vs static-batching serving benchmark
+(ISSUE 9 acceptance; docs/SERVING.md).
+
+One synthetic Poisson arrival trace (fixed prompt length, MIXED decode
+lengths — the traffic shape continuous batching exists for) served two
+ways over the same checkpoint:
+
+- **continuous** — ``torchmpi_tpu.serving.Server``: iteration-level
+  admission into slot blocks, immediate retirement, virtual clock
+  advanced by each tick's measured wall time;
+- **static** — the classic offline semantics over
+  ``models.generate.generate``: wait until a full batch has ARRIVED,
+  run every member to the batch's longest decode length, deliver
+  results at batch completion (which is when the offline API returns
+  them — its TTFT is honestly its completion time).
+
+Reported (the ``SERVING-SUMMARY`` line CI asserts on):
+
+- token throughput = useful tokens / summed compute seconds for each
+  system (idle queue gaps excluded from both) — continuous wins by not
+  burning steps on retired rows and not idling short rows to the batch
+  straggler;
+- mean TTFT on the shared virtual clock (arrival -> first token);
+- ``bitwise`` — every request's continuous tokens equal the offline
+  ``generate`` oracle token for token (greedy);
+- with ``--chaos``: a deterministic fault plan hard-kills one of two
+  replicas mid-trace; the run must still complete, re-route > 0
+  sessions, and stay token-exact (``CHAOS-SUMMARY`` line).
+
+Exits nonzero unless continuous >= --min-speedup x static throughput
+AND continuous mean TTFT < static AND bitwise holds (and the chaos
+phase, when run, drained + re-routed).  Run under obs
+(``TORCHMPI_TPU_OBS=metrics``) to get the ``tm_serving_*`` SLO
+histograms; ``scripts/obs_tool.py slo`` renders them.
+
+Usage::
+
+    JAX_PLATFORMS=cpu TORCHMPI_TPU_OBS=metrics \
+        python benchmarks/serving_bench.py --requests 48 --chaos
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def build_trace(rng, n, tp, lens, inter_arrival_s, vocab):
+    import numpy as np
+
+    from torchmpi_tpu import serving
+
+    prompts = rng.randint(0, vocab, size=(n, tp)).astype(np.int32)
+    max_news = [int(lens[i % len(lens)]) for i in
+                rng.permutation(n)]
+    gaps = rng.exponential(inter_arrival_s, size=n)
+    arrivals = np.cumsum(gaps)
+    return [serving.Request(f"q{i}", prompts[i], max_new=max_news[i],
+                            arrival_s=float(arrivals[i]))
+            for i in range(n)]
+
+
+def offline_oracle(model, params, reqs):
+    """Per-request offline greedy decode — THE token reference."""
+    import numpy as np
+
+    from torchmpi_tpu.models import generate
+
+    out = {}
+    for r in reqs:
+        toks = np.asarray(generate(
+            model, params, np.asarray(r.prompt).reshape(1, -1),
+            steps=r.max_new))
+        out[r.rid] = toks[0, len(r.prompt):].tolist()
+    return out
+
+
+def run_static(model, params, reqs, batch_size, slot_tokens):
+    """Static-batch SEMANTICS through the same engine mechanics: wait
+    until a full batch has arrived, admit it whole, run every member to
+    the batch's longest decode (each tick steps all ``batch_size`` slot
+    rows whether or not a short row already finished — exactly the
+    run-to-longest cost), deliver at batch completion, admit nothing
+    mid-batch.
+
+    Same compiled ``[S, 1]`` step and prefill executables as the
+    continuous server (same slots, same model clone), so the comparison
+    isolates the SCHEDULING property — iteration-level admission +
+    early retirement — instead of dispatch mechanics.  (The
+    fully-offline ``models.generate`` scan amortizes its whole decode
+    inside one XLA dispatch and is the TOKEN oracle, not the latency
+    baseline: no server can batch requests that have not arrived.)
+
+    The clock is the same work-unit clock the continuous run uses (one
+    unit = one prefill or one step invocation), so both schedules are
+    deterministic and the throughput ratio is a pure invocation-count
+    ratio of IDENTICAL executables — immune to container noise; wall
+    time is measured alongside as the per-unit cost evidence.
+
+    Returns (per-rid tokens, work_units, wall_s, mean_ttft_units)."""
+    import numpy as np
+
+    from torchmpi_tpu import serving
+
+    ordered = [serving.Request(r.rid, r.prompt, r.max_new,
+                               eos_id=r.eos_id, arrival_s=r.arrival_s)
+               for r in sorted(reqs, key=lambda r: r.arrival_s)]
+    eng = serving.ReplicaEngine(model, params, name="static",
+                                slots=batch_size,
+                                slot_tokens=slot_tokens)
+    tokens, clock, ttfts = {}, 0.0, []
+    wall0 = time.monotonic()
+    for i in range(0, len(ordered), batch_size):
+        batch = ordered[i:i + batch_size]
+        start = max(clock, max(r.arrival_s for r in batch))
+        units0 = eng.stats["prefills"] + eng.stats["steps"]
+        finished = []
+        for r in batch:
+            sess, done = eng.admit(r)
+            if done:
+                finished.append(sess)
+        while eng.active:
+            _, fin = eng.step()
+            finished.extend(fin)
+        clock = start + (eng.stats["prefills"] + eng.stats["steps"]
+                         - units0)
+        for sess in finished:
+            tokens[sess.request.rid] = list(sess.emitted)
+            ttfts.append(clock - sess.request.arrival_s)
+    wall = time.monotonic() - wall0
+    work = eng.stats["prefills"] + eng.stats["steps"]
+    return tokens, work, wall, float(np.mean(ttfts))
+
+
+def run_chaos(model, params, args, rng, vocab):
+    """Two replicas, deterministic mid-trace hard kill: the server must
+    drain + re-route and stay token-exact."""
+    import torchmpi_tpu as mpi
+    from torchmpi_tpu import serving
+
+    plan = {"version": 1, "seed": args.seed, "note": "serving kill",
+            "rules": [{"site": "serving.replica", "kind": "fail",
+                       "prob": 1.0, "after": args.chaos_after,
+                       "max_hits": 1}]}
+    path = os.path.join(tempfile.mkdtemp(prefix="serving_chaos_"),
+                        "plan.json")
+    with open(path, "w") as f:
+        json.dump(plan, f)
+    reqs = build_trace(rng, args.requests, args.prompt_len,
+                       args.lens, 0.01, vocab)
+    oracle = offline_oracle(model, params, reqs)
+    mpi.set_config(faults=path)
+    try:
+        srv = serving.Server(model, params, replicas=2,
+                             slots=args.slots,
+                             slot_tokens=args.slot_tokens)
+        done = srv.run_trace(reqs, tick_seconds=0.005)
+    finally:
+        mpi.set_config(faults="off")
+    dead = [e.name for e in srv.router.replicas if e.dead]
+    rerouted = sum(r.reroutes for r in reqs)
+    ok = (len(done) == len(reqs) and len(dead) == 1 and rerouted > 0
+          and all(r.tokens == oracle[r.rid] for r in reqs))
+    print(f"CHAOS-SUMMARY requests={len(reqs)} dead={','.join(dead)} "
+          f"rerouted={rerouted} "
+          f"bitwise={'ok' if ok else 'FAIL'} "
+          f"verdict={'drain-reroute-ok' if ok else 'FAIL'}")
+    return ok, rerouted
+
+
+def main():
+    p = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0])
+    p.add_argument("--requests", type=int, default=64)
+    p.add_argument("--prompt-len", type=int, default=6)
+    p.add_argument("--lens", type=int, nargs="+",
+                   default=[4, 8, 16, 56],
+                   help="decode-length mix (static pays the longest "
+                        "per batch, so the tail sets its waste)")
+    p.add_argument("--slots", type=int, default=8,
+                   help="slot blocks per replica; also the static "
+                        "batch size")
+    p.add_argument("--slot-tokens", type=int, default=64)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--load", type=float, default=1.05,
+                   help="offered load vs measured continuous capacity "
+                        "(>1 = saturating: throughput is the verdict "
+                        "metric; TTFT then includes queueing, which is "
+                        "exactly where static batching loses hardest)")
+    p.add_argument("--embed", type=int, default=64)
+    p.add_argument("--min-speedup", type=float, default=1.5)
+    p.add_argument("--chaos", action="store_true",
+                   help="also run the replica-kill phase")
+    p.add_argument("--chaos-after", type=int, default=20,
+                   help="site arrivals before the planned kill")
+    args = p.parse_args()
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    import torchmpi_tpu as mpi
+    from torchmpi_tpu import serving
+    from torchmpi_tpu.models import TransformerLM
+
+    mpi.init()
+    vocab = 64
+    model = TransformerLM(vocab=vocab, embed=args.embed, depth=2,
+                          num_heads=4, head_dim=8,
+                          max_len=max(args.slot_tokens, 64),
+                          pos_emb="rope")
+    params = model.init(jax.random.PRNGKey(args.seed + 1),
+                        jnp.zeros((1, args.prompt_len),
+                                  jnp.int32))["params"]
+    rng = np.random.RandomState(args.seed)
+
+    # Warmup: one saturating trace pays the prefill/step compiles so
+    # the timed phases run warm executables only.
+    srv = serving.Server(model, params, replicas=1, slots=args.slots,
+                         slot_tokens=args.slot_tokens)
+    srv.run_trace(build_trace(rng, args.slots, args.prompt_len,
+                              [max(args.lens)], 0.0, vocab))
+
+    # Poisson arrivals on the WORK-UNIT clock (one unit = one compiled
+    # prefill or step invocation): offered token rate = load x the
+    # slots-per-step capacity.  Deterministic in the seed — scheduling
+    # never depends on wall noise.
+    mean_len = float(np.mean(args.lens))
+    inter_arrival = mean_len / (args.load * args.slots)
+    reqs = build_trace(rng, args.requests, args.prompt_len, args.lens,
+                       inter_arrival, vocab)
+    oracle = offline_oracle(model, params, reqs)
+
+    srv = serving.Server(model, params, replicas=1, slots=args.slots,
+                         slot_tokens=args.slot_tokens)
+    wall0 = time.monotonic()
+    done = srv.run_trace(reqs, unit_seconds=1.0)
+    cont_wall = time.monotonic() - wall0
+    eng = srv.router.replicas[0]
+    cont_work = eng.stats["prefills"] + eng.stats["steps"]
+    n_tok = sum(len(r.tokens) for r in reqs)
+    cont_ttft_u = float(np.mean([r.ttft_s for r in reqs]))
+    bitwise = all(r.tokens == oracle[r.rid] for r in reqs) \
+        and len(done) == len(reqs)
+
+    static_toks, static_work, static_wall, static_ttft_u = run_static(
+        model, params, reqs, args.slots, args.slot_tokens)
+    bitwise = bitwise and all(static_toks[r.rid] == oracle[r.rid]
+                              for r in reqs)
+
+    # Throughput ratio = invocation-count ratio of the SAME two
+    # executables; wall tok/s uses each phase's own measured unit cost.
+    speedup = static_work / cont_work
+    cont_tps = n_tok / cont_wall
+    static_tps = n_tok / static_wall
+    unit_ms = (cont_wall + static_wall) / (cont_work + static_work) * 1e3
+
+    chaos_ok, rerouted = (True, 0)
+    if args.chaos:
+        chaos_ok, rerouted = run_chaos(model, params, args, rng, vocab)
+
+    good = (bitwise and speedup >= args.min_speedup
+            and cont_ttft_u < static_ttft_u and chaos_ok)
+    print(f"SERVING-SUMMARY requests={len(reqs)} tokens={n_tok} "
+          f"cont_work={cont_work} static_work={static_work} "
+          f"speedup={speedup:.2f} "
+          f"cont_tok_s={cont_tps:.1f} static_tok_s={static_tps:.1f} "
+          f"unit_ms={unit_ms:.2f} "
+          f"cont_ttft_ms={cont_ttft_u * unit_ms:.1f} "
+          f"static_ttft_ms={static_ttft_u * unit_ms:.1f} "
+          f"bitwise={'ok' if bitwise else 'FAIL'} "
+          f"rerouted={rerouted} "
+          f"verdict="
+          f"{'continuous-beats-static' if good else 'FAIL'}")
+    if not good:
+        print(f"FAIL: need speedup >= {args.min_speedup}, lower TTFT, "
+              f"bitwise tokens"
+              + (", and a drained+re-routed chaos phase"
+                 if args.chaos else ""), file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

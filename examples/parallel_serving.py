@@ -51,13 +51,9 @@ def main():
     prompt = np.random.RandomState(args.seed + 1).randint(
         0, V, size=(B, 4)).astype(np.int32)
 
-    # Dense oracle: the test suite's cache-free reference implementation
-    # (tests/_tp_oracle.py) — ONE copy of the oracle math, shared.
-    import os
-    import sys
-    sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), "tests"))
-    from _tp_oracle import dense_greedy
+    # Dense oracle: the shared cache-free reference implementation
+    # (torchmpi_tpu.models.oracle) — ONE copy of the oracle math.
+    from torchmpi_tpu.models.oracle import dense_greedy
 
     toks = dense_greedy(params, prompt, steps, num_heads=8)
 

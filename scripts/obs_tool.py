@@ -11,7 +11,13 @@ This tool is the operator surface over those files:
     python scripts/obs_tool.py diff  BEFORE AFTER
     python scripts/obs_tool.py prom  FILE [FILE ...]
     python scripts/obs_tool.py blame FLIGHT [FLIGHT ...]
+    python scripts/obs_tool.py slo   FILE [FILE ...]
 
+``slo`` reads a serving session's metric dumps and prints per-replica
+p50/p95/p99 time-to-first-token and inter-token latency from the
+``tm_serving_ttft_us`` / ``tm_serving_itl_us`` histograms
+(docs/SERVING.md) — percentiles are upper log2-bucket edges, i.e.
+conservative to within 2x, which is what a latency SLO check wants.
 ``dump`` validates and pretty-prints any obs file.  ``agg`` sums
 counters and merges histograms across per-host metric files (the
 fleet view).  ``diff`` prints per-series counter deltas between two
@@ -182,6 +188,70 @@ def cmd_prom(args) -> int:
     return 0
 
 
+def _hist_percentile(buckets: Dict[str, int], count: int,
+                     q: float) -> float:
+    """Approximate quantile from log2 buckets: the UPPER edge
+    ``2**(b+1)`` of the first bucket whose cumulative count reaches
+    ``q * count`` — conservative (never under-reports a latency)."""
+    target = q * count
+    acc = 0
+    for b, c in sorted(buckets.items(), key=lambda kv: int(kv[0])):
+        acc += c
+        if acc >= target:
+            return float(2 ** (int(b) + 1))
+    return 0.0
+
+
+def cmd_slo(args) -> int:
+    snap = aggregate(args.files)
+    series: Dict[Tuple[str, str], dict] = {}
+    counters: Dict[Tuple[str, str], float] = {}
+    for rec in snap:
+        rep = rec.get("labels", {}).get("replica", "")
+        if rec["kind"] == "hist" and rec["name"] in (
+                "tm_serving_ttft_us", "tm_serving_itl_us"):
+            kind = "ttft" if "ttft" in rec["name"] else "itl"
+            series[(rep, kind)] = rec
+        elif rec["kind"] == "counter" and \
+                rec["name"].startswith("tm_serving_"):
+            # aggregate() already merged each (name, labels) series to
+            # one record — plain assignment states that invariant.
+            counters[(rep, rec["name"])] = rec["value"]
+    if not series:
+        print("no tm_serving_* latency histograms in the given files "
+              "(was the session a serving run with obs active?)",
+              file=sys.stderr)
+        return 2
+    replicas = sorted({rep for rep, _ in series})
+    print(f"serving SLO percentiles over {len(args.files)} file(s) "
+          f"(upper log2-bucket edges):")
+    for rep in replicas:
+        parts = []
+        for kind, label in (("ttft", "TTFT"), ("itl", "inter-token")):
+            rec = series.get((rep, kind))
+            if rec is None or not rec.get("count"):
+                continue
+            ps = {p: _hist_percentile(rec.get("buckets", {}),
+                                      rec["count"], p / 100.0) / 1e3
+                  for p in (50, 95, 99)}
+            mean = rec["sum"] / rec["count"] / 1e3
+            parts.append(
+                f"{label} p50<={ps[50]:g}ms p95<={ps[95]:g}ms "
+                f"p99<={ps[99]:g}ms mean={mean:.3g}ms n={rec['count']}")
+        extras = []
+        for cname in ("tm_serving_requests_total",
+                      "tm_serving_completed_total",
+                      "tm_serving_rerouted_total",
+                      "tm_serving_rejected_total"):
+            v = counters.get((rep, cname))
+            if v:
+                extras.append(f"{cname.split('_')[2]}={int(v)}")
+        rep_name = rep or "<all>"
+        tail = f"  [{' '.join(extras)}]" if extras else ""
+        print(f"  {rep_name}: " + " | ".join(parts) + tail)
+    return 0
+
+
 def _event_sig(e: dict) -> Tuple:
     """What must agree across an SPMD gang at one seq: the event type,
     op, and payload (backend compared too — hosts replaying divergent
@@ -270,6 +340,12 @@ def main(argv=None) -> int:
                                      "name the first diverging collective")
     s.add_argument("files", nargs="+")
     s.set_defaults(fn=cmd_blame)
+
+    s = sub.add_parser("slo", help="per-replica p50/p95/p99 TTFT and "
+                                   "inter-token latency from a serving "
+                                   "session's metric dumps")
+    s.add_argument("files", nargs="+")
+    s.set_defaults(fn=cmd_slo)
 
     args = p.parse_args(argv)
     try:

@@ -126,9 +126,7 @@ print(f"CHECK rank={pid} zero3 ok", flush=True)
 # TP serving across the process boundary: the decode's per-sublayer
 # psum and per-token head all_gather ride the same gloo DCN backend the
 # training collectives use; tokens must equal the local dense oracle.
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-from _tp_oracle import dense_greedy, setup  # noqa: E402
-
+from torchmpi_tpu.models.oracle import dense_greedy, setup  # noqa: E402
 from torchmpi_tpu.models.tp_generate import tp_generate  # noqa: E402
 
 tp_params, tp_prompt = setup(seed=21, vocab=32, embed=16, depth=2,

@@ -48,6 +48,13 @@ def test_parallel_serving_example():
 
 
 @pytest.mark.slow
+def test_continuous_serving_example():
+    # Continuous-batching server over 2 device-pinned replicas; every
+    # request token-exact vs the offline generate path.
+    _run("continuous_serving.py", "--devices", "8")
+
+
+@pytest.mark.slow
 def test_lm_generate_example():
     # Serving path: train, then KV-cache decode; asserts the generated
     # continuations follow the learned next-token rule.

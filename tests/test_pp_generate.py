@@ -1,6 +1,6 @@
 """Pipeline-parallel serving tests: the round-robin micro-group decode
 over the stage axis must emit the same tokens as the cache-free dense
-oracle (tests/_tp_oracle.py — also the TP serving oracle, since both
+oracle (torchmpi_tpu.models.oracle — also the TP serving oracle, since both
 paths consume the same init_tp_lm tree)."""
 
 import jax
@@ -8,7 +8,7 @@ import numpy as np
 import pytest
 
 import torchmpi_tpu as mpi
-from _tp_oracle import dense_greedy, setup
+from torchmpi_tpu.models.oracle import dense_greedy, setup
 from torchmpi_tpu.models.pp_generate import pp_generate
 
 AXIS = ("dcn", "ici")  # 8 stages on the flat 1x8 world mesh
@@ -64,7 +64,7 @@ def test_pp_generate_eos_predicted_during_prefill(flat_runtime):
     freeze the row: that prediction is discarded (the prompt supplies
     the real token), and only generated tokens may trip EOS — the dense
     oracle's semantics."""
-    from _tp_oracle import dense_forward
+    from torchmpi_tpu.models.oracle import dense_forward
     import jax.numpy as jnp
 
     mesh = mpi.world_mesh()

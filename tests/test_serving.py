@@ -1,0 +1,372 @@
+"""Continuous-batching serving layer (torchmpi_tpu/serving/, ISSUE 9;
+docs/SERVING.md).
+
+Covers: slot-pool lifecycle invariants, iteration-level scheduling
+emitting per-request tokens BIT-IDENTICAL to the offline
+``models.generate.generate`` path (admission at token boundaries, EOS
+retirement, slot reuse without zeroing), health-routed multi-replica
+dispatch with a deterministic fault-plan replica kill (drain +
+re-route, sessions still token-exact, ``tm_serving_rerouted_total``),
+the ``tm_serving_*`` SLO telemetry + ``obs_tool slo`` rendering, and
+the off-by-default import discipline (a non-serving session never has
+``torchmpi_tpu.serving`` in ``sys.modules`` — subprocess-checked like
+analysis/obs/faults).
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import torchmpi_tpu as mpi
+from torchmpi_tpu import serving
+from torchmpi_tpu.models import TransformerLM, generate
+from torchmpi_tpu.serving.slots import SlotPool
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+VOCAB = 41
+
+
+@pytest.fixture(scope="module")
+def lm():
+    """One tiny RoPE LM shared by the module (rope: slot blocks may be
+    smaller than max_len, and the jit caches are keyed by the decode
+    clone, so every test reuses the same executables)."""
+    model = TransformerLM(vocab=VOCAB, embed=32, depth=2, num_heads=4,
+                          head_dim=8, max_len=64, pos_emb="rope")
+    params = model.init(jax.random.PRNGKey(1),
+                        jnp.zeros((1, 4), jnp.int32))["params"]
+    return model, params
+
+
+def _prompts(n, tp=5, seed=0):
+    return np.random.RandomState(seed).randint(
+        0, VOCAB, size=(n, tp)).astype(np.int32)
+
+
+def _offline(model, params, prompt, steps, eos_id=None):
+    """The static offline oracle: ``generate`` on a [1, Tp] batch."""
+    out = np.asarray(generate(model, params, prompt.reshape(1, -1),
+                              steps=steps, eos_id=eos_id))
+    return out[0, prompt.size:]
+
+
+# ---------------------------------------------------------------------------
+# Slot pool invariants
+# ---------------------------------------------------------------------------
+
+
+def test_slot_pool_lifecycle():
+    pool = SlotPool(3, slot_tokens=16)
+    assert pool.fits(16) and not pool.fits(17) and not pool.fits(0)
+    got = [pool.alloc() for _ in range(3)]
+    assert sorted(got) == [0, 1, 2]
+    assert pool.alloc() is None  # exhausted, not an error
+    assert pool.in_use == 3 and pool.occupancy_pct() == 100.0
+    pool.free(1)
+    assert pool.alloc() == 1  # LIFO reuse: the freed block comes back
+    pool.free(2)
+    with pytest.raises(ValueError, match="not allocated"):
+        pool.free(2)  # double free
+    with pytest.raises(ValueError, match="not allocated"):
+        pool.free(7)  # never allocated
+    with pytest.raises(ValueError):
+        SlotPool(0, 16)
+    with pytest.raises(ValueError):
+        SlotPool(2, 0)
+
+
+# ---------------------------------------------------------------------------
+# Continuous batching == offline generate, token for token
+# ---------------------------------------------------------------------------
+
+
+def test_continuous_matches_offline(lm):
+    model, params = lm
+    prompts = _prompts(6)
+    # Three DISTINCT lengths keep the offline oracle at three scan
+    # compiles (steps is a static argnum) while still mixing decode
+    # lengths enough that retirement interleaves with admission.
+    lens = [4, 12, 4, 8, 12, 8]
+    reqs = [serving.Request(f"r{i}", prompts[i], max_new=lens[i],
+                            arrival_s=0.002 * i) for i in range(6)]
+    srv = serving.Server(model, params, replicas=1, slots=3,
+                         slot_tokens=32)
+    done = srv.run_trace(reqs, tick_seconds=0.001)
+    assert sorted(r.rid for r in done) == sorted(r.rid for r in reqs)
+    for i, req in enumerate(reqs):
+        exp = _offline(model, params, prompts[i], lens[i])
+        assert req.tokens == exp.tolist(), (i, req.tokens, exp)
+        assert req.ttft_s is not None and req.ttft_s >= 0
+        assert req.finish_s is not None and req.latency_s() >= req.ttft_s
+    # 6 requests through 3 slot blocks: admission really was
+    # iteration-level (a static batcher would have needed 6 slots or
+    # two sequential batches — completion ISN'T in arrival order).
+    assert srv.router.replicas[0].pool.in_use == 0
+
+
+def test_eos_retirement_frees_slot_and_reuse_is_bitwise(lm):
+    model, params = lm
+    engine = serving.ReplicaEngine(model, params, slots=1,
+                                   slot_tokens=32)
+    pa, pb = _prompts(2, seed=3)
+    # EOS chosen as a token request A actually emits mid-stream, so the
+    # retirement path (not the budget path) frees the slot.
+    free_run = _offline(model, params, pa, 8)
+    eos = next(int(t) for t in free_run[1:] if t != free_run[0])
+    exp_a = _offline(model, params, pa, 8, eos_id=eos)
+
+    ra = serving.Request("a", pa, max_new=8, eos_id=eos)
+    sess_a, done = engine.admit(ra)
+    assert sess_a.slot == 0 and not done
+    emitted = list(sess_a.emitted)
+    while not engine.pool.in_use == 0:
+        _, finished = engine.step()
+        if finished:
+            emitted = finished[0].emitted
+    # EOS retired the session early and freed the block.
+    assert emitted[-1] == eos and len(emitted) < 8
+    assert emitted == exp_a.tolist()[:len(emitted)]
+    assert engine.pool.free_count == 1
+
+    # Reuse the SAME block (no zeroing) for an unrelated request: its
+    # tokens must equal a fresh static-batch decode bit for bit.
+    rb = serving.Request("b", pb, max_new=9)
+    sess_b, done = engine.admit(rb)
+    assert sess_b.slot == 0 and not done  # the reused block
+    toks = list(sess_b.emitted)
+    while engine.pool.in_use:
+        _, finished = engine.step()
+        if finished:
+            toks = finished[0].emitted
+    exp_b = _offline(model, params, pb, 9)
+    assert toks == exp_b.tolist()
+
+
+def test_request_that_cannot_fit_a_block_is_rejected(lm):
+    model, params = lm
+    engine = serving.ReplicaEngine(model, params, slots=2,
+                                   slot_tokens=16)
+    req = serving.Request("big", _prompts(1)[0], max_new=12)  # 5+12 > 16
+    with pytest.raises(ValueError, match="slot block"):
+        engine.admit(req)
+    # Server level: the bad request is rejected with .error set and
+    # everyone else still serves — one unservable request must not
+    # abort the trace.
+    prompts = _prompts(3, seed=11)
+    reqs = [serving.Request("ok0", prompts[0], max_new=4),
+            serving.Request("big", prompts[1], max_new=99),
+            serving.Request("ok1", prompts[2], max_new=4)]
+    srv = serving.Server(model, params, replicas=1, slots=2,
+                         slot_tokens=32)
+    done = srv.run_trace(reqs, tick_seconds=0.001)
+    assert len(done) == 3
+    bad = next(r for r in done if r.rid == "big")
+    assert bad.error and "slot block" in bad.error and not bad.tokens
+    for rid, i in (("ok0", 0), ("ok1", 2)):
+        good = next(r for r in done if r.rid == rid)
+        assert good.error is None
+        assert good.tokens == _offline(model, params, prompts[i],
+                                       4).tolist()
+
+
+def test_failed_prefill_does_not_leak_slot(lm, monkeypatch):
+    import torchmpi_tpu.serving.engine as eng_mod
+
+    model, params = lm
+    engine = serving.ReplicaEngine(model, params, slots=1,
+                                   slot_tokens=32)
+    monkeypatch.setattr(
+        eng_mod, "slot_prefill",
+        lambda *a, **k: (_ for _ in ()).throw(RuntimeError("exploded")))
+    with pytest.raises(RuntimeError, match="exploded"):
+        engine.admit(serving.Request("x", _prompts(1)[0], max_new=4))
+    # The block came back: after `slots` such failures the pool would
+    # otherwise be silently full forever.
+    assert engine.pool.free_count == 1
+
+
+def test_learned_pos_requires_full_size_blocks():
+    # Constructor-time validation only: no prefill/step runs, so dummy
+    # params suffice (the pool cache comes from eval_shape — abstract).
+    model = TransformerLM(vocab=VOCAB, embed=16, depth=1, num_heads=2,
+                          head_dim=8, max_len=32, pos_emb="learned")
+    with pytest.raises(ValueError, match="rope"):
+        serving.ReplicaEngine(model, {}, slots=2, slot_tokens=16)
+    # Full-size blocks are fine for learned tables.
+    serving.ReplicaEngine(model, {}, slots=2, slot_tokens=32)
+
+
+# ---------------------------------------------------------------------------
+# Health-routed multi-replica dispatch + deterministic replica kill
+# ---------------------------------------------------------------------------
+
+
+def _write_kill_plan(path, after=6):
+    plan = {"version": 1, "seed": 3, "note": "serving chaos",
+            "rules": [{"site": "serving.replica", "kind": "fail",
+                       "prob": 1.0, "after": after, "max_hits": 1}]}
+    path.write_text(json.dumps(plan))
+    return str(path)
+
+
+def test_replica_kill_drains_and_reroutes(lm, tmp_path):
+    model, params = lm
+    mpi.stop()
+    mpi.init(mpi.Config(dcn_size=1,
+                        faults=_write_kill_plan(tmp_path / "plan.json"),
+                        obs="metrics", obs_dir=str(tmp_path / "obs")))
+    try:
+        from torchmpi_tpu import faults, obs
+
+        obs.reset()
+        faults.ledger().clear()
+        prompts = _prompts(10, seed=5)
+        # Same three distinct lengths as the offline-match test: the
+        # oracle scan executables are already compiled.
+        lens = [4, 12, 4, 8, 12, 8, 4, 12, 8, 4]
+        reqs = [serving.Request(f"k{i}", prompts[i], max_new=lens[i],
+                                arrival_s=0.01 * i) for i in range(10)]
+        srv = serving.Server(model, params, replicas=2, slots=3,
+                             slot_tokens=32)
+        done = srv.run_trace(reqs, tick_seconds=0.01)
+        assert len(done) == 10  # the run COMPLETES despite the kill
+        dead = [e.name for e in srv.router.replicas if e.dead]
+        assert len(dead) == 1  # exactly the planned hard failure
+        rerouted = obs.registry().counter_total(
+            "tm_serving_rerouted_total")
+        assert rerouted > 0
+        assert sum(r.reroutes for r in reqs) == rerouted
+        # Every request — including the re-routed ones — still matches
+        # the offline oracle token for token (greedy re-prefill from
+        # the emitted prefix is exact).
+        for i, req in enumerate(reqs):
+            exp = _offline(model, params, prompts[i], lens[i])
+            assert req.tokens == exp.tolist(), (i, req.reroutes)
+        # SLO histograms landed for BOTH replicas.
+        snap = obs.registry().snapshot()
+        ttft = [r for r in snap if r["name"] == "tm_serving_ttft_us"]
+        assert ttft and sum(r["count"] for r in ttft) == 10
+    finally:
+        # stop() keeps the fault layer armed (init with faults="off"
+        # disarms stale state); later tests must not inherit it.
+        from torchmpi_tpu import faults
+
+        faults.reset()
+        mpi.stop()
+
+
+def test_router_prefers_healthy_replicas(lm):
+    from torchmpi_tpu.faults.health import HealthLedger
+
+    model, params = lm
+    e0 = serving.ReplicaEngine(model, params, name="r0", slots=2,
+                               slot_tokens=16)
+    e1 = serving.ReplicaEngine(model, params, name="r1", slots=2,
+                               slot_tokens=16)
+    # Explicit ledger: the suspect/dead thresholds under test must not
+    # depend on whether an earlier test left the fault layer armed.
+    router = serving.Router([e0, e1],
+                            ledger=HealthLedger(suspect_after=1,
+                                                dead_after=3))
+    assert router.pick() in (e0, e1)
+    router.record(e1, False)  # r1 suspect
+    assert router.decide(e1) == "degrade"
+    assert router.pick() is e0  # healthy wins while it has capacity
+    # Dead replicas never admit; drained state shows through decide().
+    router.mark_dead(e1)
+    assert router.decide(e1) == "raise"
+    assert router.pick() is e0
+    with pytest.raises(ValueError, match="unique"):
+        serving.Router([e0, e0])
+
+
+# ---------------------------------------------------------------------------
+# SLO telemetry + obs_tool slo
+# ---------------------------------------------------------------------------
+
+
+def _load_obs_tool():
+    spec = importlib.util.spec_from_file_location(
+        "_obs_tool_under_test",
+        os.path.join(_REPO, "scripts", "obs_tool.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_slo_metrics_and_obs_tool_slo(lm, tmp_path, capsys):
+    model, params = lm
+    mpi.stop()
+    mpi.init(mpi.Config(dcn_size=1, obs="metrics",
+                        obs_dir=str(tmp_path)))
+    try:
+        from torchmpi_tpu import obs
+
+        obs.reset()
+        prompts = _prompts(6, seed=7)
+        reqs = [serving.Request(f"s{i}", prompts[i], max_new=4 + i,
+                                arrival_s=0.001 * i) for i in range(6)]
+        srv = serving.Server(model, params, replicas=1, slots=4,
+                             slot_tokens=32)
+        srv.run_trace(reqs)
+        reg = obs.registry()
+        assert reg.counter_total("tm_serving_requests_total") == 6
+        assert reg.counter_total("tm_serving_completed_total") == 6
+        assert reg.counter_total("tm_serving_tokens_total") == \
+            sum(len(r.tokens) for r in reqs)
+        snap = reg.snapshot()
+        names = {r["name"] for r in snap}
+        assert {"tm_serving_ttft_us", "tm_serving_itl_us",
+                "tm_serving_queue_depth",
+                "tm_serving_slot_occupancy_pct"} <= names
+        paths = obs.dump(str(tmp_path))
+        tool = _load_obs_tool()
+        rc = tool.main(["slo", paths[0]])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "TTFT" in out and "inter-token" in out and "p99" in out
+        assert "replica0" in out
+        # And a non-serving dump exits nonzero (CI greps depend on it).
+        empty = tmp_path / "empty.jsonl"
+        empty.write_text(json.dumps(
+            {"kind": "meta", "stream": "metrics", "host": "x"}) + "\n")
+        assert tool.main(["slo", str(empty)]) == 2
+    finally:
+        mpi.stop()
+
+
+# ---------------------------------------------------------------------------
+# Off-by-default: a non-serving session never imports the package
+# ---------------------------------------------------------------------------
+
+
+def test_non_serving_session_never_imports_serving():
+    code = (
+        "import sys\n"
+        "import numpy as np\n"
+        "import torchmpi_tpu as mpi\n"
+        "mpi.init(mpi.Config(dcn_size=1))\n"
+        "mpi.allreduce(np.ones((2, 4), np.float32))\n"
+        "mpi.barrier()\n"
+        "mpi.stop()\n"
+        "assert 'torchmpi_tpu.serving' not in sys.modules, "
+        "'serving imported!'\n"
+        "print('SERVING-OFF-OK')\n"
+    )
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    out = subprocess.run([sys.executable, "-c", code],
+                         capture_output=True, text=True, timeout=300,
+                         env=env, cwd=_REPO)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "SERVING-OFF-OK" in out.stdout

@@ -1,6 +1,6 @@
 """Tensor-parallel serving tests: TP prefill+decode over the 8-device
 mesh must emit the same tokens as a dense single-device oracle running
-the identical architecture (tests/_tp_oracle.py — cache-free, so a
+the identical architecture (torchmpi_tpu.models.oracle — cache-free, so a
 cache bug cannot hide in both sides)."""
 
 import jax
@@ -8,7 +8,7 @@ import numpy as np
 import pytest
 
 import torchmpi_tpu as mpi
-from _tp_oracle import dense_greedy, setup
+from torchmpi_tpu.models.oracle import dense_greedy, setup
 from torchmpi_tpu.models.tp_generate import (tp_beam_search,
                                              tp_generate)
 
@@ -82,7 +82,7 @@ def test_tp_beam_exhaustive_at_steps2(flat_runtime):
     """beams == vocab at steps=2 IS exhaustive search: the TP beam's
     best hypothesis must score as high as brute force over all vocab^2
     continuations (scored by the dense oracle)."""
-    from _tp_oracle import seq_logprob
+    from torchmpi_tpu.models.oracle import seq_logprob
 
     mesh = mpi.world_mesh()
     params, prompt = _oracle_setup_small()

@@ -297,6 +297,23 @@ class Config:
     # unset).
     ps_timeout_s: float = 30.0
 
+    # --- continuous-batching serving (torchmpi_tpu.serving) -----------------
+    # Defaults for the off-by-default serving layer (docs/SERVING.md);
+    # the package is only ever imported by explicit use — these knobs
+    # just size it.  KV slot blocks per replica (the admission
+    # concurrency bound; cache memory = slots x serving_slot_tokens).
+    # Env: TORCHMPI_TPU_SERVING_SLOTS.
+    serving_slots: int = 8
+    # Tokens per slot block (prompt + generated must fit one block).
+    # 0 = the model's max_len.  Shrinking below max_len needs
+    # pos_emb="rope" (a learned position table is sized by max_len).
+    # Env: TORCHMPI_TPU_SERVING_SLOT_TOKENS.
+    serving_slot_tokens: int = 0
+    # Default replica count for serving.Server (data-parallel decode
+    # replicas the router spreads sessions over).
+    # Env: TORCHMPI_TPU_SERVING_REPLICAS.
+    serving_replicas: int = 1
+
     # --- distributed bring-up ----------------------------------------------
     coordinator_address: Optional[str] = None
     num_processes: Optional[int] = None
@@ -349,6 +366,10 @@ class Config:
             gradsync_average=_env_bool("TORCHMPI_TPU_GRADSYNC_AVERAGE", True),
             gradsync_compress=(
                 os.environ.get("TORCHMPI_TPU_GRADSYNC_COMPRESS") or None),
+            serving_slots=_env_int("TORCHMPI_TPU_SERVING_SLOTS", 8),
+            serving_slot_tokens=_env_int(
+                "TORCHMPI_TPU_SERVING_SLOT_TOKENS", 0),
+            serving_replicas=_env_int("TORCHMPI_TPU_SERVING_REPLICAS", 1),
             ps_port=_env_int("TORCHMPI_TPU_PS_PORT", 52312),
             ps_host=_env_str("TORCHMPI_TPU_PS_HOST", "127.0.0.1"),
             ps_num_threads=_env_int("TORCHMPI_TPU_PS_THREADS", 2),

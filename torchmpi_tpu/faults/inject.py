@@ -54,6 +54,11 @@ SITES = (
     "ps.request",           # parameter-server client enqueue leg
     "ps.response",          # parameter-server client wait leg
     "aio.submit",           # async host-IO submission
+    "serving.replica",      # one replica decode step in the continuous-
+    #                         batching server (torchmpi_tpu/serving/):
+    #                         drop = transient step failure (health
+    #                         ledger counts it), fail = the replica dies
+    #                         and its sessions drain + re-route
 )
 
 KINDS = ("delay", "drop", "corrupt", "fail")

@@ -451,6 +451,85 @@ def generate_parallel(model, params, prompt, steps: int, *, mesh,
     return fn(params, prompt, jnp.float32(temperature), rng)
 
 
+# ---------------------------------------------------------------------------
+# Slot-indexed cache plumbing (the continuous-batching serving path,
+# torchmpi_tpu/serving/ — docs/SERVING.md).  Three primitives over a
+# POOL cache whose batch dimension is the slot dimension:
+#
+# - :func:`slot_prefill`    — one request's prompt onto a FRESH [1, L]
+#   cache (the same single-forward prefill + last-position sampling as
+#   :func:`_generate_scan`, so tokens can never diverge from ``generate``);
+# - :func:`slot_write`      — copy that request's cache rows into pool
+#   row ``slot`` (admission);
+# - :func:`slot_decode_step` — ONE [S, 1] decode tick advancing every
+#   active slot at its own depth (per-row ``pos_offset`` — see
+#   ``SPAttention``); rows beyond a slot's filled prefix are masked, so
+#   REUSING a retired slot needs no zeroing to stay bit-identical to a
+#   fresh static-batch decode.
+#
+# Greedy only: iteration-level scheduling re-prefills a re-routed
+# request from its emitted prefix, which is only token-exact when
+# decoding is deterministic.
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnums=(0,))
+def _slot_prefill_jit(dmodel, params, prompt):
+    (xs, head), updated = dmodel.apply(
+        {"params": params}, prompt, pos_offset=0, return_prehead=True,
+        mutable=["cache"])
+    first = _sample(xs[:, -1] @ head, jax.random.PRNGKey(0),
+                    jnp.float32(0.0), None, None, prompt.dtype)
+    return updated["cache"], first
+
+
+def slot_prefill(dmodel, params, prompt):
+    """Prefill one request ([1, Tp] prompt) on a fresh cache; returns
+    ``(cache, first_token [1])``.  ``dmodel`` is the ``decode=True``
+    clone (one jit specialization per prompt length)."""
+    return _slot_prefill_jit(dmodel, params, jnp.asarray(prompt))
+
+
+@partial(jax.jit, static_argnums=(0,))
+def _slot_step_jit(dmodel, params, cache, tokens, positions):
+    logits, updated = dmodel.apply(
+        {"params": params, "cache": cache}, tokens[:, None],
+        pos_offset=positions, mutable=["cache"])
+    nxt = _sample(logits[:, 0], jax.random.PRNGKey(0), jnp.float32(0.0),
+                  None, None, tokens.dtype)
+    return updated["cache"], nxt
+
+
+def slot_decode_step(dmodel, params, cache, tokens, positions):
+    """One decode tick over the whole slot pool: ``tokens`` [S] are each
+    slot's pending token, ``positions`` [S] its absolute write index
+    (inactive slots pass any valid filler — their outputs are ignored
+    and their cache rows are fully overwritten on the next admission).
+    Returns ``(new_cache, next_tokens [S])``.  One compiled executable
+    serves the entire trace — admission and retirement never retrace."""
+    return _slot_step_jit(dmodel, params, cache,
+                          jnp.asarray(tokens), jnp.asarray(positions))
+
+
+@jax.jit
+def _slot_write_jit(pool_cache, one_cache, slot):
+    def put(p, o):
+        if getattr(o, "ndim", 0) >= 1 and o.shape[0] == 1 \
+                and p.ndim == o.ndim:
+            return lax.dynamic_update_slice(
+                p, o.astype(p.dtype), (slot,) + (0,) * (p.ndim - 1))
+        return p  # scalar cache leaves (the unused idx counter)
+
+    return jax.tree.map(put, pool_cache, one_cache)
+
+
+def slot_write(pool_cache, one_cache, slot: int):
+    """Copy a :func:`slot_prefill` cache (leading dim 1) into row
+    ``slot`` of the pool cache (leading dim = slot count)."""
+    return _slot_write_jit(pool_cache, one_cache,
+                           jnp.asarray(slot, jnp.int32))
+
+
 @lru_cache(maxsize=None)
 def _parallel_fn(dmodel, steps, mesh, batch_axis, top_k=None, top_p=None,
                  eos_id=None):

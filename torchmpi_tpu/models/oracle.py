@@ -1,13 +1,22 @@
-"""Shared dense oracle for the TP/PP serving tests: single-device,
-cache-free greedy decode of the init_tp_lm architecture (recomputes the
-full forward every step, so a KV-cache bug cannot hide in both sides)."""
+"""Shared dense oracle for the TP/PP/continuous-serving paths:
+single-device, cache-free greedy decode of the init_tp_lm architecture
+(recomputes the full forward every step, so a KV-cache bug cannot hide
+in both sides).
+
+Importable home (ISSUE 9 satellite): this used to live at
+``tests/_tp_oracle.py`` and ``examples/parallel_serving.py`` reached it
+through a ``sys.path.insert`` hack; now the tests, the examples, and
+the graft-entry smoke all import ONE copy as
+``torchmpi_tpu.models.oracle``.  The math stays deliberately
+independent of the serving implementations it oracles (its own
+layernorm, no KV cache, numpy-side loop)."""
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from torchmpi_tpu.models.tp_generate import init_tp_lm
-from torchmpi_tpu.models.transformer import apply_rope
+from .tp_generate import init_tp_lm
+from .transformer import apply_rope
 
 
 def _ln(h, scale, bias):

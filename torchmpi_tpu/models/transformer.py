@@ -41,15 +41,21 @@ def apply_rope(x, pos, *, base: float = 10000.0):
     to q and k before attention — relative positions then live in the
     dot products, so no learned position table exists and decode just
     rotates each new token by its absolute position (``pos`` may be
-    traced: cache index, ring-shard offset)."""
+    traced: cache index, ring-shard offset).  ``pos`` is [T] (one
+    position per timestep, shared across the batch) or [B, T] (per-ROW
+    positions — the slot-indexed continuous-batching decode, where every
+    cache row sits at its own depth)."""
     D = x.shape[-1]
     if D % 2:
         raise ValueError(f"rope requires an even head_dim, got {D}")
     half = D // 2
     inv = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
-    ang = pos.astype(jnp.float32)[:, None] * inv[None]  # [T, half]
-    cos = jnp.cos(ang)[None, :, None, :]  # [1, T, 1, half]
-    sin = jnp.sin(ang)[None, :, None, :]
+    # [T, half] or [B, T, half]; the head axis is inserted below and the
+    # leading batch axis (when absent) broadcasts — bitwise identical to
+    # the historical [1, T, 1, half] layout for 1-D pos.
+    ang = pos.astype(jnp.float32)[..., None] * inv
+    cos = jnp.cos(ang)[..., None, :]  # [(B,) T, 1, half]
+    sin = jnp.sin(ang)[..., None, :]
     x1, x2 = x[..., :half], x[..., half:]
     return jnp.concatenate([x1 * cos - x2 * sin,
                             x2 * cos + x1 * sin], axis=-1).astype(x.dtype)
@@ -158,6 +164,19 @@ class SPAttention(nn.Module):
                 q = lax.dynamic_slice_in_dim(q, h0, h_cache, 2)
                 k = lax.dynamic_slice_in_dim(k, h0, h_cache, 2)
                 v = lax.dynamic_slice_in_dim(v, h0, h_cache, 2)
+            # Slot-indexed decode (the continuous-batching serving path,
+            # torchmpi_tpu/serving/): a 1-D ``pos_offset`` gives every
+            # batch row its OWN cache position, so one [S, 1] step can
+            # advance S in-flight requests sitting at different depths.
+            # The internal ``idx`` counter is neither read nor advanced
+            # — the slot engine owns per-row positions.
+            po = jnp.asarray(pos_offset)
+            per_row = po.ndim == 1
+            if per_row and T != 1:
+                raise ValueError(
+                    "per-row pos_offset (slot-indexed decode) supports "
+                    "T == 1 steps only; prefill each request on its own "
+                    "fresh cache first")
             ck = self.variable("cache", "k", jnp.zeros,
                                (B, self.max_len, h_cache, D), jnp.float32)
             cv = self.variable("cache", "v", jnp.zeros,
@@ -165,16 +184,27 @@ class SPAttention(nn.Module):
             idx = self.variable("cache", "idx",
                                 lambda: jnp.zeros((), jnp.int32))
             start = idx.value
+            starts = po.astype(jnp.int32) if per_row else None  # [B]
             if self.rope:
                 # Rotate by absolute cache positions, THEN cache: the
                 # cache holds rotated keys, so old entries never need
                 # re-rotation as decoding advances.
-                rpos = start + jnp.arange(T)
+                rpos = (starts[:, None] + jnp.arange(T) if per_row
+                        else start + jnp.arange(T))
                 q = apply_rope(q, rpos)
                 k = apply_rope(k, rpos)
-            ck.value = lax.dynamic_update_slice(ck.value, k, (0, start, 0, 0))
-            cv.value = lax.dynamic_update_slice(cv.value, v, (0, start, 0, 0))
-            idx.value = start + T
+            if per_row:
+                row_upd = jax.vmap(
+                    lambda c, u, s: lax.dynamic_update_slice(c, u,
+                                                             (s, 0, 0)))
+                ck.value = row_upd(ck.value, k, starts)
+                cv.value = row_upd(cv.value, v, starts)
+            else:
+                ck.value = lax.dynamic_update_slice(ck.value, k,
+                                                    (0, start, 0, 0))
+                cv.value = lax.dynamic_update_slice(cv.value, v,
+                                                    (0, start, 0, 0))
+                idx.value = start + T
             if T > 1:
                 # Prefill block (generate's one full-prompt pass onto a
                 # FRESH cache): causal attention within the block —
@@ -188,17 +218,30 @@ class SPAttention(nn.Module):
             else:
                 # Steady-state single-token step: query the filled cache.
                 # Causal mask over the cache: query t attends to cache
-                # positions <= start + t.
-                q_pos = start + jnp.arange(T)
+                # positions <= start + t.  Per-row (slot) decode masks
+                # each row at its own depth — stale cache beyond a row's
+                # filled prefix is -inf'd out, which is what makes slot
+                # REUSE bit-identical to a fresh cache without zeroing.
                 kv_pos = jnp.arange(self.max_len)
-                mask = kv_pos[None, :] <= q_pos[:, None]  # [T, max_len]
-                if self.window is not None:
-                    # Sliding window over the cache: same band the
-                    # training mask applied, so decode logits match the
-                    # trained distribution past the window.  (The cache
-                    # still stores max_len entries; a rolling buffer is
-                    # a memory optimization, not a semantics change.)
-                    mask &= kv_pos[None, :] > q_pos[:, None] - self.window
+                if per_row:
+                    q_pos = starts[:, None] + jnp.arange(T)  # [B, T]
+                    mask = kv_pos[None, None, :] <= q_pos[:, :, None]
+                    if self.window is not None:
+                        mask &= (kv_pos[None, None, :]
+                                 > q_pos[:, :, None] - self.window)
+                    m_gqa, m_mha = mask[:, None, None], mask[:, None]
+                else:
+                    q_pos = start + jnp.arange(T)
+                    mask = kv_pos[None, :] <= q_pos[:, None]  # [T, max_len]
+                    if self.window is not None:
+                        # Sliding window over the cache: same band the
+                        # training mask applied, so decode logits match
+                        # the trained distribution past the window.  (The
+                        # cache still stores max_len entries; a rolling
+                        # buffer is a memory optimization, not a
+                        # semantics change.)
+                        mask &= kv_pos[None, :] > q_pos[:, None] - self.window
+                    m_gqa, m_mha = mask[None, None, None], mask[None, None]
                 if h_cache != q.shape[2]:
                     # GQA (q has more heads than the cache — under
                     # ulysses decode q was head-sliced to h_cache too,
@@ -210,14 +253,14 @@ class SPAttention(nn.Module):
                     qg = q.reshape(B, T, h_cache, g_rep, D)
                     s = jnp.einsum("bqhgd,bkhd->bhgqk", qg,
                                    ck.value) / (D ** 0.5)
-                    s = jnp.where(mask[None, None, None], s, -jnp.inf)
+                    s = jnp.where(m_gqa, s, -jnp.inf)
                     p = jax.nn.softmax(s, axis=-1)
                     o = jnp.einsum("bhgqk,bkhd->bqhgd", p, cv.value)
                     o = o.reshape(B, T, q.shape[2], D)
                 else:
                     s = jnp.einsum("bqhd,bkhd->bhqk", q,
                                    ck.value) / (D ** 0.5)
-                    s = jnp.where(mask[None, None], s, -jnp.inf)
+                    s = jnp.where(m_mha, s, -jnp.inf)
                     p = jax.nn.softmax(s, axis=-1)
                     o = jnp.einsum("bhqk,bkhd->bqhd", p, cv.value)
             if ulysses:
@@ -387,10 +430,15 @@ class TransformerLM(nn.Module):
         B, T = tokens.shape
         x = nn.Embed(self.vocab, self.embed, dtype=self.dtype)(tokens)
         if self.pos_emb == "learned":
-            pos = pos_offset + jnp.arange(T)
-            pe = nn.Embed(self.max_len, self.embed, dtype=self.dtype,
-                          name="pos_embed")(pos)
-            x = x + pe[None]
+            table = nn.Embed(self.max_len, self.embed, dtype=self.dtype,
+                             name="pos_embed")
+            po = jnp.asarray(pos_offset)
+            if po.ndim == 1:
+                # Per-row offsets (slot-indexed decode): each batch row
+                # embeds its own absolute position.
+                x = x + table(po[:, None] + jnp.arange(T)[None])
+            else:
+                x = x + table(pos_offset + jnp.arange(T))[None]
         elif self.pos_emb != "rope":
             raise ValueError(f"unknown pos_emb {self.pos_emb!r}")
         for _ in range(self.depth):

@@ -477,6 +477,43 @@ def record_overlap(stage: str, bucket: int, total: int) -> None:
                      f"bucket {bucket}/{total}")
 
 
+def record_serving(event: str, n: int = 1, *, replica: str = "") -> None:
+    """One serving-layer counter event (docs/SERVING.md): ``event`` is
+    ``requests`` (admitted) | ``completed`` | ``tokens`` (emitted) |
+    ``rerouted`` (sessions moved off a dead replica) | ``rejected``
+    (unservable request refused at admission) — counter
+    ``tm_serving_<event>_total`` labeled by replica.  Re-routes also
+    land in the flight ring, so a post-mortem sees the replica death
+    next to the collectives (or faults) that preceded it."""
+    _registry.counter_inc(f"tm_serving_{event}_total", n, replica=replica)
+    if event == "rerouted":
+        _recorder.append("serving", event, int(n), "", replica)
+
+
+def record_serving_latency(kind: str, seconds: float, *,
+                           replica: str = "") -> None:
+    """One per-request SLO observation: ``kind`` is ``ttft``
+    (time-to-first-token) or ``itl`` (inter-token latency) — histogram
+    ``tm_serving_<kind>_us`` in MICROSECONDS, so the log2 buckets
+    resolve sub-second latencies (the ``tm_tuning_measured_us``
+    convention); ``obs_tool slo`` renders p50/p95/p99 per replica."""
+    _registry.hist_observe(f"tm_serving_{kind}_us",
+                           max(1.0, float(seconds) * 1e6),
+                           replica=replica)
+
+
+def record_serving_depth(depth: int) -> None:
+    """Admission-queue depth, sampled once per scheduler tick (a gauge
+    exposed as a histogram: count = ticks, sum/count = mean depth)."""
+    _registry.hist_observe("tm_serving_queue_depth", max(0, int(depth)))
+
+
+def record_serving_occupancy(pct: float, *, replica: str = "") -> None:
+    """Slot-block occupancy percent per replica, sampled per tick."""
+    _registry.hist_observe("tm_serving_slot_occupancy_pct",
+                           max(0.0, float(pct)), replica=replica)
+
+
 def record_restart(event: str, step: int) -> None:
     """One checkpoint-restart driver event (``utils/restart.py``):
     ``recovered`` (settled on a checkpoint step), ``fresh_start`` (no

@@ -330,6 +330,22 @@ def init(config: Optional[Config] = None, **overrides) -> Mesh:
                             "TORCHMPI_TPU_FAULT_DEADLINE", float)
         _env_default_pickup(cfg, "ps_timeout_s",
                             "TORCHMPI_TPU_PS_TIMEOUT", float)
+        # Serving-layer sizing (docs/SERVING.md): same any-config env
+        # pickup; the knobs are plain ints, the package itself is only
+        # ever imported by explicit use.
+        _env_default_pickup(cfg, "serving_slots",
+                            "TORCHMPI_TPU_SERVING_SLOTS", int)
+        _env_default_pickup(cfg, "serving_slot_tokens",
+                            "TORCHMPI_TPU_SERVING_SLOT_TOKENS", int)
+        _env_default_pickup(cfg, "serving_replicas",
+                            "TORCHMPI_TPU_SERVING_REPLICAS", int)
+        if cfg.serving_slots < 1 or cfg.serving_replicas < 1 \
+                or cfg.serving_slot_tokens < 0:
+            raise ValueError(
+                f"config.serving_slots/serving_replicas must be >= 1 and "
+                f"serving_slot_tokens >= 0 (0 = model max_len), got "
+                f"{cfg.serving_slots}/{cfg.serving_replicas}/"
+                f"{cfg.serving_slot_tokens}")
         if (os.environ.get("TORCHMPI_TPU_PS_TIMEOUT") is None
                 and os.environ.get("TORCHMPI_TPU_PS_TIMEOUT_MS")):
             # Legacy millisecond spelling (pre-Config knob): honored
@@ -607,6 +623,16 @@ def set_config(**kw) -> None:
             if v < 0:
                 raise ValueError(
                     "config.ps_timeout_s must be >= 0 (0 disables)")
+        if k in ("serving_slots", "serving_replicas"):
+            v = int(v)
+            if v < 1:
+                raise ValueError(f"config.{k} must be >= 1")
+        if k == "serving_slot_tokens":
+            v = int(v)
+            if v < 0:
+                raise ValueError(
+                    "config.serving_slot_tokens must be >= 0 "
+                    "(0 = model max_len)")
         if k == "fault_retries":
             v = int(v)
         if k in ("fault_backoff_s", "fault_deadline_s"):

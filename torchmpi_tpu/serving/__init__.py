@@ -1,0 +1,42 @@
+"""Continuous-batching serving layer (docs/SERVING.md).
+
+The request-level server over the decode stack: an admission queue +
+iteration-level scheduler (:mod:`.scheduler`) injects newly-arrived
+requests into the running decode batch at token boundaries and retires
+finished sequences immediately; a paged KV slot pool (:mod:`.slots`)
+bounds cache memory at ``slots x block`` instead of ``batch x
+max_len``; a health-routed multi-replica router (:mod:`.router`)
+spreads sessions over data-parallel replicas and drains + re-routes a
+dead replica's in-flight sessions instead of crashing the server; and
+per-request SLO telemetry (TTFT / inter-token latency histograms,
+queue-depth and slot-occupancy gauges) rides the obs registry as
+``tm_serving_*`` when telemetry is on.
+
+Off by default and **never imported unless used** — the analysis/obs/
+faults discipline: nothing in the library imports this package; a
+session that never serves pays zero import cost
+(``tests/test_serving.py`` subprocess-asserts it).  Import explicitly:
+
+    from torchmpi_tpu import serving
+
+    server = serving.Server(model, params, replicas=2, slots=8)
+    results = server.run_trace([
+        serving.Request("r0", prompt, max_new=32, arrival_s=0.0),
+        ...
+    ])
+
+``benchmarks/serving_bench.py`` measures the continuous-vs-static win
+on a synthetic Poisson trace; the emitted tokens stay bit-identical per
+request to the offline ``models.generate.generate`` path (greedy-only,
+which is also what makes re-routing token-exact).
+"""
+
+from __future__ import annotations
+
+from .engine import ReplicaEngine, RequestRejected, Session  # noqa: F401
+from .router import Router  # noqa: F401
+from .scheduler import Request, Server  # noqa: F401
+from .slots import SlotPool  # noqa: F401
+
+__all__ = ["ReplicaEngine", "Request", "RequestRejected", "Router",
+           "Server", "Session", "SlotPool"]

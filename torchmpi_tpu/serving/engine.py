@@ -1,0 +1,222 @@
+"""One replica's continuous-batching decode engine.
+
+A :class:`ReplicaEngine` owns one slot-pooled KV cache (leading dim =
+slot count) plus the per-slot session bookkeeping, and exposes the two
+iteration-level operations the scheduler composes:
+
+- :meth:`admit` — allocate a slot, prefill the request's prompt onto a
+  fresh cache, write it into the pool row, emit the FIRST token (the
+  TTFT event).  Admission happens at token boundaries: no batch
+  formation, no waiting for peers.
+- :meth:`step` — ONE ``[S, 1]`` decode tick advancing every in-flight
+  slot at its own cache depth (``models.generate.slot_decode_step``);
+  sequences that emit EOS or reach their token budget retire
+  immediately and their slot frees for the next admission.
+
+Greedy decoding only (see ``models/generate.py``: re-routing a session
+after a replica death re-prefills from its emitted prefix, which is
+only token-exact when decoding is deterministic).
+
+The engine is time-free and telemetry-free on purpose: the scheduler
+owns the clock, the SLO histograms, and the fault hooks, so the engine
+stays a pure slot/cache mechanism that tests can drive tick by tick.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import runtime
+from ..models.generate import slot_decode_step, slot_prefill, slot_write
+from .slots import SlotPool
+
+
+class RequestRejected(ValueError):
+    """Raised by :meth:`ReplicaEngine.admit` for a request that can
+    NEVER be served (its ``prompt + max_new`` exceeds the slot block).
+    A dedicated type so the scheduler can reject exactly this case and
+    keep serving — any other exception out of admission is a real bug
+    and stays loud."""
+
+
+@dataclasses.dataclass
+class Session:
+    """One in-flight request on one slot."""
+
+    request: Any            # scheduler.Request
+    slot: int
+    last_tok: int           # pending token (input of the next step)
+    pos_next: int           # absolute cache index the next step writes
+    emitted: List[int] = dataclasses.field(default_factory=list)
+
+
+class ReplicaEngine:
+    """Slot-pooled decode engine for one model replica.
+
+    ``slots``/``slot_tokens`` default from the active
+    :class:`~torchmpi_tpu.config.Config` (``serving_slots`` /
+    ``serving_slot_tokens``; 0 slot tokens = the model's ``max_len``).
+    With ``device`` set, params and the pool cache are committed to that
+    device, so replicas of one host spread over its chips exactly like
+    data-parallel shards.
+    """
+
+    def __init__(self, model, params, *, name: str = "replica0",
+                 slots: Optional[int] = None,
+                 slot_tokens: Optional[int] = None,
+                 device=None):
+        cfg = runtime.effective_config()
+        slots = int(slots if slots is not None else cfg.serving_slots)
+        st = int(slot_tokens if slot_tokens is not None
+                 else (cfg.serving_slot_tokens or 0))
+        if st == 0:
+            st = int(model.max_len)
+        if getattr(model, "pos_emb", "learned") == "learned" \
+                and st != model.max_len:
+            raise ValueError(
+                f"serving_slot_tokens={st} != model.max_len="
+                f"{model.max_len}: a learned position table is sized by "
+                f"max_len, so slot blocks can only be shrunk for "
+                f"pos_emb='rope' models")
+        if getattr(model, "moe_axis", None) is not None or \
+                getattr(model, "seq_axis", None) is not None:
+            raise ValueError(
+                "ReplicaEngine serves dense single-device models; "
+                "mesh-parallel decode stays on generate_parallel/"
+                "tp_generate (static batch)")
+        self.name = name
+        self.pool = SlotPool(slots, st)
+        self.dmodel = model.clone(decode=True, max_len=st)
+        self.params = (jax.device_put(params, device)
+                       if device is not None else params)
+        self._device = device
+        self.dead = False
+        self._sessions: Dict[int, Session] = {}
+        #: Executable-invocation counters (one prefill = one admit, one
+        #: step = one [S, 1] tick) — the work-unit accounting
+        #: benchmarks/serving_bench.py builds its noise-immune
+        #: continuous-vs-static comparison on.
+        self.stats = {"prefills": 0, "steps": 0}
+        # Zero pool cache from the decode model's cache spec — no
+        # forward pass runs at construction.
+        shapes = jax.eval_shape(
+            lambda: self.dmodel.init(
+                jax.random.PRNGKey(0), jnp.zeros((slots, 1), jnp.int32),
+                pos_offset=jnp.zeros((slots,), jnp.int32)))["cache"]
+        cache = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
+                             shapes)
+        self._cache = (jax.device_put(cache, device)
+                       if device is not None else cache)
+
+    # -- introspection -----------------------------------------------------
+
+    @property
+    def active(self) -> int:
+        return len(self._sessions)
+
+    def sessions(self) -> List[Session]:
+        return list(self._sessions.values())
+
+    def has_capacity(self) -> bool:
+        return not self.dead and self.pool.free_count > 0
+
+    # -- iteration-level operations ----------------------------------------
+
+    def admit(self, request) -> Optional[Tuple[Session, bool]]:
+        """Prefill ``request`` into a free slot; returns ``(session,
+        finished)`` — ``finished`` when the first token already ends the
+        request (EOS, or max_new == 1; its slot is freed again before
+        returning).  None when the pool is full (caller retries next
+        tick).  Raises on a request that can NEVER fit a slot block."""
+        if self.dead:
+            raise RuntimeError(f"{self.name} is dead")
+        base = np.asarray(request.prompt, np.int32).reshape(-1)
+        prev = np.asarray(getattr(request, "tokens", []) or [], np.int32)
+        # A re-routed session re-prefills from its emitted prefix:
+        # greedy decode is deterministic, so the continuation equals
+        # what the dead replica would have produced.
+        prompt = np.concatenate([base, prev]).reshape(1, -1)
+        total = base.size + int(request.max_new)
+        if not self.pool.fits(total):
+            raise RequestRejected(
+                f"request {request.rid!r}: prompt+max_new = {total} "
+                f"exceeds the {self.pool.slot_tokens}-token slot block")
+        slot = self.pool.alloc()
+        if slot is None:
+            return None
+        try:
+            self.stats["prefills"] += 1
+            one_cache, first = slot_prefill(self.dmodel, self.params,
+                                            jnp.asarray(prompt))
+            self._cache = slot_write(self._cache, one_cache, slot)
+            tok = int(np.asarray(first)[0])
+        except BaseException:
+            # A failed prefill must not leak the block: after `slots`
+            # leaks the pool would be silently full forever.
+            self.pool.free(slot)
+            raise
+        sess = Session(request=request, slot=slot, last_tok=tok,
+                       pos_next=prompt.shape[1], emitted=[tok])
+        if self._finished(sess):
+            self.pool.free(slot)
+            return sess, True
+        self._sessions[slot] = sess
+        return sess, False
+
+    def step(self) -> Tuple[List[Session], List[Session]]:
+        """One decode tick over every in-flight slot; returns
+        ``(advanced, finished)``.  Finished sessions are already retired
+        (slot freed) — their blocks are reusable in the same tick."""
+        if self.dead:
+            raise RuntimeError(f"{self.name} is dead")
+        if not self._sessions:
+            return [], []
+        self.stats["steps"] += 1
+        S = self.pool.n_slots
+        toks = np.zeros((S,), np.int32)
+        pos = np.zeros((S,), np.int32)
+        for slot, sess in self._sessions.items():
+            toks[slot] = sess.last_tok
+            pos[slot] = sess.pos_next
+        self._cache, nxt = slot_decode_step(
+            self.dmodel, self.params, self._cache, toks, pos)
+        nxt = np.asarray(nxt)
+        advanced, finished = [], []
+        for slot in list(self._sessions):
+            sess = self._sessions[slot]
+            sess.last_tok = int(nxt[slot])
+            sess.pos_next += 1
+            sess.emitted.append(sess.last_tok)
+            advanced.append(sess)
+            if self._finished(sess):
+                del self._sessions[slot]
+                self.pool.free(slot)
+                finished.append(sess)
+        return advanced, finished
+
+    def drain(self) -> List[Session]:
+        """Mark this replica dead and hand its in-flight sessions back
+        for re-routing (their cache state is presumed lost with the
+        replica — the scheduler re-prefills each from its emitted
+        prefix on a healthy replica)."""
+        self.dead = True
+        out = list(self._sessions.values())
+        for sess in out:
+            self.pool.free(sess.slot)
+        self._sessions.clear()
+        return out
+
+    # -- internals ---------------------------------------------------------
+
+    @staticmethod
+    def _finished(sess: Session) -> bool:
+        req = sess.request
+        if req.eos_id is not None and sess.last_tok == int(req.eos_id):
+            return True
+        done_before = len(req.tokens) if hasattr(req, "tokens") else 0
+        return done_before + len(sess.emitted) >= int(req.max_new)

@@ -1,0 +1,93 @@
+"""Health-routed multi-replica dispatch.
+
+The router spreads sessions across replicas and folds every step
+outcome into a per-replica health ledger — the same
+``HealthLedger`` / ``decide()`` (ok | degrade | raise) machinery the
+fault layer runs on its cross-host surfaces (docs/FAULTS.md).  When the
+fault layer is armed, the router uses ITS ledger, so replica
+transitions emit the standard ``tm_fault_health_total`` counters and
+chaos plans drive the same thresholds; otherwise a private ledger with
+the same semantics.
+
+Routing policy (:meth:`Router.pick`): least-loaded among the replicas
+whose verdict is ``ok``; ``degrade`` replicas only admit when no
+healthy replica has a free slot (shed optional load onto suspects,
+never prefer them); ``raise`` (dead) replicas admit nothing and —
+handled by the scheduler — drain their in-flight sessions for
+re-routing instead of crashing the server.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import List, Optional
+
+from .engine import ReplicaEngine
+
+
+def _shared_ledger():
+    """The fault layer's ledger when armed (sys.modules lookup keeps the
+    decision symmetric with the rest of the library: an armed fault
+    layer is necessarily already imported)."""
+    mod = sys.modules.get("torchmpi_tpu.faults")
+    if mod is not None and mod.active():
+        return mod.ledger()
+    return None
+
+
+class Router:
+    """Health-aware replica selection over a fixed replica set."""
+
+    def __init__(self, replicas: List[ReplicaEngine], *,
+                 ledger=None, suspect_after: int = 2,
+                 dead_after: int = 3):
+        if not replicas:
+            raise ValueError("router needs at least one replica")
+        names = [r.name for r in replicas]
+        if len(set(names)) != len(names):
+            raise ValueError(f"replica names must be unique: {names}")
+        self.replicas = list(replicas)
+        self._ledger = ledger or _shared_ledger()
+        if self._ledger is None:
+            from ..faults.health import HealthLedger
+
+            self._ledger = HealthLedger(suspect_after=suspect_after,
+                                        dead_after=dead_after)
+
+    # -- health ------------------------------------------------------------
+
+    def record(self, replica: ReplicaEngine, ok: bool) -> str:
+        """Fold one step outcome; returns the decide() verdict."""
+        self._ledger.record(replica.name, ok)
+        return self.decide(replica)
+
+    def decide(self, replica: ReplicaEngine) -> str:
+        if replica.dead:
+            return "raise"
+        return self._ledger.decide(replica.name)
+
+    def mark_dead(self, replica: ReplicaEngine) -> None:
+        """Hard failure (the peer is gone — ``InjectedFailure``
+        semantics): push the ledger straight past its thresholds so the
+        verdict flips to ``raise`` without burning ``dead_after`` ticks
+        of a replica that already told us it is dead."""
+        for _ in range(max(1, getattr(self._ledger, "dead_after", 1))):
+            self._ledger.record(replica.name, ok=False)
+
+    # -- selection ---------------------------------------------------------
+
+    def live(self) -> List[ReplicaEngine]:
+        return [r for r in self.replicas if not r.dead]
+
+    def pick(self) -> Optional[ReplicaEngine]:
+        """Replica for the next admission, or None when nothing can
+        take it this tick."""
+        ok = [r for r in self.live()
+              if self.decide(r) == "ok" and r.has_capacity()]
+        if ok:
+            return min(ok, key=lambda r: (r.active, r.name))
+        degraded = [r for r in self.live()
+                    if self.decide(r) == "degrade" and r.has_capacity()]
+        if degraded:
+            return min(degraded, key=lambda r: (r.active, r.name))
+        return None

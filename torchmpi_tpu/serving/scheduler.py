@@ -1,0 +1,369 @@
+"""Admission queue + iteration-level scheduler (the continuous-batching
+serving loop).
+
+One :class:`Server` drives N :class:`~.engine.ReplicaEngine` replicas
+through a :class:`~.router.Router` over a shared FIFO admission queue:
+
+- every tick, newly-arrived requests are admitted into free slot blocks
+  (prefill + first token — the TTFT event) and ONE ``[S, 1]`` decode
+  step advances each replica's in-flight slots; finished sequences
+  retire immediately and their blocks free for the next admission —
+  iteration-level (in-flight/continuous) batching, vs. the static
+  baseline that forms a full batch and runs everyone to the longest
+  decode (``benchmarks/serving_bench.py`` measures the gap);
+- the clock is virtual: each tick advances by the measured wall time of
+  its work (or a fixed ``tick_seconds`` for deterministic tests/chaos
+  runs), and arrivals from the trace are admitted when the clock
+  passes their ``arrival_s`` — so Poisson traces replay identically
+  while TTFT/inter-token latencies still reflect real compute cost;
+- a replica step that raises a fault-layer error takes the resilience
+  path instead of crashing the server: transient faults count against
+  the health ledger (the replica's sessions stall a tick), and a hard
+  failure — or a ledger verdict of ``raise`` — DRAINS the replica: its
+  in-flight sessions re-enter the queue front and re-prefill from
+  their emitted prefix on a healthy replica (token-exact, because
+  decoding is greedy).  ``tm_serving_rerouted_total`` counts the moved
+  sessions.
+
+SLO observability rides the obs registry when telemetry is active
+(``tm_serving_*`` — docs/OBSERVABILITY.md): TTFT and inter-token
+latency histograms (microseconds) per replica, queue-depth and
+slot-occupancy gauges per tick, request/token/completion counters.
+``scripts/obs_tool.py slo`` turns the dumps into p50/p95/p99 tables.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import sys
+import time
+from collections import deque
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from .. import runtime
+from .engine import ReplicaEngine, RequestRejected, Session
+from .router import Router
+
+
+@dataclasses.dataclass
+class Request:
+    """One serving request.  ``max_new`` bounds the generated tokens;
+    ``eos_id`` retires the sequence early.  The server fills in the
+    result fields (``tokens`` — the emitted ids, eos included when hit
+    — and the SLO timestamps, seconds on the virtual clock)."""
+
+    rid: str
+    prompt: np.ndarray
+    max_new: int
+    eos_id: Optional[int] = None
+    arrival_s: float = 0.0
+    # -- results (server-owned) --
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    ttft_s: Optional[float] = None
+    finish_s: Optional[float] = None
+    replica: Optional[str] = None
+    reroutes: int = 0
+    # Set instead of tokens when the request is unservable (e.g. it can
+    # never fit a slot block): the server rejects IT and keeps serving
+    # everyone else — one bad request must not abort the trace.
+    error: Optional[str] = None
+    # Clock of the most recent emitted token — carries the inter-token
+    # gap across a drain/re-admission so the re-route stall really
+    # lands in the ITL histogram.
+    last_token_s: Optional[float] = None
+
+    def latency_s(self) -> Optional[float]:
+        if self.finish_s is None:
+            return None
+        return self.finish_s - self.arrival_s
+
+
+def _obs():
+    """The obs module when telemetry is active (sys.modules lookup —
+    serving must not import the telemetry it reports to)."""
+    mod = sys.modules.get("torchmpi_tpu.obs")
+    try:
+        if mod is not None and mod.active():
+            return mod
+    except Exception:  # noqa: BLE001 — telemetry never fails a tick
+        pass
+    return None
+
+
+def _is_fault(e: BaseException) -> bool:
+    """Is ``e`` a fault-layer error?  Checked via sys.modules: if the
+    fault layer was never armed, the classes do not exist and no
+    exception can be one (the restart.py discipline)."""
+    mod = sys.modules.get("torchmpi_tpu.faults.inject")
+    return mod is not None and isinstance(e, mod.FaultError)
+
+
+class Server:
+    """Continuous-batching server over ``replicas`` engine replicas of
+    one ``(model, params)`` checkpoint.
+
+    Replica count / slots / slot block size default from the active
+    Config (``serving_replicas`` / ``serving_slots`` /
+    ``serving_slot_tokens``).  ``devices`` optionally pins replica i to
+    ``devices[i]`` (data-parallel spread on a multi-chip host).
+    """
+
+    def __init__(self, model, params, *, replicas: Optional[int] = None,
+                 slots: Optional[int] = None,
+                 slot_tokens: Optional[int] = None,
+                 devices: Optional[Sequence] = None,
+                 ledger=None):
+        cfg = runtime.effective_config()
+        n = int(replicas if replicas is not None else cfg.serving_replicas)
+        if n < 1:
+            raise ValueError(f"need >= 1 replica, got {n}")
+        if devices is not None and len(devices) < n:
+            raise ValueError(
+                f"{n} replicas but only {len(devices)} devices")
+        engines = [
+            ReplicaEngine(model, params, name=f"replica{i}", slots=slots,
+                          slot_tokens=slot_tokens,
+                          device=devices[i] if devices is not None
+                          else None)
+            for i in range(n)]
+        self.router = Router(engines, ledger=ledger)
+        #: Filled by :meth:`run_trace`: ``ticks`` (work ticks run),
+        #: ``busy_s`` (summed tick durations — the compute time
+        #: throughput divides by), ``clock_s`` (final virtual clock,
+        #: idle gaps included), ``tokens`` (total emitted).
+        self.last_stats: dict = {}
+
+    # -- the serving loop --------------------------------------------------
+
+    def run_trace(self, requests: Sequence[Request], *,
+                  tick_seconds: Optional[float] = None,
+                  unit_seconds: Optional[float] = None,
+                  max_ticks: int = 1_000_000) -> List[Request]:
+        """Serve a whole arrival trace to completion; returns the
+        requests in completion order (every one finished — the server
+        refuses to lose work: with all replicas dead it raises).
+
+        The virtual clock, per tick:
+
+        - default (both None): each tick's measured wall time —
+          latencies reflect real compute cost;
+        - ``tick_seconds``: a fixed step per tick (deterministic tests
+          / chaos runs);
+        - ``unit_seconds``: the tick's WORK UNITS (prefills admitted +
+          replica steps run, i.e. invocations of the two compiled
+          executables) times this — deterministic like
+          ``tick_seconds`` but load-faithful, since a tick that
+          admitted three requests costs three prefills of clock.  The
+          noise-immune schedule ``benchmarks/serving_bench.py``
+          compares continuous vs static on.
+        """
+        if tick_seconds is not None and unit_seconds is not None:
+            raise ValueError(
+                "tick_seconds and unit_seconds are exclusive clock "
+                "modes")
+        arrivals = deque(sorted(requests, key=lambda r: r.arrival_s))
+        pending: deque = deque()
+        completed: List[Request] = []
+        clock = busy = 0.0
+        n_ticks = n_tokens = 0
+        for _tick in range(max_ticks):
+            if not (arrivals or pending
+                    or any(e.active for e in self.router.live())):
+                self.last_stats = {"ticks": n_ticks, "busy_s": busy,
+                                   "clock_s": clock,
+                                   "tokens": n_tokens}
+                return completed
+            t0 = time.monotonic()
+            while arrivals and arrivals[0].arrival_s <= clock:
+                pending.append(arrivals.popleft())
+            newly_admitted, stepped, finished, steps_run, rejected = \
+                self._tick(pending)
+            for req in rejected:
+                req.finish_s = clock
+                completed.append(req)
+            worked = bool(newly_admitted or stepped or finished
+                          or rejected)
+            if not worked and not pending and arrivals and \
+                    not any(e.active for e in self.router.live()):
+                # Idle gap — nothing queued OR in flight: jump straight
+                # to the next arrival instead of spinning the virtual
+                # clock through empty ticks.  (In-flight sessions
+                # stalled by a transient replica fault must NOT jump:
+                # their tick still costs clock and samples gauges.)
+                clock = max(clock, arrivals[0].arrival_s)
+                continue
+            if not worked and pending and not self.router.live():
+                raise RuntimeError(
+                    "all replicas dead with requests still queued")
+            if unit_seconds is not None:
+                n_units = len(newly_admitted) + steps_run
+                elapsed = max(1, n_units) * unit_seconds
+            elif tick_seconds is not None:
+                elapsed = tick_seconds
+            else:
+                elapsed = max(time.monotonic() - t0, 1e-9)
+            clock += elapsed
+            busy += elapsed
+            n_ticks += 1
+            n_tokens += len(newly_admitted) + len(stepped)
+            self._record_tick(pending, newly_admitted, stepped,
+                              finished, completed, clock, elapsed)
+        raise RuntimeError(f"trace did not drain in {max_ticks} ticks")
+
+    # -- one tick ----------------------------------------------------------
+
+    def _tick(self, pending: deque):
+        admitted: List[Session] = []
+        finished: List[Session] = []
+        stepped: List[Session] = []
+        rejected: List[Request] = []
+        steps_run = 0
+        # Admission at the token boundary: fill free slot blocks from
+        # the queue front, spread by router health/load.
+        while pending:
+            eng = self.router.pick()
+            if eng is None:
+                break
+            req = pending.popleft()
+            try:
+                res = eng.admit(req)
+            except RequestRejected as e:
+                # Unservable request (can never fit a slot block):
+                # reject IT and keep serving — one bad request must not
+                # abort everyone else's trace.  Only this typed
+                # rejection is absorbed; any other admission exception
+                # is a real bug and stays loud.
+                req.error = str(e)
+                rejected.append(req)
+                mod = _obs()
+                if mod is not None:
+                    mod.record_serving("rejected", replica=eng.name)
+                continue
+            if res is None:  # raced a full pool; retry next tick
+                pending.appendleft(req)
+                break
+            sess, done = res
+            req.replica = eng.name
+            admitted.append(sess)
+            if done:
+                finished.append(sess)
+        # One decode step per replica with in-flight slots.
+        for eng in list(self.router.live()):
+            if not eng.active:
+                continue
+            try:
+                self._fire(eng.name)
+                advanced, fin = eng.step()
+                steps_run += 1
+            except BaseException as e:  # noqa: BLE001 — resilience path
+                if not self._handle_failure(eng, e, pending):
+                    raise
+                continue
+            self.router.record(eng, True)
+            stepped.extend(advanced)
+            finished.extend(fin)
+        return admitted, stepped, finished, steps_run, rejected
+
+    @staticmethod
+    def _fire(name: str) -> None:
+        """The ``serving.replica`` chaos site: one arrival per replica
+        step when the fault layer is armed (one string compare when
+        off — the import discipline of every other site)."""
+        if runtime.effective_config().faults == "off":
+            return
+        from .. import faults
+
+        faults.fire("serving.replica", peer=name)
+
+    def _handle_failure(self, eng: ReplicaEngine, e: BaseException,
+                        pending: deque) -> bool:
+        """Route a failed replica step; returns False to re-raise (not
+        a fault-layer error — a model bug must stay loud)."""
+        if not _is_fault(e):
+            return False
+        if getattr(e, "transient", False):
+            verdict = self.router.record(eng, False)
+        else:
+            # Hard failure: the replica is gone now.
+            self.router.mark_dead(eng)
+            verdict = "raise"
+        if verdict == "raise":
+            self._drain(eng, pending)
+        return True
+
+    def _drain(self, eng: ReplicaEngine, pending: deque) -> None:
+        """Dead replica: move its in-flight sessions to the queue FRONT
+        (they already waited once) for re-prefill elsewhere."""
+        sessions = eng.drain()
+        eng.dead = True
+        mod = _obs()
+        if mod is not None and sessions:
+            mod.record_serving("rerouted", len(sessions),
+                               replica=eng.name)
+        for sess in reversed(sessions):
+            req = sess.request
+            req.tokens.extend(sess.emitted)
+            req.reroutes += 1
+            pending.appendleft(req)
+
+    # -- telemetry + result bookkeeping ------------------------------------
+
+    def _record_tick(self, pending, admitted, stepped, finished,
+                     completed, clock: float, elapsed: float) -> None:
+        mod = _obs()
+        for sess in admitted:
+            req = sess.request
+            if req.ttft_s is None:
+                req.ttft_s = clock - req.arrival_s
+                if mod is not None:
+                    mod.record_serving("requests", replica=req.replica)
+                    mod.record_serving_latency("ttft", req.ttft_s,
+                                               replica=req.replica)
+            elif mod is not None:
+                # Re-admission after a re-route: the WHOLE stall since
+                # the session's last token (drain + queue wait +
+                # re-prefill) is one long inter-token latency, not a
+                # second TTFT — that is the SLO impact of the kill.
+                since = (req.last_token_s if req.last_token_s is not None
+                         else clock - elapsed)
+                mod.record_serving_latency("itl", clock - since,
+                                           replica=req.replica)
+            req.last_token_s = clock
+        for sess in finished:
+            req = sess.request
+            req.tokens.extend(sess.emitted)
+            sess.emitted = []
+            req.finish_s = clock
+            completed.append(req)
+            if mod is not None:
+                mod.record_serving("completed", replica=req.replica)
+        if mod is None:
+            return
+        for sess in stepped:
+            req = sess.request
+            # Gap since the request's LAST token, not this tick's
+            # elapsed: equal for an unstalled session (its previous
+            # token landed exactly one tick ago), but a session stalled
+            # N ticks by transient replica faults — or re-admitted
+            # after a drain this same tick (then the admission already
+            # carried the stall and last_token_s is this clock) —
+            # reports its true inter-token latency.
+            since = (req.last_token_s if req.last_token_s is not None
+                     else clock - elapsed)
+            mod.record_serving_latency("itl", clock - since,
+                                       replica=req.replica)
+            req.last_token_s = clock
+        n_tok = len(admitted) + len(stepped)
+        if n_tok:
+            by_rep: dict = {}
+            for sess in admitted + stepped:
+                by_rep[sess.request.replica] = \
+                    by_rep.get(sess.request.replica, 0) + 1
+            for rep, n in by_rep.items():
+                mod.record_serving("tokens", n, replica=rep)
+        mod.record_serving_depth(len(pending))
+        for eng in self.router.live():
+            mod.record_serving_occupancy(eng.pool.occupancy_pct(),
+                                         replica=eng.name)

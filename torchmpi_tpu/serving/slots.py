@@ -1,0 +1,78 @@
+"""Paged KV-cache slot pool: fixed-size slot blocks, allocated per
+request, freed on EOS/retirement.
+
+A slot is one row of the replica's pool cache — a fixed block of
+``slot_tokens`` KV positions.  Admission allocates a free slot, prefill
+overwrites the row, retirement returns it to the free list, and the
+per-row causal mask in the decode step makes reuse safe without zeroing
+(stale entries beyond a row's filled prefix are ``-inf``'d out of every
+attention, so a reused slot decodes bit-identically to a fresh cache —
+asserted in tests/test_serving.py).
+
+Memory therefore bounds at ``n_slots x slot_tokens`` cache positions per
+replica — the slot pool's whole point: a long straggler pins ONE block,
+not the whole batch's ``batch x max_len`` cache.
+
+Dependency-free (no jax): the pool is bookkeeping; the cache arrays live
+in the engine.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+
+class SlotPool:
+    """Free-list of ``n_slots`` fixed-size KV blocks.
+
+    LIFO reuse (the most recently freed slot is handed out first) keeps
+    the hot block resident and the allocation order deterministic — the
+    replica-kill chaos runs replay identically from a seed.
+    """
+
+    def __init__(self, n_slots: int, slot_tokens: int):
+        if n_slots < 1:
+            raise ValueError(f"n_slots must be >= 1, got {n_slots}")
+        if slot_tokens < 1:
+            raise ValueError(
+                f"slot_tokens must be >= 1, got {slot_tokens}")
+        self.n_slots = int(n_slots)
+        self.slot_tokens = int(slot_tokens)
+        self._free: List[int] = list(range(n_slots - 1, -1, -1))
+        self._in_use: set = set()
+
+    def fits(self, total_tokens: int) -> bool:
+        """Can a request of ``prompt + max_new`` tokens ever live in one
+        slot block?  (Admission-time check — an unservable request must
+        be rejected at the door, not wedge a slot forever.)"""
+        return 0 < total_tokens <= self.slot_tokens
+
+    def alloc(self) -> Optional[int]:
+        """Allocate a slot; None when the pool is exhausted (the request
+        stays in the admission queue for the next tick)."""
+        if not self._free:
+            return None
+        slot = self._free.pop()
+        self._in_use.add(slot)
+        return slot
+
+    def free(self, slot: int) -> None:
+        if slot not in self._in_use:
+            raise ValueError(
+                f"slot {slot} is not allocated (double free, or never "
+                f"alloc'd from this pool)")
+        self._in_use.remove(slot)
+        self._free.append(slot)
+
+    @property
+    def in_use(self) -> int:
+        return len(self._in_use)
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    def occupancy_pct(self) -> float:
+        """Percent of slot blocks in use — the ``tm_serving_slot_
+        occupancy_pct`` gauge sample."""
+        return 100.0 * len(self._in_use) / self.n_slots

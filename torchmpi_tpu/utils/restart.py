@@ -76,6 +76,46 @@ def _fsync_verify(directory: str, step: int) -> None:
         pass
 
 
+def attach_ef_residuals(state: Dict[str, Any], *,
+                        params_key: str = "params",
+                        axis_names=None, mesh=None,
+                        n_buckets: Optional[int] = None,
+                        key: str = "ef_residuals") -> Dict[str, Any]:
+    """Bundle zero-initialized DCN error-feedback residual state into a
+    checkpointable train-state dict (docs/HIERARCHICAL.md: "checkpoint
+    residuals with the optimizer state").
+
+    The quantized-DCN gradient paths thread a persistent per-bucket
+    residual accumulator (``gradsync.synchronize_gradients(residuals=
+    ...)``); dropping it on restore silently re-applies one step's
+    accumulated error on every restart replay (the at-least-once
+    hazard).  This helper is the restart-driver seam that closes the
+    loop: call it inside ``init_fn`` so the residuals ride every
+    :func:`run_with_restarts` checkpoint exactly like optimizer state —
+    a fresh start zero-initializes them, a recovery restores the saved
+    accumulators bitwise (round-trip asserted in tests/test_restart.py).
+
+    ``state[params_key]`` is the gradient-shaped template the bucket
+    layout derives from; ``axis_names``/``mesh``/``n_buckets`` pass
+    through to :func:`~torchmpi_tpu.parallel.gradsync.
+    init_dcn_residuals`.  Returns a NEW dict with the residual list
+    under ``key``; the step function threads ``state[key]`` through the
+    EF sync and stores the returned state back.
+    """
+    from ..parallel import gradsync
+
+    if params_key not in state:
+        raise KeyError(
+            f"state has no {params_key!r} entry to derive the residual "
+            f"bucket layout from (keys: {sorted(state)})")
+    if key in state:
+        raise ValueError(f"state already has a {key!r} entry")
+    out = dict(state)
+    out[key] = gradsync.init_dcn_residuals(
+        state[params_key], axis_names, mesh=mesh, n_buckets=n_buckets)
+    return out
+
+
 def run_with_restarts(
     init_fn: Callable[[], PyTree],
     step_fn: Callable[[PyTree, int], PyTree],
