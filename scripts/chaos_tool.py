@@ -4,20 +4,30 @@ The chaos-engineering operator surface over ``torchmpi_tpu/faults/``:
 
     python scripts/chaos_tool.py gen --out plan.json --seed 7 \\
         --rule ps.request:drop:0.5:3:0.01 --rule host_staged.*:corrupt
+    python scripts/chaos_tool.py gen --out shrink.json \\
+        --shrink 2:5:4      # kill rank 2 at step 5 of a 4-rank gang
     python scripts/chaos_tool.py lint plan.json
     python scripts/chaos_tool.py summarize metrics_host*.jsonl
 
 ``gen`` writes a versioned fault-plan JSON from ``--rule`` specs
 (``site:kind[:prob[:max_hits[:delay_s]]]``; ``site`` may glob the
-instrumented sites, ``max_hits=-1`` means unbounded).  ``lint``
-validates a plan — schema/version errors exit 2, semantic problems
-(site patterns matching no instrumented site, dead rules) print and
-exit 1.  ``summarize`` reads per-host obs metric dumps (the files
-``TORCHMPI_TPU_OBS=metrics`` leaves behind) and prints only the
-``tm_fault_*`` series — what was injected, what survived a retry, what
-hit a deadline — the after-action report of a chaos run; exits 1 when a
-chaos run left NO fault counters (it injected nothing: wrong plan,
-wrong sites, or faults never armed).
+instrumented sites, ``max_hits=-1`` means unbounded).  ``--shrink
+RANK:STEP:NRANKS`` is the elastic-gang recipe (docs/ELASTIC.md): the
+driver fires the ``elastic.member`` site once per member per step
+boundary in rank order, so arrival ``STEP*NRANKS + RANK`` is exactly
+rank RANK's liveness check at step STEP — the recipe emits a
+``fail`` rule with that ``after`` and ``max_hits=1``, a deterministic
+kill-one-peer-at-step-n plan (compute NRANKS against the ORIGINAL gang
+size; arrivals per step shrink with the gang).  ``lint`` validates a
+plan — schema/version errors exit 2, semantic problems (site patterns
+matching no instrumented site, dead rules) print and exit 1.
+``summarize`` reads per-host obs metric dumps (the files
+``TORCHMPI_TPU_OBS=metrics`` leaves behind) and prints the
+``tm_fault_*`` and ``tm_elastic_*`` series — what was injected, what
+survived a retry, what hit a deadline, what shrink/rejoin the gang ran
+— the after-action report of a chaos run; exits 1 when a chaos run
+left NO fault counters (it injected nothing: wrong plan, wrong sites,
+or faults never armed).
 
 Standalone on purpose: no jax — writing a chaos plan for a pod (or
 reading its post-mortem) must not need the pod's software stack.  The
@@ -64,12 +74,52 @@ def parse_rule(inject, spec: str):
     return rule
 
 
+def parse_shrink(inject, spec: str):
+    """``RANK:STEP:NRANKS`` -> a deterministic kill-rank-at-step rule
+    on the ``elastic.member`` site (the gang fires it once per member
+    per step boundary in rank order, so the arrival ordinal is
+    ``STEP*NRANKS + RANK``)."""
+    parts = spec.split(":")
+    if len(parts) != 3:
+        raise ValueError(f"--shrink {spec!r}: want RANK:STEP:NRANKS")
+    rank, step, nranks = (int(p) for p in parts)
+    if nranks < 1 or not (0 <= rank < nranks) or step < 0:
+        raise ValueError(
+            f"--shrink {spec!r}: need 0 <= RANK < NRANKS and STEP >= 0")
+    rule = inject.FaultRule(site="elastic.member", kind="fail",
+                            prob=1.0, after=step * nranks + rank,
+                            max_hits=1)
+    rule.validate()
+    return rule, rank, step, nranks
+
+
 def cmd_gen(args) -> int:
     inject = _load_inject()
     try:
+        if len(args.shrink) > 1:
+            # After the first kill the gang recovers (replaying step
+            # boundaries) AND fires one fewer arrival per step, so a
+            # second rule's step*NRANKS+RANK ordinal no longer lands on
+            # the (rank, step) it names — the recipe is exact for ONE
+            # kill per plan.
+            raise ValueError(
+                "--shrink may be given once per plan: arrival ordinals "
+                "are only exact for the first kill (recovery replays "
+                "and the shrunken gang shift later arrivals) — "
+                "generate separate plans for separate kills")
         rules = [parse_rule(inject, spec) for spec in args.rule]
+        for spec in args.shrink:
+            rule, rank, step, nranks = parse_shrink(inject, spec)
+            rules.append(rule)
+            print(f"shrink recipe: kill rank {rank} at step {step} of a "
+                  f"{nranks}-rank gang (elastic.member arrival "
+                  f"{rule.after})")
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
+        return 2
+    if not rules:
+        print("error: gen needs at least one --rule or --shrink",
+              file=sys.stderr)
         return 2
     plan = inject.FaultPlan(seed=args.seed, note=args.note, rules=rules)
     problems = inject.lint_plan(plan)
@@ -121,21 +171,24 @@ def cmd_summarize(args) -> int:
     for path in args.files:
         for rec in _load_counters(path):
             name = rec.get("name", "")
-            if not name.startswith("tm_fault_"):
+            if not name.startswith(("tm_fault_", "tm_elastic_")):
                 continue
             key = (name, tuple(sorted(rec.get("labels", {}).items())))
             totals[key] = totals.get(key, 0) + rec.get("value", 0)
     if not totals:
-        print("no tm_fault_* counters found — the chaos run injected "
-              "nothing (plan never matched a site, or faults were not "
-              "armed)", file=sys.stderr)
+        print("no tm_fault_*/tm_elastic_* counters found — the chaos "
+              "run injected nothing (plan never matched a site, or "
+              "faults were not armed)", file=sys.stderr)
         return 1
     by_action: Dict[str, float] = {}
     print(f"fault summary over {len(args.files)} host dump(s):")
     for (name, labels), v in sorted(totals.items()):
         lab = ",".join(f"{k}={val}" for k, val in labels)
         print(f"  {name}{{{lab}}} = {int(v)}")
-        action = name[len("tm_fault_"):-len("_total")]
+        if name.startswith("tm_fault_"):
+            action = name[len("tm_fault_"):-len("_total")]
+        else:  # tm_elastic_*: keep the subsystem prefix in the totals
+            action = "elastic_" + name[len("tm_elastic_"):-len("_total")]
         by_action[action] = by_action.get(action, 0) + v
     line = "  ".join(f"{a}={int(v)}" for a, v in sorted(by_action.items()))
     print(f"totals: {line}")
@@ -153,6 +206,11 @@ def main(argv=None) -> int:
     s.add_argument("--rule", action="append", default=[],
                    help="site:kind[:prob[:max_hits[:delay_s]]] "
                         "(repeatable)")
+    s.add_argument("--shrink", action="append", default=[],
+                   help="RANK:STEP:NRANKS — elastic-gang recipe: kill "
+                        "rank RANK at step STEP of an NRANKS-rank gang "
+                        "(once per plan — later kills' arrival "
+                        "ordinals shift after the first shrink)")
     s.set_defaults(fn=cmd_gen)
 
     s = sub.add_parser("lint", help="validate plan files")

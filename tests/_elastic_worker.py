@@ -1,0 +1,187 @@
+"""Worker for the 2-process elastic acceptance test (test_elastic.py /
+the elastic-smoke CI job; underscore prefix keeps pytest from
+collecting it).
+
+The docs/ELASTIC.md acceptance scenario, one phase per argv mode:
+
+- elastic : a 2-process gang trains under a seeded ``elastic.member``
+            kill plan.  The planned dead rank raises MemberDeath and
+            exits (``CHECK rank=K member-death ok``); the survivor
+            re-forms the gang at N-1 over its own devices, finishes
+            the run, and prints an ``ELASTIC-SUMMARY`` JSON line with
+            shrink counts, the recovered step, the
+            tm_elastic_shrink_total counter, and digests of the
+            post-recovery loss trajectory + final params.
+- clean   : a from-scratch 1-process run restored from the SAME
+            checkpoint step (the driver copies only that step's files
+            into a fresh directory) — its summary digests must be
+            BIT-identical to the elastic survivor's.
+- elastic-rejoin : like ``elastic``, but the dead rank comes BACK:
+            after MemberDeath it calls ``elastic.admit`` (posting a
+            join request), the survivor admits it at a step boundary
+            (seeding its checkpoint file for the committed step), and
+            BOTH processes finish the run together on the re-grown
+            full mesh — summaries from both ranks must carry equal
+            digests.
+
+argv: pid nproc port mode directory plan_path
+"""
+
+import hashlib
+import json
+import os
+import sys
+
+pid = int(sys.argv[1])
+nproc = int(sys.argv[2])
+port = sys.argv[3]
+mode = sys.argv[4]
+directory = sys.argv[5]
+plan_path = sys.argv[6] if len(sys.argv) > 6 else ""
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+if nproc > 1:
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+import numpy as np  # noqa: E402
+
+import torchmpi_tpu as mpi  # noqa: E402
+
+import jax.numpy as jnp  # noqa: E402
+from jax import lax, shard_map  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+STEPS = 10
+DIM, H, B = 4, 8, 8
+LR = 0.05
+
+
+def _slot_batch(slot, step):
+    rng = np.random.RandomState(10_000 + slot * 97 + step)
+    return (rng.randn(B, DIM).astype(np.float32),
+            rng.randn(B, 1).astype(np.float32))
+
+
+def _to_np(a):
+    """Host copy of a replicated global array (works when the mesh
+    spans non-addressable devices — every device holds the full
+    value, so the first local shard IS the value)."""
+    if isinstance(a, jax.Array) and not a.is_fully_addressable:
+        return np.asarray(a.addressable_data(0))
+    return np.asarray(a)
+
+
+def build(mesh, view):
+    """One per-view training program: 2-layer MLP, data-parallel over
+    every device of the view, per-(device-slot, step) deterministic
+    batches keyed by MEMBER id so a survivors-only gang sees exactly
+    the data a from-scratch N-1 run would."""
+    axes = tuple(mesh.axis_names)
+    per = mesh.devices.size // len(view.members)
+    slots = [m * per + j for m in view.members for j in range(per)]
+
+    def init_fn():
+        rng = np.random.RandomState(0)
+        params = {"w1": (rng.randn(DIM, H) * 0.3).astype(np.float32),
+                  "b1": np.zeros((H,), np.float32),
+                  "w2": (rng.randn(H, 1) * 0.3).astype(np.float32)}
+        return {"params": params,
+                "losses": np.full((STEPS,), np.nan, np.float32)}
+
+    def body(p, x, y):
+        x, y = x[0], y[0]
+        ax = axes if len(axes) > 1 else axes[0]
+
+        def loss_fn(p):
+            h = jnp.tanh(x @ p["w1"] + p["b1"])
+            return jnp.mean((h @ p["w2"] - y) ** 2)
+
+        l, g = jax.value_and_grad(loss_fn)(p)
+        l = lax.pmean(l, ax)
+        g = jax.tree.map(lambda a: lax.pmean(a, ax), g)
+        return jax.tree.map(lambda a, b: a - LR * b, p, g), l
+
+    data_sharding = NamedSharding(mesh, P(axes))
+    stepf = jax.jit(shard_map(
+        body, mesh=mesh, in_specs=(P(), P(axes), P(axes)),
+        out_specs=(P(), P()), check_vma=False))
+
+    def _put(arr):
+        return jax.make_array_from_callback(
+            arr.shape, data_sharding, lambda idx: arr[idx])
+
+    def step_fn(state, i):
+        xs, ys = zip(*(_slot_batch(s, i) for s in slots))
+        p2, l = stepf(state["params"], _put(np.stack(xs)),
+                      _put(np.stack(ys)))
+        losses = np.array(state["losses"])
+        losses[i] = _to_np(l)
+        return {"params": jax.tree.map(_to_np, p2), "losses": losses}
+
+    return init_fn, step_fn
+
+
+cfg = dict(elastic="on")
+if nproc > 1:
+    cfg.update(coordinator_address=f"127.0.0.1:{port}",
+               num_processes=nproc, process_id=pid)
+if mode.startswith("elastic"):
+    cfg.update(faults=plan_path, obs="metrics",
+               obs_dir=os.path.join(directory, "obs"))
+mpi.init(mpi.Config(**cfg))
+
+from torchmpi_tpu import elastic  # noqa: E402
+
+
+def _digest(arr):
+    return hashlib.sha256(
+        np.ascontiguousarray(arr).tobytes()).hexdigest()
+
+
+try:
+    state, info = elastic.run_elastic(
+        build, steps=STEPS, directory=directory, save_every=2)
+except elastic.MemberDeath as e:
+    print(f"CHECK rank={pid} member-death ok (member {e.member} at "
+          f"step {e.step})", flush=True)
+    if mode != "elastic-rejoin":
+        sys.exit(0)
+    # The healed-peer path: post a join request, wait for the gang to
+    # admit us at a step boundary, then re-enter the driver — the
+    # adopted committed view lines our recovery agreement up with the
+    # survivors', and the seeded checkpoint file restores exactly the
+    # admission step.
+    view = elastic.admit(directory, pid, deadline_s=120)
+    print(f"CHECK rank={pid} admitted epoch={view.epoch} "
+          f"step={view.step}", flush=True)
+    state, info = elastic.run_elastic(
+        build, steps=STEPS, directory=directory, save_every=2)
+
+shrink_total = 0
+if mode.startswith("elastic"):
+    from torchmpi_tpu import obs
+
+    shrink_total = int(obs.registry().counter_total(
+        "tm_elastic_shrink_total"))
+r = info["recovered_step"]
+summary = {
+    "rank": pid,
+    "shrinks": info["shrinks"],
+    "rejoins": info["rejoins"],
+    "reconciles": info["reconciles"],
+    "recovered_step": r,
+    "members": list(info["view"].members),
+    "elastic_shrink_total": shrink_total,
+    "losses_digest": _digest(state["losses"][r:]),
+    "params_digest": _digest(np.concatenate(
+        [state["params"][k].reshape(-1)
+         for k in sorted(state["params"])])),
+}
+print("ELASTIC-SUMMARY " + json.dumps(summary), flush=True)
+mpi.stop()
+print(f"CHECK rank={pid} done", flush=True)

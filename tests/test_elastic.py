@@ -1,0 +1,768 @@
+"""Elastic gang resize (torchmpi_tpu/elastic.py + faults/membership.py —
+docs/ELASTIC.md): the host-staged two-phase membership protocol, the
+deterministic chaos-shrink acceptance (kill one rank mid-training ->
+survivors re-form at N-1 and produce a loss trajectory bit-identical to
+a clean N-1 run restored from the same checkpoint step; ZeRO-0/1
+bitwise, ZeRO-3 tight-allclose), step-boundary rejoin restoring the
+original partition layout, the ``runtime.resize_world`` plan
+invalidation, EF-residual re-bucketing, the chaos_tool shrink recipe,
+and the off-mode never-imported guarantee."""
+
+import importlib.util
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import torchmpi_tpu as mpi  # noqa: F401 — installs the jax.shard_map shim
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax import lax, shard_map  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+from torchmpi_tpu.faults import membership  # noqa: E402
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+STEPS = 10
+DIM, H, B = 4, 8, 8
+LR = 0.05
+MOM = 0.9
+
+
+# ---------------------------------------------------------------------------
+# Membership board + two-phase reconcile (pure python, no runtime)
+# ---------------------------------------------------------------------------
+
+
+def test_membership_two_phase_reconcile(tmp_path):
+    board = membership.Board(str(tmp_path / "board"))
+    v1 = membership.reconcile(board, [0, 1, 2], [0, 1, 2], epoch=1,
+                              step=0, deadline_s=2, poll_s=0.01)
+    assert v1.members == (0, 1, 2) and v1.epoch == 1
+    assert board.committed_view() == v1
+    # Shrink: rank 2 died; the survivors reconcile to N-1.
+    v2 = membership.reconcile(board, [0, 1], [0, 1], epoch=v1.epoch + 1,
+                              step=5, deadline_s=2, poll_s=0.01)
+    assert v2.members == (0, 1) and v2.step == 5
+    assert board.committed_view() == v2
+    # Round-trip through JSON (a healed peer reads these files cold).
+    assert membership.MembershipView.from_json(v2.to_json()) == v2
+
+
+def test_membership_bounded_drop(tmp_path):
+    """A voter that posts nothing within the deadline is itself dropped
+    and the round retries one smaller — the bounded half of the
+    bounded two-phase reconcile."""
+    board = membership.Board(str(tmp_path / "board"))
+    v = membership.reconcile(board, [0], [0, 1, 2], epoch=1, step=3,
+                             deadline_s=0.25, poll_s=0.01)
+    assert v.members == (0,)  # 1 and 2 never spoke: voted out together
+    assert v.epoch > 1        # took extra round(s)
+    assert board.committed_view() == v
+
+
+def test_membership_grow_without_joiner_vote(tmp_path):
+    """An admission commits with the PRE-grow members as voters, so the
+    healed joiner appears in the view without having voted."""
+    board = membership.Board(str(tmp_path / "board"))
+    v1 = membership.reconcile(board, [0, 1], [0, 1], epoch=1, step=0,
+                              deadline_s=2, poll_s=0.01)
+    v2 = membership.reconcile(board, [0, 1], [0, 1, 2],
+                              epoch=v1.epoch + 1, step=7,
+                              voters=[0, 1], deadline_s=2, poll_s=0.01)
+    assert v2.members == (0, 1, 2) and v2.step == 7
+    assert board.committed_view() == v2
+
+
+def test_membership_step_disagreement_resolves_min(tmp_path):
+    """Two survivors entering the same reconcile with different step
+    boundaries (deaths observed at adjacent steps) must converge on
+    ONE view — the min step, which both can restore — not silently
+    commit divergent views."""
+    import threading
+
+    board = membership.Board(str(tmp_path / "board"))
+    results = {}
+
+    def run(rank, step):
+        results[rank] = membership.reconcile(
+            board, [rank], [0, 1], epoch=1, step=step, deadline_s=5,
+            poll_s=0.005)
+
+    threads = [threading.Thread(target=run, args=(0, 5)),
+               threading.Thread(target=run, args=(1, 7))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    assert results[0] == results[1]
+    assert results[0].step == 5 and results[0].members == (0, 1)
+    assert board.committed_view() == results[0]
+
+
+def test_membership_dropped_rank_raises(tmp_path):
+    board = membership.Board(str(tmp_path / "board"))
+    with pytest.raises(membership.ReconcileDropped):
+        membership.reconcile(board, [5], [0, 1], epoch=1, step=0,
+                             deadline_s=0.2, poll_s=0.01)
+
+
+def test_membership_agree_min_and_join(tmp_path):
+    board = membership.Board(str(tmp_path / "board"))
+    assert membership.agree_min(board, "t0", [0, 1], [0, 1], 7,
+                                deadline_s=1, poll_s=0.01) == 7
+    board.post_value("t1", 1, 3)
+    assert membership.agree_min(board, "t1", [0], [0, 1], 9,
+                                deadline_s=1, poll_s=0.01) == 3
+    with pytest.raises(membership.ReconcileTimeout):
+        membership.agree_min(board, "t2", [0], [0, 1], 1,
+                             deadline_s=0.2, poll_s=0.01)
+    board.request_join(4)
+    assert board.join_requests() == [4]
+    board.clear_join(4)
+    assert board.join_requests() == []
+
+
+# ---------------------------------------------------------------------------
+# The elastic training harness (shared by the acceptance tests)
+# ---------------------------------------------------------------------------
+
+
+def _member_batch(m, step):
+    rng = np.random.RandomState(10_000 + m * 97 + step)
+    return (rng.randn(B, DIM).astype(np.float32),
+            rng.randn(B, 1).astype(np.float32))
+
+
+def _flat(tree):
+    return jnp.concatenate([a.reshape(-1) for a in
+                            jax.tree.leaves(tree)])
+
+
+def _unflat(flat, tree):
+    leaves, treedef = jax.tree.flatten(tree)
+    out, off = [], 0
+    for a in leaves:
+        out.append(flat[off:off + a.size].reshape(a.shape))
+        off += a.size
+    return jax.tree.unflatten(treedef, out)
+
+
+def _make_build(steps, *, zero=0):
+    """build(mesh, view) for run_elastic: a 2-layer MLP data-parallel
+    over one device per member, per-(member, step) deterministic
+    batches.  ``zero`` switches the update: 0 = replicated (psum'd
+    grads), 1 = mini-ZeRO-1 (psum_scatter grads, shard-local momentum
+    update, all_gather params — the shard extents re-derive from the
+    FULL checkpointed state under whatever n the view has, which IS
+    the deterministic re-partition), 3 = ZeRO-3 data flow (params
+    transiently re-gathered from this device's shard before the loss)."""
+
+    def build(mesh, view):
+        axes = tuple(mesh.axis_names)
+        members = view.members
+
+        def init_fn():
+            rng = np.random.RandomState(0)
+            params = {"w1": (rng.randn(DIM, H) * 0.3).astype(np.float32),
+                      "b1": np.zeros((H,), np.float32),
+                      "w2": (rng.randn(H, 1) * 0.3).astype(np.float32)}
+            return {"params": params,
+                    "mu": jax.tree.map(np.zeros_like, params),
+                    "losses": np.full((steps,), np.nan, np.float32)}
+
+        def body(p, mu, x, y):
+            x, y = x[0], y[0]
+            n = 1
+            for a in axes:
+                n = n * lax.axis_size(a)
+            ax = axes if len(axes) > 1 else axes[0]
+
+            def loss_fn(p):
+                h = jnp.tanh(x @ p["w1"] + p["b1"])
+                return jnp.mean((h @ p["w2"] - y) ** 2)
+
+            if zero == 3:
+                # ZeRO-3 data flow: this device's param shard is the
+                # persistent form; re-gather transiently for compute.
+                pf = _flat(p)
+                pad = (-pf.size) % n
+                pfp = jnp.pad(pf, (0, pad))
+                k = pfp.size // n
+                idx = lax.axis_index(axes[0])
+                p_sh = lax.dynamic_slice(pfp, (idx * k,), (k,))
+                pfp = lax.all_gather(p_sh, ax, tiled=True)
+                p = _unflat(pfp[:pf.size], p)
+            l, g = jax.value_and_grad(loss_fn)(p)
+            l = lax.pmean(l, ax)
+            if zero == 0:
+                g = jax.tree.map(lambda a: lax.pmean(a, ax), g)
+                mu2 = jax.tree.map(lambda m, a: MOM * m + a, mu, g)
+                p2 = jax.tree.map(lambda a, m: a - LR * m, p, mu2)
+                return p2, mu2, l
+            # mini-ZeRO-1/3: scatter the mean grads, update this
+            # device's momentum/param shard, gather both back full.
+            gf = _flat(g)
+            pad = (-gf.size) % n
+            gfp = jnp.pad(gf, (0, pad))
+            k = gfp.size // n
+            g_sh = lax.psum_scatter(gfp, ax, scatter_dimension=0,
+                                    tiled=True) / n
+            idx = lax.axis_index(axes[0])
+            mu_sh = lax.dynamic_slice(jnp.pad(_flat(mu), (0, pad)),
+                                      (idx * k,), (k,))
+            p_sh = lax.dynamic_slice(jnp.pad(_flat(p), (0, pad)),
+                                     (idx * k,), (k,))
+            mu2_sh = MOM * mu_sh + g_sh
+            p2_sh = p_sh - LR * mu2_sh
+            p2f = lax.all_gather(p2_sh, ax, tiled=True)[:gf.size]
+            mu2f = lax.all_gather(mu2_sh, ax, tiled=True)[:gf.size]
+            return _unflat(p2f, p), _unflat(mu2f, mu), l
+
+        data_sharding = NamedSharding(mesh, P(axes))
+        stepf = jax.jit(shard_map(
+            body, mesh=mesh, in_specs=(P(), P(), P(axes), P(axes)),
+            out_specs=(P(), P(), P()), check_vma=False))
+
+        def step_fn(state, i):
+            xs, ys = zip(*(_member_batch(m, i) for m in members))
+            xb = jax.device_put(np.stack(xs), data_sharding)
+            yb = jax.device_put(np.stack(ys), data_sharding)
+            p2, mu2, l = stepf(state["params"], state["mu"], xb, yb)
+            losses = np.array(state["losses"])
+            losses[i] = np.asarray(l)
+            return {"params": jax.tree.map(np.asarray, p2),
+                    "mu": jax.tree.map(np.asarray, mu2),
+                    "losses": losses}
+
+        return init_fn, step_fn
+
+    return build
+
+
+def _kill_plan(path, rank, step, nranks, seed=3):
+    with open(path, "w") as f:
+        json.dump({"version": 1, "seed": seed, "note": "", "rules": [
+            {"site": "elastic.member", "kind": "fail", "prob": 1.0,
+             "after": step * nranks + rank, "max_hits": 1}]}, f)
+    return str(path)
+
+
+@pytest.fixture()
+def elastic_runtime():
+    """Callable fixture: (re-)init the runtime with elastic on (plus
+    optional faults/obs), always restoring the stock world on exit —
+    resize_world mutates the global mesh, and later test modules
+    assume the full 8-device world."""
+
+    def arm(**cfg_kw):
+        mpi.stop()
+        return mpi.init(mpi.Config(elastic="on", **cfg_kw))
+
+    yield arm
+    if "torchmpi_tpu.faults" in sys.modules:
+        sys.modules["torchmpi_tpu.faults"].reset()
+    mpi.stop()
+
+
+def _run(build, directory, members, **kw):
+    from torchmpi_tpu import elastic
+
+    return elastic.run_elastic(build, steps=STEPS, directory=directory,
+                               save_every=2, members=members,
+                               world_size=8, **kw)
+
+
+def _copy_ckpt(src, dst, step):
+    os.makedirs(dst, exist_ok=True)
+    for f in os.listdir(src):
+        if f.startswith(f"ckpt_{step}_"):
+            shutil.copy(os.path.join(src, f), os.path.join(dst, f))
+
+
+# ---------------------------------------------------------------------------
+# The acceptance scenario: deterministic chaos shrink, bit-identical
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("zero", [0, 1])
+def test_shrink_bit_identical(tmp_path, elastic_runtime, zero):
+    """Seeded kill of rank 2 at step 5 -> the survivors re-form at N-1
+    without operator intervention, continue, and the loss trajectory +
+    final params are BIT-identical to a clean N-1 run restored from
+    the same fsync-verified checkpoint step (ZeRO-0 and the sharded
+    mini-ZeRO-1 re-partition)."""
+    d1 = str(tmp_path / "elastic")
+    os.makedirs(d1)
+    elastic_runtime(faults=_kill_plan(tmp_path / "plan.json", 2, 5, 4))
+    state1, info1 = _run(_make_build(STEPS, zero=zero), d1, [0, 1, 2, 3])
+    assert info1["shrinks"] == 1 and info1["reconciles"] == 1
+    assert info1["view"].members == (0, 1, 3)
+    r = info1["recovered_step"]
+    assert 0 < r <= 5
+
+    # Clean N-1 comparison run: ONLY the recovered step's checkpoint.
+    d2 = str(tmp_path / "clean")
+    _copy_ckpt(d1, d2, r)
+    elastic_runtime()  # no fault plan
+    state2, info2 = _run(_make_build(STEPS, zero=zero), d2, [0, 1, 3])
+    assert info2["recovered_step"] == r and info2["shrinks"] == 0
+
+    assert np.array_equal(state1["losses"][r:], state2["losses"][r:])
+    for k in state1["params"]:
+        assert np.array_equal(state1["params"][k], state2["params"][k])
+        assert np.array_equal(state1["mu"][k], state2["mu"][k])
+
+
+def test_shrink_zero3_allclose(tmp_path, elastic_runtime):
+    """Same scenario through the ZeRO-3 data flow (params transiently
+    re-gathered from shards): tight allclose per the acceptance bar —
+    and the trajectories are byte-stable run to run."""
+    d1 = str(tmp_path / "elastic")
+    os.makedirs(d1)
+    elastic_runtime(faults=_kill_plan(tmp_path / "plan.json", 2, 5, 4))
+    state1, info1 = _run(_make_build(STEPS, zero=3), d1, [0, 1, 2, 3])
+    assert info1["shrinks"] == 1
+    r = info1["recovered_step"]
+
+    d2 = str(tmp_path / "clean")
+    _copy_ckpt(d1, d2, r)
+    elastic_runtime()
+    state2, _ = _run(_make_build(STEPS, zero=3), d2, [0, 1, 3])
+    np.testing.assert_allclose(state1["losses"][r:],
+                               state2["losses"][r:], rtol=1e-6)
+    for k in state1["params"]:
+        np.testing.assert_allclose(state1["params"][k],
+                                   state2["params"][k], rtol=1e-6,
+                                   atol=1e-7)
+
+
+def test_rejoin_at_step_boundary(tmp_path, elastic_runtime):
+    """A healed peer rejoins at a step boundary via the same reconcile,
+    restoring the original partition layout: kill rank 2 at step 2, a
+    pre-posted join request (ignored while 2 is a member) is admitted
+    at the first boundary after the shrink, and the run finishes at
+    the FULL member set with tm_elastic_{shrink,rejoin} counted."""
+    d = str(tmp_path / "elastic")
+    os.makedirs(d)
+    elastic_runtime(faults=_kill_plan(tmp_path / "plan.json", 2, 2, 4),
+                    obs="metrics", obs_dir=str(tmp_path / "obs"))
+    board = membership.Board(os.path.join(d, "membership"))
+    board.request_join(2)  # stale while 2 lives; a join once it died
+
+    state, info = _run(_make_build(STEPS), d, [0, 1, 2, 3])
+    assert info["shrinks"] == 1 and info["rejoins"] == 1
+    assert info["view"].members == (0, 1, 2, 3)  # original layout back
+    assert info["reconciles"] == 2
+    assert board.join_requests() == []  # cleared at admission
+    assert np.isfinite(state["losses"]).all()
+    # The healed peer's half: admit() sees the committed view.
+    from torchmpi_tpu import elastic, obs
+
+    view = elastic.admit(d, 2, deadline_s=2)
+    assert 2 in view.members and view.epoch == info["view"].epoch
+    reg = obs.registry()
+    assert reg.counter_total("tm_elastic_shrink_total") == 1
+    assert reg.counter_total("tm_elastic_rejoin_total") == 1
+    assert reg.counter_total("tm_elastic_reconcile_total") == 2
+
+
+def test_ledger_escalation_shrinks(tmp_path, elastic_runtime):
+    """``HealthLedger.decide() == "raise"`` — not only an injected hard
+    fail — triggers the shrink: the detect half of detect->shrink uses
+    the SAME per-peer ledger as every other cross-host surface, so a
+    member whose failures accumulated elsewhere (PS exchanges, missed
+    heartbeats) is retired at the next step boundary."""
+    d = str(tmp_path / "elastic")
+    os.makedirs(d)
+    elastic_runtime(faults="policy")  # resilience armed, no injection
+    from torchmpi_tpu import faults
+
+    led = faults.ledger()
+    for _ in range(led.dead_after):
+        led.record("member:3", ok=False)
+    assert led.decide("member:3") == "raise"
+    state, info = _run(_make_build(STEPS), d, [0, 1, 2, 3])
+    assert info["shrinks"] == 1
+    assert info["view"].members == (0, 1, 2)
+    assert np.isfinite(state["losses"]).all()
+
+
+def test_plain_restart_keeps_mesh_and_plans(tmp_path, elastic_runtime):
+    """A non-membership failure takes the in-place restore path: the
+    view, the mesh, and every cached CollectivePlan survive — no
+    segment teardown, no config-epoch bump, no re-jit (only
+    shrink/grow may touch the planner)."""
+    from torchmpi_tpu import runtime
+
+    d = str(tmp_path / "elastic")
+    os.makedirs(d)
+    elastic_runtime()
+    base = _make_build(STEPS)
+    builds = []
+    boom = []
+
+    def build(mesh, view):
+        builds.append(runtime.config_epoch())
+        init_fn, step_fn = base(mesh, view)
+
+        def step(state, i):
+            if i == 4 and not boom:
+                boom.append(i)
+                raise RuntimeError("transient, unattributable")
+            return step_fn(state, i)
+
+        return init_fn, step
+
+    state, info = _run(build, d, [0, 1, 2, 3])
+    assert info["restarts_used"] == 1 and info["shrinks"] == 0
+    assert len(builds) == 1  # one segment: never torn down
+    assert info["recovered_step"] == 4  # restored in place
+    assert np.isfinite(state["losses"]).all()
+
+
+def test_peer_timeout_implicates_member(tmp_path, elastic_runtime):
+    """A ``PeerTimeoutError`` raised mid-step whose peer is a
+    ``member:<rank>`` row shrinks THAT member — the run_with_restarts
+    ``on_peer_timeout`` seam, elastic edition.  An unattributable peer
+    (``"gang"``) takes the plain restore path instead and burns the
+    restart budget."""
+    d = str(tmp_path / "elastic")
+    os.makedirs(d)
+    elastic_runtime(faults="policy")
+    from torchmpi_tpu.faults import PeerTimeoutError
+
+    base = _make_build(STEPS)
+    fired = []
+
+    def build(mesh, view):
+        init_fn, step_fn = base(mesh, view)
+
+        def step(state, i):
+            if i == 3 and len(view.members) == 4 and not fired:
+                fired.append(i)
+                raise PeerTimeoutError("ps.response", peer="member:1",
+                                       deadline_s=1.0)
+            return step_fn(state, i)
+
+        return init_fn, step
+
+    state, info = _run(build, d, [0, 1, 2, 3])
+    assert info["shrinks"] == 1
+    assert info["view"].members == (0, 2, 3)
+    assert info["restarts_used"] == 0  # attributed: no budget burned
+    assert np.isfinite(state["losses"]).all()
+
+
+def test_reshard_ps(tmp_path, elastic_runtime):
+    """PS shards re-partition onto the survivors: the old instance is
+    shut down (best-effort) and a fresh one re-shards the recovered
+    params deterministically."""
+    from torchmpi_tpu import elastic
+
+    elastic_runtime()
+    params = {"w": np.arange(16, dtype=np.float32),
+              "b": np.ones((4,), np.float32)}
+    ps = mpi.parameterserver.init(params, num_shards=2)
+    ps2 = None
+    try:
+        ps2 = elastic.reshard_ps(params, num_shards=1, old_ps=ps)
+        assert len(ps2.client.peers) == 1
+        got = ps2.receive().wait()
+        np.testing.assert_array_equal(np.asarray(got["w"]), params["w"])
+        np.testing.assert_array_equal(np.asarray(got["b"]), params["b"])
+    finally:
+        if ps2 is not None:
+            ps2.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# resize_world + plan invalidation
+# ---------------------------------------------------------------------------
+
+
+def test_resize_world_invalidates_plans(elastic_runtime):
+    from torchmpi_tpu import planner, runtime
+
+    elastic_runtime()
+    x = np.ones((8, 8), np.float32)
+    mpi.allreduce(x)  # builds an eager plan against the 8-dev world
+    assert planner.stats()["entries"] >= 1
+    epoch0 = runtime.config_epoch()
+    mesh = runtime.resize_world(jax.devices()[:6])
+    assert tuple(mesh.axis_names) == ("ici",)
+    assert mesh.devices.size == 6
+    assert runtime.config_epoch() == epoch0 + 1
+    assert planner.stats()["entries"] == 0  # stale plans dropped
+    assert runtime.device_count() == 6
+    y = mpi.allreduce(np.ones((6, 8), np.float32))  # works on the new gang
+    assert np.asarray(y).shape == (6, 8)
+    with pytest.raises(ValueError):
+        runtime.resize_world([])
+    with pytest.raises(ValueError):
+        runtime.resize_world(jax.devices()[:6], shape={"dcn": 2, "ici": 4})
+
+
+def test_elastic_requires_opt_in(tmp_path):
+    mpi.stop()
+    mpi.init(mpi.Config(dcn_size=1))
+    try:
+        from torchmpi_tpu import elastic
+
+        with pytest.raises(RuntimeError, match="elastic"):
+            elastic.run_elastic(lambda m, v: (None, None), steps=1,
+                                directory=str(tmp_path))
+        with pytest.raises(RuntimeError, match="elastic"):
+            elastic.admit(str(tmp_path), 0)
+    finally:
+        mpi.stop()
+
+
+def test_elastic_config_env_and_validation(monkeypatch):
+    from torchmpi_tpu import runtime
+
+    mpi.stop()
+    monkeypatch.setenv("TORCHMPI_TPU_ELASTIC", "1")
+    monkeypatch.setenv("TORCHMPI_TPU_ELASTIC_DEADLINE", "7.5")
+    try:
+        mpi.init(mpi.Config(dcn_size=1))  # explicit config, env pickup
+        assert runtime.config().elastic == "on"
+        assert runtime.config().elastic_deadline_s == 7.5
+        mpi.set_config(elastic="off")
+        assert runtime.config().elastic == "off"
+        with pytest.raises(ValueError):
+            mpi.set_config(elastic="sideways")
+        with pytest.raises(ValueError):
+            mpi.set_config(elastic_poll_s=0)
+    finally:
+        mpi.stop()
+    monkeypatch.setenv("TORCHMPI_TPU_ELASTIC", "bogus")
+    with pytest.raises(ValueError):
+        mpi.init(mpi.Config(dcn_size=1))
+    monkeypatch.delenv("TORCHMPI_TPU_ELASTIC")
+    mpi.stop()
+
+
+# ---------------------------------------------------------------------------
+# Re-partition helpers
+# ---------------------------------------------------------------------------
+
+
+def test_rebucket_ef_residuals(elastic_runtime):
+    """Re-bucketing preserves total outstanding error mass per flat
+    gradient position across a (2,4) -> (1,4) topology change, and
+    lands in exactly the layout init_dcn_residuals builds for the new
+    mesh."""
+    from torchmpi_tpu import elastic
+    from torchmpi_tpu.parallel import gradsync
+
+    elastic_runtime(ici_size=4)  # (dcn=2, ici=4) world
+    import torchmpi_tpu.runtime as runtime
+
+    params = {"w": np.zeros((3, 5), np.float32),
+              "b": np.zeros((7,), np.float32)}
+    old = gradsync.init_dcn_residuals(params, ("dcn", "ici"))
+    rng = np.random.RandomState(1)
+    old = [jnp.asarray(rng.randn(*np.asarray(r).shape)
+                       .astype(np.float32)) for r in old]
+    mesh = runtime.resize_world(jax.devices()[:4],
+                                shape={"dcn": 1, "ici": 4})
+    new = elastic.rebucket_ef_residuals(old, params, (2, 4),
+                                        axis_names=("dcn", "ici"),
+                                        mesh=mesh)
+    fresh = gradsync.init_dcn_residuals(params, ("dcn", "ici"),
+                                        mesh=mesh)
+    assert [np.asarray(a).shape for a in new] \
+        == [np.asarray(a).shape for a in fresh]
+    ext = 3 * 5 + 7
+    old_mass = np.asarray(old[0]).reshape(2, 4, -1).sum(0).reshape(-1)
+    new_mass = np.asarray(new[0]).reshape(1, 4, -1).sum(0).reshape(-1)
+    np.testing.assert_allclose(new_mass[:ext], old_mass[:ext],
+                               rtol=1e-6)
+    # Mismatched bucket count fails with the init pointer, not deep in
+    # a reshape.
+    with pytest.raises(ValueError, match="bucket"):
+        elastic.rebucket_ef_residuals(old + old, params, (2, 4),
+                                      axis_names=("dcn", "ici"),
+                                      mesh=mesh)
+
+
+# ---------------------------------------------------------------------------
+# chaos_tool shrink recipe
+# ---------------------------------------------------------------------------
+
+
+def _chaos_tool():
+    spec = importlib.util.spec_from_file_location(
+        "_chaos_tool_elastic", os.path.join(_REPO, "scripts",
+                                            "chaos_tool.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_chaos_tool_shrink_recipe(tmp_path, capsys):
+    tool = _chaos_tool()
+    out = str(tmp_path / "shrink.json")
+    assert tool.main(["gen", "--out", out, "--seed", "3",
+                      "--shrink", "2:5:4"]) == 0
+    text = capsys.readouterr().out
+    assert "kill rank 2 at step 5" in text
+    plan = json.load(open(out))
+    assert plan["rules"] == [{"site": "elastic.member", "kind": "fail",
+                              "prob": 1.0, "after": 22, "max_hits": 1,
+                              "delay_s": 0.0}]
+    assert tool.main(["lint", out]) == 0
+    capsys.readouterr()
+    # Bad specs fail loudly; empty gen does too; so does composing two
+    # kills in one plan (ordinals are only exact for the first).
+    assert tool.main(["gen", "--out", out, "--shrink", "4:1:4"]) == 2
+    assert tool.main(["gen", "--out", out]) == 2
+    assert tool.main(["gen", "--out", out, "--shrink", "1:2:4",
+                      "--shrink", "2:3:4"]) == 2
+    # corrupt at the payload-free site lints as a problem.
+    assert tool.main(["gen", "--out", out,
+                      "--rule", "elastic.member:corrupt"]) == 0
+    assert tool.main(["lint", out]) == 1
+
+
+# ---------------------------------------------------------------------------
+# Off-mode: zero cost, never imported
+# ---------------------------------------------------------------------------
+
+
+def test_off_mode_never_imports_elastic():
+    """With elastic off (the default), neither torchmpi_tpu.elastic nor
+    the membership module is ever imported — and the dispatch path has
+    no branch to take: eager + in-axis collectives and a gradsync step
+    run exactly as before."""
+    code = (
+        "import sys\n"
+        "import numpy as np\n"
+        "import torchmpi_tpu as mpi\n"
+        "mpi.init(mpi.Config(dcn_size=1))\n"
+        "mpi.allreduce(np.ones((2, 4), np.float32))\n"
+        "mpi.allreduce(np.ones((2, 4), np.float32), backend='host')\n"
+        "mpi.barrier()\n"
+        "mpi.stop()\n"
+        "assert 'torchmpi_tpu.elastic' not in sys.modules\n"
+        "assert 'torchmpi_tpu.faults.membership' not in sys.modules\n"
+        "assert 'torchmpi_tpu.faults' not in sys.modules\n"
+        "print('ELASTIC-OFF-OK')\n"
+    )
+    env = dict(os.environ)
+    for k in ("TORCHMPI_TPU_ELASTIC", "TORCHMPI_TPU_FAULTS"):
+        env.pop(k, None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    out = subprocess.run([sys.executable, "-c", code],
+                         capture_output=True, text=True, timeout=300,
+                         env=env, cwd=_REPO)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "ELASTIC-OFF-OK" in out.stdout
+
+
+# ---------------------------------------------------------------------------
+# 2-process acceptance (slow): real peer death, survivor continues
+# ---------------------------------------------------------------------------
+
+
+def _launch_workers(worker, args, n):
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    procs = [subprocess.Popen(
+        [sys.executable, worker, str(i), str(n), str(port)] + args,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, env=env) for i in range(n)]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {i} failed:\n{out}"
+    return outs
+
+
+def _summaries(outs):
+    out = {}
+    for o in outs:
+        for ln in o.splitlines():
+            if ln.startswith("ELASTIC-SUMMARY "):
+                d = json.loads(ln[len("ELASTIC-SUMMARY "):])
+                out[d["rank"]] = d
+    return out
+
+
+@pytest.mark.slow
+def test_two_process_elastic_rejoin(tmp_path):
+    """Multi-process rejoin end to end: rank 1 REALLY dies (injected),
+    rank 0 shrinks and keeps training; rank 1 then admits itself back
+    (elastic.admit), rank 0 seeds its checkpoint for the committed
+    boundary and grows, and BOTH processes finish the run together on
+    the re-grown full mesh with identical final digests — the
+    survivors-only agreement tags and the seeded joiner checkpoint are
+    exactly what this exercises."""
+    worker = os.path.join(os.path.dirname(__file__),
+                          "_elastic_worker.py")
+    plan = _kill_plan(tmp_path / "plan.json", 1, 4, 2)
+    d = str(tmp_path / "gang")
+    os.makedirs(d)
+    outs = _launch_workers(worker, ["elastic-rejoin", d, plan], 2)
+    assert any("CHECK rank=1 member-death ok" in o for o in outs), outs
+    assert any("CHECK rank=1 admitted" in o for o in outs), outs
+    by_rank = _summaries(outs)
+    assert set(by_rank) == {0, 1}, outs
+    assert by_rank[0]["shrinks"] == 1 and by_rank[0]["rejoins"] == 1
+    assert by_rank[0]["members"] == [0, 1]  # original layout restored
+    assert by_rank[1]["members"] == [0, 1]
+    assert by_rank[0]["losses_digest"] == by_rank[1]["losses_digest"]
+    assert by_rank[0]["params_digest"] == by_rank[1]["params_digest"]
+
+
+@pytest.mark.slow
+def test_two_process_elastic_shrink(tmp_path):
+    """The CI elastic-smoke scenario in-tree: a 2-process gang under a
+    seeded elastic.member kill plan — rank 1 exits as the dead member,
+    rank 0 re-forms alone at N-1 and finishes with
+    tm_elastic_shrink_total >= 1 and a loss trajectory bit-identical
+    to a from-scratch 1-process run restored from the recovered step
+    (tests/_elastic_worker.py)."""
+    worker = os.path.join(os.path.dirname(__file__),
+                          "_elastic_worker.py")
+    plan = _kill_plan(tmp_path / "plan.json", 1, 4, 2)
+    d1 = str(tmp_path / "gang")
+    os.makedirs(d1)
+
+    outs = _launch_workers(worker, ["elastic", d1, plan], 2)
+    by_rank = _summaries(outs)
+    assert 0 in by_rank, outs
+    summary = by_rank[0]
+    assert summary["shrinks"] >= 1 and summary["elastic_shrink_total"] >= 1
+    assert any("CHECK rank=1 member-death ok" in o for o in outs), outs
+
+    r = summary["recovered_step"]
+    d2 = str(tmp_path / "clean")
+    _copy_ckpt(d1, d2, r)
+    outs2 = _launch_workers(worker, ["clean", d2, ""], 1)
+    clean = _summaries(outs2).get(0)
+    assert clean is not None, outs2
+    assert clean["recovered_step"] == r
+    assert clean["losses_digest"] == summary["losses_digest"], \
+        (summary, clean)
+    assert clean["params_digest"] == summary["params_digest"]

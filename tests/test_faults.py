@@ -261,6 +261,112 @@ def test_health_ledger_thresholds_and_decide():
         fhealth.HealthLedger(suspect_after=5, dead_after=2)
 
 
+def test_health_ledger_edge_transitions():
+    """The edges around the happy thresholds: suspect -> healthy on ONE
+    success (no half-credit), dead stays dead under further failures
+    (no transition spam), and a suspect that keeps failing walks
+    through dead without revisiting healthy."""
+    seen = []
+    led = fhealth.HealthLedger(suspect_after=1, dead_after=3,
+                               on_transition=lambda p, o, n: seen.append(
+                                   (o, n)))
+    assert led.record("a", ok=False) == "suspect"  # suspect_after=1
+    assert led.record("a", ok=True) == "healthy"   # one success resets
+    h = led.get("a")
+    assert h.consecutive_failures == 0 and h.total_failures == 1
+    for _ in range(3):
+        led.record("a", ok=False)
+    assert led.state("a") == "dead"
+    # Further failures keep it dead without re-firing the transition.
+    n_seen = len(seen)
+    assert led.record("a", ok=False) == "dead"
+    assert len(seen) == n_seen
+    assert seen == [("healthy", "suspect"), ("suspect", "healthy"),
+                    ("healthy", "suspect"), ("suspect", "dead")]
+    # An unknown peer is healthy by definition (get() says so too).
+    assert led.state("zzz") == "healthy" and led.get("zzz") is None
+
+
+def test_health_ledger_concurrent_site_failures():
+    """decide() under concurrent failures from multiple sites: the
+    lock keeps the counts exact and the verdict monotonic (no lost
+    updates resurrecting a dead peer)."""
+    import threading
+
+    led = fhealth.HealthLedger(suspect_after=2, dead_after=4)
+    n_threads, per = 4, 25
+
+    def hammer():
+        for _ in range(per):
+            led.record("p", ok=False)
+
+    threads = [threading.Thread(target=hammer) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    h = led.get("p")
+    assert h.total_failures == n_threads * per
+    assert h.consecutive_failures == n_threads * per
+    assert led.decide("p") == "raise"
+
+
+def test_health_ledger_snapshot_roundtrip():
+    """to_dict/from_dict/restore (docs/ELASTIC.md): rows round-trip,
+    restore() re-classifies against the LIVE ledger's thresholds, and
+    a snapshot replay fires no transition callbacks (old evidence is
+    not a new observation)."""
+    led = fhealth.HealthLedger(suspect_after=2, dead_after=4)
+    for _ in range(4):
+        led.record("dead-peer", ok=False)
+    led.record("fine-peer", ok=True)
+    led.record("iffy-peer", ok=False)
+    led.record("iffy-peer", ok=False)
+    snap = led.to_dict()
+    assert snap["suspect_after"] == 2 and snap["dead_after"] == 4
+
+    led2 = fhealth.HealthLedger.from_dict(snap)
+    assert led2.state("dead-peer") == "dead"
+    assert led2.decide("iffy-peer") == "degrade"
+    assert led2.get("fine-peer").total_successes == 1
+
+    # restore() into a ledger with TIGHTER thresholds re-classifies
+    # from the counts — and stays silent.
+    fired = []
+    led3 = fhealth.HealthLedger(suspect_after=1, dead_after=2,
+                                on_transition=lambda *a: fired.append(a))
+    led3.restore(snap)
+    assert fired == []
+    assert led3.state("iffy-peer") == "dead"  # 2 >= dead_after=2
+    with pytest.raises(ValueError):
+        led3.restore({"peers": "nope"})
+    with pytest.raises(ValueError):
+        led3.restore({"peers": [{"no_peer_key": 1}]})
+
+
+def test_dead_peer_ping_reprobe(fault_runtime):
+    """A peer the ledger already calls dead is resurrected by a
+    successful ping() re-probe — liveness probes feed the same ledger
+    the resilient exchanges read, so an operator (or the elastic
+    driver) can re-admit a healed shard without restarting."""
+    fault_runtime([])  # armed, nothing injected
+    from torchmpi_tpu import faults
+
+    ps = mpi.parameterserver.init({"w": np.zeros(8, np.float32)},
+                                  num_shards=1)
+    try:
+        peer = ps.client.peers[0]
+        led = faults.ledger()
+        for _ in range(led.dead_after):
+            led.record(peer, ok=False)
+        assert led.decide(peer) == "raise"
+        alive = ps.client.ping()
+        assert alive == [True]
+        assert led.decide(peer) == "ok"  # one success resurrects
+    finally:
+        ps.shutdown()
+
+
 # ---------------------------------------------------------------------------
 # Per-site injection through the real call sites
 # ---------------------------------------------------------------------------

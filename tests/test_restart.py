@@ -95,6 +95,44 @@ def test_process_level_resume(tmp_path):
     assert info["recovered_step"] == 6  # which step the resume settled on
 
 
+def test_health_ledger_survives_restart_recovery(tmp_path):
+    """docs/ELASTIC.md satellite: peer health is snapshotted next to
+    every checkpoint and rehydrated on entry, so a process-level
+    restart does not reset every peer to healthy — a peer two failures
+    into its streak is STILL two failures in after recovery."""
+    import os
+
+    import torchmpi_tpu as mpi
+
+    mpi.stop()
+    mpi.init(mpi.Config(dcn_size=1, faults="policy"))
+    try:
+        from torchmpi_tpu import faults
+
+        led = faults.ledger()
+        led.clear()
+        led.record("flaky:9", ok=False)
+        led.record("flaky:9", ok=False)
+        restart.run_with_restarts(_init, _step, steps=4,
+                                  directory=str(tmp_path), save_every=2)
+        assert os.path.exists(
+            os.path.join(str(tmp_path), "health_p0.json"))
+        # Simulated process restart: the fresh process's ledger knows
+        # nothing — the next run_with_restarts entry rehydrates it.
+        led.clear()
+        assert led.get("flaky:9") is None
+        restart.run_with_restarts(_init, _step, steps=4,
+                                  directory=str(tmp_path), save_every=2)
+        h = led.get("flaky:9")
+        assert h is not None and h.consecutive_failures == 2
+        assert led.decide("flaky:9") == "degrade"
+    finally:
+        from torchmpi_tpu import faults
+
+        faults.reset()
+        mpi.stop()
+
+
 def test_corrupt_latest_checkpoint_falls_back(tmp_path):
     # A truncated newest npz (crash mid-write under a NON-atomic writer,
     # or torn storage) must not poison resume: recovery walks back to the

@@ -289,6 +289,54 @@ def test_router_prefers_healthy_replicas(lm):
         serving.Router([e0, e0])
 
 
+def test_healed_replica_readmitted(lm):
+    """The recovery half of health routing (ISSUE 11 satellite): a
+    drained replica whose ledger returns to healthy — one recorded
+    success, the HealthLedger contract — rejoins the dispatch rotation
+    and actually serves again."""
+    from torchmpi_tpu.faults.health import HealthLedger
+
+    model, params = lm
+    mpi.stop()
+    mpi.init(mpi.Config(dcn_size=1))
+    try:
+        e0 = serving.ReplicaEngine(model, params, name="r0", slots=2,
+                                   slot_tokens=16)
+        e1 = serving.ReplicaEngine(model, params, name="r1", slots=2,
+                                   slot_tokens=16)
+        router = serving.Router([e0, e1],
+                                ledger=HealthLedger(suspect_after=2,
+                                                    dead_after=3))
+        router.mark_dead(e1)
+        e1.drain()  # the scheduler's kill path: sessions out, dead on
+        assert e1.dead and router.decide(e1) == "raise"
+        assert router.pick() is e0
+        assert router.live() == [e0]
+        # A failure on a dead replica must NOT readmit it.
+        assert router.record(e1, ok=False) == "raise"
+        assert e1.dead
+        # One success resets the ledger -> healthy -> readmitted.
+        assert router.record(e1, ok=True) == "ok"
+        assert not e1.dead
+        assert router.live() == [e0, e1]
+        # And it really serves: two concurrent sessions spread across
+        # both replicas by least-loaded routing.
+        srv = serving.Server.__new__(serving.Server)
+        srv.router = router
+        srv.last_stats = {}
+        prompts = _prompts(2, seed=9)
+        reqs = [serving.Request(f"h{i}", prompts[i], max_new=4)
+                for i in range(2)]
+        done = srv.run_trace(reqs, tick_seconds=0.01)
+        assert len(done) == 2
+        assert {r.replica for r in reqs} == {"r0", "r1"}
+        for i, req in enumerate(reqs):
+            assert req.tokens == _offline(model, params, prompts[i],
+                                          4).tolist()
+    finally:
+        mpi.stop()
+
+
 # ---------------------------------------------------------------------------
 # SLO telemetry + obs_tool slo
 # ---------------------------------------------------------------------------

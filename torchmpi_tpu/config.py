@@ -229,6 +229,33 @@ class Config:
     # Env: TORCHMPI_TPU_OBS_RING.
     obs_ring_size: int = 1024
 
+    # --- elastic gang membership (torchmpi_tpu.elastic) ----------------------
+    # Elastic gang resize (docs/ELASTIC.md): "off" (default — the
+    # module is never imported, the dispatch path gains zero branches;
+    # same discipline as ``analysis``/``obs``/``faults``) or "on"
+    # (the ``elastic.run_elastic`` driver may re-form the gang at N-1
+    # when a member dies — membership epochs over a two-phase
+    # host-staged reconcile — and re-admit healed members at step
+    # boundaries).  The knob is a consent gate for the driver layer,
+    # not a dispatch-path switch: collectives never consult it.
+    # Env: TORCHMPI_TPU_ELASTIC.
+    elastic: str = "off"
+    # Directory of the membership board (heartbeats, proposals,
+    # commits, join requests — host-staged files on the shared
+    # checkpoint filesystem).  None resolves to
+    # ``<checkpoint directory>/membership`` inside the driver.
+    # Env: TORCHMPI_TPU_ELASTIC_DIR.
+    elastic_dir: Optional[str] = None
+    # Poll interval for the membership board (reconcile waits, healed-
+    # peer admission polls).  Env: TORCHMPI_TPU_ELASTIC_POLL.
+    elastic_poll_s: float = 0.05
+    # Per-round reconcile deadline: a member that posts neither its
+    # proposal nor its commit within this is dropped from the proposed
+    # view and the two-phase round retries one smaller (the bounded
+    # part of the bounded two-phase reconcile).
+    # Env: TORCHMPI_TPU_ELASTIC_DEADLINE.
+    elastic_deadline_s: float = 30.0
+
     # --- fault injection + resilient dispatch -------------------------------
     # torchmpi_tpu.faults (docs/FAULTS.md): "off" (default — one string
     # compare per cross-host call site, the module is never imported;
@@ -342,6 +369,12 @@ class Config:
             analysis=_env_str("TORCHMPI_TPU_ANALYSIS", "off"),
             obs=_env_str("TORCHMPI_TPU_OBS", "off"),
             faults=_env_str("TORCHMPI_TPU_FAULTS", "off"),
+            elastic=_env_str("TORCHMPI_TPU_ELASTIC", "off"),
+            elastic_dir=(os.environ.get("TORCHMPI_TPU_ELASTIC_DIR")
+                         or None),
+            elastic_poll_s=_env_float("TORCHMPI_TPU_ELASTIC_POLL", 0.05),
+            elastic_deadline_s=_env_float("TORCHMPI_TPU_ELASTIC_DEADLINE",
+                                          30.0),
             fault_retries=_env_int("TORCHMPI_TPU_FAULT_RETRIES", 2),
             fault_backoff_s=_env_float("TORCHMPI_TPU_FAULT_BACKOFF", 0.05),
             fault_deadline_s=_env_float("TORCHMPI_TPU_FAULT_DEADLINE",

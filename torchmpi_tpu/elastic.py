@@ -1,0 +1,662 @@
+"""Elastic gang resize: continue training at N-1 on peer death, re-admit
+healed peers at step boundaries (docs/ELASTIC.md).
+
+The reference's communicators were disposable — the gang could be torn
+down and re-formed (PAPER.md) — but an MPI rank failure still aborted
+the job.  This module closes that gap for the modern stack: when a
+member of the training gang dies, the survivors agree on a new
+membership view (a bounded two-phase reconcile over the host-staged
+board, :mod:`torchmpi_tpu.faults.membership`), re-form the world mesh
+at N-1 (:func:`runtime.resize_world` — the config-epoch bump strands
+every cached :class:`~torchmpi_tpu.planner.CollectivePlan`), restore
+the last fsync-verified checkpoint, deterministically re-partition the
+state onto the survivors (ZeRO shard layouts and PS shard extents are
+pure functions of ``(tree, n)``, so re-sharding is a rebuild, not a
+migration), and resume the step loop.  A healed peer polls the board
+(:func:`admit`) and rejoins only at a step boundary via the same
+reconcile, restoring the original partition layout.
+
+Membership granularity: one member per **process** on a multi-process
+gang (the deployment shape), one member per **device** on the
+single-process CPU sim (``members``/``world_size`` let tests carve an
+8-device sim into any gang) — elasticity is fully testable without
+hardware, driven by deterministic chaos plans on the new
+``elastic.member`` fault site (``scripts/chaos_tool.py gen --shrink``).
+
+Off by default and **never imported when off** — the
+``analysis``/``obs``/``faults`` import discipline: ``Config.elastic``
+is a consent gate for this driver layer, the dispatch path has no
+branch on it anywhere, and ``import torchmpi_tpu`` never imports this
+module (``tests/test_elastic.py`` asserts both).  Telemetry
+(``tm_elastic_{reconcile,shrink,rejoin}_total`` + flight events) rides
+:mod:`torchmpi_tpu.obs` through ``sys.modules`` when obs is active.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from . import runtime
+from .faults import membership
+from .faults.membership import MembershipView  # noqa: F401 (re-export)
+from .utils import checkpoint, restart
+
+PyTree = Any
+
+# ``build(mesh, view) -> (init_fn, step_fn)``: the per-view step
+# factory run_elastic rebuilds the training program through after every
+# membership change.  ``init_fn() -> state`` returns the FULL
+# (topology-portable) train state — the checkpoint template; sharded
+# layouts (ZeRO partitions, PS shards, EF residuals) are derived from
+# it under the view's mesh, which is what makes the re-partition
+# deterministic.  ``step_fn(state, i) -> state``.
+BuildFn = Callable[[Any, MembershipView], Tuple[Callable[[], PyTree],
+                                                Callable[[PyTree, int],
+                                                         PyTree]]]
+
+
+class MemberDeath(RuntimeError):
+    """A gang member died.  Raised out of :func:`run_elastic` only when
+    the dead member is THIS process (the survivors continue without
+    it); carries ``member`` (rank) and ``step``."""
+
+    def __init__(self, member: int, step: int, msg: str = ""):
+        super().__init__(
+            msg or f"gang member {member} died at step {step}")
+        self.member = int(member)
+        self.step = int(step)
+
+
+def _require_on():
+    """Every public entry point's consent gate (the user must opt in
+    via ``Config.elastic`` — same posture as the other layers' modes,
+    minus any dispatch-path branch)."""
+    cfg = runtime.effective_config()
+    if cfg.elastic == "off":
+        raise RuntimeError(
+            "torchmpi_tpu.elastic requires Config.elastic='on' (or "
+            "TORCHMPI_TPU_ELASTIC=1) — the elastic gang driver is "
+            "opt-in; see docs/ELASTIC.md")
+    return cfg
+
+
+def _obs_record(event: str, *, epoch: int = 0, members: int = 0,
+                peer: str = "") -> None:
+    """tm_elastic_* through obs when active (sys.modules lookup — the
+    driver never imports the telemetry it reports to)."""
+    mod = sys.modules.get("torchmpi_tpu.obs")
+    try:
+        if mod is not None and mod.active():
+            mod.record_elastic(event, epoch=epoch, members=members,
+                               peer=peer)
+    except Exception:  # noqa: BLE001 — telemetry never fails a resize
+        pass
+
+
+def _faults_mod():
+    """The armed fault layer, or None (one string compare + sys.modules
+    — matches the call-site discipline everywhere else)."""
+    if runtime.effective_config().faults == "off":
+        return None
+    mod = sys.modules.get("torchmpi_tpu.faults")
+    if mod is not None and mod.active():
+        return mod
+    return None
+
+
+def _member_peer(m: int) -> str:
+    """Ledger peer name for gang member ``m`` (prefixed so member rows
+    never collide with PS ``host:port`` endpoints)."""
+    return f"member:{int(m)}"
+
+
+class ElasticGang:
+    """Membership state + resize mechanics for one training gang.
+
+    ``directory`` is the checkpoint directory; the membership board
+    defaults to ``Config.elastic_dir`` or ``<directory>/membership``.
+    ``members`` (default: one per process, or one per device on the
+    single-process sim) are integer ranks; on the sim, ``world_size``
+    fixes the member -> device mapping (member ``m`` owns the
+    ``len(devices)/world_size`` devices starting at slot ``m``) so a
+    survivors-only gang maps to the SAME devices a full gang would give
+    them — the bit-reproducibility anchor of the shrink tests.
+    """
+
+    def __init__(self, directory: str, *,
+                 members: Optional[Sequence[int]] = None,
+                 world_size: Optional[int] = None,
+                 board_dir: Optional[str] = None):
+        cfg = _require_on()
+        self.poll_s = float(cfg.elastic_poll_s)
+        self.deadline_s = float(cfg.elastic_deadline_s)
+        self.board = membership.Board(
+            board_dir or cfg.elastic_dir
+            or os.path.join(directory, "membership"))
+        self._multiproc = jax.process_count() > 1
+        all_devs = list(jax.devices())
+        if members is None:
+            members = (range(jax.process_count()) if self._multiproc
+                       else range(len(all_devs)))
+        members = tuple(sorted(int(m) for m in members))
+        # The member -> devices map covers EVERY possible member slot,
+        # not just the starting set: a driver restarted with only the
+        # survivors must still be able to admit a healed rank it never
+        # met (the rank's devices are a function of its slot, not of
+        # who happened to be alive at startup).
+        if self._multiproc:
+            ws = jax.process_count()
+            self._dev_of = {
+                m: [d for d in all_devs if d.process_index == m]
+                for m in range(ws)}
+            self.local_ranks: Tuple[int, ...] = (jax.process_index(),)
+        else:
+            ws = int(world_size) if world_size else (members[-1] + 1)
+            if ws < members[-1] + 1 or len(all_devs) % ws:
+                raise ValueError(
+                    f"world_size {ws} must cover member {members[-1]} "
+                    f"and divide the device count {len(all_devs)}")
+            per = len(all_devs) // ws
+            self._dev_of = {m: all_devs[m * per:(m + 1) * per]
+                            for m in range(ws)}
+            self.local_ranks = members
+        for m, devs in self._dev_of.items():
+            if not devs:
+                raise ValueError(f"member {m} owns no devices")
+        # Adopt the board's committed view: the WHOLE view when its
+        # member set matches the caller's (a healed joiner re-entering
+        # after `admit` must hold the SAME (epoch, step) the survivors
+        # committed, so their recovery-agreement tags line up), else
+        # just its epoch (the caller's ``members`` is the operator's
+        # statement of who is starting NOW; proposing above the
+        # history avoids colliding with a past epoch's commit files).
+        committed = self.board.committed_view()
+        if committed is not None and committed.members == members:
+            self.view = committed
+        else:
+            epoch0 = committed.epoch if committed is not None else 0
+            self.view = MembershipView(epoch=epoch0, members=members,
+                                       step=0)
+        self.stats = {"shrinks": 0, "rejoins": 0, "reconciles": 0}
+        # Recovery-agreement round counter: reset on every view change
+        # so every participant — however it got here (survivor,
+        # restarted driver, healed joiner) — derives the same tag
+        # sequence for the same view.  Recoveries are collective
+        # (restart.recover's contract), so the per-view counts advance
+        # in lockstep.
+        self._agree_round = 0
+        self._last_hb = 0.0
+        # A previous incarnation's in-flight protocol state must not
+        # poison this one: drop our own agreement values and any
+        # propose/commit files above the committed epoch (committed
+        # history stays — committed_view reads it).
+        for r in self.local_ranks:
+            self.board.clear_values(r)
+            self.board.clear_votes_above(r, self.view.epoch)
+
+    # -- mesh ------------------------------------------------------------
+
+    def member_mesh(self):
+        """(Re-)form the world mesh over the current view's devices —
+        1-D ``(ici,)`` for one device per member, ``(dcn=members,
+        ici=per)`` otherwise.  Routes through
+        :func:`runtime.resize_world`, so the config epoch bumps and
+        every stale CollectivePlan is dropped."""
+        devs = [d for m in self.view.members for d in self._dev_of[m]]
+        per = len(self._dev_of[self.view.members[0]])
+        shape = (None if per == 1
+                 else {runtime.DCN_AXIS: len(self.view.members),
+                       runtime.ICI_AXIS: per})
+        return runtime.resize_world(devs, shape=shape)
+
+    def participants(self) -> int:
+        """Surviving PROCESS count (recovery-agreement granularity)."""
+        if not self._multiproc:
+            return 1
+        return len(self.view.members)
+
+    def agreement(self):
+        """Survivors-only min-agreement callable for
+        :func:`restart.recover` (the full-gang
+        ``checkpoint.agree_min_step`` would hang on the dead peer)."""
+
+        def agree(value: int) -> int:
+            self._agree_round += 1
+            tag = (f"e{self.view.epoch}s{self.view.step}"
+                   f"r{self._agree_round}")
+            return membership.agree_min(
+                self.board, tag,
+                self.local_ranks, self.view.members, value,
+                deadline_s=self.deadline_s, poll_s=self.poll_s)
+
+        return agree
+
+    # -- step-boundary poll ----------------------------------------------
+
+    def poll(self, step: int) -> Optional[Tuple[str, List[int]]]:
+        """One step-boundary membership check; returns ``("shrink",
+        dead_members)``, ``("rejoin", joiners)``, or None.
+
+        With the fault layer armed this fires the ``elastic.member``
+        chaos site once per member in rank order (arrival ordinal =
+        ``step * len(members) + index`` — what ``chaos_tool gen
+        --shrink`` computes): an injected hard ``fail`` kills that
+        member outright; a transient ``drop`` records a ledger failure
+        so repeated drops escalate healthy -> suspect -> dead through
+        ``HealthLedger.decide`` exactly like any other peer."""
+        import time
+
+        # Heartbeats are liveness evidence at detection granularity
+        # (~deadline), not per-step state: throttle the fsync'd board
+        # writes off the hot step loop.
+        now = time.monotonic()
+        if now - self._last_hb >= max(self.poll_s, self.deadline_s / 4):
+            for r in self.local_ranks:
+                if r in self.view.members:
+                    self.board.heartbeat(r, epoch=self.view.epoch,
+                                         step=step)
+            self._last_hb = now
+        dead: set = set()
+        faults = _faults_mod()
+        if faults is not None:
+            led = faults.ledger()
+            if faults.injecting():
+                for m in self.view.members:
+                    try:
+                        faults.fire("elastic.member", peer=_member_peer(m))
+                    except faults.InjectedFailure:
+                        dead.add(m)
+                    except faults.TransientFault:
+                        led.record(_member_peer(m), ok=False)
+                    else:
+                        led.record(_member_peer(m), ok=True)
+            dead |= {m for m in self.view.members
+                     if led.decide(_member_peer(m)) == "raise"}
+        if dead:
+            return ("shrink", sorted(dead))
+        joins = [r for r in self.board.join_requests()
+                 if r not in self.view.members and r in self._dev_of
+                 and self._joiner_alive(r)]
+        if joins:
+            return ("rejoin", joins)
+        return None
+
+    def _joiner_alive(self, rank: int) -> bool:
+        """Admit only joiners that look alive: a join request whose
+        poster is heartbeating (``admit()`` heartbeats while it polls)
+        is a waiting peer; one whose heartbeat went stale is a joiner
+        that crashed AFTER requesting — growing the mesh toward it
+        would wedge the gang's first collective.  A join with NO
+        heartbeat at all is an operator's explicit request and is
+        trusted."""
+        import time
+
+        hb = self.board.heartbeats().get(int(rank))
+        if hb is None:
+            return True
+        return time.time() - float(hb.get("ts", 0)) <= self.deadline_s
+
+    def includes_self(self, ranks: Sequence[int]) -> bool:
+        """Is THIS process among ``ranks``?  Only meaningful on a
+        multi-process gang — on the sim every member is local and a
+        death is by definition a peer's."""
+        return self._multiproc and jax.process_index() in set(ranks)
+
+    # -- resize ----------------------------------------------------------
+
+    def _reconcile(self, members: Sequence[int], *, step: int,
+                   voters: Optional[Sequence[int]] = None
+                   ) -> MembershipView:
+        view = membership.reconcile(
+            self.board, self.local_ranks, members,
+            epoch=self.view.epoch + 1, step=step, voters=voters,
+            deadline_s=self.deadline_s, poll_s=self.poll_s)
+        self.stats["reconciles"] += 1
+        _obs_record("reconcile", epoch=view.epoch,
+                    members=len(view.members))
+        self.view = view
+        self._agree_round = 0  # new view => fresh, lockstep tag sequence
+        return view
+
+    def shrink(self, dead: Sequence[int], *, step: int):
+        """Agree on the survivors-only view and re-form the mesh at
+        N-1 (or N-k).  Returns the new mesh; the caller then recovers
+        state from the last checkpoint and rebuilds its step."""
+        dead = sorted(set(int(m) for m in dead))
+        survivors = [m for m in self.view.members if m not in dead]
+        if not survivors:
+            raise membership.MembershipError(
+                f"every member died at step {step} — nothing to "
+                f"shrink to")
+        faults = _faults_mod()
+        if faults is not None:
+            led = faults.ledger()
+            for m in dead:
+                # The gang decision IS the death verdict — pin the
+                # ledger so a later decide() agrees with the view.
+                for _ in range(led.dead_after):
+                    led.record(_member_peer(m), ok=False)
+        view = self._reconcile(survivors, step=step)
+        self.stats["shrinks"] += 1
+        _obs_record("shrink", epoch=view.epoch, members=len(view.members),
+                    peer=",".join(_member_peer(m) for m in dead))
+        return self.member_mesh()
+
+    def grow(self, joiners: Sequence[int], *, step: int):
+        """Re-admit healed members at a step boundary: the CURRENT
+        members vote the grown view in (the joiner polls it via
+        :func:`admit`), the mesh re-forms at the original size, and
+        the original partition layout is restored by the same
+        deterministic re-partition that shrank it.  The caller must
+        have checkpointed ``step`` BEFORE growing — the joiner restores
+        exactly that step."""
+        joiners = sorted(set(int(r) for r in joiners)
+                         - set(self.view.members))
+        voters = list(self.view.members)
+        view = self._reconcile(sorted(set(voters) | set(joiners)),
+                               step=step, voters=voters)
+        faults = _faults_mod()
+        for r in joiners:
+            self.board.clear_join(r)
+            if faults is not None:
+                # A re-admitted member starts with a clean bill —
+                # its pre-death failure streak is stale evidence.
+                faults.ledger().record(_member_peer(r), ok=True)
+        self.stats["rejoins"] += 1
+        _obs_record("rejoin", epoch=view.epoch, members=len(view.members),
+                    peer=",".join(_member_peer(r) for r in joiners))
+        return self.member_mesh()
+
+
+def _seed_joiner_checkpoints(directory: str, step: int,
+                             joiners: Sequence[int],
+                             gang: ElasticGang) -> None:
+    """Give each joiner a per-process checkpoint file for the rejoin
+    boundary: ``checkpoint.save`` writes ``ckpt_<step>_p<proc>.npz``
+    for the CALLING process only, and recovery reads only a process's
+    own files — without this the joiner's newest checkpoint predates
+    its death and the post-grow min-agreement would roll the whole
+    gang back to it.  The state is replicated by the ``build``
+    contract (full/topology-portable leaves, identical on every
+    process), so the lowest surviving member's file IS the joiner's
+    file — copied via tmp + atomic rename, the checkpoint discipline.
+    No-op on the single-process sim (one process, one file)."""
+    if not gang._multiproc or \
+            jax.process_index() != min(gang.view.members):
+        return
+    import shutil
+
+    src = os.path.join(directory,
+                       f"ckpt_{step}_p{jax.process_index()}.npz")
+    for r in joiners:
+        dst = os.path.join(directory, f"ckpt_{step}_p{int(r)}.npz")
+        tmp = dst + ".tmp"
+        shutil.copyfile(src, tmp)
+        os.replace(tmp, dst)
+
+
+def _member_of_failure(e: BaseException) -> Optional[int]:
+    """Map a fault-layer error to the gang member it implicates, if
+    any: a ``PeerTimeoutError`` whose peer is a ``member:<rank>`` row.
+    Checked via sys.modules (the restart.py discipline)."""
+    mod = sys.modules.get("torchmpi_tpu.faults.policy")
+    if mod is None or not isinstance(e, mod.PeerTimeoutError):
+        return None
+    peer = str(getattr(e, "peer", ""))
+    if peer.startswith("member:") and peer[len("member:"):].isdigit():
+        return int(peer[len("member:"):])
+    return None
+
+
+def run_elastic(build: BuildFn, *, steps: int, directory: str,
+                save_every: int = 10, max_restarts: int = 3,
+                members: Optional[Sequence[int]] = None,
+                world_size: Optional[int] = None,
+                gang: Optional[ElasticGang] = None
+                ) -> Tuple[PyTree, Dict[str, Any]]:
+    """Run ``steps`` steps elastically: the detect -> shrink ->
+    rebalance -> rejoin loop over :func:`restart.run_with_restarts`'s
+    checkpoint machinery.
+
+    ``build(mesh, view)`` returns ``(init_fn, step_fn)`` for one
+    membership view (see :data:`BuildFn`); it is re-invoked after every
+    membership change, which is where the deterministic re-partition
+    happens — ZeRO shard layouts, PS shard extents and EF residual
+    buckets are pure functions of ``(state tree, view)``, so rebuilding
+    them from the recovered full state IS the rebalance.
+
+    Per-epoch segment: recover the newest fsync-verified checkpoint
+    (survivors-only agreement on a multi-process gang), then step,
+    checkpointing every ``save_every`` steps.  At every step boundary
+    the gang polls membership (:meth:`ElasticGang.poll`):
+
+    - a dead peer (injected hard-fail at the ``elastic.member`` site,
+      ledger escalation to ``dead``, or a ``PeerTimeoutError``
+      implicating a member mid-step) triggers :meth:`~ElasticGang.
+      shrink` and the segment restarts at N-1 from the last
+      checkpoint — no operator intervention;
+    - if THIS process is the dead member, :class:`MemberDeath` raises
+      out (finish dying, then come back through :func:`admit`);
+    - a posted join request triggers a checkpoint at the boundary and
+      :meth:`~ElasticGang.grow` — the healed member restores exactly
+      that step and the original layout is back.
+
+    Non-membership failures take the plain restore-and-replay path
+    with the ``max_restarts`` budget, exactly like
+    ``run_with_restarts``.  Returns ``(state, info)`` with ``info``
+    carrying ``shrinks``/``rejoins``/``reconciles``/``restarts_used``/
+    ``recovered_step``/``steps_run`` and the final ``view``.
+    """
+    _require_on()
+    if steps < 0:
+        raise ValueError(f"steps must be >= 0, got {steps}")
+    if gang is None:
+        gang = ElasticGang(directory, members=members,
+                           world_size=world_size)
+    restarts = 0
+    steps_run = 0
+    recovered_step = 0
+    mesh = None  # carried from shrink()/grow(): ONE resize per change
+    while True:
+        if mesh is None:
+            mesh = gang.member_mesh()
+        init_fn, step_fn = build(mesh, gang.view)
+        template = init_fn()
+        state, i = restart.recover(
+            init_fn, directory, template,
+            participants=gang.participants(), agree=gang.agreement())
+        recovered_step = i
+        resized = False
+        while i < steps:
+            ev = gang.poll(i)
+            if ev is not None:
+                kind, ranks = ev
+                if kind == "shrink":
+                    if gang.includes_self(ranks):
+                        raise MemberDeath(jax.process_index(), i)
+                    mesh = gang.shrink(ranks, step=i)
+                else:
+                    # Rejoin happens at a SAVED boundary so the healed
+                    # member restores exactly this step.
+                    checkpoint.save(directory, state, step=i)
+                    _seed_joiner_checkpoints(directory, i, ranks, gang)
+                    mesh = gang.grow(ranks, step=i)
+                resized = True
+                break
+            try:
+                state = step_fn(state, i)
+                steps_run += 1
+                i += 1
+                if i % save_every == 0 or i == steps:
+                    checkpoint.save(directory, state, step=i)
+            except KeyboardInterrupt:
+                raise
+            except BaseException as e:  # noqa: BLE001 — the elastic
+                # loop IS the handler: shrink, restore, or re-raise.
+                member = _member_of_failure(e)
+                if member is not None and member in gang.view.members:
+                    if gang.includes_self([member]):
+                        raise MemberDeath(member, i) from e
+                    mesh = gang.shrink([member], step=i)
+                    resized = True
+                    break
+                restarts += 1
+                if restarts > max_restarts:
+                    raise
+                # Plain (non-membership) restore: the view — and with
+                # it the mesh, the step program, and every cached
+                # CollectivePlan — is unchanged; recover in place
+                # instead of tearing the segment down and re-jitting.
+                state, i = restart.recover(
+                    init_fn, directory, template,
+                    participants=gang.participants(),
+                    agree=gang.agreement())
+                recovered_step = i
+        if not resized:
+            return state, {"shrinks": gang.stats["shrinks"],
+                           "rejoins": gang.stats["rejoins"],
+                           "reconciles": gang.stats["reconciles"],
+                           "restarts": restarts,
+                           "restarts_used": restarts,
+                           "steps_run": steps_run,
+                           "recovered_step": recovered_step,
+                           "view": gang.view}
+
+
+def admit(directory: str, rank: int, *,
+          board_dir: Optional[str] = None,
+          deadline_s: Optional[float] = None,
+          poll_s: Optional[float] = None) -> MembershipView:
+    """The healed peer's half of a rejoin: post a join request on the
+    membership board and poll until a committed view containing
+    ``rank`` appears — the gang admits at its next step boundary, so
+    the returned ``view.step`` is the checkpoint step to restore (the
+    caller then re-enters :func:`run_elastic` with the full member
+    set).  Blocks up to ``deadline_s`` (default
+    ``Config.elastic_deadline_s``)."""
+    import time
+
+    cfg = _require_on()
+    board = membership.Board(
+        board_dir or cfg.elastic_dir
+        or os.path.join(directory, "membership"))
+    deadline_s = (cfg.elastic_deadline_s if deadline_s is None
+                  else float(deadline_s))
+    poll_s = cfg.elastic_poll_s if poll_s is None else float(poll_s)
+    view = board.committed_view()
+    min_epoch = (view.epoch + 1) if view is not None \
+        and rank not in view.members else 0
+    board.request_join(rank)
+    t0 = time.monotonic()
+    while True:
+        # Heartbeat while waiting: the gang admits only joiners that
+        # look ALIVE (a stale-heartbeat join is a joiner that crashed
+        # after requesting — growing toward it would wedge the gang).
+        board.heartbeat(rank, epoch=-1, step=-1)
+        view = board.committed_view()
+        if view is not None and view.epoch >= min_epoch \
+                and int(rank) in view.members:
+            return view
+        if time.monotonic() - t0 > deadline_s:
+            raise membership.ReconcileTimeout(
+                f"no committed view containing rank {rank} appeared "
+                f"within {deadline_s:.3g}s")
+        time.sleep(poll_s)
+
+
+# ---------------------------------------------------------------------------
+# Deterministic re-partition helpers (the "rebalance" of the loop).
+# ---------------------------------------------------------------------------
+
+
+def rebucket_ef_residuals(residuals, params_template: PyTree,
+                          old_shape: Tuple[int, int], *,
+                          axis_names=None, mesh=None,
+                          n_buckets: Optional[int] = None) -> list:
+    """Re-bucket DCN error-feedback residual state
+    (``gradsync.init_dcn_residuals`` layout — ``[n_dev, shard]`` f32
+    per bucket) for a resized topology.
+
+    Residuals are positional error mass over the flat gradient: row
+    ``dcn*n_inner + ici`` of a bucket holds slice ``ici``'s
+    ICI-scattered extent as quantized by that slice.  Across a
+    topology change the per-slice attribution is meaningless (the
+    slices themselves changed), but the TOTAL outstanding error per
+    flat position — the sum over the old outer axis, which is exactly
+    what the next EF step would have added back — is portable: it is
+    summed out of the old layout, re-split per the new topology's
+    shard extents, and spread evenly over the new outer axis (so the
+    new outer sum reproduces it).  ``old_shape`` is the old
+    ``(n_outer, n_inner)``; the new layout comes from ``mesh`` (default
+    current) via ``gradsync.init_dcn_residuals`` — same tree, same
+    buckets, new extents.  Returns the re-bucketed state; no error
+    mass is dropped (asserted in tests/test_elastic.py).
+    """
+    import jax.numpy as jnp
+
+    from . import compress, fusion
+    from .parallel import gradsync
+
+    old_outer, old_inner = int(old_shape[0]), int(old_shape[1])
+    m = mesh if mesh is not None else runtime.current_mesh()
+    if axis_names is None:
+        axis_names = tuple(m.axis_names)
+    outer_ax, inner_ax = compress.ef_axes(axis_names)
+    inner_new = int(m.shape[inner_ax])
+    outer_new = int(m.shape[outer_ax])
+    fresh = gradsync.init_dcn_residuals(
+        params_template, axis_names, mesh=m, n_buckets=n_buckets)
+    if n_buckets is None:
+        n_buckets = runtime.effective_config().gradsync_buckets
+    spec = fusion.FusedSpec(params_template,
+                            n_buckets=max(1, int(n_buckets)))
+    extents = [hi - lo for g in spec.groups for (lo, hi) in g.bounds]
+    if len(residuals) != len(fresh):
+        raise ValueError(
+            f"residual state has {len(residuals)} buckets, the "
+            f"template derives {len(fresh)} — re-bucketing needs the "
+            f"same tree and n_buckets the state was initialized with")
+    out = []
+    for old, new, ext in zip(residuals, fresh, extents):
+        old = np.asarray(old)
+        if old.shape[0] != old_outer * old_inner:
+            raise ValueError(
+                f"residual rows {old.shape[0]} != old topology "
+                f"{old_outer}x{old_inner}")
+        # [outer, inner, shard] -> total outstanding error per flat
+        # position (old per-row shard padding falls off the extent).
+        total = old.reshape(old_outer, old_inner, -1).sum(axis=0)
+        flat = total.reshape(-1)[:ext]
+        shard_new = int(new.shape[1])
+        padded = np.zeros((inner_new * shard_new,), np.float32)
+        padded[:ext] = flat
+        per_slice = (padded.reshape(inner_new, shard_new)
+                     / np.float32(outer_new))
+        tiled = np.broadcast_to(
+            per_slice, (outer_new, inner_new, shard_new))
+        out.append(jnp.asarray(np.ascontiguousarray(
+            tiled.reshape(new.shape)).astype(np.float32)))
+    return out
+
+
+def reshard_ps(params: PyTree, *, num_shards: int, old_ps=None):
+    """Re-partition a sharded parameter server onto the surviving
+    hosts: shut the old instance down (best-effort — some of its shard
+    servers may be exactly what died) and re-create it over
+    ``num_shards`` fresh shards from the recovered ``params``.  Shard
+    extents are a pure function of ``(tree, num_shards)``
+    (``parallel/ps.py``), so the re-partition is deterministic."""
+    _require_on()
+    from . import parameterserver
+
+    if old_ps is not None:
+        try:
+            old_ps.shutdown()
+        except Exception:  # noqa: BLE001 — the dead shard IS the reason
+            pass
+    return parameterserver.init(params, num_shards=int(num_shards))

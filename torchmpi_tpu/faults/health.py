@@ -118,3 +118,57 @@ class HealthLedger:
     def clear(self) -> None:
         with self._lock:
             self._peers.clear()
+
+    # -- snapshot / restore (docs/ELASTIC.md, docs/FAULTS.md) -------------
+    #
+    # Peer health is evidence, and evidence must survive recovery:
+    # ``utils/restart.py`` snapshots the armed ledger next to every
+    # checkpoint and rehydrates it on recovery, so a process-level
+    # restart does not reset every peer to ``healthy`` and re-burn the
+    # full suspect->dead escalation on a peer that was already dead.
+
+    def to_dict(self) -> dict:
+        """JSON-ready snapshot of thresholds + every peer row."""
+        with self._lock:
+            return {
+                "suspect_after": self.suspect_after,
+                "dead_after": self.dead_after,
+                "peers": [dataclasses.asdict(h)
+                          for h in self._peers.values()],
+            }
+
+    def restore(self, d: dict) -> None:
+        """Replace this ledger's peer rows with a :meth:`to_dict`
+        snapshot.  Thresholds stay this ledger's own (they come from
+        the live policy config, not the snapshot); states are
+        re-classified against them from the snapshot's consecutive-
+        failure counts.  No ``on_transition`` callbacks fire — a
+        snapshot replay is old evidence, not a new observation."""
+        peers = d.get("peers")
+        if not isinstance(peers, list):
+            raise ValueError("health snapshot has no peers list")
+        rows = {}
+        for p in peers:
+            if not isinstance(p, dict) or "peer" not in p:
+                raise ValueError(f"malformed health snapshot row: {p!r}")
+            h = PeerHealth(
+                peer=str(p["peer"]),
+                consecutive_failures=int(p.get("consecutive_failures", 0)),
+                total_failures=int(p.get("total_failures", 0)),
+                total_successes=int(p.get("total_successes", 0)))
+            h.state = self._classify(h.consecutive_failures)
+            rows[h.peer] = h
+        with self._lock:
+            self._peers = rows
+
+    @staticmethod
+    def from_dict(d: dict, *, on_transition: Optional[
+            Callable[[str, str, str], None]] = None) -> "HealthLedger":
+        """Build a fresh ledger from a :meth:`to_dict` snapshot
+        (thresholds included)."""
+        led = HealthLedger(
+            suspect_after=int(d.get("suspect_after", 2)),
+            dead_after=int(d.get("dead_after", 4)),
+            on_transition=on_transition)
+        led.restore(d)
+        return led

@@ -59,6 +59,15 @@ SITES = (
     #                         drop = transient step failure (health
     #                         ledger counts it), fail = the replica dies
     #                         and its sessions drain + re-route
+    "elastic.member",       # one member liveness check per step
+    #                         boundary in the elastic gang driver
+    #                         (torchmpi_tpu/elastic.py): arrival
+    #                         ordinal = step * n_members + member
+    #                         index, so `fail` with after=k kills a
+    #                         SPECIFIC rank at a SPECIFIC step
+    #                         (chaos_tool gen --shrink computes k);
+    #                         drop = a missed heartbeat the health
+    #                         ledger escalates healthy->suspect->dead
 )
 
 KINDS = ("delay", "drop", "corrupt", "fail")
@@ -258,7 +267,8 @@ def lint_plan(plan: FaultPlan) -> List[str]:
         if rule.max_hits == 0:
             problems.append(f"rule {i}: max_hits=0 never fires")
         if rule.kind == "corrupt" and matched and all(
-                s in ("runtime.barrier",) for s in matched):
+                s in ("runtime.barrier", "serving.replica",
+                      "elastic.member") for s in matched):
             problems.append(
                 f"rule {i}: corrupt at {matched} has no payload to flip "
                 f"(raises CorruptPayload without mutating anything)")

@@ -1,0 +1,418 @@
+"""Gang-membership epochs: a host-staged board + bounded two-phase
+reconcile (docs/ELASTIC.md).
+
+The reference could tear a communicator down and re-form it (PAPER.md:
+communicators are disposable); the modern gang needs the agreement half
+of that — after a peer dies, the survivors must all switch to the SAME
+new member set at the SAME point, and a healed peer must be able to find
+the current set without asking the (possibly re-forming) gang.  Both go
+through a **membership board**: a directory of small JSON files on the
+shared checkpoint filesystem, the one transport that is still there
+when the device fabric's gang is exactly what broke.  Every value is
+staged through the host and an atomic rename — the same host-staged,
+fsync-friendly discipline as ``utils/checkpoint.py`` — so a reconcile
+survives the crash of any participant at any point.
+
+Protocol (``reconcile``): a **bounded two-phase commit** per epoch.
+
+- *Phase 1 — propose.*  Every survivor writes
+  ``propose_<epoch>_<rank>.json`` naming the member set it believes in
+  and the step boundary the view takes effect at.  A survivor then
+  polls until every proposed member's proposal is present and equal.
+- *Phase 2 — commit.*  Once the proposals agree, each survivor writes
+  ``commit_<epoch>_<rank>.json``; the view is **committed** when every
+  member of the proposal has committed.  A healed peer (or a late
+  reader) recognizes the current view as the highest fully-committed
+  epoch — commit files are never removed, so the read is race-free.
+- *Bounded.*  A member that posts neither file within the deadline is
+  itself declared dead: it is dropped from the set and the round
+  retries at ``epoch + 1`` with the smaller membership.  Disagreeing
+  proposals (two survivors observed different deaths concurrently)
+  resolve the same way — the next round proposes the INTERSECTION of
+  what was proposed, which all parties compute identically from the
+  same files.  At most ``len(members)`` rounds can run before the set
+  is a singleton, so the protocol terminates.
+
+Dependency-free on purpose (no jax, no numpy): the board must be
+readable by a peer whose runtime is exactly what died, and by
+standalone tooling.  Only ever imported when ``Config.elastic`` is on
+(via ``torchmpi_tpu.elastic``) — the off path never touches it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+
+class MembershipError(RuntimeError):
+    """Base of the membership-protocol failures."""
+
+
+class ReconcileDropped(MembershipError):
+    """This rank was voted out of the membership during a reconcile (it
+    stalled past the deadline and the survivors moved on without it).
+    The correct response is the healed-peer path: finish dying, then
+    :func:`torchmpi_tpu.elastic.admit` back in at a step boundary."""
+
+
+class ReconcileTimeout(MembershipError):
+    """A bounded wait on the board expired without the protocol making
+    progress (e.g. every other participant vanished mid-round)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class MembershipView:
+    """One committed gang membership: ``epoch`` (monotonic view
+    counter), ``members`` (sorted rank tuple), ``step`` (the step
+    boundary the view took effect at — a healed peer restores the
+    checkpoint of exactly this step)."""
+
+    epoch: int
+    members: Tuple[int, ...]
+    step: int
+
+    def to_json(self) -> dict:
+        return {"epoch": int(self.epoch),
+                "members": [int(m) for m in self.members],
+                "step": int(self.step)}
+
+    @staticmethod
+    def from_json(d: dict) -> "MembershipView":
+        return MembershipView(epoch=int(d["epoch"]),
+                              members=tuple(sorted(int(m)
+                                                   for m in d["members"])),
+                              step=int(d["step"]))
+
+
+class Board:
+    """The host-staged membership board: one directory of atomic JSON
+    files.  All methods are crash-safe (write-tmp-then-rename) and
+    idempotent; readers tolerate torn/missing files by ignoring them
+    (an unreadable proposal is the same as an unposted one — the
+    deadline handles both)."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+
+    # -- low-level staged IO ---------------------------------------------
+
+    def _write(self, name: str, payload: dict) -> None:
+        path = os.path.join(self.directory, name)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f, sort_keys=True)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def _read(self, name: str) -> Optional[dict]:
+        try:
+            with open(os.path.join(self.directory, name)) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def _ls(self, prefix: str) -> List[str]:
+        try:
+            return sorted(n for n in os.listdir(self.directory)
+                          if n.startswith(prefix) and n.endswith(".json"))
+        except OSError:
+            return []
+
+    # -- heartbeats (the real-detection seam) ------------------------------
+
+    def heartbeat(self, rank: int, *, epoch: int, step: int) -> None:
+        """Record liveness: ``(epoch, step, wall ts)``.  A monitor (or a
+        fellow member) that sees a heartbeat stop advancing has the
+        same staleness signal ``examples/downpour_elastic.py``'s
+        monitor thread reads from its progress counters."""
+        self._write(f"hb_{int(rank)}.json",
+                    {"rank": int(rank), "epoch": int(epoch),
+                     "step": int(step), "ts": time.time()})
+
+    def heartbeats(self) -> Dict[int, dict]:
+        out: Dict[int, dict] = {}
+        for name in self._ls("hb_"):
+            d = self._read(name)
+            if d is not None:
+                out[int(d.get("rank", -1))] = d
+        return out
+
+    # -- join requests (healed peers) --------------------------------------
+
+    def request_join(self, rank: int) -> None:
+        self._write(f"join_{int(rank)}.json",
+                    {"rank": int(rank), "ts": time.time()})
+
+    def join_requests(self) -> List[int]:
+        out = []
+        for name in self._ls("join_"):
+            d = self._read(name)
+            if d is not None:
+                out.append(int(d["rank"]))
+        return sorted(out)
+
+    def clear_join(self, rank: int) -> None:
+        try:
+            os.remove(os.path.join(self.directory,
+                                   f"join_{int(rank)}.json"))
+        except OSError:
+            pass
+
+    # -- two-phase state ---------------------------------------------------
+    #
+    # Payloads carry ``voters`` — the ranks whose agreement commits the
+    # view — separately from ``members``: at a shrink they are the same
+    # set (the survivors), but at an admission the deciding voters are
+    # the PRE-grow members, so a healed joiner appears in ``members``
+    # without having to vote in the reconcile that admits it.
+
+    def _vote(self, phase: str, epoch: int, rank: int,
+              members: Sequence[int], voters: Sequence[int],
+              step: int) -> None:
+        self._write(f"{phase}_{int(epoch)}_{int(rank)}.json",
+                    {"epoch": int(epoch),
+                     "members": sorted(int(m) for m in members),
+                     "voters": sorted(int(v) for v in voters),
+                     "step": int(step)})
+
+    def propose(self, epoch: int, rank: int, members: Sequence[int],
+                step: int, voters: Optional[Sequence[int]] = None) -> None:
+        self._vote("propose", epoch, rank, members,
+                   members if voters is None else voters, step)
+
+    def commit(self, epoch: int, rank: int, members: Sequence[int],
+               step: int, voters: Optional[Sequence[int]] = None) -> None:
+        self._vote("commit", epoch, rank, members,
+                   members if voters is None else voters, step)
+
+    def _votes(self, phase: str, epoch: int) -> Dict[int, dict]:
+        out: Dict[int, dict] = {}
+        for name in self._ls(f"{phase}_{int(epoch)}_"):
+            d = self._read(name)
+            if d is None or "members" not in d:
+                continue
+            rank = int(name[:-len(".json")].split("_")[-1])
+            out[rank] = d
+        return out
+
+    def proposals(self, epoch: int) -> Dict[int, dict]:
+        return self._votes("propose", epoch)
+
+    def commits(self, epoch: int) -> Dict[int, dict]:
+        return self._votes("commit", epoch)
+
+    def committed_view(self) -> Optional[MembershipView]:
+        """The highest fully-committed view: every VOTER named in a
+        commit payload has itself committed an equal payload for that
+        epoch.  None before the first reconcile completes."""
+        epochs = set()
+        for name in self._ls("commit_"):
+            try:
+                epochs.add(int(name.split("_")[1]))
+            except (IndexError, ValueError):
+                continue
+        for e in sorted(epochs, reverse=True):
+            commits = self.commits(e)
+            for d in commits.values():
+                voters = [int(v) for v in d.get("voters", d["members"])]
+                if voters and all(
+                        v in commits and _payload_key(commits[v])
+                        == _payload_key(d) for v in voters):
+                    return MembershipView.from_json(d)
+        return None
+
+    # -- generic bounded min-agreement (recovery-step votes) ---------------
+
+    def post_value(self, tag: str, rank: int, value: int) -> None:
+        self._write(f"agree_{tag}_{int(rank)}.json",
+                    {"rank": int(rank), "value": int(value)})
+
+    def clear_values(self, rank: int) -> None:
+        """Drop every agreement value THIS rank ever posted — called at
+        gang construction so a full-gang crash-restart reusing the same
+        board cannot hand a peer this rank's previous life's value
+        under a re-used tag."""
+        suffix = f"_{int(rank)}.json"
+        for name in self._ls("agree_"):
+            if name.endswith(suffix):
+                try:
+                    os.remove(os.path.join(self.directory, name))
+                except OSError:
+                    pass
+
+    def clear_votes_above(self, rank: int, epoch: int) -> None:
+        """Drop THIS rank's propose/commit files ABOVE ``epoch`` — a
+        previous incarnation's aborted reconcile rounds must not poison
+        the next reconcile at the same epochs (committed history at or
+        below ``epoch`` stays: ``committed_view`` reads it)."""
+        suffix = f"_{int(rank)}.json"
+        for phase in ("propose_", "commit_"):
+            for name in self._ls(phase):
+                if not name.endswith(suffix):
+                    continue
+                try:
+                    e = int(name.split("_")[1])
+                except (IndexError, ValueError):
+                    continue
+                if e > int(epoch):
+                    try:
+                        os.remove(os.path.join(self.directory, name))
+                    except OSError:
+                        pass
+
+    def values(self, tag: str) -> Dict[int, int]:
+        out: Dict[int, int] = {}
+        for name in self._ls(f"agree_{tag}_"):
+            d = self._read(name)
+            if d is not None:
+                out[int(d["rank"])] = int(d["value"])
+        return out
+
+
+def _payload_key(d: dict) -> Tuple:
+    return (tuple(sorted(int(m) for m in d["members"])),
+            tuple(sorted(int(v) for v in d.get("voters", d["members"]))),
+            int(d.get("step", 0)))
+
+
+def reconcile(board: Board, local_ranks: Iterable[int],
+              members: Iterable[int], *, epoch: int, step: int,
+              voters: Optional[Iterable[int]] = None,
+              deadline_s: float = 30.0, poll_s: float = 0.05,
+              ) -> MembershipView:
+    """Run the bounded two-phase reconcile for ``local_ranks`` (the
+    ranks THIS process speaks for — its own rank in a multi-process
+    gang; every simulated member on the single-process CPU sim) until a
+    view commits, and return it.
+
+    ``members`` is the set this process proposes (survivors after a
+    death; survivors plus the joiner at an admission); ``voters`` the
+    subset whose agreement commits it (defaults to ``members``; at an
+    admission it is the PRE-grow members, so the healed joiner need not
+    vote in the reconcile that admits it); ``epoch`` the epoch to
+    propose at (one above the current view).  See the module docstring
+    for the drop/intersect retry semantics.  Raises
+    :class:`ReconcileDropped` if every local rank was voted out, and
+    :class:`ReconcileTimeout` if the voter set would shrink to empty.
+    """
+    members = sorted(set(int(m) for m in members))
+    voters = (sorted(set(int(v) for v in voters))
+              if voters is not None else list(members))
+    if not set(voters) <= set(members):
+        raise ValueError(
+            f"voters {voters} must be a subset of members {members}")
+    local = sorted(set(int(r) for r in local_ranks))
+    e = int(epoch)
+    step = int(step)
+    while True:
+        if not voters:
+            raise ReconcileTimeout(
+                "reconcile ran out of voters — every participant "
+                "stalled past the deadline")
+        speak = [r for r in local if r in voters]
+        if not speak:
+            raise ReconcileDropped(
+                f"ranks {local} were dropped from the membership "
+                f"(survivors moved on to {members} at epoch {e})")
+
+        def _phase(read) -> Tuple[List[int], List[int], int, bool]:
+            """Poll one phase until every voter's payload is present
+            and equal; returns ``(members, voters, step, settled)``.
+            Not settled means EVERY participant retries one epoch up
+            with the returned resolution — even one whose own payload
+            already matched it (committing while others move up would
+            fork the view): stalled voters are dropped past the
+            deadline; concurrently-differing proposals resolve to the
+            member/voter INTERSECTION and the MIN step — all computed
+            identically by every party from the same files, and the
+            min step is the safe one: every proposer can restore a
+            checkpoint at or before its own proposed boundary."""
+            t0 = time.monotonic()
+            while True:
+                got = read(e)
+                if all(v in got for v in voters):
+                    keys = {_payload_key(got[v]) for v in voters}
+                    if len(keys) == 1:
+                        return members, voters, step, True
+                    inter = set(members)
+                    for mset, _, _ in keys:
+                        inter &= set(mset)
+                    vinter = set(voters)
+                    for _, vset, _ in keys:
+                        vinter &= set(vset)
+                    return (sorted(inter),
+                            sorted(v for v in vinter if v in inter),
+                            min(s for _, _, s in keys), False)
+                if time.monotonic() - t0 > deadline_s:
+                    alive = [v for v in voters if v in got]
+                    return ([m for m in members
+                             if m in alive or m not in voters], alive,
+                            step, False)
+                time.sleep(poll_s)
+
+        for r in speak:
+            board.propose(e, r, members, step, voters)
+        members, voters, step, settled = _phase(board.proposals)
+        if not settled:
+            e += 1
+            continue
+        for r in speak:
+            board.commit(e, r, members, step, voters)
+        members, voters, step, settled = _phase(board.commits)
+        if not settled:
+            e += 1
+            continue
+        return MembershipView(epoch=e, members=tuple(members),
+                              step=int(step))
+
+
+def agree_min(board: Board, tag: str, local_ranks: Iterable[int],
+              members: Iterable[int], value: int, *,
+              deadline_s: float = 30.0, poll_s: float = 0.05) -> int:
+    """Bounded cross-member MIN of an int over the board — the
+    survivors-only analog of ``checkpoint.agree_min_step`` (which runs
+    over the full gang and therefore hangs forever once a member is
+    dead).  ``tag`` must be unique per agreement round (the elastic
+    driver derives it from (epoch, round))."""
+    members = sorted(set(int(m) for m in members))
+    for r in set(int(r) for r in local_ranks):
+        if r in members:
+            board.post_value(tag, r, value)
+    t0 = time.monotonic()
+    while True:
+        got = board.values(tag)
+        if all(m in got for m in members):
+            return min(got[m] for m in members)
+        if time.monotonic() - t0 > deadline_s:
+            missing = [m for m in members if m not in got]
+            raise ReconcileTimeout(
+                f"agreement {tag!r}: members {missing} posted no value "
+                f"within {deadline_s:.3g}s")
+        time.sleep(poll_s)
+
+
+def wait_for_view(board: Board, *, containing: Optional[int] = None,
+                  min_epoch: int = 0, deadline_s: float = 30.0,
+                  poll_s: float = 0.05) -> MembershipView:
+    """Poll the board for a committed view (optionally one containing
+    rank ``containing`` at epoch >= ``min_epoch``) — the healed peer's
+    half of :func:`torchmpi_tpu.elastic.admit`."""
+    t0 = time.monotonic()
+    while True:
+        view = board.committed_view()
+        if view is not None and view.epoch >= min_epoch and (
+                containing is None or containing in view.members):
+            return view
+        if time.monotonic() - t0 > deadline_s:
+            want = ("" if containing is None
+                    else f" containing rank {containing}")
+            raise ReconcileTimeout(
+                f"no committed view{want} appeared within "
+                f"{deadline_s:.3g}s")
+        time.sleep(poll_s)

@@ -481,7 +481,8 @@ def record_serving(event: str, n: int = 1, *, replica: str = "") -> None:
     """One serving-layer counter event (docs/SERVING.md): ``event`` is
     ``requests`` (admitted) | ``completed`` | ``tokens`` (emitted) |
     ``rerouted`` (sessions moved off a dead replica) | ``rejected``
-    (unservable request refused at admission) — counter
+    (unservable request refused at admission) | ``readmitted`` (a
+    healed replica returned to the dispatch rotation) — counter
     ``tm_serving_<event>_total`` labeled by replica.  Re-routes also
     land in the flight ring, so a post-mortem sees the replica death
     next to the collectives (or faults) that preceded it."""
@@ -521,3 +522,21 @@ def record_restart(event: str, step: int) -> None:
     routed through the restore path)."""
     _registry.counter_inc("tm_restart_events_total", event=event)
     _recorder.append("restart", event, int(step))
+
+
+def record_elastic(event: str, *, epoch: int = 0, members: int = 0,
+                   peer: str = "") -> None:
+    """One elastic gang-resize event (``torchmpi_tpu/elastic.py`` —
+    docs/ELASTIC.md): ``event`` is ``reconcile`` (a membership view
+    committed) | ``shrink`` (the gang re-formed without a dead member)
+    | ``rejoin`` (a healed member re-admitted at a step boundary) —
+    counter ``tm_elastic_<event>_total``, labeled with the implicated
+    member(s) when there are any.  Every event also lands in the
+    flight ring, so a post-mortem sees the resize right next to the
+    last collectives of the old gang."""
+    labels = {}
+    if peer:
+        labels["peer"] = peer
+    _registry.counter_inc(f"tm_elastic_{event}_total", **labels)
+    _recorder.append("elastic", event, int(members), "",
+                     f"epoch {int(epoch)}")

@@ -183,6 +183,18 @@ def _normalize_dcn_compress(value) -> Optional[str]:
     return None
 
 
+def _normalize_elastic(value) -> Optional[str]:
+    """Canonical elastic mode for a config/env value: "off"|"on", with
+    boolean-ish spellings accepted.  None = unrecognized (the caller
+    raises)."""
+    v = str(value).strip().lower()
+    if v in ("off", "0", "false", "no", "none", ""):
+        return "off"
+    if v in ("on", "1", "true", "yes"):
+        return "on"
+    return None
+
+
 def _normalize_faults(value) -> str:
     """Canonical faults mode for a config/env value: "off", "policy",
     or a fault-plan path (kept verbatim).  Boolean-ish spellings map to
@@ -330,6 +342,29 @@ def init(config: Optional[Config] = None, **overrides) -> Mesh:
                             "TORCHMPI_TPU_FAULT_DEADLINE", float)
         _env_default_pickup(cfg, "ps_timeout_s",
                             "TORCHMPI_TPU_PS_TIMEOUT", float)
+        # Elastic gang membership (docs/ELASTIC.md): same any-config env
+        # pickup + one-home normalization.  "on" arms NOTHING here —
+        # torchmpi_tpu.elastic is a driver layer the user calls
+        # explicitly, and the knob is its consent gate; "off" (default)
+        # never imports the module and the dispatch path has no branch
+        # on it at all.
+        if _normalize_elastic(cfg.elastic) == "off":
+            cfg.elastic = os.environ.get("TORCHMPI_TPU_ELASTIC", "off")
+        cfg.elastic = _normalize_elastic(cfg.elastic)
+        if cfg.elastic is None:
+            raise ValueError(
+                "config.elastic (or TORCHMPI_TPU_ELASTIC) must be off|on")
+        if cfg.elastic_dir is None:
+            cfg.elastic_dir = (
+                os.environ.get("TORCHMPI_TPU_ELASTIC_DIR") or None)
+        _env_default_pickup(cfg, "elastic_poll_s",
+                            "TORCHMPI_TPU_ELASTIC_POLL", float)
+        _env_default_pickup(cfg, "elastic_deadline_s",
+                            "TORCHMPI_TPU_ELASTIC_DEADLINE", float)
+        if cfg.elastic_poll_s <= 0 or cfg.elastic_deadline_s <= 0:
+            raise ValueError(
+                f"config.elastic_poll_s and elastic_deadline_s must be "
+                f"> 0, got {cfg.elastic_poll_s}/{cfg.elastic_deadline_s}")
         # Serving-layer sizing (docs/SERVING.md): same any-config env
         # pickup; the knobs are plain ints, the package itself is only
         # ever imported by explicit use.
@@ -600,6 +635,14 @@ def set_config(**kw) -> None:
                 raise ValueError("config.obs must be off|metrics|trace")
         if k == "faults":
             v = _normalize_faults(v)
+        if k == "elastic":
+            v = _normalize_elastic(v)
+            if v is None:
+                raise ValueError("config.elastic must be off|on")
+        if k in ("elastic_poll_s", "elastic_deadline_s"):
+            v = float(v)
+            if v <= 0:
+                raise ValueError(f"config.{k} must be > 0")
         if k == "gradsync_overlap":
             v = _normalize_overlap(v)
             if v is None:
@@ -766,6 +809,49 @@ def current_mesh() -> Mesh:
 def current_mesh_name() -> str:
     _require_init()
     return _state.mesh_stack[-1][0]
+
+
+def resize_world(devices: Sequence[jax.Device], *,
+                 shape: Optional[Dict[str, int]] = None) -> Mesh:
+    """Re-form the world mesh over a device subset — the gang-resize
+    primitive ``torchmpi_tpu.elastic`` shrinks/grows through
+    (docs/ELASTIC.md; the reference analog is tearing down and
+    re-creating the communicator tree, PAPER.md: communicators are
+    disposable).
+
+    ``shape`` is an ordered axis-name -> size dict over exactly
+    ``devices`` (the :func:`push_communicator` convention); ``None``
+    builds a 1-D ``(ici,)`` mesh.  Replaces the whole communicator
+    stack (pushed communicators are views of the OLD gang — they do
+    not survive a membership change) and bumps the config epoch, so
+    every cached :class:`~torchmpi_tpu.planner.CollectivePlan` built
+    against the old mesh is stranded; ``planner.invalidate()`` then
+    releases the stale plans' memory.  The active Config is untouched.
+    """
+    _require_init()
+    devs = list(devices)
+    if not devs:
+        raise ValueError("resize_world needs at least one device")
+    with _state.lock:
+        if shape is None:
+            mesh = Mesh(np.asarray(devs), (ICI_AXIS,))
+        else:
+            axes = tuple(shape.keys())
+            sizes = tuple(shape.values())
+            if int(np.prod(sizes)) != len(devs):
+                raise ValueError(
+                    f"shape {shape} does not cover {len(devs)} devices")
+            mesh = Mesh(np.asarray(devs).reshape(sizes), axes)
+        _state.devices = devs
+        _state.mesh_stack = [("world", mesh)]
+        _state.mesh_cache = {"world": mesh}
+        _state.config_epoch += 1
+    from . import collectives
+
+    # Routes to planner.invalidate(): drops every plan + cached
+    # sharding + legacy executable pinned to the old gang's meshes.
+    collectives.clear_cache()
+    return mesh
 
 
 def push_communicator(

@@ -15,6 +15,13 @@ healthy replica has a free slot (shed optional load onto suspects,
 never prefer them); ``raise`` (dead) replicas admit nothing and —
 handled by the scheduler — drain their in-flight sessions for
 re-routing instead of crashing the server.
+
+Recovery feeds back the same way (docs/ELASTIC.md's rejoin, replica
+edition): a drained replica whose ledger returns to ``healthy`` — a
+probe or a shared-ledger success for the same peer recorded through
+:meth:`Router.record` — is re-admitted into the dispatch rotation
+(:meth:`Router.readmit`); its slot pool was drained, so it comes back
+empty and simply starts taking new admissions.
 """
 
 from __future__ import annotations
@@ -57,9 +64,31 @@ class Router:
     # -- health ------------------------------------------------------------
 
     def record(self, replica: ReplicaEngine, ok: bool) -> str:
-        """Fold one step outcome; returns the decide() verdict."""
+        """Fold one step outcome; returns the decide() verdict.  A
+        success that brings a DRAINED replica's ledger back to
+        ``healthy`` (one success fully resets — the HealthLedger
+        contract) re-admits it into the rotation."""
         self._ledger.record(replica.name, ok)
+        if ok and replica.dead and \
+                self._ledger.state(replica.name) == "healthy":
+            self.readmit(replica)
         return self.decide(replica)
+
+    def readmit(self, replica: ReplicaEngine) -> None:
+        """Return a healed (previously drained) replica to the
+        dispatch rotation: clears its dead flag so ``pick()`` can
+        select it again.  Its sessions were re-routed at the drain, so
+        it rejoins empty; callers that cannot trust the old process
+        should rebuild the engine instead."""
+        if not replica.dead:
+            return
+        replica.dead = False
+        mod = sys.modules.get("torchmpi_tpu.obs")
+        try:
+            if mod is not None and mod.active():
+                mod.record_serving("readmitted", replica=replica.name)
+        except Exception:  # noqa: BLE001 — telemetry never fails this
+            pass
 
     def decide(self, replica: ReplicaEngine) -> str:
         if replica.dead:

@@ -24,6 +24,7 @@ off-mode import discipline).
 
 from __future__ import annotations
 
+import json
 import os
 import sys
 from typing import Any, Callable, Dict, Optional, Tuple
@@ -33,6 +34,46 @@ import jax
 from . import checkpoint
 
 PyTree = Any
+
+
+def _health_path(directory: str) -> str:
+    return os.path.join(directory,
+                        f"health_p{jax.process_index()}.json")
+
+
+def _save_health(directory: str) -> None:
+    """Snapshot the armed fault layer's per-peer health ledger next to
+    the checkpoints (sys.modules lookup — recovery never imports the
+    fault layer), so peer health survives a process-level restart
+    instead of resetting every peer to ``healthy`` and re-burning the
+    suspect->dead escalation on a peer that was already dead.
+    Best-effort: telemetry-grade state must never fail a save."""
+    mod = sys.modules.get("torchmpi_tpu.faults")
+    if mod is None or not mod.active():
+        return
+    try:
+        path = _health_path(directory)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(mod.ledger().to_dict(), f, sort_keys=True)
+        os.replace(tmp, path)
+    except Exception:  # noqa: BLE001 — evidence, not correctness
+        pass
+
+
+def _load_health(directory: str) -> None:
+    """Rehydrate the armed ledger from the last :func:`_save_health`
+    snapshot, if one exists (the restore half of the same seam)."""
+    mod = sys.modules.get("torchmpi_tpu.faults")
+    if mod is None or not mod.active():
+        return
+    try:
+        path = _health_path(directory)
+        if os.path.exists(path):
+            with open(path) as f:
+                mod.ledger().restore(json.load(f))
+    except Exception:  # noqa: BLE001 — a torn snapshot is just absent
+        pass
 
 
 def _is_peer_timeout(e: BaseException) -> bool:
@@ -116,6 +157,79 @@ def attach_ef_residuals(state: Dict[str, Any], *,
     return out
 
 
+def recover(init_fn: Callable[[], PyTree], directory: str,
+            template: PyTree, *, participants: Optional[int] = None,
+            agree: Optional[Callable[[int], int]] = None
+            ) -> Tuple[PyTree, int]:
+    """Restore the newest checkpoint all participants can agree on.
+
+    Single-participant: the newest locally-restorable step, walking
+    backwards past unreadable ones (atomic saves make those rare, but
+    an older good step must win over a bad newer file — never a hard
+    stop).  The settled-on step is fsync-verified and logged (via obs
+    when active) so post-mortems can see WHICH step a recovery
+    resumed from, not just that one happened.
+
+    Multi-host (the gang-scheduled restart path): a crash between
+    per-process ``save()`` calls can land step N on some hosts only,
+    and replicas silently resuming from different steps diverge and
+    desync collectives.  So the hosts run an agreement loop in which
+    EVERY branch decision is a function of globally-agreed values — no
+    host can raise, restore, or fall back alone: propose the newest
+    local step under the ceiling, agree on the minimum, all try to
+    restore exactly that step, agree on a success flag; any failure
+    anywhere lowers the ceiling for everyone and the loop retries,
+    degrading to a collective fresh start when no common restorable
+    step exists.  Requires all participants to call :func:`recover`
+    together; a failure on only a subset is not survivable by any
+    in-band protocol.
+
+    ``participants`` defaults to ``jax.process_count()`` and ``agree``
+    to the full-gang :func:`checkpoint.agree_min_step`; the elastic
+    driver (``torchmpi_tpu/elastic.py``) passes the surviving process
+    count and a survivors-only board agreement instead — the full-gang
+    collective would hang forever on the member whose death is exactly
+    what recovery is recovering from.  Returns ``(state, next_step)``.
+    """
+    if participants is None:
+        participants = jax.process_count()
+    if agree is None:
+        agree = checkpoint.agree_min_step
+
+    def settled(state, step):
+        if step > 0:
+            _fsync_verify(directory, step)
+        _obs_record("recovered" if step > 0 else "fresh_start", step)
+        return state, step
+
+    steps_avail = [s for s in checkpoint.available_steps(directory)
+                   if s > 0]
+    if participants <= 1:
+        for step in reversed(steps_avail):
+            try:
+                return settled(checkpoint.restore(directory, template,
+                                                  step=step), step)
+            except Exception:  # noqa: BLE001 — fall back to older
+                continue
+        return settled(init_fn(), 0)
+    ceiling = None
+    while True:
+        cand = next((s for s in reversed(steps_avail)
+                     if ceiling is None or s <= ceiling), 0)
+        agreed = agree(cand)
+        if agreed <= 0:
+            return settled(init_fn(), 0)  # collectively: nothing common
+        state, ok = None, 1
+        try:
+            state = checkpoint.restore(directory, template,
+                                       step=agreed)
+        except Exception:  # noqa: BLE001 — resolved collectively
+            ok = 0
+        if agree(ok):
+            return settled(state, agreed)
+        ceiling = agreed - 1  # someone failed: walk back TOGETHER
+
+
 def run_with_restarts(
     init_fn: Callable[[], PyTree],
     step_fn: Callable[[PyTree, int], PyTree],
@@ -156,67 +270,9 @@ def run_with_restarts(
     if steps < 0:
         raise ValueError(f"steps must be >= 0, got {steps}")
     template = init_fn()
+    _load_health(directory)
 
-    def recover():
-        """Restore the newest checkpoint all processes can agree on.
-
-        Single-process: the newest locally-restorable step, walking
-        backwards past unreadable ones (atomic saves make those rare, but
-        an older good step must win over a bad newer file — never a hard
-        stop).  The settled-on step is fsync-verified and logged (via obs
-        when active) so post-mortems can see WHICH step a recovery
-        resumed from, not just that one happened.
-
-        Multi-host (the gang-scheduled restart path): a crash between
-        per-process ``save()`` calls can land step N on some hosts only,
-        and replicas silently resuming from different steps diverge and
-        desync collectives.  So the hosts run an agreement loop in which
-        EVERY branch decision is a function of globally-allgathered
-        values — no host can raise, restore, or fall back alone:
-        propose the newest local step under the ceiling, agree on the
-        minimum, all try to restore exactly that step, allgather a
-        success flag; any failure anywhere lowers the ceiling for
-        everyone and the loop retries, degrading to a collective fresh
-        start when no common restorable step exists.  Requires all
-        processes to call ``recover()`` together — the gang-failure model
-        this module documents (an SPMD failure fails the slice as a
-        unit); a failure on only a subset of hosts is not survivable by
-        any in-band protocol.  Returns (state, next_step)."""
-
-        def settled(state, step):
-            if step > 0:
-                _fsync_verify(directory, step)
-            _obs_record("recovered" if step > 0 else "fresh_start", step)
-            return state, step
-
-        steps_avail = [s for s in checkpoint.available_steps(directory)
-                       if s > 0]
-        if jax.process_count() <= 1:
-            for step in reversed(steps_avail):
-                try:
-                    return settled(checkpoint.restore(directory, template,
-                                                      step=step), step)
-                except Exception:  # noqa: BLE001 — fall back to older
-                    continue
-            return settled(init_fn(), 0)
-        ceiling = None
-        while True:
-            cand = next((s for s in reversed(steps_avail)
-                         if ceiling is None or s <= ceiling), 0)
-            agreed = checkpoint.agree_min_step(cand)
-            if agreed <= 0:
-                return settled(init_fn(), 0)  # collectively: nothing common
-            state, ok = None, 1
-            try:
-                state = checkpoint.restore(directory, template,
-                                           step=agreed)
-            except Exception:  # noqa: BLE001 — resolved collectively
-                ok = 0
-            if checkpoint.agree_min_step(ok):
-                return settled(state, agreed)
-            ceiling = agreed - 1  # someone failed: walk back TOGETHER
-
-    state, i = recover()
+    state, i = recover(init_fn, directory, template)
     recovered_step = i
     restarts = 0
     steps_run = 0
@@ -227,11 +283,16 @@ def run_with_restarts(
             i += 1
             if i % save_every == 0 or i == steps:
                 checkpoint.save(directory, state, step=i)
+                _save_health(directory)
         except KeyboardInterrupt:
             raise
         except BaseException as e:  # noqa: BLE001 — the restart loop IS
             # the handler: restore-and-replay or re-raise after budget.
             restarts += 1
+            # The failure itself is health evidence (the ledger just
+            # counted it) — snapshot BEFORE recovery so a process-level
+            # restart sees the peer's streak, not a clean slate.
+            _save_health(directory)
             if _is_peer_timeout(e):
                 # Detected-dead peer: checkpoint-restore instead of a
                 # watchdog kill.  Consumes restart budget like any other
@@ -243,7 +304,7 @@ def run_with_restarts(
                 on_restart(restarts, e)
             if restarts > max_restarts:
                 raise
-            state, i = recover()
+            state, i = recover(init_fn, directory, template)
             recovered_step = i
     return state, {"restarts": restarts, "restarts_used": restarts,
                    "steps_run": steps_run,
