@@ -176,6 +176,77 @@ def _faults_compare_mode(args, mpi, n):
           file=sys.stderr)
 
 
+def _guard_compare_mode(args, mpi, n):
+    """Dispatch overhead of the guard layer (docs/GUARD.md), in two
+    halves.  **wire**: the same small STAGED allreduce (the surface
+    that carries the digest compute + verify) timed under
+    guard=off/wire.  **numeric**: a jitted in-axis gradient sync timed
+    under guard=off/numeric — the fused sum-of-squares tripwire is
+    in-graph, so this measures the compiled-step cost, not Python
+    dispatch.  Acceptance: overhead recorded on the CPU sim, expected
+    small; documented either way (the GUARD-SUMMARY line is what the
+    guard-smoke CI job archives)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from torchmpi_tpu.parallel import gradsync
+    from torchmpi_tpu.utils import metrics as umetrics
+
+    x = np.random.RandomState(0).rand(n, 1024).astype(np.float32)
+    summary = {}
+    for mode in ("off", "wire"):
+        mpi.set_config(guard=mode)
+        mpi.allreduce(x, backend="host")  # warm the placement path
+        r = umetrics.timed(lambda: mpi.allreduce(x, backend="host"),
+                           iters=args.iters, rounds=5)
+        summary[f"wire_{mode}_us"] = round(r.median * 1e6, 2)
+        summary[f"wire_{mode}_jitter_us"] = round(r.jitter * 1e6, 2)
+        line = {"half": "wire", "mode": mode,
+                "us_per_dispatch": summary[f"wire_{mode}_us"],
+                "jitter_us": summary[f"wire_{mode}_jitter_us"]}
+        print(json.dumps(line) if args.json else
+              f"guard={mode:8s} staged {r.median * 1e6:9.2f} us/dispatch "
+              f"(jitter {r.jitter * 1e6:.2f} us)")
+    mesh = mpi.current_mesh()
+    axes = mesh.axis_names
+    grads = {"a": jnp.ones((256, 64), jnp.float32),
+             "b": jnp.ones((1024,), jnp.float32)}
+    for mode in ("off", "numeric"):
+        mpi.set_config(guard=mode)
+        sync = jax.jit(shard_map(
+            lambda g: gradsync.synchronize_gradients(g, axes),
+            mesh=mesh, in_specs=(P(),), out_specs=P(), check_vma=False))
+        jax.block_until_ready(sync(grads))  # compile
+        r = umetrics.timed(
+            lambda: jax.block_until_ready(sync(grads)),
+            iters=args.iters, rounds=5)
+        summary[f"numeric_{mode}_us"] = round(r.median * 1e6, 2)
+        summary[f"numeric_{mode}_jitter_us"] = round(r.jitter * 1e6, 2)
+        line = {"half": "numeric", "mode": mode,
+                "us_per_step": summary[f"numeric_{mode}_us"],
+                "jitter_us": summary[f"numeric_{mode}_jitter_us"]}
+        print(json.dumps(line) if args.json else
+              f"guard={mode:8s} gradsync {r.median * 1e6:9.2f} us/step "
+              f"(jitter {r.jitter * 1e6:.2f} us)")
+    mpi.set_config(guard="off")
+    for half in ("wire", "numeric"):
+        on = "wire" if half == "wire" else "numeric"
+        delta = summary[f"{half}_{on}_us"] - summary[f"{half}_off_us"]
+        floor = (summary[f"{half}_off_jitter_us"]
+                 + summary[f"{half}_{on}_jitter_us"])
+        summary[f"{half}_delta_us"] = round(delta, 2)
+        summary[f"{half}_verdict"] = ("WITHIN NOISE"
+                                      if abs(delta) <= floor
+                                      else "MEASURABLE")
+        print(f"# {half} {on}-vs-off delta {delta:+.2f} us "
+              f"(noise floor {floor:.2f} us): "
+              f"{summary[f'{half}_verdict']}", file=sys.stderr)
+    print("GUARD-SUMMARY " + json.dumps(summary))
+
+
 def _plan_compare_mode(args, mpi, n):
     """Dispatch overhead of the CollectivePlan replay path
     (docs/PLANNER.md acceptance): the same small eager allreduce timed
@@ -563,6 +634,12 @@ def main():
                    help="fault-layer overhead mode: the same small "
                         "staged allreduce under faults=off/policy "
                         "(docs/FAULTS.md)")
+    p.add_argument("--guard-compare", action="store_true",
+                   help="guard overhead mode: the same small staged "
+                        "allreduce under guard=off/wire (digest cost) "
+                        "and a jitted gradient sync under "
+                        "guard=off/numeric (fused tripwire cost) — "
+                        "docs/GUARD.md")
     p.add_argument("--plan-compare", action="store_true",
                    help="planner overhead mode: the same small eager "
                         "allreduce, planned vs pre-planner dispatch, "
@@ -630,6 +707,11 @@ def main():
 
     if args.faults_compare:
         _faults_compare_mode(args, mpi, n)
+        mpi.stop()
+        return
+
+    if args.guard_compare:
+        _guard_compare_mode(args, mpi, n)
         mpi.stop()
         return
 

@@ -22,6 +22,11 @@ def main():
     p.add_argument("--sizes", type=str, default="65536,1048576,16777216")
     p.add_argument("--shards", type=int, default=4)
     p.add_argument("--iters", type=int, default=20)
+    p.add_argument("--elastic", action="store_true",
+                   help="also run an EASGD elastic-rule workload (the "
+                        "response carries a full delta payload; its "
+                        "bytes are tracked separately so the apply "
+                        "ns/B denominator stays honest)")
     args = p.parse_args()
 
     from torchmpi_tpu.parallel.ps import ParameterServer
@@ -47,10 +52,20 @@ def main():
             for h in hs:
                 h.wait()
             pipe_dt = (time.time() - t0) / args.iters
-            print(f"{nbytes:>12d} B x{args.shards} shards  "
-                  f"send {nbytes/send_dt/1e9:6.2f} GB/s  "
-                  f"recv {nbytes/recv_dt/1e9:6.2f} GB/s  "
-                  f"pipelined-send {nbytes/pipe_dt/1e9:6.2f} GB/s")
+            line = (f"{nbytes:>12d} B x{args.shards} shards  "
+                    f"send {nbytes/send_dt/1e9:6.2f} GB/s  "
+                    f"recv {nbytes/recv_dt/1e9:6.2f} GB/s  "
+                    f"pipelined-send {nbytes/pipe_dt/1e9:6.2f} GB/s")
+            if args.elastic:
+                ps.send(payload, rule="elastic", alpha=0.5).wait()  # warm
+                t0 = time.time()
+                for _ in range(args.iters):
+                    ps.send(payload, rule="elastic", alpha=0.5).wait()
+                el_dt = (time.time() - t0) / args.iters
+                # The elastic exchange moves the payload BOTH ways
+                # (gradient in, delta out) — report the two-way rate.
+                line += f"  elastic {2*nbytes/el_dt/1e9:6.2f} GB/s"
+            print(line)
             # Server-loop cycle-cost decomposition (VERDICT r4 #8): the
             # measured split behind the loopback numbers — syscall
             # (recv+send) vs memcpy/rule-apply vs mutex contention.
@@ -67,16 +82,26 @@ def main():
                 # Bytes the apply bucket actually touched: send payloads
                 # in + receive payloads out (bytes_out minus the 1-byte
                 # status per op) — receives run their memcpy in `apply`
-                # too (code review r5).
-                apply_bytes = st["bytes_in"] + st["bytes_out"] - st["ops"]
-                print(f"{'':>12s}   server-loop decomposition over "
-                      f"{st['ops']} ops ({busy*1e3:.1f} ms busy): "
-                      f"recv {pct(st['recv_s'])}  "
-                      f"lock-wait {pct(st['lock_wait_s'])}  "
-                      f"apply {pct(st['apply_s'])}  "
-                      f"send {pct(st['send_s'])}  | "
-                      f"apply {st['apply_s']*1e9/max(1,apply_bytes):.2f}"
-                      f" ns/B")
+                # too (code review r5).  RULE_ELASTIC response payloads
+                # are EXCLUDED (ADVICE round 5): the delta reply is
+                # written into the same buffer the apply loop already
+                # touched once as input, so counting it again would
+                # inflate the ns/B denominator for elastic workloads —
+                # the server tracks them separately (elastic_bytes_out).
+                ebytes = st.get("elastic_bytes_out", 0)
+                apply_bytes = (st["bytes_in"] + st["bytes_out"]
+                               - st["ops"] - ebytes)
+                line = (f"{'':>12s}   server-loop decomposition over "
+                        f"{st['ops']} ops ({busy*1e3:.1f} ms busy): "
+                        f"recv {pct(st['recv_s'])}  "
+                        f"lock-wait {pct(st['lock_wait_s'])}  "
+                        f"apply {pct(st['apply_s'])}  "
+                        f"send {pct(st['send_s'])}  | "
+                        f"apply {st['apply_s']*1e9/max(1,apply_bytes):.2f}"
+                        f" ns/B")
+                if ebytes:
+                    line += f"  (elastic resp {ebytes} B excluded)"
+                print(line)
         finally:
             ps.shutdown()
 

@@ -121,10 +121,23 @@ struct Server {
   // that is idle time between ops, not op cost.  recv = payload read
   // (syscall share), lock_wait = shard-mutex acquisition (contention
   // share), apply = rule loop / memcpy under the mutex, send = response
-  // write.  Backs benchmarks/ps_bench.py's loopback breakdown and the
-  // ROUND3_NOTES scaling model with measured constants.
+  // write.  elastic_bytes_out tracks RULE_ELASTIC response payloads
+  // separately so consumers (ps_bench's apply ns/B denominator) can
+  // subtract bytes the apply loop never touched as extra work.  Backs
+  // benchmarks/ps_bench.py's loopback breakdown and the ROUND3_NOTES
+  // scaling model with measured constants.
+  //
+  // Snapshot consistency (ADVICE round 5): counters update in GROUPS
+  // under the existing shard mutex — the request-side group
+  // (recv/lock_wait/apply/bytes_in/ops) lands inside the same critical
+  // section as the rule apply, i.e. BEFORE the ok byte unblocks the
+  // client, so a stats() read taken after a completed wait() sees
+  // every finished op exactly; the response-side group
+  // (send/bytes_out) lands after the write under a second acquire.
+  // tm_ps_server_stats reads under the same mutex, so a snapshot can
+  // never tear mid-group (ops ticked but its bytes_in invisible).
   std::atomic<uint64_t> recv_ns{0}, lock_wait_ns{0}, apply_ns{0},
-      send_ns{0}, bytes_in{0}, bytes_out{0};
+      send_ns{0}, bytes_in{0}, bytes_out{0}, elastic_bytes_out{0};
 
   ~Server() { stop(); }
 
@@ -180,7 +193,7 @@ struct Server {
         uint64_t t0 = now_ns();
         if (!read_exact(fd, buf.data(), h.count * sizeof(float))) break;
         uint64_t t1 = now_ns();
-        uint64_t t2;
+        uint64_t t2, t3;
         {
           std::lock_guard<std::mutex> g(shard_mu);
           t2 = now_ns();
@@ -208,42 +221,54 @@ struct Server {
             default:
               break;
           }
+          t3 = now_ns();
+          // Request-side counter group, inside the SAME critical
+          // section as the apply: consistent under the stats mutex and
+          // visible BEFORE the ok byte unblocks the client.
+          recv_ns.fetch_add(t1 - t0);
+          lock_wait_ns.fetch_add(t2 - t1);
+          apply_ns.fetch_add(t3 - t2);
+          bytes_in.fetch_add(h.count * sizeof(float));
+          ops_served.fetch_add(1);
         }
-        uint64_t t3 = now_ns();
         uint8_t ok = 1;
         if (!write_exact(fd, &ok, 1)) break;
         if (h.rule == RULE_ELASTIC &&
             !write_exact(fd, buf.data(), h.count * sizeof(float)))
           break;
         uint64_t t4 = now_ns();
-        recv_ns.fetch_add(t1 - t0);
-        lock_wait_ns.fetch_add(t2 - t1);
-        apply_ns.fetch_add(t3 - t2);
-        send_ns.fetch_add(t4 - t3);
-        bytes_in.fetch_add(h.count * sizeof(float));
-        bytes_out.fetch_add(
-            1 + (h.rule == RULE_ELASTIC ? h.count * sizeof(float) : 0));
-        ops_served.fetch_add(1);
+        {
+          std::lock_guard<std::mutex> g(shard_mu);
+          send_ns.fetch_add(t4 - t3);
+          bytes_out.fetch_add(
+              1 + (h.rule == RULE_ELASTIC ? h.count * sizeof(float) : 0));
+          if (h.rule == RULE_ELASTIC)
+            elastic_bytes_out.fetch_add(h.count * sizeof(float));
+        }
       } else if (h.op == OP_RECEIVE) {
         buf.resize(h.count);  // allocation kept out of every bucket
         uint64_t t0 = now_ns();
-        uint64_t t1;
+        uint64_t t1, t2;
         {
           std::lock_guard<std::mutex> g(shard_mu);
           t1 = now_ns();
           std::memcpy(buf.data(), shard.data() + h.offset,
                       h.count * sizeof(float));
+          t2 = now_ns();
+          // Request-side counter group (see OP_SEND).
+          lock_wait_ns.fetch_add(t1 - t0);
+          apply_ns.fetch_add(t2 - t1);
+          ops_served.fetch_add(1);
         }
-        uint64_t t2 = now_ns();
         uint8_t ok = 1;
         if (!write_exact(fd, &ok, 1)) break;
         if (!write_exact(fd, buf.data(), h.count * sizeof(float))) break;
         uint64_t t3 = now_ns();
-        lock_wait_ns.fetch_add(t1 - t0);
-        apply_ns.fetch_add(t2 - t1);
-        send_ns.fetch_add(t3 - t2);
-        bytes_out.fetch_add(1 + h.count * sizeof(float));
-        ops_served.fetch_add(1);
+        {
+          std::lock_guard<std::mutex> g(shard_mu);
+          send_ns.fetch_add(t3 - t2);
+          bytes_out.fetch_add(1 + h.count * sizeof(float));
+        }
       } else {
         break;
       }
@@ -431,20 +456,23 @@ uint64_t tm_ps_server_ops(int64_t sid) {
 
 // Cycle-cost decomposition (VERDICT r4 #8): fills out[0..n-1] (n >= 7)
 // with {ops_served, bytes_in, bytes_out, recv_ns, lock_wait_ns,
-// apply_ns, send_ns} — cumulative since server start, summed over all
-// handler threads.  Returns the number of fields written, or -1 for an
-// unknown server / too-small buffer.  The idle wait for each next
-// request header is NOT in any bucket (see the Server field comment).
-// The snapshot can be TORN: each atomic loads individually while
-// handler threads keep incrementing, so a snapshot may be mutually
-// inconsistent (ops ticked, its bytes_in not yet visible).  Acceptable
-// for a diagnostic; consumers compare successive snapshots with >=.
+// apply_ns, send_ns} and, with n >= 8, {elastic_bytes_out} — cumulative
+// since server start, summed over all handler threads.  Returns the
+// number of fields written, or -1 for an unknown server / too-small
+// buffer.  The idle wait for each next request header is NOT in any
+// bucket (see the Server field comment).  The read takes the shard
+// mutex the counter groups update under (ADVICE round 5), so a
+// snapshot can no longer tear mid-group: every op whose ok byte the
+// client has seen is fully counted in {ops, bytes_in, recv, lock_wait,
+// apply}; {send_ns, bytes_out, elastic_bytes_out} land after the
+// response write and may lag by the in-flight ops only.
 int tm_ps_server_stats(int64_t sid, uint64_t* out, int n) {
   if (n < 7) return -1;
   std::lock_guard<std::mutex> g(g_mu);
   auto it = g_servers.find(sid);
   if (it == g_servers.end()) return -1;
   Server& s = *it->second;
+  std::lock_guard<std::mutex> g2(s.shard_mu);
   out[0] = s.ops_served.load();
   out[1] = s.bytes_in.load();
   out[2] = s.bytes_out.load();
@@ -452,6 +480,10 @@ int tm_ps_server_stats(int64_t sid, uint64_t* out, int n) {
   out[4] = s.lock_wait_ns.load();
   out[5] = s.apply_ns.load();
   out[6] = s.send_ns.load();
+  if (n >= 8) {
+    out[7] = s.elastic_bytes_out.load();
+    return 8;
+  }
   return 7;
 }
 

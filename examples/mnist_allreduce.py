@@ -20,8 +20,16 @@ import common
 
 
 def main():
-    args = common.parse_args(__doc__)
+    args = common.parse_args(__doc__, eager_loss=dict(
+        action="store_true",
+        help="reduce the per-step logging loss via the EAGER host-staged "
+             "rank-major allreduce (backend='host') — the surface the "
+             "guard-smoke CI wounds with corrupt_silent (docs/GUARD.md); "
+             "prints a LOSS-DIGEST line for bit-identity checks"))
+    import hashlib
+
     import jax
+    import numpy as np
     import optax
 
     import torchmpi_tpu as mpi
@@ -53,18 +61,38 @@ def main():
     params = mpi.nn.synchronize_parameters(params)
     opt_state = mpi.nn.synchronize_parameters(opt_state)
 
+    n_dev = mpi.device_count()
     X, Y = dutil.synthetic_mnist(4096, seed=args.seed)
     timer = common.StepTimer()
     timer.start()
+    losses = []
     for i, (xb, yb) in enumerate(
             dutil.batches(X, Y, args.batch_size, steps=args.steps,
                           seed=args.seed)):
         params, opt_state, loss = dp_step(params, opt_state, xb, yb)
+        loss_v = float(loss)
+        if args.eager_loss:
+            # Route the (replicated) step loss through the eager
+            # HOST-STAGED rank-major allreduce: the payload round-trips
+            # through host memory — the end-to-end surface the wire
+            # guard digests and the guard-smoke chaos plan corrupts.
+            red = mpi.allreduce(
+                np.full((n_dev, 1), loss_v, np.float32), op="mean",
+                backend="host")
+            loss_v = float(np.asarray(red)[0, 0])
+        losses.append(loss_v)
         timer.tick()
         if i % 20 == 0 or i == args.steps - 1:
-            print(f"step {i:4d}  loss {float(loss):.4f}")
+            print(f"step {i:4d}  loss {loss_v:.4f}")
     acc = common.evaluate(model, params, X[:1024], Y[:1024])
     print(f"final accuracy {acc:.3f}  ({timer.rate(args.batch_size):.0f} img/s)")
+    if args.eager_loss:
+        # Bit-identity evidence for the guard-smoke CI: the digest of
+        # every loss that crossed the (possibly wounded) staged path.
+        dig = hashlib.blake2b(
+            np.asarray(losses, np.float32).tobytes(),
+            digest_size=16).hexdigest()
+        print(f"LOSS-DIGEST {dig}")
     mpi.stop()
     assert acc > 0.9, "data-parallel MNIST did not converge"
 

@@ -11,7 +11,10 @@ The chaos-engineering operator surface over ``torchmpi_tpu/faults/``:
 
 ``gen`` writes a versioned fault-plan JSON from ``--rule`` specs
 (``site:kind[:prob[:max_hits[:delay_s]]]``; ``site`` may glob the
-instrumented sites, ``max_hits=-1`` means unbounded).  ``--shrink
+instrumented sites, ``max_hits=-1`` means unbounded).  Kinds include
+``corrupt_silent`` (docs/GUARD.md): bits flip and NOTHING raises —
+payload-carrying sites only (``host_staged.*``, ``ps.request``);
+``lint`` rejects it anywhere else, where it would be a total no-op.  ``--shrink
 RANK:STEP:NRANKS`` is the elastic-gang recipe (docs/ELASTIC.md): the
 driver fires the ``elastic.member`` site once per member per step
 boundary in rank order, so arrival ``STEP*NRANKS + RANK`` is exactly
@@ -23,11 +26,12 @@ plan — schema/version errors exit 2, semantic problems (site patterns
 matching no instrumented site, dead rules) print and exit 1.
 ``summarize`` reads per-host obs metric dumps (the files
 ``TORCHMPI_TPU_OBS=metrics`` leaves behind) and prints the
-``tm_fault_*`` and ``tm_elastic_*`` series — what was injected, what
-survived a retry, what hit a deadline, what shrink/rejoin the gang ran
-— the after-action report of a chaos run; exits 1 when a chaos run
-left NO fault counters (it injected nothing: wrong plan, wrong sites,
-or faults never armed).
+``tm_fault_*``, ``tm_elastic_*``, and ``tm_guard_*`` series — what was
+injected, what survived a retry, what hit a deadline, what
+shrink/rejoin the gang ran, what digests failed/healed and what
+updates the numeric tripwire skipped — the after-action report of a
+chaos run; exits 1 when a chaos run left NO fault counters (it
+injected nothing: wrong plan, wrong sites, or faults never armed).
 
 Standalone on purpose: no jax — writing a chaos plan for a pod (or
 reading its post-mortem) must not need the pod's software stack.  The
@@ -171,14 +175,15 @@ def cmd_summarize(args) -> int:
     for path in args.files:
         for rec in _load_counters(path):
             name = rec.get("name", "")
-            if not name.startswith(("tm_fault_", "tm_elastic_")):
+            if not name.startswith(("tm_fault_", "tm_elastic_",
+                                    "tm_guard_")):
                 continue
             key = (name, tuple(sorted(rec.get("labels", {}).items())))
             totals[key] = totals.get(key, 0) + rec.get("value", 0)
     if not totals:
-        print("no tm_fault_*/tm_elastic_* counters found — the chaos "
-              "run injected nothing (plan never matched a site, or "
-              "faults were not armed)", file=sys.stderr)
+        print("no tm_fault_*/tm_elastic_*/tm_guard_* counters found — "
+              "the chaos run injected nothing (plan never matched a "
+              "site, or faults were not armed)", file=sys.stderr)
         return 1
     by_action: Dict[str, float] = {}
     print(f"fault summary over {len(args.files)} host dump(s):")
@@ -187,8 +192,8 @@ def cmd_summarize(args) -> int:
         print(f"  {name}{{{lab}}} = {int(v)}")
         if name.startswith("tm_fault_"):
             action = name[len("tm_fault_"):-len("_total")]
-        else:  # tm_elastic_*: keep the subsystem prefix in the totals
-            action = "elastic_" + name[len("tm_elastic_"):-len("_total")]
+        else:  # tm_elastic_*/tm_guard_*: keep the subsystem prefix
+            action = name[len("tm_"):-len("_total")]
         by_action[action] = by_action.get(action, 0) + v
     line = "  ".join(f"{a}={int(v)}" for a, v in sorted(by_action.items()))
     print(f"totals: {line}")

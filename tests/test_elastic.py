@@ -362,15 +362,105 @@ def test_rejoin_at_step_boundary(tmp_path, elastic_runtime):
     assert info["reconciles"] == 2
     assert board.join_requests() == []  # cleared at admission
     assert np.isfinite(state["losses"]).all()
-    # The healed peer's half: admit() sees the committed view.
-    from torchmpi_tpu import elastic, obs
+    # The healed peer's half of the READ: a committed view containing
+    # it.  (admit() itself now demands a commit FRESHER than the one
+    # current when it was called — the per-life incarnation contract,
+    # covered by test_admit_rejects_stale_view_with_incarnation — so
+    # the post-run read goes through wait_for_view.)
+    from torchmpi_tpu import obs
 
-    view = elastic.admit(d, 2, deadline_s=2)
+    view = membership.wait_for_view(board, containing=2, deadline_s=2)
     assert 2 in view.members and view.epoch == info["view"].epoch
     reg = obs.registry()
     assert reg.counter_total("tm_elastic_shrink_total") == 1
     assert reg.counter_total("tm_elastic_rejoin_total") == 1
     assert reg.counter_total("tm_elastic_reconcile_total") == 2
+
+
+def test_admit_rejects_stale_view_with_incarnation(tmp_path,
+                                                   elastic_runtime):
+    """docs/ELASTIC.md caveat, resolved: a twice-dead rank whose death
+    the survivors have NOT committed yet used to get the stale
+    pre-death view back from admit() (it still listed the rank) and
+    re-enter training against a membership about to change.  admit()
+    now bumps a per-life incarnation id first and only accepts a view
+    committed AFTER this life's join — the stale view times out
+    instead of admitting an ambiguous joiner."""
+    elastic_runtime()
+    from torchmpi_tpu import elastic
+
+    d = str(tmp_path / "ckpt")
+    board = membership.Board(os.path.join(d, "membership"))
+    # A committed view that still lists rank 1 (its death un-committed).
+    membership.reconcile(board, [0, 1], [0, 1], epoch=1, step=4,
+                         deadline_s=2, poll_s=0.01)
+    assert board.committed_view().members == (0, 1)
+    with pytest.raises(membership.ReconcileTimeout):
+        elastic.admit(d, 1, deadline_s=0.5, poll_s=0.01)
+    # The new life is on the board: incarnation bumped, join carries it.
+    assert board.incarnation(1) == 1
+    assert board.join_details()[1]["incarnation"] == 1
+
+
+def test_twice_dead_join_is_a_death_notice(tmp_path, elastic_runtime):
+    """The gang's half of the incarnation contract: a join request from
+    a rank STILL in the view under a newer incarnation means that
+    member's old life died un-detected — poll() shrinks the stale life
+    out first, and the next boundary admits the new life as an
+    ordinary healed joiner (original layout back)."""
+    elastic_runtime()
+    from torchmpi_tpu import elastic
+
+    d = str(tmp_path / "ckpt")
+    os.makedirs(d)
+    gang = elastic.ElasticGang(d, members=[0, 1], world_size=2)
+    assert gang.view.members == (0, 1)
+    board = gang.board
+    # Rank 1's next life knocks while its death is un-committed.
+    inc = board.bump_incarnation(1)
+    board.heartbeat(1, epoch=-1, step=-1, incarnation=inc)
+    board.request_join(1, incarnation=inc)
+    ev = gang.poll(0)
+    assert ev == ("shrink", [1])
+    gang.shrink([1], step=0)
+    assert gang.view.members == (0,)
+    # Next boundary: the same join now reads as a healed joiner.
+    ev = gang.poll(1)
+    assert ev == ("rejoin", [1])
+    gang.grow([1], step=1)
+    assert gang.view.members == (0, 1)
+    assert gang._inc[1] == inc  # the admitted life is the new one
+    assert board.join_requests() == []
+    # A re-knock at the SAME incarnation is this life, not a death.
+    board.request_join(1, incarnation=inc)
+    assert gang.poll(2) is None
+
+
+def test_restarted_driver_sees_pending_join_as_death(tmp_path,
+                                                     elastic_runtime):
+    """code review: a rank dies un-committed, its new life admit()s
+    (bumping the incarnation), and the DRIVER restarts before seeing
+    the join — the fresh gang must still read the pending
+    incarnation-carrying join as the old life's death notice instead
+    of adopting the already-bumped counter and ignoring it forever."""
+    elastic_runtime()
+    from torchmpi_tpu import elastic
+
+    d = str(tmp_path / "ckpt")
+    os.makedirs(d)
+    gang0 = elastic.ElasticGang(d, members=[0, 1], world_size=2)
+    board = gang0.board
+    # Rank 1's new life knocks (what admit() posts), then the driver
+    # restarts: the new gang adopts the committed state + the board.
+    inc = board.bump_incarnation(1)
+    board.heartbeat(1, epoch=-1, step=-1, incarnation=inc)
+    board.request_join(1, incarnation=inc)
+    gang = elastic.ElasticGang(d, members=[0, 1], world_size=2)
+    assert gang.poll(0) == ("shrink", [1])
+    gang.shrink([1], step=0)
+    assert gang.poll(1) == ("rejoin", [1])
+    gang.grow([1], step=1)
+    assert gang.view.members == (0, 1) and gang._inc[1] == inc
 
 
 def test_ledger_escalation_shrinks(tmp_path, elastic_runtime):

@@ -1,0 +1,746 @@
+"""Guard layer (torchmpi_tpu/guard.py + faults/integrity.py —
+docs/GUARD.md): wire integrity over the host-staged and PS payloads
+(the silent-corruption acceptance: seeded ``corrupt_silent`` diverges
+with guard off, heals bit-identical with guard="wire", with
+HealthLedger attribution and tm_guard_* evidence), the fused numeric
+tripwire (skip_step / deferred raise) across gradsync/overlap/ZeRO,
+the loss-spike detector + board-agreed rewind-to-checkpoint (the
+rewind acceptance: post-rewind trajectory bit-identical, no
+config-epoch bump, plans untouched), the failure-path plumbing the
+guard depends on (PeerTimeoutError flight-tail contents, health
+snapshot round-trip under concurrent checkpoint writes), and the
+off-mode never-imported guarantee."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import torchmpi_tpu as mpi
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax import shard_map  # noqa: E402
+from jax.sharding import PartitionSpec as P  # noqa: E402
+
+from torchmpi_tpu.faults import inject as finject  # noqa: E402
+from torchmpi_tpu.faults import policy as fpolicy  # noqa: E402
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _write_plan(path, rules, seed=7):
+    with open(path, "w") as f:
+        json.dump({"version": finject.FAULT_PLAN_VERSION, "seed": seed,
+                   "rules": rules}, f)
+    return str(path)
+
+
+@pytest.fixture()
+def guard_runtime(tmp_path):
+    """Callable fixture: arm a flat 8-device runtime with the guard on
+    (optionally under a fault plan); cleans up guard stats + fault
+    state on exit."""
+    counter = [0]
+
+    def arm(rules=None, *, guard="wire", seed=7, **cfg_kw):
+        counter[0] += 1
+        kw = dict(dcn_size=1, guard=guard, fault_backoff_s=0.01)
+        if rules is not None:
+            kw["faults"] = _write_plan(
+                tmp_path / f"plan{counter[0]}.json", rules, seed=seed)
+        kw.update(cfg_kw)
+        mpi.stop()
+        return mpi.init(mpi.Config(**kw))
+
+    yield arm
+    if "torchmpi_tpu.faults" in sys.modules:
+        sys.modules["torchmpi_tpu.faults"].reset()
+    if "torchmpi_tpu.guard" in sys.modules:
+        sys.modules["torchmpi_tpu.guard"].reset_stats()
+    mpi.stop()
+
+
+def _clean_staged(x):
+    mpi.stop()
+    mpi.init(mpi.Config(dcn_size=1))
+    out = np.asarray(mpi.allreduce(x, backend="host"))
+    mpi.stop()
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Config plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_guard_config_normalization_env_and_validation(monkeypatch):
+    mpi.stop()
+    mpi.init(mpi.Config(dcn_size=1, guard="on"))  # boolean-ish => full
+    assert mpi.config().guard == "full"
+    mpi.stop()
+    monkeypatch.setenv("TORCHMPI_TPU_GUARD", "wire")
+    monkeypatch.setenv("TORCHMPI_TPU_GUARD_NORM_BOUND", "5.5")
+    mpi.init(mpi.Config(dcn_size=1))  # explicit Config, env pickup
+    assert mpi.config().guard == "wire"
+    assert mpi.config().guard_norm_bound == 5.5
+    with pytest.raises(ValueError, match="guard"):
+        mpi.set_config(guard="sideways")
+    with pytest.raises(ValueError, match="guard_numeric_policy"):
+        mpi.set_config(guard_numeric_policy="explode")
+    with pytest.raises(ValueError):
+        mpi.set_config(guard_norm_bound=-1)
+    with pytest.raises(ValueError):
+        mpi.set_config(guard_spike_window=1)
+    mpi.set_config(guard="numeric", guard_numeric_policy="raise")
+    assert mpi.config().guard == "numeric"
+    mpi.stop()
+    monkeypatch.delenv("TORCHMPI_TPU_GUARD")
+    with pytest.raises(ValueError, match="guard"):
+        mpi.init(mpi.Config(dcn_size=1, guard="banana"))
+    mpi.stop()
+
+
+# ---------------------------------------------------------------------------
+# Wire integrity: the silent-corruption acceptance (host-staged path)
+# ---------------------------------------------------------------------------
+
+
+def test_corrupt_silent_diverges_without_guard(guard_runtime):
+    """The contrast half of the acceptance: corrupt_silent flips bits
+    and raises NOTHING — with guard off the staged allreduce completes
+    with silently-wrong values and no retry happened."""
+    x = np.arange(32, dtype=np.float32).reshape(8, 4)
+    clean = _clean_staged(x)
+    guard_runtime([{"site": "host_staged.gather", "kind": "corrupt_silent",
+                    "max_hits": 1}], guard="off", fault_retries=2)
+    got = np.asarray(mpi.allreduce(x, backend="host"))
+    assert not np.array_equal(got, clean), "corruption must propagate"
+    from torchmpi_tpu import faults
+
+    assert faults.plan().arrivals("host_staged.gather") == 1  # no retry
+    assert "torchmpi_tpu.guard" not in sys.modules
+
+
+@pytest.mark.parametrize("leg", ["host_staged.gather",
+                                 "host_staged.scatter"])
+def test_corrupt_silent_healed_with_wire_guard(guard_runtime, leg):
+    """The detection half: the same seeded corrupt_silent under
+    guard="wire" is caught by the digest verify (a transient
+    IntegrityError), retried from the device buffers, attributed in
+    the HealthLedger, and the result is bit-identical to a clean
+    run."""
+    x = np.arange(32, dtype=np.float32).reshape(8, 4)
+    clean = _clean_staged(x)
+    guard_runtime([{"site": leg, "kind": "corrupt_silent",
+                    "max_hits": 1}])
+    got = np.asarray(mpi.allreduce(x, backend="host"))
+    np.testing.assert_array_equal(got, clean)
+    from torchmpi_tpu import faults
+
+    assert faults.plan().arrivals(leg) >= 2  # wounded, then retried
+    h = faults.ledger().get("gang")
+    assert h is not None and h.total_failures >= 1  # attributed
+    assert h.state == "healthy"  # and healed
+
+
+def test_wire_guard_counters_flight_and_latency(guard_runtime, tmp_path):
+    """tm_guard_* evidence: verify_failed + healed counters, per-site
+    verify-latency histogram, and guard flight events carrying the
+    digest (what obs_tool blame aligns across hosts)."""
+    guard_runtime([{"site": "host_staged.gather", "kind": "corrupt_silent",
+                    "max_hits": 1}], obs="metrics",
+                  obs_dir=str(tmp_path / "obs"))
+    from torchmpi_tpu import obs
+
+    obs.reset()
+    try:
+        mpi.allreduce(np.ones((8, 2), np.float32), backend="host")
+        reg = obs.registry()
+        assert reg.counter("tm_guard_verify_failed_total",
+                           site="host_staged.gather", peer="gang") == 1
+        assert reg.counter_total("tm_guard_healed_total") == 1
+        assert reg.counter_total("tm_guard_verified_total") >= 2
+        snap = reg.snapshot()
+        hists = [r for r in snap if r["kind"] == "hist"
+                 and r["name"] == "tm_guard_verify_us"]
+        assert hists and {h["labels"]["site"] for h in hists} >= {
+            "host_staged.gather"}
+        ev = [e for e in obs.recorder().events() if e[2] == "guard"]
+        assert any(e[6] == "verify_failed" for e in ev)
+        # The digest rides the backend slot of the flight event.
+        assert any(e[5] for e in ev)
+    finally:
+        obs.deactivate()
+        obs.reset()
+
+
+def test_wire_guard_async_staged_heals(guard_runtime):
+    """The async staged worker path under corrupt_silent + wire guard:
+    donation deletes the device buffers, the _RestageView master feeds
+    each attempt a fresh copy, and the handle result is bit-identical
+    to clean."""
+    x = np.arange(32, dtype=np.float32).reshape(8, 4)
+    clean = _clean_staged(x)
+    guard_runtime([{"site": "host_staged.gather", "kind": "corrupt_silent",
+                    "max_hits": 1}])
+    xj = jax.device_put(x)
+    h = mpi.async_.allreduce(xj, backend="host", donate=True)
+    got = np.asarray(h.wait())
+    np.testing.assert_array_equal(got, clean)
+    assert xj.is_deleted()
+    from torchmpi_tpu import faults
+
+    assert faults.plan().arrivals("host_staged.gather") >= 2
+
+
+def test_wire_guard_planned_into_collective_plan(guard_runtime):
+    """Planner integration: guard enablement is pre-resolved into the
+    eager-staged CollectivePlan (a describe row), and guard="off"
+    plans carry guard=False — the off path's replay has no guard
+    branch at all."""
+    from torchmpi_tpu import planner
+
+    guard_runtime(None, guard="wire")
+    mpi.allreduce(np.ones((8, 2), np.float32), backend="host")
+    rows = [r for r in planner.describe() if r["kind"] == "eager-staged"]
+    assert rows and all(r["guard"] for r in rows)
+    mpi.set_config(guard="off")  # epoch bump strands the guarded plan
+    mpi.allreduce(np.ones((8, 2), np.float32), backend="host")
+    rows = [r for r in planner.describe() if r["kind"] == "eager-staged"]
+    assert rows and not any(r["guard"] for r in rows)
+
+
+# ---------------------------------------------------------------------------
+# Wire integrity: the PS exchange
+# ---------------------------------------------------------------------------
+
+
+def test_ps_corrupt_silent_diverges_without_guard(guard_runtime):
+    # after=1: arrival 0 is the init copy of zeros (bit flips in 0.0
+    # make subnormals that vanish under +1.0); the wounded arrival must
+    # be the real payload.
+    guard_runtime([{"site": "ps.request", "kind": "corrupt_silent",
+                    "after": 1, "max_hits": 1}], guard="off")
+    ps = mpi.parameterserver.init({"w": np.zeros(64, np.float32)},
+                                  num_shards=2)
+    try:
+        ps.send({"w": np.ones(64, np.float32)}, rule="add").wait()
+        got = ps.receive().wait()
+        assert not np.array_equal(got["w"], np.ones(64, np.float32))
+    finally:
+        ps.shutdown()
+
+
+def test_ps_corrupt_silent_healed_with_wire_guard(guard_runtime):
+    guard_runtime([{"site": "ps.request", "kind": "corrupt_silent",
+                    "after": 1, "max_hits": 1}])
+    ps = mpi.parameterserver.init({"w": np.zeros(64, np.float32)},
+                                  num_shards=2)
+    try:
+        ps.send({"w": np.ones(64, np.float32)}, rule="add").wait()
+        got = ps.receive().wait()
+        np.testing.assert_array_equal(got["w"], np.ones(64, np.float32))
+        from torchmpi_tpu import faults
+
+        # Attribution: the joint shard peer took the transient hit.
+        assert any(h.total_failures >= 1
+                   for h in faults.ledger().peers())
+    finally:
+        ps.shutdown()
+
+
+def test_ps_wire_guard_without_fault_plan(guard_runtime):
+    """guard="wire" with no fault plan (and faults config off): the PS
+    path digests + verifies (nothing to detect) and exchanges still
+    round-trip — the guard rides the default retry policy without the
+    injection layer being armed."""
+    guard_runtime(None, guard="wire")
+    ps = mpi.parameterserver.init({"w": np.zeros(32, np.float32)},
+                                  num_shards=2)
+    try:
+        ps.send({"w": np.full(32, 2.0, np.float32)}, rule="add").wait()
+        got = ps.receive().wait()
+        np.testing.assert_array_equal(got["w"],
+                                      np.full(32, 2.0, np.float32))
+    finally:
+        ps.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Numeric tripwire (gradsync / overlap / ZeRO)
+# ---------------------------------------------------------------------------
+
+
+def _gradsync_jit(mesh):
+    from torchmpi_tpu.parallel import gradsync
+
+    axes = mesh.axis_names
+    return jax.jit(shard_map(
+        lambda g: gradsync.synchronize_gradients(g, axes),
+        mesh=mesh, in_specs=(P(),), out_specs=P(), check_vma=False))
+
+
+def test_numeric_tripwire_skip_step_and_bitwise(guard_runtime):
+    from torchmpi_tpu import guard
+
+    guard.reset_stats()
+    mesh = guard_runtime(None, guard="off")
+    grads = {"a": jnp.arange(16.0).reshape(2, 8), "b": jnp.ones((4,))}
+    base = jax.tree.map(np.asarray, _gradsync_jit(mesh)(grads))
+    mesh = guard_runtime(None, guard="numeric")
+    sync = _gradsync_jit(mesh)
+    ok = jax.tree.map(np.asarray, sync(grads))
+    for k in base:  # finite pass-through is bit-identical
+        np.testing.assert_array_equal(base[k], ok[k])
+    bad = {"a": grads["a"].at[0, 0].set(jnp.nan), "b": grads["b"]}
+    z = jax.tree.map(np.asarray, sync(bad))
+    assert all(np.all(v == 0) for v in z.values())  # update skipped
+    st = guard.stats()
+    assert st["numeric_trips"] >= 1 and st["skipped_steps"] >= 1
+
+
+def test_numeric_tripwire_norm_bound(guard_runtime):
+    from torchmpi_tpu import guard
+
+    guard.reset_stats()
+    mesh = guard_runtime(None, guard="numeric", guard_norm_bound=1.0)
+    sync = _gradsync_jit(mesh)
+    big = {"w": jnp.full((8,), 10.0)}  # ||g|| = ~28 > 1
+    z = jax.tree.map(np.asarray, sync(big))
+    assert np.all(z["w"] == 0)
+    small = {"w": jnp.full((8,), 0.01)}
+    out = jax.tree.map(np.asarray, sync(small))
+    assert np.all(out["w"] != 0)  # under the bound: untouched
+
+
+def test_numeric_raise_policy_defers_typed_error(guard_runtime):
+    """policy="raise": the tripped bucket is still zeroed in-graph (an
+    in-callback raise would wedge jax's effects token for the whole
+    process) and the typed error surfaces at the next raise_pending()
+    boundary — with the runtime healthy afterwards."""
+    from torchmpi_tpu import guard
+
+    guard.reset_stats()
+    mesh = guard_runtime(None, guard="numeric",
+                         guard_numeric_policy="raise")
+    sync = _gradsync_jit(mesh)
+    bad = {"w": jnp.full((8,), jnp.inf)}
+    z = jax.tree.map(np.asarray, sync(bad))
+    assert np.all(z["w"] == 0)  # the poisoned update never applies
+    assert guard.pending() >= 1
+    with pytest.raises(guard.NumericAnomalyError) as ei:
+        guard.raise_pending()
+    assert ei.value.site == "gradsync" and guard.pending() == 0
+    out = jax.tree.map(np.asarray, sync({"w": jnp.ones((8,))}))
+    assert np.isfinite(out["w"]).all()  # runtime still healthy
+    guard.raise_pending()  # nothing pending: no-op
+
+
+def test_numeric_tripwire_zero_shard_leg(guard_runtime):
+    import optax
+
+    from torchmpi_tpu.parallel import zero as zmod
+
+    mesh = guard_runtime(None, guard="numeric")
+    axes = mesh.axis_names
+    params = {"w": jnp.ones((8, 4))}
+    tx = optax.sgd(0.1)
+    opt = zmod.init(params, tx, axes)
+    step = jax.jit(shard_map(
+        lambda p, g, o: zmod.update(p, g, o, tx, axes),
+        mesh=mesh,
+        in_specs=(P(), P(), zmod.state_specs(params, tx, axes)),
+        out_specs=(P(), zmod.state_specs(params, tx, axes)),
+        check_vma=False))
+    p2, _ = step(params, {"w": jnp.full((8, 4), jnp.nan)}, opt)
+    # The shard legs zeroed the anomalous gradient: params unchanged.
+    np.testing.assert_array_equal(np.asarray(p2["w"]),
+                                  np.asarray(params["w"]))
+
+
+def test_numeric_trip_reverts_ef_residuals(guard_runtime):
+    """code review: a tripped round's EF residual state must revert to
+    the PRE-step accumulators — returning the poisoned new_res would
+    re-inject the anomaly through the next step's quantized DCN leg,
+    degenerating 'skip and continue' into a permanent no-op."""
+    from torchmpi_tpu.parallel import gradsync
+
+    mesh = guard_runtime(None, guard="numeric", dcn_size=2,
+                         dcn_compress="int8", dcn_compress_min_bytes=0)
+    axes = ("dcn", "ici")
+    params = {"w": jnp.zeros((64, 8), jnp.float32)}
+    res0 = gradsync.init_dcn_residuals(params, axes, mesh=mesh)
+    sync = jax.jit(shard_map(
+        lambda g, r: gradsync.synchronize_gradients(g, axes,
+                                                    residuals=r),
+        mesh=mesh, in_specs=(P(), P(axes)), out_specs=(P(), P(axes)),
+        check_vma=False))
+    # One clean step: residuals accumulate real quantization error.
+    g1 = {"w": jnp.full((64, 8), 0.37, jnp.float32)}
+    _, res1 = sync(g1, res0)
+    assert any(float(np.abs(np.asarray(r)).max()) > 0 for r in res1)
+    # A poisoned step: synced zeroed AND residuals bit-identical to
+    # the pre-step state (the round never happened).
+    bad = {"w": jnp.full((64, 8), jnp.nan, jnp.float32)}
+    synced, res2 = sync(bad, res1)
+    assert np.all(np.asarray(synced["w"]) == 0)
+    for a, b in zip(res2, res1):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_numeric_tripwire_overlap_buckets(guard_runtime):
+    from torchmpi_tpu import guard
+    from torchmpi_tpu.parallel import gradsync
+
+    guard.reset_stats()
+    mesh = guard_runtime(None, guard="numeric")
+    axes = mesh.axis_names
+    params = {"w1": jnp.ones((16,)), "w2": jnp.ones((16,))}
+
+    def loss_fn(p, x):
+        return jnp.sum(p["w1"] * x) + jnp.sum(p["w2"] * x)
+
+    vag = gradsync.make_overlapped_grad_fn(loss_fn, params, axes,
+                                           max_bytes=64)
+    stepf = jax.jit(shard_map(
+        lambda p, x: vag(p, x), mesh=mesh, in_specs=(P(), P()),
+        out_specs=(P(), P()), check_vma=False))
+    # A NaN batch makes every bucket's cotangent anomalous; the
+    # per-bucket tripwire inside the custom_vjp bwd zeroes them all.
+    _, grads = stepf(params, jnp.full((16,), jnp.nan))
+    assert all(np.all(np.asarray(v) == 0) for v in grads.values())
+    assert guard.stats()["numeric_trips"] >= 1
+    _, grads = stepf(params, jnp.ones((16,)))
+    # mean over the 8 replicated devices: d/dw = x = 1.0 everywhere.
+    assert all(np.all(np.asarray(v) == 1.0) for v in grads.values())
+
+
+# ---------------------------------------------------------------------------
+# Loss-spike detector + agreed rewind
+# ---------------------------------------------------------------------------
+
+
+def test_loss_spike_detector_unit(guard_runtime):
+    from torchmpi_tpu import guard
+
+    guard_runtime(None, guard="full")
+    det = guard.LossSpikeDetector(window=8, threshold=6.0, min_history=4)
+    rng = np.random.RandomState(0)
+    for i in range(8):  # noisy but sane: never trips
+        assert not det.update(1.0 + 0.02 * rng.randn())
+    assert det.update(50.0)  # spike trips
+    assert not det.update(1.01)  # the spike did not poison the window
+    assert det.update(float("nan"))  # non-finite always trips
+    assert det.update(float("inf"))
+    with pytest.raises(ValueError):
+        guard.LossSpikeDetector(window=1)
+
+
+def test_rewind_bit_identical_plans_and_epoch_untouched(guard_runtime,
+                                                        tmp_path):
+    """The rewind acceptance: an injected loss spike trips the
+    detector, the board commits a rewind record, training resumes from
+    the last fsync-verified step in place — no config-epoch bump, no
+    re-plans — and the post-rewind trajectory is bit-identical to a
+    clean run restored from that step."""
+    from torchmpi_tpu import planner, runtime
+
+    guard_runtime(None, guard="full")
+    from torchmpi_tpu import guard
+    from torchmpi_tpu.faults import membership
+
+    guard.reset_stats()
+
+    def init_fn():
+        return {"w": np.zeros((4,), np.float32),
+                "losses": np.full((12,), np.nan, np.float32)}
+
+    def make_step(poison_at, armed):
+        def step(state, i):
+            w = state["w"] + (i + 1)
+            loss = 1.0 / (i + 1)
+            if poison_at is not None and i == poison_at and armed[0]:
+                armed[0] = False  # one-shot corruption: replay is clean
+                w = w + 1e6
+                loss = 1e9
+            losses = np.array(state["losses"])
+            losses[i] = loss
+            return {"w": w, "losses": losses}, loss
+
+        return step
+
+    d = str(tmp_path / "guarded")
+    epoch0 = runtime.config_epoch()
+    misses0 = planner.stats()["misses"]
+    det = guard.LossSpikeDetector(window=8, threshold=6.0, min_history=3)
+    final, info = guard.run_guarded(
+        init_fn, make_step(7, [True]), steps=12, directory=d,
+        save_every=3, detector=det)
+    assert info["rewinds"] == 1 and info["trip_steps"] == [7]
+    assert info["recovered_step"] == 6
+    # In place: no epoch bump, no re-plans, plans untouched.
+    assert runtime.config_epoch() == epoch0
+    assert planner.stats()["misses"] == misses0
+    # The rewind record landed on the board.
+    board = membership.Board(os.path.join(d, "membership"))
+    recs = board.rewind_records()
+    assert recs and recs[0]["step"] == 7
+    assert guard.stats()["rewinds"] == 1
+    # Clean comparison run (no poison), fresh directory.
+    d2 = str(tmp_path / "clean")
+    clean, cinfo = guard.run_guarded(
+        init_fn, make_step(None, [False]), steps=12, directory=d2,
+        save_every=3,
+        detector=guard.LossSpikeDetector(window=8, threshold=6.0,
+                                         min_history=3))
+    assert cinfo["rewinds"] == 0
+    np.testing.assert_array_equal(final["w"], clean["w"])
+    np.testing.assert_array_equal(final["losses"], clean["losses"])
+
+
+def test_rewind_quarantines_implicated_peer(guard_runtime, tmp_path):
+    guard_runtime(None, guard="full", faults="policy")
+    from torchmpi_tpu import faults, guard
+
+    def init_fn():
+        return {"w": np.zeros((2,), np.float32)}
+
+    armed = [True]
+
+    def step(state, i):
+        loss = 1.0
+        if i == 6 and armed[0]:
+            armed[0] = False
+            loss = float("nan")  # non-finite: trips immediately
+        return {"w": state["w"] + 1}, loss
+
+    _, info = guard.run_guarded(
+        init_fn, step, steps=10, directory=str(tmp_path),
+        save_every=2, implicate="member:3")
+    assert info["rewinds"] == 1
+    assert faults.ledger().decide("member:3") == "raise"
+    from torchmpi_tpu.faults import membership
+
+    board = membership.Board(os.path.join(str(tmp_path), "membership"))
+    rec = board.rewind_records()[0]
+    assert rec["peer"] == "member:3" and rec["quarantined"] is True
+    # With faults unarmed, quarantine is an honest no-op: no ledger
+    # write, no counter, and the record says so.
+    mpi.set_config(faults="off")
+    assert guard.quarantine("member:9") is False
+
+
+def test_rewind_budget_exhausts_on_recurring_spike(guard_runtime,
+                                                   tmp_path):
+    """A deterministically-poisoned step trips on every replay: the
+    rewind budget bounds the loop and surfaces a typed error instead
+    of rewinding forever."""
+    guard_runtime(None, guard="full")
+    from torchmpi_tpu import guard
+
+    def init_fn():
+        return {"w": np.zeros((2,), np.float32)}
+
+    def step(state, i):
+        loss = float("nan") if i == 4 else 1.0  # data-born: every pass
+        return {"w": state["w"] + 1}, loss
+
+    with pytest.raises(guard.NumericAnomalyError, match="budget"):
+        guard.run_guarded(init_fn, step, steps=8,
+                          directory=str(tmp_path), save_every=2,
+                          max_rewinds=2)
+
+
+def test_run_guarded_requires_opt_in(tmp_path):
+    mpi.stop()
+    mpi.init(mpi.Config(dcn_size=1))
+    try:
+        from torchmpi_tpu import guard
+
+        with pytest.raises(RuntimeError, match="guard"):
+            guard.run_guarded(lambda: {}, lambda s, i: (s, 0.0),
+                              steps=1, directory=str(tmp_path))
+    finally:
+        mpi.stop()
+
+
+# ---------------------------------------------------------------------------
+# chaos_tool: corrupt_silent + tm_guard_* summaries
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_tool_corrupt_silent_and_guard_summary(tmp_path, capsys):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "_chaos_tool_guard_test",
+        os.path.join(_REPO, "scripts", "chaos_tool.py"))
+    tool = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(tool)
+    out = tmp_path / "plan.json"
+    assert tool.main(["gen", "--out", str(out), "--seed", "5",
+                      "--rule", "host_staged.*:corrupt_silent:1.0:2"]) == 0
+    plan = finject.FaultPlan.load(str(out))
+    assert plan.rules[0].kind == "corrupt_silent"
+    assert tool.main(["lint", str(out)]) == 0
+    bad = tmp_path / "bad.json"
+    _write_plan(bad, [{"site": "elastic.member",
+                       "kind": "corrupt_silent"}])
+    assert tool.main(["lint", str(bad)]) == 1
+    assert "no payload" in capsys.readouterr().out
+    m = tmp_path / "metrics_host0.jsonl"
+    with open(m, "w") as f:
+        f.write(json.dumps({"kind": "counter",
+                            "name": "tm_guard_verify_failed_total",
+                            "labels": {"site": "host_staged.gather"},
+                            "value": 2}) + "\n")
+        f.write(json.dumps({"kind": "counter",
+                            "name": "tm_guard_healed_total",
+                            "labels": {"site": "host_staged"},
+                            "value": 2}) + "\n")
+    assert tool.main(["summarize", str(m)]) == 0
+    text = capsys.readouterr().out
+    assert "tm_guard_verify_failed_total" in text
+    assert "guard_healed=2" in text
+
+
+# ---------------------------------------------------------------------------
+# Failure-path plumbing the guard depends on (ISSUE 12 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_peer_timeout_flight_tail_contents(guard_runtime, tmp_path):
+    """The flight tail a PeerTimeoutError carries is the recorder's
+    actual tail: dict records with seq/ev/op fields, ending at the
+    most recent event, and named in the exception message — the
+    evidence contract obs_tool blame and the rewind post-mortems rely
+    on."""
+    guard_runtime(None, guard="off", obs="metrics",
+                  obs_dir=str(tmp_path / "obs"))
+    from torchmpi_tpu import obs
+
+    obs.reset()
+    try:
+        for _ in range(3):
+            mpi.barrier()  # seed the flight ring with known events
+
+        def attempt(i):
+            raise finject.DroppedPacket("silence")
+
+        with pytest.raises(fpolicy.PeerTimeoutError) as ei:
+            fpolicy.run("s", attempt, peer="p0",
+                        policy=fpolicy.Policy(retries=0, deadline_s=5.0))
+        tail = ei.value.flight_tail
+        assert tail and len(tail) <= 8
+        for rec in tail:
+            assert {"seq", "ev", "op"} <= set(rec)
+        want = obs.recorder().to_records()[-len(tail):]
+        assert [r["seq"] for r in tail] == [r["seq"] for r in want]
+        assert tail[-1]["ev"] == "barrier"
+        assert f"last flight event #{tail[-1]['seq']}" in str(ei.value)
+    finally:
+        obs.deactivate()
+        obs.reset()
+
+
+def test_health_snapshot_roundtrip_under_concurrent_checkpoint(
+        guard_runtime, tmp_path):
+    """restart._save_health/_load_health next to a checkpoint stream
+    being written concurrently: the round-trip stays exact (atomic tmp
+    + rename), a torn snapshot file reads as absent, and nothing
+    raises from either side."""
+    from torchmpi_tpu.utils import checkpoint, restart
+
+    guard_runtime(None, guard="off", faults="policy")
+    from torchmpi_tpu import faults
+
+    led = faults.ledger()
+    led.clear()
+    led.record("flaky:7", ok=False)
+    led.record("flaky:7", ok=False)
+    d = str(tmp_path)
+    state = {"w": np.arange(64, dtype=np.float32)}
+    stop = threading.Event()
+    errors = []
+
+    def writer():
+        step = 0
+        while not stop.is_set():
+            step += 1
+            try:
+                checkpoint.save(d, state, step=step)
+            except Exception as e:  # noqa: BLE001 — failure IS the test
+                errors.append(e)
+
+    th = threading.Thread(target=writer)
+    th.start()
+    try:
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 1.0:
+            restart._save_health(d)
+            restart._load_health(d)
+    finally:
+        stop.set()
+        th.join()
+    assert not errors
+    h = led.get("flaky:7")
+    assert h is not None and h.consecutive_failures == 2
+    # A torn (mid-write) snapshot must read as absent, not raise.
+    with open(os.path.join(d, "health_p0.json"), "w") as f:
+        f.write('{"suspect_after": 2, "peers": [{"pe')
+    restart._load_health(d)
+    assert led.get("flaky:7").consecutive_failures == 2
+
+
+# ---------------------------------------------------------------------------
+# Off-mode import discipline
+# ---------------------------------------------------------------------------
+
+
+def test_guard_off_never_imports():
+    """guard="off" (the default) is zero-cost: neither torchmpi_tpu.guard
+    nor faults.integrity is ever imported — the probe drives the
+    staged eager path, an in-axis gradient sync, and a PS exchange."""
+    code = (
+        "import sys\n"
+        "import numpy as np\n"
+        "import torchmpi_tpu as mpi\n"
+        "import jax, jax.numpy as jnp\n"
+        "from jax import shard_map\n"
+        "from jax.sharding import PartitionSpec as P\n"
+        "from torchmpi_tpu.parallel import gradsync\n"
+        "mesh = mpi.init(mpi.Config(dcn_size=1))\n"
+        "mpi.allreduce(np.ones((2, 4), np.float32), backend='host')\n"
+        "sync = jax.jit(shard_map(\n"
+        "    lambda g: gradsync.synchronize_gradients(g, "
+        "mesh.axis_names),\n"
+        "    mesh=mesh, in_specs=(P(),), out_specs=P(), "
+        "check_vma=False))\n"
+        "sync({'w': jnp.ones((4,))})\n"
+        "ps = mpi.parameterserver.init({'w': np.zeros(8, np.float32)})\n"
+        "ps.send({'w': np.ones(8, np.float32)}).wait()\n"
+        "ps.receive().wait()\n"
+        "ps.shutdown()\n"
+        "mpi.stop()\n"
+        "assert 'torchmpi_tpu.guard' not in sys.modules, 'guard!'\n"
+        "assert 'torchmpi_tpu.faults.integrity' not in sys.modules, "
+        "'integrity!'\n"
+        "print('GUARD-OFF-OK')\n"
+    )
+    env = dict(os.environ)
+    for k in ("TORCHMPI_TPU_GUARD", "TORCHMPI_TPU_FAULTS",
+              "TORCHMPI_TPU_STAGED"):
+        env.pop(k, None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    out = subprocess.run([sys.executable, "-c", code],
+                         capture_output=True, text=True, timeout=300,
+                         env=env, cwd=_REPO)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "GUARD-OFF-OK" in out.stdout

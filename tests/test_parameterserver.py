@@ -195,12 +195,19 @@ def test_concurrent_clients_add():
         # Cycle-cost decomposition (VERDICT r4 #8): after real traffic
         # the counters must be populated and self-consistent.
         st = servers.stats()
-        # >= not ==: a handler can unblock the client's wait() before its
-        # own counter increments land (code review r5), so a second
-        # read may run ahead of a stats() snapshot.
-        assert st["ops"] >= k * iters
-        assert st["bytes_in"] >= k * iters * spec.total * 4
-        assert st["bytes_out"] >= st["ops"]  # >= 1 status byte per op
+        # == now, not the old >= compensation (ADVICE round 5): the
+        # request-side counter group lands under the shard mutex BEFORE
+        # the ok byte unblocks the client, and stats() reads under the
+        # same mutex — at quiescence every completed exchange is
+        # counted exactly (1 op per shard per exchange; the receive
+        # contributes ops but no bytes_in).
+        n_exchanges = k * iters + 1  # sends + the reader's receive
+        assert st["ops"] == n_exchanges * servers.num_shards
+        assert st["bytes_in"] == k * iters * spec.total * 4
+        assert st["elastic_bytes_out"] == 0  # no elastic rule ran
+        # bytes_out lands after the response write — at most one op per
+        # connection can sit in that window when stats() reads.
+        assert st["bytes_out"] >= st["ops"] - (k + 1) * servers.num_shards
         for key in ("recv_s", "apply_s", "send_s"):
             assert st[key] > 0.0, st
         assert st["lock_wait_s"] >= 0.0

@@ -91,16 +91,16 @@ from .utils.compilegate import (
 # TORCHMPI_TPU_COMPILE_GATE=0.
 _install_compile_gate()
 
-# The static analyzer, observability, fault-layer, and elastic-gang
-# subpackages load lazily (PEP 562): with Config.analysis="off" /
-# Config.obs="off" / Config.faults="off" / Config.elastic="off" — the
-# defaults — `import torchmpi_tpu` never imports them, keeping the
-# zero-added-cost claims literal (tests assert the modules are absent
-# from sys.modules).  Any access (`mpi.analysis`, `mpi.obs`,
-# `mpi.faults`, `mpi.elastic`, `from torchmpi_tpu import obs`) imports
-# on first touch.
+# The static analyzer, observability, fault-layer, elastic-gang, and
+# guard subpackages load lazily (PEP 562): with Config.analysis="off" /
+# Config.obs="off" / Config.faults="off" / Config.elastic="off" /
+# Config.guard="off" — the defaults — `import torchmpi_tpu` never
+# imports them, keeping the zero-added-cost claims literal (tests
+# assert the modules are absent from sys.modules).  Any access
+# (`mpi.analysis`, `mpi.obs`, `mpi.faults`, `mpi.elastic`,
+# `mpi.guard`, `from torchmpi_tpu import obs`) imports on first touch.
 def __getattr__(name):
-    if name in ("analysis", "obs", "faults", "elastic"):
+    if name in ("analysis", "obs", "faults", "elastic", "guard"):
         # importlib, not ``from . import``: the from-import form does a
         # hasattr() probe on this package first, which would re-enter
         # this very function.
@@ -134,7 +134,7 @@ __all__ = [
     "current_mesh", "push_communicator", "pop_communicator", "communicator",
     "set_config", "config", "DCN_AXIS", "ICI_AXIS", "WORLD_AXES",
     "collectives", "fusion", "planner", "selector", "tuning", "analysis",
-    "obs", "faults", "elastic", "parallel",
+    "obs", "faults", "elastic", "guard", "parallel",
     "allreduce",
     "broadcast", "reduce",
     "allgather", "reduce_scatter", "sendreceive", "alltoall", "gather",

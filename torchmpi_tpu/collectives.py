@@ -603,13 +603,17 @@ def _staged_leaf(cfg, op_name: str, x, n: int, params: dict):
     worker, an already-staged host master wrapped in
     :class:`_RestageView` so each fault-layer attempt still re-stages a
     fresh writable copy."""
-    if cfg is not None and cfg.faults != "off":
+    wire = cfg is not None and cfg.guard in ("wire", "full")
+    if (cfg is not None and cfg.faults != "off") or wire:
         from . import faults
 
         # Injection + retry policy around both staging legs
-        # (sites host_staged.gather/scatter — docs/FAULTS.md);
-        # off is one string compare, the module never imported.
-        return faults.staged_exchange(op_name, x, n, params, _host_staged)
+        # (sites host_staged.gather/scatter — docs/FAULTS.md); the
+        # wire guard (docs/GUARD.md) brackets each leg with a sender
+        # digest verified at the receiver, riding the same retry loop.
+        # Off is one string compare each, the modules never imported.
+        return faults.staged_exchange(op_name, x, n, params, _host_staged,
+                                      wire_guard=wire)
     return _host_staged(op_name, np.asarray(x), n, **params)
 
 
@@ -1047,7 +1051,8 @@ def _staged_async_work(op_name: str, leaves, treedef, n: int, m: Mesh,
     handles complete in dispatch order."""
     outs = []
     sharding = _rank_major_sharding(m)
-    faults_on = cfg is not None and cfg.faults != "off"
+    faults_on = cfg is not None and (cfg.faults != "off"
+                                     or cfg.guard in ("wire", "full"))
     for v in leaves:
         _obs_record_eager(cfg, op_name, v, m)
         if donate and isinstance(v, jax.Array):

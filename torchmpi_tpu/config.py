@@ -256,6 +256,39 @@ class Config:
     # Env: TORCHMPI_TPU_ELASTIC_DEADLINE.
     elastic_deadline_s: float = 30.0
 
+    # --- payload integrity + numeric anomaly guard ---------------------------
+    # torchmpi_tpu.guard (docs/GUARD.md): "off" (default — the module is
+    # never imported, plan build pays one string compare, the planned
+    # dispatch path gains zero branches; same discipline as
+    # ``analysis``/``obs``/``faults``), "wire" (blake2b digests over
+    # every host-staged payload and PS exchange, computed at the sender
+    # and verified at the receiver; a mismatch raises a typed
+    # ``IntegrityError`` the fault policy retries by re-staging from
+    # the device buffers), "numeric" (an all-finite + norm-bound
+    # tripwire fused into the synced-gradient paths — gradsync, overlap
+    # buckets, ZeRO shard legs — one fused reduction per bucket), or
+    # "full" (both).  Env: TORCHMPI_TPU_GUARD.
+    guard: str = "off"
+    # What the numeric tripwire does on a tripped bucket:
+    # "skip_step" (zero the synced update and count it — training
+    # continues, ``tm_guard_skipped_step_total`` records the loss) or
+    # "raise" (a runtime NumericAnomalyError surfaces from the step).
+    # Env: TORCHMPI_TPU_GUARD_POLICY.
+    guard_numeric_policy: str = "skip_step"
+    # L2-norm ceiling per checked bucket for the numeric tripwire
+    # (compared against the fused sum-of-squares, so the finite check
+    # and the bound are ONE reduction).  0 disables the bound — the
+    # tripwire then checks finiteness only.
+    # Env: TORCHMPI_TPU_GUARD_NORM_BOUND.
+    guard_norm_bound: float = 0.0
+    # Rolling window (steps) of the loss-spike detector used by the
+    # anomaly-rewind driver (``guard.run_guarded`` /
+    # ``guard.LossSpikeDetector``).  Env: TORCHMPI_TPU_GUARD_WINDOW.
+    guard_spike_window: int = 16
+    # Trip threshold in MADs (median absolute deviations) above the
+    # rolling median.  Env: TORCHMPI_TPU_GUARD_THRESHOLD.
+    guard_spike_threshold: float = 8.0
+
     # --- fault injection + resilient dispatch -------------------------------
     # torchmpi_tpu.faults (docs/FAULTS.md): "off" (default — one string
     # compare per cross-host call site, the module is never imported;
@@ -375,6 +408,14 @@ class Config:
             elastic_poll_s=_env_float("TORCHMPI_TPU_ELASTIC_POLL", 0.05),
             elastic_deadline_s=_env_float("TORCHMPI_TPU_ELASTIC_DEADLINE",
                                           30.0),
+            guard=_env_str("TORCHMPI_TPU_GUARD", "off"),
+            guard_numeric_policy=_env_str("TORCHMPI_TPU_GUARD_POLICY",
+                                          "skip_step"),
+            guard_norm_bound=_env_float("TORCHMPI_TPU_GUARD_NORM_BOUND",
+                                        0.0),
+            guard_spike_window=_env_int("TORCHMPI_TPU_GUARD_WINDOW", 16),
+            guard_spike_threshold=_env_float("TORCHMPI_TPU_GUARD_THRESHOLD",
+                                             8.0),
             fault_retries=_env_int("TORCHMPI_TPU_FAULT_RETRIES", 2),
             fault_backoff_s=_env_float("TORCHMPI_TPU_FAULT_BACKOFF", 0.05),
             fault_deadline_s=_env_float("TORCHMPI_TPU_FAULT_DEADLINE",

@@ -183,6 +183,23 @@ class ElasticGang:
             self.view = MembershipView(epoch=epoch0, members=members,
                                        step=0)
         self.stats = {"shrinks": 0, "rejoins": 0, "reconciles": 0}
+        # The incarnation each member is CURRENTLY admitted at (the
+        # board's counter at adoption; docs/ELASTIC.md): a join request
+        # carrying a HIGHER incarnation for a sitting member is a new
+        # life of a rank whose previous death was never committed — the
+        # join itself is the death notice, and poll() shrinks first
+        # instead of admitting an ambiguous joiner.  A join already
+        # PENDING at the board's current counter when this driver
+        # starts (rank died, admitted a new life, and the driver
+        # restarted before seeing it) adopts the PRIOR incarnation: a
+        # live sitting member never posts an incarnation-carrying join,
+        # so the pending one must be a new life knocking (code review).
+        joins = self.board.join_details()
+        self._inc: Dict[int, int] = {}
+        for m in self.view.members:
+            inc = self.board.incarnation(m)
+            j = int(joins.get(m, {}).get("incarnation", 0) or 0)
+            self._inc[m] = min(inc, j - 1) if j >= max(1, inc) else inc
         # Recovery-agreement round counter: reset on every view change
         # so every participant — however it got here (survivor,
         # restarted driver, healed joiner) — derives the same tag
@@ -277,9 +294,19 @@ class ElasticGang:
                         led.record(_member_peer(m), ok=True)
             dead |= {m for m in self.view.members
                      if led.decide(_member_peer(m)) == "raise"}
+        details = self.board.join_details()
+        # A join from a rank STILL in the view under a NEWER incarnation
+        # is a twice-dead rank's fresh life (docs/ELASTIC.md): its
+        # previous death was never committed, and the join is the death
+        # notice — shrink the stale life out first; the next boundary's
+        # poll then sees an ordinary healed-joiner request.
+        for r, d in details.items():
+            if r in self.view.members and \
+                    int(d.get("incarnation", 0)) > self._inc.get(r, 0):
+                dead.add(r)
         if dead:
             return ("shrink", sorted(dead))
-        joins = [r for r in self.board.join_requests()
+        joins = [r for r in sorted(details)
                  if r not in self.view.members and r in self._dev_of
                  and self._joiner_alive(r)]
         if joins:
@@ -363,6 +390,10 @@ class ElasticGang:
         faults = _faults_mod()
         for r in joiners:
             self.board.clear_join(r)
+            # Adopt the life being admitted: later joins at the same
+            # incarnation are this life re-knocking, a HIGHER one is
+            # the next death notice.
+            self._inc[r] = self.board.incarnation(r)
             if faults is not None:
                 # A re-admitted member starts with a clean bill —
                 # its pre-death failure streak is stale evidence.
@@ -532,13 +563,23 @@ def admit(directory: str, rank: int, *,
           board_dir: Optional[str] = None,
           deadline_s: Optional[float] = None,
           poll_s: Optional[float] = None) -> MembershipView:
-    """The healed peer's half of a rejoin: post a join request on the
-    membership board and poll until a committed view containing
-    ``rank`` appears — the gang admits at its next step boundary, so
-    the returned ``view.step`` is the checkpoint step to restore (the
-    caller then re-enters :func:`run_elastic` with the full member
-    set).  Blocks up to ``deadline_s`` (default
-    ``Config.elastic_deadline_s``)."""
+    """The healed peer's half of a rejoin: bump this rank's per-life
+    **incarnation id** on the board, post a join request carrying it,
+    and poll until a committed view containing ``rank`` appears — the
+    gang admits at its next step boundary, so the returned
+    ``view.step`` is the checkpoint step to restore (the caller then
+    re-enters :func:`run_elastic` with the full member set).  Blocks up
+    to ``deadline_s`` (default ``Config.elastic_deadline_s``).
+
+    The incarnation bump is what makes a *twice-dead* rank safe
+    (docs/ELASTIC.md — the old stale-view-admission caveat, resolved):
+    every committed view at or below the epoch current when this life
+    started is treated as stale — even one that still lists ``rank``
+    because the survivors have not committed its previous death yet —
+    so admit only ever returns a view the gang committed AFTER seeing
+    this life's join.  The gang side (``ElasticGang.poll``) reads the
+    higher incarnation on a sitting member's join as the death notice
+    and shrinks the stale life out first."""
     import time
 
     cfg = _require_on()
@@ -548,16 +589,16 @@ def admit(directory: str, rank: int, *,
     deadline_s = (cfg.elastic_deadline_s if deadline_s is None
                   else float(deadline_s))
     poll_s = cfg.elastic_poll_s if poll_s is None else float(poll_s)
+    inc = board.bump_incarnation(rank)
     view = board.committed_view()
-    min_epoch = (view.epoch + 1) if view is not None \
-        and rank not in view.members else 0
-    board.request_join(rank)
+    min_epoch = (view.epoch + 1) if view is not None else 0
+    board.request_join(rank, incarnation=inc)
     t0 = time.monotonic()
     while True:
         # Heartbeat while waiting: the gang admits only joiners that
         # look ALIVE (a stale-heartbeat join is a joiner that crashed
         # after requesting — growing toward it would wedge the gang).
-        board.heartbeat(rank, epoch=-1, step=-1)
+        board.heartbeat(rank, epoch=-1, step=-1, incarnation=inc)
         view = board.committed_view()
         if view is not None and view.epoch >= min_epoch \
                 and int(rank) in view.members:

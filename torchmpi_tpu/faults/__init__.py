@@ -139,20 +139,15 @@ def reset() -> None:
 
 # ---------------------------------------------------------------------------
 # Telemetry: tm_fault_* through obs, when obs itself is active.  A
-# faults-only session must not import obs, so the lookup goes through
-# sys.modules (the MetricsLogger-mirror pattern).
+# faults-only session must not import obs, so the dispatch goes through
+# the ONE sys.modules-gated shim (utils/telemetry.py).
 # ---------------------------------------------------------------------------
 
 
 def _emit(action: str, site: str, *, kind: str = "", peer: str = "") -> None:
-    import sys
+    from ..utils import telemetry
 
-    mod = sys.modules.get("torchmpi_tpu.obs")
-    try:
-        if mod is not None and mod.active():
-            mod.record_fault(action, site, kind=kind, peer=peer)
-    except Exception:  # noqa: BLE001 — telemetry never fails a step
-        pass
+    telemetry.emit("record_fault", action, site, kind=kind, peer=peer)
 
 
 # ---------------------------------------------------------------------------
@@ -188,6 +183,13 @@ def fire(site: str, payload=None, peer: str = "") -> None:
         raise CorruptPayload(
             f"injected payload corruption at {site} (integrity check "
             f"failed)")
+    if rule.kind == "corrupt_silent":
+        # The silent production failure mode: bits flip, NOTHING is
+        # raised — with Config.guard="off" the corruption propagates
+        # and the run silently diverges; with "wire" the digest check
+        # detects it downstream (docs/GUARD.md).
+        corrupt_buffer(payload, p.seed, arrival)
+        return
     raise InjectedFailure(f"injected hard failure at {site}")
 
 
@@ -231,20 +233,72 @@ def run_site(site: str, attempt: Callable[[int], Any], *,
 
 
 def staged_exchange(op_name: str, x_dev, n: int, params: dict,
-                    compute: Callable) -> Any:
+                    compute: Callable, *, wire_guard: bool = False) -> Any:
     """The host-staged eager collective under injection + policy: the
     devices->host leg (``host_staged.gather``) and host->devices leg
     (``host_staged.scatter``) each fire per attempt; transient faults
     retry the WHOLE exchange (re-staging from the device buffers, which
     the faults cannot touch — that is what makes corrupt-then-heal
-    converge back to the bit-identical result)."""
+    converge back to the bit-identical result).
+
+    ``wire_guard=True`` (``Config.guard`` in ``wire``/``full`` —
+    docs/GUARD.md) brackets each leg with an end-to-end blake2b check:
+    the digest is taken the moment the payload is staged (sender) and
+    verified just before it is consumed (receiver), so corruption the
+    fault site did NOT announce — the ``corrupt_silent`` kind, or the
+    real thing — raises a typed transient :class:`~torchmpi_tpu.faults.
+    integrity.IntegrityError` this same retry loop heals."""
     import numpy as np
 
+    if wire_guard:
+        from . import integrity
+
+        watch = integrity.Watch("host_staged", "gang")
+
     def attempt(_i: int):
+        # A WRITABLE per-attempt staging copy: an injected corrupt must
+        # flip real bits in THIS attempt's buffer while the retry
+        # re-stages bit-identical from the untouched source (code
+        # review r6: a read-only staged copy made corrupt a silent
+        # no-op, and corrupt_silent would be a no-op twice over).
+        # np.asarray of a device array yields a read-only view — copy
+        # it; the async worker's _RestageView.__array__ already returns
+        # a fresh writable copy per call — don't copy twice (only
+        # collectives calls this, always with one of those two forms).
         xs = np.asarray(x_dev)
-        fire("host_staged.gather", payload=xs, peer="gang")
-        out = compute(op_name, xs, n, **params)
-        fire("host_staged.scatter", payload=out, peer="gang")
+        if not xs.flags.writeable:
+            xs = np.array(xs)
+        d_in = integrity.digest(xs) if wire_guard else None
+        try:
+            fire("host_staged.gather", payload=xs, peer="gang")
+            if wire_guard:
+                # Receiver side of the devices->host leg: the staged
+                # buffer is about to feed the host reduction.
+                integrity.verify("host_staged.gather", xs, d_in,
+                                 peer="gang")
+            out = compute(op_name, xs, n, **params)
+            # Same writability contract for the scatter leg: several
+            # host reductions return broadcast VIEWS (read-only, zero
+            # strides) — a corrupt there would silently flip nothing.
+            # ascontiguousarray is a no-op for the ops that already
+            # return fresh buffers, and the placement path re-runs it
+            # for free afterwards.
+            out = np.ascontiguousarray(out)
+            if not out.flags.writeable:
+                out = np.array(out)
+            d_out = integrity.digest(out) if wire_guard else None
+            fire("host_staged.scatter", payload=out, peer="gang")
+            if wire_guard:
+                # Receiver side of the host->devices leg: the result is
+                # about to be placed back onto the mesh.
+                integrity.verify("host_staged.scatter", out, d_out,
+                                 peer="gang")
+        except BaseException as e:
+            if wire_guard:
+                watch.note(e)
+            raise
+        if wire_guard:
+            watch.settle()
         return out
 
     return run_site("host_staged", attempt, peer="gang")
@@ -277,14 +331,55 @@ def aio_submit(submit: Callable[[], Any]) -> Any:
     return run_site("aio.submit", attempt, peer="aio")
 
 
-def ps_enqueue(peers: List[str], enqueue: Callable[[], Any]) -> Any:
-    """A PS client enqueue (send/receive) under injection + policy:
-    ``ps.request`` fires per attempt before the sockets are touched."""
+def ps_exchange_once(peers: List[str], stage: Optional[Callable[[], Any]],
+                     enqueue: Callable[..., Any], *,
+                     wire_guard: bool = False) -> Any:
+    """ONE staged PS enqueue: ``stage()`` materializes the flat host
+    payload (None for payload-free exchanges like receive), the
+    ``ps.request`` site fires on it, and — with the wire guard armed —
+    the payload's sender digest is verified at the transport handoff
+    before ``enqueue(payload)`` hands it to the native layer.  Not
+    retried here: the caller composes it under :func:`ps_enqueue`
+    (first enqueue) or :func:`ps_wait` (retransmits), so every attempt
+    re-stages and re-verifies."""
     peer = ",".join(peers)
+    payload = stage() if stage is not None else None
+    if wire_guard and payload is not None:
+        from . import integrity
+
+        d = integrity.digest(payload)
+        fire("ps.request", payload=payload, peer=peer)
+        integrity.verify("ps.request", payload, d, peer=peer)
+    else:
+        fire("ps.request", payload=payload, peer=peer)
+    return enqueue(payload) if stage is not None else enqueue()
+
+
+def ps_enqueue(peers: List[str], enqueue: Callable[..., Any], *,
+               stage: Optional[Callable[[], Any]] = None,
+               wire_guard: bool = False) -> Any:
+    """A PS client enqueue (send/receive) under injection + policy:
+    ``ps.request`` fires per attempt before the sockets are touched.
+    With ``stage`` the payload is re-staged per attempt (the retry
+    contract that makes corrupt-then-heal converge) and — under
+    ``wire_guard`` — digest-verified at the transport handoff."""
+    peer = ",".join(peers)
+    if wire_guard:
+        from . import integrity
+
+        watch = integrity.Watch("ps.request", peer)
 
     def attempt(_i: int):
-        fire("ps.request", peer=peer)
-        return enqueue()
+        try:
+            out = ps_exchange_once(peers, stage, enqueue,
+                                   wire_guard=wire_guard)
+        except BaseException as e:
+            if wire_guard:
+                watch.note(e)
+            raise
+        if wire_guard:
+            watch.settle()
+        return out
 
     return run_site("ps.request", attempt, peer=peer)
 
@@ -297,7 +392,10 @@ def ps_wait(peers: List[str], make_handle: Callable[[], Any],
     exchange via ``make_handle`` — a retransmit, not a re-wait, because
     the native future is consumed by its failure.  Peer health is
     recorded per shard endpoint from the handle's failure index, and a
-    peer the ledger already calls dead stops the retransmit loop."""
+    peer the ledger already calls dead stops the retransmit loop.
+    ``make_handle`` owns the ``ps.request`` fire (it routes through
+    :func:`ps_exchange_once`, so a retransmit re-stages — and under
+    the wire guard re-verifies — exactly like a first send)."""
     state = {"handle": first_handle}
     peer_all = ",".join(peers)
 
@@ -311,7 +409,6 @@ def ps_wait(peers: List[str], make_handle: Callable[[], Any],
                     "ps.response", peer=doomed[0],
                     deadline_s=_policy.deadline_s,
                     flight_tail=flight_tail())
-            fire("ps.request", peer=peer_all)
             state["handle"] = make_handle()
         fire("ps.response", peer=peer_all)
         h = state["handle"]

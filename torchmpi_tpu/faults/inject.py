@@ -26,6 +26,13 @@ Kinds model the failures a benign-fabric port never had to survive:
 - ``corrupt``  — flip bits in the staged payload (when the site carries
   one), then :class:`CorruptPayload` ("checksum mismatch"): transient,
   so a bounded ``max_hits`` makes it corrupt-then-heal.
+- ``corrupt_silent`` — flip bits in the staged payload and raise
+  NOTHING: the corruption a benign-fabric port never detects (a
+  bit-flipped host buffer, a torn PS payload).  Only meaningful on
+  payload-carrying sites (``PAYLOAD_SITES``; lint rejects the rest);
+  with ``Config.guard="off"`` the run silently diverges, with
+  ``"wire"`` the digest check detects it and the retry heals —
+  docs/GUARD.md.
 - ``fail``     — :class:`InjectedFailure`: a hard peer death.  NOT
   transient; the policy never retries it.
 
@@ -70,7 +77,16 @@ SITES = (
     #                         ledger escalates healthy->suspect->dead
 )
 
-KINDS = ("delay", "drop", "corrupt", "fail")
+KINDS = ("delay", "drop", "corrupt", "corrupt_silent", "fail")
+
+# Sites whose ``fire()`` call passes a real writable payload buffer —
+# the only sites where a ``corrupt``/``corrupt_silent`` rule can flip
+# bits (and where the wire-integrity guard has something to digest).
+PAYLOAD_SITES = (
+    "host_staged.gather",
+    "host_staged.scatter",
+    "ps.request",
+)
 
 
 class FaultError(RuntimeError):
@@ -266,12 +282,17 @@ def lint_plan(plan: FaultPlan) -> List[str]:
                 f"site (known: {', '.join(SITES)})")
         if rule.max_hits == 0:
             problems.append(f"rule {i}: max_hits=0 never fires")
-        if rule.kind == "corrupt" and matched and all(
-                s in ("runtime.barrier", "serving.replica",
-                      "elastic.member") for s in matched):
+        if rule.kind == "corrupt" and matched and not any(
+                s in PAYLOAD_SITES for s in matched):
             problems.append(
                 f"rule {i}: corrupt at {matched} has no payload to flip "
                 f"(raises CorruptPayload without mutating anything)")
+        if rule.kind == "corrupt_silent" and matched and not any(
+                s in PAYLOAD_SITES for s in matched):
+            problems.append(
+                f"rule {i}: corrupt_silent at {matched} has no payload "
+                f"to flip — the rule is a total no-op (payload sites: "
+                f"{', '.join(PAYLOAD_SITES)})")
     return problems
 
 

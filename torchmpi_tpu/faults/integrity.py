@@ -1,0 +1,144 @@
+"""Wire integrity: blake2b digests over host-staged payloads
+(docs/GUARD.md — the ``Config.guard="wire"`` half of torchmpi_tpu.guard).
+
+Every surface that leaves the device fabric stages its payload through
+host memory — the eager staged collectives (devices -> host -> devices)
+and the parameter-server client (tree -> flat f32 -> native transport).
+TCP checksums the sockets and the device fabric checksums its links;
+the *staged host buffer in between* is the window nothing covers, and
+the failure mode there is silent: a flipped bit propagates through the
+reduction and poisons every rank with no typed error to retry.
+
+This module closes that window: :func:`digest` is computed over the
+payload at the **sender** boundary (the moment it is staged), and
+:func:`verify` re-hashes at the **receiver** boundary (just before the
+payload is consumed — the host compute, the native enqueue).  A
+mismatch raises :class:`IntegrityError`, a *transient* fault: the PR 5
+retry policy re-runs the whole exchange, which re-stages from the
+device buffers the corruption cannot touch — the same
+corrupt-then-heal contract the injected ``corrupt`` kind proved, now
+for corruption we did NOT inject (the ``corrupt_silent`` chaos kind is
+its deterministic test double).
+
+Only ever imported when ``Config.guard`` is ``"wire"``/``"full"`` —
+the ``analysis``/``obs``/``faults`` import discipline; ``guard="off"``
+is one string compare at plan build and this module never loads.
+Telemetry (``tm_guard_*`` counters, per-site verify-latency
+histograms, ``guard`` flight events carrying the digest so
+``obs_tool blame`` can name the first rank whose digest diverged)
+rides :mod:`torchmpi_tpu.obs` through ``sys.modules`` when obs is
+active.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+from typing import Optional
+
+import numpy as np
+
+from ..utils import telemetry
+from .inject import TransientFault
+
+DIGEST_BYTES = 16
+
+
+class IntegrityError(TransientFault):
+    """A staged payload failed its end-to-end digest check: bits
+    changed between the sender's staging and the receiver's consume.
+    Transient — a retry re-stages from the device buffers — and
+    carries ``site``/``peer``/``bucket`` so the policy layer's health
+    ledger and ``obs_tool blame`` can attribute the corruption."""
+
+    def __init__(self, site: str, *, peer: str = "", bucket: int = 0,
+                 expect: str = "", got: str = ""):
+        self.site = site
+        self.peer = peer
+        self.bucket = int(bucket)
+        self.expect = expect
+        self.got = got
+        peer_s = f" (peer {peer})" if peer else ""
+        super().__init__(
+            f"{site}{peer_s}: payload integrity check failed — digest "
+            f"{got[:12]} != staged {expect[:12]} (bucket {bucket}); "
+            f"bits changed between staging and consume")
+
+
+def digest(buf) -> str:
+    """blake2b hex digest over a numpy payload's bytes (+ shape/dtype,
+    so a torn reshape cannot alias a clean buffer).  One pass, no
+    copy for C-contiguous buffers."""
+    a = np.asarray(buf)
+    h = hashlib.blake2b(digest_size=DIGEST_BYTES)
+    h.update(str(a.dtype).encode())
+    h.update(str(a.shape).encode())
+    if not a.flags.c_contiguous:
+        a = np.ascontiguousarray(a)
+    h.update(a.view(np.uint8).reshape(-1).data)
+    return h.hexdigest()
+
+
+def verify(site: str, buf, expect: str, *, peer: str = "",
+           bucket: int = 0) -> str:
+    """Receiver-side check: re-hash ``buf`` and compare with the
+    sender's ``expect``.  Records the verify latency per site and a
+    ``guard`` flight event carrying the digest (the cross-host
+    evidence ``obs_tool blame`` aligns); a mismatch bumps
+    ``tm_guard_verify_failed_total`` and raises
+    :class:`IntegrityError` (transient — the policy retries)."""
+    t0 = time.monotonic()
+    got = digest(buf)
+    nbytes = int(np.asarray(buf).nbytes)
+    _obs_latency(site, time.monotonic() - t0)
+    if got != expect:
+        record("verify_failed", site, peer=peer, digest=got,
+               nbytes=nbytes)
+        raise IntegrityError(site, peer=peer, bucket=bucket,
+                             expect=expect, got=got)
+    record("verified", site, peer=peer, digest=got, nbytes=nbytes)
+    return got
+
+
+def healed(site: str, *, peer: str = "") -> None:
+    """A retried exchange whose earlier attempt failed its digest check
+    just completed clean — the corrupt-then-heal close
+    (``tm_guard_healed_total``)."""
+    record("healed", site, peer=peer)
+
+
+def record(action: str, site: str, *, peer: str = "", digest: str = "",
+           nbytes: int = 0) -> None:
+    """tm_guard_* through obs, when obs itself is active (the shared
+    sys.modules-gated shim — a guard-only session must not import the
+    telemetry it reports to)."""
+    telemetry.emit("record_guard", action, site, peer=peer,
+                   digest=digest, nbytes=nbytes)
+
+
+def _obs_latency(site: str, seconds: float) -> None:
+    telemetry.emit("record_guard_latency", site, seconds)
+
+
+class Watch:
+    """Per-exchange heal tracker: counts integrity failures across an
+    exchange's attempts and emits ``healed`` when a later attempt
+    completes clean (the evidence the guard-smoke CI job asserts)."""
+
+    __slots__ = ("site", "peer", "failures")
+
+    def __init__(self, site: str, peer: str = ""):
+        self.site = site
+        self.peer = peer
+        self.failures = 0
+
+    def note(self, e: Optional[BaseException]) -> None:
+        if isinstance(e, IntegrityError):
+            self.failures += 1
+
+    def settle(self) -> None:
+        """Call on attempt success: emits healed if any prior attempt
+        failed its digest check."""
+        if self.failures:
+            healed(self.site, peer=self.peer)
+            self.failures = 0

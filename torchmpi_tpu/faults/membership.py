@@ -126,14 +126,20 @@ class Board:
 
     # -- heartbeats (the real-detection seam) ------------------------------
 
-    def heartbeat(self, rank: int, *, epoch: int, step: int) -> None:
+    def heartbeat(self, rank: int, *, epoch: int, step: int,
+                  incarnation: Optional[int] = None) -> None:
         """Record liveness: ``(epoch, step, wall ts)``.  A monitor (or a
         fellow member) that sees a heartbeat stop advancing has the
         same staleness signal ``examples/downpour_elastic.py``'s
-        monitor thread reads from its progress counters."""
-        self._write(f"hb_{int(rank)}.json",
-                    {"rank": int(rank), "epoch": int(epoch),
-                     "step": int(step), "ts": time.time()})
+        monitor thread reads from its progress counters.  A waiting
+        joiner's heartbeat also carries its per-life ``incarnation``
+        (``elastic.admit``), so the gang can tell which life is
+        knocking."""
+        payload = {"rank": int(rank), "epoch": int(epoch),
+                   "step": int(step), "ts": time.time()}
+        if incarnation is not None:
+            payload["incarnation"] = int(incarnation)
+        self._write(f"hb_{int(rank)}.json", payload)
 
     def heartbeats(self) -> Dict[int, dict]:
         out: Dict[int, dict] = {}
@@ -143,19 +149,47 @@ class Board:
                 out[int(d.get("rank", -1))] = d
         return out
 
+    # -- per-life incarnation ids (docs/ELASTIC.md) -------------------------
+    #
+    # Each call of ``elastic.admit`` bumps the rank's incarnation before
+    # posting its join, so a join request distinguishes "the life the
+    # gang already admitted" from "a NEW life of a rank whose previous
+    # death has not been committed yet" — the stale-view-admission
+    # ambiguity the pre-incarnation board could not resolve.
+
+    def incarnation(self, rank: int) -> int:
+        d = self._read(f"inc_{int(rank)}.json")
+        return int(d.get("incarnation", 0)) if d is not None else 0
+
+    def bump_incarnation(self, rank: int) -> int:
+        n = self.incarnation(rank) + 1
+        self._write(f"inc_{int(rank)}.json",
+                    {"rank": int(rank), "incarnation": n,
+                     "ts": time.time()})
+        return n
+
     # -- join requests (healed peers) --------------------------------------
 
-    def request_join(self, rank: int) -> None:
-        self._write(f"join_{int(rank)}.json",
-                    {"rank": int(rank), "ts": time.time()})
+    def request_join(self, rank: int,
+                     incarnation: Optional[int] = None) -> None:
+        payload = {"rank": int(rank), "ts": time.time()}
+        if incarnation is not None:
+            payload["incarnation"] = int(incarnation)
+        self._write(f"join_{int(rank)}.json", payload)
 
     def join_requests(self) -> List[int]:
-        out = []
+        return sorted(self.join_details())
+
+    def join_details(self) -> Dict[int, dict]:
+        """Join requests with their payloads (incarnation, timestamp) —
+        what :meth:`~torchmpi_tpu.elastic.ElasticGang.poll` reads to
+        tell a healed joiner from a twice-dead rank's new life."""
+        out: Dict[int, dict] = {}
         for name in self._ls("join_"):
             d = self._read(name)
             if d is not None:
-                out.append(int(d["rank"]))
-        return sorted(out)
+                out[int(d["rank"])] = d
+        return out
 
     def clear_join(self, rank: int) -> None:
         try:
@@ -163,6 +197,54 @@ class Board:
                                    f"join_{int(rank)}.json"))
         except OSError:
             pass
+
+    # -- rewind records (torchmpi_tpu.guard — docs/GUARD.md) ---------------
+    #
+    # The anomaly-rewind driver runs its agreement over this same board
+    # (the transport that is still standing when the step loop's
+    # numerics are exactly what broke): a tripped rank posts a rewind
+    # request, every rank joins the bounded two-phase verdict
+    # (guard.agree_rewind over post_value/values), and the committed
+    # outcome is recorded as a ``rewind_<round>.json`` record — the
+    # post-mortem row naming the step, the detection evidence, and any
+    # quarantined peer.  No membership/epoch state changes: a rewind
+    # restores a checkpoint in place, views and plans untouched.
+
+    def request_rewind(self, rank: int, *, step: int,
+                       stat: float = 0.0) -> None:
+        """A tripped rank's signal: makes the per-step board poll of the
+        untripped ranks cheap (one listdir) without them having to
+        enter the agreement every step."""
+        self._write(f"rewreq_{int(rank)}.json",
+                    {"rank": int(rank), "step": int(step),
+                     "stat": float(stat), "ts": time.time()})
+
+    def rewind_requests(self) -> Dict[int, dict]:
+        out: Dict[int, dict] = {}
+        for name in self._ls("rewreq_"):
+            d = self._read(name)
+            if d is not None:
+                out[int(d.get("rank", -1))] = d
+        return out
+
+    def clear_rewind_request(self, rank: int) -> None:
+        try:
+            os.remove(os.path.join(self.directory,
+                                   f"rewreq_{int(rank)}.json"))
+        except OSError:
+            pass
+
+    def post_rewind_record(self, round_no: int, payload: dict) -> None:
+        self._write(f"rewind_{int(round_no)}.json",
+                    dict(payload, round=int(round_no), ts=time.time()))
+
+    def rewind_records(self) -> List[dict]:
+        out = []
+        for name in self._ls("rewind_"):
+            d = self._read(name)
+            if d is not None:
+                out.append(d)
+        return sorted(out, key=lambda d: int(d.get("round", 0)))
 
     # -- two-phase state ---------------------------------------------------
     #

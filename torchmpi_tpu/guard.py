@@ -1,0 +1,507 @@
+"""Guard: end-to-end payload integrity + numeric anomaly detection with
+agreed rewind-to-checkpoint (docs/GUARD.md).
+
+Every robustness layer so far handles failures that *announce
+themselves* — a raised ``CorruptPayload``, a dead heartbeat, a
+``PeerTimeoutError``.  The production failure mode the at-least-once PS
+semantics and the DCN transport both invite is **silent**: a
+bit-flipped host-staged buffer, a torn PS payload, or a
+numerically-diverging step propagates through
+``synchronize_gradients`` and poisons every rank with no typed error to
+retry.  ``Config.guard`` arms three layers against it:
+
+- **wire** — blake2b digests over every host-staged payload and PS
+  exchange, computed at the sender and verified at the receiver
+  (:mod:`torchmpi_tpu.faults.integrity`); a mismatch is a typed
+  *transient* ``IntegrityError`` the PR 5 policy retries by re-staging
+  from the device buffers, feeding ``HealthLedger`` attribution and
+  ``tm_guard_*`` telemetry.
+- **numeric** — an all-finite + norm-bound tripwire fused into the
+  synced-gradient paths (gradsync, the overlap buckets' custom_vjp
+  rules, the ZeRO shard legs): ONE fused sum-of-squares reduction per
+  bucket (finite iff the sum is finite; the norm bound compares against
+  the same scalar), jit-compatible, policy ``skip_step`` (zero the
+  update, count it) or ``raise``.
+- **full** — both, plus this module's anomaly-rewind driver
+  (:func:`run_guarded`): a rolling median/MAD loss-spike detector in
+  the step loop; on trip, ranks reach agreement through the PR 10
+  membership board (a bounded two-phase verdict + a new ``rewind``
+  record) and restore the last fsync-verified ``restart.recover`` step
+  *in place* — view, mesh, and every cached CollectivePlan untouched,
+  no config-epoch bump — optionally quarantining an implicated peer
+  via the ``HealthLedger``.
+
+Off by default and **never imported when off** — the
+``analysis``/``obs``/``faults`` import discipline: ``guard="off"``
+costs one string compare at plan build / trace time, the planned
+dispatch path gains zero branches, and ``import torchmpi_tpu`` never
+imports this module (``tests/test_guard.py`` asserts all three).
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import sys
+import threading
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from . import runtime
+from .utils import checkpoint, restart, telemetry
+
+PyTree = Any
+
+MODES = ("off", "wire", "numeric", "full")
+
+# Agreement sentinel for "this rank did not trip" — far above any real
+# step index, so the min over the gang is a trip step iff anyone
+# tripped.
+_NO_TRIP = 1 << 62
+
+
+class NumericAnomalyError(RuntimeError):
+    """The numeric tripwire (policy ``raise``) or the rewind budget
+    tripped: a synced-gradient bucket was non-finite / out of bound, or
+    loss spikes kept recurring past ``max_rewinds``."""
+
+    def __init__(self, site: str, *, bucket: int = 0,
+                 stat: float = float("nan"), msg: str = ""):
+        self.site = site
+        self.bucket = int(bucket)
+        self.stat = float(stat)
+        super().__init__(
+            msg or f"numeric anomaly at {site} (bucket {bucket}): "
+                   f"sum-of-squares {stat!r} failed the finite/bound "
+                   f"check")
+
+
+# ---------------------------------------------------------------------------
+# Module stats (tests + operator spot checks without obs armed)
+# ---------------------------------------------------------------------------
+
+_lock = threading.Lock()
+_stats = {"numeric_trips": 0, "skipped_steps": 0, "rewinds": 0}
+_pending: List[NumericAnomalyError] = []
+
+
+def stats() -> Dict[str, int]:
+    with _lock:
+        return dict(_stats)
+
+
+def reset_stats() -> None:
+    with _lock:
+        for k in _stats:
+            _stats[k] = 0
+        _pending.clear()
+
+
+def pending() -> int:
+    """Deferred anomalies queued by the ``raise`` policy (see
+    :func:`raise_pending`)."""
+    with _lock:
+        return len(_pending)
+
+
+def raise_pending() -> None:
+    """Raise (and clear) the oldest deferred :class:`NumericAnomalyError`.
+
+    The ``raise`` policy cannot raise from inside the compiled step —
+    an exception thrown in a jax debug callback permanently errors the
+    runtime's effects token, wedging every later dispatch in the
+    process — so the tripped bucket is zeroed in-graph (the poisoned
+    update never applies, exactly like ``skip_step``) and the typed
+    error is queued here for the next eager boundary.
+    ``nn.data_parallel_step`` and :func:`run_guarded` call this after
+    every step when the guard is armed; hand-rolled step loops call it
+    themselves.  No-op when nothing tripped."""
+    with _lock:
+        if not _pending:
+            return
+        e = _pending[0]
+        _pending.clear()
+    raise e
+
+
+def _bump(key: str) -> None:
+    with _lock:
+        _stats[key] += 1
+
+
+def _record(action: str, site: str, *, peer: str = "") -> None:
+    """tm_guard_* through obs when active (the shared sys.modules-gated
+    shim — the guard never imports the telemetry it reports to)."""
+    telemetry.emit("record_guard", action, site, peer=peer)
+
+
+# ---------------------------------------------------------------------------
+# Layer 2: the numeric tripwire (fused into the synced-grad paths)
+# ---------------------------------------------------------------------------
+
+
+def _on_trip(site: str, bucket: int, policy: str) -> Callable:
+    """Runtime half of one fused check: fires per device when the
+    bucket's scalar verdict materializes (jax.debug.callback — the
+    obs.record_overlap pattern)."""
+
+    def cb(ok, ss) -> None:
+        if bool(ok):
+            return
+        _bump("numeric_trips")
+        _record("numeric_tripped", site)
+        if policy == "skip_step":
+            _bump("skipped_steps")
+            _record("skipped_step", site)
+            return
+        err = NumericAnomalyError(site, bucket=bucket, stat=float(ss))
+        with _lock:
+            _pending.append(err)
+
+    return cb
+
+
+def _verdict(ss, norm_bound: float):
+    """The fused verdict from one sum-of-squares scalar: finite iff the
+    sum is finite (any NaN/Inf element poisons it), and — with a bound
+    — ``ss <= bound**2`` rides the SAME scalar, so the whole tripwire
+    is one reduction per bucket."""
+    ok = jnp.isfinite(ss)
+    if norm_bound > 0:
+        ok = jnp.logical_and(ok, ss <= jnp.float32(float(norm_bound) ** 2))
+    return ok
+
+
+def check_flat(flat, *, site: str, bucket: int = 0,
+               policy: Optional[str] = None,
+               norm_bound: Optional[float] = None,
+               aux: Optional[List[Tuple[Any, Any]]] = None):
+    """Numeric tripwire over one flat (already-synced) bucket — the
+    form the overlap custom_vjp rules and the ZeRO shard legs fuse in.
+    Trace-time gated by the caller (``Config.guard`` in
+    ``numeric``/``full``); jit-compatible.  The tripped bucket comes
+    back ZEROED under both policies — the poisoned update must never
+    apply — and ``skip_step`` counts it
+    (``tm_guard_skipped_step_total``) while ``raise`` queues a typed
+    :class:`NumericAnomalyError` for the next eager boundary
+    (:func:`raise_pending`).
+
+    ``aux`` is a list of ``(value, fallback)`` array pairs selected
+    under the SAME verdict — value when clean, fallback when tripped.
+    This is the error-feedback residual contract: a tripped round's
+    residuals revert to the pre-step state (as if the round never
+    happened) instead of carrying the poisoned error mass into the
+    next step's quantized leg.  With ``aux``, returns
+    ``(flat, aux_values)``."""
+    cfg = runtime.effective_config()
+    if policy is None:
+        policy = cfg.guard_numeric_policy
+    if norm_bound is None:
+        norm_bound = cfg.guard_norm_bound
+    ss = jnp.sum(jnp.square(flat.astype(jnp.float32)))
+    ok = _verdict(ss, norm_bound)
+    jax.debug.callback(_on_trip(site, bucket, policy), ok, ss)
+    out = jnp.where(ok, flat, jnp.zeros_like(flat))
+    if aux is None:
+        return out
+    return out, [jnp.where(ok, v, fb) for v, fb in aux]
+
+
+def check_tree(tree, *, site: str, policy: Optional[str] = None,
+               norm_bound: Optional[float] = None,
+               aux: Optional[List[Tuple[Any, Any]]] = None):
+    """Numeric tripwire over a synced gradient pytree (the
+    ``synchronize_gradients`` output): per-leaf sums of squares fold
+    into ONE scalar verdict — a single fused reduction for the whole
+    sync round — and a trip zeroes every leaf together (a half-zeroed
+    update would be a worse poison than the anomaly; the ``raise``
+    policy defers its typed error to :func:`raise_pending`).  ``aux``
+    as in :func:`check_flat` (the EF-residual revert contract); with
+    it, returns ``(tree, aux_values)``."""
+    leaves, treedef = jax.tree.flatten(tree)
+    if not leaves:
+        return tree if aux is None else (tree, [v for v, _ in aux])
+    cfg = runtime.effective_config()
+    if policy is None:
+        policy = cfg.guard_numeric_policy
+    if norm_bound is None:
+        norm_bound = cfg.guard_norm_bound
+    ss = jnp.float32(0)
+    for leaf in leaves:
+        ss = ss + jnp.sum(jnp.square(leaf.astype(jnp.float32)))
+    ok = _verdict(ss, norm_bound)
+    jax.debug.callback(_on_trip(site, 0, policy), ok, ss)
+    leaves = [jnp.where(ok, v, jnp.zeros_like(v)) for v in leaves]
+    out = jax.tree.unflatten(treedef, leaves)
+    if aux is None:
+        return out
+    return out, [jnp.where(ok, v, fb) for v, fb in aux]
+
+
+# ---------------------------------------------------------------------------
+# Layer 3: loss-spike detection + agreed rewind-to-checkpoint
+# ---------------------------------------------------------------------------
+
+
+class LossSpikeDetector:
+    """Rolling median/MAD spike detector over the step-loop loss.
+
+    ``update(loss)`` returns True when the loss is non-finite, or —
+    once ``min_history`` observations accumulated — when it exceeds the
+    rolling median by ``threshold`` median-absolute-deviations.  The
+    MAD has a relative floor (1% of ``max(1, |median|)``) so a
+    perfectly flat history cannot make noise trip the detector; a
+    tripped value is NOT appended (the spike must not poison the very
+    window that detected it).  Defaults come from
+    ``Config.guard_spike_window`` / ``guard_spike_threshold``.
+    """
+
+    def __init__(self, window: Optional[int] = None,
+                 threshold: Optional[float] = None,
+                 min_history: int = 5):
+        cfg = runtime.effective_config()
+        self.window = int(window if window is not None
+                          else cfg.guard_spike_window)
+        self.threshold = float(threshold if threshold is not None
+                               else cfg.guard_spike_threshold)
+        self.min_history = int(min_history)
+        if self.window < 2 or self.threshold <= 0 or self.min_history < 2:
+            raise ValueError(
+                f"need window >= 2, threshold > 0, min_history >= 2; got "
+                f"{self.window}/{self.threshold}/{self.min_history}")
+        self._hist: deque = deque(maxlen=self.window)
+        self.last_stat = 0.0  # deviation (in MADs) of the last update
+
+    @staticmethod
+    def _median(vals: List[float]) -> float:
+        s = sorted(vals)
+        n = len(s)
+        mid = n // 2
+        return s[mid] if n % 2 else 0.5 * (s[mid - 1] + s[mid])
+
+    def update(self, loss) -> bool:
+        v = float(loss)
+        if not math.isfinite(v):
+            self.last_stat = float("inf")
+            return True
+        if len(self._hist) >= self.min_history:
+            med = self._median(list(self._hist))
+            mad = self._median([abs(x - med) for x in self._hist])
+            scale = max(mad, 0.01 * max(1.0, abs(med)))
+            self.last_stat = (v - med) / scale
+            if self.last_stat > self.threshold:
+                return True
+        self._hist.append(v)
+        return False
+
+    def reset(self) -> None:
+        self._hist.clear()
+        self.last_stat = 0.0
+
+
+def _require_on():
+    """Consent gate of the driver-layer entry points (the elastic
+    pattern: the knob gates the driver, the dispatch path has no branch
+    on it)."""
+    cfg = runtime.effective_config()
+    if cfg.guard == "off":
+        raise RuntimeError(
+            "torchmpi_tpu.guard requires Config.guard != 'off' (or "
+            "TORCHMPI_TPU_GUARD=wire|numeric|full) — the guard layer is "
+            "opt-in; see docs/GUARD.md")
+    return cfg
+
+
+def quarantine(peer: str, *, site: str = "rewind") -> bool:
+    """Pin ``peer`` dead in the armed fault layer's ``HealthLedger`` —
+    the optional attribution half of a rewind: the implicated peer
+    stops receiving traffic (PS routing, elastic membership) until a
+    successful probe resurrects it.  Returns True iff a ledger was
+    actually written; with faults unarmed this is a no-op that reports
+    False and emits NOTHING (telemetry must never claim an isolation
+    that did not happen)."""
+    mod = sys.modules.get("torchmpi_tpu.faults")
+    if mod is None or not mod.active():
+        return False
+    led = mod.ledger()
+    for _ in range(led.dead_after):
+        led.record(peer, ok=False)
+    _record("quarantined", site, peer=peer)
+    return True
+
+
+def agree_rewind(board, tag: str, local_ranks: Sequence[int],
+                 members: Sequence[int], trip_step: Optional[int], *,
+                 deadline_s: float, poll_s: float) -> Optional[int]:
+    """Bounded two-phase rewind verdict over the membership board
+    (docs/GUARD.md): phase 1 *proposes* — every rank posts the step it
+    tripped at (or the no-trip sentinel) and the bounded min resolves
+    to the earliest trip; phase 2 *commits* — every rank acknowledges
+    the resolved verdict, so no rank can rewind while another proceeds
+    (the same propose-then-commit shape as ``membership.reconcile``,
+    minus any view/epoch change).  Returns the agreed trip step, or
+    None when nobody tripped (a stale request)."""
+    from .faults import membership
+
+    value = _NO_TRIP if trip_step is None else int(trip_step)
+    prop = membership.agree_min(board, tag + "p", local_ranks, members,
+                                value, deadline_s=deadline_s,
+                                poll_s=poll_s)
+    # Commit: every rank posts the verdict it resolved; the min of
+    # identical values is the value — reaching it proves every member
+    # saw (and will act on) the same outcome.
+    membership.agree_min(board, tag + "c", local_ranks, members,
+                         int(prop), deadline_s=deadline_s, poll_s=poll_s)
+    return None if prop >= _NO_TRIP else int(prop)
+
+
+def run_guarded(init_fn: Callable[[], PyTree],
+                step_fn: Callable[[PyTree, int], Tuple[PyTree, Any]],
+                *, steps: int, directory: str, save_every: int = 10,
+                detector: Optional[LossSpikeDetector] = None,
+                max_rewinds: int = 3,
+                board_dir: Optional[str] = None,
+                members: Optional[Sequence[int]] = None,
+                participants: Optional[int] = None,
+                agree: Optional[Callable[[int], int]] = None,
+                implicate: Optional[str] = None,
+                ) -> Tuple[PyTree, Dict[str, Any]]:
+    """Run ``steps`` calls of ``step_fn(state, i) -> (state, loss)``
+    under the anomaly-rewind guard (docs/GUARD.md).
+
+    Per step the loss feeds the :class:`LossSpikeDetector`; on a trip
+    the gang reaches a bounded two-phase verdict over the membership
+    board (:func:`agree_rewind` — a ``rewind`` record lands next to
+    the reconcile history) and restores the last fsync-verified
+    checkpoint via :func:`restart.recover` **in place**: the view, the
+    mesh, and every cached CollectivePlan are untouched and the config
+    epoch does not move (asserted in tests/test_guard.py) — a rewind
+    is a state restore, not a re-plan.  ``implicate`` optionally
+    quarantines a peer in the ``HealthLedger`` at each rewind.  Every
+    rank of a multi-process gang must call this collectively (the
+    ``restart.recover`` contract); the single-process sim degrades to
+    a trivially-agreeing board.  A trip that keeps recurring past
+    ``max_rewinds`` raises :class:`NumericAnomalyError` — rewinding
+    forever over a deterministically-poisoned input would be the
+    silent failure this module exists to end.
+
+    Returns ``(state, info)`` with ``info`` carrying ``rewinds`` /
+    ``trip_steps`` / ``steps_run`` / ``recovered_step``.
+    """
+    cfg = _require_on()
+    if steps < 0:
+        raise ValueError(f"steps must be >= 0, got {steps}")
+    from .faults import membership
+
+    det = detector if detector is not None else LossSpikeDetector()
+    board = membership.Board(board_dir
+                             or os.path.join(directory, "membership"))
+    multi = jax.process_count() > 1
+    local: Tuple[int, ...] = (jax.process_index(),) if multi else (0,)
+    if members is None:
+        members = (tuple(range(jax.process_count())) if multi else (0,))
+    members = tuple(sorted(int(m) for m in members))
+    if participants is None:
+        participants = len(members) if multi else 1
+    deadline_s = float(cfg.elastic_deadline_s)
+    poll_s = float(cfg.elastic_poll_s)
+
+    # A previous life's in-flight protocol state must not poison this
+    # one (the ElasticGang construction-time discipline): drop our own
+    # agreement values and any stale rewind request; continue the round
+    # numbering past recorded rewinds so a restarted driver neither
+    # resolves a dead life's values nor overwrites its post-mortem
+    # records (every rank reads the same records, so the numbering
+    # stays lockstep).
+    for r in local:
+        board.clear_values(r)
+        board.clear_rewind_request(r)
+    rounds = max([int(d.get("round", 0))
+                  for d in board.rewind_records()] or [0])
+
+    template = init_fn()
+    state, i = restart.recover(init_fn, directory, template,
+                               participants=participants, agree=agree)
+    recovered_step = i
+    rewinds = 0
+    steps_run = 0
+    trip_steps: List[int] = []
+
+    def commit_rewind(agreed: int):
+        nonlocal rewinds, recovered_step, state, i
+        rewinds += 1
+        trip_steps.append(agreed)
+        _bump("rewinds")
+        _record("rewind", "loss_spike")
+        quarantined = bool(implicate) and quarantine(implicate)
+        board.post_rewind_record(rounds, {
+            "step": int(agreed), "stat": float(det.last_stat),
+            "peer": implicate or "",
+            "quarantined": quarantined,
+            "members": list(members)})
+        if rewinds > max_rewinds:
+            raise NumericAnomalyError(
+                "loss_spike", stat=det.last_stat,
+                msg=f"loss spike at step {agreed} kept recurring "
+                    f"past the rewind budget ({max_rewinds})")
+        state, i = restart.recover(
+            init_fn, directory, template,
+            participants=participants, agree=agree)
+        recovered_step = i
+        # Fresh eyes after the restore: the rolled-back segment's
+        # losses would otherwise sit in the window while the replay
+        # re-appends the same steps — duplicated history collapses the
+        # MAD and makes the post-rewind detector more trigger-happy
+        # than the configured threshold (code review).  The cost is
+        # min_history steps of detection grace after each rewind.
+        det.reset()
+
+    while True:
+        while i < steps:
+            state, loss = step_fn(state, i)
+            steps_run += 1
+            raise_pending()  # the tripwire's raise-policy boundary
+            tripped = det.update(loss)
+            if multi and tripped:
+                board.request_rewind(local[0], step=i,
+                                     stat=det.last_stat)
+            pending = tripped or (multi
+                                  and bool(board.rewind_requests()))
+            if pending:
+                rounds += 1
+                agreed = agree_rewind(
+                    board, f"rw{rounds}", local, members,
+                    i if tripped else None,
+                    deadline_s=deadline_s, poll_s=poll_s)
+                for r in local:
+                    board.clear_rewind_request(r)
+                if agreed is not None:
+                    commit_rewind(agreed)
+                    continue
+            i += 1
+            if i % save_every == 0 or i == steps:
+                checkpoint.save(directory, state, step=i)
+        if not multi:
+            break
+        # Closing agreement: a peer whose detector tripped at its FINAL
+        # step is blocked in a round this rank's per-step poll may have
+        # missed (the request landed after our last listdir) — every
+        # rank joins one more round at exit, so no rank can return
+        # while another waits on it.  The round counter stays lockstep:
+        # the tripped peer's in-loop round and our closing round are
+        # the same tag.  A rewind verdict re-enters the step loop on
+        # every rank; a no-trip verdict ends the run everywhere.
+        rounds += 1
+        agreed = agree_rewind(board, f"rw{rounds}", local, members, None,
+                              deadline_s=deadline_s, poll_s=poll_s)
+        for r in local:
+            board.clear_rewind_request(r)
+        if agreed is None:
+            break
+        commit_rewind(agreed)
+    return state, {"rewinds": rewinds, "trip_steps": trip_steps,
+                   "steps_run": steps_run,
+                   "recovered_step": recovered_step}

@@ -447,6 +447,36 @@ def record_fault(action: str, site: str, *, kind: str = "",
         _recorder.append("fault", site, 0, kind, action)
 
 
+def record_guard(action: str, site: str, *, peer: str = "",
+                 digest: str = "", nbytes: int = 0) -> None:
+    """One ``torchmpi_tpu.guard`` event (docs/GUARD.md): ``action`` is
+    ``verified`` | ``verify_failed`` | ``healed`` | ``numeric_tripped``
+    | ``skipped_step`` | ``rewind`` | ``quarantined`` (counter
+    ``tm_guard_<action>_total{site,peer}``).  Wire verifies land in the
+    flight ring with the payload digest in the backend slot, so
+    ``obs_tool blame`` — which compares ``(ev, op, nbytes, backend)``
+    per seq across hosts — names the first rank whose digest diverged
+    from the gang's; failures/heals/rewinds always ride the ring as
+    post-mortem anchors."""
+    labels = {"site": site}
+    if peer:
+        labels["peer"] = peer
+    _registry.counter_inc(f"tm_guard_{action}_total", **labels)
+    if action in ("verified", "verify_failed", "healed",
+                  "numeric_tripped", "rewind", "quarantined"):
+        _recorder.append("guard", site, int(nbytes), digest[:12], action)
+
+
+def record_guard_latency(site: str, seconds: float) -> None:
+    """One wire-integrity digest verification: per-site latency in
+    MICROSECONDS (``tm_guard_verify_us{site}``; the
+    ``tm_tuning_measured_us`` convention so log2 buckets resolve
+    sub-millisecond hashes) — the measured cost model docs/GUARD.md
+    quotes per payload size."""
+    _registry.hist_observe("tm_guard_verify_us",
+                           max(1.0, float(seconds) * 1e6), site=site)
+
+
 def record_async(event: str, op: str, *, wait_s: Optional[float] = None,
                  nbytes: int = 0) -> None:
     """One :class:`~torchmpi_tpu.collectives.AsyncHandle` lifecycle

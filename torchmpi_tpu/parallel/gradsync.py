@@ -348,8 +348,22 @@ def synchronize_gradients(grads: PyTree, axis_names: Optional[AxisNames] = None,
             from .. import obs
 
             obs.record_gradsync(max(1, n_buckets), op, f"dcn-{codec}")
-        return _dcn_ef_allreduce(grads, axes, op=op, n_buckets=n_buckets,
-                                 codec=codec, residuals=residuals)
+        synced, new_res = _dcn_ef_allreduce(grads, axes, op=op,
+                                            n_buckets=n_buckets,
+                                            codec=codec,
+                                            residuals=residuals)
+        if cfg is not None and cfg.guard in ("numeric", "full"):
+            # Numeric tripwire on the synced output (docs/GUARD.md) —
+            # trace-time gate, one fused reduction; off adds nothing.
+            # The residuals revert to the PRE-step state under the same
+            # verdict: a tripped round's error mass must not re-enter
+            # the next step through the EF accumulator (code review).
+            from .. import guard
+
+            synced, new_res = guard.check_tree(
+                synced, site="gradsync",
+                aux=list(zip(new_res, residuals)))
+        return synced, new_res
     if cfg is not None and cfg.obs != "off":
         from .. import obs
 
@@ -366,6 +380,15 @@ def synchronize_gradients(grads: PyTree, axis_names: Optional[AxisNames] = None,
                                   backend=backend, barrier=barrier)
     if orig_dtypes is not None:
         out = jax.tree.map(lambda g, d: g.astype(d), out, orig_dtypes)
+    if cfg is not None and cfg.guard in ("numeric", "full"):
+        # Numeric tripwire fused onto the synced gradients
+        # (docs/GUARD.md): one sum-of-squares reduction over the round;
+        # skip_step zeroes the whole update when tripped, raise
+        # surfaces NumericAnomalyError.  Trace-time gate — guard="off"
+        # adds zero branches to the compiled step.
+        from .. import guard
+
+        out = guard.check_tree(out, site="gradsync")
     return out
 
 
@@ -508,8 +531,21 @@ def _make_bucket_sync(idx: int, total: int, axes: Tuple[str, ...],
                 flat, outer, inner, dcn_codec, res, op=op,
                 min_bytes=runtime.effective_config()
                 .dcn_compress_min_bytes)
-            out, tok_out = _post(red.astype(flat.dtype), tok, shapes,
-                                 sizes)
+            red = red.astype(flat.dtype)
+            if runtime.effective_config().guard in ("numeric", "full"):
+                # Numeric tripwire per overlap bucket (docs/GUARD.md):
+                # fused into the same backward rule that fired the
+                # collective — trace-time gate, zero cost when off.
+                # The bucket's EF residual reverts to its pre-step
+                # state under the same verdict (code review: a tripped
+                # round's error mass must not ride the accumulator
+                # into the next step).
+                from .. import guard
+
+                red, (new_res,) = guard.check_flat(
+                    red, site="overlap", bucket=idx,
+                    aux=[(new_res, res)])
+            out, tok_out = _post(red, tok, shapes, sizes)
             return (out, tok_out, new_res)
 
         sync_ef.defvjp(fwd_ef, bwd_ef)
@@ -535,6 +571,13 @@ def _make_bucket_sync(idx: int, total: int, axes: Tuple[str, ...],
         red = bucket_impl(flat, axes, op=op)
         if compress == "bf16":
             red = red.astype(orig_dtype)
+        if runtime.effective_config().guard in ("numeric", "full"):
+            # Numeric tripwire per overlap bucket (docs/GUARD.md):
+            # fused into the same backward rule that fired the
+            # collective — trace-time gate, zero cost when off.
+            from .. import guard
+
+            red = guard.check_flat(red, site="overlap", bucket=idx)
         out, tok_out = _post(red, tok, shapes, sizes)
         return (out, tok_out)
 
@@ -872,7 +915,23 @@ def data_parallel_step(
         from .. import obs
 
         obs.record_step_build("data_parallel_step")
-    return throttle_dispatch(jitted, mesh=m, max_inflight=max_inflight)
+    stepper = throttle_dispatch(jitted, mesh=m, max_inflight=max_inflight)
+    if cfg is not None and cfg.guard in ("numeric", "full"):
+        # The numeric tripwire's raise-policy boundary (docs/GUARD.md):
+        # a tripped bucket is zeroed in-graph, and the deferred typed
+        # error surfaces HERE, on the eager side of the dispatch — up
+        # to max_inflight steps after the trip (the in-flight window).
+        # Build-time gate: guard="off" returns the bare stepper.
+        from .. import guard
+
+        def guarded(*args):
+            out = stepper(*args)
+            guard.raise_pending()
+            return out
+
+        guarded.jitted = jitted
+        return guarded
+    return stepper
 
 
 def completion_token(out: PyTree):

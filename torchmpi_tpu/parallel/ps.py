@@ -64,6 +64,13 @@ def _faults_armed() -> bool:
     imported unless the config armed it (docs/FAULTS.md)."""
     return runtime.effective_config().faults != "off"
 
+
+def _wire_guard() -> bool:
+    """One string compare per call — the wire-integrity guard
+    (docs/GUARD.md); ``faults.integrity`` is never imported unless the
+    config armed it."""
+    return runtime.effective_config().guard in ("wire", "full")
+
 _LIB_LOCK = threading.Lock()
 _LIB: Optional[ctypes.CDLL] = None
 
@@ -258,11 +265,13 @@ class ShardedParameterServer:
         return sum(self._lib.tm_ps_server_ops(s) for s in self.server_ids)
 
     def _read_counters(self) -> np.ndarray:
-        """One pass over every shard's 7 native counters."""
-        tot = np.zeros(7, dtype=np.uint64)
-        buf = (ctypes.c_uint64 * 7)()
+        """One pass over every shard's 8 native counters (each shard's
+        pass is mutex-consistent in the native layer; an older .so that
+        only knows 7 leaves ``elastic_bytes_out`` at 0)."""
+        tot = np.zeros(8, dtype=np.uint64)
+        buf = (ctypes.c_uint64 * 8)()
         for sid in self.server_ids:
-            if self._lib.tm_ps_server_stats(sid, buf, 7) == 7:
+            if self._lib.tm_ps_server_stats(sid, buf, 8) >= 7:
                 tot += np.ctypeslib.as_array(buf)
         return tot
 
@@ -272,26 +281,27 @@ class ShardedParameterServer:
         ``recv_s`` (payload read syscalls), ``lock_wait_s`` (shard-mutex
         contention), ``apply_s`` (rule loop / memcpy under the mutex),
         ``send_s`` (response writes) — plus ``ops``, ``bytes_in``,
-        ``bytes_out``.  The idle wait between requests is in no bucket.
-        Backs benchmarks/ps_bench.py's loopback breakdown and the
-        scaling model in docs/ROUND3_NOTES.md.
+        ``bytes_out``, and ``elastic_bytes_out`` (the RULE_ELASTIC
+        response payloads inside ``bytes_out``, tracked separately so
+        throughput models don't count them as apply work —
+        benchmarks/ps_bench.py).  The idle wait between requests is in
+        no bucket.  Backs ps_bench's loopback breakdown and the scaling
+        model in docs/ROUND3_NOTES.md.
 
-        Tearing: the seven counters are read individually while handler
-        threads keep incrementing, so one pass may be mutually
-        inconsistent (e.g. ``ops`` ticked but its ``bytes_in`` not yet
-        visible).  The read is therefore performed twice and retried
-        once on mismatch (a seqlock without the seq: two identical
-        passes mean no increment landed mid-read).  Under sustained
-        concurrent load the retried pass can still tear — compare
-        successive snapshots with ``>=``, never ``==`` (the tests do).
+        Consistency (ADVICE round 5): the native counters update in
+        groups under the shard mutex and the snapshot reads under the
+        same mutex, so a per-shard pass can no longer tear mid-op.
+        Every op a completed ``wait()`` observed is fully counted in
+        ``ops``/``bytes_in``/``recv_s``/``lock_wait_s``/``apply_s``
+        (they land before the response unblocks the client — tests
+        assert ``==`` at quiescence); ``send_s``/``bytes_out``/
+        ``elastic_bytes_out`` land after the response write and may lag
+        by the ops still in flight.
 
         With ``Config.obs`` on, each snapshot's deltas against the
         previous one are folded into the telemetry registry as
         ``tm_ps_*_total`` counters (docs/OBSERVABILITY.md)."""
         tot = self._read_counters()
-        again = self._read_counters()
-        if not np.array_equal(tot, again):
-            tot = self._read_counters()  # retry once on seq mismatch
         out = {
             "ops": int(tot[0]),
             "bytes_in": int(tot[1]),
@@ -300,6 +310,7 @@ class ShardedParameterServer:
             "lock_wait_s": float(tot[4]) / 1e9,
             "apply_s": float(tot[5]) / 1e9,
             "send_s": float(tot[6]) / 1e9,
+            "elastic_bytes_out": int(tot[7]),
         }
         if runtime.effective_config().obs != "off":
             from .. import obs
@@ -387,22 +398,43 @@ class PSClient:
         """Async push (reference: ``ps.send(handle, grads, rule)``).
 
         For ``rule="elastic"`` the handle's ``wait()`` returns the elastic
-        delta pytree (subtract it from the local params — EASGD)."""
-        if _faults_armed():
+        delta pytree (subtract it from the local params — EASGD).
+
+        With the wire guard armed (``Config.guard`` in ``wire``/``full``
+        — docs/GUARD.md) each attempt's staged flat payload is blake2b-
+        digested at staging and verified at the native-transport
+        handoff; a mismatch is a transient the fault policy retries by
+        re-staging from ``tree``."""
+        wire = _wire_guard()
+        if _faults_armed() or wire:
             from .. import faults
 
-            make = lambda: self._send_once(tree, rule, alpha)  # noqa: E731
+            stage = lambda: self._stage(tree)  # noqa: E731
+            enq = lambda flat: self._send_flat(flat, rule, alpha)  # noqa: E731
+            make = lambda: faults.ps_exchange_once(  # noqa: E731
+                self.peers, stage, enq, wire_guard=wire)
             return _ResilientPSHandle(
-                faults.ps_enqueue(self.peers, make), make, self.peers)
+                faults.ps_enqueue(self.peers, enq, stage=stage,
+                                  wire_guard=wire), make, self.peers)
         return self._send_once(tree, rule, alpha)
 
-    def _send_once(self, tree: PyTree, rule: str,
-                   alpha: float) -> PSHandle:
-        rid = RULES[rule]
+    def _stage(self, tree: PyTree) -> np.ndarray:
+        """Stage a pytree to the flat f32 wire format (one attempt's
+        host payload; retries re-stage from the tree — the buffers the
+        faults/corruption cannot touch)."""
         flat, _ = tree_util.flatten_f32(tree)
         if flat.shape[0] != self.total:
             raise ValueError(f"tree has {flat.shape[0]} floats, PS holds "
                              f"{self.total}")
+        return flat
+
+    def _send_once(self, tree: PyTree, rule: str,
+                   alpha: float) -> PSHandle:
+        return self._send_flat(self._stage(tree), rule, alpha)
+
+    def _send_flat(self, flat: np.ndarray, rule: str,
+                   alpha: float) -> PSHandle:
+        rid = RULES[rule]
         fids, bufs = [], []
         inout_full = (np.zeros_like(flat) if rule == "elastic" else None)
         for cid, lo, hi, seg in self._per_shard(flat):
@@ -428,9 +460,11 @@ class PSClient:
         if _faults_armed():
             from .. import faults
 
+            make = lambda: faults.ps_exchange_once(  # noqa: E731
+                self.peers, None, self._receive_once)
             return _ResilientPSHandle(
                 faults.ps_enqueue(self.peers, self._receive_once),
-                self._receive_once, self.peers)
+                make, self.peers)
         return self._receive_once()
 
     def _receive_once(self) -> PSHandle:

@@ -323,6 +323,7 @@ def _reduce_scatter_grads(grads: PyTree, axes: Tuple[str, ...], *,
     # groups on top.
     g_leaves = jax.tree.leaves(grads)
     new_res = None
+    ef_inputs = None  # pre-step residuals (the guard's revert fallback)
     if codec is not None and int(_axis_size(axes[:1])) > 1:
         # Error-feedback quantized DCN path: reduce_scatter over ICI in
         # each group's native dtype, residual-corrected quantized
@@ -361,6 +362,7 @@ def _reduce_scatter_grads(grads: PyTree, axes: Tuple[str, ...], *,
             parts.append(shard.astype(spec.dtype))
             new_parts.append(nr)
         new_res = tuple(new_parts)
+        ef_inputs = tuple(res_list)
     else:
         if codec is not None:
             # Flat span: no DCN crossing — plain path, residuals
@@ -384,6 +386,23 @@ def _reduce_scatter_grads(grads: PyTree, axes: Tuple[str, ...], *,
     g_shard = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
     if op == "mean":
         g_shard = g_shard / n
+    if cfg is not None and cfg.guard in ("numeric", "full"):
+        # Numeric tripwire on the synced gradient shard (docs/GUARD.md):
+        # one fused sum-of-squares over this device's extent — each
+        # shard leg checks exactly the update it will apply.  Trace-time
+        # gate; guard="off" adds zero branches to the compiled step.
+        # On the EF path the residuals revert to the pre-step state
+        # under the same verdict (code review: a tripped round's error
+        # mass must not ride the accumulator into the next step).
+        from .. import guard
+
+        if ef_inputs is not None:
+            g_shard, reverted = guard.check_flat(
+                g_shard, site="zero",
+                aux=list(zip(new_res, ef_inputs)))
+            new_res = tuple(reverted)
+        else:
+            g_shard = guard.check_flat(g_shard, site="zero")
     return g_shard, spec, new_res
 
 

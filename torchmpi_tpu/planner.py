@@ -77,8 +77,9 @@ class CollectivePlan:
     """
 
     __slots__ = ("key", "kind", "op", "backend", "nbytes", "spec", "impls",
-                 "extra", "staged", "obs", "faults", "analysis", "epoch",
-                 "topology", "build_seconds", "hits", "_replay", "_obs_hit")
+                 "extra", "staged", "obs", "faults", "guard", "analysis",
+                 "epoch", "topology", "build_seconds", "hits", "_replay",
+                 "_obs_hit")
 
     def __init__(self, key: tuple, kind: str, op: str, *,
                  backend: str = "", nbytes: int = 0,
@@ -86,7 +87,8 @@ class CollectivePlan:
                  impls: Optional[List[Callable]] = None,
                  extra: Optional[dict] = None,
                  staged: bool = False, obs: bool = False,
-                 faults: bool = False, analysis: str = "off",
+                 faults: bool = False, guard: bool = False,
+                 analysis: str = "off",
                  topology: str = "",
                  replay: Optional[Callable] = None) -> None:
         self.key = key
@@ -105,6 +107,10 @@ class CollectivePlan:
         self.staged = bool(staged)
         self.obs = bool(obs)
         self.faults = bool(faults)
+        # Wire-integrity guard enablement, resolved at build like
+        # obs/faults (docs/GUARD.md): guard="off" is one string compare
+        # HERE — the replay closure carries no guard branch at all.
+        self.guard = bool(guard)
         self.analysis = analysis
         self.epoch = runtime.config_epoch()
         self.build_seconds = 0.0
@@ -133,6 +139,7 @@ class CollectivePlan:
                          else (self.spec.n_launches
                                if self.spec is not None else 1)),
             "staged": self.staged, "obs": self.obs, "faults": self.faults,
+            "guard": self.guard,
             "analysis": self.analysis, "epoch": self.epoch,
             "topology": self.topology,
             "build_ms": round(self.build_seconds * 1e3, 3),
@@ -368,22 +375,25 @@ def _build_eager(key: tuple, op: str, x, m: Mesh, n: int,
 
     if C._staged_requested(cfg, backend_arg):
         # Host-staged mode (the reference's staged data path): the
-        # faults enablement is resolved HERE — the replay carries no
-        # Config.faults compare (injection/retry decisions inside an
-        # armed fault layer remain per-attempt, as they must).
+        # faults AND guard enablement are resolved HERE — the replay
+        # carries no Config.faults/Config.guard compare (injection/
+        # retry/verify decisions inside an armed layer remain
+        # per-attempt, as they must).
         faults_on = cfg is not None and cfg.faults != "off"
+        wire_on = cfg is not None and cfg.guard in ("wire", "full")
         rec = None
         if obs_on:
             from . import obs
 
             rec = obs.eager_recorder(op, nbytes, "host", m, x.dtype)
-        if faults_on:
+        if faults_on or wire_on:
             from . import faults
 
             def _replay(x, _faults=faults):
                 if rec is not None:
                     rec()
-                out = _faults.staged_exchange(op, x, n, pd, C._host_staged)
+                out = _faults.staged_exchange(op, x, n, pd, C._host_staged,
+                                              wire_guard=wire_on)
                 return C._place_rank_major(np.ascontiguousarray(out), m,
                                            sharding)
         else:
@@ -397,7 +407,8 @@ def _build_eager(key: tuple, op: str, x, m: Mesh, n: int,
 
         return CollectivePlan(key, "eager-staged", op, backend="host",
                               nbytes=nbytes, staged=True, obs=obs_on,
-                              faults=faults_on, topology=topology_of(m),
+                              faults=faults_on, guard=wire_on,
+                              topology=topology_of(m),
                               replay=_replay)
 
     # Direct mode.  Resolve backend="auto" against the persistent tuning

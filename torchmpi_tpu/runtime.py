@@ -195,6 +195,33 @@ def _normalize_elastic(value) -> Optional[str]:
     return None
 
 
+def _normalize_guard(value) -> Optional[str]:
+    """Canonical guard mode for a config/env value:
+    "off"|"wire"|"numeric"|"full", with boolean-ish spellings accepted
+    ("1"/"true"/"yes"/"on" mean "full" — the everything-armed reading a
+    boolean opt-in wants, "0"/"false"/"no"/"" mean "off").  None =
+    unrecognized (the caller raises)."""
+    v = str(value).strip().lower()
+    if v in ("off", "0", "false", "no", "none", ""):
+        return "off"
+    if v in ("full", "on", "1", "true", "yes"):
+        return "full"
+    if v in ("wire", "numeric"):
+        return v
+    return None
+
+
+def _normalize_guard_policy(value) -> Optional[str]:
+    """Canonical guard_numeric_policy: "skip_step"|"raise".  None =
+    unrecognized (the caller raises)."""
+    v = str(value).strip().lower()
+    if v in ("skip_step", "skip"):
+        return "skip_step"
+    if v == "raise":
+        return "raise"
+    return None
+
+
 def _normalize_faults(value) -> str:
     """Canonical faults mode for a config/env value: "off", "policy",
     or a fault-plan path (kept verbatim).  Boolean-ish spellings map to
@@ -342,6 +369,39 @@ def init(config: Optional[Config] = None, **overrides) -> Mesh:
                             "TORCHMPI_TPU_FAULT_DEADLINE", float)
         _env_default_pickup(cfg, "ps_timeout_s",
                             "TORCHMPI_TPU_PS_TIMEOUT", float)
+        # Payload-integrity + numeric-anomaly guard (docs/GUARD.md):
+        # same any-config env pickup + one-home normalization as
+        # analysis/obs/faults.  "off" (the default) never imports
+        # torchmpi_tpu.guard (or faults.integrity): the mode is read as
+        # one string compare at plan build / trace time.
+        if _normalize_guard(cfg.guard) == "off":
+            cfg.guard = os.environ.get("TORCHMPI_TPU_GUARD", "off")
+        cfg.guard = _normalize_guard(cfg.guard)
+        if cfg.guard is None:
+            raise ValueError(
+                "config.guard (or TORCHMPI_TPU_GUARD) must be "
+                "off|wire|numeric|full")
+        cfg.guard_numeric_policy = _normalize_guard_policy(
+            cfg.guard_numeric_policy)
+        if cfg.guard_numeric_policy is None:
+            raise ValueError(
+                "config.guard_numeric_policy (or TORCHMPI_TPU_GUARD_POLICY)"
+                " must be skip_step|raise")
+        _env_default_pickup(cfg, "guard_norm_bound",
+                            "TORCHMPI_TPU_GUARD_NORM_BOUND", float)
+        _env_default_pickup(cfg, "guard_spike_window",
+                            "TORCHMPI_TPU_GUARD_WINDOW", int)
+        _env_default_pickup(cfg, "guard_spike_threshold",
+                            "TORCHMPI_TPU_GUARD_THRESHOLD", float)
+        if cfg.guard_norm_bound < 0:
+            raise ValueError(
+                f"config.guard_norm_bound must be >= 0 (0 = finite-only),"
+                f" got {cfg.guard_norm_bound}")
+        if cfg.guard_spike_window < 2 or cfg.guard_spike_threshold <= 0:
+            raise ValueError(
+                f"config.guard_spike_window must be >= 2 and "
+                f"guard_spike_threshold > 0, got "
+                f"{cfg.guard_spike_window}/{cfg.guard_spike_threshold}")
         # Elastic gang membership (docs/ELASTIC.md): same any-config env
         # pickup + one-home normalization.  "on" arms NOTHING here —
         # torchmpi_tpu.elastic is a driver layer the user calls
@@ -635,6 +695,31 @@ def set_config(**kw) -> None:
                 raise ValueError("config.obs must be off|metrics|trace")
         if k == "faults":
             v = _normalize_faults(v)
+        if k == "guard":
+            v = _normalize_guard(v)
+            if v is None:
+                raise ValueError(
+                    "config.guard must be off|wire|numeric|full")
+        if k == "guard_numeric_policy":
+            v = _normalize_guard_policy(v)
+            if v is None:
+                raise ValueError(
+                    "config.guard_numeric_policy must be skip_step|raise")
+        if k == "guard_norm_bound":
+            v = float(v)
+            if v < 0:
+                raise ValueError(
+                    "config.guard_norm_bound must be >= 0 "
+                    "(0 = finite-only)")
+        if k == "guard_spike_window":
+            v = int(v)
+            if v < 2:
+                raise ValueError("config.guard_spike_window must be >= 2")
+        if k == "guard_spike_threshold":
+            v = float(v)
+            if v <= 0:
+                raise ValueError(
+                    "config.guard_spike_threshold must be > 0")
         if k == "elastic":
             v = _normalize_elastic(v)
             if v is None:

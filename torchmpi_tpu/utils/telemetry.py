@@ -1,0 +1,25 @@
+"""The sys.modules-gated obs dispatch shim.
+
+Every off-by-default layer (faults, guard, integrity) reports telemetry
+through :mod:`torchmpi_tpu.obs` *without importing it* — a
+faults-only or guard-only session must never pull the telemetry layer
+into the process (the never-imported-when-off discipline).  This is
+the ONE implementation of that contract: look the module up in
+``sys.modules``, check ``active()``, dispatch, and swallow everything
+— telemetry never fails a step.  Dependency-free on purpose.
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def emit(method: str, *args, **kwargs) -> None:
+    """Call ``torchmpi_tpu.obs.<method>(*args, **kwargs)`` iff obs is
+    imported AND active; no-op (and exception-proof) otherwise."""
+    mod = sys.modules.get("torchmpi_tpu.obs")
+    try:
+        if mod is not None and mod.active():
+            getattr(mod, method)(*args, **kwargs)
+    except Exception:  # noqa: BLE001 — telemetry never fails a step
+        pass
