@@ -349,6 +349,13 @@ def latest_banked_for_metric(metric, want=None, art_dir=None):
     return None
 
 
+# Probe-path heartbeat-drain cap: long enough to outlast a signal-shadow
+# window around an in-flight compile's heartbeat refresh, SHORT enough
+# that a dead-relay verdict stays in probe territory (~minutes) instead
+# of inheriting the ladder's 2700 s worst case (ADVICE r5).
+_PROBE_DRAIN_CAP_S = 120.0
+
+
 def relay_probe(env, timeout_s=150.0):
     """Pre-flight liveness probe (VERDICT r4 #1): one tiny device op in
     a bounded subprocess (``bench.py --probe``, which honors the same
@@ -359,7 +366,12 @@ def relay_probe(env, timeout_s=150.0):
     probe's tiny op can legitimately queue behind another client's
     blessed compile (compilegate heartbeat fresh).  In that case the
     escalation waits for the heartbeat to drain and the probe retries
-    once before any verdict.  Termination is SIGTERM-then-bounded-KILL
+    once before any verdict.  The drain on THIS path is capped at
+    ``_PROBE_DRAIN_CAP_S``, not the 2700 s ladder default (ADVICE r5:
+    a fresh compile heartbeat inflated the "~2 min dead-relay
+    detection" to over an hour — the probe exists to be FAST; if the
+    relay is still busy past the short cap, the retry's own timeout
+    delivers the verdict).  Termination is SIGTERM-then-bounded-KILL
     with the heartbeat drain before each signal, mirroring
     scripts/tpu_watch.run_bounded — a bare SIGKILL mid-device-claim is
     the round-1 wedge class.  Returns ``(alive, seconds)``."""
@@ -373,12 +385,12 @@ def relay_probe(env, timeout_s=150.0):
             out, _ = proc.communicate(timeout=timeout_s)
         except subprocess.TimeoutExpired:
             busy = _compile_heartbeat_fresh()
-            _wait_compile_heartbeat_drain()
+            _wait_compile_heartbeat_drain(cap_s=_PROBE_DRAIN_CAP_S)
             proc.terminate()
             try:
                 out, _ = proc.communicate(timeout=30)
             except subprocess.TimeoutExpired:
-                _wait_compile_heartbeat_drain()
+                _wait_compile_heartbeat_drain(cap_s=_PROBE_DRAIN_CAP_S)
                 proc.kill()
                 out, _ = proc.communicate()
             if busy and attempt == 1:

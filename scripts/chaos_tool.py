@@ -13,8 +13,9 @@ The chaos-engineering operator surface over ``torchmpi_tpu/faults/``:
 (``site:kind[:prob[:max_hits[:delay_s]]]``; ``site`` may glob the
 instrumented sites, ``max_hits=-1`` means unbounded).  Kinds include
 ``corrupt_silent`` (docs/GUARD.md): bits flip and NOTHING raises —
-payload-carrying sites only (``host_staged.*``, ``ps.request``);
-``lint`` rejects it anywhere else, where it would be a total no-op.  ``--shrink
+payload-carrying sites only (``host_staged.*``, ``ps.request``,
+``ckpt.write``, ``ckpt.read``); ``lint`` rejects it anywhere else,
+where it would be a total no-op.  ``--shrink
 RANK:STEP:NRANKS`` is the elastic-gang recipe (docs/ELASTIC.md): the
 driver fires the ``elastic.member`` site once per member per step
 boundary in rank order, so arrival ``STEP*NRANKS + RANK`` is exactly
@@ -24,14 +25,23 @@ kill-one-peer-at-step-n plan (compute NRANKS against the ORIGINAL gang
 size; arrivals per step shrink with the gang).  ``lint`` validates a
 plan — schema/version errors exit 2, semantic problems (site patterns
 matching no instrumented site, dead rules) print and exit 1.
-``summarize`` reads per-host obs metric dumps (the files
-``TORCHMPI_TPU_OBS=metrics`` leaves behind) and prints the
-``tm_fault_*``, ``tm_elastic_*``, and ``tm_guard_*`` series — what was
-injected, what survived a retry, what hit a deadline, what
-shrink/rejoin the gang ran, what digests failed/healed and what
-updates the numeric tripwire skipped — the after-action report of a
-chaos run; exits 1 when a chaos run left NO fault counters (it
-injected nothing: wrong plan, wrong sites, or faults never armed).
+The checkpoint sites (docs/CHECKPOINT.md) round out the storage
+surface: ``ckpt.write``/``ckpt.read`` carry real payloads (the
+serialized npz bytes), so ``corrupt``/``corrupt_silent`` flip bits
+that land on (or come back from) disk; ``torn`` — ``ckpt.write``
+only, lint rejects it elsewhere — leaves a truncated ``.tmp``
+artifact and kills the save (the crash-mid-write double); ``fail``
+is ENOSPC-flavored on write, EIO on read.  ``summarize`` reads
+per-host obs metric dumps (the files ``TORCHMPI_TPU_OBS=metrics``
+leaves behind) and prints the ``tm_fault_*``, ``tm_elastic_*``,
+``tm_guard_*``, and ``tm_ckpt_*`` series — what was injected, what
+survived a retry, what hit a deadline, what shrink/rejoin the gang
+ran, what digests failed/healed, what updates the numeric tripwire
+skipped, and what checkpoint copies failed verification, were
+repaired from buddies, or were walked past by recovery — the
+after-action report of a chaos run; exits 1 when a chaos run left NO
+fault counters (it injected nothing: wrong plan, wrong sites, or
+faults never armed).
 
 Standalone on purpose: no jax — writing a chaos plan for a pod (or
 reading its post-mortem) must not need the pod's software stack.  The
@@ -176,7 +186,7 @@ def cmd_summarize(args) -> int:
         for rec in _load_counters(path):
             name = rec.get("name", "")
             if not name.startswith(("tm_fault_", "tm_elastic_",
-                                    "tm_guard_")):
+                                    "tm_guard_", "tm_ckpt_")):
                 continue
             key = (name, tuple(sorted(rec.get("labels", {}).items())))
             totals[key] = totals.get(key, 0) + rec.get("value", 0)
@@ -192,7 +202,8 @@ def cmd_summarize(args) -> int:
         print(f"  {name}{{{lab}}} = {int(v)}")
         if name.startswith("tm_fault_"):
             action = name[len("tm_fault_"):-len("_total")]
-        else:  # tm_elastic_*/tm_guard_*: keep the subsystem prefix
+        else:  # tm_elastic_*/tm_guard_*/tm_ckpt_*: keep the subsystem
+            #   prefix
             action = name[len("tm_"):-len("_total")]
         by_action[action] = by_action.get(action, 0) + v
     line = "  ".join(f"{a}={int(v)}" for a, v in sorted(by_action.items()))

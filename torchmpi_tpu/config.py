@@ -289,6 +289,33 @@ class Config:
     # rolling median.  Env: TORCHMPI_TPU_GUARD_THRESHOLD.
     guard_spike_threshold: float = 8.0
 
+    # --- durable checkpoints (utils/checkpoint.py + utils/durable.py) --------
+    # Checkpoint-resilience mode (docs/CHECKPOINT.md): "off" (default —
+    # utils/durable.py is never imported, save/restore pay exactly one
+    # string compare at entry; same discipline as ``analysis``/``obs``/
+    # ``faults``/``guard``), "verify" (a blake2b digest over the
+    # serialized checkpoint bytes is recorded in the per-file metadata
+    # and re-checked on every restore — bit-rot raises a typed
+    # ``CheckpointCorruptError`` the recovery walk-back treats as
+    # evidence, never a silent garbage restore), or "buddy" (verify
+    # PLUS each process mirrors its checkpoint pair to ``ckpt_buddies``
+    # buddy locations — ranks (proc+1..K) mod world — so a restore
+    # whose local file is missing or corrupt repairs from a buddy copy
+    # bit-identically).  Env: TORCHMPI_TPU_CKPT_REDUNDANCY.
+    ckpt_redundancy: str = "off"
+    # Buddy copies per checkpoint file under ckpt_redundancy="buddy"
+    # (K in the (proc+1..K) mod world placement; a single-process sim
+    # mirrors to one separate on-disk location).
+    # Env: TORCHMPI_TPU_CKPT_BUDDIES.
+    ckpt_buddies: int = 1
+    # Retention: keep only the newest K checkpoint steps per process
+    # (primaries AND buddy mirrors), never pruning the step recovery
+    # last settled on (the agreed/rewind step) so a chaos soak cannot
+    # prune its own rewind target.  0 = keep everything (the
+    # pre-retention behavior).  Only enforced when ckpt_redundancy is
+    # on — off-mode saves stay untouched.  Env: TORCHMPI_TPU_CKPT_KEEP.
+    ckpt_keep: int = 0
+
     # --- fault injection + resilient dispatch -------------------------------
     # torchmpi_tpu.faults (docs/FAULTS.md): "off" (default — one string
     # compare per cross-host call site, the module is never imported;
@@ -416,6 +443,10 @@ class Config:
             guard_spike_window=_env_int("TORCHMPI_TPU_GUARD_WINDOW", 16),
             guard_spike_threshold=_env_float("TORCHMPI_TPU_GUARD_THRESHOLD",
                                              8.0),
+            ckpt_redundancy=_env_str("TORCHMPI_TPU_CKPT_REDUNDANCY",
+                                     "off"),
+            ckpt_buddies=_env_int("TORCHMPI_TPU_CKPT_BUDDIES", 1),
+            ckpt_keep=_env_int("TORCHMPI_TPU_CKPT_KEEP", 0),
             fault_retries=_env_int("TORCHMPI_TPU_FAULT_RETRIES", 2),
             fault_backoff_s=_env_float("TORCHMPI_TPU_FAULT_BACKOFF", 0.05),
             fault_deadline_s=_env_float("TORCHMPI_TPU_FAULT_DEADLINE",

@@ -415,20 +415,17 @@ def _seed_joiner_checkpoints(directory: str, step: int,
     gang back to it.  The state is replicated by the ``build``
     contract (full/topology-portable leaves, identical on every
     process), so the lowest surviving member's file IS the joiner's
-    file — copied via tmp + atomic rename, the checkpoint discipline.
-    No-op on the single-process sim (one process, one file)."""
+    file — seeded via ``checkpoint.replicate_for`` (tmp + atomic
+    rename, the checkpoint discipline; with ``Config.ckpt_redundancy``
+    on the source bytes are digest-verified first — repairing from a
+    buddy copy if the survivor's own primary rotted — and each joiner
+    gets the stamped metadata plus its own buddy mirrors,
+    docs/CHECKPOINT.md).  No-op on the single-process sim (one
+    process, one file)."""
     if not gang._multiproc or \
             jax.process_index() != min(gang.view.members):
         return
-    import shutil
-
-    src = os.path.join(directory,
-                       f"ckpt_{step}_p{jax.process_index()}.npz")
-    for r in joiners:
-        dst = os.path.join(directory, f"ckpt_{step}_p{int(r)}.npz")
-        tmp = dst + ".tmp"
-        shutil.copyfile(src, tmp)
-        os.replace(tmp, dst)
+    checkpoint.replicate_for(directory, step, [int(r) for r in joiners])
 
 
 def _member_of_failure(e: BaseException) -> Optional[int]:

@@ -52,6 +52,7 @@ from .inject import (  # noqa: F401
     FaultPlan,
     FaultRule,
     InjectedFailure,
+    TornWrite,
     TransientFault,
     corrupt_buffer,
     lint_plan,
@@ -187,9 +188,13 @@ def fire(site: str, payload=None, peer: str = "") -> None:
         # The silent production failure mode: bits flip, NOTHING is
         # raised — with Config.guard="off" the corruption propagates
         # and the run silently diverges; with "wire" the digest check
-        # detects it downstream (docs/GUARD.md).
+        # detects it downstream (docs/GUARD.md).  At the ckpt sites
+        # the same kind models on-disk bit-rot (docs/CHECKPOINT.md).
         corrupt_buffer(payload, p.seed, arrival)
         return
+    if rule.kind == "torn":
+        raise TornWrite(
+            f"injected torn write at {site} (arrival {arrival})")
     raise InjectedFailure(f"injected hard failure at {site}")
 
 
@@ -438,6 +443,57 @@ def _wait_budget_ms() -> int:
         return 0
     return max(1, int(_policy.deadline_s * 1000
                       / (1 + max(0, _policy.retries))))
+
+
+def ckpt_write(path: str, data, commit: Callable[[], Any]) -> Any:
+    """One checkpoint-file commit under injection (site ``ckpt.write``
+    — utils/checkpoint.py, docs/CHECKPOINT.md).  ``data`` is the
+    WRITABLE staged byte buffer (uint8 view) about to land on disk:
+    ``corrupt_silent`` flips real bits that then get written and
+    fsynced (bit-rot between serialize and commit — the digest
+    recorded beforehand no longer matches, which is what the verified
+    restore catches), ``torn`` writes a truncated prefix to the
+    ``path + '.tmp'`` staging file and raises (the crash-mid-save
+    artifact ``latest_step`` must ignore), and ``fail`` converts to an
+    ENOSPC-flavored ``OSError``.  Deliberately NOT retried here:
+    checkpoint durability belongs to the recovery protocol (walk-back
+    + buddy repair), not a transport retry — a disk that ate one write
+    will eat the next."""
+    import errno
+
+    try:
+        fire("ckpt.write", payload=data, peer="storage")
+    except TornWrite as e:
+        try:
+            n = max(1, len(data) // 2)
+            with open(path + ".tmp", "wb") as f:
+                f.write(memoryview(data).cast("B")[:n])
+        except OSError:
+            pass  # even the torn prefix failed — artifact optional
+        raise OSError(
+            errno.EIO, f"injected torn write (crash mid-save): {path}"
+        ) from e
+    except InjectedFailure as e:
+        raise OSError(
+            errno.ENOSPC, f"injected ENOSPC writing {path}") from e
+    return commit()
+
+
+def ckpt_read(path: str, data) -> None:
+    """One checkpoint npz read under injection (site ``ckpt.read``).
+    ``data`` is the writable buffer just read back from disk —
+    ``corrupt_silent`` is the on-disk bit-rot a digest-verified
+    restore must catch (and, with buddies, repair); ``fail`` converts
+    to an EIO-flavored ``OSError`` (the dead disk).  Like the write
+    side, never retried here — re-reading a rotten file yields the
+    same rot; recovery's job is to find a DIFFERENT copy."""
+    import errno
+
+    try:
+        fire("ckpt.read", payload=data, peer="storage")
+    except InjectedFailure as e:
+        raise OSError(
+            errno.EIO, f"injected read failure for {path}") from e
 
 
 def _as_transient(e: BaseException) -> BaseException:

@@ -34,7 +34,16 @@ Kinds model the failures a benign-fabric port never had to survive:
   ``"wire"`` the digest check detects it and the retry heals —
   docs/GUARD.md.
 - ``fail``     — :class:`InjectedFailure`: a hard peer death.  NOT
-  transient; the policy never retries it.
+  transient; the policy never retries it.  At the ``ckpt.*`` sites the
+  site wrapper converts it to an OS-flavored error (ENOSPC on write,
+  EIO on read) so the recovery stack sees what a real disk failure
+  looks like.
+- ``torn``     — :class:`TornWrite`: a crash mid-checkpoint-write.
+  Only meaningful at ``ckpt.write`` (lint rejects it elsewhere): the
+  site wrapper writes a truncated prefix of the payload to the
+  ``.tmp`` staging path and raises — the artifact the atomic-rename
+  discipline must leave invisible to ``latest_step``.  Hard, never
+  retried (the writer is dead).
 
 Dependency-free on purpose (no jax, no numpy at import): loaded by
 ``scripts/chaos_tool.py`` standalone, and by the dump path of a dying
@@ -75,9 +84,23 @@ SITES = (
     #                         (chaos_tool gen --shrink computes k);
     #                         drop = a missed heartbeat the health
     #                         ledger escalates healthy->suspect->dead
+    "ckpt.write",           # one checkpoint-file commit (npz or
+    #                         metadata json, primaries and buddy
+    #                         mirrors alike — utils/checkpoint.py /
+    #                         utils/durable.py, docs/CHECKPOINT.md):
+    #                         corrupt_silent = bit-rot between
+    #                         serialize and fsync, `torn` = a
+    #                         truncated-prefix .tmp artifact + crash
+    #                         (the mid-save kill), `fail` = an
+    #                         ENOSPC-flavored OSError
+    "ckpt.read",            # one checkpoint npz read (restore /
+    #                         restore_sharded / buddy-repair source):
+    #                         corrupt_silent = on-disk bit-rot the
+    #                         digest verify must catch, `fail` = an
+    #                         EIO-flavored dead disk
 )
 
-KINDS = ("delay", "drop", "corrupt", "corrupt_silent", "fail")
+KINDS = ("delay", "drop", "corrupt", "corrupt_silent", "fail", "torn")
 
 # Sites whose ``fire()`` call passes a real writable payload buffer —
 # the only sites where a ``corrupt``/``corrupt_silent`` rule can flip
@@ -86,6 +109,8 @@ PAYLOAD_SITES = (
     "host_staged.gather",
     "host_staged.scatter",
     "ps.request",
+    "ckpt.write",
+    "ckpt.read",
 )
 
 
@@ -118,6 +143,12 @@ class CorruptPayload(TransientFault):
 
 class InjectedFailure(FaultError):
     """Hard failure: the peer is gone.  Never retried."""
+
+
+class TornWrite(InjectedFailure):
+    """A crash mid-checkpoint-write (``torn`` kind, ``ckpt.write``
+    only): the site wrapper leaves a truncated ``.tmp`` artifact and
+    raises this.  Hard — the writing process is modeled as dead."""
 
 
 @dataclasses.dataclass
@@ -293,6 +324,11 @@ def lint_plan(plan: FaultPlan) -> List[str]:
                 f"rule {i}: corrupt_silent at {matched} has no payload "
                 f"to flip — the rule is a total no-op (payload sites: "
                 f"{', '.join(PAYLOAD_SITES)})")
+        if rule.kind == "torn" and matched and "ckpt.write" not in matched:
+            problems.append(
+                f"rule {i}: torn at {matched} has no staged file write "
+                f"to truncate (only ckpt.write models a crash "
+                f"mid-checkpoint-write)")
     return problems
 
 

@@ -20,9 +20,11 @@ corrupt-then-heal contract the injected ``corrupt`` kind proved, now
 for corruption we did NOT inject (the ``corrupt_silent`` chaos kind is
 its deterministic test double).
 
-Only ever imported when ``Config.guard`` is ``"wire"``/``"full"`` —
-the ``analysis``/``obs``/``faults`` import discipline; ``guard="off"``
-is one string compare at plan build and this module never loads.
+Only ever imported when ``Config.guard`` is ``"wire"``/``"full"`` or
+``Config.ckpt_redundancy`` is on (utils/durable.py reuses
+:func:`digest_bytes` as the ONE digest home for checkpoint files —
+docs/CHECKPOINT.md) — the ``analysis``/``obs``/``faults`` import
+discipline; with both knobs off this module never loads.
 Telemetry (``tm_guard_*`` counters, per-site verify-latency
 histograms, ``guard`` flight events carrying the digest so
 ``obs_tool blame`` can name the first rank whose digest diverged)
@@ -63,6 +65,17 @@ class IntegrityError(TransientFault):
             f"{site}{peer_s}: payload integrity check failed — digest "
             f"{got[:12]} != staged {expect[:12]} (bucket {bucket}); "
             f"bits changed between staging and consume")
+
+
+def digest_bytes(data) -> str:
+    """blake2b hex digest over a raw byte buffer — the checkpoint-file
+    edition of :func:`digest` (utils/durable.py records it per file in
+    the checkpoint metadata and re-checks it on every restore,
+    docs/CHECKPOINT.md).  No shape/dtype salt: the bytes ARE the
+    artifact."""
+    h = hashlib.blake2b(digest_size=DIGEST_BYTES)
+    h.update(memoryview(data).cast("B"))
+    return h.hexdigest()
 
 
 def digest(buf) -> str:

@@ -379,7 +379,12 @@ def run_guarded(init_fn: Callable[[], PyTree],
     checkpoint via :func:`restart.recover` **in place**: the view, the
     mesh, and every cached CollectivePlan are untouched and the config
     epoch does not move (asserted in tests/test_guard.py) — a rewind
-    is a state restore, not a re-plan.  ``implicate`` optionally
+    is a state restore, not a re-plan.  With ``Config.ckpt_redundancy``
+    on (docs/CHECKPOINT.md) the rewind target is digest-verified and
+    buddy-repairable — a rewind whose checkpoint rotted walks back to
+    the next verifiable step instead of restoring garbage — and the
+    step each rewind settles on is pinned against ``ckpt_keep``
+    retention so a chaos soak cannot prune its own rewind target.  ``implicate`` optionally
     quarantines a peer in the ``HealthLedger`` at each rewind.  Every
     rank of a multi-process gang must call this collectively (the
     ``restart.recover`` contract); the single-process sim degrades to

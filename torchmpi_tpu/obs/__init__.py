@@ -554,6 +554,25 @@ def record_restart(event: str, step: int) -> None:
     _recorder.append("restart", event, int(step))
 
 
+def record_ckpt(event: str, *, step: int = 0, reason: str = "") -> None:
+    """One durable-checkpoint event (``utils/checkpoint.py`` +
+    ``utils/durable.py`` — docs/CHECKPOINT.md): ``event`` is ``saved``
+    (a digest-stamped pair + its buddy mirrors committed) |
+    ``verified`` (a restore's digest check passed) | ``verify_failed``
+    (a copy failed it — ``reason`` names primary vs ``buddy_r<k>``) |
+    ``repaired`` (the primary was rewritten bit-identically from the
+    buddy named by ``reason``) | ``pruned`` (retention removed a
+    step) | ``walkback`` (recovery rejected a step — ``reason`` is
+    corrupt | missing | template_mismatch) — counter
+    ``tm_ckpt_<event>_total``.  Every event rides the flight ring with
+    the STEP in the nbytes slot, so ``obs_tool`` post-mortems can
+    attribute which step recovery settled on and why the steps above
+    it were rejected, aligned against the collectives around them."""
+    labels = {"reason": reason} if reason else {}
+    _registry.counter_inc(f"tm_ckpt_{event}_total", **labels)
+    _recorder.append("ckpt", event, int(step), reason, event)
+
+
 def record_elastic(event: str, *, epoch: int = 0, members: int = 0,
                    peer: str = "") -> None:
     """One elastic gang-resize event (``torchmpi_tpu/elastic.py`` —

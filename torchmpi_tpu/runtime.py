@@ -211,6 +211,22 @@ def _normalize_guard(value) -> Optional[str]:
     return None
 
 
+def _normalize_ckpt_redundancy(value) -> Optional[str]:
+    """Canonical ckpt_redundancy mode for a config/env value:
+    "off"|"verify"|"buddy", with boolean-ish spellings accepted
+    ("1"/"true"/"yes"/"on" mean "buddy" — the everything-armed reading
+    a boolean opt-in wants, "0"/"false"/"no"/"" mean "off").  None =
+    unrecognized (the caller raises)."""
+    v = str(value).strip().lower()
+    if v in ("off", "0", "false", "no", "none", ""):
+        return "off"
+    if v in ("buddy", "on", "1", "true", "yes"):
+        return "buddy"
+    if v == "verify":
+        return v
+    return None
+
+
 def _normalize_guard_policy(value) -> Optional[str]:
     """Canonical guard_numeric_policy: "skip_step"|"raise".  None =
     unrecognized (the caller raises)."""
@@ -402,6 +418,28 @@ def init(config: Optional[Config] = None, **overrides) -> Mesh:
                 f"config.guard_spike_window must be >= 2 and "
                 f"guard_spike_threshold > 0, got "
                 f"{cfg.guard_spike_window}/{cfg.guard_spike_threshold}")
+        # Durable checkpoints (docs/CHECKPOINT.md): same any-config env
+        # pickup + one-home normalization.  "off" (default) never
+        # imports utils/durable.py — save/restore read the mode as one
+        # string compare at entry.
+        if _normalize_ckpt_redundancy(cfg.ckpt_redundancy) == "off":
+            cfg.ckpt_redundancy = os.environ.get(
+                "TORCHMPI_TPU_CKPT_REDUNDANCY", "off")
+        cfg.ckpt_redundancy = _normalize_ckpt_redundancy(
+            cfg.ckpt_redundancy)
+        if cfg.ckpt_redundancy is None:
+            raise ValueError(
+                "config.ckpt_redundancy (or TORCHMPI_TPU_CKPT_REDUNDANCY)"
+                " must be off|verify|buddy")
+        _env_default_pickup(cfg, "ckpt_buddies",
+                            "TORCHMPI_TPU_CKPT_BUDDIES", int)
+        _env_default_pickup(cfg, "ckpt_keep",
+                            "TORCHMPI_TPU_CKPT_KEEP", int)
+        if cfg.ckpt_buddies < 1 or cfg.ckpt_keep < 0:
+            raise ValueError(
+                f"config.ckpt_buddies must be >= 1 and ckpt_keep >= 0 "
+                f"(0 = keep everything), got "
+                f"{cfg.ckpt_buddies}/{cfg.ckpt_keep}")
         # Elastic gang membership (docs/ELASTIC.md): same any-config env
         # pickup + one-home normalization.  "on" arms NOTHING here —
         # torchmpi_tpu.elastic is a driver layer the user calls
@@ -720,6 +758,20 @@ def set_config(**kw) -> None:
             if v <= 0:
                 raise ValueError(
                     "config.guard_spike_threshold must be > 0")
+        if k == "ckpt_redundancy":
+            v = _normalize_ckpt_redundancy(v)
+            if v is None:
+                raise ValueError(
+                    "config.ckpt_redundancy must be off|verify|buddy")
+        if k == "ckpt_buddies":
+            v = int(v)
+            if v < 1:
+                raise ValueError("config.ckpt_buddies must be >= 1")
+        if k == "ckpt_keep":
+            v = int(v)
+            if v < 0:
+                raise ValueError(
+                    "config.ckpt_keep must be >= 0 (0 = keep everything)")
         if k == "elastic":
             v = _normalize_elastic(v)
             if v is None:

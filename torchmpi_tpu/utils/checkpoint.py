@@ -24,14 +24,128 @@ from __future__ import annotations
 import io as _io
 import json
 import os
+import sys
 import threading
 import time
-from typing import Any, Optional
+from typing import Any, Optional, Sequence
 
 import jax
 import numpy as np
 
 PyTree = Any
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint file failed its integrity check — the blake2b
+    digest recorded at save time (``Config.ckpt_redundancy`` in
+    ``verify``/``buddy``, docs/CHECKPOINT.md) does not match the bytes
+    read back, and (in buddy mode) no buddy copy verified either.
+    Typed so ``restart.recover``'s walk-back can treat it as
+    try-the-next-older-step EVIDENCE (recorded as ``corrupt``) instead
+    of a blanket exception, and so callers can tell bit-rot apart from
+    a model-shape mismatch."""
+
+    def __init__(self, path: str, *, step: Optional[int] = None,
+                 expect: str = "", got: str = "",
+                 reason: str = "digest mismatch"):
+        self.path = path
+        self.step = step
+        self.expect = expect
+        self.got = got
+        self.reason = reason
+        detail = (f" (digest {got[:12]} != recorded {expect[:12]})"
+                  if expect and got else "")
+        super().__init__(
+            f"{path}: checkpoint corrupt — {reason}{detail}")
+
+
+class TemplateMismatchError(ValueError):
+    """The restore template's shape/dtype contradicts the checkpoint —
+    the model changed since the save.  A ``ValueError`` subclass (the
+    historical type), split out so the recovery walk-back can report
+    ``template_mismatch`` distinctly from corruption."""
+
+
+def _faults_mod():
+    """The INJECTING fault layer, via sys.modules — this module NEVER
+    imports ``torchmpi_tpu.faults`` (the off-mode import discipline;
+    the layer is guaranteed imported whenever ``runtime.init`` armed
+    it).  Gated on ``injecting()`` (a plan is loaded), not merely
+    ``active()``: the ``ckpt.*`` sites are injection-only (no retry
+    policy — checkpoint durability is the recovery protocol's job),
+    so the common ``faults="policy"`` production mode must not pay the
+    per-save/per-read staging copies for a fire() that can never land
+    anything.  None otherwise: the sites then cost one dict lookup per
+    file operation."""
+    mod = sys.modules.get("torchmpi_tpu.faults")
+    if mod is not None and mod.injecting():
+        return mod
+    return None
+
+
+def _redundancy():
+    """The ONE string compare of the durable-checkpoint opt-in
+    (docs/CHECKPOINT.md): ``Config.ckpt_redundancy == "off"`` returns
+    None and ``utils/durable.py`` is never imported; otherwise the
+    armed module handles digests, buddy mirrors, and retention."""
+    from .. import runtime
+
+    if runtime.effective_config().ckpt_redundancy == "off":
+        return None
+    from . import durable
+
+    return durable
+
+
+def _writable_u8(data):
+    """A writable uint8 numpy view over ``data`` for the fault sites
+    (``corrupt_silent`` must flip REAL bits in the staged buffer).
+    Copies only when the buffer is read-only."""
+    mv = memoryview(data)
+    if mv.readonly:
+        mv = memoryview(bytearray(mv))
+    return np.frombuffer(mv, dtype=np.uint8)
+
+
+def _write_atomic(path: str, data, *, fsync: bool = True) -> None:
+    """Commit ``data`` (bytes-like) to ``path`` via tmp + write +
+    flush + fsync + atomic rename — the one synchronous write home for
+    checkpoint npz AND metadata json files (the json used to skip the
+    fsync: a crash after its rename could surface a step whose dtype
+    record was torn while ``latest_step(require_meta=False)`` still
+    picked it).  With the fault layer armed the write runs under the
+    ``ckpt.write`` site (torn/ENOSPC/bit-rot injection)."""
+    mod = _faults_mod()
+    if mod is not None:
+        u8 = _writable_u8(data)
+        mod.ckpt_write(path, u8, lambda: _commit_file(path, u8, fsync))
+        return
+    _commit_file(path, data, fsync)
+
+
+def _commit_file(path: str, data, fsync: bool) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        if fsync:
+            f.flush()
+            os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def _read_npz_bytes(path: str) -> bytes:
+    """Read a checkpoint npz back as bytes, through the ``ckpt.read``
+    fault site when armed (injected bit-rot lands in the returned
+    buffer — exactly what on-disk rot looks like to the parser and the
+    digest check above it)."""
+    with open(path, "rb") as f:
+        raw = f.read()
+    mod = _faults_mod()
+    if mod is None:
+        return raw
+    buf = bytearray(raw)
+    mod.ckpt_read(path, np.frombuffer(buf, dtype=np.uint8))
+    return bytes(buf)
 
 
 def _paths(tree: PyTree):
@@ -49,37 +163,63 @@ def save(directory: str, tree: PyTree, *, step: int = 0) -> str:
     writes ``ckpt_<step>_p<proc>.npz`` (replicated trees: identical files,
     restore reads the local one).
 
-    Writes are tmp+atomic-rename (matching the async writer), so a crash
-    mid-save can never surface a truncated npz as the latest step — the
-    invariant the checkpoint-restart driver (utils/restart.py) leans on."""
+    Writes are tmp+atomic-rename with BOTH files fsynced before their
+    renames (the metadata json included — a torn dtype record would
+    poison the step ``latest_step(require_meta=False)`` still picks),
+    so a crash mid-save can never surface a truncated artifact as the
+    latest step — the invariant the checkpoint-restart driver
+    (utils/restart.py) leans on.  With ``Config.ckpt_redundancy`` on
+    (ONE string compare here, docs/CHECKPOINT.md) the serialized bytes
+    are digest-stamped in the metadata, mirrored to buddy locations,
+    and old steps pruned per ``ckpt_keep``."""
     os.makedirs(directory, exist_ok=True)
     proc = jax.process_index()
     path = os.path.join(directory, f"ckpt_{step}_p{proc}.npz")
     arrays = {key: np.asarray(leaf) for key, leaf in _paths(tree)}
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        np.savez(f, **arrays)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, path)
     # dtypes recorded because npz erases extension dtypes (bf16 -> '|V2');
     # restore() needs the true stored dtype to reinterpret and to make the
     # template-mismatch check meaningful.
     meta = {"step": step, "keys": sorted(arrays.keys()),
             "dtypes": {k: str(a.dtype) for k, a in arrays.items()}}
-    meta_path = os.path.join(directory, f"ckpt_{step}_p{proc}.json")
-    with open(meta_path + ".tmp", "w") as f:
-        json.dump(meta, f)
-    os.replace(meta_path + ".tmp", meta_path)
+    dur = _redundancy()
+    if dur is None and _faults_mod() is None:
+        # Default path: STREAM the npz straight to the tmp file — no
+        # second in-memory copy of the checkpoint (buffering is only
+        # needed when a digest is recorded or a fault site wants the
+        # staged payload).
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            np.savez(f, **arrays)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    else:
+        buf = _io.BytesIO()
+        np.savez(buf, **arrays)
+        if dur is not None:
+            dur.save_pair(directory, f"ckpt_{step}_p{proc}",
+                          buf.getbuffer(), meta, step=step, proc=proc)
+            return path
+        _write_atomic(path, buf.getbuffer())
+    _write_atomic(path[:-4] + ".json",
+                  json.dumps(meta).encode())
     return path
 
 
 class CheckpointHandle:
-    """Future for one async checkpoint (data + metadata writes)."""
+    """Future for one async checkpoint (data + metadata writes).
 
-    def __init__(self, handles, path: str):
+    ``on_durable`` (durable-checkpoint retention) runs once, after
+    every write has landed: pruning older steps any earlier would race
+    their still-queued writes on the FIFO writer — the removed file
+    would be resurrected by its own pending rename.  A handle that is
+    never waited skips its prune; the next save's prune recomputes the
+    full doomed list, so retention self-heals one save later."""
+
+    def __init__(self, handles, path: str, on_durable=None):
         self._handles = handles
         self.path = path
+        self._on_durable = on_durable
 
     def done(self) -> bool:
         return all(h.done() for h in self._handles)
@@ -93,6 +233,9 @@ class CheckpointHandle:
         for h in self._handles:
             h.wait(None if deadline is None
                    else max(0.0, deadline - time.monotonic()))
+        if self._on_durable is not None:
+            cb, self._on_durable = self._on_durable, None
+            cb()
         return self.path
 
 
@@ -135,22 +278,39 @@ def save_async(directory: str, tree: PyTree, *, step: int = 0,
     arrays = {key: np.asarray(leaf) for key, leaf in _paths(tree)}
     buf = _io.BytesIO()
     np.savez(buf, **arrays)
-    meta = json.dumps({"step": step, "keys": sorted(arrays.keys()),
-                       "dtypes": {k: str(a.dtype)
-                                  for k, a in arrays.items()}})
+    meta = {"step": step, "keys": sorted(arrays.keys()),
+            "dtypes": {k: str(a.dtype) for k, a in arrays.items()}}
+    dur = _redundancy()
+    if dur is not None:
+        return dur.submit_pair(
+            _writer(), directory, f"ckpt_{step}_p{proc}",
+            buf.getbuffer(), meta, step=step, proc=proc,
+            durable=durable)
     w = _writer()
-    h_data = w.submit(path, buf.getbuffer(), durable=durable)
-    h_meta = w.submit(
-        os.path.join(directory, f"ckpt_{step}_p{proc}.json"),
-        meta.encode(), durable=durable)
+    h_data = _submit(w, path, buf.getbuffer(), durable)
+    h_meta = _submit(
+        w, os.path.join(directory, f"ckpt_{step}_p{proc}.json"),
+        json.dumps(meta).encode(), durable)
     return CheckpointHandle((h_data, h_meta), path)
 
 
-def _steps(directory: str, prefix: str, *, require_meta: bool) -> list:
+def _submit(w, path: str, data, durable: bool):
+    """One async-writer submission, through the ``ckpt.write`` fault
+    site when armed (the async twin of :func:`_write_atomic` — the
+    native writer already does tmp+rename+fsync itself)."""
+    mod = _faults_mod()
+    if mod is None:
+        return w.submit(path, data, durable=durable)
+    u8 = _writable_u8(data)
+    return mod.ckpt_write(
+        path, u8, lambda: w.submit(path, u8, durable=durable))
+
+
+def _scan_steps(directory: str, prefix: str, suffix: str,
+                require_meta: bool) -> set:
+    found = set()
     if not os.path.isdir(directory):
-        return []
-    suffix = f"_p{jax.process_index()}.npz"
-    steps = []
+        return found
     for name in os.listdir(directory):
         if name.startswith(prefix) and name.endswith(suffix):
             try:
@@ -163,7 +323,22 @@ def _steps(directory: str, prefix: str, *, require_meta: bool) -> list:
             if require_meta and not os.path.exists(
                     os.path.join(directory, name[:-4] + ".json")):
                 continue
-            steps.append(step)
+            found.add(step)
+    return found
+
+
+def _steps(directory: str, prefix: str, *, require_meta: bool) -> list:
+    suffix = f"_p{jax.process_index()}.npz"
+    steps = _scan_steps(directory, prefix, suffix, require_meta)
+    # Buddy mode: a step whose primary died with its storage is STILL
+    # restorable (restore repairs it from the buddy copy), so the
+    # listing recovery walks must see it — otherwise a total primary
+    # loss silently degrades to fresh-start with healthy buddies on
+    # disk (docs/CHECKPOINT.md).
+    dur = _redundancy()
+    if dur is not None:
+        for d in dur.scan_dirs(directory, jax.process_index()):
+            steps |= _scan_steps(d, prefix, suffix, require_meta)
     return sorted(steps)
 
 
@@ -200,7 +375,7 @@ def _check_template(key: str, stored_shape, stored_dtype, leaf) -> None:
         else tuple(leaf.shape)
     t_dtype = np.dtype(getattr(leaf, "dtype", np.asarray(leaf).dtype))
     if tuple(stored_shape) != t_shape or np.dtype(stored_dtype) != t_dtype:
-        raise ValueError(
+        raise TemplateMismatchError(
             f"{key!r}: checkpoint has {tuple(stored_shape)} "
             f"{np.dtype(stored_dtype)} but template expects {t_shape} "
             f"{t_dtype} — the model changed since this checkpoint was "
@@ -264,14 +439,21 @@ def save_sharded(directory: str, tree: PyTree, *, step: int = 0,
                     "name": name}]}
     buf = _io.BytesIO()
     np.savez(buf, **arrays)
-    meta = json.dumps({"step": step, "leaves": meta_leaves})
-    w = _writer()
+    meta = {"step": step, "leaves": meta_leaves}
     path = os.path.join(directory, f"shckpt_{step}_p{proc}.npz")
-    h_data = w.submit(path, buf.getbuffer(), durable=durable)
-    h_meta = w.submit(
-        os.path.join(directory, f"shckpt_{step}_p{proc}.json"),
-        meta.encode(), durable=durable)
-    handle = CheckpointHandle((h_data, h_meta), path)
+    dur = _redundancy()
+    if dur is not None:
+        handle = dur.submit_pair(
+            _writer(), directory, f"shckpt_{step}_p{proc}",
+            buf.getbuffer(), meta, step=step, proc=proc,
+            durable=durable)
+    else:
+        w = _writer()
+        h_data = _submit(w, path, buf.getbuffer(), durable)
+        h_meta = _submit(
+            w, os.path.join(directory, f"shckpt_{step}_p{proc}.json"),
+            json.dumps(meta).encode(), durable)
+        handle = CheckpointHandle((h_data, h_meta), path)
     if wait:
         handle.wait()
     return handle
@@ -318,7 +500,11 @@ def restore_sharded(directory: str, template: PyTree,
             # corrupt global array.  Everyone restores the minimum latest.
             # The collective runs UNCONDITIONALLY on every process (with a
             # no-checkpoint sentinel) — raising before it would leave the
-            # other hosts hanging in the allgather.
+            # other hosts hanging in the allgather.  A corrupt agreed
+            # step raises the typed CheckpointCorruptError for the
+            # caller's gang-level walk-back (restart.recover's ceiling
+            # loop); walking back unilaterally here would desync the
+            # gang.
             agreed = agree_min_step(-1 if local is None else local)
             if agreed < 0:
                 raise FileNotFoundError(
@@ -334,13 +520,49 @@ def restore_sharded(directory: str, template: PyTree,
             if local is None:
                 raise FileNotFoundError(
                     f"no sharded checkpoints in {directory}")
-            step = local
+            # Single participant: a corrupt (or vanished) newest step is
+            # walk-back-one-step EVIDENCE, not a hard stop — the same
+            # contract as restart.recover over the replicated files,
+            # with each rejection recorded through the obs shim.
+            steps = _steps(directory, "shckpt_", require_meta=True)
+            last_err: Optional[BaseException] = None
+            for cand in reversed(steps):
+                try:
+                    return _restore_sharded_at(directory, template, cand)
+                except Exception as e:  # noqa: BLE001 — classified +
+                    # recorded, then fall back to the next older step
+                    _record_walkback(cand, e)
+                    last_err = e
+                    continue
+            raise last_err if last_err is not None else FileNotFoundError(
+                f"no sharded checkpoints in {directory}")
+    return _restore_sharded_at(directory, template, step)
+
+
+def _restore_sharded_at(directory: str, template: PyTree,
+                        step: int) -> PyTree:
     proc = jax.process_index()
-    data = np.load(os.path.join(directory,
-                                f"shckpt_{step}_p{proc}.npz"))
-    with open(os.path.join(directory,
-                           f"shckpt_{step}_p{proc}.json")) as f:
-        meta = json.load(f)["leaves"]
+    path = os.path.join(directory, f"shckpt_{step}_p{proc}.npz")
+    dur = _redundancy()
+    if dur is not None:
+        raw, _meta_full = dur.read_pair(
+            directory, f"shckpt_{step}_p{proc}", step=step, proc=proc)
+        meta = (_meta_full or {}).get("leaves")
+        if meta is None:
+            # A sharded pair is unrestorable without its shard-extent
+            # metadata — a torn json here is corruption, typed so the
+            # walk-back classifies it instead of crashing on None.
+            raise CheckpointCorruptError(
+                os.path.join(directory, f"shckpt_{step}_p{proc}.json"),
+                step=step, reason="shard metadata missing/unparseable")
+        data = np.load(_io.BytesIO(raw))
+    else:
+        mod = _faults_mod()
+        data = np.load(_io.BytesIO(_read_npz_bytes(path))) \
+            if mod is not None else np.load(path)
+        with open(os.path.join(
+                directory, f"shckpt_{step}_p{proc}.json")) as f:
+            meta = json.load(f)["leaves"]
 
     keys = [key for key, _ in _paths(template)]
     missing = [k for k in keys if k not in meta]
@@ -381,22 +603,38 @@ def restore_sharded(directory: str, template: PyTree,
 
 def restore(directory: str, template: PyTree,
             *, step: Optional[int] = None) -> PyTree:
-    """Restore into the structure of ``template`` (values replaced)."""
+    """Restore into the structure of ``template`` (values replaced).
+
+    With ``Config.ckpt_redundancy`` on (one string compare) the file's
+    recorded digest is verified before the bytes are parsed; a
+    mismatch repairs bit-identically from a buddy copy when one
+    verifies (``"buddy"`` mode) and otherwise raises the typed
+    :class:`CheckpointCorruptError` the recovery walk-back feeds on —
+    never a silent garbage restore."""
     if step is None:
         step = latest_step(directory)
         if step is None:
             raise FileNotFoundError(f"no checkpoints in {directory}")
     proc = jax.process_index()
     path = os.path.join(directory, f"ckpt_{step}_p{proc}.npz")
-    data = np.load(path)
-    # Recorded dtypes (see save): the authority for reinterpreting npz's
-    # void-encoded extension dtypes.  Old checkpoints without the record
-    # fall back to the template dtype for the view.
-    dtypes = {}
-    meta_path = path[:-4] + ".json"
-    if os.path.exists(meta_path):
-        with open(meta_path) as f:
-            dtypes = json.load(f).get("dtypes", {})
+    dur = _redundancy()
+    if dur is not None:
+        raw, meta_full = dur.read_pair(
+            directory, f"ckpt_{step}_p{proc}", step=step, proc=proc)
+        data = np.load(_io.BytesIO(raw))
+        dtypes = (meta_full or {}).get("dtypes", {})
+    else:
+        mod = _faults_mod()
+        data = np.load(_io.BytesIO(_read_npz_bytes(path))) \
+            if mod is not None else np.load(path)
+        # Recorded dtypes (see save): the authority for reinterpreting
+        # npz's void-encoded extension dtypes.  Old checkpoints without
+        # the record fall back to the template dtype for the view.
+        dtypes = {}
+        meta_path = path[:-4] + ".json"
+        if os.path.exists(meta_path):
+            with open(meta_path) as f:
+                dtypes = json.load(f).get("dtypes", {})
     pairs = _paths(template)
     missing = [k for k, _ in pairs if k not in data]
     if missing:
@@ -410,3 +648,105 @@ def restore(directory: str, template: PyTree,
         leaves.append(stored)
     treedef = jax.tree.structure(template)
     return jax.tree.unflatten(treedef, leaves)
+
+
+# ---------------------------------------------------------------------------
+# Recovery evidence + retention protection (docs/CHECKPOINT.md)
+# ---------------------------------------------------------------------------
+
+
+def walkback_reason(e: BaseException) -> str:
+    """Classify WHY a restore attempt rejected a step — the recovery
+    walk-back's evidence label (``restart.recover`` satellite: a
+    skipped step must say corrupt vs missing vs template mismatch, not
+    vanish into a silent ``except``).  ``corrupt`` covers the typed
+    digest failure AND an unparseable npz (torn zip, CRC mismatch);
+    ``missing`` a file the directory no longer has."""
+    import zipfile
+
+    if isinstance(e, CheckpointCorruptError):
+        return "corrupt"
+    if isinstance(e, TemplateMismatchError):
+        return "template_mismatch"
+    if isinstance(e, (FileNotFoundError, KeyError)):
+        return "missing"
+    if isinstance(e, (ValueError, OSError, zipfile.BadZipFile)):
+        # np.load on rotten bytes raises ValueError or BadZipFile (a
+        # direct Exception subclass — the CRC-mismatch signature, and
+        # with no digest recorded the only rot detector there is); an
+        # injected ENOSPC/EIO is an OSError — all storage-side.
+        return "corrupt"
+    return type(e).__name__
+
+
+def _record_walkback(step: int, e: BaseException) -> None:
+    """One rejected step in a recovery walk-back, through the obs shim
+    (``tm_ckpt_walkback_total{reason=...}`` + a ``ckpt`` flight event;
+    no-op when obs is off)."""
+    from . import telemetry
+
+    telemetry.emit("record_ckpt", "walkback", step=int(step),
+                   reason=walkback_reason(e))
+
+
+_PROTECT_LOCK = threading.Lock()
+_PROTECTED: dict = {}  # directory -> last step recovery settled on
+
+
+def protect_step(directory: str, step: int) -> None:
+    """Pin ``step`` against retention pruning in ``directory`` — called
+    by ``restart.recover`` for the step a recovery (or a guard rewind)
+    settled on, so a keep-last-K chaos soak can never prune the very
+    checkpoint the gang agreed to stand on."""
+    with _PROTECT_LOCK:
+        _PROTECTED[os.path.abspath(directory)] = int(step)
+
+
+def protected_step(directory: str) -> Optional[int]:
+    with _PROTECT_LOCK:
+        return _PROTECTED.get(os.path.abspath(directory))
+
+
+def replicate_for(directory: str, step: int, dst_procs: Sequence[int],
+                  *, src_proc: Optional[int] = None) -> None:
+    """Seed per-process checkpoint files for ``dst_procs`` at ``step``
+    from ``src_proc``'s file (default: this process) — the elastic
+    rejoin boundary's seeding primitive (docs/ELASTIC.md): recovery
+    reads only a process's own files, so a joiner needs a file under
+    its own rank.  The state is replicated by the elastic ``build``
+    contract, so the survivor's bytes ARE the joiner's bytes.
+
+    Off mode copies the npz via tmp + atomic rename (the historical
+    behavior).  With ``Config.ckpt_redundancy`` on, the source bytes
+    are digest-VERIFIED first (repairing from a buddy copy if the
+    survivor's own primary rotted — the dead-rank's-storage-died
+    scenario) and each seeded rank gets the full pair (npz + stamped
+    metadata) plus its own buddy mirrors."""
+    src = src_proc if src_proc is not None else jax.process_index()
+    dur = _redundancy()
+    if dur is not None:
+        raw, meta = dur.read_pair(directory, f"ckpt_{step}_p{src}",
+                                  step=step, proc=src)
+        for r in dst_procs:
+            dur.save_pair(directory, f"ckpt_{step}_p{int(r)}",
+                          raw, meta, step=step, proc=int(r),
+                          prune_old=False)
+        return
+    src_path = os.path.join(directory, f"ckpt_{step}_p{src}.npz")
+    mod = _faults_mod()
+    if mod is None:
+        # Off + no injection: STREAM the copy (tmp + atomic rename) —
+        # no checkpoint-sized read into host RAM at the one moment the
+        # gang is mid-recovery.
+        import shutil
+
+        for r in dst_procs:
+            dst = os.path.join(directory, f"ckpt_{step}_p{int(r)}.npz")
+            tmp = dst + ".tmp"
+            shutil.copyfile(src_path, tmp)
+            os.replace(tmp, dst)
+        return
+    raw = _read_npz_bytes(src_path)
+    for r in dst_procs:
+        _write_atomic(
+            os.path.join(directory, f"ckpt_{step}_p{int(r)}.npz"), raw)

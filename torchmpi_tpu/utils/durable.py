@@ -1,0 +1,348 @@
+"""Durable checkpoints: digests, buddy replication, retention
+(docs/CHECKPOINT.md — the ``Config.ckpt_redundancy`` layer).
+
+Every recovery path shipped so far — restart replay, elastic
+shrink/rejoin, guard rewind — bottoms out in ``utils/checkpoint.py``,
+yet the storage under it was the weakest link it protects: single-copy
+per-process files whose only post-restore check read one byte.  This
+module is the resilience layer ``checkpoint.save``/``restore`` route
+through when ``Config.ckpt_redundancy`` is on (ONE string compare at
+their entry; ``"off"`` never imports this module — the
+``analysis``/``obs``/``faults``/``guard`` discipline):
+
+- **integrity** — a blake2b digest over the serialized npz bytes
+  (:func:`~torchmpi_tpu.faults.integrity.digest_bytes`, the PR 11
+  digest home) is recorded in the per-file metadata json and
+  re-checked on every restore.  A mismatch is a typed
+  :class:`~torchmpi_tpu.utils.checkpoint.CheckpointCorruptError` the
+  recovery walk-back treats as try-the-next-older-step evidence —
+  bit-rot can cost a step, never a silent garbage restore.
+- **redundancy** (``"buddy"``) — each process mirrors its checkpoint
+  pair to ``Config.ckpt_buddies`` buddy locations, holders
+  ``(proc+1..K) mod world`` (a single-process sim mirrors to one
+  separate on-disk location under ``<dir>/buddies/``).  A restore
+  whose primary is missing or corrupt repairs from the first buddy
+  copy that verifies — rewritten over the primary via the same atomic
+  tmp+rename discipline, so the repair is bit-identical and durable.
+  This is what makes an elastic shrink survivable when the dead
+  rank's storage died with its files, and what the rejoin seeding
+  (``checkpoint.replicate_for``) leans on.
+- **retention** — ``Config.ckpt_keep`` keeps only the newest K steps
+  per process (primaries and mirrors), never pruning the step
+  recovery last settled on (``checkpoint.protect_step`` — the
+  agreed/rewind step), so a chaos soak cannot fill the disk or eat
+  its own rewind target.
+
+Telemetry (``tm_ckpt_{saved,verified,verify_failed,repaired,pruned,
+walkback}_total`` + ``ckpt`` flight events) rides
+:mod:`torchmpi_tpu.obs` through the sys.modules-gated shim — a
+checkpoint-only session never imports the telemetry it reports to.
+The ``ckpt.write``/``ckpt.read`` fault sites live one layer down in
+``checkpoint._write_atomic``/``_read_npz_bytes``, so injected
+torn-write/ENOSPC/bit-rot hits primaries, mirrors, and repairs alike.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional, Tuple
+
+import jax
+
+from . import checkpoint, telemetry
+from ..faults import integrity
+
+
+def _emit(action: str, *, step: int = 0, reason: str = "") -> None:
+    telemetry.emit("record_ckpt", action, step=step, reason=reason)
+
+
+def buddy_holders(proc: int, world: Optional[int] = None,
+                  k: Optional[int] = None) -> List[int]:
+    """The ranks holding ``proc``'s buddy copies: ``(proc+1..K) mod
+    world``, never ``proc`` itself — except on a one-process world,
+    where the single "holder" is a separate on-disk location under the
+    same rank (protects against file loss/rot, not host loss; the
+    multi-process deployment is where holders are real other
+    storages)."""
+    if world is None:
+        world = jax.process_count()
+    if k is None:
+        from .. import runtime
+
+        k = runtime.effective_config().ckpt_buddies
+    holders = []
+    for i in range(1, int(k) + 1):
+        h = (int(proc) + i) % int(world)
+        if h != int(proc) and h not in holders:
+            holders.append(h)
+    return holders or [int(proc)]
+
+
+def buddy_dir(directory: str, holder: int) -> str:
+    """The on-disk stand-in for rank ``holder``'s checkpoint storage."""
+    return os.path.join(directory, "buddies", f"r{int(holder)}")
+
+
+def _per_file_payloads(data, n: int):
+    """``n`` byte buffers for ``n`` file writes of the same content.
+    With the fault layer armed each file gets an INDEPENDENT copy —
+    injected bit-rot must rot one storage location, not the shared
+    staging buffer feeding every mirror (a shared buffer would make
+    buddy repair structurally impossible under chaos).  Unarmed, the
+    buffer is shared (zero copies)."""
+    if checkpoint._faults_mod() is None:
+        return [data] * n
+    return [bytearray(memoryview(data)) for _ in range(n)]
+
+
+def _pair_targets(directory: str, proc: int,
+                  mode: str) -> List[str]:
+    """Primary directory first, then each buddy location (created on
+    demand)."""
+    targets = [directory]
+    if mode == "buddy":
+        for h in buddy_holders(proc):
+            d = buddy_dir(directory, h)
+            os.makedirs(d, exist_ok=True)
+            targets.append(d)
+    return targets
+
+
+def save_pair(directory: str, name: str, data, meta: dict, *,
+              step: int, proc: int, prune_old: bool = True) -> str:
+    """Synchronously commit one digest-stamped checkpoint pair
+    (``<name>.npz`` + ``<name>.json``) to the primary directory and
+    every buddy location, then apply retention.  ``data`` is the
+    serialized npz; the digest is taken over it HERE, before any write
+    (and before any injected fault can touch a staging buffer), so the
+    metadata records what the saver meant to persist."""
+    from .. import runtime
+
+    cfg = runtime.effective_config()
+    meta = dict(meta or {})
+    meta["digest"] = integrity.digest_bytes(data)
+    meta_bytes = json.dumps(meta).encode()
+    targets = _pair_targets(directory, proc, cfg.ckpt_redundancy)
+    payloads = _per_file_payloads(data, len(targets))
+    for d, payload in zip(targets, payloads):
+        checkpoint._write_atomic(os.path.join(d, name + ".npz"), payload)
+        checkpoint._write_atomic(os.path.join(d, name + ".json"),
+                                 meta_bytes)
+    _emit("saved", step=step)
+    if prune_old:
+        prune(directory, name.split("_", 1)[0] + "_", proc,
+              cfg.ckpt_keep)
+    return os.path.join(directory, name + ".npz")
+
+
+def submit_pair(writer, directory: str, name: str, data, meta: dict, *,
+                step: int, proc: int, durable: bool = True):
+    """The async-writer twin of :func:`save_pair`: primary pair and
+    buddy mirrors all ride the native IO executor (FIFO — each npz
+    commits before its json), returning one
+    :class:`~torchmpi_tpu.utils.checkpoint.CheckpointHandle` over
+    every in-flight write.  Retention is DEFERRED to the handle's
+    ``wait()`` (the ``on_durable`` callback): pruning from the caller
+    thread would race older steps' still-queued writes — FIFO orders
+    completions, it does not mean they have happened — and a pruned
+    file would be resurrected by its own pending rename.  A handle
+    that is never waited prunes at the next save instead (the doomed
+    list is recomputed in full each time)."""
+    from .. import runtime
+
+    cfg = runtime.effective_config()
+    meta = dict(meta or {})
+    meta["digest"] = integrity.digest_bytes(data)
+    meta_bytes = json.dumps(meta).encode()
+    targets = _pair_targets(directory, proc, cfg.ckpt_redundancy)
+    payloads = _per_file_payloads(data, len(targets))
+    handles = []
+    for d, payload in zip(targets, payloads):
+        handles.append(checkpoint._submit(
+            writer, os.path.join(d, name + ".npz"), payload, durable))
+        handles.append(checkpoint._submit(
+            writer, os.path.join(d, name + ".json"), meta_bytes,
+            durable))
+    _emit("saved", step=step)
+    on_durable = None
+    if cfg.ckpt_keep > 0:
+        prefix = name.split("_", 1)[0] + "_"
+        keep = cfg.ckpt_keep
+
+        def on_durable():
+            prune(directory, prefix, proc, keep)
+    return checkpoint.CheckpointHandle(
+        handles, os.path.join(directory, name + ".npz"),
+        on_durable=on_durable)
+
+
+def _load_meta(path: str) -> Optional[dict]:
+    """The metadata json, or None when missing/unparseable (a torn
+    json is ABSENT evidence, not a crash — the npz digest in a buddy's
+    json can still vouch for the bytes)."""
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _read_verified(d: str, name: str) -> Tuple[bytes, Optional[dict],
+                                               Optional[str]]:
+    """Read one location's pair and check its digest.  Returns
+    ``(bytes, meta, error)`` where ``error`` is None on success, else
+    why this copy was rejected."""
+    meta = _load_meta(os.path.join(d, name + ".json"))
+    try:
+        raw = checkpoint._read_npz_bytes(os.path.join(d, name + ".npz"))
+    except OSError as e:
+        return b"", meta, f"unreadable ({e})"
+    expect = (meta or {}).get("digest", "")
+    if expect:
+        got = integrity.digest_bytes(raw)
+        if got != expect:
+            return raw, meta, f"digest {got[:12]} != {expect[:12]}"
+    elif meta is None:
+        # No digest anywhere for this copy: only acceptable for the
+        # PRIMARY of a legacy (pre-redundancy) checkpoint — the caller
+        # decides; buddies are always written with stamped metadata.
+        return raw, None, None
+    return raw, meta, None
+
+
+def read_pair(directory: str, name: str, *, step: int,
+              proc: int) -> Tuple[bytes, Optional[dict]]:
+    """Verified read of one checkpoint pair, repairing from a buddy
+    copy when the primary is missing or fails its digest check
+    (``"buddy"`` mode).  A primary whose OWN metadata is lost or torn
+    (no digest to check against) is not trusted blind in buddy mode:
+    the first buddy whose stamped pair verifies either VOUCHES for the
+    primary bytes (digests match — the primary's json is re-seeded) or
+    vetoes them (repair from the buddy); only with no verifiable buddy
+    anywhere does the digestless primary pass as a legacy checkpoint.
+    Returns ``(npz bytes, metadata dict)``; raises
+    :class:`~torchmpi_tpu.utils.checkpoint.CheckpointCorruptError`
+    when no copy verifies (or ``FileNotFoundError`` when no copy
+    exists at all) — the walk-back evidence ``restart.recover``
+    consumes."""
+    from .. import runtime
+
+    cfg = runtime.effective_config()
+    path = os.path.join(directory, name + ".npz")
+    primary_exists = os.path.exists(path)
+    first_err = ""
+    unvouched = None  # a readable primary with no digest of its own
+    if primary_exists:
+        raw, meta, err = _read_verified(directory, name)
+        if err is None:
+            if (meta or {}).get("digest"):
+                _emit("verified", step=step)
+                return raw, meta
+            if cfg.ckpt_redundancy != "buddy":
+                return raw, meta  # legacy pair; nothing to check against
+            unvouched = (raw, meta)
+        else:
+            first_err = err
+            _emit("verify_failed", step=step, reason="primary")
+    if cfg.ckpt_redundancy == "buddy":
+        for h in buddy_holders(proc):
+            d = buddy_dir(directory, h)
+            if not os.path.exists(os.path.join(d, name + ".npz")):
+                continue
+            raw, meta, err = _read_verified(d, name)
+            if err is not None or not (meta or {}).get("digest"):
+                _emit("verify_failed", step=step, reason=f"buddy_r{h}")
+                continue
+            meta_bytes = json.dumps(meta).encode()
+            if unvouched is not None and \
+                    integrity.digest_bytes(unvouched[0]) == meta["digest"]:
+                # The buddy vouches for the digestless primary: same
+                # bytes, so only the primary's json needs re-seeding.
+                try:
+                    checkpoint._write_atomic(
+                        os.path.join(directory, name + ".json"),
+                        meta_bytes)
+                except OSError:
+                    pass
+                _emit("verified", step=step)
+                return unvouched[0], meta
+            if unvouched is not None:
+                # The buddy VETOES the primary bytes — the digestless
+                # primary was rot after all.
+                first_err = "no local digest; buddy digest differs"
+                _emit("verify_failed", step=step, reason="primary")
+                unvouched = None
+            # Repair: rewrite the primary pair bit-identically via the
+            # same atomic+fsync discipline, so the NEXT restore (and
+            # any peer seeding from this rank) finds a healthy copy.
+            try:
+                checkpoint._write_atomic(path, raw)
+                checkpoint._write_atomic(
+                    os.path.join(directory, name + ".json"), meta_bytes)
+            except OSError:
+                pass  # the bytes are good even if the disk still isn't
+            _emit("repaired", step=step, reason=f"buddy_r{h}")
+            return raw, meta
+        if unvouched is not None:
+            # Readable digestless primary, no verifiable buddy to
+            # vouch or veto: the legacy acceptance.
+            return unvouched
+    if not primary_exists:
+        raise FileNotFoundError(
+            f"{path}: no checkpoint copy exists (primary missing, "
+            f"no verifiable buddy)")
+    raise checkpoint.CheckpointCorruptError(
+        path, step=step, reason=f"primary {first_err}; no buddy copy "
+                                f"verified")
+
+
+def prune(directory: str, prefix: str, proc: int, keep: int) -> None:
+    """Keep-last-``keep`` retention over one process's ``prefix`` steps
+    (primaries AND buddy mirrors).  The protected step — the one
+    recovery last settled on (``checkpoint.protect_step``) — is never
+    pruned, whatever its age: a soak that rewinds to it must find it.
+    ``keep <= 0`` disables."""
+    if keep <= 0:
+        return
+    suffix = f"_p{int(proc)}.npz"
+    steps = []
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return
+    for fname in names:
+        if fname.startswith(prefix) and fname.endswith(suffix):
+            try:
+                steps.append(int(fname[len(prefix):-len(suffix)]))
+            except ValueError:
+                continue
+    steps.sort()
+    protected = checkpoint.protected_step(directory)
+    doomed = [s for s in steps[:-keep] if s != protected]
+    for s in doomed:
+        name = f"{prefix}{s}_p{int(proc)}"
+        dirs = [directory] + [buddy_dir(directory, h)
+                              for h in buddy_holders(proc)]
+        for d in dirs:
+            for ext in (".npz", ".json"):
+                try:
+                    os.remove(os.path.join(d, name + ext))
+                except OSError:
+                    pass
+        _emit("pruned", step=s)
+
+
+def scan_dirs(directory: str, proc: int) -> List[str]:
+    """The buddy locations whose copies count as restorable steps for
+    ``proc`` (``checkpoint._steps`` unions them into the listing in
+    ``"buddy"`` mode — a step that only survives on a buddy is still a
+    step)."""
+    from .. import runtime
+
+    if runtime.effective_config().ckpt_redundancy != "buddy":
+        return []
+    return [d for d in (buddy_dir(directory, h)
+                        for h in buddy_holders(proc))
+            if os.path.isdir(d)]

@@ -199,6 +199,10 @@ def recover(init_fn: Callable[[], PyTree], directory: str,
     def settled(state, step):
         if step > 0:
             _fsync_verify(directory, step)
+        # Pin the settled step against retention pruning: the step a
+        # recovery (or a guard rewind) agreed to stand on must survive
+        # a keep-last-K chaos soak (docs/CHECKPOINT.md).
+        checkpoint.protect_step(directory, step)
         _obs_record("recovered" if step > 0 else "fresh_start", step)
         return state, step
 
@@ -209,7 +213,12 @@ def recover(init_fn: Callable[[], PyTree], directory: str,
             try:
                 return settled(checkpoint.restore(directory, template,
                                                   step=step), step)
-            except Exception:  # noqa: BLE001 — fall back to older
+            except Exception as e:  # noqa: BLE001 — fall back to older,
+                # recording WHY this step was rejected (corrupt vs
+                # missing vs template mismatch) so a post-mortem can
+                # see what the walk-back walked past, not just where
+                # it landed.
+                checkpoint._record_walkback(step, e)
                 continue
         return settled(init_fn(), 0)
     ceiling = None
@@ -223,7 +232,8 @@ def recover(init_fn: Callable[[], PyTree], directory: str,
         try:
             state = checkpoint.restore(directory, template,
                                        step=agreed)
-        except Exception:  # noqa: BLE001 — resolved collectively
+        except Exception as e:  # noqa: BLE001 — resolved collectively
+            checkpoint._record_walkback(agreed, e)
             ok = 0
         if agree(ok):
             return settled(state, agreed)
