@@ -176,6 +176,59 @@ def _faults_compare_mode(args, mpi, n):
           file=sys.stderr)
 
 
+def _watchdog_compare_mode(args, mpi, n):
+    """Dispatch overhead of the collective watchdog on its instrumented
+    hot path: the same small STAGED allreduce (the eager surface whose
+    planned replay carries the begin/end in-flight window when armed)
+    timed under watchdog=off / warn / break (docs/WATCHDOG.md
+    acceptance: off->break must sit within the same noise floor the
+    obs/faults branches establish).  No stalls injected — a stall
+    would measure the stall, not the monitor."""
+    import numpy as np
+
+    from torchmpi_tpu.utils import metrics as umetrics
+
+    x = np.random.RandomState(0).rand(n, 1024).astype(np.float32)
+    modes = ("off", "warn", "break")
+    # INTERLEAVED passes: measuring each mode in one sequential block
+    # lets container load drift between blocks dominate the ~tens-of-us
+    # signal (observed: the off/break delta flips sign run to run).
+    # Alternating the modes per pass puts every mode under the same
+    # drift; the per-mode median-of-passes is then comparable.
+    samples = {m: [] for m in modes}
+    for _ in range(4):
+        for mode in modes:
+            mpi.set_config(watchdog=mode)  # clears the plan table
+            mpi.allreduce(x, backend="host")  # re-plan under this mode
+            samples[mode].append(umetrics.timed(
+                lambda: mpi.allreduce(x, backend="host"),
+                iters=args.iters, rounds=3))
+    mpi.set_config(watchdog="off")
+
+    def med(vals):
+        s = sorted(vals)
+        return s[len(s) // 2]
+
+    results = {}
+    for mode in modes:
+        m_us = med([r.median for r in samples[mode]]) * 1e6
+        j_us = med([r.jitter for r in samples[mode]]) * 1e6
+        results[mode] = (m_us, j_us)
+        line = {"mode": mode, "us_per_dispatch": round(m_us, 2),
+                "jitter_us": round(j_us, 2)}
+        print(json.dumps(line) if args.json else
+              f"watchdog={mode:6s} {m_us:9.2f} us/dispatch "
+              f"(jitter {j_us:.2f} us)")
+    delta = results["break"][0] - results["off"][0]
+    floor = results["off"][1] + results["break"][1]
+    # One-sided on purpose: this is an OVERHEAD check — a negative
+    # delta is measurement noise, not a speedup to report.
+    verdict = "WITHIN NOISE" if delta <= floor else "MEASURABLE"
+    print(f"# break-vs-off delta {delta:+.2f} us "
+          f"(noise floor {floor:.2f} us): {verdict}",
+          file=sys.stderr)
+
+
 def _guard_compare_mode(args, mpi, n):
     """Dispatch overhead of the guard layer (docs/GUARD.md), in two
     halves.  **wire**: the same small STAGED allreduce (the surface
@@ -634,6 +687,11 @@ def main():
                    help="fault-layer overhead mode: the same small "
                         "staged allreduce under faults=off/policy "
                         "(docs/FAULTS.md)")
+    p.add_argument("--watchdog-compare", action="store_true",
+                   help="watchdog overhead mode: the same small staged "
+                        "allreduce under watchdog=off/warn/break (the "
+                        "armed in-flight window + monitor thread, no "
+                        "stalls injected) — docs/WATCHDOG.md")
     p.add_argument("--guard-compare", action="store_true",
                    help="guard overhead mode: the same small staged "
                         "allreduce under guard=off/wire (digest cost) "
@@ -707,6 +765,11 @@ def main():
 
     if args.faults_compare:
         _faults_compare_mode(args, mpi, n)
+        mpi.stop()
+        return
+
+    if args.watchdog_compare:
+        _watchdog_compare_mode(args, mpi, n)
         mpi.stop()
         return
 

@@ -20,13 +20,29 @@ import common
 
 
 def main():
-    args = common.parse_args(__doc__, eager_loss=dict(
-        action="store_true",
-        help="reduce the per-step logging loss via the EAGER host-staged "
-             "rank-major allreduce (backend='host') — the surface the "
-             "guard-smoke CI wounds with corrupt_silent (docs/GUARD.md); "
-             "prints a LOSS-DIGEST line for bit-identity checks"))
+    args = common.parse_args(
+        __doc__,
+        eager_loss=dict(
+            action="store_true",
+            help="reduce the per-step logging loss via the EAGER "
+                 "host-staged rank-major allreduce (backend='host') — "
+                 "the surface the guard-smoke CI wounds with "
+                 "corrupt_silent (docs/GUARD.md) and the watchdog-smoke "
+                 "CI wedges with a stall (docs/WATCHDOG.md); prints a "
+                 "LOSS-DIGEST line for bit-identity checks"),
+        restart_loop=dict(
+            action="store_true",
+            help="drive the steps through restart.run_with_restarts "
+                 "(periodic checkpoints + restore-and-replay recovery) "
+                 "— the watchdog-smoke CI recipe: a seeded stall on the "
+                 "eager-loss staged path under watchdog=break is broken "
+                 "into a typed CollectiveHangError and recovered; "
+                 "prints RECOVERED-STEP / RESTARTS"),
+        save_every={"type": int, "default": 10,
+                    "help": "checkpoint cadence (--restart-loop only)"})
     import hashlib
+    import shutil
+    import tempfile
 
     import jax
     import numpy as np
@@ -65,36 +81,79 @@ def main():
     X, Y = dutil.synthetic_mnist(4096, seed=args.seed)
     timer = common.StepTimer()
     timer.start()
-    losses = []
-    for i, (xb, yb) in enumerate(
-            dutil.batches(X, Y, args.batch_size, steps=args.steps,
-                          seed=args.seed)):
+    # Keyed by step (not appended) so a restart's replayed steps
+    # overwrite their own slots: the digest of a recovered run is
+    # bit-identical to a clean one when the replay reproduces the same
+    # losses — the watchdog-smoke CI verdict.
+    losses = {}
+
+    def train_step(params, opt_state, i, xb, yb):
         params, opt_state, loss = dp_step(params, opt_state, xb, yb)
         loss_v = float(loss)
         if args.eager_loss:
             # Route the (replicated) step loss through the eager
             # HOST-STAGED rank-major allreduce: the payload round-trips
             # through host memory — the end-to-end surface the wire
-            # guard digests and the guard-smoke chaos plan corrupts.
+            # guard digests, the guard-smoke chaos plan corrupts, and
+            # the watchdog-smoke chaos plan stalls.
             red = mpi.allreduce(
                 np.full((n_dev, 1), loss_v, np.float32), op="mean",
                 backend="host")
             loss_v = float(np.asarray(red)[0, 0])
-        losses.append(loss_v)
+        losses[i] = loss_v
         timer.tick()
         if i % 20 == 0 or i == args.steps - 1:
             print(f"step {i:4d}  loss {loss_v:.4f}")
+        return params, opt_state
+
+    if args.restart_loop:
+        from torchmpi_tpu.utils import restart
+
+        batches = list(dutil.batches(X, Y, args.batch_size,
+                                     steps=args.steps, seed=args.seed))
+
+        def init_fn():
+            p, _, o, _ = common.make_train_tools(
+                model, (1, 28, 28, 1), args.lr, args.momentum, args.seed)
+            return {"params": mpi.nn.synchronize_parameters(p),
+                    "opt": mpi.nn.synchronize_parameters(o)}
+
+        def step_fn(state, i):
+            xb, yb = batches[i]
+            p, o = train_step(state["params"], state["opt"], i, xb, yb)
+            return {"params": p, "opt": o}
+
+        ckpt_dir = tempfile.mkdtemp(prefix="tm_wd_ckpt_")
+        try:
+            state, info = restart.run_with_restarts(
+                init_fn, step_fn, steps=args.steps, directory=ckpt_dir,
+                save_every=args.save_every)
+        finally:
+            shutil.rmtree(ckpt_dir, ignore_errors=True)
+        params = state["params"]
+        print(f"RESTARTS {info['restarts_used']}")
+        print(f"RECOVERED-STEP {info['recovered_step']}")
+    else:
+        for i, (xb, yb) in enumerate(
+                dutil.batches(X, Y, args.batch_size, steps=args.steps,
+                              seed=args.seed)):
+            params, opt_state = train_step(params, opt_state, i, xb, yb)
     acc = common.evaluate(model, params, X[:1024], Y[:1024])
     print(f"final accuracy {acc:.3f}  ({timer.rate(args.batch_size):.0f} img/s)")
     if args.eager_loss:
-        # Bit-identity evidence for the guard-smoke CI: the digest of
-        # every loss that crossed the (possibly wounded) staged path.
+        # Bit-identity evidence for the guard-/watchdog-smoke CI: the
+        # digest of every loss that crossed the (possibly wounded)
+        # staged path, in step order.
         dig = hashlib.blake2b(
-            np.asarray(losses, np.float32).tobytes(),
+            np.asarray([losses[i] for i in sorted(losses)],
+                       np.float32).tobytes(),
             digest_size=16).hexdigest()
         print(f"LOSS-DIGEST {dig}")
     mpi.stop()
-    assert acc > 0.9, "data-parallel MNIST did not converge"
+    # Short recovery-recipe runs stop before convergence; the full
+    # default run keeps its regression bar.
+    assert args.steps < 60 or acc > 0.9, \
+        "data-parallel MNIST did not converge"
 
 
 if __name__ == "__main__":

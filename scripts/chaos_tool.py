@@ -31,17 +31,26 @@ serialized npz bytes), so ``corrupt``/``corrupt_silent`` flip bits
 that land on (or come back from) disk; ``torn`` — ``ckpt.write``
 only, lint rejects it elsewhere — leaves a truncated ``.tmp``
 artifact and kills the save (the crash-mid-write double); ``fail``
-is ENOSPC-flavored on write, EIO on read.  ``summarize`` reads
+is ENOSPC-flavored on write, EIO on read.  The ``stall`` kind
+(docs/WATCHDOG.md) is the silent hang: the site stops making progress
+and raises nothing — valid at EVERY site, payload-free ones included
+(the failure is the absence of progress, there is nothing to flip);
+``delay_s`` on a stall is linted (the hold is indefinite by
+definition).  ``--stall RANK:STEP:NRANKS`` is the gang-wedge recipe:
+a stall on rank RANK's ``elastic.member`` liveness check at step
+STEP, the deterministic "one rank wedges the whole gang" scenario
+the watchdog acceptance drives.  ``summarize`` reads
 per-host obs metric dumps (the files ``TORCHMPI_TPU_OBS=metrics``
 leaves behind) and prints the ``tm_fault_*``, ``tm_elastic_*``,
-``tm_guard_*``, and ``tm_ckpt_*`` series — what was injected, what
-survived a retry, what hit a deadline, what shrink/rejoin the gang
-ran, what digests failed/healed, what updates the numeric tripwire
-skipped, and what checkpoint copies failed verification, were
-repaired from buddies, or were walked past by recovery — the
-after-action report of a chaos run; exits 1 when a chaos run left NO
-fault counters (it injected nothing: wrong plan, wrong sites, or
-faults never armed).
+``tm_guard_*``, ``tm_ckpt_*``, and ``tm_watchdog_*`` series — what
+was injected, what survived a retry, what hit a deadline, what
+shrink/rejoin the gang ran, what digests failed/healed, what updates
+the numeric tripwire skipped, what checkpoint copies failed
+verification, were repaired from buddies, or were walked past by
+recovery, and what collectives the watchdog flagged stalled / broke /
+escalated — the after-action report of a chaos run; exits 1 when a
+chaos run left NO fault counters (it injected nothing: wrong plan,
+wrong sites, or faults never armed).
 
 Standalone on purpose: no jax — writing a chaos plan for a pod (or
 reading its post-mortem) must not need the pod's software stack.  The
@@ -71,11 +80,16 @@ def _load_inject():
 
 
 def parse_rule(inject, spec: str):
-    """``site:kind[:prob[:max_hits[:delay_s]]]`` -> FaultRule."""
+    """``site:kind[:prob[:max_hits[:delay_s[:after]]]]`` -> FaultRule.
+    ``after`` skips the first N arrivals — how a plain --rule lands a
+    fault at a specific mid-run arrival (the boundary recipes compute
+    it for the ``elastic.member`` site; everywhere else the arrival
+    ordinal is the site's dispatch count)."""
     parts = spec.split(":")
-    if len(parts) < 2 or len(parts) > 5:
+    if len(parts) < 2 or len(parts) > 6:
         raise ValueError(
-            f"--rule {spec!r}: want site:kind[:prob[:max_hits[:delay_s]]]")
+            f"--rule {spec!r}: want "
+            f"site:kind[:prob[:max_hits[:delay_s[:after]]]]")
     kw = {"site": parts[0], "kind": parts[1]}
     if len(parts) > 2:
         kw["prob"] = float(parts[2])
@@ -83,28 +97,47 @@ def parse_rule(inject, spec: str):
         kw["max_hits"] = int(parts[3])
     if len(parts) > 4:
         kw["delay_s"] = float(parts[4])
+    if len(parts) > 5:
+        kw["after"] = int(parts[5])
     rule = inject.FaultRule(**kw)
     rule.validate()
     return rule
 
 
-def parse_shrink(inject, spec: str):
-    """``RANK:STEP:NRANKS`` -> a deterministic kill-rank-at-step rule
-    on the ``elastic.member`` site (the gang fires it once per member
-    per step boundary in rank order, so the arrival ordinal is
+def _boundary_rule(inject, flag: str, spec: str, kind: str):
+    """``RANK:STEP:NRANKS`` -> a deterministic rule at the
+    ``elastic.member`` site (the gang fires it once per member per step
+    boundary in rank order, so the arrival ordinal is
     ``STEP*NRANKS + RANK``)."""
     parts = spec.split(":")
     if len(parts) != 3:
-        raise ValueError(f"--shrink {spec!r}: want RANK:STEP:NRANKS")
+        raise ValueError(f"{flag} {spec!r}: want RANK:STEP:NRANKS")
     rank, step, nranks = (int(p) for p in parts)
     if nranks < 1 or not (0 <= rank < nranks) or step < 0:
         raise ValueError(
-            f"--shrink {spec!r}: need 0 <= RANK < NRANKS and STEP >= 0")
-    rule = inject.FaultRule(site="elastic.member", kind="fail",
+            f"{flag} {spec!r}: need 0 <= RANK < NRANKS and STEP >= 0")
+    rule = inject.FaultRule(site="elastic.member", kind=kind,
                             prob=1.0, after=step * nranks + rank,
                             max_hits=1)
     rule.validate()
     return rule, rank, step, nranks
+
+
+def parse_shrink(inject, spec: str):
+    """Kill-rank-at-step recipe (``fail`` at ``elastic.member``)."""
+    return _boundary_rule(inject, "--shrink", spec, "fail")
+
+
+def parse_stall(inject, spec: str):
+    """Wedge-rank-at-step recipe (docs/WATCHDOG.md): a ``stall`` at
+    member RANK's liveness check at step STEP — every process of the
+    gang holds at that same boundary arrival, which is exactly the
+    symmetric wedge a peer stalled mid-collective produces.  With the
+    watchdog off the gang hangs until the harness timeout; with
+    ``break`` every rank's hold converts into a
+    ``CollectiveHangError`` implicating ``member:RANK`` and the gang
+    shrinks to N-1."""
+    return _boundary_rule(inject, "--stall", spec, "stall")
 
 
 def cmd_gen(args) -> int:
@@ -128,12 +161,20 @@ def cmd_gen(args) -> int:
             print(f"shrink recipe: kill rank {rank} at step {step} of a "
                   f"{nranks}-rank gang (elastic.member arrival "
                   f"{rule.after})")
+        for spec in args.stall:
+            rule, rank, step, nranks = parse_stall(inject, spec)
+            rules.append(rule)
+            print(f"stall recipe: wedge the gang on rank {rank}'s "
+                  f"liveness check at step {step} of a {nranks}-rank "
+                  f"gang (elastic.member arrival {rule.after}; "
+                  f"watchdog=break recovers at N-1, watchdog=off hangs "
+                  f"— docs/WATCHDOG.md)")
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
     if not rules:
-        print("error: gen needs at least one --rule or --shrink",
-              file=sys.stderr)
+        print("error: gen needs at least one --rule, --shrink or "
+              "--stall", file=sys.stderr)
         return 2
     plan = inject.FaultPlan(seed=args.seed, note=args.note, rules=rules)
     problems = inject.lint_plan(plan)
@@ -186,7 +227,8 @@ def cmd_summarize(args) -> int:
         for rec in _load_counters(path):
             name = rec.get("name", "")
             if not name.startswith(("tm_fault_", "tm_elastic_",
-                                    "tm_guard_", "tm_ckpt_")):
+                                    "tm_guard_", "tm_ckpt_",
+                                    "tm_watchdog_")):
                 continue
             key = (name, tuple(sorted(rec.get("labels", {}).items())))
             totals[key] = totals.get(key, 0) + rec.get("value", 0)
@@ -220,13 +262,19 @@ def main(argv=None) -> int:
     s.add_argument("--seed", type=int, default=0)
     s.add_argument("--note", default="")
     s.add_argument("--rule", action="append", default=[],
-                   help="site:kind[:prob[:max_hits[:delay_s]]] "
+                   help="site:kind[:prob[:max_hits[:delay_s[:after]]]] "
                         "(repeatable)")
     s.add_argument("--shrink", action="append", default=[],
                    help="RANK:STEP:NRANKS — elastic-gang recipe: kill "
                         "rank RANK at step STEP of an NRANKS-rank gang "
                         "(once per plan — later kills' arrival "
                         "ordinals shift after the first shrink)")
+    s.add_argument("--stall", action="append", default=[],
+                   help="RANK:STEP:NRANKS — watchdog recipe "
+                        "(docs/WATCHDOG.md): wedge the gang on rank "
+                        "RANK's liveness check at step STEP (a silent "
+                        "indefinite hold; watchdog=break converts it "
+                        "into a typed hang + N-1 shrink)")
     s.set_defaults(fn=cmd_gen)
 
     s = sub.add_parser("lint", help="validate plan files")

@@ -11,6 +11,7 @@ This tool is the operator surface over those files:
     python scripts/obs_tool.py diff  BEFORE AFTER
     python scripts/obs_tool.py prom  FILE [FILE ...]
     python scripts/obs_tool.py blame FLIGHT [FLIGHT ...]
+    python scripts/obs_tool.py blame --live LEASE_DIR
     python scripts/obs_tool.py slo   FILE [FILE ...]
 
 ``slo`` reads a serving session's metric dumps and prints per-replica
@@ -28,7 +29,13 @@ collective — the runtime complement of the static analyzer's D1/D3
 deadlock rules: hosts of one SPMD gang must issue identical collective
 sequences, so the first seq where op/bytes differ (or where one host
 keeps launching past the others' last event) is where the hang began.
-Exits nonzero on divergence (blame) or unparseable input.
+Since the ring records BOTH edges of a collective (dispatch + the
+``*_done`` completion events), the laggard's last event distinguishes
+"launched and stuck inside it" from "completed, never launched the
+next".  ``blame --live <dir>`` skips the dump entirely: it reads the
+collective watchdog's liveness leases (``wd_lease_*.json``,
+docs/WATCHDOG.md) while the job runs and names the stalled/expired
+rank live.  Exits nonzero on divergence (blame) or unparseable input.
 
 Standalone on purpose: no jax — parsing a pod's post-mortem must not
 need the pod's software stack.  The Prometheus renderer is loaded
@@ -260,7 +267,40 @@ def _event_sig(e: dict) -> Tuple:
             e.get("backend"))
 
 
+# Dispatch events that now have a matching completion edge in the ring
+# (docs/WATCHDOG.md): a laggard whose LAST event is one of these died
+# INSIDE that collective; a laggard whose last event is a *_done edge
+# completed its last collective and hung before launching the next.
+_DISPATCH_EVENTS = ("eager", "barrier")
+_DONE_EVENTS = ("eager_done", "barrier_done", "ps_wait_done")
+
+
+def _laggard_verdict(last_event: dict) -> str:
+    ev = last_event.get("ev", "")
+    what = f"{ev}:{last_event.get('op') or last_event.get('detail')}"
+    if ev in _DISPATCH_EVENTS:
+        return (f"last event is a DISPATCH ({what}) with no completion "
+                f"edge — launched and stuck INSIDE that collective")
+    if ev == "eager_done" and last_event.get("backend") != "host":
+        # The direct (XLA) path's done edge marks the ASYNC ENQUEUE
+        # returning, not device execution finishing — a wedge in the
+        # fabric still happens after this edge, at the blocking
+        # readiness wait.
+        return (f"last event is the dispatch-returned edge ({what}, "
+                f"direct backend) — the collective was enqueued; the "
+                f"wedge is in its device execution or in whatever "
+                f"comes after (check async waits / block_until_ready)")
+    if ev in _DONE_EVENTS:
+        return (f"last event is a COMPLETION edge ({what}) — its last "
+                f"collective finished; the NEXT one was never launched "
+                f"(stuck between collectives: data loader, host code, "
+                f"or a non-collective wait)")
+    return f"last event: {what}"
+
+
 def cmd_blame(args) -> int:
+    if getattr(args, "live", False):
+        return cmd_blame_live(args)
     streams: Dict[str, Dict[int, dict]] = {}
     for path in args.files:
         meta, records = load_jsonl(path)
@@ -309,8 +349,115 @@ def cmd_blame(args) -> int:
             print(f"  {n}: reached seq {e}; first extra event: {desc}")
         print("the lagging host(s) likely hang in (or before) that "
               "collective")
+        for n in sorted(set(names) - set(ahead)):
+            # Both edges are recorded now (dispatch + completion), so
+            # the laggard's last event says WHERE it died: inside its
+            # last collective, or between collectives.
+            print(f"  {n}: {_laggard_verdict(streams[n][ends[n]])}")
         return 1
     print(f"aligned: {len(names)} hosts agree on seqs {lo}..{hi}")
+    return 0
+
+
+def _load_leases(directory: str) -> Dict[int, dict]:
+    """Parse every ``wd_lease_*.json`` under ``directory`` (the
+    collective watchdog's liveness leases, docs/WATCHDOG.md) keyed by
+    rank.  Parsing is inlined on purpose — this tool must not import
+    the pod's software stack; the lease schema is self-describing
+    (each lease carries its own ``ttl_s``)."""
+    out: Dict[int, dict] = {}
+    try:
+        names = sorted(n for n in os.listdir(directory)
+                       if n.startswith("wd_lease_") and n.endswith(".json"))
+    except OSError as e:
+        raise ValueError(f"{directory}: {e}") from None
+    for name in names:
+        try:
+            with open(os.path.join(directory, name)) as f:
+                d = json.load(f)
+            out[int(d["rank"])] = d
+        except (OSError, ValueError, KeyError):
+            continue  # torn mid-renewal — same as unrenewed
+    return out
+
+
+def cmd_blame_live(args) -> int:
+    """``blame --live <dir>``: read the watchdog leases while the job
+    RUNS — no dumps, no SIGTERM — and name the implicated rank(s).
+    The triage matrix (docs/WATCHDOG.md): a rank whose lease is FRESH
+    but whose collective is STALLED is wedged on a *peer*; an EXPIRED
+    or ``escalated`` lease is that rank's own death evidence.  Exits 1
+    when anything is stalled/expired, 0 when all ranks look healthy,
+    2 on unusable input."""
+    import time
+
+    if len(args.files) != 1:
+        print("blame --live takes exactly one lease DIRECTORY "
+              "(Config.watchdog_dir / the membership board)",
+              file=sys.stderr)
+        return 2
+    directory = args.files[0]
+    leases = _load_leases(directory)
+    if not leases:
+        print(f"no wd_lease_*.json under {directory} — is the watchdog "
+              f"armed (Config.watchdog != 'off') with watchdog_dir "
+              f"pointing here?", file=sys.stderr)
+        return 2
+    now = time.time()
+    implicated = []
+    stalled_peers = set()
+    print(f"live watchdog leases in {directory} ({len(leases)} rank(s)):")
+    for rank in sorted(leases):
+        d = leases[rank]
+        age = now - float(d.get("ts", 0))
+        expired = age > float(d.get("ttl_s", 0))
+        stalls = [e for e in d.get("inflight", []) if e.get("stalled")]
+        if d.get("escalated"):
+            state = (f"ESCALATED (watchdog exited the process on an "
+                     f"unbreakable stall; lease renewed {age:.1f}s ago)")
+            implicated.append(rank)
+        elif expired:
+            state = (f"EXPIRED (last renewed {age:.1f}s ago, ttl "
+                     f"{d.get('ttl_s')}s) — dead, or wedged beyond its "
+                     f"own watchdog")
+            implicated.append(rank)
+        elif stalls:
+            parts = ", ".join(
+                f"{e.get('site')}"
+                + (f" op={e.get('op')}" if e.get("op") else "")
+                + (f" peer={e.get('peer')}" if e.get("peer") else "")
+                + f" for {e.get('elapsed_s', 0):.3g}s"
+                + (" [break requested]" if e.get("break_requested")
+                   else "")
+                for e in stalls)
+            state = f"LIVE but STALLED in {parts}"
+            stalled_peers.update(e.get("peer") for e in stalls
+                                 if e.get("peer"))
+        elif d.get("inflight"):
+            state = (f"LIVE (renewed {age:.1f}s ago), "
+                     f"{len(d['inflight'])} collective(s) in flight")
+        else:
+            state = f"LIVE idle (renewed {age:.1f}s ago)"
+        print(f"  rank {rank}: {state}")
+    verdicts = []
+    if implicated:
+        verdicts.append(
+            f"rank(s) {implicated} implicated (expired/escalated lease "
+            f"— the elastic layer treats this as death evidence)")
+    stalled_ranks = [r for r in sorted(leases)
+                     if any(e.get("stalled")
+                            for e in leases[r].get("inflight", []))]
+    if stalled_ranks and not implicated:
+        peers = sorted(p for p in stalled_peers if p and p != "gang")
+        blame_s = f"; stalls implicate {peers}" if peers else ""
+        verdicts.append(
+            f"rank(s) {stalled_ranks} stalled with fresh leases — the "
+            f"hang is on a peer (or the fabric), not their own "
+            f"liveness{blame_s}")
+    if verdicts:
+        print("verdict: " + "; ".join(verdicts))
+        return 1
+    print("verdict: all ranks healthy (fresh leases, no stalls)")
     return 0
 
 
@@ -337,8 +484,16 @@ def main(argv=None) -> int:
     s.set_defaults(fn=cmd_prom)
 
     s = sub.add_parser("blame", help="align per-host flight recorders, "
-                                     "name the first diverging collective")
-    s.add_argument("files", nargs="+")
+                                     "name the first diverging collective; "
+                                     "--live reads watchdog leases from a "
+                                     "directory while the job runs")
+    s.add_argument("files", nargs="+",
+                   help="flight_host*.jsonl files, or with --live ONE "
+                        "lease directory (Config.watchdog_dir)")
+    s.add_argument("--live", action="store_true",
+                   help="read wd_lease_*.json liveness leases "
+                        "(docs/WATCHDOG.md) instead of post-mortem "
+                        "flight dumps")
     s.set_defaults(fn=cmd_blame)
 
     s = sub.add_parser("slo", help="per-replica p50/p95/p99 TTFT and "

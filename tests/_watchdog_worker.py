@@ -1,0 +1,180 @@
+"""Worker for the 2-process watchdog hang acceptance
+(tests/test_watchdog.py / the watchdog-smoke CI job; underscore prefix
+keeps pytest from collecting it).
+
+The docs/WATCHDOG.md acceptance scenario, one phase per argv mode:
+
+- hang  : a 2-process gang trains under a seeded ``elastic.member``
+          STALL plan (``chaos_tool gen --stall``) with
+          ``watchdog="break"`` and leases on the membership board.
+          Every process wedges at the same boundary arrival — the
+          symmetric "one rank stalls the whole gang" hang.  The
+          watchdog flags the stall at 1x the deadline (the window the
+          parent test reads with ``obs_tool blame --live``), breaks it
+          at 1.5x into a ``CollectiveHangError`` implicating
+          ``member:1``: rank 1 raises ``MemberDeath`` and exits
+          (``CHECK rank=1 member-death ok``); rank 0 shrinks to N-1,
+          recovers the last checkpoint, finishes the run, and prints a
+          ``WATCHDOG-SUMMARY`` JSON line with shrink counts, the
+          recovered step, ``tm_watchdog_{stalled,broken}_total``, and
+          digests of the post-recovery loss trajectory + final params.
+- clean : a from-scratch 1-process N-1 run restored from the SAME
+          checkpoint step (the driver copies only that step's files
+          into a fresh directory) — its digests must be BIT-identical
+          to the hang survivor's.
+
+argv: pid nproc port mode directory plan_path
+"""
+
+import hashlib
+import json
+import os
+import sys
+
+pid = int(sys.argv[1])
+nproc = int(sys.argv[2])
+port = sys.argv[3]
+mode = sys.argv[4]
+directory = sys.argv[5]
+plan_path = sys.argv[6] if len(sys.argv) > 6 else ""
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+if nproc > 1:
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+import numpy as np  # noqa: E402
+
+import torchmpi_tpu as mpi  # noqa: E402
+
+import jax.numpy as jnp  # noqa: E402
+from jax import lax, shard_map  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+STEPS = 10
+DIM, H, B = 4, 8, 8
+LR = 0.05
+WD_DEADLINE_S = 4.0  # stalled at 4s (the live-blame window), broken at 6s
+
+
+def _slot_batch(slot, step):
+    rng = np.random.RandomState(10_000 + slot * 97 + step)
+    return (rng.randn(B, DIM).astype(np.float32),
+            rng.randn(B, 1).astype(np.float32))
+
+
+def _to_np(a):
+    if isinstance(a, jax.Array) and not a.is_fully_addressable:
+        return np.asarray(a.addressable_data(0))
+    return np.asarray(a)
+
+
+def build(mesh, view):
+    """Same per-view program as tests/_elastic_worker.py: 2-layer MLP,
+    data-parallel over the view's devices, per-(device-slot, step)
+    deterministic batches keyed by MEMBER id — a survivors-only gang
+    sees exactly the data a from-scratch N-1 run would."""
+    axes = tuple(mesh.axis_names)
+    per = mesh.devices.size // len(view.members)
+    slots = [m * per + j for m in view.members for j in range(per)]
+
+    def init_fn():
+        rng = np.random.RandomState(0)
+        params = {"w1": (rng.randn(DIM, H) * 0.3).astype(np.float32),
+                  "b1": np.zeros((H,), np.float32),
+                  "w2": (rng.randn(H, 1) * 0.3).astype(np.float32)}
+        return {"params": params,
+                "losses": np.full((STEPS,), np.nan, np.float32)}
+
+    def body(p, x, y):
+        x, y = x[0], y[0]
+        ax = axes if len(axes) > 1 else axes[0]
+
+        def loss_fn(p):
+            h = jnp.tanh(x @ p["w1"] + p["b1"])
+            return jnp.mean((h @ p["w2"] - y) ** 2)
+
+        l, g = jax.value_and_grad(loss_fn)(p)
+        l = lax.pmean(l, ax)
+        g = jax.tree.map(lambda a: lax.pmean(a, ax), g)
+        return jax.tree.map(lambda a, b: a - LR * b, p, g), l
+
+    data_sharding = NamedSharding(mesh, P(axes))
+    stepf = jax.jit(shard_map(
+        body, mesh=mesh, in_specs=(P(), P(axes), P(axes)),
+        out_specs=(P(), P()), check_vma=False))
+
+    def _put(arr):
+        return jax.make_array_from_callback(
+            arr.shape, data_sharding, lambda idx: arr[idx])
+
+    def step_fn(state, i):
+        xs, ys = zip(*(_slot_batch(s, i) for s in slots))
+        p2, l = stepf(state["params"], _put(np.stack(xs)),
+                      _put(np.stack(ys)))
+        losses = np.array(state["losses"])
+        losses[i] = _to_np(l)
+        return {"params": jax.tree.map(_to_np, p2), "losses": losses}
+
+    return init_fn, step_fn
+
+
+board_dir = os.path.join(directory, "membership")
+cfg = dict(elastic="on")
+if nproc > 1:
+    cfg.update(coordinator_address=f"127.0.0.1:{port}",
+               num_processes=nproc, process_id=pid)
+if mode == "hang":
+    cfg.update(faults=plan_path, obs="metrics",
+               obs_dir=os.path.join(directory, "obs"),
+               watchdog="break", watchdog_deadline_s=WD_DEADLINE_S,
+               watchdog_poll_s=0.05, watchdog_dir=board_dir)
+mpi.init(mpi.Config(**cfg))
+
+from torchmpi_tpu import elastic  # noqa: E402
+
+
+def _digest(arr):
+    return hashlib.sha256(
+        np.ascontiguousarray(arr).tobytes()).hexdigest()
+
+
+try:
+    state, info = elastic.run_elastic(
+        build, steps=STEPS, directory=directory, save_every=2)
+except elastic.MemberDeath as e:
+    # The stalled member's own hold broke with a hang error naming
+    # itself — finish dying (the survivor shrinks without us).
+    print(f"CHECK rank={pid} member-death ok (member {e.member} at "
+          f"step {e.step})", flush=True)
+    sys.exit(0)
+
+stalled_total = broken_total = 0
+if mode == "hang":
+    from torchmpi_tpu import obs
+
+    stalled_total = int(obs.registry().counter_total(
+        "tm_watchdog_stalled_total"))
+    broken_total = int(obs.registry().counter_total(
+        "tm_watchdog_broken_total"))
+r = info["recovered_step"]
+summary = {
+    "rank": pid,
+    "shrinks": info["shrinks"],
+    "reconciles": info["reconciles"],
+    "recovered_step": r,
+    "members": list(info["view"].members),
+    "watchdog_stalled_total": stalled_total,
+    "watchdog_broken_total": broken_total,
+    "losses_digest": _digest(state["losses"][r:]),
+    "params_digest": _digest(np.concatenate(
+        [state["params"][k].reshape(-1)
+         for k in sorted(state["params"])])),
+}
+print("WATCHDOG-SUMMARY " + json.dumps(summary), flush=True)
+mpi.stop()
+print(f"CHECK rank={pid} done", flush=True)

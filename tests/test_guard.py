@@ -642,7 +642,10 @@ def test_peer_timeout_flight_tail_contents(guard_runtime, tmp_path):
             assert {"seq", "ev", "op"} <= set(rec)
         want = obs.recorder().to_records()[-len(tail):]
         assert [r["seq"] for r in tail] == [r["seq"] for r in want]
-        assert tail[-1]["ev"] == "barrier"
+        # Since the watchdog PR the ring records BOTH edges of a
+        # barrier; a completed barrier's most recent event is its
+        # completion edge (docs/WATCHDOG.md).
+        assert tail[-1]["ev"] == "barrier_done"
         assert f"last flight event #{tail[-1]['seq']}" in str(ei.value)
     finally:
         obs.deactivate()

@@ -304,11 +304,17 @@ def test_eager_collective_records_and_dump(obs_runtime):
     assert reg.counter_total("tm_barriers_total") == 1
     evs = obs.recorder().events()
     # Each cold dispatch is a plan build (flight event "plan") followed
-    # by its planned replay's eager event (docs/PLANNER.md).
-    assert [e[2] for e in evs] == ["plan", "eager", "plan", "eager",
-                                   "barrier"]
+    # by its planned replay's eager event (docs/PLANNER.md) and — since
+    # the watchdog PR — the matching completion edge, which is what
+    # lets blame tell "launched and stuck" from "done, next never
+    # launched" (docs/WATCHDOG.md).
+    assert [e[2] for e in evs] == ["plan", "eager", "eager_done",
+                                   "plan", "eager", "eager_done",
+                                   "barrier", "barrier_done"]
     eager = [e for e in evs if e[2] == "eager"]
     assert eager[0][5] == "xla" and eager[1][5] == "host"
+    done = [e for e in evs if e[2] == "eager_done"]
+    assert done[0][5] == "xla" and done[1][5] == "host"
     assert reg.counter_total("tm_plan_miss_total") == 2
     # dump -> obs_tool parses both files
     paths = obs.dump()
@@ -317,7 +323,7 @@ def test_eager_collective_records_and_dump(obs_runtime):
     assert tool.main(["dump"] + paths) == 0
     meta, records = tool.load_jsonl(paths[1])
     assert meta["stream"] == "flight"
-    assert [r["seq"] for r in records] == [0, 1, 2, 3, 4]
+    assert [r["seq"] for r in records] == list(range(8))
 
 
 def test_set_config_obs_off_stops_recording(obs_runtime):

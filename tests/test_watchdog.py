@@ -1,0 +1,889 @@
+"""Collective watchdog (torchmpi_tpu/watchdog.py — docs/WATCHDOG.md):
+config plumbing, the stall->break->escalate ladder, the deferred-raise
+boundary, the ``stall`` fault kind through the real staged call site,
+AsyncHandle.wait(timeout_s=), restart-loop recovery bit-identity,
+liveness leases + ``obs_tool blame --live``, the flight-ring completion
+edges, the elastic hang-shrink integration on the CPU sim, the off-mode
+never-imported guarantee, and the 2-process hang acceptance (slow)."""
+
+import contextlib
+import importlib.util
+import io
+import json
+import os
+import shutil
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import torchmpi_tpu as mpi
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(
+        f"_{name}_under_test", os.path.join(_REPO, "scripts",
+                                            f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _write_plan(path, rules, seed=7):
+    with open(path, "w") as f:
+        json.dump({"version": 1, "seed": seed, "rules": rules}, f)
+    return str(path)
+
+
+def _stall_rule(site, after=0, max_hits=1):
+    return {"site": site, "kind": "stall", "prob": 1.0, "after": after,
+            "max_hits": max_hits}
+
+
+@pytest.fixture()
+def wd_runtime(tmp_path):
+    """Callable fixture: (re-)init the runtime with the watchdog armed
+    at test-speed deadlines; always disarms + resets on exit (the
+    monitor thread and a monkeypatched exit seam must never leak into
+    later tests)."""
+    counter = [0]
+
+    def arm(rules=None, *, watchdog="break", deadline_s=0.3,
+            **cfg_kw):
+        counter[0] += 1
+        if rules is not None:
+            cfg_kw["faults"] = _write_plan(
+                tmp_path / f"plan{counter[0]}.json", rules)
+            cfg_kw.setdefault("fault_backoff_s", 0.01)
+        mpi.stop()
+        mesh = mpi.init(mpi.Config(
+            dcn_size=1, watchdog=watchdog,
+            watchdog_deadline_s=deadline_s, watchdog_poll_s=0.02,
+            **cfg_kw))
+        # Belt: the tests run at ~0.3s deadlines, so a loaded container
+        # can push a deliberately-stalled window past the 2.5x
+        # escalation point — which would os._exit the whole pytest
+        # process.  Observe instead of dying; the escalation test
+        # installs its own recorder over this.
+        from torchmpi_tpu import watchdog as wd
+
+        wd._exit_fn = lambda code: None
+        return mesh
+
+    yield arm
+    from torchmpi_tpu import watchdog
+
+    # reset() (which joins the monitor thread) BEFORE restoring the
+    # real exit: restoring first can hand a monitor mid-_escalate the
+    # real os._exit and kill the whole pytest process.
+    watchdog.reset()
+    watchdog._exit_fn = os._exit
+    if "torchmpi_tpu.faults" in sys.modules:
+        sys.modules["torchmpi_tpu.faults"].reset()
+    if "torchmpi_tpu.obs" in sys.modules:
+        sys.modules["torchmpi_tpu.obs"].reset()
+    mpi.stop()
+
+
+# ---------------------------------------------------------------------------
+# Config plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_config_env_normalization(tmp_path, monkeypatch):
+    monkeypatch.setenv("TORCHMPI_TPU_WATCHDOG", "1")
+    monkeypatch.setenv("TORCHMPI_TPU_WATCHDOG_DEADLINE", "2.5")
+    monkeypatch.setenv("TORCHMPI_TPU_WATCHDOG_DIR",
+                       str(tmp_path / "leases"))
+    mpi.stop()
+    try:
+        mpi.init(mpi.Config(dcn_size=1))
+        cfg = mpi.config()
+        assert cfg.watchdog == "break"  # boolean opt-in = everything
+        assert cfg.watchdog_deadline_s == 2.5
+        assert cfg.watchdog_dir == str(tmp_path / "leases")
+        from torchmpi_tpu import watchdog
+
+        assert watchdog.active() and watchdog.mode() == "break"
+    finally:
+        from torchmpi_tpu import watchdog
+
+        watchdog.reset()
+        mpi.stop()
+
+
+def test_config_validation():
+    mpi.stop()
+    with pytest.raises(ValueError, match="off|warn|break"):
+        mpi.init(mpi.Config(dcn_size=1, watchdog="sometimes"))
+    with pytest.raises(ValueError, match="must be > 0"):
+        mpi.init(mpi.Config(dcn_size=1, watchdog="warn",
+                            watchdog_deadline_s=0))
+    mpi.init(mpi.Config(dcn_size=1))
+    with pytest.raises(ValueError, match="off|warn|break"):
+        mpi.set_config(watchdog="x")
+    with pytest.raises(ValueError, match="must be > 0"):
+        mpi.set_config(watchdog_deadline_s=-1)
+    mpi.stop()
+
+
+# ---------------------------------------------------------------------------
+# The monitor: stall flagging, the break ladder, deferred raise
+# ---------------------------------------------------------------------------
+
+
+def test_warn_mode_flags_and_clears(wd_runtime):
+    wd_runtime(watchdog="warn", obs="metrics")
+    from torchmpi_tpu import obs, watchdog
+
+    with pytest.warns(RuntimeWarning, match="stalled at slow.site"):
+        tok = watchdog.begin("slow.site", op="allreduce", peer="gang")
+        time.sleep(0.6)
+    assert watchdog.stats()["stalled"] >= 1
+    assert watchdog.stats()["broken"] == 0  # warn never intervenes
+    watchdog.end(tok)
+    reg = obs.registry()
+    assert reg.counter_total("tm_watchdog_armed_total") >= 1
+    assert reg.counter_total("tm_watchdog_stalled_total") >= 1
+    assert reg.counter_total("tm_watchdog_cleared_total") >= 1
+    evs = [e for e in obs.recorder().to_records()
+           if e["ev"] == "watchdog"]
+    assert any(e["backend"] == "slow.site"
+               and e["detail"].startswith("stalled") for e in evs)
+
+
+def test_break_ladder_cooperative(wd_runtime):
+    """stalled at 1x the deadline, broken at 1.5x — and the in-place
+    cooperative raise carries the site/op/elapsed attribution."""
+    wd_runtime(watchdog="break", deadline_s=0.3)
+    from torchmpi_tpu import watchdog
+
+    tok = watchdog.begin("wedged.site", op="reduce_scatter")
+    time.sleep(0.35)  # past 1x, before 1.5x
+    watchdog.check_break(tok)  # stalled but not yet broken: no raise
+    assert watchdog.stats()["stalled"] >= 1
+    time.sleep(0.2)  # past 1.5x
+    with pytest.raises(watchdog.CollectiveHangError) as ei:
+        watchdog.check_break(tok)
+    watchdog.end(tok)
+    e = ei.value
+    assert e.site == "wedged.site" and e.op == "reduce_scatter"
+    assert e.elapsed_s >= 0.45 and e.deadline_s == 0.3
+    assert e.is_timeout and not e.transient
+    assert watchdog.pending_count() == 0  # in-place raise consumed it
+
+
+def test_deferred_raise_at_boundary(wd_runtime):
+    """A non-cooperative stall (nobody polls the token) is delivered
+    at the next eager boundary via raise_pending — and an ended window
+    never double-raises."""
+    wd_runtime(watchdog="break", deadline_s=0.2)
+    from torchmpi_tpu import watchdog
+
+    tok = watchdog.begin("background.site", op="ps_wait")
+    time.sleep(0.45)
+    with pytest.raises(watchdog.CollectiveHangError):
+        watchdog.raise_pending()
+    watchdog.end(tok)
+    watchdog.raise_pending()  # delivered + ended: nothing left
+
+    tok2 = watchdog.begin("resolves.site")
+    time.sleep(0.45)
+    watchdog.end(tok2)  # the wait completed before any boundary ran
+    watchdog.raise_pending()  # its queued break died with it
+
+
+def test_softening_to_warn_disarms_pending_breaks(wd_runtime):
+    """Re-activating at "warn" (which "never intervenes") must disarm
+    breaks queued under the previous break-mode activation — and the
+    delivery points themselves are gated on break mode (review)."""
+    wd_runtime(watchdog="break", deadline_s=0.2)
+    from torchmpi_tpu import watchdog
+
+    tok = watchdog.begin("background.site", op="ps_wait")
+    deadline = time.monotonic() + 5.0
+    while watchdog.pending_count() == 0 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert watchdog.pending_count() == 1
+    mpi.set_config(watchdog="warn")
+    assert watchdog.pending_count() == 0
+    watchdog.raise_pending()   # no-op: nothing armed, mode gated
+    watchdog.check_break(tok)  # likewise
+    watchdog.end(tok)
+
+
+def test_dead_ranks_ignores_previous_runs_leases(tmp_path):
+    """A SIGKILLed previous run's leftover (expired) leases on a
+    persistent board must not read as THIS run's deaths: with a
+    ``newer_than`` floor (the elastic driver passes its construction
+    time) only leases renewed in this life are evidence (review)."""
+    from torchmpi_tpu import watchdog
+
+    d = str(tmp_path / "board")
+    os.makedirs(d)
+    stale = {"rank": 1, "pid": 1, "mode": "break", "deadline_s": 1.0,
+             "ttl_s": 1.0, "ts": time.time() - 3600, "inflight": [],
+             "stalled_total": 0, "broken_total": 0, "escalated": False}
+    with open(watchdog.lease_path(d, 1), "w") as f:
+        json.dump(stale, f)
+    floor = time.time()
+    assert watchdog.dead_ranks(d) == [1]          # raw read: expired
+    assert watchdog.dead_ranks(d, newer_than=floor) == []  # floored
+    fresh = dict(stale, ts=time.time(), escalated=True)
+    with open(watchdog.lease_path(d, 1), "w") as f:
+        json.dump(fresh, f)
+    assert watchdog.dead_ranks(d, newer_than=floor) == [1]
+
+
+def test_escalation_exit_seam(wd_runtime, tmp_path):
+    """An unbreakable stall escalates at 2.5x the deadline through the
+    clean-exit seam, tombstoning the lease so dead_ranks (the elastic
+    death evidence) reports it."""
+    lease_dir = str(tmp_path / "leases")
+    wd_runtime(watchdog="break", deadline_s=0.2,
+               watchdog_dir=lease_dir)
+    from torchmpi_tpu import watchdog
+
+    calls = []
+    watchdog._exit_fn = calls.append  # observe instead of dying
+    tok = watchdog.begin("compiled.region", op="psum")
+    deadline = time.monotonic() + 5.0
+    while not calls and time.monotonic() < deadline:
+        time.sleep(0.02)
+    # (in production _exit_fn never returns; the observing seam lets
+    # the monitor tick again, so assert on the first call only)
+    assert calls and calls[0] == watchdog.ESCALATE_EXIT_CODE
+    assert watchdog.stats()["escalated"] >= 1
+    lease = watchdog.read_leases(lease_dir)[0]
+    assert lease["escalated"] is True
+    assert watchdog.dead_ranks(lease_dir) == [0]
+    watchdog.end(tok)
+
+
+# ---------------------------------------------------------------------------
+# The `stall` fault kind through the real call sites
+# ---------------------------------------------------------------------------
+
+
+def test_stall_breaks_staged_collective(wd_runtime):
+    """A seeded stall on the host-staged gather leg wedges the eager
+    allreduce; break mode converts it into the typed hang error within
+    the ladder, and the healed site (max_hits=1) replays clean."""
+    wd_runtime([_stall_rule("host_staged.gather")], watchdog="break",
+               deadline_s=0.3, obs="metrics")
+    from torchmpi_tpu import obs, watchdog
+
+    x = np.ones((8, 16), np.float32)
+    t0 = time.monotonic()
+    with pytest.raises(watchdog.CollectiveHangError) as ei:
+        mpi.allreduce(x, backend="host")
+    assert time.monotonic() - t0 < 2.0  # ~1.5 x 0.3s, not forever
+    assert ei.value.site == "host_staged.gather"
+    y = mpi.allreduce(x, backend="host")
+    assert np.allclose(np.asarray(y), 8.0)
+    reg = obs.registry()
+    assert reg.counter_total("tm_watchdog_stalled_total") >= 1
+    assert reg.counter_total("tm_watchdog_broken_total") >= 1
+    # The enclosing host_staged window unwound through the hold's
+    # break — that must NOT read as a stall that "resolved on its own"
+    # (the deadline-tuning signal; review round 3).
+    assert reg.counter_total("tm_watchdog_cleared_total") == 0
+
+
+def test_stall_wedges_without_watchdog_until_disarm(wd_runtime):
+    """The off-mode contrast, in-process: with the watchdog off the
+    stall holds the dispatch indefinitely (the caller thread stays
+    blocked), and disarming the fault layer releases the hold — the
+    modeled wedge exists only while the chaos plan does."""
+    wd_runtime([_stall_rule("host_staged.gather")], watchdog="off")
+    done = []
+    th = threading.Thread(
+        target=lambda: done.append(
+            mpi.allreduce(np.ones((8, 4), np.float32), backend="host")),
+        daemon=True)
+    th.start()
+    th.join(0.7)
+    assert th.is_alive() and not done  # wedged, nothing raised
+    mpi.set_config(faults="off")  # disarm: the hold releases
+    th.join(10.0)
+    assert not th.is_alive() and done
+
+
+def test_wait_timeout_typed(wd_runtime):
+    """AsyncHandle.wait(timeout_s=) on a wedged staged worker raises
+    the typed flight-tail-carrying PeerTimeoutError instead of
+    blocking forever; wait_all threads ONE deadline across the batch.
+    The stall is then released by disarming the plan, so the worker
+    thread drains instead of leaking."""
+    wd_runtime([_stall_rule("host_staged.gather")], watchdog="off",
+               obs="metrics")
+    from torchmpi_tpu.faults.policy import PeerTimeoutError
+
+    x = np.ones((8, 4), np.float32)
+    h = mpi.async_.allreduce(x, backend="host")
+    t0 = time.monotonic()
+    with pytest.raises(PeerTimeoutError) as ei:
+        h.wait(timeout_s=0.4)
+    assert 0.3 < time.monotonic() - t0 < 5.0
+    assert ei.value.deadline_s == 0.4
+    with pytest.raises(PeerTimeoutError):
+        mpi.collectives.wait_all([h], timeout_s=0.3)
+    mpi.set_config(faults="off")  # release the hold; worker drains
+    out = h.wait(timeout_s=30.0)
+    assert np.allclose(np.asarray(out), 8.0)
+
+
+def test_restart_loop_recovers_bit_identical(wd_runtime, tmp_path):
+    """The single-process acceptance: a stall mid-run under
+    watchdog=break is broken into a typed hang, run_with_restarts
+    routes it through the on_peer_timeout restore path, and the final
+    state is BIT-identical to a clean run."""
+    from torchmpi_tpu.utils import restart
+
+    def run(tag, rules):
+        wd_runtime(rules, watchdog="break", deadline_s=0.3)
+        d = str(tmp_path / tag)
+        losses = []
+        peer_timeouts = []
+
+        def init_fn():
+            return {"w": np.zeros((8, 4), np.float32)}
+
+        def step_fn(state, i):
+            red = mpi.allreduce(
+                np.full((8, 4), float(i + 1), np.float32),
+                backend="host")
+            w = state["w"] + np.asarray(red)[0] * 0.1
+            losses.append(float(w.sum()))
+            return {"w": w}
+
+        state, info = restart.run_with_restarts(
+            init_fn, step_fn, steps=6, directory=d, save_every=2,
+            on_peer_timeout=lambda n, e: peer_timeouts.append(e))
+        return state, info, peer_timeouts
+
+    # Arrival 3 = step 3's staged allreduce (one per step).
+    state1, info1, pts = run("stalled", [_stall_rule(
+        "host_staged.gather", after=3)])
+    assert info1["restarts_used"] == 1
+    assert len(pts) == 1  # routed through the detected-dead-peer hook
+    assert info1["recovered_step"] == 2
+    state2, info2, _ = run("clean", None)
+    assert info2["restarts_used"] == 0
+    assert np.array_equal(state1["w"], state2["w"])
+
+
+# ---------------------------------------------------------------------------
+# Leases + blame --live
+# ---------------------------------------------------------------------------
+
+
+def _blame_live(directory):
+    tool = _load_script("obs_tool")
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = tool.main(["blame", "--live", str(directory)])
+    return rc, buf.getvalue()
+
+
+def test_blame_live_names_stalled_rank(wd_runtime, tmp_path):
+    lease_dir = str(tmp_path / "leases")
+    wd_runtime(watchdog="warn", deadline_s=0.25,
+               watchdog_dir=lease_dir)
+    from torchmpi_tpu import watchdog
+
+    tok = watchdog.begin("runtime.barrier", op="step7",
+                         peer="member:1")
+    time.sleep(0.55)
+    rc, out = _blame_live(lease_dir)
+    watchdog.end(tok)
+    assert rc == 1, out
+    assert "STALLED in runtime.barrier" in out and "op=step7" in out
+    assert "member:1" in out  # the stall's peer attribution surfaces
+    # Healthy again after the window closes (next renewal clears it).
+    time.sleep(0.3)
+    rc, out = _blame_live(lease_dir)
+    assert rc == 0 and "all ranks healthy" in out, out
+    # An expired lease flips the verdict to death evidence.  Disarm
+    # first (which RETRACTS the live lease — see the regression test
+    # below), then plant a backdated one as the dead rank's remains.
+    lease = watchdog.read_leases(lease_dir)[0]
+    lease["ts"] -= 10 * lease["ttl_s"]
+    watchdog.deactivate()
+    with open(watchdog.lease_path(lease_dir, 0), "w") as f:
+        json.dump(lease, f)
+    rc, out = _blame_live(lease_dir)
+    assert rc == 1 and "EXPIRED" in out and "implicated" in out, out
+
+
+def test_blame_live_usage_errors(tmp_path):
+    rc, out = _blame_live(tmp_path / "nothing_here")
+    assert rc == 2
+
+
+def test_deactivate_retracts_lease(wd_runtime, tmp_path):
+    """Turning the watchdog OFF must not leave a lease behind to
+    expire: peers read expiry as death evidence, and a live rank that
+    merely disarmed must not get shrunk out of the gang (review)."""
+    lease_dir = str(tmp_path / "leases")
+    wd_runtime(watchdog="warn", watchdog_dir=lease_dir)
+    from torchmpi_tpu import watchdog
+
+    assert os.path.exists(watchdog.lease_path(lease_dir, 0))
+    watchdog.deactivate()
+    assert not os.path.exists(watchdog.lease_path(lease_dir, 0))
+    assert watchdog.dead_ranks(lease_dir) == []
+
+
+def test_reactivate_without_dir_disables_leases(wd_runtime, tmp_path):
+    """Re-activation with lease_dir=None DISABLES leases instead of
+    silently keeping the previous activation's directory (review)."""
+    lease_dir = str(tmp_path / "leases")
+    wd_runtime(watchdog="warn", watchdog_dir=lease_dir)
+    from torchmpi_tpu import watchdog
+
+    assert watchdog.lease_dir() == lease_dir
+    watchdog.activate("warn", deadline_s=1.0, lease_dir=None)
+    assert watchdog.lease_dir() is None
+
+
+def test_elastic_gang_adopts_board_for_leases(tmp_path,
+                                              wd_elastic_runtime):
+    """Under the DEFAULT config (no watchdog_dir/elastic_dir) the
+    gang's board is only known at driver construction — ElasticGang
+    must adopt it as the lease home so the lease-death evidence (and
+    blame --live) actually has a shared directory to meet in
+    (review)."""
+    from torchmpi_tpu import elastic
+
+    d = str(tmp_path / "gang")
+    os.makedirs(d)
+    wd_elastic_runtime(watchdog="warn", watchdog_deadline_s=5.0)
+    from torchmpi_tpu import watchdog
+
+    assert watchdog.lease_dir() is None  # nothing configured
+    gang = elastic.ElasticGang(d, members=[0, 1, 2, 3], world_size=8)
+    assert watchdog.lease_dir() == gang.board.directory
+    assert os.path.exists(
+        watchdog.lease_path(gang.board.directory, 0))
+
+
+def test_set_config_preserves_adopted_lease_dir(tmp_path,
+                                                wd_elastic_runtime):
+    """A mid-run watchdog tune (set_config deadline bump — the
+    documented knob) must not discard the lease home the gang adopted:
+    orphaning the rank's lease on the board would read as its death
+    to every peer within one ttl (review round 2)."""
+    from torchmpi_tpu import elastic
+
+    d = str(tmp_path / "gang")
+    os.makedirs(d)
+    wd_elastic_runtime(watchdog="warn", watchdog_deadline_s=5.0)
+    from torchmpi_tpu import watchdog
+
+    gang = elastic.ElasticGang(d, members=[0, 1], world_size=8)
+    board = gang.board.directory
+    assert watchdog.lease_dir() == board
+    mpi.set_config(watchdog_deadline_s=60.0)
+    assert watchdog.lease_dir() == board  # adoption survives the tune
+    assert os.path.exists(watchdog.lease_path(board, 0))
+
+
+def test_wait_all_armed_drives_whole_batch(wd_runtime):
+    """Arming the watchdog (no timeout) must not change wait_all's
+    completion contract: every handle is driven to completion before
+    the first input-order error re-raises (review)."""
+    from concurrent.futures import Future
+
+    wd_runtime(watchdog="warn", deadline_s=5.0)
+    f = Future()
+    f.set_exception(RuntimeError("boom"))
+    bad = mpi.collectives.AsyncHandle(future=f, op="allreduce")
+    good = mpi.async_.allreduce(np.ones((8, 4), np.float32),
+                                backend="host")
+    with pytest.raises(RuntimeError, match="boom"):
+        mpi.collectives.wait_all([bad, good])
+    assert good.done  # the failing head did not strand the tail
+
+
+# ---------------------------------------------------------------------------
+# Flight-ring completion edges + blame's stuck-vs-done verdict
+# ---------------------------------------------------------------------------
+
+
+def test_completion_edges_recorded(wd_runtime):
+    wd_runtime(watchdog="off", obs="metrics")
+    from torchmpi_tpu import obs
+
+    obs.reset()
+    x = np.ones((8, 8), np.float32)
+    mpi.allreduce(x)                    # planned direct
+    mpi.allreduce(x, backend="host")    # planned staged
+    mpi.barrier()
+    evs = [(e["ev"], e["op"]) for e in obs.recorder().to_records()]
+    names = [ev for ev, _ in evs]
+    assert names.count("eager") == 2 and names.count("eager_done") == 2
+    # Each dispatch precedes its completion edge.
+    assert names.index("eager") < names.index("eager_done")
+    assert "barrier" in names and "barrier_done" in names
+    assert names.index("barrier") < names.index("barrier_done")
+
+
+def _flight_file(path, host, events, backend="host"):
+    with open(path, "w") as f:
+        f.write(json.dumps({"kind": "meta", "stream": "flight",
+                            "host": host}) + "\n")
+        for seq, (ev, op) in enumerate(events):
+            f.write(json.dumps({"kind": "event", "seq": seq, "ts": seq,
+                                "ev": ev, "op": op, "nbytes": 64,
+                                "backend": backend, "detail": ""})
+                    + "\n")
+    return str(path)
+
+
+@pytest.mark.parametrize("backend,last,needle", [
+    # Staged backend: the done edge really means the exchange finished.
+    ("host", ("eager", "allreduce"), "stuck INSIDE"),
+    ("host", ("eager_done", "allreduce"), "never launched"),
+    # Direct backend: the done edge only means the async enqueue
+    # returned — the verdict must hedge toward device execution.
+    ("xla", ("eager_done", "allreduce"), "device execution"),
+])
+def test_blame_distinguishes_stuck_vs_done(tmp_path, backend, last,
+                                           needle):
+    common = [("eager", "allreduce"), ("eager_done", "allreduce")]
+    # Host 0 (the laggard) dies right after `last`; host 1 moves on.
+    a = _flight_file(tmp_path / "a.jsonl", "0", common + [last],
+                     backend=backend)
+    b = _flight_file(tmp_path / "b.jsonl", "1",
+                     common + [last, ("eager", "broadcast"),
+                               ("eager_done", "broadcast")],
+                     backend=backend)
+    tool = _load_script("obs_tool")
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = tool.main(["blame", a, b])
+    out = buf.getvalue()
+    assert rc == 1 and needle in out, out
+
+
+# ---------------------------------------------------------------------------
+# chaos_tool: the stall kind + recipe + summarize
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_tool_stall(tmp_path, capsys):
+    tool = _load_script("chaos_tool")
+    out = str(tmp_path / "stall.json")
+    assert tool.main(["gen", "--out", out, "--seed", "5",
+                      "--stall", "1:3:2"]) == 0
+    with open(out) as f:
+        plan = json.load(f)
+    [rule] = plan["rules"]
+    assert rule["kind"] == "stall" and rule["site"] == "elastic.member"
+    assert rule["after"] == 3 * 2 + 1 and rule["max_hits"] == 1
+    assert tool.main(["lint", out]) == 0
+    capsys.readouterr()
+    # delay_s on a stall is linted (the hold is indefinite).
+    bad = _write_plan(tmp_path / "bad.json",
+                      [{"site": "ps.request", "kind": "stall",
+                        "delay_s": 1.0}])
+    assert tool.main(["lint", bad]) == 1
+    assert "indefinite" in capsys.readouterr().out
+    # summarize surfaces tm_watchdog_* counters.
+    dump = tmp_path / "metrics_host0.jsonl"
+    with open(dump, "w") as f:
+        f.write(json.dumps({"kind": "counter",
+                            "name": "tm_watchdog_stalled_total",
+                            "labels": {"site": "runtime.barrier"},
+                            "value": 2}) + "\n")
+    assert tool.main(["summarize", str(dump)]) == 0
+    assert "tm_watchdog_stalled_total" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# Elastic integration on the CPU sim: a member hang becomes a shrink
+# ---------------------------------------------------------------------------
+
+
+def _mlp_build(steps):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax, shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    DIM, H, B, LR = 4, 8, 8, 0.05
+
+    def member_batch(m, step):
+        rng = np.random.RandomState(10_000 + m * 97 + step)
+        return (rng.randn(B, DIM).astype(np.float32),
+                rng.randn(B, 1).astype(np.float32))
+
+    def build(mesh, view):
+        axes = tuple(mesh.axis_names)
+        members = list(view.members)
+
+        def init_fn():
+            rng = np.random.RandomState(0)
+            return {"params": {
+                        "w1": (rng.randn(DIM, H) * 0.3).astype(
+                            np.float32),
+                        "w2": (rng.randn(H, 1) * 0.3).astype(
+                            np.float32)},
+                    "losses": np.full((steps,), np.nan, np.float32)}
+
+        def body(p, x, y):
+            x, y = x[0], y[0]
+            ax = axes if len(axes) > 1 else axes[0]
+
+            def loss_fn(p):
+                return jnp.mean(
+                    (jnp.tanh(x @ p["w1"]) @ p["w2"] - y) ** 2)
+
+            l, g = jax.value_and_grad(loss_fn)(p)
+            l = lax.pmean(l, ax)
+            g = jax.tree.map(lambda a: lax.pmean(a, ax), g)
+            return jax.tree.map(lambda a, b: a - LR * b, p, g), l
+
+        sh = NamedSharding(mesh, P(axes))
+        stepf = jax.jit(shard_map(
+            body, mesh=mesh, in_specs=(P(), P(axes), P(axes)),
+            out_specs=(P(), P()), check_vma=False))
+        per = mesh.devices.size // len(members)
+
+        def step_fn(state, i):
+            xs, ys = zip(*(member_batch(m, i)
+                           for m in members for _ in range(per)))
+            xb = jax.device_put(np.stack(xs), sh)
+            yb = jax.device_put(np.stack(ys), sh)
+            p2, l = stepf(state["params"], xb, yb)
+            losses = np.array(state["losses"])
+            losses[i] = np.asarray(l)
+            return {"params": jax.tree.map(np.asarray, p2),
+                    "losses": losses}
+
+        return init_fn, step_fn
+
+    return build
+
+
+@pytest.fixture()
+def wd_elastic_runtime(tmp_path):
+    def arm(**cfg_kw):
+        mpi.stop()
+        mesh = mpi.init(mpi.Config(elastic="on", **cfg_kw))
+        if cfg_kw.get("watchdog", "off") != "off":
+            # Same escalation belt as wd_runtime: never os._exit pytest.
+            from torchmpi_tpu import watchdog as wd
+
+            wd._exit_fn = lambda code: None
+        return mesh
+
+    yield arm
+    from torchmpi_tpu import watchdog
+
+    watchdog.reset()  # joins the monitor BEFORE the exit-seam restore
+    watchdog._exit_fn = os._exit
+    if "torchmpi_tpu.faults" in sys.modules:
+        sys.modules["torchmpi_tpu.faults"].reset()
+    if "torchmpi_tpu.obs" in sys.modules:
+        sys.modules["torchmpi_tpu.obs"].reset()
+    mpi.stop()
+
+
+def test_elastic_hang_shrinks_bit_identical(tmp_path,
+                                            wd_elastic_runtime):
+    """A stall on member 2's liveness check at step 3 (chaos_tool's
+    --stall recipe shape, 4-member sim gang): the watchdog breaks the
+    hold into a hang error implicating member:2, the poll treats it as
+    death evidence, the gang shrinks to N-1 and finishes with a loss
+    trajectory + params BIT-identical to a clean N-1 run restored from
+    the recovered step."""
+    from torchmpi_tpu import elastic
+
+    STEPS = 8
+    d1 = str(tmp_path / "gang")
+    os.makedirs(d1)
+    plan = _write_plan(tmp_path / "plan.json",
+                       [_stall_rule("elastic.member",
+                                    after=3 * 4 + 2)])
+    wd_elastic_runtime(faults=plan, fault_backoff_s=0.01,
+                       watchdog="break", watchdog_deadline_s=0.3,
+                       watchdog_poll_s=0.02, obs="metrics")
+    state1, info1 = elastic.run_elastic(
+        _mlp_build(STEPS), steps=STEPS, directory=d1, save_every=2,
+        members=[0, 1, 2, 3], world_size=8)
+    assert info1["shrinks"] == 1
+    assert info1["view"].members == (0, 1, 3)
+    from torchmpi_tpu import obs
+
+    assert obs.registry().counter_total(
+        "tm_watchdog_broken_total") >= 1
+    r = info1["recovered_step"]
+    assert 0 < r <= 3
+
+    d2 = str(tmp_path / "clean")
+    os.makedirs(d2)
+    for f in os.listdir(d1):
+        if f.startswith(f"ckpt_{r}_"):
+            shutil.copy(os.path.join(d1, f), os.path.join(d2, f))
+    wd_elastic_runtime()  # no faults, no watchdog
+    state2, info2 = elastic.run_elastic(
+        _mlp_build(STEPS), steps=STEPS, directory=d2, save_every=2,
+        members=[0, 1, 3], world_size=8)
+    assert info2["recovered_step"] == r and info2["shrinks"] == 0
+    assert np.array_equal(state1["losses"][r:], state2["losses"][r:])
+    for k in state1["params"]:
+        assert np.array_equal(state1["params"][k], state2["params"][k])
+
+
+# ---------------------------------------------------------------------------
+# Off-mode import discipline
+# ---------------------------------------------------------------------------
+
+
+def test_off_mode_never_imports_watchdog():
+    """With watchdog off (the default), torchmpi_tpu.watchdog is never
+    imported — one string compare at plan build / site entry is the
+    whole cost.  The probe drives every instrumented surface (planned
+    staged + direct eager dispatch, barrier, async handle wait)."""
+    code = (
+        "import sys\n"
+        "import numpy as np\n"
+        "import torchmpi_tpu as mpi\n"
+        "mpi.init(mpi.Config(dcn_size=1))\n"
+        "x = np.ones((2, 4), np.float32)\n"
+        "mpi.allreduce(x)\n"
+        "mpi.allreduce(x, backend='host')\n"
+        "mpi.barrier()\n"
+        "mpi.async_.allreduce(x, backend='host').wait()\n"
+        "mpi.collectives.wait_all([mpi.async_.allreduce(x)])\n"
+        "mpi.stop()\n"
+        "assert 'torchmpi_tpu.watchdog' not in sys.modules, 'imported!'\n"
+        "print('OFF-MODE-OK')\n"
+    )
+    env = dict(os.environ)
+    for k in ("TORCHMPI_TPU_WATCHDOG", "TORCHMPI_TPU_WATCHDOG_DIR",
+              "TORCHMPI_TPU_FAULTS"):
+        env.pop(k, None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    out = subprocess.run([sys.executable, "-c", code],
+                         capture_output=True, text=True, timeout=300,
+                         env=env, cwd=_REPO)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "OFF-MODE-OK" in out.stdout
+
+
+# ---------------------------------------------------------------------------
+# 2-process hang acceptance (slow): one rank stalls inside the gang,
+# the peer's watchdog names it LIVE, break-mode recovery finishes at
+# N-1 bit-identical to a clean run from the recovered step.
+# ---------------------------------------------------------------------------
+
+
+def _launch_workers(worker, args, n):
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    return [subprocess.Popen(
+        [sys.executable, worker, str(i), str(n), str(port)] + args,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, env=env) for i in range(n)]
+
+
+def _drain(procs, timeout=240):
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {i} failed:\n{out}"
+    return outs
+
+
+@pytest.mark.slow
+def test_two_process_hang_acceptance(tmp_path):
+    """docs/WATCHDOG.md acceptance end to end: a seeded stall wedges a
+    REAL 2-process gang at a step boundary; while it is wedged, the
+    watchdog leases name the stall live (obs_tool blame --live); the
+    break converts it into a member-implicating hang — rank 1 exits,
+    rank 0 shrinks to N-1 and finishes with digests bit-identical to a
+    clean 1-process run restored from the recovered step
+    (tests/_watchdog_worker.py)."""
+    worker = os.path.join(os.path.dirname(__file__),
+                          "_watchdog_worker.py")
+    d1 = str(tmp_path / "gang")
+    os.makedirs(d1)
+    # The chaos_tool --stall recipe shape: wedge the gang on rank 1's
+    # liveness check at step 5 of a 2-rank gang.
+    plan = _write_plan(tmp_path / "plan.json",
+                       [_stall_rule("elastic.member",
+                                    after=5 * 2 + 1)])
+    procs = _launch_workers(worker, ["hang", d1, plan], 2)
+
+    # Layer-2 evidence while the gang is WEDGED: poll the leases on
+    # the membership board until the stall is flagged (1x deadline),
+    # then blame --live must name it — before the 1.5x break resolves
+    # anything.
+    lease_dir = os.path.join(d1, "membership")
+    live = None
+    deadline = time.monotonic() + 120
+    while live is None and time.monotonic() < deadline:
+        if any(p.poll() is not None for p in procs):
+            break  # workers already finished: we missed the window
+        rc, out = _blame_live(lease_dir)
+        if rc == 1 and "STALLED" in out:
+            live = out
+        else:
+            time.sleep(0.1)
+    outs = _drain(procs)
+    assert live is not None, (
+        "never observed the stall live via blame --live:\n"
+        + "\n".join(outs))
+    assert "elastic.member" in live and "member:1" in live, live
+
+    assert any("CHECK rank=1 member-death ok" in o for o in outs), outs
+    by_rank = {}
+    for o in outs:
+        for ln in o.splitlines():
+            if ln.startswith("WATCHDOG-SUMMARY "):
+                d = json.loads(ln[len("WATCHDOG-SUMMARY "):])
+                by_rank[d["rank"]] = d
+    assert 0 in by_rank, outs
+    s = by_rank[0]
+    assert s["shrinks"] == 1 and s["members"] == [0]
+    assert s["watchdog_stalled_total"] >= 1
+    assert s["watchdog_broken_total"] >= 1
+    r = s["recovered_step"]
+    assert 0 < r <= 5
+
+    # Clean N-1 run restored from exactly the recovered step.
+    d2 = str(tmp_path / "clean")
+    os.makedirs(d2)
+    for f in os.listdir(d1):
+        if f.startswith(f"ckpt_{r}_"):
+            shutil.copy(os.path.join(d1, f), os.path.join(d2, f))
+    outs2 = _drain(_launch_workers(worker, ["clean", d2, ""], 1))
+    clean = None
+    for ln in outs2[0].splitlines():
+        if ln.startswith("WATCHDOG-SUMMARY "):
+            clean = json.loads(ln[len("WATCHDOG-SUMMARY "):])
+    assert clean is not None, outs2
+    assert clean["recovered_step"] == r
+    assert clean["losses_digest"] == s["losses_digest"], (s, clean)
+    assert clean["params_digest"] == s["params_digest"]

@@ -595,6 +595,22 @@ def _obs_record_eager(cfg, op_name: str, x, m: Mesh, impl=None) -> None:
                      backend, m, dtype=x.dtype)
 
 
+def _obs_record_eager_done(cfg, op_name: str, x, m: Mesh,
+                           impl=None) -> None:
+    """The matching completion edge (flight ring only): recorded AFTER
+    the dispatch/exchange returns, so ``obs_tool blame`` can tell
+    "launched and stuck" from "launched and done, next never
+    launched" (docs/OBSERVABILITY.md)."""
+    if cfg is None or cfg.obs == "off":
+        return
+    from . import obs
+
+    backend = "host" if impl is None else selector.name_of(op_name, impl)
+    obs.record_eager_done(op_name,
+                          int(np.prod(x.shape[1:])) * x.dtype.itemsize,
+                          backend, m)
+
+
 def _staged_leaf(cfg, op_name: str, x, n: int, params: dict):
     """One leaf's host-staged exchange: the faults-instrumented (sites
     ``host_staged.gather``/``scatter``) or plain host compute, shared by
@@ -604,17 +620,34 @@ def _staged_leaf(cfg, op_name: str, x, n: int, params: dict):
     :class:`_RestageView` so each fault-layer attempt still re-stages a
     fresh writable copy."""
     wire = cfg is not None and cfg.guard in ("wire", "full")
-    if (cfg is not None and cfg.faults != "off") or wire:
-        from . import faults
+    wd = None
+    wd_tok = -1
+    if cfg is not None and cfg.watchdog != "off":
+        # Live hang detection over the whole exchange
+        # (docs/WATCHDOG.md): one string compare when off, the module
+        # never imported.  Pending deferred breaks deliver here — the
+        # eager boundary — before this dispatch blocks.
+        from . import watchdog
 
-        # Injection + retry policy around both staging legs
-        # (sites host_staged.gather/scatter — docs/FAULTS.md); the
-        # wire guard (docs/GUARD.md) brackets each leg with a sender
-        # digest verified at the receiver, riding the same retry loop.
-        # Off is one string compare each, the modules never imported.
-        return faults.staged_exchange(op_name, x, n, params, _host_staged,
-                                      wire_guard=wire)
-    return _host_staged(op_name, np.asarray(x), n, **params)
+        wd = watchdog
+        wd.raise_pending()
+        wd_tok = wd.begin("host_staged", op=op_name, peer="gang")
+    try:
+        if (cfg is not None and cfg.faults != "off") or wire:
+            from . import faults
+
+            # Injection + retry policy around both staging legs
+            # (sites host_staged.gather/scatter — docs/FAULTS.md); the
+            # wire guard (docs/GUARD.md) brackets each leg with a sender
+            # digest verified at the receiver, riding the same retry
+            # loop.  Off is one string compare each, the modules never
+            # imported.
+            return faults.staged_exchange(op_name, x, n, params,
+                                          _host_staged, wire_guard=wire)
+        return _host_staged(op_name, np.asarray(x), n, **params)
+    finally:
+        if wd is not None:
+            wd.end(wd_tok)
 
 
 def _staged_requested(cfg, backend: Optional[str]) -> bool:
@@ -667,7 +700,9 @@ def _eager_collective_unplanned(op_name: str, x, m: Mesh, n: int, *,
     if _staged_requested(cfg, backend):
         _obs_record_eager(cfg, op_name, x, m)
         out = _staged_leaf(cfg, op_name, x, n, params)
-        return _place_rank_major(np.ascontiguousarray(out), m)
+        placed = _place_rank_major(np.ascontiguousarray(out), m)
+        _obs_record_eager_done(cfg, op_name, x, m)
+        return placed
     # Online "auto" mode (config default, per-op table, or an explicit
     # backend="auto"): resolve against the persistent tuning plan.  The
     # first eager call of an uncached (op, size bucket, mesh, platform)
@@ -729,7 +764,9 @@ def _eager_collective_unplanned(op_name: str, x, m: Mesh, n: int, *,
         entry = (jax.jit(shmapped), _rank_major_sharding(m))
         _legacy_jit_cache[key] = entry
     fn, sharding = entry
-    return fn(_place_rank_major(x, m, sharding))
+    out = fn(_place_rank_major(x, m, sharding))
+    _obs_record_eager_done(cfg, op_name, x, m, impl=impl)
+    return out
 
 
 def allreduce(x, *, op: str = "sum", mesh: Optional[Mesh] = None,
@@ -887,24 +924,70 @@ class AsyncHandle:
         except Exception as e:  # noqa: BLE001 — carried to wait()/done
             self._error = e
 
-    def wait(self):
+    def wait(self, timeout_s: Optional[float] = None):
         """Block until the collective completes; return its result.
 
         Re-raises the underlying error if the computation failed — on
         every call, so a handle waited twice fails twice rather than
-        handing out half-initialized buffers."""
+        handing out half-initialized buffers.
+
+        ``timeout_s`` bounds the block: on expiry a typed
+        :class:`~torchmpi_tpu.faults.policy.PeerTimeoutError` (carrying
+        the obs flight-recorder tail) raises instead of waiting
+        forever — the computation itself is NOT cancelled, the caller
+        is expected to checkpoint-restore or die, which is the point.
+        ``None`` (the default) blocks unbounded and never imports the
+        fault layer.  With ``Config.watchdog`` armed the wait is also
+        a cooperative break point: a stall the watchdog flags raises
+        :class:`~torchmpi_tpu.watchdog.CollectiveHangError` in place
+        (docs/WATCHDOG.md)."""
         if self._done:
             if self._error is not None:
                 raise self._error
             return self._value
         t0 = time.monotonic()
-        self._resolve_future()
-        if self._error is None:
-            try:
-                jax.block_until_ready(self._value)
-            except Exception as e:  # noqa: BLE001 — surfaced below
-                self._error = e
-        self._done = True
+        wd = None
+        if runtime.effective_config().watchdog != "off":
+            from . import watchdog
+
+            wd = watchdog
+        if timeout_s is None and wd is None:
+            # The unbounded fast path: one blocking readiness call.
+            self._resolve_future()
+            if self._error is None:
+                try:
+                    jax.block_until_ready(self._value)
+                except Exception as e:  # noqa: BLE001 — surfaced below
+                    self._error = e
+            self._done = True
+            _obs_async("wait", self._op, time.monotonic() - t0)
+            if self._error is not None:
+                raise self._error
+            return self._value
+        # Bounded / watchdog-armed path: poll readiness so the wait
+        # stays interruptible (block_until_ready cannot be unwound).
+        tok = wd.begin("async.wait", op=self._op) if wd is not None else -1
+        try:
+            while not self.done:
+                if wd is not None:
+                    wd.check_break(tok)
+                elapsed = time.monotonic() - t0
+                if timeout_s is not None and elapsed >= timeout_s:
+                    from .faults.policy import (PeerTimeoutError,
+                                                flight_tail)
+
+                    raise PeerTimeoutError(
+                        f"async.wait({self._op})", elapsed_s=elapsed,
+                        deadline_s=float(timeout_s),
+                        flight_tail=flight_tail())
+                # Coarsen the poll as the wait ages: sub-ms latency for
+                # results that are nearly ready, ~20ms granularity for
+                # long waits (the watchdog deadline dwarfs it).
+                time.sleep(0.0005 if elapsed < 0.01
+                           else (0.002 if elapsed < 0.1 else 0.02))
+        finally:
+            if wd is not None:
+                wd.end(tok)
         _obs_async("wait", self._op, time.monotonic() - t0)
         if self._error is not None:
             raise self._error
@@ -944,7 +1027,7 @@ def sync_handle(handle: AsyncHandle):
     return handle.wait()
 
 
-def wait_all(handles):
+def wait_all(handles, timeout_s: Optional[float] = None):
     """Batched ``wait()``: block until EVERY handle completes, then
     return their results **in input order** (completion order does not
     reorder anything).  One ``jax.block_until_ready`` spans all device
@@ -952,8 +1035,44 @@ def wait_all(handles):
     instead of one blocking call per handle.  If any handle failed, the
     first (in input order) error re-raises — after all handles have
     been driven to completion, so no work is silently left in flight.
+
+    ``timeout_s`` is ONE deadline threaded across the whole batch (not
+    per handle): each successive wait gets whatever budget the ones
+    before it left, so a wedged batch surfaces a typed
+    ``PeerTimeoutError`` within ``timeout_s`` total instead of N times
+    it.  On a timeout the remaining handles are left in flight — the
+    caller is recovering, not harvesting.  With ``Config.watchdog``
+    armed (and no timeout) the per-handle waits become cooperative
+    break points (docs/WATCHDOG.md) but keep this function's
+    completion contract: every handle is still driven to completion
+    before the first (input-order) error re-raises — merely arming
+    monitoring must not change error semantics.
     """
     hs = list(handles)
+    if timeout_s is not None or \
+            runtime.effective_config().watchdog != "off":
+        t0 = time.monotonic()
+        first_err: Optional[BaseException] = None
+        for h in hs:
+            left = (None if timeout_s is None
+                    else max(0.0, float(timeout_s)
+                             - (time.monotonic() - t0)))
+            try:
+                h.wait(timeout_s=left)
+            except Exception as e:  # noqa: BLE001 — re-raised below;
+                # deliberately NOT BaseException: a KeyboardInterrupt
+                # mid-batch must abort NOW, not after blocking on the
+                # remaining (possibly wedged) handles.
+                if timeout_s is not None:
+                    # Bounded batch: abort — the remainder is left in
+                    # flight by documented contract (the caller is
+                    # recovering, not harvesting).
+                    raise
+                if first_err is None:
+                    first_err = e
+        if first_err is not None:
+            raise first_err
+        return [h._value for h in hs]
     t0 = time.monotonic()
     pending = []
     for h in hs:
@@ -1073,6 +1192,7 @@ def _staged_async_work(op_name: str, leaves, treedef, n: int, m: Mesh,
         out = _staged_leaf(cfg, op_name, hx, n, params)
         outs.append(_place_rank_major(np.ascontiguousarray(out), m,
                                       sharding))
+        _obs_record_eager_done(cfg, op_name, v, m)
     return jax.tree.unflatten(treedef, outs)
 
 

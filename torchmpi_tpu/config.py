@@ -316,6 +316,44 @@ class Config:
     # on — off-mode saves stay untouched.  Env: TORCHMPI_TPU_CKPT_KEEP.
     ckpt_keep: int = 0
 
+    # --- collective watchdog (torchmpi_tpu.watchdog) -------------------------
+    # Live hang detection over the blocking dispatch surfaces
+    # (docs/WATCHDOG.md): "off" (default — the module is never
+    # imported, plan build / site entry pay one string compare, the
+    # planned dispatch path gains zero branches; same discipline as
+    # ``analysis``/``obs``/``faults``/``guard``), "warn" (a per-process
+    # monitor thread flags any in-flight collective older than
+    # ``watchdog_deadline_s`` — ``tm_watchdog_*`` counters, a
+    # ``watchdog`` flight event, a Python warning — and renews liveness
+    # leases, but never intervenes), or "break" (warn PLUS typed
+    # hang-breaking: the stalled wait is converted into a
+    # ``CollectiveHangError`` the restart/elastic recovery paths heal,
+    # escalating to a clean ``os._exit`` when the stall cannot be
+    # unwound).  Env: TORCHMPI_TPU_WATCHDOG ("1"/"true"/"on" mean
+    # "break" — the everything-armed reading a boolean opt-in wants).
+    watchdog: str = "off"
+    # Age at which an in-flight collective is declared stalled.  Tune
+    # ABOVE the slowest legitimate collective (first-compile stalls are
+    # excluded by construction — the watchdog brackets runtime waits,
+    # not trace/compile time, but a genuinely slow DCN allreduce must
+    # not trip it); docs/WATCHDOG.md has the tuning guidance.  The
+    # break-mode ladder is staged on this value: stalled at 1x (the
+    # blame --live window), typed break at 1.5x, clean-exit escalation
+    # at 2.5x.  Env: TORCHMPI_TPU_WATCHDOG_DEADLINE.
+    watchdog_deadline_s: float = 30.0
+    # Monitor tick (scan + cooperative-break latency; lease renewal is
+    # throttled separately to ~deadline/4).
+    # Env: TORCHMPI_TPU_WATCHDOG_POLL.
+    watchdog_poll_s: float = 0.05
+    # Directory for the liveness lease files (``wd_lease_<rank>.json``
+    # — read live by ``obs_tool blame --live`` and by
+    # ``elastic.ElasticGang.poll`` as death evidence).  None resolves
+    # to TORCHMPI_TPU_WATCHDOG_DIR, then ``elastic_dir`` (the
+    # membership board — the transport still standing when the gang
+    # wedged), else leases are disabled and the watchdog is
+    # process-local.  Env: TORCHMPI_TPU_WATCHDOG_DIR.
+    watchdog_dir: Optional[str] = None
+
     # --- fault injection + resilient dispatch -------------------------------
     # torchmpi_tpu.faults (docs/FAULTS.md): "off" (default — one string
     # compare per cross-host call site, the module is never imported;
@@ -447,6 +485,13 @@ class Config:
                                      "off"),
             ckpt_buddies=_env_int("TORCHMPI_TPU_CKPT_BUDDIES", 1),
             ckpt_keep=_env_int("TORCHMPI_TPU_CKPT_KEEP", 0),
+            watchdog=_env_str("TORCHMPI_TPU_WATCHDOG", "off"),
+            watchdog_deadline_s=_env_float(
+                "TORCHMPI_TPU_WATCHDOG_DEADLINE", 30.0),
+            watchdog_poll_s=_env_float("TORCHMPI_TPU_WATCHDOG_POLL",
+                                       0.05),
+            watchdog_dir=(os.environ.get("TORCHMPI_TPU_WATCHDOG_DIR")
+                          or None),
             fault_retries=_env_int("TORCHMPI_TPU_FAULT_RETRIES", 2),
             fault_backoff_s=_env_float("TORCHMPI_TPU_FAULT_BACKOFF", 0.05),
             fault_deadline_s=_env_float("TORCHMPI_TPU_FAULT_DEADLINE",

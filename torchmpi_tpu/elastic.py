@@ -115,6 +115,14 @@ def _member_peer(m: int) -> str:
     return f"member:{int(m)}"
 
 
+def _is_hang(e: BaseException) -> bool:
+    """Is ``e`` the watchdog's ``CollectiveHangError``?  sys.modules
+    check (the restart.py discipline): the error can only exist if the
+    watchdog raised it, so the module is necessarily loaded then."""
+    mod = sys.modules.get("torchmpi_tpu.watchdog")
+    return mod is not None and isinstance(e, mod.CollectiveHangError)
+
+
 class ElasticGang:
     """Membership state + resize mechanics for one training gang.
 
@@ -138,6 +146,25 @@ class ElasticGang:
         self.board = membership.Board(
             board_dir or cfg.elastic_dir
             or os.path.join(directory, "membership"))
+        # Lease-death floor: only leases renewed AFTER this driver
+        # started count as evidence — a SIGKILLed previous run's
+        # leftover leases on the persistent board must not shrink a
+        # slow-starting peer out of the new gang (docs/WATCHDOG.md).
+        import time as _time
+
+        self._lease_floor = _time.time()
+        if cfg.watchdog != "off":
+            # Adopt this board as the watchdog's lease home when
+            # watchdog_dir was left unset (docs/WATCHDOG.md layer 2:
+            # the leases belong on the membership board, but its
+            # default location — <ckpt dir>/membership — is only known
+            # HERE, not at runtime.init).  An explicitly configured
+            # lease dir wins; the lease-death scan in poll() reads
+            # wherever the watchdog actually leases.
+            from . import watchdog
+
+            if watchdog.active() and watchdog.lease_dir() is None:
+                watchdog.set_lease_dir(self.board.directory)
         self._multiproc = jax.process_count() > 1
         all_devs = list(jax.devices())
         if members is None:
@@ -290,10 +317,39 @@ class ElasticGang:
                         dead.add(m)
                     except faults.TransientFault:
                         led.record(_member_peer(m), ok=False)
+                    except RuntimeError as e:
+                        # A member liveness check the WATCHDOG had to
+                        # break (an injected `stall` held it past the
+                        # deadline — docs/WATCHDOG.md) is itself the
+                        # death evidence: the gang wedged on exactly
+                        # this member's boundary check.
+                        if not _is_hang(e):
+                            raise
+                        dead.add(m)
                     else:
                         led.record(_member_peer(m), ok=True)
             dead |= {m for m in self.view.members
                      if led.decide(_member_peer(m)) == "raise"}
+        if self._multiproc and \
+                runtime.effective_config().watchdog != "off":
+            # Lease-based liveness (docs/WATCHDOG.md layer 2): a member
+            # whose watchdog lease EXPIRED — or carries the `escalated`
+            # tombstone an unbreakable stall exits through — is PR-10
+            # death evidence, folded into the same shrink verdict as an
+            # injected kill or a ledger escalation.  Read from wherever
+            # this process actually leases (every rank shares the
+            # config, so that is where the peers lease too; the
+            # constructor adopted the board when nothing was
+            # configured).  One string compare when the watchdog is
+            # off; a member that never leased is not evidence.
+            from . import watchdog
+
+            ld = watchdog.lease_dir()
+            if ld is not None:
+                dead |= {r for r in watchdog.dead_ranks(
+                             ld, newer_than=self._lease_floor)
+                         if r in self.view.members
+                         and r not in self.local_ranks}
         details = self.board.join_details()
         # A join from a rank STILL in the view under a NEWER incarnation
         # is a twice-dead rank's fresh life (docs/ELASTIC.md): its
@@ -430,10 +486,14 @@ def _seed_joiner_checkpoints(directory: str, step: int,
 
 def _member_of_failure(e: BaseException) -> Optional[int]:
     """Map a fault-layer error to the gang member it implicates, if
-    any: a ``PeerTimeoutError`` whose peer is a ``member:<rank>`` row.
-    Checked via sys.modules (the restart.py discipline)."""
+    any: a ``PeerTimeoutError`` — or a watchdog ``CollectiveHangError``
+    (a mid-step stall the watchdog broke) — whose peer is a
+    ``member:<rank>`` row.  Checked via sys.modules (the restart.py
+    discipline)."""
     mod = sys.modules.get("torchmpi_tpu.faults.policy")
-    if mod is None or not isinstance(e, mod.PeerTimeoutError):
+    timeoutish = (mod is not None
+                  and isinstance(e, mod.PeerTimeoutError)) or _is_hang(e)
+    if not timeoutish:
         return None
     peer = str(getattr(e, "peer", ""))
     if peer.startswith("member:") and peer[len("member:"):].isdigit():

@@ -195,7 +195,51 @@ def fire(site: str, payload=None, peer: str = "") -> None:
     if rule.kind == "torn":
         raise TornWrite(
             f"injected torn write at {site} (arrival {arrival})")
+    if rule.kind == "stall":
+        _stall_hold(site, peer, p)
+        return
     raise InjectedFailure(f"injected hard failure at {site}")
+
+
+def _stall_hold(site: str, peer: str, plan: "FaultPlan") -> None:
+    """The ``stall`` kind's indefinite hold: the silent hang —
+    progress simply stops, nothing raises (docs/WATCHDOG.md proves the
+    watchdog contract against it).  The hold registers itself with the
+    armed watchdog via sys.modules (this package never imports it —
+    the off-discipline runs both ways), so:
+
+    - watchdog off   -> the site wedges until the harness timeout;
+    - mode "warn"    -> the stall is flagged live (counters, flight
+      event, lease) but never interrupted;
+    - mode "break"   -> :func:`~torchmpi_tpu.watchdog.check_break`
+      raises the typed ``CollectiveHangError`` out of the hold, which
+      propagates through the site exactly like a real broken wait.
+
+    A watchdog armed AFTER the hold started is picked up on the next
+    tick.  Disarming the fault layer (or replacing the plan) releases
+    the hold: the modeled wedge exists only while the chaos plan does.
+    """
+    import sys
+    import time
+
+    mod = None
+    tok = -1
+    try:
+        while True:
+            if not _armed or _plan is not plan:
+                return  # chaos disarmed: the modeled wedge is gone
+            m = sys.modules.get("torchmpi_tpu.watchdog")
+            if m is not None and m.active():
+                if m is not mod or not m.is_inflight(tok):
+                    # First sight of an armed watchdog — or a stale
+                    # token from before a deactivate/re-activate cycle:
+                    # (re-)register so the new monitor sees this hold.
+                    mod, tok = m, m.begin(site, op="stall", peer=peer)
+                m.check_break(tok)  # raises CollectiveHangError on break
+            time.sleep(0.01)
+    finally:
+        if mod is not None:
+            mod.end(tok)
 
 
 def _sleep(seconds: float) -> None:

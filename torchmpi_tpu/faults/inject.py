@@ -44,6 +44,21 @@ Kinds model the failures a benign-fabric port never had to survive:
   ``.tmp`` staging path and raises — the artifact the atomic-rename
   discipline must leave invisible to ``latest_step``.  Hard, never
   retried (the writer is dead).
+- ``stall``    — an **indefinite hold**: the site stops making progress
+  and raises NOTHING — the silent hang a benign-fabric port never had
+  to survive (a wedged peer mid-collective, a dead link under a
+  blocking wait).  Valid at every site, payload-free ones included
+  (there is nothing to flip — the failure IS the absence of progress).
+  With ``Config.watchdog="off"`` the job wedges until the harness
+  timeout; with the watchdog armed the hold registers itself as an
+  in-flight window (via sys.modules — this module never imports the
+  watchdog) so ``warn`` mode flags it live and ``break`` mode converts
+  it into a typed ``CollectiveHangError`` the recovery paths heal
+  (docs/WATCHDOG.md).  ``delay_s`` is meaningless on a stall (the hold
+  is indefinite by definition; lint flags it).  Disarming the fault
+  layer releases the hold — the wedge it models exists only while the
+  chaos plan does, which is also what keeps in-process tests from
+  leaking stuck threads.
 
 Dependency-free on purpose (no jax, no numpy at import): loaded by
 ``scripts/chaos_tool.py`` standalone, and by the dump path of a dying
@@ -100,7 +115,8 @@ SITES = (
     #                         EIO-flavored dead disk
 )
 
-KINDS = ("delay", "drop", "corrupt", "corrupt_silent", "fail", "torn")
+KINDS = ("delay", "drop", "corrupt", "corrupt_silent", "fail", "torn",
+         "stall")
 
 # Sites whose ``fire()`` call passes a real writable payload buffer —
 # the only sites where a ``corrupt``/``corrupt_silent`` rule can flip
@@ -329,6 +345,11 @@ def lint_plan(plan: FaultPlan) -> List[str]:
                 f"rule {i}: torn at {matched} has no staged file write "
                 f"to truncate (only ckpt.write models a crash "
                 f"mid-checkpoint-write)")
+        if rule.kind == "stall" and float(rule.delay_s) > 0:
+            problems.append(
+                f"rule {i}: stall ignores delay_s={rule.delay_s!r} — "
+                f"the hold is indefinite by definition (use kind "
+                f"'delay' for a bounded slowdown)")
     return problems
 
 

@@ -40,11 +40,15 @@ _TRANSIENT_ERRNOS = frozenset({
 
 
 def is_transient(e: BaseException) -> bool:
-    """Would a retry plausibly succeed?  Injected transients say so
-    themselves; real socket errors qualify by class/errno; everything
-    else (including injected hard failures) does not."""
-    if isinstance(e, FaultError):
-        return e.transient
+    """Would a retry plausibly succeed?  Errors that classify
+    themselves (a bool ``transient`` attribute — injected
+    ``FaultError``\\ s, the watchdog's ``CollectiveHangError``, which is
+    timeout-flavored but must NOT be retried: re-waiting the wait that
+    wedged would re-wedge) are believed; real socket errors qualify by
+    class/errno; everything else does not."""
+    t = getattr(e, "transient", None)
+    if isinstance(t, bool):
+        return t
     if isinstance(e, (socket.timeout, TimeoutError, ConnectionError,
                       BrokenPipeError)):
         return True
@@ -55,9 +59,12 @@ def is_transient(e: BaseException) -> bool:
 
 def is_timeoutish(e: BaseException) -> bool:
     """Does this error mean "the peer went silent" (so exhausting
-    retries is a peer timeout, not a logic failure)?"""
-    if isinstance(e, FaultError):
-        return e.is_timeout
+    retries is a peer timeout, not a logic failure)?  Self-classifying
+    errors (a bool ``is_timeout`` attribute) are believed — the same
+    duck-typed contract as :func:`is_transient`."""
+    t = getattr(e, "is_timeout", None)
+    if isinstance(t, bool):
+        return t
     return isinstance(e, (socket.timeout, TimeoutError)) or (
         isinstance(e, OSError) and e.errno == errno.ETIMEDOUT)
 
@@ -127,16 +134,11 @@ class Policy:
 
 def flight_tail(n: int = 8) -> List[dict]:
     """The last ``n`` flight-recorder events, when obs is active (via
-    sys.modules — a faults-only session must not import obs)."""
-    import sys
+    sys.modules — a faults-only session must not import obs).  ONE
+    implementation, shared with the watchdog: ``utils/telemetry.py``."""
+    from ..utils import telemetry
 
-    mod = sys.modules.get("torchmpi_tpu.obs")
-    try:
-        if mod is not None and mod.active():
-            return mod.recorder().to_records(best_effort=True)[-n:]
-    except Exception:  # noqa: BLE001 — evidence must not mask the error
-        pass
-    return []
+    return telemetry.flight_tail(n)
 
 
 def run(site: str, attempt: Callable[[int], Any], *, policy: Policy,

@@ -305,6 +305,54 @@ def eager_recorder(op: str, nbytes: int, backend: str, mesh, dtype):
     return record
 
 
+def record_eager_done(op: str, nbytes: int, backend: str, mesh) -> None:
+    """The COMPLETION edge of one eager dispatch (ring only — launch
+    counters already counted it at the dispatch edge).  Pairing both
+    edges is what lets ``obs_tool blame`` distinguish "launched and
+    stuck inside it" (a dispatch with no matching ``eager_done``) from
+    "launched and done, the next one never launched"
+    (docs/WATCHDOG.md's live-blame workflow)."""
+    _recorder.append("eager_done", op, nbytes, backend, mesh_label(mesh))
+
+
+def eager_done_recorder(op: str, nbytes: int, backend: str, mesh):
+    """Pre-bound completion recorder for one eager CollectivePlan (the
+    :func:`eager_recorder` companion): labels resolved once at build,
+    the replay pays one ring append."""
+    mk = mesh_label(mesh)
+
+    def record_done() -> None:
+        _recorder.append("eager_done", op, nbytes, backend, mk)
+
+    return record_done
+
+
+def record_watchdog(action: str, site: str, *, op: str = "",
+                    seq: int = -1, elapsed_s: float = 0.0,
+                    peer: str = "") -> None:
+    """One ``torchmpi_tpu.watchdog`` event (docs/WATCHDOG.md):
+    ``action`` is ``armed`` (an instrumented wait opened its in-flight
+    window) | ``stalled`` (a window outlived ``watchdog_deadline_s``) |
+    ``broken`` (break mode converted it into a typed
+    ``CollectiveHangError``) | ``escalated`` (an unbreakable stall took
+    the clean-exit ladder) | ``cleared`` (a flagged stall completed on
+    its own — the genuinely-slow-collective signal deadline tuning
+    reads) — counter ``tm_watchdog_<action>_total{site}``.  Everything
+    past ``armed`` also rides the flight ring carrying op/seq/elapsed,
+    so a post-mortem sees the stall verdict right next to the
+    collective events it indicts."""
+    labels = {"site": site}
+    if peer:
+        labels["peer"] = peer
+    _registry.counter_inc(f"tm_watchdog_{action}_total", **labels)
+    if action != "armed":
+        detail = f"{action} elapsed={elapsed_s:.3g}s"
+        if peer:
+            detail += f" peer={peer}"
+        _recorder.append("watchdog", op or site, max(0, int(seq)), site,
+                         detail)
+
+
 def record_plan(event: str, op: str, kind: str = "",
                 build_s: Optional[float] = None) -> None:
     """One CollectivePlan table event (docs/PLANNER.md): ``event`` is
@@ -399,6 +447,15 @@ def record_tuning_measure(op: str, backend: str, median_s: float) -> None:
                            max(1.0, median_s * 1e6), op=op, backend=backend)
 
 
+def record_ps_wait(n_futures: int) -> None:
+    """The completion edge of one parameter-server wait (every shard
+    future resolved) — ring only, the shard-level counters ride
+    :func:`record_ps_stats`.  A gang wedged inside a PS wait shows the
+    preceding dispatch as its last event; one that cleared it shows
+    this."""
+    _recorder.append("ps_wait_done", "ps", int(n_futures))
+
+
 def record_ps_stats(stats: dict, prev: Optional[dict]) -> None:
     """Fold a ``ShardedParameterServer.stats()`` snapshot into the
     registry as deltas against the previous snapshot (the native
@@ -427,6 +484,13 @@ def record_barrier(name: str) -> None:
     ``obs_tool.py blame``)."""
     _registry.counter_inc("tm_barriers_total")
     _recorder.append("barrier", name)
+
+
+def record_barrier_done(name: str) -> None:
+    """The barrier's completion edge (ring only) — without it blame
+    cannot tell a host stuck INSIDE the barrier from one that cleared
+    it and hung before its next dispatch."""
+    _recorder.append("barrier_done", name)
 
 
 def record_fault(action: str, site: str, *, kind: str = "",

@@ -163,6 +163,37 @@ class PSHandle:
         instead of hanging."""
         if self._failed:
             raise RuntimeError("parameter-server op already failed")
+        was_done = self._done
+        wd = None
+        wd_tok = -1
+        if not self._done and \
+                runtime.effective_config().watchdog != "off":
+            # Live hang detection over the native shard waits
+            # (docs/WATCHDOG.md): a wedged shard server past its socket
+            # timeout still shows up as a stalled in-flight window — and
+            # under an unbounded timeout_ms=0 wait, the watchdog is the
+            # ONLY thing bounding it.  One string compare when off.
+            from .. import watchdog
+
+            wd = watchdog
+            wd.raise_pending()
+            wd_tok = wd.begin("ps.response", op="ps_wait",
+                              nbytes=self._n_futures)
+        try:
+            self._wait_pending(timeout_ms)
+        finally:
+            if wd is not None:
+                wd.end(wd_tok)
+        if self._done and not was_done:
+            from ..utils import telemetry
+
+            # The completion edge for PS waits (flight ring via the
+            # sys.modules-gated shim, ONCE per handle): lets blame see
+            # "the PS exchange completed; the hang is elsewhere".
+            telemetry.emit("record_ps_wait", self._n_futures)
+        return self._result
+
+    def _wait_pending(self, timeout_ms: int = 0):
         if not self._done:
             while self._pending:
                 fid = self._pending[0]

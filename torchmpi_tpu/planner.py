@@ -77,9 +77,9 @@ class CollectivePlan:
     """
 
     __slots__ = ("key", "kind", "op", "backend", "nbytes", "spec", "impls",
-                 "extra", "staged", "obs", "faults", "guard", "analysis",
-                 "epoch", "topology", "build_seconds", "hits", "_replay",
-                 "_obs_hit")
+                 "extra", "staged", "obs", "faults", "guard", "watchdog",
+                 "analysis", "epoch", "topology", "build_seconds", "hits",
+                 "_replay", "_obs_hit")
 
     def __init__(self, key: tuple, kind: str, op: str, *,
                  backend: str = "", nbytes: int = 0,
@@ -88,6 +88,7 @@ class CollectivePlan:
                  extra: Optional[dict] = None,
                  staged: bool = False, obs: bool = False,
                  faults: bool = False, guard: bool = False,
+                 watchdog: bool = False,
                  analysis: str = "off",
                  topology: str = "",
                  replay: Optional[Callable] = None) -> None:
@@ -111,6 +112,12 @@ class CollectivePlan:
         # obs/faults (docs/GUARD.md): guard="off" is one string compare
         # HERE — the replay closure carries no guard branch at all.
         self.guard = bool(guard)
+        # Watchdog enablement, same build-time resolution
+        # (docs/WATCHDOG.md): "off" is one string compare at build and
+        # the replay closure carries ZERO watchdog branches; "on" binds
+        # the in-flight window (staged) / deferred-raise boundary
+        # (direct) into the closure itself.
+        self.watchdog = bool(watchdog)
         self.analysis = analysis
         self.epoch = runtime.config_epoch()
         self.build_seconds = 0.0
@@ -139,7 +146,7 @@ class CollectivePlan:
                          else (self.spec.n_launches
                                if self.spec is not None else 1)),
             "staged": self.staged, "obs": self.obs, "faults": self.faults,
-            "guard": self.guard,
+            "guard": self.guard, "watchdog": self.watchdog,
             "analysis": self.analysis, "epoch": self.epoch,
             "topology": self.topology,
             "build_ms": round(self.build_seconds * 1e3, 3),
@@ -349,6 +356,41 @@ def _in_axis_recorder(cfg, op: str, nbytes: int, axes) -> Optional[Callable]:
 # ---------------------------------------------------------------------------
 
 
+def _wd_wrap(replay: Callable, site: str, op: str,
+             nbytes: int) -> Callable:
+    """Bind the watchdog in-flight window around a BLOCKING replay (the
+    staged-host exchange): resolved once at plan build — the off path
+    never reaches here — so the armed replay pays one begin/end pair
+    and the deferred-raise boundary check, and the off replay pays
+    nothing at all (docs/WATCHDOG.md)."""
+    from . import watchdog
+
+    def wrapped(x):
+        watchdog.raise_pending()
+        tok = watchdog.begin(site, op=op, peer="gang", nbytes=nbytes)
+        try:
+            return replay(x)
+        finally:
+            watchdog.end(tok)
+
+    return wrapped
+
+
+def _wd_boundary(replay: Callable) -> Callable:
+    """Bind only the deferred-raise boundary into a NON-blocking replay
+    (the direct eager dispatch, which XLA enqueues asynchronously):
+    a stall a background thread is wedged in surfaces at the main
+    thread's next eager dispatch — the guard-style raise_pending
+    delivery point."""
+    from . import watchdog
+
+    def wrapped(x):
+        watchdog.raise_pending()
+        return replay(x)
+
+    return wrapped
+
+
 def plan_for(op: str, x, m: Mesh, n: int, backend: Optional[str],
              params: dict) -> CollectivePlan:
     """Plan (or replay-hit) one eager rank-major collective dispatch.
@@ -381,11 +423,14 @@ def _build_eager(key: tuple, op: str, x, m: Mesh, n: int,
         # per-attempt, as they must).
         faults_on = cfg is not None and cfg.faults != "off"
         wire_on = cfg is not None and cfg.guard in ("wire", "full")
+        wd_on = cfg is not None and cfg.watchdog != "off"
         rec = None
+        done = None
         if obs_on:
             from . import obs
 
             rec = obs.eager_recorder(op, nbytes, "host", m, x.dtype)
+            done = obs.eager_done_recorder(op, nbytes, "host", m)
         if faults_on or wire_on:
             from . import faults
 
@@ -394,20 +439,31 @@ def _build_eager(key: tuple, op: str, x, m: Mesh, n: int,
                     rec()
                 out = _faults.staged_exchange(op, x, n, pd, C._host_staged,
                                               wire_guard=wire_on)
-                return C._place_rank_major(np.ascontiguousarray(out), m,
-                                           sharding)
+                out = C._place_rank_major(np.ascontiguousarray(out), m,
+                                          sharding)
+                if done is not None:
+                    done()
+                return out
         else:
 
             def _replay(x):
                 if rec is not None:
                     rec()
                 out = C._host_staged(op, np.asarray(x), n, **pd)
-                return C._place_rank_major(np.ascontiguousarray(out), m,
-                                           sharding)
+                out = C._place_rank_major(np.ascontiguousarray(out), m,
+                                          sharding)
+                if done is not None:
+                    done()
+                return out
 
+        if wd_on:
+            # Resolved HERE, at plan build (the one string compare):
+            # the off replay above carries zero watchdog branches.
+            _replay = _wd_wrap(_replay, "host_staged", op, nbytes)
         return CollectivePlan(key, "eager-staged", op, backend="host",
                               nbytes=nbytes, staged=True, obs=obs_on,
                               faults=faults_on, guard=wire_on,
+                              watchdog=wd_on,
                               topology=topology_of(m),
                               replay=_replay)
 
@@ -461,18 +517,33 @@ def _build_eager(key: tuple, op: str, x, m: Mesh, n: int,
     fn = jax.jit(shmapped)
     backend_name = selector.name_of(op, impl)
     rec = None
+    done = None
     if obs_on:
         from . import obs
 
         rec = obs.eager_recorder(op, nbytes, backend_name, m, x.dtype)
+        done = obs.eager_done_recorder(op, nbytes, backend_name, m)
 
     def _replay(x):
         if rec is not None:
             rec()
-        return fn(C._place_rank_major(x, m, sharding))
+        out = fn(C._place_rank_major(x, m, sharding))
+        if done is not None:
+            # The dispatch-returned edge (XLA enqueue is async; the
+            # blocking completion surface is AsyncHandle.wait /
+            # block_until_ready, which record their own events).
+            done()
+        return out
 
+    wd_on = cfg is not None and cfg.watchdog != "off"
+    if wd_on:
+        # The direct dispatch never blocks — bind only the
+        # deferred-raise boundary (one string compare at build; zero
+        # branches in the off replay).
+        _replay = _wd_boundary(_replay)
     return CollectivePlan(key, "eager", op, backend=backend_name,
-                          nbytes=nbytes, obs=obs_on, analysis=verdict,
+                          nbytes=nbytes, obs=obs_on, watchdog=wd_on,
+                          analysis=verdict,
                           topology=topology_of(m),
                           extra={"executable": fn}, replay=_replay)
 

@@ -211,6 +211,22 @@ def _normalize_guard(value) -> Optional[str]:
     return None
 
 
+def _normalize_watchdog(value) -> Optional[str]:
+    """Canonical watchdog mode for a config/env value:
+    "off"|"warn"|"break", with boolean-ish spellings accepted
+    ("1"/"true"/"yes"/"on" mean "break" — the everything-armed reading
+    a boolean opt-in wants, "0"/"false"/"no"/"" mean "off").  None =
+    unrecognized (the caller raises)."""
+    v = str(value).strip().lower()
+    if v in ("off", "0", "false", "no", "none", ""):
+        return "off"
+    if v in ("break", "on", "1", "true", "yes"):
+        return "break"
+    if v == "warn":
+        return v
+    return None
+
+
 def _normalize_ckpt_redundancy(value) -> Optional[str]:
     """Canonical ckpt_redundancy mode for a config/env value:
     "off"|"verify"|"buddy", with boolean-ish spellings accepted
@@ -287,6 +303,38 @@ def _faults_deactivate_stale() -> None:
     import sys
 
     mod = sys.modules.get(__package__ + ".faults")
+    if mod is not None and mod.active():
+        mod.deactivate()
+
+
+def _watchdog_activate(cfg: Config) -> None:
+    """Import and arm the collective watchdog (only ever called with
+    ``cfg.watchdog != "off"`` — the off path never imports the
+    module).  The lease directory resolves to ``watchdog_dir``, then
+    the membership board (``elastic_dir``), then — on a re-activation
+    (a mid-run ``set_config`` deadline tune) — whatever directory the
+    already-armed watchdog leases into, so a lease home the elastic
+    driver ADOPTED at gang construction (``watchdog.set_lease_dir``)
+    survives reconfiguration instead of silently orphaning the rank's
+    lease on the board (peers read its expiry as death evidence).
+    None disables leases; the in-process monitor still runs."""
+    from . import watchdog
+
+    lease_dir = cfg.watchdog_dir or cfg.elastic_dir
+    if lease_dir is None and watchdog.active():
+        lease_dir = watchdog.lease_dir()
+    watchdog.activate(cfg.watchdog, deadline_s=cfg.watchdog_deadline_s,
+                      poll_s=cfg.watchdog_poll_s,
+                      lease_dir=lease_dir,
+                      rank=jax.process_index())
+
+
+def _watchdog_deactivate_stale() -> None:
+    """Disarm a previous session's watchdog without importing it
+    (sys.modules only — turning the watchdog off never imports it)."""
+    import sys
+
+    mod = sys.modules.get(__package__ + ".watchdog")
     if mod is not None and mod.active():
         mod.deactivate()
 
@@ -418,6 +466,30 @@ def init(config: Optional[Config] = None, **overrides) -> Mesh:
                 f"config.guard_spike_window must be >= 2 and "
                 f"guard_spike_threshold > 0, got "
                 f"{cfg.guard_spike_window}/{cfg.guard_spike_threshold}")
+        # Collective watchdog (docs/WATCHDOG.md): same any-config env
+        # pickup + one-home normalization as analysis/obs/faults/guard.
+        # "off" (default) never imports torchmpi_tpu.watchdog — the
+        # mode is read as one string compare at plan build / site
+        # entry, and the planned dispatch path gains zero branches.
+        if _normalize_watchdog(cfg.watchdog) == "off":
+            cfg.watchdog = os.environ.get("TORCHMPI_TPU_WATCHDOG", "off")
+        cfg.watchdog = _normalize_watchdog(cfg.watchdog)
+        if cfg.watchdog is None:
+            raise ValueError(
+                "config.watchdog (or TORCHMPI_TPU_WATCHDOG) must be "
+                "off|warn|break")
+        _env_default_pickup(cfg, "watchdog_deadline_s",
+                            "TORCHMPI_TPU_WATCHDOG_DEADLINE", float)
+        _env_default_pickup(cfg, "watchdog_poll_s",
+                            "TORCHMPI_TPU_WATCHDOG_POLL", float)
+        if cfg.watchdog_dir is None:
+            cfg.watchdog_dir = (
+                os.environ.get("TORCHMPI_TPU_WATCHDOG_DIR") or None)
+        if cfg.watchdog_deadline_s <= 0 or cfg.watchdog_poll_s <= 0:
+            raise ValueError(
+                f"config.watchdog_deadline_s and watchdog_poll_s must "
+                f"be > 0, got {cfg.watchdog_deadline_s}/"
+                f"{cfg.watchdog_poll_s}")
         # Durable checkpoints (docs/CHECKPOINT.md): same any-config env
         # pickup + one-home normalization.  "off" (default) never
         # imports utils/durable.py — save/restore read the mode as one
@@ -608,6 +680,13 @@ def init(config: Optional[Config] = None, **overrides) -> Mesh:
         mod = sys.modules.get(__package__ + ".obs")
         if mod is not None and mod.active():
             mod.deactivate()
+    # Collective watchdog: armed AFTER obs so the monitor's first
+    # events land in an armed registry.  Off (the default) never
+    # imports torchmpi_tpu.watchdog.
+    if cfg.watchdog != "off":
+        _watchdog_activate(cfg)
+    else:
+        _watchdog_deactivate_stale()
     return world
 
 
@@ -758,6 +837,15 @@ def set_config(**kw) -> None:
             if v <= 0:
                 raise ValueError(
                     "config.guard_spike_threshold must be > 0")
+        if k == "watchdog":
+            v = _normalize_watchdog(v)
+            if v is None:
+                raise ValueError(
+                    "config.watchdog must be off|warn|break")
+        if k in ("watchdog_deadline_s", "watchdog_poll_s"):
+            v = float(v)
+            if v <= 0:
+                raise ValueError(f"config.{k} must be > 0")
         if k == "ckpt_redundancy":
             v = _normalize_ckpt_redundancy(v)
             if v is None:
@@ -838,6 +926,13 @@ def set_config(**kw) -> None:
             mod = sys.modules.get(__package__ + ".obs")
             if mod is not None:
                 mod.deactivate()
+    if ("watchdog" in kw or "watchdog_deadline_s" in kw
+            or "watchdog_poll_s" in kw or "watchdog_dir" in kw):
+        if _state.config.watchdog != "off":
+            _watchdog_activate(_state.config)
+        else:
+            # Turning the watchdog OFF must not import the module.
+            _watchdog_deactivate_stale()
     from . import collectives, tuning
 
     collectives.clear_cache()
@@ -908,6 +1003,19 @@ def barrier(name: str = "torchmpi_tpu_barrier") -> None:
         # Recorded BEFORE the wait: a host stuck in this barrier shows
         # it as the last flight event (obs_tool.py blame anchor).
         obs.record_barrier(name)
+    wd = None
+    wd_tok = -1
+    if _state.config.watchdog != "off":
+        # Live hang detection over the gang sync (docs/WATCHDOG.md):
+        # a barrier the gang never completes is flagged stalled within
+        # watchdog_deadline_s — and any deferred break from a stalled
+        # background wait is delivered HERE, at the eager boundary,
+        # before this process commits to another gang-wide wait.
+        from . import watchdog
+
+        wd = watchdog
+        wd.raise_pending()
+        wd_tok = wd.begin("runtime.barrier", op=name, peer="gang")
 
     def _sync():
         if jax.process_count() > 1:
@@ -917,15 +1025,26 @@ def barrier(name: str = "torchmpi_tpu_barrier") -> None:
         else:
             jax.block_until_ready(jax.device_put(np.zeros(())))
 
-    if _state.config.faults != "off":
-        from . import faults
+    try:
+        if _state.config.faults != "off":
+            from . import faults
 
-        # Injection fires per attempt and the gang sync runs under the
-        # site deadline: a wedged peer becomes PeerTimeoutError instead
-        # of an unbounded wait (docs/FAULTS.md).
-        faults.guarded_barrier(name, _sync)
-    else:
-        _sync()
+            # Injection fires per attempt and the gang sync runs under
+            # the site deadline: a wedged peer becomes PeerTimeoutError
+            # instead of an unbounded wait (docs/FAULTS.md).
+            faults.guarded_barrier(name, _sync)
+        else:
+            _sync()
+    finally:
+        if wd is not None:
+            wd.end(wd_tok)
+    if _state.config.obs != "off":
+        from . import obs
+
+        # The completion edge: lets obs_tool blame tell "launched and
+        # stuck inside the barrier" from "completed it, never launched
+        # the next collective" (docs/OBSERVABILITY.md).
+        obs.record_barrier_done(name)
 
 
 # --- communicator (mesh) stack ---------------------------------------------

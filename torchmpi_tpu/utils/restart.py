@@ -77,11 +77,16 @@ def _load_health(directory: str) -> None:
 
 
 def _is_peer_timeout(e: BaseException) -> bool:
-    """Is ``e`` a ``faults.PeerTimeoutError``?  Checked via sys.modules:
-    if the fault layer was never armed, the class does not exist and no
-    exception can be one."""
+    """Is ``e`` a ``faults.PeerTimeoutError`` — or the watchdog's
+    ``CollectiveHangError`` (a stalled collective the watchdog broke;
+    docs/WATCHDOG.md), which takes the same detected-dead-peer restore
+    path?  Checked via sys.modules: if neither layer was ever armed,
+    the classes do not exist and no exception can be one."""
     mod = sys.modules.get("torchmpi_tpu.faults.policy")
-    return mod is not None and isinstance(e, mod.PeerTimeoutError)
+    if mod is not None and isinstance(e, mod.PeerTimeoutError):
+        return True
+    wd = sys.modules.get("torchmpi_tpu.watchdog")
+    return wd is not None and isinstance(e, wd.CollectiveHangError)
 
 
 def _obs_record(event: str, step: int) -> None:

@@ -23,3 +23,19 @@ def emit(method: str, *args, **kwargs) -> None:
             getattr(mod, method)(*args, **kwargs)
     except Exception:  # noqa: BLE001 — telemetry never fails a step
         pass
+
+
+def flight_tail(n: int = 8) -> list:
+    """The last ``n`` flight-recorder events, when obs is active — the
+    evidence a typed hang/timeout error ships with so the exception
+    that kills a step arrives with what ``obs_tool blame`` would
+    otherwise dig out of a post-mortem dump.  The ONE implementation
+    (``faults.policy`` and ``watchdog`` both route here); same
+    sys.modules gate as :func:`emit`."""
+    mod = sys.modules.get("torchmpi_tpu.obs")
+    try:
+        if mod is not None and mod.active():
+            return mod.recorder().to_records(best_effort=True)[-n:]
+    except Exception:  # noqa: BLE001 — evidence must not mask the error
+        pass
+    return []
