@@ -39,7 +39,16 @@ and raises nothing — valid at EVERY site, payload-free ones included
 definition).  ``--stall RANK:STEP:NRANKS`` is the gang-wedge recipe:
 a stall on rank RANK's ``elastic.member`` liveness check at step
 STEP, the deterministic "one rank wedges the whole gang" scenario
-the watchdog acceptance drives.  ``summarize`` reads
+the watchdog acceptance drives.  ``--partition
+RANKS:STEP[:HEAL_STEP]`` is the split-brain recipe (docs/ELASTIC.md):
+a ``partition`` rule at the ``board.read`` site masks the membership
+board's visibility along RANKS (``"2,3"`` symmetric, ``"0,1|2,3"``
+explicit groups, ``"~2,3"`` one-way/deaf — the asymmetric case) from
+gang step STEP until HEAL_STEP; ``lint`` rejects ``partition`` off
+the ``board.*`` sites and payload kinds ON them, and ``summarize``
+reports the park/fence counters
+(``tm_elastic_{quorum_lost,parked,fenced,healed}_total``) alongside
+the rest.  ``summarize`` reads
 per-host obs metric dumps (the files ``TORCHMPI_TPU_OBS=metrics``
 leaves behind) and prints the ``tm_fault_*``, ``tm_elastic_*``,
 ``tm_guard_*``, ``tm_ckpt_*``, and ``tm_watchdog_*`` series — what
@@ -128,6 +137,33 @@ def parse_shrink(inject, spec: str):
     return _boundary_rule(inject, "--shrink", spec, "fail")
 
 
+def parse_partition(inject, spec: str):
+    """``RANKS:STEP[:HEAL_STEP]`` -> a ``partition`` rule at the
+    ``board.read`` site (docs/ELASTIC.md "Partitions and split-brain"):
+    from gang step STEP, the membership board's visibility splits along
+    RANKS — ``"2,3"`` (those ranks vs. the rest, symmetric),
+    ``"0,1|2,3"`` (explicit groups), ``"~2,3"`` (one-way: the named
+    ranks go DEAF — they see nobody else's board files while their own
+    writes stay visible; the asymmetric case).  With HEAL_STEP the
+    mask lifts once any member's posted progress reaches it; without,
+    the partition never heals.  The step clock is the gang's own
+    progress, so the recipe replays bit-exactly."""
+    parts = spec.split(":")
+    if len(parts) not in (2, 3):
+        raise ValueError(
+            f"--partition {spec!r}: want RANKS:STEP[:HEAL_STEP] "
+            f"(RANKS e.g. '1' / '0,1|2,3' / '~1')")
+    ranks = parts[0]
+    step = int(parts[1])
+    heal = int(parts[2]) if len(parts) == 3 else -1
+    if step < 0:
+        raise ValueError(f"--partition {spec!r}: STEP must be >= 0")
+    rule = inject.FaultRule(site="board.read", kind="partition",
+                            ranks=ranks, after=step, heal_after=heal)
+    rule.validate()
+    return rule, ranks, step, heal
+
+
 def parse_stall(inject, spec: str):
     """Wedge-rank-at-step recipe (docs/WATCHDOG.md): a ``stall`` at
     member RANK's liveness check at step STEP — every process of the
@@ -169,12 +205,22 @@ def cmd_gen(args) -> int:
                   f"gang (elastic.member arrival {rule.after}; "
                   f"watchdog=break recovers at N-1, watchdog=off hangs "
                   f"— docs/WATCHDOG.md)")
+        for spec in args.partition:
+            rule, ranks, step, heal = parse_partition(inject, spec)
+            rules.append(rule)
+            heal_s = (f", heals at step {heal}" if heal >= 0
+                      else ", never heals")
+            print(f"partition recipe: split the membership board "
+                  f"along ranks {ranks!r} from step {step}{heal_s} "
+                  f"(elastic_quorum=majority parks the minority and "
+                  f"rejoins at heal; quorum off forks the view — "
+                  f"docs/ELASTIC.md)")
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
     if not rules:
-        print("error: gen needs at least one --rule, --shrink or "
-              "--stall", file=sys.stderr)
+        print("error: gen needs at least one --rule, --shrink, "
+              "--stall or --partition", file=sys.stderr)
         return 2
     plan = inject.FaultPlan(seed=args.seed, note=args.note, rules=rules)
     problems = inject.lint_plan(plan)
@@ -275,6 +321,14 @@ def main(argv=None) -> int:
                         "RANK's liveness check at step STEP (a silent "
                         "indefinite hold; watchdog=break converts it "
                         "into a typed hang + N-1 shrink)")
+    s.add_argument("--partition", action="append", default=[],
+                   help="RANKS:STEP[:HEAL_STEP] — split-brain recipe "
+                        "(docs/ELASTIC.md): partition the membership "
+                        "board along RANKS ('2,3' symmetric, "
+                        "'0,1|2,3' groups, '~2,3' one-way/deaf) from "
+                        "gang step STEP, optionally healing at "
+                        "HEAL_STEP; elastic_quorum=majority parks the "
+                        "minority, quorum off demonstrably forks")
     s.set_defaults(fn=cmd_gen)
 
     s = sub.add_parser("lint", help="validate plan files")

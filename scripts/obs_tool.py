@@ -386,9 +386,11 @@ def cmd_blame_live(args) -> int:
     RUNS — no dumps, no SIGTERM — and name the implicated rank(s).
     The triage matrix (docs/WATCHDOG.md): a rank whose lease is FRESH
     but whose collective is STALLED is wedged on a *peer*; an EXPIRED
-    or ``escalated`` lease is that rank's own death evidence.  Exits 1
-    when anything is stalled/expired, 0 when all ranks look healthy,
-    2 on unusable input."""
+    or ``escalated`` lease is that rank's own death evidence; a fresh
+    lease with ``state=parked`` is a quorum-lost minority waiting out
+    a partition (docs/ELASTIC.md) — alive, deliberately idle, and NOT
+    to be restarted.  Exits 1 when anything is stalled/expired/parked,
+    0 when all ranks look healthy, 2 on unusable input."""
     import time
 
     if len(args.files) != 1:
@@ -405,6 +407,7 @@ def cmd_blame_live(args) -> int:
         return 2
     now = time.time()
     implicated = []
+    parked = []
     stalled_peers = set()
     print(f"live watchdog leases in {directory} ({len(leases)} rank(s)):")
     for rank in sorted(leases):
@@ -421,6 +424,16 @@ def cmd_blame_live(args) -> int:
                      f"{d.get('ttl_s')}s) — dead, or wedged beyond its "
                      f"own watchdog")
             implicated.append(rank)
+        elif d.get("state") == "parked":
+            # A quorum-parked minority (docs/ELASTIC.md "Partitions
+            # and split-brain"): deliberately idle, lease FRESH — not
+            # a corpse, not a stall.  It rejoins the majority's
+            # committed epoch on its own once the partition heals.
+            detail = d.get("state_detail") or "a newer committed epoch"
+            state = (f"PARKED (quorum lost — {detail}; lease renewed "
+                     f"{age:.1f}s ago; will rejoin at heal, no "
+                     f"restart needed)")
+            parked.append(rank)
         elif stalls:
             parts = ", ".join(
                 f"{e.get('site')}"
@@ -444,6 +457,11 @@ def cmd_blame_live(args) -> int:
         verdicts.append(
             f"rank(s) {implicated} implicated (expired/escalated lease "
             f"— the elastic layer treats this as death evidence)")
+    if parked:
+        verdicts.append(
+            f"rank(s) {parked} PARKED (quorum-lost minority waiting "
+            f"out a partition — alive and heartbeating, NOT a corpse; "
+            f"they readmit themselves once the board heals)")
     stalled_ranks = [r for r in sorted(leases)
                      if any(e.get("stalled")
                             for e in leases[r].get("inflight", []))]

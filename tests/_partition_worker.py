@@ -1,0 +1,196 @@
+"""Worker for the 2-process split-brain acceptance (test_partition.py /
+the partition-smoke CI job; underscore prefix keeps pytest from
+collecting it).
+
+Two INDEPENDENT processes share ONE membership board + checkpoint
+directory — and nothing else.  That is the honest shape of a board
+partition: the data plane of each side keeps working (each side trains
+its own devices), the shared filesystem protocol layer is what splits.
+Each process runs the real driver (``elastic.run_elastic``) over an
+``ElasticGang(local=[rank])`` protocol-harness gang speaking only for
+its own rank, under a seeded asymmetric partition plan
+(``chaos_tool gen --partition "~0:S:H"``: rank 0 goes DEAF — it stops
+seeing rank 1's board files — while rank 1 still sees everything).
+
+- mode ``partition`` (argv: directory plan quorum): the chaos run.
+  With ``elastic_quorum="majority"``: rank 0 stops seeing rank 1,
+  declares it stale, WINS the even-split tie-break (it holds the
+  lowest prior rank) and commits the survivor view — training
+  continues at N-1 on ONE lineage.  Rank 1 still sees rank 0's files,
+  so the moment rank 0's higher epoch commits, rank 1's next board
+  write / checkpoint save is FENCED (typed ``FencedWriterError``) —
+  the zombie-minority signal — and it PARKS: heartbeat-visible wait,
+  then ``admit`` back into the majority's committed epoch once rank
+  0's progress passes the heal step.  Both finish on the re-grown
+  view with bit-identical digests.  With quorum OFF the same plan
+  forks: rank 0 commits the survivor view and trains the N-1 lineage
+  while unfenced rank 1 keeps training the full-view lineage against
+  a superseded epoch — two live gangs, divergent digests.
+- mode ``replay`` (argv: directory schedule_json): the clean
+  comparison — a pure, boardless compute of the same deterministic
+  program under an explicit view schedule (the chaos run's
+  ``recoveries`` + grow boundary), proving the chaotic majority's
+  final state is BIT-identical to a clean N-1 -> N run.
+
+argv: pid nproc port mode directory [plan quorum | schedule_json]
+"""
+
+import hashlib
+import json
+import os
+import sys
+import time
+
+pid = int(sys.argv[1])
+nproc = int(sys.argv[2])
+mode = sys.argv[4]
+directory = sys.argv[5]
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+import numpy as np  # noqa: E402
+
+import torchmpi_tpu as mpi  # noqa: E402
+
+import jax.numpy as jnp  # noqa: E402
+from jax import lax, shard_map  # noqa: E402
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: E402
+
+STEPS = 28
+DIM, H, B = 4, 8, 8
+LR = 0.05
+SLEEP_S = 0.15  # slow the loop so wall-clock staleness detection runs
+
+
+def _member_batch(m, step):
+    rng = np.random.RandomState(10_000 + m * 97 + step)
+    return (rng.randn(B, DIM).astype(np.float32),
+            rng.randn(B, 1).astype(np.float32))
+
+
+def build(mesh, view):
+    """Deterministic per-(member, step) data-parallel MLP: the
+    trajectory is a pure function of the view schedule, which is what
+    makes fork-vs-one-lineage assertable by digest."""
+    axes = tuple(mesh.axis_names)
+    members = view.members
+
+    def init_fn():
+        rng = np.random.RandomState(0)
+        params = {"w1": (rng.randn(DIM, H) * 0.3).astype(np.float32),
+                  "b1": np.zeros((H,), np.float32),
+                  "w2": (rng.randn(H, 1) * 0.3).astype(np.float32)}
+        return {"params": params,
+                "losses": np.full((STEPS,), np.nan, np.float32)}
+
+    def body(p, x, y):
+        x, y = x[0], y[0]
+        ax = axes if len(axes) > 1 else axes[0]
+
+        def loss_fn(p):
+            h = jnp.tanh(x @ p["w1"] + p["b1"])
+            return jnp.mean((h @ p["w2"] - y) ** 2)
+
+        l, g = jax.value_and_grad(loss_fn)(p)
+        l = lax.pmean(l, ax)
+        g = jax.tree.map(lambda a: lax.pmean(a, ax), g)
+        return jax.tree.map(lambda a, b: a - LR * b, p, g), l
+
+    data_sharding = NamedSharding(mesh, P(axes))
+    stepf = jax.jit(shard_map(
+        body, mesh=mesh, in_specs=(P(), P(axes), P(axes)),
+        out_specs=(P(), P()), check_vma=False))
+
+    def step_fn(state, i):
+        time.sleep(SLEEP_S)
+        xs, ys = zip(*(_member_batch(m, i) for m in members))
+        xb = jax.device_put(np.stack(xs), data_sharding)
+        yb = jax.device_put(np.stack(ys), data_sharding)
+        p2, l = stepf(state["params"], xb, yb)
+        losses = np.array(state["losses"])
+        losses[i] = np.asarray(l)
+        return {"params": jax.tree.map(np.asarray, p2),
+                "losses": losses}
+
+    return init_fn, step_fn
+
+
+def _digest(arr):
+    return hashlib.sha256(
+        np.ascontiguousarray(arr).tobytes()).hexdigest()
+
+
+def _summary(state, extra):
+    out = {"rank": pid,
+           "losses_digest": _digest(state["losses"]),
+           "params_digest": _digest(np.concatenate(
+               [state["params"][k].reshape(-1)
+                for k in sorted(state["params"])]))}
+    out.update(extra)
+    print("PARTITION-SUMMARY " + json.dumps(out), flush=True)
+
+
+if mode == "replay":
+    # Clean N-1 -> N comparison: compute the same program under the
+    # chaos run's view schedule, no board, no faults, no recovery.
+    sched = json.loads(sys.argv[6])  # [[start, [members...]], ...]
+    mpi.init(mpi.Config(dcn_size=1))
+    from torchmpi_tpu.faults import membership  # noqa: E402
+
+    devs = jax.devices()
+    state = None
+    for idx, (start, members) in enumerate(sched):
+        end = sched[idx + 1][0] if idx + 1 < len(sched) else STEPS
+        mesh = Mesh(np.array([devs[m] for m in members]), ("ici",))
+        view = membership.MembershipView(epoch=idx, members=tuple(members),
+                                         step=start)
+        init_fn, step_fn = build(mesh, view)
+        if state is None:
+            state = init_fn()
+        for i in range(start, end):
+            state = step_fn(state, i)
+    _summary(state, {"mode": "replay"})
+    mpi.stop()
+    sys.exit(0)
+
+plan_path = sys.argv[6]
+quorum = sys.argv[7]
+mpi.init(mpi.Config(
+    elastic="on",
+    elastic_quorum=("majority" if quorum == "on" else "off"),
+    elastic_deadline_s=1.0, elastic_poll_s=0.02,
+    faults=plan_path, obs="metrics",
+    obs_dir=os.path.join(directory, f"obs{pid}")))
+
+from torchmpi_tpu import elastic, obs  # noqa: E402
+
+gang = elastic.ElasticGang(directory, members=[0, 1], world_size=2,
+                           local=[pid])
+state, info = elastic.run_elastic(
+    build, steps=STEPS, directory=directory, save_every=2, gang=gang,
+    park_budget_s=120)
+
+reg = obs.registry()
+_summary(state, {
+    "mode": f"partition-quorum-{quorum}",
+    "shrinks": info["shrinks"],
+    "rejoins": info["rejoins"],
+    "parks": info["parks"],
+    "recoveries": info["recoveries"],
+    "recovered_step": info["recovered_step"],
+    "members": list(info["view"].members),
+    "epoch": info["view"].epoch,
+    "view_step": info["view"].step,
+    "quorum_lost_total": int(reg.counter_total(
+        "tm_elastic_quorum_lost_total")),
+    "parked_total": int(reg.counter_total("tm_elastic_parked_total")),
+    "fenced_total": int(reg.counter_total("tm_elastic_fenced_total")),
+    "healed_total": int(reg.counter_total("tm_elastic_healed_total")),
+})
+mpi.stop()
+print(f"CHECK rank={pid} done", flush=True)

@@ -1,0 +1,897 @@
+"""Split-brain-safe elastic membership (docs/ELASTIC.md "Partitions
+and split-brain"): the quorum rule + deterministic tie-break, the
+minority's typed ``QuorumLost`` and the park->heal->resume round trip
+on the CPU sim, epoch fencing at the board and checkpoint-save seams,
+the ``partition`` fault kind's per-rank board visibility mask
+(symmetric, grouped, one-way/asymmetric; step-deterministic heal), the
+board-trouble-vs-voter-silence reconcile fix, the watchdog ``parked``
+lease state through ``obs_tool blame --live``, the chaos_tool
+partition recipe + lint pairings, and the quorum-off /
+elastic_quorum-off never-imported guarantees."""
+
+import contextlib
+import importlib.util
+import io
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import torchmpi_tpu as mpi  # noqa: F401 — installs the jax.shard_map shim
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax import lax, shard_map  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+from torchmpi_tpu import faults  # noqa: E402
+from torchmpi_tpu.faults import membership  # noqa: E402
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(
+        f"_{name}_under_partition_test",
+        os.path.join(_REPO, "scripts", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _write_plan(path, rules, seed=7):
+    with open(path, "w") as f:
+        json.dump({"version": 1, "seed": seed, "rules": rules}, f)
+    return str(path)
+
+
+def _partition_rule(ranks, after=0, heal=-1, site="board.read"):
+    return {"site": site, "kind": "partition", "ranks": ranks,
+            "after": after, "heal_after": heal}
+
+
+@pytest.fixture()
+def armed_plan(tmp_path):
+    """Callable fixture: write + arm a fault plan; always disarms."""
+
+    def arm(rules, seed=7):
+        faults.activate(_write_plan(tmp_path / "plan.json", rules,
+                                    seed=seed))
+        return faults.plan()
+
+    yield arm
+    faults.reset()
+
+
+# ---------------------------------------------------------------------------
+# Partition grammar + rule validation + lint pairings
+# ---------------------------------------------------------------------------
+
+
+def test_partition_ranks_grammar():
+    groups, one_way = faults.parse_partition_ranks("2,3")
+    assert groups == [frozenset({2, 3})] and not one_way
+    groups, one_way = faults.parse_partition_ranks("0,1|2,3")
+    assert groups == [frozenset({0, 1}), frozenset({2, 3})]
+    assert not one_way
+    groups, one_way = faults.parse_partition_ranks("~2,3")
+    assert groups == [frozenset({2, 3})] and one_way
+    for bad in ("", "a,b", "1|1", "~0|1", "-1", "1,,|"):
+        with pytest.raises(ValueError):
+            faults.parse_partition_ranks(bad)
+
+
+def test_partition_rule_validation():
+    rule = faults.FaultRule(site="board.read", kind="partition",
+                            ranks="~1", after=4, heal_after=9)
+    rule.validate()
+    # Round-trips through the plan JSON with its new fields; rules
+    # WITHOUT them serialize byte-identically to the old schema.
+    d = rule.to_json()
+    assert d["ranks"] == "~1" and d["heal_after"] == 9
+    assert faults.FaultRule.from_json(d) == rule
+    old = faults.FaultRule(site="ps.request", kind="drop").to_json()
+    assert "ranks" not in old and "heal_after" not in old
+    with pytest.raises(ValueError):  # partition needs a split
+        faults.FaultRule(site="board.read", kind="partition").validate()
+    with pytest.raises(ValueError):  # heal must be after the start
+        faults.FaultRule(site="board.read", kind="partition",
+                         ranks="1", after=5, heal_after=5).validate()
+    with pytest.raises(ValueError):  # ranks is partition-only
+        faults.FaultRule(site="ps.request", kind="drop",
+                         ranks="1").validate()
+
+
+def test_partition_lint_pairings():
+    def lint(rule):
+        return faults.lint_plan(faults.FaultPlan(rules=[rule]))
+
+    ok = faults.FaultRule(site="board.read", kind="partition", ranks="1")
+    assert lint(ok) == []
+    off_board = faults.FaultRule(site="elastic.member",
+                                 kind="partition", ranks="1")
+    assert any("membership board" in p for p in lint(off_board))
+    knobs = faults.FaultRule(site="board.read", kind="partition",
+                             ranks="1", prob=0.5)
+    assert any("standing window" in p for p in lint(knobs))
+    # Payload kinds on the payload-free board sites are rejected too.
+    rot = faults.FaultRule(site="board.write", kind="corrupt_silent")
+    assert any("no payload" in p for p in lint(rot))
+    torn = faults.FaultRule(site="board.write", kind="torn")
+    assert any("torn" in p for p in lint(torn))
+    stray = faults.FaultRule(site="ps.request", kind="drop",
+                             heal_after=9)
+    assert any("heal_after" in p for p in lint(stray))
+
+
+# ---------------------------------------------------------------------------
+# The board visibility mask (symmetric / asymmetric / heal window)
+# ---------------------------------------------------------------------------
+
+
+def test_board_mask_symmetric_and_self_exempt(tmp_path, armed_plan):
+    armed_plan([_partition_rule("0|1", after=0)])
+    d = str(tmp_path / "board")
+    b0 = membership.Board(d, reader_rank=0)
+    b1 = membership.Board(d, reader_rank=1)
+    raw = membership.Board(d)  # no reader identity -> never masked
+    b0.note_step(0)
+    b1.note_step(0)
+    b0.heartbeat(0, epoch=1, step=0)
+    b1.heartbeat(1, epoch=1, step=0)
+    assert set(b0.heartbeats()) == {0}   # own side only
+    assert set(b1.heartbeats()) == {1}
+    assert set(raw.heartbeats()) == {0, 1}  # the files are all there
+
+
+def test_board_mask_one_way_asymmetric(tmp_path, armed_plan):
+    """``~1``: rank 1 is DEAF — it sees nobody else's files while its
+    own writes stay visible to everyone (A sees B, B doesn't see A)."""
+    armed_plan([_partition_rule("~1", after=0)])
+    d = str(tmp_path / "board")
+    b0 = membership.Board(d, reader_rank=0)
+    b1 = membership.Board(d, reader_rank=1)
+    b0.note_step(0)
+    b1.note_step(0)
+    b0.heartbeat(0, epoch=1, step=0)
+    b1.heartbeat(1, epoch=1, step=0)
+    assert set(b0.heartbeats()) == {0, 1}  # A sees B
+    assert set(b1.heartbeats()) == {1}     # B doesn't see A
+
+
+def test_board_mask_window_and_heal_clock(tmp_path, armed_plan):
+    """The mask is a step-deterministic window [after, heal): inactive
+    before the gang reaches `after`, lifted once ANY member's posted
+    progress reaches `heal` — including for a reader whose own step
+    froze (the parked minority reads the clock raw)."""
+    armed_plan([_partition_rule("1", after=3, heal=6)])
+    d = str(tmp_path / "board")
+    b0 = membership.Board(d, reader_rank=0)
+    writer = membership.Board(d)
+    writer.heartbeat(1, epoch=1, step=0)
+    assert set(b0.heartbeats()) == {1}  # step 0: not yet active
+    b0.note_step(3)
+    assert set(b0.heartbeats()) == set()  # active
+    # The reader's own step stays 3; the WRITER's progress heals it.
+    writer.heartbeat(1, epoch=1, step=6)
+    assert set(b0.heartbeats()) == {1}  # healed via the raw clock scan
+
+
+# ---------------------------------------------------------------------------
+# Quorum rule + reconcile gating
+# ---------------------------------------------------------------------------
+
+
+def test_quorum_rule_matrix():
+    prior = [0, 1, 2, 3]
+    assert membership.has_quorum([0, 1, 2], prior)        # majority
+    assert not membership.has_quorum([3], prior)          # minority
+    assert not membership.has_quorum([], prior)
+    assert membership.has_quorum([0, 1], prior)           # tie: has 0
+    assert not membership.has_quorum([2, 3], prior)       # tie: no 0
+    assert membership.has_quorum([5, 6], [])              # no history
+    # Odd prior: no tie exists, strict majority decides.
+    assert membership.has_quorum([0, 1], [0, 1, 2])
+    assert not membership.has_quorum([2], [0, 1, 2])
+
+
+def test_reconcile_quorum_minority_raises(tmp_path):
+    board = membership.Board(str(tmp_path / "board"))
+    with pytest.raises(membership.QuorumLost) as ei:
+        membership.reconcile(board, [3], [3], epoch=2, step=5,
+                             quorum_of=[0, 1, 2, 3], deadline_s=1,
+                             poll_s=0.01)
+    assert ei.value.voters == (3,)
+    assert ei.value.quorum_of == (0, 1, 2, 3)
+    # Nothing landed: the minority never even proposed.
+    assert board.proposals(2) == {} and board.commits(2) == {}
+    # The tie WINNER (holds rank 0) commits the same shrink fine.
+    v = membership.reconcile(board, [0, 1], [0, 1], epoch=2, step=5,
+                             quorum_of=[0, 1, 2, 3], deadline_s=1,
+                             poll_s=0.01)
+    assert v.members == (0, 1) and v.epoch == 2
+
+
+def test_reconcile_fork_vs_single_lineage(tmp_path, armed_plan):
+    """The acceptance contrast at the membership layer: under a
+    symmetric board partition, quorum OFF commits two fully-committed
+    DISJOINT views at the same epoch (the fork); quorum=majority
+    commits exactly one — the tie-winner's — while the minority raises
+    QuorumLost."""
+    armed_plan([_partition_rule("0|1", after=0)])
+
+    def split_brain(d, quorum_of):
+        b0 = membership.Board(d, reader_rank=0)
+        b1 = membership.Board(d, reader_rank=1)
+        for b in (b0, b1):
+            b.note_step(0)
+        results = {}
+
+        def run(board, rank):
+            try:
+                results[rank] = membership.reconcile(
+                    board, [rank], [rank], epoch=2, step=5,
+                    quorum_of=quorum_of, deadline_s=2, poll_s=0.01)
+            except membership.MembershipError as e:
+                results[rank] = e
+
+        ts = [threading.Thread(target=run, args=(b0, 0)),
+              threading.Thread(target=run, args=(b1, 1))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=20)
+        return results
+
+    # Quorum OFF: both sides commit; the board holds a forked epoch.
+    d1 = str(tmp_path / "fork")
+    res = split_brain(d1, None)
+    assert res[0].members == (0,) and res[1].members == (1,)
+    raw = membership.Board(d1)
+    payloads = {tuple(p["members"]) for p in raw.commits(2).values()}
+    assert payloads == {(0,), (1,)}  # two live lineages, one epoch
+
+    # Quorum MAJORITY: one lineage; the minority parks instead.
+    d2 = str(tmp_path / "lineage")
+    res = split_brain(d2, [0, 1])
+    assert res[0].members == (0,)
+    assert isinstance(res[1], membership.QuorumLost)
+    raw = membership.Board(d2)
+    payloads = {tuple(p["members"]) for p in raw.commits(2).values()}
+    assert payloads == {(0,)}
+    assert raw.committed_view().members == (0,)
+
+
+def test_double_death_partition_interplay(tmp_path, armed_plan):
+    """Concurrent double death + partition: a side that lost BOTH a
+    genuinely-dead member and the other side of the split only commits
+    when what remains is still a majority of the prior view."""
+    armed_plan([_partition_rule("0,1|2,3,4", after=0)])
+    d = str(tmp_path / "board")
+    prior = [0, 1, 2, 3, 4]
+    b_small = membership.Board(d, reader_rank=0)
+    b_big = membership.Board(d, reader_rank=2)
+    for b in (b_small, b_big):
+        b.note_step(0)
+    # Side {0,1}: 2 of 5 is a minority -> parks.
+    with pytest.raises(membership.QuorumLost):
+        membership.reconcile(b_small, [0, 1], [0, 1], epoch=2, step=3,
+                             quorum_of=prior, deadline_s=1, poll_s=0.01)
+    # Side {2,3,4} ALSO observed member 4 die concurrently: {2,3} is
+    # 2 of 5 -> minority despite being the bigger side of the split.
+    with pytest.raises(membership.QuorumLost):
+        membership.reconcile(b_big, [2, 3], [2, 3], epoch=2, step=3,
+                             quorum_of=prior, deadline_s=1, poll_s=0.01)
+    # With all three alive it IS the majority and commits.
+    v = membership.reconcile(b_big, [2, 3, 4], [2, 3, 4], epoch=2,
+                             step=3, quorum_of=prior, deadline_s=1,
+                             poll_s=0.01)
+    assert v.members == (2, 3, 4)
+
+
+def test_board_trouble_is_not_voter_silence(tmp_path, armed_plan):
+    """The reconcile deadline used to treat an unreadable BOARD as
+    universal voter silence (everyone 'dropped', shrink toward
+    ReconcileTimeout).  Now: a deadline at which even this rank's OWN
+    payload is invisible re-posts and retries the SAME epoch; only
+    specific silent voters get dropped."""
+    # Reads lost for ~2 deadline rounds, then the board heals.
+    armed_plan([{"site": "board.read", "kind": "drop", "prob": 1.0,
+                 "after": 0, "max_hits": 25}])
+    board = membership.Board(str(tmp_path / "board"), reader_rank=0)
+    v = membership.reconcile(board, [0, 1], [0, 1], epoch=1, step=0,
+                             deadline_s=0.25, poll_s=0.02)
+    # Same epoch, nobody dropped — board trouble was retried in place.
+    assert v.epoch == 1 and v.members == (0, 1)
+
+
+def test_board_unreadable_exhausts_with_typed_timeout(tmp_path,
+                                                      armed_plan):
+    armed_plan([{"site": "board.read", "kind": "drop", "prob": 1.0,
+                 "after": 0, "max_hits": -1}])
+    board = membership.Board(str(tmp_path / "board"), reader_rank=0)
+    with pytest.raises(membership.ReconcileTimeout,
+                       match="board unreadable"):
+        membership.reconcile(board, [0, 1], [0, 1], epoch=1, step=0,
+                             deadline_s=0.1, poll_s=0.02)
+
+
+# ---------------------------------------------------------------------------
+# Epoch fencing: board writes + the checkpoint-save seam
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def fence_teardown():
+    yield
+    from torchmpi_tpu.faults import fencing
+
+    fencing.disarm()
+
+
+def test_fence_rejects_stale_board_writes(tmp_path, fence_teardown):
+    from torchmpi_tpu.faults import fencing
+
+    d = str(tmp_path / "board")
+    board = membership.Board(d, reader_rank=0)
+    fence = fencing.arm(board, 0, epoch=1)
+    # Someone else commits epoch 2 without us.
+    other = membership.Board(d, reader_rank=1)
+    membership.reconcile(other, [1], [1], epoch=2, step=7,
+                         deadline_s=1, poll_s=0.01)
+    # Our stale-epoch writes are refused and never land.
+    with pytest.raises(fencing.FencedWriterError) as ei:
+        board.heartbeat(0, epoch=1, step=9)
+    assert ei.value.committed_epoch == 2 and ei.value.writer_epoch == 1
+    assert not os.path.exists(os.path.join(d, "hb_0.json"))
+    with pytest.raises(fencing.FencedWriterError):
+        board.propose(1, 0, [0, 1], 9)
+    # Protocol progress AT/ABOVE the committed epoch still lands, and
+    # the no-view-claimed beacon (epoch -1, the park loop's heartbeat)
+    # stays exempt — a parked rank must remain joiner-alive.
+    board.propose(3, 0, [0, 1], 9)
+    assert 0 in board.proposals(3)
+    board.heartbeat(0, epoch=-1, step=9)
+    assert 0 in membership.Board(d).heartbeats()
+    # Adopting the committed epoch un-fences the writer.
+    fence.update(2)
+    board.heartbeat(0, epoch=2, step=9)
+
+
+def test_fence_rejects_stale_checkpoint_save(tmp_path, fence_teardown):
+    """The checkpoint seam: a zombie minority's ``checkpoint.save``
+    (sync AND async paths) raises the typed error BEFORE any byte
+    lands on the majority's lineage; adopting the committed epoch
+    restores writability."""
+    from torchmpi_tpu.faults import fencing
+    from torchmpi_tpu.utils import checkpoint
+
+    d = str(tmp_path / "board")
+    board = membership.Board(d, reader_rank=0)
+    fence = fencing.arm(board, 0, epoch=1)
+    other = membership.Board(d, reader_rank=1)
+    membership.reconcile(other, [1], [1], epoch=2, step=7,
+                         deadline_s=1, poll_s=0.01)
+    ckpt_dir = str(tmp_path / "ckpt")
+    state = {"w": np.arange(6, dtype=np.float32)}
+    with pytest.raises(fencing.FencedWriterError):
+        checkpoint.save(ckpt_dir, state, step=5)
+    with pytest.raises(fencing.FencedWriterError):
+        checkpoint.save_async(ckpt_dir, state, step=5)
+    assert checkpoint.latest_step(ckpt_dir) is None  # nothing landed
+    fence.update(2)
+    checkpoint.save(ckpt_dir, state, step=5)
+    assert checkpoint.latest_step(ckpt_dir) == 5
+    # Disarm retracts the seam entirely (runtime.stop does this too).
+    fencing.disarm()
+    checkpoint.save(ckpt_dir, state, step=6)
+    assert checkpoint.latest_step(ckpt_dir) == 6
+
+
+def test_agreement_gate_refuses_stale_minority(tmp_path):
+    """The quorum gate routed through the recovery agreement: a gang
+    whose board committed past its view must not 'agree' a restore
+    step among a minority — it raises QuorumLost into the park path."""
+    mpi.stop()
+    mpi.init(mpi.Config(elastic="on", elastic_quorum="majority"))
+    try:
+        from torchmpi_tpu import elastic
+
+        d = str(tmp_path / "ckpt")
+        os.makedirs(d)
+        gang = elastic.ElasticGang(d, members=[0, 1], world_size=8)
+        other = membership.Board(os.path.join(d, "membership"),
+                                 reader_rank=1)
+        membership.reconcile(other, [1], [1],
+                             epoch=gang.view.epoch + 1, step=7,
+                             deadline_s=1, poll_s=0.01)
+        with pytest.raises(membership.QuorumLost):
+            gang.agreement()(5)
+    finally:
+        from torchmpi_tpu.faults import fencing
+
+        fencing.disarm()
+        mpi.stop()
+
+
+# ---------------------------------------------------------------------------
+# The park -> heal -> resume round trip on the CPU sim (run_elastic)
+# ---------------------------------------------------------------------------
+
+STEPS = 12
+DIM, H, B = 4, 8, 8
+LR = 0.05
+
+
+def _member_batch(m, step):
+    rng = np.random.RandomState(10_000 + m * 97 + step)
+    return (rng.randn(B, DIM).astype(np.float32),
+            rng.randn(B, 1).astype(np.float32))
+
+
+def _make_build(steps, sleep_s=0.0):
+    """Compact data-parallel MLP build (the test_elastic recipe):
+    deterministic per-(member, step) batches, so the trajectory is a
+    pure function of the view schedule; ``sleep_s`` slows the step
+    loop so wall-clock staleness detection can engage on the sim."""
+
+    def build(mesh, view):
+        axes = tuple(mesh.axis_names)
+        members = view.members
+
+        def init_fn():
+            rng = np.random.RandomState(0)
+            params = {"w1": (rng.randn(DIM, H) * 0.3).astype(np.float32),
+                      "b1": np.zeros((H,), np.float32),
+                      "w2": (rng.randn(H, 1) * 0.3).astype(np.float32)}
+            return {"params": params,
+                    "losses": np.full((steps,), np.nan, np.float32)}
+
+        def body(p, x, y):
+            x, y = x[0], y[0]
+            ax = axes if len(axes) > 1 else axes[0]
+
+            def loss_fn(p):
+                h = jnp.tanh(x @ p["w1"] + p["b1"])
+                return jnp.mean((h @ p["w2"] - y) ** 2)
+
+            l, g = jax.value_and_grad(loss_fn)(p)
+            l = lax.pmean(l, ax)
+            g = jax.tree.map(lambda a: lax.pmean(a, ax), g)
+            return jax.tree.map(lambda a, b: a - LR * b, p, g), l
+
+        data_sharding = NamedSharding(mesh, P(axes))
+        stepf = jax.jit(shard_map(
+            body, mesh=mesh, in_specs=(P(), P(axes), P(axes)),
+            out_specs=(P(), P()), check_vma=False))
+
+        def step_fn(state, i):
+            if sleep_s:
+                time.sleep(sleep_s)
+            xs, ys = zip(*(_member_batch(m, i) for m in members))
+            xb = jax.device_put(np.stack(xs), data_sharding)
+            yb = jax.device_put(np.stack(ys), data_sharding)
+            p2, l = stepf(state["params"], xb, yb)
+            losses = np.array(state["losses"])
+            losses[i] = np.asarray(l)
+            return {"params": jax.tree.map(np.asarray, p2),
+                    "losses": losses}
+
+        return init_fn, step_fn
+
+    return build
+
+
+@pytest.fixture()
+def elastic_runtime():
+    def arm(**cfg_kw):
+        mpi.stop()
+        return mpi.init(mpi.Config(elastic="on", **cfg_kw))
+
+    yield arm
+    if "torchmpi_tpu.faults" in sys.modules:
+        sys.modules["torchmpi_tpu.faults"].reset()
+    fencing = sys.modules.get("torchmpi_tpu.faults.fencing")
+    if fencing is not None:
+        fencing.disarm()
+    mpi.stop()
+
+
+def _heal_when_parked(board_dir, stop, deadline=25.0):
+    """Helper thread: once the gang reports itself parked, advance the
+    board's raw step clock past the heal step (the role the majority's
+    progress plays on a multi-process gang)."""
+    from torchmpi_tpu import obs
+
+    t0 = time.monotonic()
+    while not stop.is_set() and time.monotonic() - t0 < deadline:
+        if obs.registry().counter_total("tm_elastic_parked_total") >= 1:
+            with open(os.path.join(board_dir, "hb_9.json"), "w") as f:
+                json.dump({"rank": 9, "epoch": -1, "step": 10_000,
+                           "ts": time.time()}, f)
+            return
+        time.sleep(0.05)
+
+
+def test_park_heal_resume_roundtrip_sim(tmp_path, elastic_runtime):
+    """The fast single-process acceptance: a one-way partition hides
+    two of three members from the gang's reader -> staleness trips ->
+    the survivors-only reconcile is a MINORITY -> typed QuorumLost ->
+    the driver PARKS (counters; no commit, no fork) -> the clock
+    passes the heal step -> heal evidence (fresh heartbeats) -> the
+    driver resumes at the SAME epoch with the full member set, and the
+    final state is bit-identical to an unpartitioned run."""
+    from torchmpi_tpu import elastic, obs
+
+    d = str(tmp_path / "elastic")
+    os.makedirs(d)
+    plan = _write_plan(tmp_path / "plan.json",
+                       [_partition_rule("~0", after=2, heal=10_000)])
+    elastic_runtime(faults=plan, elastic_quorum="majority",
+                    elastic_deadline_s=0.5, elastic_poll_s=0.02,
+                    obs="metrics", obs_dir=str(tmp_path / "obs"))
+    stop = threading.Event()
+    healer = threading.Thread(
+        target=_heal_when_parked,
+        args=(os.path.join(d, "membership"), stop))
+    healer.start()
+    try:
+        state1, info1 = elastic.run_elastic(
+            _make_build(STEPS, sleep_s=0.08), steps=STEPS,
+            directory=d, save_every=2, members=[0, 1, 2],
+            world_size=8, park_budget_s=30)
+    finally:
+        stop.set()
+        healer.join(timeout=30)
+    assert info1["parks"] == 1
+    assert info1["shrinks"] == 0  # the minority never committed
+    assert info1["view"].members == (0, 1, 2)
+    assert np.isfinite(state1["losses"]).all()
+    reg = obs.registry()
+    assert reg.counter_total("tm_elastic_quorum_lost_total") >= 1
+    assert reg.counter_total("tm_elastic_parked_total") >= 1
+    assert reg.counter_total("tm_elastic_healed_total") >= 1
+
+    # Bit-identical to a clean, never-partitioned run.
+    d2 = str(tmp_path / "clean")
+    os.makedirs(d2)
+    elastic_runtime()
+    state2, info2 = elastic.run_elastic(
+        _make_build(STEPS), steps=STEPS, directory=d2, save_every=2,
+        members=[0, 1, 2], world_size=8)
+    assert info2["parks"] == 0
+    assert np.array_equal(state1["losses"], state2["losses"])
+    for k in state1["params"]:
+        assert np.array_equal(state1["params"][k], state2["params"][k])
+
+
+def test_quorum_off_same_plan_commits_minority(tmp_path,
+                                               elastic_runtime):
+    """The contrast leg: the SAME partition plan with quorum off lets
+    the minority reader commit a survivors-only view — the unprotected
+    behavior the quorum gate exists to stop (the threaded fork test
+    above shows both sides committing; here the driver demonstrably
+    commits from the minority side)."""
+    from torchmpi_tpu import elastic
+
+    d = str(tmp_path / "elastic")
+    os.makedirs(d)
+    plan = _write_plan(tmp_path / "plan.json",
+                       [_partition_rule("~0", after=2, heal=-1)])
+    elastic_runtime(faults=plan, elastic_deadline_s=0.5,
+                    elastic_poll_s=0.02)
+    state, info = elastic.run_elastic(
+        _make_build(STEPS, sleep_s=0.08), steps=STEPS, directory=d,
+        save_every=2, members=[0, 1, 2], world_size=8)
+    assert info["shrinks"] == 1 and info["parks"] == 0
+    assert info["view"].members == (0,)  # the fork, minority edition
+    assert np.isfinite(state["losses"]).all()
+
+
+def test_stale_staging_orphans_reaped(tmp_path):
+    """Writer-unique staging names (``*.tmp.<pid>``) never
+    self-overwrite, so a writer that died mid-stage would leak a
+    checkpoint-sized orphan per life — each successful commit reaps
+    stale ones (age-gated: a live concurrent writer's seconds-old
+    staging survives, and the exact-``.tmp`` torn-write artifact is
+    never touched)."""
+    from torchmpi_tpu.utils import checkpoint
+
+    d = str(tmp_path)
+    old = tmp_path / "ckpt_3_p0.npz.tmp.99999"
+    old.write_bytes(b"dead writer's leavings")
+    os.utime(old, (time.time() - 3600, time.time() - 3600))
+    fresh = tmp_path / "ckpt_5_p0.npz.tmp.88888"
+    fresh.write_bytes(b"live writer staging")
+    torn = tmp_path / "ckpt_7_p0.npz.tmp"
+    torn.write_bytes(b"PK torn artifact")
+    os.utime(torn, (time.time() - 3600, time.time() - 3600))
+    checkpoint.save(d, {"w": np.ones(3, np.float32)}, step=9)
+    assert not old.exists()      # stale orphan reaped
+    assert fresh.exists()        # live staging untouched
+    assert torn.exists()         # torn artifact preserved
+    assert checkpoint.latest_step(d) == 9
+
+
+# ---------------------------------------------------------------------------
+# Watchdog parked lease + blame --live triage
+# ---------------------------------------------------------------------------
+
+
+def test_blame_live_distinguishes_parked(tmp_path):
+    from torchmpi_tpu import watchdog
+
+    lease_dir = str(tmp_path / "leases")
+    watchdog.reset()
+    watchdog.activate("warn", deadline_s=5, poll_s=0.05,
+                      lease_dir=lease_dir, rank=1)
+    try:
+        watchdog.set_state("parked",
+                           "waiting for a committed epoch > 4")
+        with open(watchdog.lease_path(lease_dir, 1)) as f:
+            lease = json.load(f)
+        assert lease["state"] == "parked"
+        assert "epoch > 4" in lease["state_detail"]
+        tool = _load_script("obs_tool")
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = tool.main(["blame", "--live", lease_dir])
+        out = buf.getvalue()
+        assert rc == 1
+        assert "PARKED" in out and "epoch > 4" in out
+        assert "NOT a corpse" in out
+        # Back to running: healthy verdict, state resets.
+        watchdog.set_state("running")
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = tool.main(["blame", "--live", lease_dir])
+        assert rc == 0 and "all ranks healthy" in buf.getvalue()
+    finally:
+        watchdog.reset()
+
+
+# ---------------------------------------------------------------------------
+# chaos_tool: the partition recipe + summarize pass-through
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_tool_partition_recipe(tmp_path, capsys):
+    tool = _load_script("chaos_tool")
+    out = str(tmp_path / "part.json")
+    assert tool.main(["gen", "--out", out, "--seed", "3",
+                      "--partition", "~1:4:9"]) == 0
+    text = capsys.readouterr().out
+    assert "partition recipe" in text and "heals at step 9" in text
+    plan = json.load(open(out))
+    assert plan["rules"] == [{"site": "board.read", "kind": "partition",
+                              "prob": 1.0, "after": 4, "max_hits": 1,
+                              "delay_s": 0.0, "ranks": "~1",
+                              "heal_after": 9}]
+    assert tool.main(["lint", out]) == 0
+    capsys.readouterr()
+    # Bad specs fail loudly.
+    assert tool.main(["gen", "--out", out, "--partition", "1:4:3"]) == 2
+    assert tool.main(["gen", "--out", out, "--partition", "x:y"]) == 2
+    assert tool.main(["gen", "--out", out,
+                      "--rule", "elastic.member:partition"]) == 2
+    capsys.readouterr()
+    # Wrong-site partition and payload kinds on board sites lint dirty.
+    bad = str(tmp_path / "bad.json")
+    _write_plan(bad, [
+        dict(_partition_rule("1"), site="ps.request"),
+        {"site": "board.write", "kind": "corrupt_silent"}])
+    assert tool.main(["lint", bad]) == 1
+    text = capsys.readouterr().out
+    assert "membership board" in text and "no payload" in text
+
+
+def test_chaos_tool_summarize_reports_partition_counters(tmp_path,
+                                                         capsys):
+    tool = _load_script("chaos_tool")
+    dump = tmp_path / "metrics_host0.jsonl"
+    with open(dump, "w") as f:
+        for name in ("tm_elastic_quorum_lost_total",
+                     "tm_elastic_parked_total",
+                     "tm_elastic_fenced_total",
+                     "tm_elastic_healed_total"):
+            f.write(json.dumps({"kind": "counter", "name": name,
+                                "labels": {}, "value": 1}) + "\n")
+    assert tool.main(["summarize", str(dump)]) == 0
+    out = capsys.readouterr().out
+    for key in ("elastic_quorum_lost=1", "elastic_parked=1",
+                "elastic_fenced=1", "elastic_healed=1"):
+        assert key in out
+
+
+# ---------------------------------------------------------------------------
+# Config plumbing + off-mode never-imported guarantees
+# ---------------------------------------------------------------------------
+
+
+def test_elastic_quorum_config_env_and_validation(monkeypatch):
+    from torchmpi_tpu import runtime
+
+    mpi.stop()
+    monkeypatch.setenv("TORCHMPI_TPU_ELASTIC_QUORUM", "majority")
+    try:
+        mpi.init(mpi.Config(dcn_size=1))
+        assert runtime.config().elastic_quorum == "majority"
+        mpi.set_config(elastic_quorum="off")
+        assert runtime.config().elastic_quorum == "off"
+        mpi.set_config(elastic_quorum="1")  # boolean-ish spelling
+        assert runtime.config().elastic_quorum == "majority"
+        with pytest.raises(ValueError):
+            mpi.set_config(elastic_quorum="plurality")
+    finally:
+        mpi.stop()
+    monkeypatch.setenv("TORCHMPI_TPU_ELASTIC_QUORUM", "bogus")
+    with pytest.raises(ValueError):
+        mpi.init(mpi.Config(dcn_size=1))
+    monkeypatch.delenv("TORCHMPI_TPU_ELASTIC_QUORUM")
+    mpi.stop()
+
+
+def test_quorum_off_never_imports_fencing_or_partition():
+    """The acceptance guarantee: with ``elastic="off"`` nothing
+    elastic loads at all, and with ``elastic="on"`` but
+    ``elastic_quorum="off"`` (and no partition plan) the gang runs the
+    historical protocol with the fencing and partition modules never
+    imported — zero new dispatch-path branches either way."""
+    code = (
+        "import sys\n"
+        "import numpy as np\n"
+        "import torchmpi_tpu as mpi\n"
+        "mpi.init(mpi.Config(dcn_size=1))\n"
+        "mpi.allreduce(np.ones((2, 4), np.float32))\n"
+        "from torchmpi_tpu.utils import checkpoint\n"
+        "import tempfile\n"
+        "d = tempfile.mkdtemp()\n"
+        "checkpoint.save(d, {'w': np.ones(3, np.float32)}, step=1)\n"
+        "assert 'torchmpi_tpu.elastic' not in sys.modules\n"
+        "assert 'torchmpi_tpu.faults.fencing' not in sys.modules\n"
+        "assert 'torchmpi_tpu.faults.partition' not in sys.modules\n"
+        "mpi.stop()\n"
+        "mpi.init(mpi.Config(elastic='on'))\n"
+        "from torchmpi_tpu import elastic\n"
+        "g = elastic.ElasticGang(d, members=[0, 1], world_size=2)\n"
+        "assert g.poll(0) is None\n"
+        "g.shrink([1], step=0)\n"
+        "assert 'torchmpi_tpu.faults.fencing' not in sys.modules\n"
+        "assert 'torchmpi_tpu.faults.partition' not in sys.modules\n"
+        "mpi.stop()\n"
+        "print('QUORUM-OFF-OK')\n"
+    )
+    env = dict(os.environ)
+    for k in ("TORCHMPI_TPU_ELASTIC", "TORCHMPI_TPU_ELASTIC_QUORUM",
+              "TORCHMPI_TPU_FAULTS"):
+        env.pop(k, None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    out = subprocess.run([sys.executable, "-c", code],
+                         capture_output=True, text=True, timeout=300,
+                         env=env, cwd=_REPO)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "QUORUM-OFF-OK" in out.stdout
+
+
+# ---------------------------------------------------------------------------
+# 2-process acceptance (slow): a real asymmetric board partition across
+# two independent processes sharing only the board + checkpoint dir
+# ---------------------------------------------------------------------------
+
+
+def _launch_partition_workers(args, n):
+    worker = os.path.join(os.path.dirname(__file__),
+                          "_partition_worker.py")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    procs = [subprocess.Popen(
+        [sys.executable, worker, str(i), str(n), "0"] + args,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, env=env) for i in range(n)]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {i} failed:\n{out}"
+    return outs
+
+
+def _partition_summaries(outs):
+    out = {}
+    for o in outs:
+        for ln in o.splitlines():
+            if ln.startswith("PARTITION-SUMMARY "):
+                d = json.loads(ln[len("PARTITION-SUMMARY "):])
+                out[d["rank"]] = d
+    return out
+
+
+def _asym_plan(tmp_path):
+    """``chaos_tool gen --partition ~0:4:18`` as a file: rank 0 goes
+    deaf to rank 1's board files from gang step 4, healing at 18."""
+    tool = _load_script("chaos_tool")
+    out = str(tmp_path / "partition.json")
+    assert tool.main(["gen", "--out", out, "--seed", "3",
+                      "--partition", "~0:4:18"]) == 0
+    return out
+
+
+@pytest.mark.slow
+def test_two_process_partition_one_lineage(tmp_path):
+    """quorum=majority under a seeded asymmetric 2-process partition:
+    the majority (tie-break winner) commits exactly one survivor view
+    and continues at N-1; the minority's stale writes are FENCED (none
+    land), it PARKS, and the heal readmits it — both processes finish
+    on the re-grown view with BIT-identical digests, themselves
+    bit-identical to a clean N-1 -> N replay of the same schedule."""
+    d = str(tmp_path / "gang")
+    os.makedirs(d)
+    plan = _asym_plan(tmp_path)
+    outs = _launch_partition_workers(["partition", d, plan, "on"], 2)
+    by_rank = _partition_summaries(outs)
+    assert set(by_rank) == {0, 1}, outs
+    r0, r1 = by_rank[0], by_rank[1]
+    # Majority: shrank to N-1 once, then readmitted the healed rank.
+    assert r0["shrinks"] == 1 and r0["rejoins"] == 1
+    assert r0["parks"] == 0
+    # Minority: never committed — fenced, parked, healed, readmitted.
+    assert r1["parks"] == 1 and r1["shrinks"] == 0
+    assert r1["quorum_lost_total"] >= 1
+    assert r1["parked_total"] >= 1
+    assert r1["fenced_total"] >= 1
+    assert r1["healed_total"] >= 1
+    # ONE lineage: both ranks end on the same committed view with
+    # bit-identical state.
+    assert r0["members"] == [0, 1] and r1["members"] == [0, 1]
+    assert r0["epoch"] == r1["epoch"]
+    assert r0["losses_digest"] == r1["losses_digest"]
+    assert r0["params_digest"] == r1["params_digest"]
+    # Clean N-1 -> N replay of the majority's recovery schedule:
+    # full view to the shrink recovery, N-1 to the grow boundary,
+    # full view to the end — digests must match bit-exactly.
+    assert len(r0["recoveries"]) == 3, r0
+    start, c1, b = r0["recoveries"]
+    sched = json.dumps([[start, [0, 1]], [c1, [0]], [b, [0, 1]]])
+    outs2 = _launch_partition_workers(["replay", d, sched], 1)
+    clean = _partition_summaries(outs2)[0]
+    assert clean["losses_digest"] == r0["losses_digest"], (r0, clean)
+    assert clean["params_digest"] == r0["params_digest"]
+
+
+@pytest.mark.slow
+def test_two_process_partition_forks_without_quorum(tmp_path):
+    """The contrast: the SAME seeded plan with quorum off provably
+    forks — the deaf side commits a survivor view and trains the N-1
+    lineage while the unfenced other side keeps training the full-view
+    lineage against a superseded epoch: two live gangs, two committed
+    views, divergent digests."""
+    d = str(tmp_path / "gang")
+    os.makedirs(d)
+    plan = _asym_plan(tmp_path)
+    outs = _launch_partition_workers(["partition", d, plan, "off"], 2)
+    by_rank = _partition_summaries(outs)
+    assert set(by_rank) == {0, 1}, outs
+    r0, r1 = by_rank[0], by_rank[1]
+    assert r0["shrinks"] == 1 and r0["members"] == [0]
+    assert r1["shrinks"] == 0 and r1["members"] == [0, 1]
+    assert r0["epoch"] > r1["epoch"]  # two live views at once: the fork
+    assert r0["parks"] == 0 and r1["parks"] == 0
+    assert r1["fenced_total"] == 0  # nothing stopped the zombie
+    assert r0["losses_digest"] != r1["losses_digest"]
+    # The board itself shows the fork: a fully-committed survivor view
+    # ABOVE the epoch the other live gang is still training under.
+    board = membership.Board(os.path.join(d, "membership"))
+    assert board.committed_view().members == (0,)
+    assert board.committed_view().epoch == r0["epoch"]

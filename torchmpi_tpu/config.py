@@ -255,6 +255,28 @@ class Config:
     # part of the bounded two-phase reconcile).
     # Env: TORCHMPI_TPU_ELASTIC_DEADLINE.
     elastic_deadline_s: float = 30.0
+    # Split-brain protection for the reconcile (docs/ELASTIC.md
+    # "Partitions and split-brain"): "off" (default — the historical
+    # drop-the-silent-and-commit behavior; a network partition can fork
+    # the view.  Detection is shared by both modes: a member whose
+    # board heartbeat goes stale past elastic_deadline_s relative to
+    # the freshest member is death evidence either way, like the
+    # watchdog lease scan — keep the deadline above the slowest
+    # legitimate step/filesystem hiccup) or "majority" (a reconcile
+    # may only COMMIT a view whose
+    # voter set is a strict majority of the LAST COMMITTED view's
+    # members; an even split breaks deterministically toward the side
+    # containing the lowest-ranked member of the prior view.  A
+    # minority side raises the typed ``QuorumLost`` and the driver
+    # PARKS — a bounded, heartbeat-visible wait that rejoins the
+    # majority's committed epoch once the partition heals, no restart
+    # required).  Quorum also arms epoch FENCING: board votes,
+    # heartbeats, and elastic-driven checkpoint writes from a writer
+    # whose view epoch is behind the board's committed epoch raise
+    # ``FencedWriterError`` and never land.  One string compare when
+    # off; the fencing/partition modules are never imported.
+    # Env: TORCHMPI_TPU_ELASTIC_QUORUM.
+    elastic_quorum: str = "off"
 
     # --- payload integrity + numeric anomaly guard ---------------------------
     # torchmpi_tpu.guard (docs/GUARD.md): "off" (default — the module is
@@ -473,6 +495,8 @@ class Config:
             elastic_poll_s=_env_float("TORCHMPI_TPU_ELASTIC_POLL", 0.05),
             elastic_deadline_s=_env_float("TORCHMPI_TPU_ELASTIC_DEADLINE",
                                           30.0),
+            elastic_quorum=_env_str("TORCHMPI_TPU_ELASTIC_QUORUM",
+                                    "off"),
             guard=_env_str("TORCHMPI_TPU_GUARD", "off"),
             guard_numeric_policy=_env_str("TORCHMPI_TPU_GUARD_POLICY",
                                           "skip_step"),

@@ -28,8 +28,25 @@ Off by default and **never imported when off** — the
 is a consent gate for this driver layer, the dispatch path has no
 branch on it anywhere, and ``import torchmpi_tpu`` never imports this
 module (``tests/test_elastic.py`` asserts both).  Telemetry
-(``tm_elastic_{reconcile,shrink,rejoin}_total`` + flight events) rides
-:mod:`torchmpi_tpu.obs` through ``sys.modules`` when obs is active.
+(``tm_elastic_{reconcile,shrink,rejoin,quorum_lost,parked,fenced,
+healed}_total`` + flight events) rides :mod:`torchmpi_tpu.obs`
+through ``sys.modules`` when obs is active.
+
+Partitions (docs/ELASTIC.md "Partitions and split-brain"):
+``Config.elastic_quorum="majority"`` gates every reconcile and
+recovery agreement on a strict majority of the last committed view —
+a partitioned minority raises the typed
+:class:`~torchmpi_tpu.faults.membership.QuorumLost` and
+:func:`run_elastic` PARKS it (:func:`_park`: heartbeat-visible wait,
+watchdog lease ``state="parked"``) until it can adopt, readmit into,
+or retry against the healed board; epoch fencing
+(``faults/fencing.py``) rides the same opt-in so a zombie minority's
+board writes and checkpoint saves never land on the majority's
+lineage.  Quorum off keeps the historical COMMIT semantics (a
+partition can fork the view) and never imports either module; the
+board-heartbeat staleness DETECTOR in :meth:`ElasticGang.poll` is
+evidence shared by both modes — like the watchdog lease scan, it
+names who looks dead, while quorum alone governs what may commit.
 """
 
 from __future__ import annotations
@@ -139,13 +156,51 @@ class ElasticGang:
     def __init__(self, directory: str, *,
                  members: Optional[Sequence[int]] = None,
                  world_size: Optional[int] = None,
-                 board_dir: Optional[str] = None):
+                 board_dir: Optional[str] = None,
+                 local: Optional[Sequence[int]] = None):
         cfg = _require_on()
         self.poll_s = float(cfg.elastic_poll_s)
         self.deadline_s = float(cfg.elastic_deadline_s)
+        # Quorum gating (docs/ELASTIC.md "Partitions and split-brain"):
+        # one string compare; "off" keeps the historical semantics and
+        # never imports the fencing module.
+        self.quorum = cfg.elastic_quorum == "majority"
+        self._multiproc = jax.process_count() > 1
+        all_devs = list(jax.devices())
+        if members is None:
+            members = (range(jax.process_count()) if self._multiproc
+                       else range(len(all_devs)))
+        members = tuple(sorted(int(m) for m in members))
+        # ``local``: the members THIS process speaks for — None keeps
+        # the historical granularity (its own rank on a multi-process
+        # gang; every member on the single-process sim).  A sim gang
+        # speaking for a SUBSET is the protocol harness the partition
+        # tests run two independent processes over one board with
+        # (each side trains its own devices; only the BOARD is shared,
+        # which is exactly a partition's failure surface).
+        self._local_subset = local is not None
+        if local is not None:
+            if self._multiproc:
+                raise ValueError(
+                    "local= is a single-process (protocol-harness) "
+                    "knob; a multi-process gang speaks for its own "
+                    "rank")
+            local = tuple(sorted(int(r) for r in local))
+            if not local or not set(local) <= set(members):
+                raise ValueError(
+                    f"local {list(local)} must be a non-empty subset "
+                    f"of members {list(members)}")
+        # The board reads as THIS process's rank (the partition
+        # visibility mask is per reader; on the sim — where one process
+        # speaks for every member — the lowest spoken-for member is
+        # the reader, so a one-way mask can model exactly what each
+        # side of a split board would see).
+        self._rank = (jax.process_index() if self._multiproc
+                      else int((local or members)[0]))
         self.board = membership.Board(
             board_dir or cfg.elastic_dir
-            or os.path.join(directory, "membership"))
+            or os.path.join(directory, "membership"),
+            reader_rank=self._rank)
         # Lease-death floor: only leases renewed AFTER this driver
         # started count as evidence — a SIGKILLed previous run's
         # leftover leases on the persistent board must not shrink a
@@ -165,12 +220,6 @@ class ElasticGang:
 
             if watchdog.active() and watchdog.lease_dir() is None:
                 watchdog.set_lease_dir(self.board.directory)
-        self._multiproc = jax.process_count() > 1
-        all_devs = list(jax.devices())
-        if members is None:
-            members = (range(jax.process_count()) if self._multiproc
-                       else range(len(all_devs)))
-        members = tuple(sorted(int(m) for m in members))
         # The member -> devices map covers EVERY possible member slot,
         # not just the starting set: a driver restarted with only the
         # survivors must still be able to admit a healed rank it never
@@ -191,7 +240,7 @@ class ElasticGang:
             per = len(all_devs) // ws
             self._dev_of = {m: all_devs[m * per:(m + 1) * per]
                             for m in range(ws)}
-            self.local_ranks = members
+            self.local_ranks = local if local is not None else members
         for m, devs in self._dev_of.items():
             if not devs:
                 raise ValueError(f"member {m} owns no devices")
@@ -242,6 +291,23 @@ class ElasticGang:
         for r in self.local_ranks:
             self.board.clear_values(r)
             self.board.clear_votes_above(r, self.view.epoch)
+        # Board-heartbeat sightings (member -> newest ts this gang has
+        # SEEN) — the partition detection signal: a member whose
+        # heartbeat stops being visible/renewed relative to the
+        # freshest member's goes stale (docs/ELASTIC.md).
+        self._hb_seen: Dict[int, float] = {}
+        # Epoch fencing rides the quorum opt-in: arm this process's
+        # writer identity on the board (votes/heartbeats check it) and
+        # publish it for the checkpoint-save seam.  Quorum off = the
+        # module is never imported (tests assert it, subprocess-wise).
+        if self.quorum:
+            from .faults import fencing
+
+            self._fence = fencing.arm(
+                self.board, self._rank, epoch=self.view.epoch,
+                incarnation=self._inc.get(self._rank, 0))
+        else:
+            self._fence = None
 
     # -- mesh ------------------------------------------------------------
 
@@ -267,9 +333,31 @@ class ElasticGang:
     def agreement(self):
         """Survivors-only min-agreement callable for
         :func:`restart.recover` (the full-gang
-        ``checkpoint.agree_min_step`` would hang on the dead peer)."""
+        ``checkpoint.agree_min_step`` would hang on the dead peer).
+
+        With quorum on, the same gate that stops a minority COMMITTING
+        a view stops it AGREEING a restore step: a board whose
+        committed epoch moved past this rank's view means a majority
+        reconciled without us — agreeing among a minority would settle
+        a step the majority's lineage never chose.  The typed
+        :class:`~torchmpi_tpu.faults.membership.QuorumLost` routes the
+        caller into the park/rejoin path."""
 
         def agree(value: int) -> int:
+            if self.quorum:
+                committed = self.board.committed_view()
+                if committed is not None and \
+                        committed.epoch > self.view.epoch:
+                    raise membership.QuorumLost(
+                        epoch=self.view.epoch,
+                        voters=self.local_ranks,
+                        quorum_of=committed.members,
+                        msg=f"recovery agreement refused: the board "
+                            f"committed epoch {committed.epoch} past "
+                            f"this rank's view epoch "
+                            f"{self.view.epoch} — a majority moved "
+                            f"on; park and rejoin instead of agreeing "
+                            f"a stale restore step")
             self._agree_round += 1
             tag = (f"e{self.view.epoch}s{self.view.step}"
                    f"r{self._agree_round}")
@@ -295,6 +383,10 @@ class ElasticGang:
         ``HealthLedger.decide`` exactly like any other peer."""
         import time
 
+        # The board's gang-step clock: the deterministic window the
+        # injected partition mask is evaluated against (a plain int
+        # max, free when nothing is armed).
+        self.board.note_step(step)
         # Heartbeats are liveness evidence at detection granularity
         # (~deadline), not per-step state: throttle the fsync'd board
         # writes off the hot step loop.
@@ -306,6 +398,27 @@ class ElasticGang:
                                          step=step)
             self._last_hb = now
         dead: set = set()
+        # Board-heartbeat staleness (docs/ELASTIC.md "Partitions and
+        # split-brain"): the evidence a partition actually produces is
+        # a member's board files no longer being visible or renewed.
+        # A member whose heartbeat this gang HAS seen before, but whose
+        # newest sighting lags the freshest member heartbeat by more
+        # than the detection deadline, is dead-or-partitioned-away.
+        # Staleness is relative to the gang's freshest member — not
+        # wall clock — so a whole-gang stall (compile, slow step) ages
+        # every heartbeat together and trips nothing; a member never
+        # seen at all is NOT evidence (absence proves nothing — the
+        # slow-starter posture of the lease scan below).
+        for m, d in self.board.heartbeats().items():
+            if m in self._dev_of:
+                self._hb_seen[m] = max(self._hb_seen.get(m, 0.0),
+                                       float(d.get("ts", 0.0)))
+        seen = {m: self._hb_seen[m] for m in self.view.members
+                if m in self._hb_seen}
+        if seen:
+            newest = max(seen.values())
+            dead |= {m for m, ts in seen.items()
+                     if newest - ts > self.deadline_s}
         faults = _faults_mod()
         if faults is not None:
             led = faults.ledger()
@@ -385,10 +498,15 @@ class ElasticGang:
         return time.time() - float(hb.get("ts", 0)) <= self.deadline_s
 
     def includes_self(self, ranks: Sequence[int]) -> bool:
-        """Is THIS process among ``ranks``?  Only meaningful on a
-        multi-process gang — on the sim every member is local and a
-        death is by definition a peer's."""
-        return self._multiproc and jax.process_index() in set(ranks)
+        """Is THIS process among ``ranks``?  On the full sim every
+        member is local and a death is by definition a peer's; a
+        subset-harness gang (``local=``) dies when any rank it speaks
+        for does."""
+        if self._multiproc:
+            return jax.process_index() in set(ranks)
+        if self._local_subset:
+            return bool(set(ranks) & set(self.local_ranks))
+        return False
 
     # -- resize ----------------------------------------------------------
 
@@ -398,13 +516,37 @@ class ElasticGang:
         view = membership.reconcile(
             self.board, self.local_ranks, members,
             epoch=self.view.epoch + 1, step=step, voters=voters,
+            quorum_of=self.view.members if self.quorum else None,
             deadline_s=self.deadline_s, poll_s=self.poll_s)
         self.stats["reconciles"] += 1
         _obs_record("reconcile", epoch=view.epoch,
                     members=len(view.members))
         self.view = view
         self._agree_round = 0  # new view => fresh, lockstep tag sequence
+        if self._fence is not None:
+            self._fence.update(view.epoch)
         return view
+
+    def adopt(self, view: MembershipView) -> None:
+        """Adopt a view committed WITHOUT this rank's vote — the park
+        loop's exit (the majority committed while we were quorum-lost,
+        or :func:`admit` returned the grown view readmitting us).
+        Resets the agreement-round lockstep, clears this rank's stale
+        protocol state above the adopted epoch, refreshes the admitted
+        incarnations, and moves the fence forward so our writes land
+        again."""
+        self.view = view
+        self._agree_round = 0
+        self._hb_seen.clear()  # old sightings are pre-heal evidence
+        for r in self.local_ranks:
+            self.board.clear_values(r)
+            self.board.clear_votes_above(r, view.epoch)
+        for m in view.members:
+            self._inc[m] = self.board.incarnation(m)
+        if self._fence is not None:
+            self._fence.update(
+                view.epoch,
+                incarnation=self._inc.get(self._rank, 0))
 
     def shrink(self, dead: Sequence[int], *, step: int):
         """Agree on the survivors-only view and re-form the mesh at
@@ -484,6 +626,103 @@ def _seed_joiner_checkpoints(directory: str, step: int,
     checkpoint.replicate_for(directory, step, [int(r) for r in joiners])
 
 
+def _is_fenced(e: BaseException) -> bool:
+    """Is ``e`` the fencing layer's ``FencedWriterError``?  sys.modules
+    check (the restart.py discipline): the error can only exist if the
+    fencing module raised it, so it is necessarily loaded then."""
+    mod = sys.modules.get("torchmpi_tpu.faults.fencing")
+    return mod is not None and isinstance(e, mod.FencedWriterError)
+
+
+def _park(gang: ElasticGang, directory: str, *, step: int,
+          suspects: Sequence[int], cause: BaseException,
+          budget_s: float) -> str:
+    """The minority side of a quorum loss (docs/ELASTIC.md "Partitions
+    and split-brain"): instead of committing a forked view — or dying
+    and demanding an operator restart — the rank PARKS: a bounded,
+    heartbeat-visible wait loop that keeps the rank alive and
+    observable (board heartbeats with the no-view-claimed epoch -1;
+    watchdog lease state ``parked`` naming the epoch it waits on, so
+    ``obs_tool blame --live`` does not misread it as a corpse) while it
+    re-polls the board for one of three exits:
+
+    - ``"adopted"``  — the majority committed a higher-epoch view that
+      STILL CONTAINS this rank (it was partitioned, not dropped):
+      adopt it and resume at its boundary.
+    - ``"admitted"`` — the majority committed past us WITHOUT us: run
+      the healed-peer path in place (:func:`admit` — incarnation bump,
+      join request, wait for the grown view), adopt the admitting
+      view.  No process restart.
+    - ``"retry"``    — nobody committed anything (BOTH sides of the
+      split were minorities — e.g. a three-way partition) and every
+      suspect is heartbeating fresh again: the partition healed, so
+      re-enter the driver loop and reconcile with full visibility.
+
+    Exhausting ``budget_s`` re-raises ``cause`` (the original
+    ``QuorumLost``/``FencedWriterError``) — a partition that never
+    heals must eventually surface, not wait forever."""
+    import time
+
+    _obs_record("quorum_lost", epoch=gang.view.epoch,
+                members=len(gang.view.members),
+                peer=",".join(_member_peer(m) for m in suspects))
+    _obs_record("parked", epoch=gang.view.epoch,
+                members=len(gang.view.members))
+    wd = sys.modules.get("torchmpi_tpu.watchdog")
+    if wd is not None and wd.active():
+        wd.set_state("parked",
+                     detail=f"waiting for a committed epoch > "
+                            f"{gang.view.epoch}")
+    t0 = time.monotonic()
+    t_park = time.time()
+    try:
+        while True:
+            for r in gang.local_ranks:
+                # The waiting beacon: epoch -1 claims no view, so it is
+                # fence-exempt and keeps the rank joiner-alive.
+                gang.board.heartbeat(r, epoch=-1, step=step)
+            committed = gang.board.committed_view()
+            if committed is not None and \
+                    committed.epoch > gang.view.epoch:
+                if all(r in committed.members for r in gang.local_ranks):
+                    gang.adopt(committed)
+                    _obs_record("healed", epoch=committed.epoch,
+                                members=len(committed.members))
+                    return "adopted"
+                if gang._multiproc or len(gang.local_ranks) == 1:
+                    remaining = max(gang.poll_s,
+                                    budget_s - (time.monotonic() - t0))
+                    view = admit(directory, gang._rank,
+                                 board_dir=gang.board.directory,
+                                 deadline_s=remaining,
+                                 poll_s=gang.poll_s)
+                    gang.adopt(view)
+                    _obs_record("healed", epoch=view.epoch,
+                                members=len(view.members))
+                    return "admitted"
+                raise cause  # full sim: a committed view excluding
+                #              every local member is unrecoverable
+                #              in-process
+            if suspects:
+                hbs = gang.board.heartbeats()
+                if all(float(hbs.get(m, {}).get("ts", 0)) > t_park
+                       for m in suspects):
+                    # Every rank we timed out on is fresh again and
+                    # nobody committed past us: the partition healed
+                    # with no majority formed — reconcile over again
+                    # with full visibility.
+                    _obs_record("healed", epoch=gang.view.epoch,
+                                members=len(gang.view.members))
+                    gang._hb_seen.clear()
+                    return "retry"
+            if time.monotonic() - t0 > budget_s:
+                raise cause
+            time.sleep(gang.poll_s)
+    finally:
+        if wd is not None and wd.active():
+            wd.set_state("running")
+
+
 def _member_of_failure(e: BaseException) -> Optional[int]:
     """Map a fault-layer error to the gang member it implicates, if
     any: a ``PeerTimeoutError`` — or a watchdog ``CollectiveHangError``
@@ -505,7 +744,8 @@ def run_elastic(build: BuildFn, *, steps: int, directory: str,
                 save_every: int = 10, max_restarts: int = 3,
                 members: Optional[Sequence[int]] = None,
                 world_size: Optional[int] = None,
-                gang: Optional[ElasticGang] = None
+                gang: Optional[ElasticGang] = None,
+                park_budget_s: Optional[float] = None
                 ) -> Tuple[PyTree, Dict[str, Any]]:
     """Run ``steps`` steps elastically: the detect -> shrink ->
     rebalance -> rejoin loop over :func:`restart.run_with_restarts`'s
@@ -536,44 +776,111 @@ def run_elastic(build: BuildFn, *, steps: int, directory: str,
 
     Non-membership failures take the plain restore-and-replay path
     with the ``max_restarts`` budget, exactly like
-    ``run_with_restarts``.  Returns ``(state, info)`` with ``info``
-    carrying ``shrinks``/``rejoins``/``reconciles``/``restarts_used``/
-    ``recovered_step``/``steps_run`` and the final ``view``.
+    ``run_with_restarts``.  Under ``Config.elastic_quorum="majority"``
+    a quorum loss (a partition left this side a minority — typed
+    ``QuorumLost``, or a write FENCED by a majority that moved on)
+    PARKS instead of committing or dying (:func:`_park`): the rank
+    waits heartbeat-visible up to ``park_budget_s`` (default 10x the
+    reconcile deadline) and rejoins the majority's committed epoch in
+    place once the partition heals — counted in ``info["parks"]`` and
+    bounded by ``max_restarts`` parks before the cause re-raises.
+    Returns ``(state, info)`` with ``info`` carrying ``shrinks``/
+    ``rejoins``/``reconciles``/``parks``/``restarts_used``/
+    ``recovered_step``/``recoveries`` (every step a recovery settled
+    on, in order — the view-schedule evidence)/``steps_run`` and the
+    final ``view``.
     """
-    _require_on()
+    cfg = _require_on()
     if steps < 0:
         raise ValueError(f"steps must be >= 0, got {steps}")
     if gang is None:
         gang = ElasticGang(directory, members=members,
                            world_size=world_size)
+    park_budget = (10.0 * cfg.elastic_deadline_s
+                   if park_budget_s is None else float(park_budget_s))
     restarts = 0
+    parks = 0
     steps_run = 0
     recovered_step = 0
+    recoveries: List[int] = []  # every step a recovery settled on, in
+    #                             order — the view-schedule evidence the
+    #                             partition acceptance replays
     mesh = None  # carried from shrink()/grow(): ONE resize per change
+
+    def quorum_park(e: BaseException, step: int,
+                    suspects: Sequence[int]) -> str:
+        nonlocal parks
+        parks += 1
+        if parks > max_restarts:
+            raise e
+        return _park(gang, directory, step=step, suspects=suspects,
+                     cause=e, budget_s=park_budget)
+
     while True:
         if mesh is None:
             mesh = gang.member_mesh()
         init_fn, step_fn = build(mesh, gang.view)
         template = init_fn()
-        state, i = restart.recover(
-            init_fn, directory, template,
-            participants=gang.participants(), agree=gang.agreement())
+        try:
+            state, i = restart.recover(
+                init_fn, directory, template,
+                participants=gang.participants(),
+                agree=gang.agreement())
+        except membership.QuorumLost as e:
+            # The agreement gate: a majority committed past this view
+            # while we were down/partitioned — park, adopt/admit, and
+            # rebuild against the adopted view.
+            if quorum_park(e, recovered_step, []) != "retry":
+                mesh = None
+            continue
         recovered_step = i
+        recoveries.append(i)
         resized = False
         while i < steps:
-            ev = gang.poll(i)
+            try:
+                ev = gang.poll(i)
+            except BaseException as e:  # noqa: BLE001 — classified below
+                if not (isinstance(e, membership.QuorumLost)
+                        or _is_fenced(e)):
+                    raise
+                # A FENCED boundary heartbeat: the board committed past
+                # this rank's view while it was partitioned away — the
+                # zombie-minority signal; park and rejoin.
+                if quorum_park(e, i, []) != "retry":
+                    mesh = None
+                resized = True
+                break
             if ev is not None:
                 kind, ranks = ev
                 if kind == "shrink":
                     if gang.includes_self(ranks):
-                        raise MemberDeath(jax.process_index(), i)
-                    mesh = gang.shrink(ranks, step=i)
+                        raise MemberDeath(gang._rank, i)
+                    try:
+                        mesh = gang.shrink(ranks, step=i)
+                    except membership.QuorumLost as e:
+                        # The suspects are a majority of the view: WE
+                        # are the partitioned minority — park instead
+                        # of committing a forked survivor view.
+                        if quorum_park(e, i, ranks) != "retry":
+                            mesh = None
                 else:
                     # Rejoin happens at a SAVED boundary so the healed
-                    # member restores exactly this step.
-                    checkpoint.save(directory, state, step=i)
-                    _seed_joiner_checkpoints(directory, i, ranks, gang)
-                    mesh = gang.grow(ranks, step=i)
+                    # member restores exactly this step.  The same
+                    # quorum guard as the shrink sites: a partition
+                    # landing mid-grow can fence the boundary save or
+                    # shrink the grow reconcile's voters below quorum
+                    # — park, don't crash the driver (review).
+                    try:
+                        checkpoint.save(directory, state, step=i)
+                        _seed_joiner_checkpoints(directory, i, ranks,
+                                                 gang)
+                        mesh = gang.grow(ranks, step=i)
+                    except BaseException as e:  # noqa: BLE001
+                        if not (isinstance(e, membership.QuorumLost)
+                                or _is_fenced(e)):
+                            raise
+                        if quorum_park(e, i, []) != "retry":
+                            mesh = None
                 resized = True
                 break
             try:
@@ -586,11 +893,23 @@ def run_elastic(build: BuildFn, *, steps: int, directory: str,
                 raise
             except BaseException as e:  # noqa: BLE001 — the elastic
                 # loop IS the handler: shrink, restore, or re-raise.
+                if isinstance(e, membership.QuorumLost) or _is_fenced(e):
+                    # A fenced write (or an in-step quorum loss) means
+                    # the majority's lineage moved past this rank —
+                    # the zombie-minority case; park and rejoin it.
+                    if quorum_park(e, i, []) != "retry":
+                        mesh = None
+                    resized = True
+                    break
                 member = _member_of_failure(e)
                 if member is not None and member in gang.view.members:
                     if gang.includes_self([member]):
                         raise MemberDeath(member, i) from e
-                    mesh = gang.shrink([member], step=i)
+                    try:
+                        mesh = gang.shrink([member], step=i)
+                    except membership.QuorumLost as qe:
+                        if quorum_park(qe, i, [member]) != "retry":
+                            mesh = None
                     resized = True
                     break
                 restarts += 1
@@ -600,17 +919,26 @@ def run_elastic(build: BuildFn, *, steps: int, directory: str,
                 # it the mesh, the step program, and every cached
                 # CollectivePlan — is unchanged; recover in place
                 # instead of tearing the segment down and re-jitting.
-                state, i = restart.recover(
-                    init_fn, directory, template,
-                    participants=gang.participants(),
-                    agree=gang.agreement())
+                try:
+                    state, i = restart.recover(
+                        init_fn, directory, template,
+                        participants=gang.participants(),
+                        agree=gang.agreement())
+                except membership.QuorumLost as qe:
+                    if quorum_park(qe, i, []) != "retry":
+                        mesh = None
+                    resized = True
+                    break
                 recovered_step = i
+                recoveries.append(i)
         if not resized:
             return state, {"shrinks": gang.stats["shrinks"],
                            "rejoins": gang.stats["rejoins"],
                            "reconciles": gang.stats["reconciles"],
                            "restarts": restarts,
                            "restarts_used": restarts,
+                           "parks": parks,
+                           "recoveries": list(recoveries),
                            "steps_run": steps_run,
                            "recovered_step": recovered_step,
                            "view": gang.view}
@@ -642,7 +970,8 @@ def admit(directory: str, rank: int, *,
     cfg = _require_on()
     board = membership.Board(
         board_dir or cfg.elastic_dir
-        or os.path.join(directory, "membership"))
+        or os.path.join(directory, "membership"),
+        reader_rank=int(rank))
     deadline_s = (cfg.elastic_deadline_s if deadline_s is None
                   else float(deadline_s))
     poll_s = cfg.elastic_poll_s if poll_s is None else float(poll_s)

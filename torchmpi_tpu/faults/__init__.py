@@ -43,6 +43,7 @@ from typing import Any, Callable, List, Optional
 
 from .health import HealthLedger, PeerHealth  # noqa: F401
 from .inject import (  # noqa: F401
+    BOARD_SITES,
     FAULT_PLAN_VERSION,
     KINDS,
     SITES,
@@ -56,6 +57,7 @@ from .inject import (  # noqa: F401
     TransientFault,
     corrupt_buffer,
     lint_plan,
+    parse_partition_ranks,
 )
 from . import policy as policy_mod  # bound BEFORE the policy() accessor
 #                                     shadows the submodule name below
@@ -97,6 +99,30 @@ def current_policy() -> Policy:
 
 def ledger() -> HealthLedger:
     return _ledger
+
+
+_NO_MASK = object()  # "computed: plan has no partition rules" sentinel
+
+
+def board_partition():
+    """The armed plan's board-partition visibility mask
+    (``faults/partition.py`` — docs/ELASTIC.md), or None.  Built
+    lazily ONCE per plan and cached on it: the partition module is
+    only ever imported when a partition rule actually exists, so a
+    plan without one (and every quorum-off session) never loads it."""
+    p = _plan
+    if not _armed or p is None:
+        return None
+    mask = getattr(p, "_partition_mask", None)
+    if mask is None:
+        if not any(r.kind == "partition" for r in p.rules):
+            mask = _NO_MASK
+        else:
+            from . import partition
+
+            mask = partition.build(p) or _NO_MASK
+        p._partition_mask = mask  # type: ignore[attr-defined]
+    return None if mask is _NO_MASK else mask
 
 
 def activate(mode: str, *, retries: int = 2, backoff_s: float = 0.05,

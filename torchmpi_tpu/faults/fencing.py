@@ -1,0 +1,137 @@
+"""Epoch fencing for the membership board and the checkpoint writers
+(docs/ELASTIC.md "Partitions and split-brain").
+
+Quorum (``Config.elastic_quorum="majority"``) stops a minority from
+COMMITTING a forked view; fencing stops a *zombie* — a minority rank
+that parked (or wedged) through a partition heal and has not yet
+noticed the majority moved on — from WRITING against the majority's
+lineage in the window before it adopts the new view.  The write seam
+is the fence: board votes and heartbeats (``membership.Board``) and
+elastic-driven ``checkpoint.save*`` calls check the writer's claimed
+view epoch against the board's highest COMMITTED epoch; a writer whose
+epoch is behind gets the typed :class:`FencedWriterError` and the
+write never lands.  The correct response is the park/rejoin path the
+error message points at — the zombie's state is stale by definition.
+
+Armed only by :class:`~torchmpi_tpu.elastic.ElasticGang` when quorum
+is on; with ``elastic_quorum="off"`` (or elastic off) this module is
+NEVER imported — ``utils/checkpoint.py`` reaches it through one
+``sys.modules`` lookup per save, the same zero-cost discipline as
+every other off-by-default layer (tests/test_partition.py asserts it,
+subprocess included).  Dependency-free on purpose.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from ..utils import telemetry
+
+
+class FencedWriterError(RuntimeError):
+    """A write from a view epoch the board has already committed past.
+    Carries ``what`` (the write that was refused), ``writer_epoch``,
+    ``committed_epoch``, ``rank`` and ``incarnation``.  NOT transient
+    — retrying the same stale write can never succeed; the writer must
+    rejoin the committed epoch (``elastic.admit`` / the park loop)."""
+
+    transient = False
+    is_timeout = False
+
+    def __init__(self, what: str, *, writer_epoch: int,
+                 committed_epoch: int, rank: int = -1,
+                 incarnation: int = 0):
+        self.what = what
+        self.writer_epoch = int(writer_epoch)
+        self.committed_epoch = int(committed_epoch)
+        self.rank = int(rank)
+        self.incarnation = int(incarnation)
+        super().__init__(
+            f"fenced {what}: writer rank {rank} (incarnation "
+            f"{incarnation}) holds view epoch {writer_epoch} but the "
+            f"board has committed epoch {committed_epoch} — a majority "
+            f"moved on; rejoin via the park/admit path instead of "
+            f"writing (docs/ELASTIC.md)")
+
+
+class Fence:
+    """One armed writer identity: (board, rank, view epoch,
+    incarnation).  ``check(epoch)`` is the seam — called by the Board's
+    vote/heartbeat writes with the write's claimed epoch, and by the
+    checkpoint seam with the fence's own epoch."""
+
+    def __init__(self, board, rank: int, *, epoch: int,
+                 incarnation: int = 0):
+        self.board = board
+        self.rank = int(rank)
+        self.epoch = int(epoch)
+        self.incarnation = int(incarnation)
+
+    def update(self, epoch: int, incarnation: Optional[int] = None):
+        """The writer adopted a new committed view (reconcile, park
+        adopt, admit)."""
+        self.epoch = int(epoch)
+        if incarnation is not None:
+            self.incarnation = int(incarnation)
+
+    def check(self, epoch: Optional[int] = None,
+              what: str = "write") -> None:
+        """Raise :class:`FencedWriterError` iff the board's committed
+        epoch is ahead of the write's claimed ``epoch`` (default: the
+        fence's view epoch).  ``epoch < 0`` is exempt — it is the
+        "no view claimed" beacon a waiting joiner / parked rank
+        heartbeats with, which must stay writable precisely while the
+        rank is behind.  Reads the board through the normal (masked)
+        path on purpose: a zombie still inside the partition cannot
+        see the majority's commits and is not fenced until the heal —
+        its writes are invisible to the majority anyway."""
+        e = self.epoch if epoch is None else int(epoch)
+        if e < 0:
+            return
+        committed = self.board.committed_view()
+        if committed is not None and committed.epoch > e:
+            telemetry.emit("record_elastic", "fenced",
+                           epoch=committed.epoch, peer=what)
+            raise FencedWriterError(
+                what, writer_epoch=e, committed_epoch=committed.epoch,
+                rank=self.rank, incarnation=self.incarnation)
+
+
+_lock = threading.Lock()
+_current: Optional[Fence] = None
+
+
+def arm(board, rank: int, *, epoch: int, incarnation: int = 0) -> Fence:
+    """Arm fencing for this process's writer identity: attaches the
+    fence to ``board`` (its vote/heartbeat writes start checking) and
+    publishes it for the checkpoint seam (:func:`current`)."""
+    global _current
+    fence = Fence(board, rank, epoch=epoch, incarnation=incarnation)
+    with _lock:
+        _current = fence
+    board.fence = fence
+    return fence
+
+
+def disarm() -> None:
+    global _current
+    with _lock:
+        if _current is not None and getattr(_current.board, "fence",
+                                            None) is _current:
+            _current.board.fence = None
+        _current = None
+
+
+def current() -> Optional[Fence]:
+    return _current
+
+
+def check_save(path: str) -> None:
+    """The checkpoint seam: ``utils/checkpoint.py`` calls this (via
+    ``sys.modules`` — it never imports this module) before committing
+    a save, so a zombie minority's checkpoint cannot land on the
+    majority's lineage."""
+    fence = _current
+    if fence is not None:
+        fence.check(what=f"checkpoint save {path}")

@@ -59,6 +59,22 @@ Kinds model the failures a benign-fabric port never had to survive:
   layer releases the hold — the wedge it models exists only while the
   chaos plan does, which is also what keeps in-process tests from
   leaking stuck threads.
+- ``partition`` — a **network partition of the membership board**
+  (docs/ELASTIC.md): not an arrival-fired fault but a standing
+  per-rank visibility MASK the board consults on every read — reader
+  rank r cannot see files written by ranks on the other side of the
+  ``ranks`` split (symmetric groups ``"0,1|2,3"``, shorthand ``"2,3"``
+  = those ranks vs everyone else, or one-way ``"~2,3"`` = those ranks
+  are DEAF: they see nobody else's files while their own writes stay
+  visible — the asymmetric case).  The window is step-deterministic:
+  active from gang step ``after`` until ``heal_after`` (-1 = never
+  heals); the step clock is the highest step any member has posted to
+  the board (heartbeats/commits), so the heal is globally consistent
+  even for a parked minority whose own step froze.  Only meaningful at
+  the ``board.*`` sites (lint rejects the rest).  This is the
+  split-brain reproducer: with ``Config.elastic_quorum="off"`` both
+  sides commit disjoint views (the fork), with ``"majority"`` the
+  minority parks and rejoins at heal.
 
 Dependency-free on purpose (no jax, no numpy at import): loaded by
 ``scripts/chaos_tool.py`` standalone, and by the dump path of a dying
@@ -113,10 +129,26 @@ SITES = (
     #                         corrupt_silent = on-disk bit-rot the
     #                         digest verify must catch, `fail` = an
     #                         EIO-flavored dead disk
+    "board.write",          # one membership-board file commit
+    #                         (faults/membership.py, docs/ELASTIC.md):
+    #                         heartbeats, proposals, commits, joins —
+    #                         `drop` loses the write (the file never
+    #                         lands), `delay`/`stall` model a slow or
+    #                         wedged board filesystem, and `partition`
+    #                         rules key their visibility mask here
+    "board.read",           # one membership-board listing/file read:
+    #                         `drop` = the board is briefly unreadable
+    #                         (the reconcile must retry the SAME epoch,
+    #                         not vote everyone out), `partition` masks
+    #                         which writers this reader can see
 )
 
 KINDS = ("delay", "drop", "corrupt", "corrupt_silent", "fail", "torn",
-         "stall")
+         "stall", "partition")
+
+# Sites a ``partition`` rule may target: the membership board is the
+# only surface with per-rank file ownership to mask.
+BOARD_SITES = ("board.read", "board.write")
 
 # Sites whose ``fire()`` call passes a real writable payload buffer —
 # the only sites where a ``corrupt``/``corrupt_silent`` rule can flip
@@ -175,9 +207,16 @@ class FaultRule:
     kind: str                 # delay | drop | corrupt | fail
     prob: float = 1.0         # per-hit firing probability
     after: int = 0            # skip the first ``after`` arrivals
+    #                           (partition: the START step of the mask)
     max_hits: int = 1         # fire at most this many times (0 = never,
     #                           -1 = unbounded) — the "heal" knob
     delay_s: float = 0.0      # sleep for delay/drop kinds
+    ranks: str = ""           # partition only: the visibility split —
+    #                           "2,3" (those vs the rest), "0,1|2,3"
+    #                           (explicit symmetric groups), "~2,3"
+    #                           (one-way: those ranks go deaf)
+    heal_after: int = -1      # partition only: the step the mask lifts
+    #                           at (-1 = never heals)
 
     def validate(self) -> None:
         if not self.site or not isinstance(self.site, str):
@@ -194,9 +233,33 @@ class FaultRule:
                 f"rule max_hits {self.max_hits!r} must be >= -1")
         if float(self.delay_s) < 0:
             raise ValueError(f"rule delay_s {self.delay_s!r} must be >= 0")
+        if int(self.heal_after) < -1:
+            raise ValueError(
+                f"rule heal_after {self.heal_after!r} must be >= -1")
+        if self.kind == "partition":
+            if not str(self.ranks).strip():
+                raise ValueError(
+                    f"partition rule needs a ranks split: {self!r}")
+            parse_partition_ranks(self.ranks)  # raises on bad grammar
+            if 0 <= int(self.heal_after) <= int(self.after):
+                raise ValueError(
+                    f"partition heal_after {self.heal_after} must be "
+                    f"> after {self.after} (or -1 = never heals)")
+        elif str(self.ranks).strip():
+            raise ValueError(
+                f"rule ranks {self.ranks!r} is only meaningful on "
+                f"kind 'partition'")
 
     def to_json(self) -> dict:
-        return dataclasses.asdict(self)
+        d = dataclasses.asdict(self)
+        # The partition-only fields are omitted at their defaults so a
+        # pre-partition plan round-trips byte-identically (and readers
+        # of older dumps never meet fields they cannot hold).
+        if not d.get("ranks"):
+            d.pop("ranks", None)
+        if d.get("heal_after", -1) == -1:
+            d.pop("heal_after", None)
+        return d
 
     @staticmethod
     def from_json(d: dict) -> "FaultRule":
@@ -209,6 +272,46 @@ class FaultRule:
         rule = FaultRule(**d)
         rule.validate()
         return rule
+
+
+def parse_partition_ranks(spec: str):
+    """Parse a partition rule's ``ranks`` grammar into
+    ``(groups, one_way)``: ``groups`` is a list of disjoint rank sets,
+    ``one_way`` True for the ``~`` (deaf-ranks) form.  Grammar:
+    ``"2,3"`` (one group vs. the implicit rest), ``"0,1|2,3"``
+    (explicit symmetric groups), ``"~2,3"`` (one-way: the named ranks
+    cannot READ anyone else's files; their writes stay visible — the
+    asymmetric A-sees-B, B-doesn't-see-A case).  Raises ValueError on
+    anything else."""
+    s = str(spec).strip()
+    one_way = s.startswith("~")
+    if one_way:
+        s = s[1:]
+    groups = []
+    seen: set = set()
+    for part in s.split("|"):
+        try:
+            g = frozenset(int(r) for r in part.split(",") if r.strip())
+        except ValueError:
+            raise ValueError(
+                f"partition ranks {spec!r}: want RANK[,RANK...] groups "
+                f"separated by '|' (optional leading '~' for one-way)"
+            ) from None
+        if not g:
+            raise ValueError(f"partition ranks {spec!r}: empty group")
+        if g & seen:
+            raise ValueError(
+                f"partition ranks {spec!r}: rank in two groups")
+        if any(r < 0 for r in g):
+            raise ValueError(
+                f"partition ranks {spec!r}: ranks must be >= 0")
+        seen |= g
+        groups.append(g)
+    if one_way and len(groups) != 1:
+        raise ValueError(
+            f"partition ranks {spec!r}: the one-way '~' form takes "
+            f"exactly one group (the deaf ranks)")
+    return groups, one_way
 
 
 def decision(seed: int, site: str, hit: int) -> float:
@@ -253,6 +356,9 @@ class FaultPlan:
             hit = self._hits.get(site, 0)
             self._hits[site] = hit + 1
             for i, rule in enumerate(self.rules):
+                if rule.kind == "partition":
+                    continue  # a standing mask, not an arrival-fired
+                    #           fault (faults.board_partition serves it)
                 if not fnmatch.fnmatchcase(site, rule.site):
                     continue
                 if hit < rule.after:
@@ -350,6 +456,23 @@ def lint_plan(plan: FaultPlan) -> List[str]:
                 f"rule {i}: stall ignores delay_s={rule.delay_s!r} — "
                 f"the hold is indefinite by definition (use kind "
                 f"'delay' for a bounded slowdown)")
+        if rule.kind == "partition":
+            if matched and not all(s in BOARD_SITES for s in matched):
+                problems.append(
+                    f"rule {i}: partition at {matched} — the visibility "
+                    f"mask only exists on the membership board (sites: "
+                    f"{', '.join(BOARD_SITES)})")
+            if float(rule.delay_s) > 0 or float(rule.prob) < 1.0 \
+                    or rule.max_hits != 1:
+                problems.append(
+                    f"rule {i}: partition ignores prob/max_hits/delay_s "
+                    f"— the mask is a standing window [after, "
+                    f"heal_after) in gang steps, not an arrival-fired "
+                    f"fault")
+        elif int(rule.heal_after) != -1:
+            problems.append(
+                f"rule {i}: heal_after is only meaningful on kind "
+                f"'partition' (this rule heals via max_hits)")
     return problems
 
 

@@ -33,10 +33,41 @@ Protocol (``reconcile``): a **bounded two-phase commit** per epoch.
   same files.  At most ``len(members)`` rounds can run before the set
   is a singleton, so the protocol terminates.
 
+Partitions (docs/ELASTIC.md "Partitions and split-brain"): the
+deadline path alone is not partition-safe — under a network split both
+sides time out on each other and, unchecked, each would commit a
+disjoint survivor view (two live gangs, two checkpoint lineages).
+Three additions close it:
+
+- **Quorum** (``reconcile(quorum_of=...)``): a view may only commit
+  when its voter set is a strict majority of the LAST COMMITTED view's
+  members; an even split breaks deterministically toward the side
+  holding that view's lowest-ranked member.  The minority raises the
+  typed :class:`QuorumLost` instead of committing (the elastic driver
+  parks on it).
+- **Fencing** (``faults/fencing.py``): vote and heartbeat writes carry
+  the writer's claimed view epoch; with a fence armed on the board, a
+  write whose epoch is behind the committed epoch raises
+  ``FencedWriterError`` and never lands.
+- **Board trouble != voter silence**: a deadline round in which even
+  THIS rank's own freshly-posted payload is invisible means the board
+  itself is unreadable (lost write, unreadable listing) — the round
+  re-posts and retries the SAME epoch (bounded), instead of "dropping"
+  every voter and shrinking toward ``ReconcileTimeout``.
+
+Deterministic partitions are injectable: the ``board.read``/
+``board.write`` fault sites fire on every board IO, and a ``partition``
+rule (``faults/partition.py``) masks which writers' files this reader
+can see — evaluated against the gang-step clock, so split-brain plans
+replay bit-exactly.
+
 Dependency-free on purpose (no jax, no numpy): the board must be
 readable by a peer whose runtime is exactly what died, and by
 standalone tooling.  Only ever imported when ``Config.elastic`` is on
-(via ``torchmpi_tpu.elastic``) — the off path never touches it.
+(via ``torchmpi_tpu.elastic``) — the off path never touches it; the
+fault hooks go through ``sys.modules`` (never an import), and the
+fence is an attribute the elastic driver attaches only under
+``elastic_quorum="majority"``.
 """
 
 from __future__ import annotations
@@ -44,6 +75,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import sys
 import time
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
@@ -62,6 +94,50 @@ class ReconcileDropped(MembershipError):
 class ReconcileTimeout(MembershipError):
     """A bounded wait on the board expired without the protocol making
     progress (e.g. every other participant vanished mid-round)."""
+
+
+class QuorumLost(MembershipError):
+    """This side of a (possible) partition cannot commit: its voter set
+    is not a majority of the last committed view's members — committing
+    would risk a forked view.  Carries ``epoch`` (the epoch the commit
+    was refused at), ``voters`` and ``quorum_of``.  The correct
+    response is the elastic driver's PARK loop: keep heartbeating,
+    re-poll the board, and rejoin the majority's committed epoch once
+    the partition heals (docs/ELASTIC.md)."""
+
+    def __init__(self, *, epoch: int, voters: Sequence[int],
+                 quorum_of: Sequence[int], msg: str = ""):
+        self.epoch = int(epoch)
+        self.voters = tuple(sorted(int(v) for v in voters))
+        self.quorum_of = tuple(sorted(int(m) for m in quorum_of))
+        need = len(self.quorum_of) // 2 + 1
+        super().__init__(
+            msg or f"quorum lost at epoch {epoch}: voters "
+                   f"{list(self.voters)} are not a majority of the "
+                   f"committed view's members {list(self.quorum_of)} "
+                   f"(need {need}, or half containing rank "
+                   f"{min(self.quorum_of) if self.quorum_of else '?'}) "
+                   f"— parking instead of committing a forked view")
+
+
+def has_quorum(voters: Iterable[int], quorum_of: Iterable[int]) -> bool:
+    """The quorum rule (``Config.elastic_quorum="majority"``): may a
+    side whose voter set is ``voters`` commit a view over the last
+    committed membership ``quorum_of``?  Strict majority of
+    ``quorum_of`` wins; an exact half wins only when it contains the
+    LOWEST-ranked member of ``quorum_of`` — a deterministic tie-break
+    every side computes identically from its own files (the prior
+    members partition between the sides, so exactly one side can hold
+    that rank)."""
+    prior = sorted(set(int(m) for m in quorum_of))
+    if not prior:
+        return True  # nothing committed yet: nothing to fork from
+    inter = set(prior) & {int(v) for v in voters}
+    if 2 * len(inter) > len(prior):
+        return True
+    if 2 * len(inter) == len(prior):
+        return min(prior) in inter
+    return False
 
 
 @dataclasses.dataclass(frozen=True)
@@ -88,20 +164,123 @@ class MembershipView:
                               step=int(d["step"]))
 
 
+def _owner_of(name: str) -> Optional[int]:
+    """The rank that wrote a board file, parsed from its name (every
+    per-rank file ends ``_<rank>.json``); None for shared records
+    (``rewind_<round>.json`` — round numbers are not ranks, but those
+    records are gang-wide anyway and a partition of them is
+    meaningless, so an owner beyond the masked set is fine)."""
+    stem = name[:-len(".json")] if name.endswith(".json") else name
+    _, _, tail = stem.rpartition("_")
+    if not tail.isdigit():
+        return None
+    if stem.startswith("rewind_"):
+        return None  # the tail is a round number, not a rank
+    return int(tail)
+
+
 class Board:
     """The host-staged membership board: one directory of atomic JSON
     files.  All methods are crash-safe (write-tmp-then-rename) and
     idempotent; readers tolerate torn/missing files by ignoring them
     (an unreadable proposal is the same as an unposted one — the
-    deadline handles both)."""
+    deadline handles both).
 
-    def __init__(self, directory: str):
+    ``reader_rank`` is the rank this process READS the board as — only
+    consulted by the injected ``partition`` visibility mask (a masked
+    writer's files are invisible to this reader, exactly as if the
+    board filesystem were split); None disables masking for this
+    handle (standalone tooling).  ``fence`` is the epoch fence the
+    elastic driver attaches under ``elastic_quorum="majority"``
+    (``faults/fencing.py``); vote and heartbeat writes check it.  The
+    ``board.read``/``board.write`` fault sites fire on every IO when a
+    plan is armed — an injected transient ``drop`` LOSES that IO (an
+    unreadable listing, a write that never lands), which is what board
+    trouble looks like to the protocol above."""
+
+    def __init__(self, directory: str,
+                 reader_rank: Optional[int] = None):
         self.directory = directory
+        self.reader_rank = (None if reader_rank is None
+                            else int(reader_rank))
+        self.fence = None
+        self._step = -1  # gang-step clock (note_step / heartbeat scan)
+        self._clock_memo = (-1.0, -1)  # (monotonic ts, scanned clock)
         os.makedirs(directory, exist_ok=True)
+
+    def note_step(self, step: int) -> None:
+        """Advance the board's gang-step clock (the elastic driver
+        calls this every step boundary) — the deterministic clock the
+        partition mask's [after, heal_after) window is evaluated
+        against."""
+        self._step = max(self._step, int(step))
+
+    # -- fault hooks (sys.modules — this module never imports faults) ----
+
+    def _fire(self, site: str) -> bool:
+        """One arrival at a board fault site; returns False when the
+        IO is LOST (an injected transient — the board is briefly
+        unreadable / the write never lands)."""
+        mod = sys.modules.get("torchmpi_tpu.faults")
+        if mod is None or not mod.injecting():
+            return True
+        try:
+            mod.fire(site, peer="board")
+        except Exception as e:  # noqa: BLE001 — classified, not blanket
+            if getattr(e, "transient", False):
+                return False
+            raise
+        return True
+
+    def _mask(self):
+        """The armed partition visibility mask, or None (one
+        sys.modules lookup; the partition module itself only loads
+        when a plan actually contains a partition rule)."""
+        if self.reader_rank is None:
+            return None
+        mod = sys.modules.get("torchmpi_tpu.faults")
+        if mod is None or not mod.injecting():
+            return None
+        return mod.board_partition()
+
+    def _clock(self, fresh: bool = False) -> int:
+        """The mask's step clock: this board's noted step, advanced by
+        any step a member has heartbeated to the board — read RAW
+        (never masked; the clock must be globally consistent so a
+        parked minority still observes the heal when the majority's
+        progress reaches it).  Every LISTING rescans (``_ls`` passes
+        ``fresh=True``) and refreshes a memo the per-file ``_read``
+        mask checks reuse — re-running the listdir-plus-parse scan for
+        every file of an already-filtered listing made masked board
+        scans O(N^2) (review).  The clock only ever advances, so a
+        memoized value can delay observing a heal by one listing —
+        never reorder it."""
+        now = time.monotonic()
+        memo_ts, memo_val = self._clock_memo
+        if not fresh and now - memo_ts < 1.0:
+            return max(memo_val, self._step)
+        step = self._step
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return step
+        for n in names:
+            if not (n.startswith("hb_") and n.endswith(".json")):
+                continue
+            try:
+                with open(os.path.join(self.directory, n)) as f:
+                    step = max(step, int(json.load(f).get("step", -1)))
+            except (OSError, ValueError):
+                continue
+        self._step = step
+        self._clock_memo = (now, step)
+        return step
 
     # -- low-level staged IO ---------------------------------------------
 
     def _write(self, name: str, payload: dict) -> None:
+        if not self._fire("board.write"):
+            return  # injected: the write is lost before it lands
         path = os.path.join(self.directory, name)
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
@@ -111,6 +290,14 @@ class Board:
         os.replace(tmp, path)
 
     def _read(self, name: str) -> Optional[dict]:
+        if not self._fire("board.read"):
+            return None  # injected: the board is briefly unreadable
+        mask = self._mask()
+        if mask is not None:
+            owner = _owner_of(name)
+            if owner is not None and mask.masked(
+                    self.reader_rank, owner, self._clock()):
+                return None  # partitioned away from this reader
         try:
             with open(os.path.join(self.directory, name)) as f:
                 return json.load(f)
@@ -118,11 +305,26 @@ class Board:
             return None
 
     def _ls(self, prefix: str) -> List[str]:
+        if not self._fire("board.read"):
+            return []  # injected: the listing is briefly unreadable
         try:
-            return sorted(n for n in os.listdir(self.directory)
-                          if n.startswith(prefix) and n.endswith(".json"))
+            names = sorted(n for n in os.listdir(self.directory)
+                           if n.startswith(prefix)
+                           and n.endswith(".json"))
         except OSError:
             return []
+        mask = self._mask()
+        if mask is not None:
+            clock = self._clock(fresh=True)  # once per listing;
+            #                                  _read reuses the memo
+            kept = []
+            for n in names:
+                owner = _owner_of(n)
+                if owner is None or not mask.masked(
+                        self.reader_rank, owner, clock):
+                    kept.append(n)
+            names = kept
+        return names
 
     # -- heartbeats (the real-detection seam) ------------------------------
 
@@ -135,6 +337,12 @@ class Board:
         joiner's heartbeat also carries its per-life ``incarnation``
         (``elastic.admit``), so the gang can tell which life is
         knocking."""
+        if self.fence is not None:
+            # Epoch fencing (faults/fencing.py): a heartbeat CLAIMING a
+            # view epoch the board committed past is a zombie's — it
+            # must not land.  epoch < 0 (a waiting joiner's / parked
+            # rank's beacon) claims nothing and is exempt.
+            self.fence.check(epoch, what=f"heartbeat rank {int(rank)}")
         payload = {"rank": int(rank), "epoch": int(epoch),
                    "step": int(step), "ts": time.time()}
         if incarnation is not None:
@@ -257,6 +465,11 @@ class Board:
     def _vote(self, phase: str, epoch: int, rank: int,
               members: Sequence[int], voters: Sequence[int],
               step: int) -> None:
+        if self.fence is not None:
+            # A vote AT or ABOVE the committed epoch is legitimate
+            # protocol progress; one BELOW it is a zombie's stale
+            # reconcile and never lands (faults/fencing.py).
+            self.fence.check(epoch, what=f"{phase} rank {int(rank)}")
         self._write(f"{phase}_{int(epoch)}_{int(rank)}.json",
                     {"epoch": int(epoch),
                      "members": sorted(int(m) for m in members),
@@ -363,9 +576,14 @@ def _payload_key(d: dict) -> Tuple:
             int(d.get("step", 0)))
 
 
+_BOARD_RETRIES = 3  # same-epoch retries when the board ITSELF is
+#                     unreadable (this rank's own payload missing)
+
+
 def reconcile(board: Board, local_ranks: Iterable[int],
               members: Iterable[int], *, epoch: int, step: int,
               voters: Optional[Iterable[int]] = None,
+              quorum_of: Optional[Iterable[int]] = None,
               deadline_s: float = 30.0, poll_s: float = 0.05,
               ) -> MembershipView:
     """Run the bounded two-phase reconcile for ``local_ranks`` (the
@@ -382,7 +600,15 @@ def reconcile(board: Board, local_ranks: Iterable[int],
     for the drop/intersect retry semantics.  Raises
     :class:`ReconcileDropped` if every local rank was voted out, and
     :class:`ReconcileTimeout` if the voter set would shrink to empty.
-    """
+
+    ``quorum_of`` (``Config.elastic_quorum="majority"``) is the LAST
+    COMMITTED view's member set: every round's voter set must pass
+    :func:`has_quorum` against it BEFORE anything commits — a side
+    whose voters fell to a minority (a partition, not deaths) raises
+    the typed :class:`QuorumLost` instead of forking the view.  The
+    check runs at each round's entry, which covers every commit: a
+    round that shrinks its voters (deadline) or resolves differing
+    proposals re-enters the loop before committing."""
     members = sorted(set(int(m) for m in members))
     voters = (sorted(set(int(v) for v in voters))
               if voters is not None else list(members))
@@ -390,6 +616,8 @@ def reconcile(board: Board, local_ranks: Iterable[int],
         raise ValueError(
             f"voters {voters} must be a subset of members {members}")
     local = sorted(set(int(r) for r in local_ranks))
+    quorum = (sorted(set(int(m) for m in quorum_of))
+              if quorum_of is not None else None)
     e = int(epoch)
     step = int(step)
     while True:
@@ -397,13 +625,16 @@ def reconcile(board: Board, local_ranks: Iterable[int],
             raise ReconcileTimeout(
                 "reconcile ran out of voters — every participant "
                 "stalled past the deadline")
+        if quorum is not None and not has_quorum(voters, quorum):
+            raise QuorumLost(epoch=e, voters=voters, quorum_of=quorum)
         speak = [r for r in local if r in voters]
         if not speak:
             raise ReconcileDropped(
                 f"ranks {local} were dropped from the membership "
                 f"(survivors moved on to {members} at epoch {e})")
 
-        def _phase(read) -> Tuple[List[int], List[int], int, bool]:
+        def _phase(read, repost) -> Tuple[List[int], List[int], int,
+                                          bool]:
             """Poll one phase until every voter's payload is present
             and equal; returns ``(members, voters, step, settled)``.
             Not settled means EVERY participant retries one epoch up
@@ -414,8 +645,17 @@ def reconcile(board: Board, local_ranks: Iterable[int],
             member/voter INTERSECTION and the MIN step — all computed
             identically by every party from the same files, and the
             min step is the safe one: every proposer can restore a
-            checkpoint at or before its own proposed boundary."""
+            checkpoint at or before its own proposed boundary.
+
+            Board trouble is NOT voter silence: a deadline at which
+            even this rank's OWN payload is invisible — it posted one,
+            so the board is unreadable or the write was lost — REPOSTS
+            and retries the SAME epoch (bounded by _BOARD_RETRIES)
+            instead of "dropping" voters that never got a chance to be
+            seen; exhausted retries raise ReconcileTimeout naming the
+            board, not the voters."""
             t0 = time.monotonic()
+            board_tries = 0
             while True:
                 got = read(e)
                 if all(v in got for v in voters):
@@ -432,21 +672,37 @@ def reconcile(board: Board, local_ranks: Iterable[int],
                             sorted(v for v in vinter if v in inter),
                             min(s for _, _, s in keys), False)
                 if time.monotonic() - t0 > deadline_s:
+                    if not any(r in got for r in speak):
+                        board_tries += 1
+                        if board_tries > _BOARD_RETRIES:
+                            raise ReconcileTimeout(
+                                f"membership board unreadable at epoch "
+                                f"{e}: this rank's own payload is still "
+                                f"missing after {board_tries} "
+                                f"deadline(s) — board trouble, not "
+                                f"voter silence (no voter was dropped)")
+                        repost()
+                        t0 = time.monotonic()
+                        continue
                     alive = [v for v in voters if v in got]
                     return ([m for m in members
                              if m in alive or m not in voters], alive,
                             step, False)
                 time.sleep(poll_s)
 
-        for r in speak:
-            board.propose(e, r, members, step, voters)
-        members, voters, step, settled = _phase(board.proposals)
+        def _post(phase_fn):
+            for r in speak:
+                phase_fn(e, r, members, step, voters)
+
+        _post(board.propose)
+        members, voters, step, settled = _phase(
+            board.proposals, lambda: _post(board.propose))
         if not settled:
             e += 1
             continue
-        for r in speak:
-            board.commit(e, r, members, step, voters)
-        members, voters, step, settled = _phase(board.commits)
+        _post(board.commit)
+        members, voters, step, settled = _phase(
+            board.commits, lambda: _post(board.commit))
         if not settled:
             e += 1
             continue

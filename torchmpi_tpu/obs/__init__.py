@@ -642,7 +642,11 @@ def record_elastic(event: str, *, epoch: int = 0, members: int = 0,
     """One elastic gang-resize event (``torchmpi_tpu/elastic.py`` —
     docs/ELASTIC.md): ``event`` is ``reconcile`` (a membership view
     committed) | ``shrink`` (the gang re-formed without a dead member)
-    | ``rejoin`` (a healed member re-admitted at a step boundary) —
+    | ``rejoin`` (a healed member re-admitted at a step boundary) |
+    ``quorum_lost`` (a reconcile/agreement refused on a minority side
+    of a partition) | ``parked`` (the rank entered the quorum park
+    loop) | ``fenced`` (a stale-epoch write was refused by the epoch
+    fence) | ``healed`` (a parked rank rejoined a committed epoch) —
     counter ``tm_elastic_<event>_total``, labeled with the implicated
     member(s) when there are any.  Every event also lands in the
     flight ring, so a post-mortem sees the resize right next to the

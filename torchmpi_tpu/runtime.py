@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import sys
 import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -192,6 +193,19 @@ def _normalize_elastic(value) -> Optional[str]:
         return "off"
     if v in ("on", "1", "true", "yes"):
         return "on"
+    return None
+
+
+def _normalize_elastic_quorum(value) -> Optional[str]:
+    """Canonical elastic_quorum mode: "off"|"majority", boolean-ish
+    spellings accepted ("1"/"true"/"yes"/"on" mean "majority" — the
+    protect-me reading a boolean opt-in wants).  None = unrecognized
+    (the caller raises)."""
+    v = str(value).strip().lower()
+    if v in ("off", "0", "false", "no", "none", ""):
+        return "off"
+    if v in ("majority", "on", "1", "true", "yes"):
+        return "majority"
     return None
 
 
@@ -535,6 +549,14 @@ def init(config: Optional[Config] = None, **overrides) -> Mesh:
             raise ValueError(
                 f"config.elastic_poll_s and elastic_deadline_s must be "
                 f"> 0, got {cfg.elastic_poll_s}/{cfg.elastic_deadline_s}")
+        if _normalize_elastic_quorum(cfg.elastic_quorum) == "off":
+            cfg.elastic_quorum = os.environ.get(
+                "TORCHMPI_TPU_ELASTIC_QUORUM", "off")
+        cfg.elastic_quorum = _normalize_elastic_quorum(cfg.elastic_quorum)
+        if cfg.elastic_quorum is None:
+            raise ValueError(
+                "config.elastic_quorum (or TORCHMPI_TPU_ELASTIC_QUORUM) "
+                "must be off|majority")
         # Serving-layer sizing (docs/SERVING.md): same any-config env
         # pickup; the knobs are plain ints, the package itself is only
         # ever imported by explicit use.
@@ -701,6 +723,14 @@ def stop() -> None:
 
     collectives.clear_cache()
     tuning.reset()
+    # A quorum-armed elastic gang published an epoch fence for the
+    # checkpoint seam (faults/fencing.py) — retract it with the
+    # runtime so a later non-elastic session's saves are not checked
+    # against a dead board.  sys.modules on purpose: the module is
+    # only ever imported when quorum was armed.
+    fencing = sys.modules.get("torchmpi_tpu.faults.fencing")
+    if fencing is not None:
+        fencing.disarm()
 
 
 def is_initialized() -> bool:
@@ -868,6 +898,11 @@ def set_config(**kw) -> None:
             v = float(v)
             if v <= 0:
                 raise ValueError(f"config.{k} must be > 0")
+        if k == "elastic_quorum":
+            v = _normalize_elastic_quorum(v)
+            if v is None:
+                raise ValueError(
+                    "config.elastic_quorum must be off|majority")
         if k == "gradsync_overlap":
             v = _normalize_overlap(v)
             if v is None:

@@ -97,6 +97,20 @@ def _redundancy():
     return durable
 
 
+def _fence_check(path: str) -> None:
+    """Epoch fencing (``faults/fencing.py`` — docs/ELASTIC.md
+    "Partitions and split-brain"): ONE sys.modules lookup per save —
+    this module never imports the fencing layer; it only exists when
+    the elastic driver armed ``elastic_quorum="majority"``.  A writer
+    whose view epoch is behind the board's committed epoch (a zombie
+    minority that has not yet noticed the partition healed) raises the
+    typed ``FencedWriterError`` BEFORE any byte lands, so it can never
+    clobber the majority's checkpoint lineage."""
+    mod = sys.modules.get("torchmpi_tpu.faults.fencing")
+    if mod is not None:
+        mod.check_save(path)
+
+
 def _writable_u8(data):
     """A writable uint8 numpy view over ``data`` for the fault sites
     (``corrupt_silent`` must flip REAL bits in the staged buffer).
@@ -123,14 +137,49 @@ def _write_atomic(path: str, data, *, fsync: bool = True) -> None:
     _commit_file(path, data, fsync)
 
 
+_TMP_REAP_AGE_S = 600.0
+
+
+def _reap_stale_tmp(directory: str) -> None:
+    """Remove orphaned writer-unique staging files (``*.tmp.<pid>``)
+    left by writers that died between staging and rename.  Unlike the
+    old shared ``.tmp`` name, pid-unique staging never self-overwrites,
+    so restart-heavy runs would otherwise accumulate checkpoint-sized
+    orphans forever (review).  Age-gated: a LIVE concurrent writer's
+    staging file is seconds old; only stale ones are reaped.  Exact
+    ``.tmp`` suffixes (the injected torn-write artifact) are left for
+    the tests/post-mortems that read them."""
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return
+    now = time.time()
+    for n in names:
+        stem, _, pid = n.rpartition(".tmp.")
+        if not stem or not pid.isdigit():
+            continue
+        p = os.path.join(directory, n)
+        try:
+            if now - os.path.getmtime(p) > _TMP_REAP_AGE_S:
+                os.remove(p)
+        except OSError:
+            pass
+
+
 def _commit_file(path: str, data, fsync: bool) -> None:
-    tmp = path + ".tmp"
+    # Writer-unique staging name: two processes saving the SAME path
+    # (the split-brain two-lineages scenario — docs/ELASTIC.md — or two
+    # drivers pointed at one directory) must each stage privately and
+    # race only at the atomic rename, exactly like real shared storage;
+    # a shared ".tmp" made one writer rename the other's staging away.
+    tmp = f"{path}.tmp.{os.getpid()}"
     with open(tmp, "wb") as f:
         f.write(data)
         if fsync:
             f.flush()
             os.fsync(f.fileno())
     os.replace(tmp, path)
+    _reap_stale_tmp(os.path.dirname(path))
 
 
 def _read_npz_bytes(path: str) -> bytes:
@@ -175,6 +224,7 @@ def save(directory: str, tree: PyTree, *, step: int = 0) -> str:
     os.makedirs(directory, exist_ok=True)
     proc = jax.process_index()
     path = os.path.join(directory, f"ckpt_{step}_p{proc}.npz")
+    _fence_check(path)
     arrays = {key: np.asarray(leaf) for key, leaf in _paths(tree)}
     # dtypes recorded because npz erases extension dtypes (bf16 -> '|V2');
     # restore() needs the true stored dtype to reinterpret and to make the
@@ -186,13 +236,14 @@ def save(directory: str, tree: PyTree, *, step: int = 0) -> str:
         # Default path: STREAM the npz straight to the tmp file — no
         # second in-memory copy of the checkpoint (buffering is only
         # needed when a digest is recorded or a fault site wants the
-        # staged payload).
-        tmp = path + ".tmp"
+        # staged payload).  Writer-unique name: see _commit_file.
+        tmp = f"{path}.tmp.{os.getpid()}"
         with open(tmp, "wb") as f:
             np.savez(f, **arrays)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, path)
+        _reap_stale_tmp(directory)
     else:
         buf = _io.BytesIO()
         np.savez(buf, **arrays)
@@ -275,6 +326,7 @@ def save_async(directory: str, tree: PyTree, *, step: int = 0,
     os.makedirs(directory, exist_ok=True)
     proc = jax.process_index()
     path = os.path.join(directory, f"ckpt_{step}_p{proc}.npz")
+    _fence_check(path)
     arrays = {key: np.asarray(leaf) for key, leaf in _paths(tree)}
     buf = _io.BytesIO()
     np.savez(buf, **arrays)
@@ -408,6 +460,7 @@ def save_sharded(directory: str, tree: PyTree, *, step: int = 0,
     """
     os.makedirs(directory, exist_ok=True)
     proc = jax.process_index()
+    _fence_check(os.path.join(directory, f"shckpt_{step}_p{proc}.npz"))
     arrays = {}
     meta_leaves = {}
     for key, leaf in _paths(tree):
@@ -742,9 +795,10 @@ def replicate_for(directory: str, step: int, dst_procs: Sequence[int],
 
         for r in dst_procs:
             dst = os.path.join(directory, f"ckpt_{step}_p{int(r)}.npz")
-            tmp = dst + ".tmp"
+            tmp = f"{dst}.tmp.{os.getpid()}"
             shutil.copyfile(src_path, tmp)
             os.replace(tmp, dst)
+        _reap_stale_tmp(directory)
         return
     raw = _read_npz_bytes(src_path)
     for r in dst_procs:

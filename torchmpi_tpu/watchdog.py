@@ -173,6 +173,13 @@ _stop = threading.Event()
 # breaks, and could both reach the escalation exit.
 _gen = 0
 _last_lease = 0.0
+# Coarse driver state published in the lease ("running" | "parked"):
+# the elastic park loop sets "parked" (+ the epoch it waits on) so
+# `obs_tool blame --live` can tell a quorum-parked minority — fresh
+# lease, deliberately idle — from a corpse or a stalled rank
+# (docs/ELASTIC.md "Partitions and split-brain").
+_state = "running"
+_state_detail = ""
 
 
 def mode() -> str:
@@ -206,6 +213,25 @@ def set_lease_dir(directory: str) -> None:
     _write_lease(force=True)
 
 
+def set_state(state: str, detail: str = "") -> None:
+    """Publish a coarse driver state into the lease payload
+    (``"running"`` default; the elastic driver sets ``"parked"`` with
+    the epoch it is waiting on while a quorum-lost minority waits out
+    a partition).  Forces an immediate lease renewal so live triage
+    sees the transition at once; a no-op when the watchdog is off."""
+    global _state, _state_detail
+    if _mode == "off":
+        return
+    with _lock:
+        _state = str(state)
+        _state_detail = str(detail)
+    _write_lease(force=True)
+
+
+def state() -> str:
+    return _state
+
+
 def stats() -> Dict[str, int]:
     with _lock:
         return dict(_stats)
@@ -234,7 +260,8 @@ def activate(wd_mode: str, *, deadline_s: float, poll_s: float = 0.05,
     board directory by convention — ``Config.watchdog_dir``, falling
     back to ``Config.elastic_dir``); ``None`` disables leases, the
     in-process monitor still runs."""
-    global _mode, _deadline_s, _poll_s, _lease_dir, _rank, _thread
+    global _mode, _deadline_s, _poll_s, _lease_dir, _rank, _thread, \
+        _state, _state_detail
     if wd_mode not in ("warn", "break"):
         raise ValueError(
             f"watchdog mode must be warn|break, got {wd_mode!r}")
@@ -247,6 +274,7 @@ def activate(wd_mode: str, *, deadline_s: float, poll_s: float = 0.05,
         _deadline_s = float(deadline_s)
         _poll_s = float(poll_s)
         _rank = int(rank)
+        _state, _state_detail = "running", ""
         # Unconditional on purpose: re-activation with lease_dir=None
         # must DISABLE leases (not silently keep writing liveness into
         # a previous activation's — possibly another run's — board).
@@ -281,9 +309,10 @@ def deactivate() -> None:
     peers reading expiry as death evidence (``dead_ranks`` /
     ``ElasticGang.poll``) would shrink a live, healthy rank out of the
     gang just for turning its watchdog off."""
-    global _mode, _thread, _lease_dir, _gen
+    global _mode, _thread, _lease_dir, _gen, _state, _state_detail
     with _lock:
         _mode = "off"
+        _state, _state_detail = "running", ""
         _gen += 1  # any straggling monitor thread exits at its next tick
         th, _thread = _thread, None
         _inflight.clear()
@@ -560,6 +589,7 @@ def _write_lease(force: bool = False, escalated: bool = False) -> None:
     payload = {"rank": _rank, "pid": os.getpid(), "mode": _mode,
                "deadline_s": _deadline_s, "ttl_s": ttl,
                "ts": time.time(), "inflight": snap,
+               "state": _state, "state_detail": _state_detail,
                "stalled_total": stats["stalled"],
                "broken_total": stats["broken"],
                "escalated": bool(escalated or stats["escalated"])}
