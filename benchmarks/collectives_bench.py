@@ -43,6 +43,20 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def _maybe_bank(args, kind, summary):
+    """Persist a ``KIND-SUMMARY`` line under ``--bank`` (stamped,
+    git-pinned, platform-tagged — benchmarks/banking.py) so the
+    verdict outlives the CI log it was grepped from."""
+    if not getattr(args, "bank", False):
+        return
+    from benchmarks import banking
+
+    rec = banking.bank_summary(kind, summary)
+    print(f"# banked {kind} stamp={rec['stamp']} "
+          f"commit={rec['commit']} platform={rec['platform']} -> "
+          f"{banking.DEFAULT_PATH}", file=sys.stderr)
+
+
 def _pytree_mode(args, mpi, mesh, sizes):
     """Fused vs per-leaf pytree allreduce: launches/step (from the
     lowered HLO — the statically verifiable win) and wall time."""
@@ -298,6 +312,7 @@ def _guard_compare_mode(args, mpi, n):
               f"(noise floor {floor:.2f} us): "
               f"{summary[f'{half}_verdict']}", file=sys.stderr)
     print("GUARD-SUMMARY " + json.dumps(summary))
+    _maybe_bank(args, "GUARD-SUMMARY", summary)
 
 
 def _plan_compare_mode(args, mpi, n):
@@ -392,6 +407,7 @@ def _plan_compare_mode(args, mpi, n):
                "noise_floor_us": round(floor * 1e6, 2),
                "within_noise": bool(within)}
     print("PLAN-SUMMARY " + json.dumps(summary))
+    _maybe_bank(args, "PLAN-SUMMARY", summary)
     print(f"# all-layers-on planned vs off planned delta "
           f"{delta * 1e6:+.2f} us (noise floor {floor * 1e6:.2f} us): "
           f"{'WITHIN NOISE' if within else 'MEASURABLE'}; "
@@ -640,6 +656,7 @@ def _dcn_compare_mode(args, mpi, mesh):
         "misses": st["misses"], "topologies": sorted(topologies),
     }
     print("DCN-SUMMARY " + json.dumps(summary))
+    _maybe_bank(args, "DCN-SUMMARY", summary)
     print(f"# dcn-compare: flat {nbytes} B vs two-level {wire_none} B "
           f"(1/{n_ici}) vs int8 {wire_int8} B across dcn; chunked "
           f"bitwise={chunk_bitwise}; EF mean-err {ef_err:.4g} vs "
@@ -727,6 +744,12 @@ def main():
                         "fp32/bf16 layers)")
     p.add_argument("--overlap-dim", type=int, default=128,
                    help="overlap mode: layer width")
+    p.add_argument("--bank", action="store_true",
+                   help="persist each *-SUMMARY line to "
+                        "SUMMARY_BANK.json at the repo root (stamped + "
+                        "git-pinned + platform-tagged; "
+                        "benchmarks/banking.py) next to the "
+                        "BENCH_r*.json round records")
     args = p.parse_args()
     if args.devices:
         from torchmpi_tpu.utils.simulation import force_cpu_devices

@@ -1,0 +1,255 @@
+"""Recovery MTTR benchmark: disk-only vs RAM-buddy vs live migration.
+
+The number the hot-state tier (docs/HOTSTATE.md) exists to move: how
+much work a seeded kill costs under each recovery story, on the same
+deterministic trainer.
+
+- ``baseline``  — the uninterrupted run; its bit-exact loss digest is
+  the reference every recovered trajectory must reproduce.
+- ``disk``      — the PR 13 posture: checkpoints every ``--save-every``
+  steps, kill at ``--kill-at``, ``restart.recover`` walks the disk
+  rung.  Steps lost = the save interval's tail, all replayed.
+- ``ram``       — ``Config.hotstate="on"``: every completed step
+  streams an int8 delta (+ exact sparse correction — reconstruction is
+  bit-identical, torchmpi_tpu/hotstate) to the buddy's RAM;
+  ``restart.recover`` takes the RAM rung and resumes at the very step
+  the kill landed on.  Steps lost = 0, digest unchanged.
+- ``migration`` — the planned-preemption drill (``chaos_tool gen
+  --migrate``): ``hotstate.migrate`` drains the doomed rank onto a
+  spare at a step boundary, the source dies one step later into a gang
+  that already let it go.  Zero checkpoint rollback — recovery never
+  runs at all.
+
+Each scenario prints a ``scenario`` JSON line (``steps_lost``,
+``mttr_s``, ``rollback_steps``, ``digest``, ``digest_match``,
+``restored_step``) and the run ends with one assertable line::
+
+    RECOVERY-SUMMARY {"baseline": {...}, "disk": {...}, ...}
+
+MTTR here is the recovery-path wall time on the CPU sim (detect ->
+restore -> resume-able); the structural numbers — steps lost, rollback
+depth, digest equality, which rung served — are exact and are what CI
+asserts (tier1.yml ``recovery-smoke``).  Arm a fault plan via
+``TORCHMPI_TPU_FAULTS`` to corrupt the stream (e.g.
+``hotstate.recv:corrupt_silent``) and watch the ladder: verify fails,
+``tm_hotstate_fallback_disk_total`` counts, and the run degrades to
+exactly the disk numbers instead of restoring poisoned state.
+
+Run: ``python benchmarks/recovery_bench.py --steps 40 --save-every 10
+--kill-at 27`` (add ``--scenario ram`` etc. to run one; JSONL obs
+dumps land wherever ``TORCHMPI_TPU_OBS_DIR`` points).
+"""
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+DIM = 96
+
+
+def _make_state(steps):
+    rng = np.random.RandomState(0)
+    return {"w": (rng.randn(DIM) * 0.3).astype(np.float32),
+            "losses": np.full((steps,), np.nan, np.float32)}
+
+
+def _step(state, i):
+    """One deterministic 'training' step: pure f(state, i), so a replay
+    from ANY restored step reproduces the trajectory bit-exactly — the
+    property every digest assertion below leans on."""
+    w = state["w"]
+    drive = np.float32(0.1) * np.tanh(
+        w * np.float32(1.0 + (i % 7) * 0.03), dtype=np.float32)
+    w2 = (w - drive).astype(np.float32)
+    loss = np.float32(np.mean(w2 * w2, dtype=np.float32))
+    losses = state["losses"].copy()
+    losses[i] = loss
+    return {"w": w2, "losses": losses}
+
+
+def _digest(state):
+    h = hashlib.blake2b(digest_size=16)
+    h.update(np.ascontiguousarray(state["losses"]).tobytes())
+    h.update(np.ascontiguousarray(state["w"]).tobytes())
+    return h.hexdigest()
+
+
+def _fresh_runtime(mpi, **cfg_kw):
+    mpi.stop()
+    return mpi.init(mpi.Config(**cfg_kw))
+
+
+def _run_to(state, start, stop, publish=None):
+    for i in range(start, stop):
+        state = _step(state, i)
+        if publish is not None:
+            publish(state, i + 1)
+    return state
+
+
+def scenario_baseline(args, mpi):
+    _fresh_runtime(mpi)
+    state = _run_to(_make_state(args.steps), 0, args.steps)
+    return {"steps_lost": 0, "rollback_steps": 0, "mttr_s": 0.0,
+            "restored_step": 0, "digest": _digest(state)}
+
+
+def scenario_disk(args, mpi):
+    from torchmpi_tpu.utils import checkpoint, restart
+
+    _fresh_runtime(mpi)
+    d = tempfile.mkdtemp(prefix="rec_disk_", dir=args.workdir)
+    init_fn = lambda: _make_state(args.steps)  # noqa: E731
+
+    def save(state, step):
+        if step % args.save_every == 0:
+            checkpoint.save(d, state, step=step)
+
+    state = _run_to(init_fn(), 0, args.kill_at, publish=save)
+    # -- the kill: live state is gone; all that survives is the disk --
+    del state
+    t0 = time.perf_counter()
+    state, step = restart.recover(init_fn, d, init_fn())
+    mttr = time.perf_counter() - t0
+    lost = args.kill_at - step
+    state = _run_to(state, step, args.steps, publish=save)
+    return {"steps_lost": lost, "rollback_steps": lost, "mttr_s": mttr,
+            "restored_step": step, "digest": _digest(state)}
+
+
+def scenario_ram(args, mpi):
+    from torchmpi_tpu import hotstate
+    from torchmpi_tpu.utils import checkpoint, restart
+
+    _fresh_runtime(mpi, hotstate="on",
+                   hotstate_interval=args.hotstate_interval)
+    d = tempfile.mkdtemp(prefix="rec_ram_", dir=args.workdir)
+    rep = hotstate.enable(args.world, rank=0, buddies=1)
+    init_fn = lambda: _make_state(args.steps)  # noqa: E731
+
+    def publish(state, step):
+        rep.publish(state, step)
+        if step % args.save_every == 0:
+            checkpoint.save(d, state, step=step)
+
+    state = _run_to(init_fn(), 0, args.kill_at, publish=publish)
+    del state  # the kill: this process's live state is gone —
+    #            the buddy's RAM replicas and the disk tier survive
+    t0 = time.perf_counter()
+    state, step = restart.recover(init_fn, d, init_fn())
+    mttr = time.perf_counter() - t0
+    lost = args.kill_at - step
+    state = _run_to(state, step, args.steps, publish=publish)
+    out = {"steps_lost": lost, "rollback_steps": lost, "mttr_s": mttr,
+           "restored_step": step, "digest": _digest(state)}
+    hotstate.disable()
+    return out
+
+
+def scenario_migration(args, mpi):
+    from torchmpi_tpu import hotstate
+    from torchmpi_tpu.utils import checkpoint
+
+    _fresh_runtime(mpi, hotstate="on",
+                   hotstate_interval=args.hotstate_interval)
+    d = tempfile.mkdtemp(prefix="rec_mig_", dir=args.workdir)
+    rep = hotstate.enable(args.world, rank=0, buddies=1)
+    init_fn = lambda: _make_state(args.steps)  # noqa: E731
+    source, spare = 0, args.world  # the spare joins outside the gang
+
+    def publish(state, step):
+        rep.publish(state, step, rank=publish.rank)
+        if step % args.save_every == 0:
+            checkpoint.save(d, state, step=step)
+
+    publish.rank = source
+    state = _run_to(init_fn(), 0, args.kill_at, publish=publish)
+    # -- the drill: drain the doomed rank onto the spare at this step
+    #    boundary; the seeded kill lands at kill_at + 1, one step after
+    #    the source already left (chaos_tool gen --migrate) --
+    slot = {}
+    t0 = time.perf_counter()
+    moved, step = hotstate.migrate(
+        source, spare, init_fn(),
+        admit=lambda st, s: slot.update(state=st, step=s),
+        retire=lambda r: slot.update(retired=r))
+    drain = time.perf_counter() - t0
+    assert step == args.kill_at and slot["retired"] == source
+    publish.rank = spare
+    state = _run_to(slot["state"], step, args.steps, publish=publish)
+    out = {"steps_lost": 0, "rollback_steps": args.kill_at - step,
+           "mttr_s": drain, "restored_step": step,
+           "digest": _digest(state)}
+    hotstate.disable()
+    return out
+
+
+SCENARIOS = {"baseline": scenario_baseline, "disk": scenario_disk,
+             "ram": scenario_ram, "migration": scenario_migration}
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--steps", type=int, default=40)
+    p.add_argument("--save-every", type=int, default=10)
+    p.add_argument("--kill-at", type=int, default=27,
+                   help="last completed step before the kill (pick one "
+                        "NOT on a save boundary so disk has work to "
+                        "lose)")
+    p.add_argument("--world", type=int, default=4)
+    p.add_argument("--hotstate-interval", type=int, default=8)
+    p.add_argument("--scenario", choices=[*SCENARIOS, "all"],
+                   default="all")
+    p.add_argument("--workdir", default=None,
+                   help="parent for scenario checkpoint dirs "
+                        "(default: system tmp)")
+    p.add_argument("--bank", action="store_true",
+                   help="persist the RECOVERY-SUMMARY line to "
+                        "SUMMARY_BANK.json at the repo root "
+                        "(benchmarks/banking.py)")
+    args = p.parse_args(argv)
+    if not (0 < args.kill_at < args.steps):
+        p.error("--kill-at must be inside (0, --steps)")
+
+    import torchmpi_tpu as mpi
+
+    names = list(SCENARIOS) if args.scenario == "all" else [args.scenario]
+    if "baseline" not in names:
+        names.insert(0, "baseline")  # every digest needs the reference
+    summary = {}
+    for name in names:
+        res = SCENARIOS[name](args, mpi)
+        res["digest_match"] = (res["digest"]
+                               == summary.get("baseline",
+                                              res)["digest"])
+        summary[name] = res
+        print(json.dumps({"scenario": name, **res}))
+    mpi.stop()
+    print("RECOVERY-SUMMARY " + json.dumps(summary, sort_keys=True))
+    if args.bank:
+        from benchmarks import banking
+
+        rec = banking.bank_summary("RECOVERY-SUMMARY", summary)
+        print(f"# banked RECOVERY-SUMMARY stamp={rec['stamp']} "
+              f"commit={rec['commit']} platform={rec['platform']} -> "
+              f"{banking.DEFAULT_PATH}", file=sys.stderr)
+    # Structural self-checks (CI re-asserts these from the SUMMARY
+    # line; failing fast here makes local runs honest too).
+    ok = all(r["digest_match"] for r in summary.values())
+    if not ok:
+        print("error: a recovered trajectory diverged from baseline",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

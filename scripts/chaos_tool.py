@@ -48,16 +48,29 @@ gang step STEP until HEAL_STEP; ``lint`` rejects ``partition`` off
 the ``board.*`` sites and payload kinds ON them, and ``summarize``
 reports the park/fence counters
 (``tm_elastic_{quorum_lost,parked,fenced,healed}_total``) alongside
-the rest.  ``summarize`` reads
+the rest.  ``--migrate RANK:STEP:NRANKS`` is the planned-migration
+drill (docs/HOTSTATE.md): the driver drains rank RANK onto a spare at
+step STEP (``hotstate.migrate``), and the plan kills the SOURCE one
+step later — a ``fail`` rule at ``elastic.member`` arrival
+``(STEP+1)*NRANKS + RANK`` — so the run proves the drain beat the
+preemption: zero checkpoint rollback, ``tm_hotstate_migrated_total``
+up, and the late kill lands on a rank that already left the gang.
+The hot-state stream's own sites (``hotstate.send``/``hotstate.recv``)
+are payload-carrying like the ckpt pair: ``corrupt_silent`` flips
+real bits in the staged delta (the restore-side digest verify must
+catch it and fall to the disk rung), ``drop`` loses the message (the
+chain self-heals at the next snapshot).  ``summarize`` reads
 per-host obs metric dumps (the files ``TORCHMPI_TPU_OBS=metrics``
 leaves behind) and prints the ``tm_fault_*``, ``tm_elastic_*``,
-``tm_guard_*``, ``tm_ckpt_*``, and ``tm_watchdog_*`` series — what
+``tm_guard_*``, ``tm_ckpt_*``, ``tm_watchdog_*``, and
+``tm_hotstate_*`` series — what
 was injected, what survived a retry, what hit a deadline, what
 shrink/rejoin the gang ran, what digests failed/healed, what updates
 the numeric tripwire skipped, what checkpoint copies failed
 verification, were repaired from buddies, or were walked past by
-recovery, and what collectives the watchdog flagged stalled / broke /
-escalated — the after-action report of a chaos run; exits 1 when a
+recovery, what collectives the watchdog flagged stalled / broke /
+escalated, and which recovery rung (RAM / disk) actually served —
+the after-action report of a chaos run; exits 1 when a
 chaos run left NO fault counters (it injected nothing: wrong plan,
 wrong sites, or faults never armed).
 
@@ -164,6 +177,29 @@ def parse_partition(inject, spec: str):
     return rule, ranks, step, heal
 
 
+def parse_migrate(inject, spec: str):
+    """``RANK:STEP:NRANKS`` -> the planned-migration drill
+    (docs/HOTSTATE.md): the DRIVER is expected to drain rank RANK onto
+    a spare at step STEP (``hotstate.migrate`` — e.g.
+    ``benchmarks/recovery_bench.py --scenario migration``); this rule
+    kills the source at its NEXT boundary arrival,
+    ``(STEP+1)*NRANKS + RANK``, so a green run is the proof the drain
+    beat the preemption: zero checkpoint rollback and the kill landing
+    on an already-retired rank."""
+    parts = spec.split(":")
+    if len(parts) != 3:
+        raise ValueError(f"--migrate {spec!r}: want RANK:STEP:NRANKS")
+    rank, step, nranks = (int(p) for p in parts)
+    if nranks < 1 or not (0 <= rank < nranks) or step < 0:
+        raise ValueError(
+            f"--migrate {spec!r}: need 0 <= RANK < NRANKS and STEP >= 0")
+    rule = inject.FaultRule(site="elastic.member", kind="fail",
+                            prob=1.0, after=(step + 1) * nranks + rank,
+                            max_hits=1)
+    rule.validate()
+    return rule, rank, step, nranks
+
+
 def parse_stall(inject, spec: str):
     """Wedge-rank-at-step recipe (docs/WATCHDOG.md): a ``stall`` at
     member RANK's liveness check at step STEP — every process of the
@@ -179,16 +215,16 @@ def parse_stall(inject, spec: str):
 def cmd_gen(args) -> int:
     inject = _load_inject()
     try:
-        if len(args.shrink) > 1:
+        if len(args.shrink) + len(args.migrate) > 1:
             # After the first kill the gang recovers (replaying step
             # boundaries) AND fires one fewer arrival per step, so a
             # second rule's step*NRANKS+RANK ordinal no longer lands on
             # the (rank, step) it names — the recipe is exact for ONE
-            # kill per plan.
+            # kill per plan (--migrate kills its source too).
             raise ValueError(
-                "--shrink may be given once per plan: arrival ordinals "
-                "are only exact for the first kill (recovery replays "
-                "and the shrunken gang shift later arrivals) — "
+                "--shrink/--migrate may be given once per plan: arrival "
+                "ordinals are only exact for the first kill (recovery "
+                "replays and the shrunken gang shift later arrivals) — "
                 "generate separate plans for separate kills")
         rules = [parse_rule(inject, spec) for spec in args.rule]
         for spec in args.shrink:
@@ -197,6 +233,14 @@ def cmd_gen(args) -> int:
             print(f"shrink recipe: kill rank {rank} at step {step} of a "
                   f"{nranks}-rank gang (elastic.member arrival "
                   f"{rule.after})")
+        for spec in args.migrate:
+            rule, rank, step, nranks = parse_migrate(inject, spec)
+            rules.append(rule)
+            print(f"migrate recipe: drain rank {rank} onto a spare at "
+                  f"step {step} of a {nranks}-rank gang, source killed "
+                  f"at step {step + 1} (elastic.member arrival "
+                  f"{rule.after}; a green run means the drain beat the "
+                  f"preemption — zero rollback, docs/HOTSTATE.md)")
         for spec in args.stall:
             rule, rank, step, nranks = parse_stall(inject, spec)
             rules.append(rule)
@@ -220,7 +264,7 @@ def cmd_gen(args) -> int:
         return 2
     if not rules:
         print("error: gen needs at least one --rule, --shrink, "
-              "--stall or --partition", file=sys.stderr)
+              "--stall, --partition or --migrate", file=sys.stderr)
         return 2
     plan = inject.FaultPlan(seed=args.seed, note=args.note, rules=rules)
     problems = inject.lint_plan(plan)
@@ -274,7 +318,7 @@ def cmd_summarize(args) -> int:
             name = rec.get("name", "")
             if not name.startswith(("tm_fault_", "tm_elastic_",
                                     "tm_guard_", "tm_ckpt_",
-                                    "tm_watchdog_")):
+                                    "tm_watchdog_", "tm_hotstate_")):
                 continue
             key = (name, tuple(sorted(rec.get("labels", {}).items())))
             totals[key] = totals.get(key, 0) + rec.get("value", 0)
@@ -329,6 +373,13 @@ def main(argv=None) -> int:
                         "gang step STEP, optionally healing at "
                         "HEAL_STEP; elastic_quorum=majority parks the "
                         "minority, quorum off demonstrably forks")
+    s.add_argument("--migrate", action="append", default=[],
+                   help="RANK:STEP:NRANKS — planned-migration drill "
+                        "(docs/HOTSTATE.md): the driver drains rank "
+                        "RANK onto a spare at step STEP "
+                        "(hotstate.migrate); this kills the source at "
+                        "step STEP+1 — a green run proves the drain "
+                        "beat the preemption with zero rollback")
     s.set_defaults(fn=cmd_gen)
 
     s = sub.add_parser("lint", help="validate plan files")

@@ -389,8 +389,12 @@ def cmd_blame_live(args) -> int:
     or ``escalated`` lease is that rank's own death evidence; a fresh
     lease with ``state=parked`` is a quorum-lost minority waiting out
     a partition (docs/ELASTIC.md) — alive, deliberately idle, and NOT
-    to be restarted.  Exits 1 when anything is stalled/expired/parked,
-    0 when all ranks look healthy, 2 on unusable input."""
+    to be restarted; a fresh lease with ``state=migrating`` is a live
+    hot-state drain in flight (docs/HOTSTATE.md — the detail carries
+    ``source -> spare``): in transition BY DESIGN, neither parked nor
+    dead, and killing either end mid-drain forfeits the zero-rollback
+    hand-off.  Exits 1 when anything is stalled/expired/parked/
+    migrating, 0 when all ranks look healthy, 2 on unusable input."""
     import time
 
     if len(args.files) != 1:
@@ -408,6 +412,7 @@ def cmd_blame_live(args) -> int:
     now = time.time()
     implicated = []
     parked = []
+    migrating = []
     stalled_peers = set()
     print(f"live watchdog leases in {directory} ({len(leases)} rank(s)):")
     for rank in sorted(leases):
@@ -434,6 +439,17 @@ def cmd_blame_live(args) -> int:
                      f"{age:.1f}s ago; will rejoin at heal, no "
                      f"restart needed)")
             parked.append(rank)
+        elif d.get("state") == "migrating":
+            # A live hot-state drain (docs/HOTSTATE.md): the rank is
+            # mid-hand-off onto a spare — in transition BY DESIGN,
+            # lease fresh.  Distinct from parked (it is not waiting on
+            # anything external) and from dead (killing it mid-drain
+            # forfeits the zero-rollback migration).
+            detail = d.get("state_detail") or "onto a spare"
+            state = (f"MIGRATING ({detail}; lease renewed {age:.1f}s "
+                     f"ago — live drain in flight, do not kill either "
+                     f"end)")
+            migrating.append(rank)
         elif stalls:
             parts = ", ".join(
                 f"{e.get('site')}"
@@ -462,6 +478,12 @@ def cmd_blame_live(args) -> int:
             f"rank(s) {parked} PARKED (quorum-lost minority waiting "
             f"out a partition — alive and heartbeating, NOT a corpse; "
             f"they readmit themselves once the board heals)")
+    if migrating:
+        verdicts.append(
+            f"rank(s) {migrating} MIGRATING (hot-state drain onto a "
+            f"spare in flight — transitional, not parked, not dead; "
+            f"leave both ends alone until the lease returns to "
+            f"running)")
     stalled_ranks = [r for r in sorted(leases)
                      if any(e.get("stalled")
                             for e in leases[r].get("inflight", []))]

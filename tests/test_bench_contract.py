@@ -317,3 +317,41 @@ def test_stamp_sort_key_year_boundary():
                        "bench_1231_235959.json",
                        "bench_20261231_235959.json",
                        "bench_20270101_000001.json"]
+
+
+def test_summary_bank_round_trip_trim_and_latest(tmp_path):
+    """--bank persistence (benchmarks/banking.py): records land newest
+    first with stamp/commit/platform/argv context, the bank keeps only
+    KEEP_PER_KIND per summary kind, latest() can refuse the wrong
+    platform (a sim number must never stand in for silicon), and a
+    clobbered bank file fails loudly instead of being silently reset."""
+    from benchmarks import banking
+
+    path = str(tmp_path / "SUMMARY_BANK.json")
+    r1 = banking.bank_summary("GUARD-SUMMARY", {"verified": 3},
+                              path=path, argv=["--guard-compare"])
+    assert r1["stamp"] and r1["argv"] == ["--guard-compare"]
+    banking.bank_summary("GUARD-SUMMARY", {"verified": 4}, path=path,
+                         argv=[])
+    banking.bank_summary("RECOVERY-SUMMARY",
+                         {"ram": {"steps_lost": 0}}, path=path, argv=[])
+    bank = banking.load_bank(path)
+    assert [r["summary"]["verified"] for r in bank["GUARD-SUMMARY"]] \
+        == [4, 3]  # newest first
+    got = banking.latest("GUARD-SUMMARY", path=path)
+    assert got["summary"] == {"verified": 4}
+    assert banking.latest("RECOVERY-SUMMARY", path=path,
+                          platform="tpu") is None  # refuse sim/None
+    assert banking.latest("NOPE-SUMMARY", path=path) is None
+    for i in range(banking.KEEP_PER_KIND + 3):
+        banking.bank_summary("RECOVERY-SUMMARY", {"i": i}, path=path,
+                             argv=[])
+    rows = banking.load_bank(path)["RECOVERY-SUMMARY"]
+    assert len(rows) == banking.KEEP_PER_KIND
+    assert rows[0]["summary"] == {"i": banking.KEEP_PER_KIND + 2}
+    with pytest.raises(TypeError):
+        banking.bank_summary("X", ["not-a-dict"], path=path)
+    with open(path, "w") as f:
+        json.dump(["clobbered"], f)
+    with pytest.raises(ValueError, match="bank"):
+        banking.load_bank(path)

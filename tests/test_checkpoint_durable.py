@@ -525,6 +525,48 @@ def test_replicate_for_repairs_rotted_source(tmp_path, durable_runtime):
     assert "torchmpi_tpu.utils.durable" in sys.modules  # from above
 
 
+def test_replicate_for_races_keep_last_k_retention(tmp_path,
+                                                   durable_runtime):
+    """Seeding a joiner at the gang's agreed step must survive a
+    keep-last-K horizon that has already moved past it: replicate_for's
+    save_pair is deliberately prune-free (prune_old=False), so the
+    rejoin seed at an OLD step is never deleted by its own write — and
+    never triggers a prune that could race the recovery it serves."""
+    durable_runtime(redundancy="buddy", ckpt_keep=2)
+    d = str(tmp_path)
+    tree = _tree()
+    for s in (3, 5, 6, 7, 8):
+        checkpoint.save(d, tree, step=s)
+    # p0's own retention marched on (keep-last-2)...
+    assert checkpoint.available_steps(d) == [7, 8]
+    # ...but proc 2 was seeded newest-first and then at the agreed step
+    # 3 (the rejoin can lag the survivors' save cadence): with pruning
+    # inside replicate_for, the step-3 seed — older than proc 2's two
+    # newer files — would be deleted by the very write that created it.
+    for s in (7, 8):
+        checkpoint.replicate_for(d, s, [2], src_proc=0)
+    # The recovery settled on (and pinned) step 3 — re-materialize it
+    # under the pin, as restart.recover's protect_step does.
+    checkpoint.protect_step(d, 3)
+    checkpoint.save(d, tree, step=3)
+    checkpoint.replicate_for(d, 3, [2], src_proc=0)
+    from torchmpi_tpu.utils import durable
+
+    for s in (3, 7, 8):
+        assert os.path.exists(os.path.join(d, f"ckpt_{s}_p2.npz")), s
+        # The full verified pair landed (npz + digest meta + buddies).
+        raw, meta = durable.read_pair(d, f"ckpt_{s}_p2", step=s, proc=2)
+        assert meta["step"] == s
+        for h in durable.buddy_holders(2):
+            assert os.path.exists(os.path.join(
+                durable.buddy_dir(d, h), f"ckpt_{s}_p2.npz")), (s, h)
+    # The survivor's own keep-last-K machinery is untouched by the
+    # seeding: the next save still prunes p0 on schedule (the pinned
+    # step excepted, whatever its age).
+    checkpoint.save(d, tree, step=9)
+    assert checkpoint.available_steps(d) == [3, 8, 9]
+
+
 # ---------------------------------------------------------------------------
 # chaos_tool coverage of the new sites
 # ---------------------------------------------------------------------------

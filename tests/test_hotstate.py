@@ -1,0 +1,467 @@
+"""Hot-state replication tier (torchmpi_tpu/hotstate —
+docs/HOTSTATE.md): config consent gate + env plumbing, the bit-exact
+delta stream (int8 quantized + sparse exact correction), the
+three-rung recovery ladder under seeded corruption (RAM verify fails
+-> disk rung, counter-asserted), send-drop self-healing snapshots,
+epoch-fenced publishes, budget eviction that never eats a peer's only
+generation, live migration with zero rollback (watchdog
+``migrating`` lease state), the chaos_tool ``--migrate`` drill recipe,
+and the off-mode never-imported guarantee."""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import torchmpi_tpu as mpi
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _write_plan(path, rules, seed=11):
+    with open(path, "w") as f:
+        json.dump({"version": 1, "seed": seed, "rules": rules}, f)
+    return str(path)
+
+
+@pytest.fixture()
+def hot_runtime(tmp_path):
+    """Callable fixture: (re-)init the runtime with hotstate on and obs
+    metrics armed (counters cleared per arm — they accumulate across
+    init cycles by design), optionally under a fault plan; always
+    disables the replicator and disarms faults on exit."""
+    counter = [0]
+
+    def arm(rules=None, *, seed=11, **cfg_kw):
+        counter[0] += 1
+        kw = dict(dcn_size=1, hotstate="on", obs="metrics")
+        if rules is not None:
+            kw["faults"] = _write_plan(
+                tmp_path / f"plan{counter[0]}.json", rules, seed=seed)
+        kw.update(cfg_kw)
+        mpi.stop()
+        mesh = mpi.init(mpi.Config(**kw))
+        sys.modules["torchmpi_tpu.obs"].reset()
+        return mesh
+
+    yield arm
+    from torchmpi_tpu import hotstate
+
+    hotstate.disable()
+    if "torchmpi_tpu.faults" in sys.modules:
+        sys.modules["torchmpi_tpu.faults"].reset()
+    if "torchmpi_tpu.obs" in sys.modules:
+        sys.modules["torchmpi_tpu.obs"].reset()
+    mpi.stop()
+
+
+def _reg():
+    return sys.modules["torchmpi_tpu.obs"].registry()
+
+
+def _state(i, steps=12):
+    """Mixed-dtype state: f32 weights, f16 activations stats, an int64
+    step counter, and a NaN-padded loss ring — every leaf kind the
+    delta packer must round-trip bit-exactly."""
+    rng = np.random.RandomState(i)
+    losses = np.full((steps,), np.nan, np.float32)
+    losses[:i] = np.arange(i, dtype=np.float32) * np.float32(0.25)
+    return {"w": (rng.randn(6, 8) * (1 + 0.1 * i)).astype(np.float32),
+            "h": (rng.randn(16) * 0.01).astype(np.float16),
+            "step": np.int64(i),
+            "losses": losses}
+
+
+def _trees_equal(a, b):
+    import jax
+
+    la, ta = jax.tree_util.tree_flatten(a)
+    lb, tb = jax.tree_util.tree_flatten(b)
+    assert ta == tb
+    for x, y in zip(la, lb):
+        x, y = np.asarray(x), np.asarray(y)
+        assert x.dtype == y.dtype and x.shape == y.shape
+        assert np.array_equal(x, y, equal_nan=True)
+
+
+# ---------------------------------------------------------------------------
+# Config plumbing + consent gate
+# ---------------------------------------------------------------------------
+
+
+def test_hotstate_config_env_and_validation(monkeypatch):
+    monkeypatch.setenv("TORCHMPI_TPU_HOTSTATE", "1")
+    monkeypatch.setenv("TORCHMPI_TPU_HOTSTATE_INTERVAL", "16")
+    monkeypatch.setenv("TORCHMPI_TPU_HOTSTATE_BUDGET_MB", "64")
+    mpi.stop()
+    try:
+        mpi.init(mpi.Config(dcn_size=1))
+        cfg = mpi.config()
+        assert cfg.hotstate == "on"
+        assert cfg.hotstate_interval == 16
+        assert cfg.hotstate_budget_mb == 64
+        with pytest.raises(ValueError, match="hotstate"):
+            mpi.set_config(hotstate="sometimes")
+        with pytest.raises(ValueError, match="hotstate_interval"):
+            mpi.set_config(hotstate_interval=0)
+        with pytest.raises(ValueError, match="hotstate_budget_mb"):
+            mpi.set_config(hotstate_budget_mb=-1)
+        mpi.set_config(hotstate="off")
+        assert mpi.config().hotstate == "off"
+    finally:
+        mpi.stop()
+    monkeypatch.setenv("TORCHMPI_TPU_HOTSTATE", "maybe")
+    with pytest.raises(ValueError, match="hotstate"):
+        mpi.init(mpi.Config(dcn_size=1))
+    mpi.stop()
+
+
+def test_consent_gate_requires_on(hot_runtime):
+    from torchmpi_tpu import hotstate
+
+    hot_runtime(hotstate="off")
+    with pytest.raises(RuntimeError, match="HOTSTATE"):
+        hotstate.enable(4)
+    assert not hotstate.active()
+    with pytest.raises(RuntimeError, match="not enabled"):
+        hotstate.replicator()
+    # offer_restore is a rung, not a requirement: quietly no-ops.
+    assert hotstate.offer_restore(_state(0)) is None
+    mpi.set_config(hotstate="on")
+    rep = hotstate.enable(4, rank=0)
+    assert hotstate.active() and hotstate.replicator() is rep
+
+
+# ---------------------------------------------------------------------------
+# The stream: bit-exact reconstruction through the delta chain
+# ---------------------------------------------------------------------------
+
+
+def test_publish_restore_bit_exact_mixed_dtypes(hot_runtime):
+    from torchmpi_tpu import hotstate
+
+    hot_runtime()
+    rep = hotstate.enable(4, rank=0, interval=4)
+    for i in range(1, 11):
+        rep.publish(_state(i), i)
+    assert rep.stats["streamed"] == 10 and rep.stats["dropped"] == 0
+    # Snapshots every 4th publish, deltas between: both kinds streamed.
+    reg = _reg()
+    assert reg.counter("tm_hotstate_streamed_total", peer="member:0",
+                       reason="snap") >= 2
+    assert reg.counter("tm_hotstate_streamed_total", peer="member:0",
+                       reason="delta") >= 6
+    got = rep.restore(_state(0))
+    assert got is not None
+    state, step = got
+    assert step == 10
+    # int8-quantized delta + sparse correction = BIT-identical, every
+    # dtype, NaN padding included.
+    _trees_equal(state, _state(10))
+    # Exact-step pinning (the multi-host agreement path) and history.
+    state7, step7 = rep.restore(_state(0), step=7)
+    assert step7 == 7
+    _trees_equal(state7, _state(7))
+    assert rep.restore(_state(0), step=99) is None
+
+
+def test_offer_restore_staleness_gate(hot_runtime):
+    from torchmpi_tpu import hotstate
+
+    hot_runtime()
+    rep = hotstate.enable(4, rank=0)
+    for i in range(1, 4):
+        rep.publish(_state(i), i)
+    got = hotstate.offer_restore(_state(0), min_step=3)
+    assert got is not None and got[1] == 3
+    assert _reg().counter_total("tm_hotstate_restored_total") == 1
+    # A RAM copy older than the disk tier is stale: the disk rung wins.
+    assert hotstate.offer_restore(_state(0), min_step=4) is None
+    assert _reg().counter("tm_hotstate_fallback_disk_total",
+                          peer="member:0", reason="stale") == 1
+
+
+# ---------------------------------------------------------------------------
+# The ladder under seeded corruption (hotstate.recv corrupt_silent)
+# ---------------------------------------------------------------------------
+
+
+def test_recv_corruption_verify_fails_and_walks_back(hot_runtime):
+    from torchmpi_tpu import hotstate
+
+    # Corrupt every replica received after the 4th: steps 5.. are
+    # poisoned in RAM, steps up to 4 are clean.
+    hot_runtime(rules=[{"site": "hotstate.recv", "kind": "corrupt_silent",
+                        "prob": 1.0, "after": 4, "max_hits": -1}])
+    rep = hotstate.enable(4, rank=0, interval=3)
+    for i in range(1, 9):
+        rep.publish(_state(i), i)
+    got = rep.restore(_state(0))
+    # The digest verify rejects every poisoned candidate and the walk
+    # lands on the newest clean step — never silently restores garbage.
+    assert got is not None
+    state, step = got
+    assert step == 4
+    _trees_equal(state, _state(4))
+    assert _reg().counter_total("tm_hotstate_verify_failed_total") >= 1
+
+
+def test_recover_ladder_ram_first_then_disk(tmp_path, hot_runtime):
+    from torchmpi_tpu import hotstate
+    from torchmpi_tpu.utils import checkpoint, restart
+
+    hot_runtime()
+    d = str(tmp_path / "ckpt")
+    os.makedirs(d)
+    init_fn = lambda: _state(0)  # noqa: E731
+    rep = hotstate.enable(4, rank=0)
+    for i in range(1, 8):
+        rep.publish(_state(i), i)
+        if i == 5:
+            checkpoint.save(d, _state(i), step=i)
+    # RAM rung wins: resumes at the very step the kill landed on.
+    state, step = restart.recover(init_fn, d, init_fn())
+    assert step == 7
+    _trees_equal(state, _state(7))
+    assert _reg().counter_total("tm_hotstate_restored_total") == 1
+    # Without the tier the same directory recovers the disk step.
+    hotstate.disable()
+    state, step = restart.recover(init_fn, d, init_fn())
+    assert step == 5
+    _trees_equal(state, _state(5))
+
+
+def test_recover_falls_to_disk_on_corrupt_ram(tmp_path, hot_runtime):
+    from torchmpi_tpu import hotstate
+    from torchmpi_tpu.utils import checkpoint, restart
+
+    # Every received replica is corrupted: the RAM rung must fail its
+    # verify and recover must settle on the disk rung, counted.
+    hot_runtime(rules=[{"site": "hotstate.recv", "kind": "corrupt_silent",
+                        "prob": 1.0, "max_hits": -1}])
+    d = str(tmp_path / "ckpt")
+    os.makedirs(d)
+    init_fn = lambda: _state(0)  # noqa: E731
+    rep = hotstate.enable(4, rank=0)
+    for i in range(1, 8):
+        rep.publish(_state(i), i)
+        if i == 5:
+            checkpoint.save(d, _state(i), step=i)
+    state, step = restart.recover(init_fn, d, init_fn())
+    assert step == 5
+    _trees_equal(state, _state(5))
+    reg = _reg()
+    assert reg.counter_total("tm_hotstate_verify_failed_total") >= 1
+    assert reg.counter_total("tm_hotstate_fallback_disk_total") >= 1
+    assert reg.counter_total("tm_hotstate_restored_total") == 0
+
+
+def test_send_drop_forces_snapshot_self_heal(hot_runtime):
+    from torchmpi_tpu import hotstate
+
+    # Drop exactly one send (the 3rd): the chain must self-heal with a
+    # forced full snapshot on the next publish, and the final restore
+    # is still bit-exact at the newest step.
+    hot_runtime(rules=[{"site": "hotstate.send", "kind": "drop",
+                        "prob": 1.0, "after": 2, "max_hits": 1}])
+    rep = hotstate.enable(4, rank=0, interval=50)
+    for i in range(1, 7):
+        rep.publish(_state(i), i)
+    assert rep.stats["dropped"] == 1 and rep.stats["streamed"] == 5
+    reg = _reg()
+    assert reg.counter_total("tm_hotstate_dropped_total") == 1
+    # interval=50 would have made everything after the first publish a
+    # delta; the post-drop snapshot is the self-heal.
+    assert reg.counter("tm_hotstate_streamed_total", peer="member:0",
+                       reason="snap") == 2
+    got = rep.restore(_state(0))
+    assert got is not None and got[1] == 6
+    _trees_equal(got[0], _state(6))
+
+
+# ---------------------------------------------------------------------------
+# Fencing + budget
+# ---------------------------------------------------------------------------
+
+
+def test_fenced_publish_lands_nothing(hot_runtime):
+    from torchmpi_tpu import hotstate
+    from torchmpi_tpu.faults import fencing
+
+    hot_runtime()
+    rep = hotstate.enable(4, rank=0)
+    rep.publish(_state(1), 1, epoch=1)
+
+    class _View:
+        epoch = 3
+
+    class _Board:
+        fence = None
+
+        def committed_view(self):
+            return _View()
+
+    fencing.arm(_Board(), 0, epoch=3)
+    try:
+        with pytest.raises(fencing.FencedWriterError):
+            rep.publish(_state(2), 2, epoch=1)
+    finally:
+        fencing.disarm()
+    # The fenced write landed nothing — RAM still holds only step 1.
+    assert rep.latest_step(0) == 1
+    rep.publish(_state(2), 2, epoch=3)
+    assert rep.latest_step(0) == 2
+
+
+def test_budget_evicts_oldest_never_newest(hot_runtime):
+    from torchmpi_tpu import hotstate
+
+    hot_runtime()
+    # ~600KB snapshots against a 1MB budget: the third generation must
+    # evict the first, never a peer's only/newest one.
+    rep = hotstate.enable(4, rank=0, interval=1, budget_mb=1)
+    big = {"w": np.zeros((150_000,), np.float32)}
+    for i in range(1, 4):
+        big["w"][:] = i
+        rep.publish(big, i)
+    assert rep.stats["evicted"] >= 1
+    assert _reg().counter_total("tm_hotstate_evicted_total") >= 1
+    got = rep.restore({"w": np.zeros((150_000,), np.float32)})
+    assert got is not None and got[1] == 3
+    assert float(np.asarray(got[0]["w"])[0]) == 3.0
+
+
+# ---------------------------------------------------------------------------
+# Live migration: zero rollback, lease-visible drain
+# ---------------------------------------------------------------------------
+
+
+def test_migrate_zero_rollback_watchdog_visible(hot_runtime,
+                                                monkeypatch):
+    from torchmpi_tpu import hotstate, watchdog
+
+    hot_runtime(watchdog="warn", watchdog_deadline_s=30.0)
+    assert watchdog.active()
+    states = []
+    real = watchdog.set_state
+
+    def spy(state, detail=""):
+        states.append((state, detail))
+        return real(state, detail)
+
+    monkeypatch.setattr(watchdog, "set_state", spy)
+    rep = hotstate.enable(4, rank=0)
+    for i in range(1, 6):
+        rep.publish(_state(i), i, rank=1)
+    slot = {}
+    state, step = hotstate.migrate(
+        1, 3, _state(0),
+        admit=lambda st, s: slot.update(state=st, step=s),
+        retire=lambda r: slot.update(retired=r))
+    # Zero rollback: the spare resumes at the source's newest step,
+    # bit-exact — no checkpoint was consulted.
+    assert step == 5 and slot["step"] == 5 and slot["retired"] == 1
+    _trees_equal(state, _state(5))
+    _trees_equal(slot["state"], _state(5))
+    # The drain was lease-visible, and the lease returned to running.
+    assert ("migrating", "rank 1 -> rank 3") in states
+    assert states[-1] == ("running", "")
+    assert watchdog.state() == "running"
+    # The source's replicas are consumed; the spare's RAM is primed.
+    assert rep.latest_step(1) == 0
+    assert rep.latest_step(3) == 5
+    assert _reg().counter("tm_hotstate_migrated_total",
+                          peer="member:1->member:3") == 1
+
+
+def test_migrate_without_stream_raises_miss(hot_runtime):
+    from torchmpi_tpu import hotstate
+
+    hot_runtime()
+    hotstate.enable(4, rank=0)
+    with pytest.raises(hotstate.HotStateMiss, match="rank 2"):
+        hotstate.migrate(2, 3, _state(0))
+    assert _reg().counter_total("tm_hotstate_fallback_disk_total") == 1
+
+
+# ---------------------------------------------------------------------------
+# chaos_tool --migrate drill recipe
+# ---------------------------------------------------------------------------
+
+
+def _chaos_tool():
+    spec = importlib.util.spec_from_file_location(
+        "_chaos_tool_hotstate", os.path.join(_REPO, "scripts",
+                                             "chaos_tool.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_chaos_tool_migrate_recipe(tmp_path, capsys):
+    tool = _chaos_tool()
+    out = str(tmp_path / "migrate.json")
+    assert tool.main(["gen", "--out", out, "--seed", "3",
+                      "--migrate", "2:6:4"]) == 0
+    text = capsys.readouterr().out
+    assert "drain rank 2 onto a spare at step 6" in text
+    assert "source killed at step 7" in text
+    plan = json.load(open(out))
+    assert plan["rules"] == [{"site": "elastic.member", "kind": "fail",
+                              "prob": 1.0, "after": 30, "max_hits": 1,
+                              "delay_s": 0.0}]
+    assert tool.main(["lint", out]) == 0
+    capsys.readouterr()
+    # Bad specs fail loudly, and a migrate kills its source too — it
+    # shares the one-kill-per-plan rule with --shrink.
+    assert tool.main(["gen", "--out", out, "--migrate", "4:1:4"]) == 2
+    assert tool.main(["gen", "--out", out, "--migrate", "1:2:4",
+                      "--shrink", "2:3:4"]) == 2
+    # The hot-state sites are payload-carrying: corrupt lints clean.
+    assert tool.main(["gen", "--out", out, "--rule",
+                      "hotstate.recv:corrupt_silent:1.0:-1",
+                      "--rule", "hotstate.send:drop"]) == 0
+    assert tool.main(["lint", out]) == 0
+
+
+# ---------------------------------------------------------------------------
+# Off-mode: zero cost, never imported
+# ---------------------------------------------------------------------------
+
+
+def test_off_mode_never_imports_hotstate():
+    """With hotstate off (the default), torchmpi_tpu.hotstate is never
+    imported — init, collectives, a durable checkpoint round trip and
+    a recover all run with no branch to take."""
+    code = (
+        "import sys, tempfile\n"
+        "import numpy as np\n"
+        "import torchmpi_tpu as mpi\n"
+        "from torchmpi_tpu.utils import checkpoint, restart\n"
+        "mpi.init(mpi.Config(dcn_size=1))\n"
+        "mpi.allreduce(np.ones((2, 4), np.float32))\n"
+        "d = tempfile.mkdtemp()\n"
+        "checkpoint.save(d, {'w': np.ones(3, np.float32)}, step=1)\n"
+        "_, step = restart.recover(\n"
+        "    lambda: {'w': np.zeros(3, np.float32)}, d,\n"
+        "    {'w': np.zeros(3, np.float32)})\n"
+        "assert step == 1\n"
+        "mpi.stop()\n"
+        "assert 'torchmpi_tpu.hotstate' not in sys.modules\n"
+        "print('HOTSTATE-OFF-OK')\n"
+    )
+    env = dict(os.environ)
+    for k in ("TORCHMPI_TPU_HOTSTATE", "TORCHMPI_TPU_FAULTS",
+              "TORCHMPI_TPU_OBS"):
+        env.pop(k, None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    out = subprocess.run([sys.executable, "-c", code],
+                         capture_output=True, text=True, timeout=300,
+                         env=env, cwd=_REPO)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "HOTSTATE-OFF-OK" in out.stdout

@@ -199,6 +199,37 @@ def decode(payload, scale, dtype=jnp.float32):
     return (payload.astype(jnp.float32) * scale).astype(dtype)
 
 
+def host_encode(x, codec: str):
+    """Numpy twin of :func:`encode` for host-side payloads (the
+    hot-state replication tier — docs/HOTSTATE.md — quantizes state
+    deltas that already live in host RAM; a device round trip per
+    streamed leaf would cost more than the quantization saves).  Same
+    math, same tiny-floor scale, so a host encode decodes identically
+    to a device encode of the same values."""
+    xf = np.asarray(x, dtype=np.float32)
+    if codec == "bf16":
+        # No numpy bf16: keep the wire dtype discipline by truncating
+        # the mantissa in uint32 space (round-to-nearest-even is what
+        # jnp does; truncation here is fine — host bf16 is unused by
+        # the exact-delta path, which is int8 + correction).
+        u = xf.view(np.uint32)
+        return ((u + 0x7FFF + ((u >> 16) & 1)) >> 16).astype(np.uint16), \
+            None
+    qmax = _QMAX[codec]
+    amax = float(np.max(np.abs(xf))) if xf.size else 0.0
+    scale = np.float32(max(amax / qmax, 1e-30))
+    q = np.clip(np.round(xf / scale), -qmax, qmax).astype(np.int8)
+    return q, scale
+
+
+def host_decode(payload, scale, dtype=np.float32):
+    """Inverse of :func:`host_encode` (up to the codec's rounding)."""
+    if scale is None:
+        u = payload.astype(np.uint32) << 16
+        return u.view(np.float32).astype(dtype)
+    return (payload.astype(np.float32) * np.float32(scale)).astype(dtype)
+
+
 def _leg_record(op: str, codec: str, nbytes: int, wire_nbytes: int,
                 min_bytes: int, axes, **extra) -> dict:
     """The one ``kind="dcn_compress"`` record schema (analysis rule C2

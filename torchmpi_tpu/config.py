@@ -338,6 +338,32 @@ class Config:
     # on — off-mode saves stay untouched.  Env: TORCHMPI_TPU_CKPT_KEEP.
     ckpt_keep: int = 0
 
+    # --- hot-state replication tier (torchmpi_tpu.hotstate) ------------------
+    # In-memory (RAM-buddy) state replication above the durable disk
+    # buddies (docs/HOTSTATE.md): "off" (default — the module is never
+    # imported, the dispatch path gains zero branches; like
+    # ``elastic``, the knob is a consent gate for a driver layer the
+    # user calls explicitly) or "on" (``hotstate.enable`` may arm the
+    # replicator: after each completed step a rank ships its state
+    # delta — int8-quantized with an exact residual correction — to its
+    # buddy's RAM, tagged (step, epoch, incarnation, blake2b digest)
+    # and epoch-fenced like board writes; ``restart.recover`` and the
+    # elastic shrink path then consult the RAM tier FIRST, before disk
+    # buddies and primaries — the three-rung recovery ladder).
+    # Env: TORCHMPI_TPU_HOTSTATE.
+    hotstate: str = "off"
+    # Full-snapshot cadence: every N-th stream ships the full exact
+    # state instead of a delta, bounding the reconstruction chain a
+    # restore must replay (and the window a single lost delta can
+    # invalidate).  1 = every stream is a full snapshot.
+    # Env: TORCHMPI_TPU_HOTSTATE_INTERVAL.
+    hotstate_interval: int = 8
+    # Per-process RAM budget (MiB) for received replicas: the inbox
+    # evicts whole generations (snapshot + its delta chain), oldest
+    # first — never the newest restorable generation of any peer.
+    # Env: TORCHMPI_TPU_HOTSTATE_BUDGET_MB.
+    hotstate_budget_mb: int = 64
+
     # --- collective watchdog (torchmpi_tpu.watchdog) -------------------------
     # Live hang detection over the blocking dispatch surfaces
     # (docs/WATCHDOG.md): "off" (default — the module is never
@@ -507,6 +533,11 @@ class Config:
                                              8.0),
             ckpt_redundancy=_env_str("TORCHMPI_TPU_CKPT_REDUNDANCY",
                                      "off"),
+            hotstate=_env_str("TORCHMPI_TPU_HOTSTATE", "off"),
+            hotstate_interval=_env_int("TORCHMPI_TPU_HOTSTATE_INTERVAL",
+                                       8),
+            hotstate_budget_mb=_env_int(
+                "TORCHMPI_TPU_HOTSTATE_BUDGET_MB", 64),
             ckpt_buddies=_env_int("TORCHMPI_TPU_CKPT_BUDDIES", 1),
             ckpt_keep=_env_int("TORCHMPI_TPU_CKPT_KEEP", 0),
             watchdog=_env_str("TORCHMPI_TPU_WATCHDOG", "off"),

@@ -126,6 +126,42 @@ def _faults_mod():
     return None
 
 
+def _hotstate_mod():
+    """The armed hot-state tier, or None (docs/HOTSTATE.md) — the same
+    sys.modules seam: a session that never enabled
+    ``torchmpi_tpu.hotstate`` never imports it here."""
+    mod = sys.modules.get("torchmpi_tpu.hotstate")
+    if mod is not None and mod.active():
+        return mod
+    return None
+
+
+def _hotstate_publish(gang: "ElasticGang", state: PyTree,
+                      step: int) -> None:
+    """Stream this rank's post-step state to its buddy's RAM when the
+    hot tier is armed.  A ``FencedWriterError`` propagates on purpose
+    (a fenced stream IS the zombie-minority signal and takes the same
+    park path as a fenced board write); everything else in the tier is
+    already best-effort."""
+    mod = _hotstate_mod()
+    if mod is not None:
+        mod.replicator().publish(
+            state, step, rank=gang._rank,
+            epoch=getattr(gang.view, "epoch", 0))
+
+
+def _hotstate_note_shrink(ranks: Sequence[int], step: int) -> None:
+    """Membership evidence for the hot tier: the dead ranks stop
+    streaming, but their REPLICAS must stay — they are exactly what the
+    RAM rung restores from on the shrink recovery."""
+    mod = _hotstate_mod()
+    if mod is not None:
+        try:
+            mod.replicator().note_shrink(ranks, step)
+        except Exception:  # noqa: BLE001 — bookkeeping, not correctness
+            pass
+
+
 def _member_peer(m: int) -> str:
     """Ledger peer name for gang member ``m`` (prefixed so member rows
     never collide with PS ``host:port`` endpoints)."""
@@ -857,6 +893,7 @@ def run_elastic(build: BuildFn, *, steps: int, directory: str,
                         raise MemberDeath(gang._rank, i)
                     try:
                         mesh = gang.shrink(ranks, step=i)
+                        _hotstate_note_shrink(ranks, i)
                     except membership.QuorumLost as e:
                         # The suspects are a majority of the view: WE
                         # are the partitioned minority — park instead
@@ -887,6 +924,10 @@ def run_elastic(build: BuildFn, *, steps: int, directory: str,
                 state = step_fn(state, i)
                 steps_run += 1
                 i += 1
+                # The hot tier streams EVERY completed step (the disk
+                # tier below saves every ``save_every``) — that gap is
+                # exactly the replay the RAM rung erases on recovery.
+                _hotstate_publish(gang, state, i)
                 if i % save_every == 0 or i == steps:
                     checkpoint.save(directory, state, step=i)
             except KeyboardInterrupt:
@@ -907,6 +948,7 @@ def run_elastic(build: BuildFn, *, steps: int, directory: str,
                         raise MemberDeath(member, i) from e
                     try:
                         mesh = gang.shrink([member], step=i)
+                        _hotstate_note_shrink([member], i)
                     except membership.QuorumLost as qe:
                         if quorum_park(qe, i, [member]) != "retry":
                             mesh = None

@@ -141,6 +141,22 @@ SITES = (
     #                         (the reconcile must retry the SAME epoch,
     #                         not vote everyone out), `partition` masks
     #                         which writers this reader can see
+    "hotstate.send",        # one hot-state replica shipped to a buddy's
+    #                         RAM (torchmpi_tpu/hotstate/,
+    #                         docs/HOTSTATE.md): `drop` loses the
+    #                         stream message (the chain self-heals at
+    #                         the next full snapshot), `corrupt_silent`
+    #                         flips bits in the staged delta payload
+    #                         before it leaves the sender, `stall`
+    #                         models a wedged transport the watchdog
+    #                         must flag
+    "hotstate.recv",        # the buddy-side receipt of one replica:
+    #                         `corrupt_silent` = a bit-flipped RAM
+    #                         buffer the digest verify must catch at
+    #                         restore time (the ladder falls to the
+    #                         disk rung instead of restoring poisoned
+    #                         state), `drop` = the receiver missed the
+    #                         message, `fail` = the buddy is gone
 )
 
 KINDS = ("delay", "drop", "corrupt", "corrupt_silent", "fail", "torn",
@@ -159,6 +175,8 @@ PAYLOAD_SITES = (
     "ps.request",
     "ckpt.write",
     "ckpt.read",
+    "hotstate.send",
+    "hotstate.recv",
 )
 
 

@@ -1,0 +1,613 @@
+"""Hot-state replication tier: RAM-buddy recovery + live rank migration.
+
+The durable checkpoint stack (utils/checkpoint.py + utils/durable.py,
+docs/CHECKPOINT.md) bottoms every recovery out in filesystem restore,
+so MTTR is gated on save-interval + disk.  This package adds the tier
+above it (docs/HOTSTATE.md): after each completed step a rank ships its
+state *delta* to its buddy's RAM — int8-quantized through the
+``compress.py`` host codecs plus an exact sparse residual correction,
+so the reconstruction is BIT-IDENTICAL to the sender's state — tagged
+``(step, epoch, incarnation, blake2b digest)`` and epoch-fenced like
+board writes.  ``restart.recover`` (and through it the elastic driver)
+consults the RAM tier FIRST, falling back to the disk buddies and then
+the primaries only when the RAM copy is missing/stale/corrupt: the
+three-rung recovery ladder, each rung counted
+(``tm_hotstate_{streamed,restored,fallback_disk,verify_failed,...}_total``).
+
+The same stream generalizes to planned live migration:
+:func:`migrate` drains a rank onto a spare at a step boundary (reverse
+of ``elastic.admit`` — pre-seed the spare's RAM from the stream, admit
+it, retire the source) with zero checkpoint rollback; the drain is
+lease-visible (watchdog state ``migrating``) so ``obs_tool blame
+--live`` renders it distinct from parked/dead.
+
+Off-mode discipline (the analysis/obs/faults/guard posture):
+``Config.hotstate="off"`` never imports this module — the knob is a
+consent gate for a driver layer the user enables explicitly
+(:func:`enable`), and the dispatch path has no branch on it at all.
+``restart.recover``/``elastic.run_elastic`` reach an armed replicator
+only through ``sys.modules`` lookups, exactly like the fault and
+telemetry seams (subprocess-asserted in tests/test_hotstate.py).
+
+Fault surface (docs/FAULTS.md): every stream message crosses the
+``hotstate.send`` (sender side) and ``hotstate.recv`` (buddy side)
+payload sites — ``drop`` loses the message (the chain self-heals: the
+next publish for that rank is forced to a full snapshot),
+``corrupt_silent`` flips real bits in the packed payload (the digest
+verify catches it at restore time and the ladder falls to the disk
+rung instead of restoring poisoned state), ``stall`` wedges the stream
+where the watchdog can see it.  Deliberately NOT retried: replication
+is best-effort by design — a lost replica costs a rung, never a step.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import sys
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .. import runtime
+from ..utils import telemetry
+
+PyTree = Any
+
+_HEADER_VERSION = 1
+
+
+class HotStateMiss(RuntimeError):
+    """No usable RAM replica (missing, stale, or every candidate failed
+    its digest verify) — the caller falls to the disk rung."""
+
+
+def _require_on():
+    """Every public entry point's consent gate (the user must opt in
+    via ``Config.hotstate`` — same posture as ``elastic``: a driver
+    layer's knob, not a dispatch-path switch)."""
+    cfg = runtime.effective_config()
+    if cfg.hotstate == "off":
+        raise RuntimeError(
+            "torchmpi_tpu.hotstate requires Config.hotstate='on' (or "
+            "TORCHMPI_TPU_HOTSTATE=1) — the hot-state tier is opt-in; "
+            "see docs/HOTSTATE.md")
+    return cfg
+
+
+def _record(event: str, *, step: int = 0, peer: str = "",
+            reason: str = "") -> None:
+    """tm_hotstate_* through obs when active (the telemetry shim does
+    the sys.modules gating — this module never imports obs)."""
+    telemetry.emit("record_hotstate", event, step=step, peer=peer,
+                   reason=reason)
+
+
+def _faults_mod():
+    """The armed fault layer, or None (sys.modules — never imported)."""
+    mod = sys.modules.get("torchmpi_tpu.faults")
+    return mod if (mod is not None and mod.active()) else None
+
+
+def _fence_check(epoch: int) -> None:
+    """Epoch-fence a stream write like a board write: a publisher whose
+    view epoch is behind the board's committed epoch must not land
+    replicas the survivors could mistake for fresh state (the zombie-
+    minority hazard, RAM edition).  One sys.modules lookup — quorum-off
+    sessions never import the fencing module."""
+    fz = sys.modules.get("torchmpi_tpu.faults.fencing")
+    if fz is None:
+        return
+    fence = fz.current()
+    if fence is not None:
+        fence.check(epoch=epoch, what="hotstate stream")
+
+
+def _buddy_holders(rank: int, world: int, k: int) -> List[int]:
+    """Ranks ``(rank+1..k) mod world`` — the SAME ring as
+    ``utils.durable.buddy_holders`` (kept formula-identical so the RAM
+    replica of a shard lives where its disk mirror does; duplicated
+    rather than imported because ``utils/durable.py`` must stay
+    never-imported under ``ckpt_redundancy="off"``)."""
+    k = max(0, min(int(k), max(0, int(world) - 1)))
+    return [(int(rank) + j) % int(world) for j in range(1, k + 1)]
+
+
+def _tree_leaves(tree) -> Tuple[list, Any]:
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    return [np.asarray(x) for x in leaves], treedef
+
+
+def _digest_state(leaves: List[np.ndarray]) -> str:
+    """Canonical blake2b over the state's leaf bytes + shape/dtype
+    headers — what the sender tags and a restore must reproduce."""
+    h = hashlib.blake2b(digest_size=16)
+    for x in leaves:
+        x = np.ascontiguousarray(x)
+        h.update(f"{x.dtype.str}:{x.shape};".encode())
+        h.update(x.tobytes())
+    return h.hexdigest()
+
+
+def _is_delta_leaf(x: np.ndarray) -> bool:
+    return x.dtype.kind == "f" and x.size > 0
+
+
+def _pack_delta(new: List[np.ndarray], base: List[np.ndarray]
+                ) -> np.ndarray:
+    """Pack one delta message: per float leaf an int8-quantized delta
+    (``compress.host_encode``) plus the exact sparse correction that
+    makes ``base + decode(q)`` land bit-identically on ``new``; non-
+    float (and empty) leaves ship raw.  Returns one contiguous uint8
+    blob — the writable payload the fault sites flip bits in."""
+    from .. import compress
+
+    chunks: List[np.ndarray] = []
+    for x, b in zip(new, base):
+        x = np.ascontiguousarray(x)
+        if not _is_delta_leaf(x):
+            chunks.append(x.reshape(-1).view(np.uint8))
+            continue
+        delta = x.astype(np.float32) - b.astype(np.float32)
+        # Non-finite deltas (NaN-padded buffers, inf overflow) would
+        # poison the quantizer's scale; zero them — the sparse exact
+        # correction below carries those elements verbatim anyway.
+        delta = np.nan_to_num(delta, nan=0.0, posinf=0.0, neginf=0.0)
+        q, scale = compress.host_encode(delta, "int8")
+        approx = (b.astype(np.float32)
+                  + compress.host_decode(q, scale)).astype(x.dtype)
+        idx = np.flatnonzero((approx != x).reshape(-1)).astype(np.int64)
+        vals = x.reshape(-1)[idx]
+        chunks.append(q.reshape(-1).view(np.uint8))
+        chunks.append(np.atleast_1d(np.float32(scale)).view(np.uint8))
+        chunks.append(np.array([idx.size], np.int64).view(np.uint8))
+        chunks.append(idx.view(np.uint8))
+        chunks.append(np.ascontiguousarray(vals).view(np.uint8))
+    return (np.concatenate(chunks) if chunks
+            else np.zeros(0, np.uint8)).copy()
+
+
+def _unpack_delta(blob: np.ndarray, base: List[np.ndarray]
+                  ) -> List[np.ndarray]:
+    """Inverse of :func:`_pack_delta` against the same ``base``.
+    Raises (ValueError/IndexError) on a blob whose structure no longer
+    parses — corrupted lengths surface as a verify failure upstream."""
+    from .. import compress
+
+    buf = blob.view(np.uint8)
+    off = 0
+
+    def take(n: int) -> np.ndarray:
+        nonlocal off
+        if n < 0 or off + n > buf.size:
+            raise ValueError("hotstate delta blob truncated")
+        out = buf[off:off + n]
+        off += n
+        return out
+
+    out: List[np.ndarray] = []
+    for b in base:
+        b = np.ascontiguousarray(b)
+        if not _is_delta_leaf(b):
+            raw = take(b.nbytes)
+            out.append(raw.view(b.dtype).reshape(b.shape).copy())
+            continue
+        q = take(b.size).view(np.int8)
+        scale = take(4).view(np.float32)[0]
+        n_corr = int(take(8).view(np.int64)[0])
+        if n_corr < 0 or n_corr > b.size:
+            raise ValueError("hotstate delta correction count corrupt")
+        idx = take(n_corr * 8).view(np.int64)
+        vals = take(n_corr * b.dtype.itemsize).view(b.dtype)
+        approx = (b.astype(np.float32)
+                  + compress.host_decode(q.reshape(b.shape), scale)
+                  ).astype(b.dtype)
+        flat = approx.reshape(-1)
+        if n_corr and (idx.min() < 0 or idx.max() >= flat.size):
+            raise ValueError("hotstate delta correction index corrupt")
+        flat[idx] = vals
+        out.append(flat.reshape(b.shape))
+    if off != buf.size:
+        raise ValueError("hotstate delta blob has trailing bytes")
+    return out
+
+
+def _pack_snap(leaves: List[np.ndarray]) -> np.ndarray:
+    chunks = [np.ascontiguousarray(x).reshape(-1).view(np.uint8)
+              for x in leaves]
+    return (np.concatenate(chunks) if chunks
+            else np.zeros(0, np.uint8)).copy()
+
+
+def _unpack_snap(blob: np.ndarray, like: List[np.ndarray]
+                 ) -> List[np.ndarray]:
+    buf = blob.view(np.uint8)
+    out, off = [], 0
+    for b in like:
+        b = np.ascontiguousarray(b)
+        if off + b.nbytes > buf.size:
+            raise ValueError("hotstate snapshot blob truncated")
+        out.append(buf[off:off + b.nbytes].view(b.dtype)
+                   .reshape(b.shape).copy())
+        off += b.nbytes
+    if off != buf.size:
+        raise ValueError("hotstate snapshot blob has trailing bytes")
+    return out
+
+
+class _Entry:
+    """One received replica message in a buddy's RAM."""
+
+    __slots__ = ("kind", "step", "epoch", "incarnation", "digest",
+                 "blob")
+
+    def __init__(self, kind: str, step: int, epoch: int,
+                 incarnation: int, digest: str, blob: np.ndarray):
+        self.kind = kind            # "snap" | "delta"
+        self.step = int(step)
+        self.epoch = int(epoch)
+        self.incarnation = int(incarnation)
+        self.digest = digest        # of the FULL state at self.step
+        self.blob = blob
+
+
+class Replicator:
+    """The per-process hot-state store: sender mirrors (what each local
+    rank last streamed, leaf-exact) plus the inbox of replicas received
+    FOR peers (generations: a full snapshot and the delta chain on top
+    of it), bounded by ``budget_mb``.
+
+    The default transport is process-local delivery — on the
+    single-process CPU sim (the tested configuration, like the elastic
+    protocol harness) every simulated rank's "buddy RAM" lives in this
+    one store; a multi-process gang passes ``transport`` to carry the
+    packed blob+tag across hosts (the entry layout is transport-
+    agnostic: one contiguous uint8 payload per message)."""
+
+    def __init__(self, world: int, *, rank: int = 0, buddies: int = 1,
+                 interval: Optional[int] = None,
+                 budget_mb: Optional[int] = None,
+                 transport: Optional[Callable[[int, int, dict], None]]
+                 = None):
+        cfg = runtime.effective_config()
+        self.world = int(world)
+        self.rank = int(rank)
+        self.buddies = max(1, int(buddies))
+        self.interval = int(cfg.hotstate_interval if interval is None
+                            else interval)
+        self.budget_bytes = int(cfg.hotstate_budget_mb if budget_mb
+                                is None else budget_mb) * (1 << 20)
+        if self.interval < 1 or self.budget_bytes < 1:
+            raise ValueError(
+                f"hotstate interval/budget must be >= 1, got "
+                f"{self.interval}/{self.budget_bytes}")
+        self._transport = transport
+        self._lock = threading.RLock()
+        # sender side: rank -> (mirror leaves, treedef, streams since
+        # last snapshot, force-snapshot flag)
+        self._mirror: Dict[int, dict] = {}
+        # receiver side: rank -> list of generations, each a list of
+        # _Entry (generation[0] is the snapshot); oldest first.
+        self._inbox: Dict[int, List[List[_Entry]]] = {}
+        self.stats = {"streamed": 0, "dropped": 0, "evicted": 0}
+
+    # -- stream (sender side) -------------------------------------------
+
+    def publish(self, state: PyTree, step: int, *, rank: Optional[int]
+                = None, epoch: int = 0, incarnation: int = 0) -> None:
+        """Ship ``rank``'s state at completed step ``step`` to its
+        buddies' RAM.  Epoch-fenced first (a fenced publisher raises
+        ``FencedWriterError`` and lands nothing); the packed payload
+        then crosses the ``hotstate.send`` fault site.  A dropped
+        message forces the next publish for that rank to a full
+        snapshot, so one lost delta never poisons the chain."""
+        rank = self.rank if rank is None else int(rank)
+        _fence_check(epoch)
+        with self._lock:
+            leaves, treedef = _tree_leaves(state)
+            mir = self._mirror.get(rank)
+            fresh = (mir is None or mir["force_snap"]
+                     or mir["since_snap"] + 1 >= self.interval
+                     or len(mir["leaves"]) != len(leaves))
+            digest = _digest_state(leaves)
+            if fresh:
+                blob = _pack_snap(leaves)
+                kind = "snap"
+            else:
+                blob = _pack_delta(leaves, mir["leaves"])
+                kind = "delta"
+            entry = _Entry(kind, step, epoch, incarnation, digest, blob)
+            mod = _faults_mod()
+            try:
+                if mod is not None:
+                    mod.fire("hotstate.send", payload=entry.blob,
+                             peer=f"member:{rank}")
+            except Exception as e:  # noqa: BLE001 — any injected fault
+                # on the send leg = the message never left; best-effort
+                # by design (a lost replica costs a rung, not a step).
+                self.stats["dropped"] += 1
+                self._mirror[rank] = {"leaves": leaves,
+                                      "treedef": treedef,
+                                      "since_snap": 0,
+                                      "force_snap": True}
+                _record("dropped", step=step, peer=f"member:{rank}",
+                        reason=type(e).__name__)
+                return
+            self._mirror[rank] = {
+                "leaves": [x.copy() for x in leaves],
+                "treedef": treedef,
+                "since_snap": 0 if kind == "snap"
+                else mir["since_snap"] + 1,
+                "force_snap": False}
+            self.stats["streamed"] += 1
+            _record("streamed", step=step, peer=f"member:{rank}",
+                    reason=kind)
+            for holder in _buddy_holders(rank, self.world,
+                                         self.buddies):
+                self._deliver(rank, holder, entry)
+
+    def _deliver(self, sender: int, holder: int, entry: _Entry) -> None:
+        if self._transport is not None and holder != self.rank:
+            self._transport(sender, holder, {
+                "kind": entry.kind, "step": entry.step,
+                "epoch": entry.epoch,
+                "incarnation": entry.incarnation,
+                "digest": entry.digest, "blob": entry.blob})
+            return
+        self.receive(sender, entry.kind, entry.step, entry.blob,
+                     digest=entry.digest, epoch=entry.epoch,
+                     incarnation=entry.incarnation)
+
+    # -- inbox (buddy side) ---------------------------------------------
+
+    def receive(self, sender: int, kind: str, step: int,
+                blob: np.ndarray, *, digest: str, epoch: int = 0,
+                incarnation: int = 0) -> None:
+        """Land one replica message in this process's RAM (the buddy
+        half — also the entry point a cross-host transport calls).  The
+        payload crosses the ``hotstate.recv`` fault site: a silent
+        corruption here is exactly the bit-flipped RAM buffer the
+        digest verify must catch at restore time."""
+        blob = np.asarray(blob, np.uint8).copy()
+        entry = _Entry(kind, step, epoch, incarnation, digest, blob)
+        mod = _faults_mod()
+        try:
+            if mod is not None:
+                mod.fire("hotstate.recv", payload=entry.blob,
+                         peer=f"member:{sender}")
+        except Exception as e:  # noqa: BLE001 — a dropped/failed recv
+            # = the buddy never saw the message; the next snapshot
+            # starts a fresh generation.
+            self.stats["dropped"] += 1
+            _record("dropped", step=step, peer=f"member:{sender}",
+                    reason=type(e).__name__)
+            return
+        with self._lock:
+            gens = self._inbox.setdefault(int(sender), [])
+            if kind == "snap" or not gens:
+                if kind != "snap":
+                    return  # a delta with no base is unusable
+                gens.append([entry])
+            else:
+                gens[-1].append(entry)
+            _record("received", step=step, peer=f"member:{sender}",
+                    reason=kind)
+            self._enforce_budget()
+
+    def _enforce_budget(self) -> None:
+        total = sum(e.blob.nbytes for gs in self._inbox.values()
+                    for g in gs for e in g)
+        while total > self.budget_bytes:
+            # Oldest evictable generation across all peers — never a
+            # peer's only (newest) generation: the budget trims history
+            # depth, not restorability.
+            victim = None
+            for sender, gens in self._inbox.items():
+                if len(gens) > 1 and (victim is None
+                                      or gens[0][0].step
+                                      < victim[1][0][0].step):
+                    victim = (sender, gens)
+            if victim is None:
+                break
+            gen = victim[1].pop(0)
+            total -= sum(e.blob.nbytes for e in gen)
+            self.stats["evicted"] += 1
+            _record("evicted", step=gen[0].step,
+                    peer=f"member:{victim[0]}")
+
+    # -- restore (the RAM rung) -----------------------------------------
+
+    def latest_step(self, rank: Optional[int] = None) -> int:
+        """Newest replicated step for ``rank`` (unverified), 0 if none."""
+        rank = self.rank if rank is None else int(rank)
+        with self._lock:
+            gens = self._inbox.get(rank, [])
+            return max((e.step for g in gens for e in g), default=0)
+
+    def restore(self, template: PyTree, *, rank: Optional[int] = None,
+                step: Optional[int] = None
+                ) -> Optional[Tuple[PyTree, int]]:
+        """Reconstruct ``rank``'s newest digest-verified state from the
+        RAM replicas: walk candidate target entries newest-first (each
+        reconstructed from its generation's snapshot through its delta
+        chain), digest-verify against the sender's tag, and return the
+        first survivor as ``(state, step)``.  ``step`` pins the target
+        to one exact step (the multi-host agreement path: every
+        survivor must resume from the SAME agreed step, so a newer RAM
+        copy is unusable there).  A failed candidate counts
+        ``tm_hotstate_verify_failed_total`` and the walk continues;
+        None when nothing survives (the caller falls to the disk
+        rung)."""
+        import jax
+
+        rank = self.rank if rank is None else int(rank)
+        t_leaves, treedef = _tree_leaves(template)
+        with self._lock:
+            gens = [list(g) for g in self._inbox.get(rank, [])]
+        for gen in reversed(gens):
+            for cut in range(len(gen), 0, -1):
+                target = gen[cut - 1]
+                if step is not None and target.step != int(step):
+                    continue
+                try:
+                    leaves = _unpack_snap(gen[0].blob, t_leaves)
+                    for e in gen[1:cut]:
+                        leaves = _unpack_delta(e.blob, leaves)
+                except Exception as e:  # noqa: BLE001 — corrupt blob
+                    _record("verify_failed", step=target.step,
+                            peer=f"member:{rank}",
+                            reason=type(e).__name__)
+                    continue
+                if _digest_state(leaves) != target.digest:
+                    _record("verify_failed", step=target.step,
+                            peer=f"member:{rank}", reason="digest")
+                    continue
+                state = jax.tree_util.tree_unflatten(
+                    treedef, [l.astype(t.dtype).reshape(t.shape)
+                              for l, t in zip(leaves, t_leaves)])
+                return state, target.step
+        return None
+
+    # -- membership bookkeeping -----------------------------------------
+
+    def adopt(self, rank: int, state: PyTree, step: int, *,
+              epoch: int = 0, incarnation: int = 0) -> None:
+        """Pre-seed ``rank``'s slot with a verified full state (the
+        migration hand-off: the spare's RAM is primed before it is
+        admitted, so it starts streaming deltas immediately)."""
+        leaves, _ = _tree_leaves(state)
+        entry = _Entry("snap", step, epoch, incarnation,
+                       _digest_state(leaves), _pack_snap(leaves))
+        with self._lock:
+            self._inbox.setdefault(int(rank), []).append([entry])
+            self._enforce_budget()
+
+    def drop(self, ranks) -> None:
+        """Forget a retired/dead rank's sender mirror AND replicas —
+        called once its state has been consumed (migration retire, or
+        an elastic shrink whose recovery settled)."""
+        if isinstance(ranks, int):
+            ranks = [ranks]
+        with self._lock:
+            for r in ranks:
+                self._mirror.pop(int(r), None)
+                self._inbox.pop(int(r), None)
+
+    def note_shrink(self, ranks, step: int) -> None:
+        """Membership evidence from the elastic driver: the dead ranks
+        stop streaming (their mirrors go), but their REPLICAS stay —
+        they are exactly what the RAM rung restores from."""
+        if isinstance(ranks, int):
+            ranks = [ranks]
+        with self._lock:
+            for r in ranks:
+                self._mirror.pop(int(r), None)
+        for r in ranks:
+            _record("peer_lost", step=step, peer=f"member:{int(r)}")
+
+
+# ---------------------------------------------------------------------------
+# Module-level driver surface (what restart/elastic reach via sys.modules).
+# ---------------------------------------------------------------------------
+
+_active_rep: Optional[Replicator] = None
+
+
+def enable(world: int, *, rank: int = 0, buddies: int = 1,
+           interval: Optional[int] = None,
+           budget_mb: Optional[int] = None,
+           transport: Optional[Callable] = None) -> Replicator:
+    """Arm the hot-state tier for this process (consent-gated on
+    ``Config.hotstate``).  Returns the active :class:`Replicator`."""
+    global _active_rep
+    _require_on()
+    _active_rep = Replicator(world, rank=rank, buddies=buddies,
+                             interval=interval, budget_mb=budget_mb,
+                             transport=transport)
+    return _active_rep
+
+
+def disable() -> None:
+    global _active_rep
+    _active_rep = None
+
+
+def active() -> bool:
+    return _active_rep is not None
+
+
+def replicator() -> Replicator:
+    if _active_rep is None:
+        raise RuntimeError("hotstate is not enabled (hotstate.enable)")
+    return _active_rep
+
+
+def offer_restore(template: PyTree, *, rank: Optional[int] = None,
+                  min_step: int = 0, step: Optional[int] = None
+                  ) -> Optional[Tuple[PyTree, int]]:
+    """The RAM rung as ``restart.recover`` consults it (via
+    sys.modules): the newest digest-verified replica for ``rank``
+    (default: this process's own rank — the state it lost) at or above
+    ``min_step`` (pass the newest disk step: a RAM copy older than the
+    disk tier is stale, the disk rung wins), or None with
+    ``tm_hotstate_fallback_disk_total`` counted — the ladder's
+    explicit step down to the PR 13 disk buddies.  A hit counts
+    ``tm_hotstate_restored_total``."""
+    rep = _active_rep
+    if rep is None:
+        return None
+    who = f"member:{rep.rank if rank is None else rank}"
+    got = rep.restore(template, rank=rank, step=step)
+    if got is None or got[1] < int(min_step):
+        _record("fallback_disk",
+                step=0 if got is None else got[1], peer=who,
+                reason="missing" if got is None else "stale")
+        return None
+    _record("restored", step=got[1], peer=who)
+    return got
+
+
+def migrate(source: int, spare: int, template: PyTree, *,
+            admit: Optional[Callable[[PyTree, int], Any]] = None,
+            retire: Optional[Callable[[int], Any]] = None,
+            epoch: int = 0) -> Tuple[PyTree, int]:
+    """Drain ``source`` onto ``spare`` at a step boundary with zero
+    checkpoint rollback — the reverse of ``elastic.admit``: reconstruct
+    the source's newest verified state from the stream, pre-seed the
+    spare's RAM with it (:meth:`Replicator.adopt`), hand it to
+    ``admit(state, step)`` (e.g. the elastic grow path, or the sim's
+    slot swap), then retire the source (``retire(source)`` +
+    :meth:`Replicator.drop`).  The whole drain is lease-visible:
+    watchdog state ``migrating`` with ``source -> spare`` detail, so
+    ``obs_tool blame --live`` renders a mid-migration rank distinct
+    from parked/dead.  Returns ``(state, step)`` — the step the spare
+    resumes at (the source's last completed step)."""
+    _require_on()
+    rep = replicator()
+    wd = sys.modules.get("torchmpi_tpu.watchdog")
+    if wd is not None and wd.active():
+        wd.set_state("migrating",
+                     detail=f"rank {int(source)} -> rank {int(spare)}")
+    try:
+        got = rep.restore(template, rank=int(source))
+        if got is None:
+            _record("fallback_disk", peer=f"member:{int(source)}")
+            raise HotStateMiss(
+                f"no verified RAM replica for rank {source} — migrate "
+                f"needs a live stream (fall back to checkpoint "
+                f"admission)")
+        state, step = got
+        rep.adopt(int(spare), state, step, epoch=epoch)
+        if admit is not None:
+            admit(state, step)
+        if retire is not None:
+            retire(int(source))
+        rep.drop(int(source))
+        _record("migrated", step=step,
+                peer=f"member:{int(source)}->member:{int(spare)}")
+        return state, step
+    finally:
+        if wd is not None and wd.active():
+            wd.set_state("running")

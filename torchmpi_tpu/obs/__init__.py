@@ -657,3 +657,30 @@ def record_elastic(event: str, *, epoch: int = 0, members: int = 0,
     _registry.counter_inc(f"tm_elastic_{event}_total", **labels)
     _recorder.append("elastic", event, int(members), "",
                      f"epoch {int(epoch)}")
+
+
+def record_hotstate(event: str, *, step: int = 0, peer: str = "",
+                    reason: str = "") -> None:
+    """One hot-state replication-tier event (``torchmpi_tpu/hotstate``
+    — docs/HOTSTATE.md): ``event`` is ``streamed`` (a rank shipped its
+    post-step delta/snapshot to its buddy's RAM — ``reason`` is
+    ``snap`` | ``delta``) | ``received`` (the buddy landed it) |
+    ``dropped`` (an injected ``hotstate.send``/``hotstate.recv`` fault
+    ate the message — the chain self-heals at the next snapshot) |
+    ``restored`` (the RAM rung reconstructed a digest-verified state) |
+    ``verify_failed`` (a candidate replica failed its blake2b check —
+    ``reason`` is ``digest`` or the parse error class) |
+    ``fallback_disk`` (the ladder stepped down to the disk buddies) |
+    ``evicted`` (the memory budget trimmed an old generation) |
+    ``peer_lost`` (a streaming peer left the gang; its replicas stay) |
+    ``migrated`` (a live drain landed a rank on a spare) — counter
+    ``tm_hotstate_<event>_total``.  Every event rides the flight ring
+    with the STEP in the nbytes slot, so a post-mortem sees which rung
+    recovery actually took right next to the collectives around it."""
+    labels = {}
+    if peer:
+        labels["peer"] = peer
+    if reason:
+        labels["reason"] = reason
+    _registry.counter_inc(f"tm_hotstate_{event}_total", **labels)
+    _recorder.append("hotstate", event, int(step), peer, reason or event)

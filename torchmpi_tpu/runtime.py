@@ -196,6 +196,18 @@ def _normalize_elastic(value) -> Optional[str]:
     return None
 
 
+def _normalize_hotstate(value) -> Optional[str]:
+    """Canonical hotstate mode for a config/env value: "off"|"on", with
+    boolean-ish spellings accepted.  None = unrecognized (the caller
+    raises)."""
+    v = str(value).strip().lower()
+    if v in ("off", "0", "false", "no", "none", ""):
+        return "off"
+    if v in ("on", "1", "true", "yes"):
+        return "on"
+    return None
+
+
 def _normalize_elastic_quorum(value) -> Optional[str]:
     """Canonical elastic_quorum mode: "off"|"majority", boolean-ish
     spellings accepted ("1"/"true"/"yes"/"on" mean "majority" — the
@@ -526,6 +538,28 @@ def init(config: Optional[Config] = None, **overrides) -> Mesh:
                 f"config.ckpt_buddies must be >= 1 and ckpt_keep >= 0 "
                 f"(0 = keep everything), got "
                 f"{cfg.ckpt_buddies}/{cfg.ckpt_keep}")
+        # Hot-state replication tier (docs/HOTSTATE.md): same
+        # any-config env pickup + one-home normalization.  "on" arms
+        # NOTHING here — torchmpi_tpu.hotstate is a driver layer the
+        # user enables explicitly, and the knob is its consent gate;
+        # "off" (default) never imports the module and the dispatch
+        # path has no branch on it at all.
+        if _normalize_hotstate(cfg.hotstate) == "off":
+            cfg.hotstate = os.environ.get("TORCHMPI_TPU_HOTSTATE", "off")
+        cfg.hotstate = _normalize_hotstate(cfg.hotstate)
+        if cfg.hotstate is None:
+            raise ValueError(
+                "config.hotstate (or TORCHMPI_TPU_HOTSTATE) must be "
+                "off|on")
+        _env_default_pickup(cfg, "hotstate_interval",
+                            "TORCHMPI_TPU_HOTSTATE_INTERVAL", int)
+        _env_default_pickup(cfg, "hotstate_budget_mb",
+                            "TORCHMPI_TPU_HOTSTATE_BUDGET_MB", int)
+        if cfg.hotstate_interval < 1 or cfg.hotstate_budget_mb < 1:
+            raise ValueError(
+                f"config.hotstate_interval and hotstate_budget_mb must "
+                f"be >= 1, got {cfg.hotstate_interval}/"
+                f"{cfg.hotstate_budget_mb}")
         # Elastic gang membership (docs/ELASTIC.md): same any-config env
         # pickup + one-home normalization.  "on" arms NOTHING here —
         # torchmpi_tpu.elastic is a driver layer the user calls
@@ -894,6 +928,14 @@ def set_config(**kw) -> None:
             v = _normalize_elastic(v)
             if v is None:
                 raise ValueError("config.elastic must be off|on")
+        if k == "hotstate":
+            v = _normalize_hotstate(v)
+            if v is None:
+                raise ValueError("config.hotstate must be off|on")
+        if k in ("hotstate_interval", "hotstate_budget_mb"):
+            v = int(v)
+            if v < 1:
+                raise ValueError(f"config.{k} must be >= 1")
         if k in ("elastic_poll_s", "elastic_deadline_s"):
             v = float(v)
             if v <= 0:

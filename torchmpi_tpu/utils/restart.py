@@ -100,6 +100,28 @@ def _obs_record(event: str, step: int) -> None:
         pass
 
 
+def _ram_rung(template: PyTree, *, min_step: int = 0,
+              step: Optional[int] = None
+              ) -> Optional[Tuple[PyTree, int]]:
+    """The hot-state RAM rung (docs/HOTSTATE.md), consulted FIRST by
+    :func:`recover` when the user armed it — via sys.modules, the same
+    off-mode import discipline as the fault/telemetry seams: a session
+    that never enabled ``torchmpi_tpu.hotstate`` never imports it and
+    this is one dict lookup.  Returns a digest-verified ``(state,
+    step)`` or None (stale/missing/corrupt — the tier counts its own
+    ``tm_hotstate_fallback_disk_total`` and the ladder steps down to
+    the disk buddies).  Best-effort by construction: a broken RAM tier
+    must never block a disk recovery."""
+    mod = sys.modules.get("torchmpi_tpu.hotstate")
+    if mod is None or not mod.active():
+        return None
+    try:
+        return mod.offer_restore(template, min_step=min_step,
+                                 step=step)
+    except Exception:  # noqa: BLE001 — a rung, not a requirement
+        return None
+
+
 def _fsync_verify(directory: str, step: int) -> None:
     """Durability check on the step recovery settled on: re-open the
     local npz read-only (it must still be readable AFTER the restore
@@ -195,14 +217,24 @@ def recover(init_fn: Callable[[], PyTree], directory: str,
     count and a survivors-only board agreement instead — the full-gang
     collective would hang forever on the member whose death is exactly
     what recovery is recovering from.  Returns ``(state, next_step)``.
+
+    When the hot-state tier is armed (docs/HOTSTATE.md) the ladder
+    grows a rung ABOVE the disk walk: a digest-verified RAM replica at
+    or past the newest disk step wins (no file I/O, no replay of the
+    save interval); in the multi-host protocol the RAM step simply
+    joins the candidate proposal, so the agreement loop stays the
+    single source of truth about which step the gang stands on.
     """
     if participants is None:
         participants = jax.process_count()
     if agree is None:
         agree = checkpoint.agree_min_step
 
-    def settled(state, step):
-        if step > 0:
+    def settled(state, step, source="disk"):
+        if step > 0 and source == "disk":
+            # A RAM restore has no checkpoint file at its step to
+            # re-open — its durability story is the digest verify the
+            # hot tier already ran (and the disk tier underneath it).
             _fsync_verify(directory, step)
         # Pin the settled step against retention pruning: the step a
         # recovery (or a guard rewind) agreed to stand on must survive
@@ -214,6 +246,10 @@ def recover(init_fn: Callable[[], PyTree], directory: str,
     steps_avail = [s for s in checkpoint.available_steps(directory)
                    if s > 0]
     if participants <= 1:
+        ram = _ram_rung(template,
+                        min_step=steps_avail[-1] if steps_avail else 1)
+        if ram is not None:
+            return settled(ram[0], ram[1], source="ram")
         for step in reversed(steps_avail):
             try:
                 return settled(checkpoint.restore(directory, template,
@@ -225,24 +261,45 @@ def recover(init_fn: Callable[[], PyTree], directory: str,
                 # it landed.
                 checkpoint._record_walkback(step, e)
                 continue
+        if steps_avail:
+            # Disk fully failed: a stale-but-verified RAM replica
+            # still beats a fresh start (last rung before step 0).
+            ram = _ram_rung(template)
+            if ram is not None:
+                return settled(ram[0], ram[1], source="ram")
         return settled(init_fn(), 0)
+    hs = sys.modules.get("torchmpi_tpu.hotstate")
+    ram_step = 0
+    if hs is not None and hs.active():
+        try:
+            ram_step = hs.replicator().latest_step()
+        except Exception:  # noqa: BLE001 — a rung, not a requirement
+            ram_step = 0
     ceiling = None
     while True:
         cand = next((s for s in reversed(steps_avail)
                      if ceiling is None or s <= ceiling), 0)
+        if ram_step and (ceiling is None or ram_step <= ceiling):
+            cand = max(cand, ram_step)
         agreed = agree(cand)
         if agreed <= 0:
             return settled(init_fn(), 0)  # collectively: nothing common
-        state, ok = None, 1
-        try:
-            state = checkpoint.restore(directory, template,
-                                       step=agreed)
-        except Exception as e:  # noqa: BLE001 — resolved collectively
-            checkpoint._record_walkback(agreed, e)
-            ok = 0
+        state, ok, source = None, 1, "disk"
+        ram = (_ram_rung(template, step=agreed)
+               if ram_step and agreed <= ram_step else None)
+        if ram is not None:
+            state, source = ram[0], "ram"
+        else:
+            try:
+                state = checkpoint.restore(directory, template,
+                                           step=agreed)
+            except Exception as e:  # noqa: BLE001 — resolved collectively
+                checkpoint._record_walkback(agreed, e)
+                ok = 0
         if agree(ok):
-            return settled(state, agreed)
+            return settled(state, agreed, source=source)
         ceiling = agreed - 1  # someone failed: walk back TOGETHER
+        ram_step = 0  # a failed round demotes the RAM rung: disk only
 
 
 def run_with_restarts(

@@ -217,8 +217,11 @@ def set_state(state: str, detail: str = "") -> None:
     """Publish a coarse driver state into the lease payload
     (``"running"`` default; the elastic driver sets ``"parked"`` with
     the epoch it is waiting on while a quorum-lost minority waits out
-    a partition).  Forces an immediate lease renewal so live triage
-    sees the transition at once; a no-op when the watchdog is off."""
+    a partition; the hot-state tier sets ``"migrating"`` with the
+    ``source -> spare`` ranks while a live drain is in flight —
+    docs/HOTSTATE.md).  Forces an immediate lease renewal so live
+    triage (``obs_tool blame --live``) sees the transition at once; a
+    no-op when the watchdog is off."""
     global _state, _state_detail
     if _mode == "off":
         return
