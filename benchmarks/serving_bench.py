@@ -27,16 +27,37 @@ Reported (the ``SERVING-SUMMARY`` line CI asserts on):
   replicas mid-trace; the run must still complete, re-route > 0
   sessions, and stay token-exact (``CHAOS-SUMMARY`` line).
 
+Further phases (each with its own asserted ``*-SUMMARY`` line):
+
+- ``--tp N`` — a replica as an N-device TP mesh slice
+  (``Server.sharded``): continuous batching over the sharded model vs
+  the PR 9 fallback (static batching through the same TP engine), both
+  bitwise vs the offline ``tp_generate`` oracle
+  (``TP-SERVING-SUMMARY``);
+- ``--sample`` — temperature/top-k/top-p with per-request seeds:
+  streams must be bitwise-identical across replica layouts and re-runs,
+  and distinct from greedy (``SAMPLE-SUMMARY``);
+- ``--spec`` — draft-K/verify-once speculative decoding
+  (``--spec-draft`` ngram | model): token streams bitwise vs non-spec
+  at the same seeds (greedy AND sampled), TTFT/ITL must win on the
+  work-unit clock (``SPEC-SUMMARY``);
+- ``--buckets B`` — pow-2 bucketed prefill on a mixed-prompt-length
+  trace: compile count == bucket count (< distinct lengths), streams
+  bitwise unchanged (``BUCKET-SUMMARY``);
+- ``--bank`` — persist every emitted summary to ``SUMMARY_BANK.json``
+  (stamped, git-pinned, keep-last-20 — ``benchmarks/banking.py``).
+
 Exits nonzero unless continuous >= --min-speedup x static throughput
-AND continuous mean TTFT < static AND bitwise holds (and the chaos
-phase, when run, drained + re-routed).  Run under obs
+AND continuous mean TTFT < static AND bitwise holds (and every phase
+run passed its own verdict).  Run under obs
 (``TORCHMPI_TPU_OBS=metrics``) to get the ``tm_serving_*`` SLO
 histograms; ``scripts/obs_tool.py slo`` renders them.
 
 Usage::
 
     JAX_PLATFORMS=cpu TORCHMPI_TPU_OBS=metrics \
-        python benchmarks/serving_bench.py --requests 48 --chaos
+        python benchmarks/serving_bench.py --requests 48 --chaos \
+            --sample --spec --buckets 8 --tp 2
 """
 
 import argparse
@@ -50,19 +71,68 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 
-def build_trace(rng, n, tp, lens, inter_arrival_s, vocab):
+def build_trace(rng, n, tp, lens, inter_arrival_s, vocab, *,
+                sampling=None, prompt_lens=None, id_prefix="q"):
     import numpy as np
 
     from torchmpi_tpu import serving
 
-    prompts = rng.randint(0, vocab, size=(n, tp)).astype(np.int32)
+    if prompt_lens is None:
+        prompts = list(rng.randint(0, vocab, size=(n, tp))
+                       .astype(np.int32))
+    else:
+        prompts = [rng.randint(
+            0, vocab, size=(int(prompt_lens[i % len(prompt_lens)]),)
+        ).astype(np.int32) for i in range(n)]
     max_news = [int(lens[i % len(lens)]) for i in
                 rng.permutation(n)]
     gaps = rng.exponential(inter_arrival_s, size=n)
     arrivals = np.cumsum(gaps)
-    return [serving.Request(f"q{i}", prompts[i], max_new=max_news[i],
-                            arrival_s=float(arrivals[i]))
+    kw = dict(sampling or {})
+    seed0 = int(kw.pop("seed0", 0))
+    return [serving.Request(f"{id_prefix}{i}", prompts[i],
+                            max_new=max_news[i],
+                            arrival_s=float(arrivals[i]),
+                            seed=seed0 + i if sampling is not None else 0,
+                            **kw)
             for i in range(n)]
+
+
+def clone_reqs(reqs):
+    """Fresh Request copies (runs mutate the result fields) — sampling
+    knobs carried over so re-runs are seed-identical."""
+    from torchmpi_tpu import serving
+
+    return [serving.Request(r.rid, r.prompt, r.max_new, eos_id=r.eos_id,
+                            arrival_s=r.arrival_s,
+                            temperature=r.temperature, top_k=r.top_k,
+                            top_p=r.top_p, seed=r.seed)
+            for r in reqs]
+
+
+def _maybe_bank(args, kind, line):
+    """Persist one ``*-SUMMARY key=value ...`` line to
+    SUMMARY_BANK.json under ``--bank`` (parsed to a record dict —
+    numbers as numbers — so banked history diffs field-wise)."""
+    if not getattr(args, "bank", False) or line is None:
+        return
+    from benchmarks import banking
+
+    head, _, rest = line.partition(" ")
+    summary = {"line": head}
+    for kv in rest.split():
+        k, _, v = kv.partition("=")
+        try:
+            summary[k] = int(v)
+        except ValueError:
+            try:
+                summary[k] = float(v)
+            except ValueError:
+                summary[k] = v
+    rec = banking.bank_summary(kind, summary)
+    print(f"# banked {kind} stamp={rec['stamp']} "
+          f"commit={rec['commit']} platform={rec['platform']} -> "
+          f"{banking.DEFAULT_PATH}", file=sys.stderr)
 
 
 def offline_oracle(model, params, reqs):
@@ -80,7 +150,8 @@ def offline_oracle(model, params, reqs):
     return out
 
 
-def run_static(model, params, reqs, batch_size, slot_tokens):
+def run_static(model, params, reqs, batch_size, slot_tokens,
+               engine=None):
     """Static-batch SEMANTICS through the same engine mechanics: wait
     until a full batch has arrived, admit it whole, run every member to
     the batch's longest decode (each tick steps all ``batch_size`` slot
@@ -102,17 +173,19 @@ def run_static(model, params, reqs, batch_size, slot_tokens):
     ratio of IDENTICAL executables — immune to container noise; wall
     time is measured alongside as the per-unit cost evidence.
 
+    ``engine`` overrides the dense engine — the TP phase passes a
+    pre-built :class:`~torchmpi_tpu.serving.TPReplicaEngine` so the
+    static baseline runs the SAME sharded executables.
+
     Returns (per-rid tokens, work_units, wall_s, mean_ttft_units)."""
     import numpy as np
 
     from torchmpi_tpu import serving
 
-    ordered = [serving.Request(r.rid, r.prompt, r.max_new,
-                               eos_id=r.eos_id, arrival_s=r.arrival_s)
-               for r in sorted(reqs, key=lambda r: r.arrival_s)]
-    eng = serving.ReplicaEngine(model, params, name="static",
-                                slots=batch_size,
-                                slot_tokens=slot_tokens)
+    ordered = clone_reqs(sorted(reqs, key=lambda r: r.arrival_s))
+    eng = engine if engine is not None else serving.ReplicaEngine(
+        model, params, name="static", slots=batch_size,
+        slot_tokens=slot_tokens)
     tokens, clock, ttfts = {}, 0.0, []
     wall0 = time.monotonic()
     for i in range(0, len(ordered), batch_size):
@@ -135,6 +208,242 @@ def run_static(model, params, reqs, batch_size, slot_tokens):
     wall = time.monotonic() - wall0
     work = eng.stats["prefills"] + eng.stats["steps"]
     return tokens, work, wall, float(np.mean(ttfts))
+
+
+def run_sample(model, params, args, rng, vocab):
+    """Sampled decode (temperature/top-k/top-p, per-request seeds):
+    streams must be bitwise-identical across replica layouts and
+    re-runs — sampling keys each token on fold_in(PRNGKey(seed), i),
+    never on slot/replica/neighbors — and distinct from greedy."""
+    import numpy as np
+
+    from torchmpi_tpu import serving
+
+    n = max(16, args.requests // 2)
+    inter = float(np.mean(args.lens)) / (args.load * args.slots)
+    reqs = build_trace(rng, n, args.prompt_len, args.lens, inter, vocab,
+                       sampling=dict(temperature=0.8, top_k=20,
+                                     top_p=0.9, seed0=args.seed + 100),
+                       id_prefix="s")
+    oracle = offline_oracle(model, params, reqs)  # greedy reference
+    streams = []
+    for replicas in (1, 2, 1):
+        run = clone_reqs(reqs)
+        srv = serving.Server(model, params, replicas=replicas,
+                             slots=args.slots,
+                             slot_tokens=args.slot_tokens)
+        done = srv.run_trace(run, unit_seconds=1.0)
+        assert len(done) == len(run)
+        streams.append({r.rid: list(r.tokens) for r in run})
+    repro = streams[0] == streams[1] == streams[2]
+    distinct = any(streams[0][r.rid] != oracle[r.rid] for r in reqs)
+    ok = repro and distinct
+    line = (f"SAMPLE-SUMMARY requests={n} layouts=1,2,1 "
+            f"bitwise_repro={'ok' if repro else 'FAIL'} "
+            f"distinct_from_greedy={'ok' if distinct else 'FAIL'} "
+            f"verdict={'sampled-reproducible' if ok else 'FAIL'}")
+    print(line)
+    return ok, line
+
+
+def run_spec(model, params, args, rng, vocab):
+    """Speculative decoding: the spec stream must be bitwise the
+    non-spec stream at the same seeds (greedy AND sampled traces), and
+    must WIN mean TTFT + ITL on the work-unit clock — an accepted draft
+    lands extra tokens for the same 1-unit verify forward."""
+    import numpy as np
+
+    from torchmpi_tpu import serving
+
+    if args.spec_draft == "model":
+        import jax
+        import jax.numpy as jnp
+
+        from torchmpi_tpu.models import TransformerLM
+
+        dm = TransformerLM(vocab=vocab, embed=16, depth=1, num_heads=2,
+                           head_dim=8, max_len=args.slot_tokens,
+                           pos_emb="rope")
+        dp = dm.init(jax.random.PRNGKey(args.seed + 3),
+                     jnp.zeros((1, args.prompt_len),
+                               jnp.int32))["params"]
+        draft = serving.ModelDraft(dm, dp)
+    else:
+        draft = serving.NgramDraft()
+
+    inter = float(np.mean(args.lens)) / (args.load * args.slots)
+    greedy = build_trace(rng, args.requests, args.prompt_len, args.lens,
+                         inter, vocab, id_prefix="g")
+
+    def run(reqs, **kw):
+        srv = serving.Server(model, params, replicas=1,
+                             slots=args.slots,
+                             slot_tokens=args.slot_tokens, **kw)
+        out = clone_reqs(reqs)
+        done = srv.run_trace(out, unit_seconds=1.0)
+        assert len(done) == len(out)
+        return out, srv.router.replicas[0]
+
+    base, _ = run(greedy)
+    spec, eng = run(greedy, spec_k=args.spec_k, draft=draft)
+    bitwise = {r.rid: r.tokens for r in base} == \
+        {r.rid: r.tokens for r in spec}
+
+    def lat(reqs):
+        ttft = float(np.mean([r.ttft_s for r in reqs]))
+        itl = float(np.mean([(r.finish_s - r.arrival_s - r.ttft_s)
+                             / max(1, len(r.tokens) - 1)
+                             for r in reqs]))
+        return ttft, itl
+
+    b_ttft, b_itl = lat(base)
+    s_ttft, s_itl = lat(spec)
+    acc = eng.stats["spec_accepted"] / max(1, eng.stats["spec_drafted"])
+
+    sampled = build_trace(rng, max(16, args.requests // 2),
+                          args.prompt_len, args.lens, inter, vocab,
+                          sampling=dict(temperature=0.8, top_k=20,
+                                        top_p=0.9,
+                                        seed0=args.seed + 200),
+                          id_prefix="gs")
+    sb, _ = run(sampled)
+    ss, _ = run(sampled, spec_k=args.spec_k, draft=draft)
+    bitwise_sampled = {r.rid: r.tokens for r in sb} == \
+        {r.rid: r.tokens for r in ss}
+
+    ok = (bitwise and bitwise_sampled and s_ttft < b_ttft
+          and s_itl < b_itl)
+    line = (f"SPEC-SUMMARY draft={args.spec_draft} k={args.spec_k} "
+            f"requests={len(greedy)} acceptance={acc:.2f} "
+            f"ttft_u={s_ttft:.1f}/{b_ttft:.1f} "
+            f"itl_u={s_itl:.2f}/{b_itl:.2f} "
+            f"bitwise={'ok' if bitwise else 'FAIL'} "
+            f"bitwise_sampled={'ok' if bitwise_sampled else 'FAIL'} "
+            f"verdict={'spec-wins' if ok else 'FAIL'}")
+    print(line)
+    return ok, line
+
+
+def run_buckets(model, params, args, rng, vocab):
+    """Mixed prompt lengths: bucketed prefill compiles O(buckets)
+    executables instead of one per distinct length, with every stream
+    bitwise unchanged (causality + true-length logit slice)."""
+    import numpy as np
+
+    from torchmpi_tpu import serving
+
+    plens = [3, 5, 6, 9, 11, 17]
+    reqs = build_trace(rng, max(24, args.requests // 2), 0, [4, 8],
+                       0.02, vocab, prompt_lens=plens, id_prefix="b")
+    oracle = offline_oracle(model, params, reqs)
+
+    def run(bucket):
+        srv = serving.Server(model, params, replicas=1,
+                             slots=args.slots,
+                             slot_tokens=args.slot_tokens,
+                             prefill_bucket=bucket)
+        out = clone_reqs(reqs)
+        done = srv.run_trace(out, unit_seconds=1.0)
+        assert len(done) == len(out)
+        eng = srv.router.replicas[0]
+        return ({r.rid: r.tokens for r in out},
+                eng.stats["prefill_compiles"])
+
+    plain_toks, plain_compiles = run(0)
+    buck_toks, buck_compiles = run(args.buckets)
+    expect = {min(max(args.buckets, 1 << max(0, L - 1).bit_length()),
+                  args.slot_tokens) for L in plens}
+    distinct = len(set(plens))
+    bitwise = (plain_toks == buck_toks
+               and all(plain_toks[r.rid] == oracle[r.rid]
+                       for r in reqs))
+    ok = (bitwise and buck_compiles == len(expect)
+          and plain_compiles == distinct
+          and buck_compiles < plain_compiles)
+    line = (f"BUCKET-SUMMARY bucket={args.buckets} "
+            f"distinct_lens={distinct} compiles_plain={plain_compiles} "
+            f"compiles_bucketed={buck_compiles} "
+            f"expected_buckets={len(expect)} "
+            f"bitwise={'ok' if bitwise else 'FAIL'} "
+            f"verdict={'bucketed-compiles-ok' if ok else 'FAIL'}")
+    print(line)
+    return ok, line
+
+
+def run_tp(args, rng, vocab):
+    """One replica as an ``--tp``-device TP mesh slice
+    (``Server.sharded``): continuous batching vs static batching
+    through the SAME sharded engine class, both bitwise vs the offline
+    ``tp_generate`` oracle."""
+    import importlib
+
+    import numpy as np
+
+    import jax
+    from jax.sharding import Mesh
+
+    from torchmpi_tpu import serving
+    from torchmpi_tpu.serving.tp_engine import TPReplicaEngine
+
+    if len(jax.devices()) < args.tp:
+        print(f"TP-SERVING-SUMMARY tp={args.tp} verdict=SKIP "
+              f"(only {len(jax.devices())} devices)")
+        return True, None
+    tpg = importlib.import_module("torchmpi_tpu.models.tp_generate")
+    tparams = tpg.init_tp_lm(jax.random.PRNGKey(args.seed + 2),
+                             vocab=vocab, embed=args.embed, depth=2,
+                             num_heads=4, head_dim=8)
+    mesh = Mesh(np.asarray(jax.devices()[:args.tp]), ("model",))
+
+    n = min(args.requests, 32)
+    inter = float(np.mean(args.lens)) / (args.load * args.slots)
+    reqs = build_trace(rng, n, args.prompt_len, args.lens, inter,
+                       vocab, id_prefix="t")
+    oracle = {}
+    for r in reqs:
+        toks = np.asarray(tpg.tp_generate(
+            tparams, np.asarray(r.prompt).reshape(1, -1),
+            steps=r.max_new, mesh=mesh, axis="model", num_heads=4))
+        oracle[r.rid] = toks[0, len(r.prompt):].tolist()
+
+    def sharded():
+        return serving.Server.sharded(
+            tparams, tp=args.tp, num_heads=4,
+            slot_tokens=args.slot_tokens, replicas=1, slots=args.slots)
+
+    # Warmup: pay the shard_map prefill/step compiles off the clock.
+    sharded().run_trace(clone_reqs(reqs[:args.slots]))
+
+    run = clone_reqs(reqs)
+    srv = sharded()
+    wall0 = time.monotonic()
+    done = srv.run_trace(run, unit_seconds=1.0)
+    cont_wall = time.monotonic() - wall0
+    eng = srv.router.replicas[0]
+    cont_work = eng.stats["prefills"] + eng.stats["steps"]
+    bitwise = (len(done) == len(run)
+               and all(r.tokens == oracle[r.rid] for r in run))
+
+    static_eng = TPReplicaEngine(
+        tparams, mesh=mesh, axis="model", num_heads=4, name="tpstatic",
+        slots=args.slots, slot_tokens=args.slot_tokens)
+    static_toks, static_work, static_wall, _ = run_static(
+        None, None, reqs, args.slots, args.slot_tokens,
+        engine=static_eng)
+    bitwise = bitwise and all(static_toks[r.rid] == oracle[r.rid]
+                              for r in reqs)
+    speedup = static_work / cont_work
+    n_tok = sum(len(oracle[r.rid]) for r in reqs)
+    ok = bitwise and speedup >= args.min_speedup
+    line = (f"TP-SERVING-SUMMARY tp={args.tp} requests={n} "
+            f"tokens={n_tok} cont_work={cont_work} "
+            f"static_work={static_work} speedup={speedup:.2f} "
+            f"cont_tok_s={n_tok / cont_wall:.1f} "
+            f"static_tok_s={n_tok / static_wall:.1f} "
+            f"bitwise={'ok' if bitwise else 'FAIL'} verdict="
+            f"{'tp-continuous-beats-static' if ok else 'FAIL'}")
+    print(line)
+    return ok, line
 
 
 def run_chaos(model, params, args, rng, vocab):
@@ -166,11 +475,12 @@ def run_chaos(model, params, args, rng, vocab):
     rerouted = sum(r.reroutes for r in reqs)
     ok = (len(done) == len(reqs) and len(dead) == 1 and rerouted > 0
           and all(r.tokens == oracle[r.rid] for r in reqs))
-    print(f"CHAOS-SUMMARY requests={len(reqs)} dead={','.join(dead)} "
-          f"rerouted={rerouted} "
-          f"bitwise={'ok' if ok else 'FAIL'} "
-          f"verdict={'drain-reroute-ok' if ok else 'FAIL'}")
-    return ok, rerouted
+    line = (f"CHAOS-SUMMARY requests={len(reqs)} dead={','.join(dead)} "
+            f"rerouted={rerouted} "
+            f"bitwise={'ok' if ok else 'FAIL'} "
+            f"verdict={'drain-reroute-ok' if ok else 'FAIL'}")
+    print(line)
+    return ok, rerouted, line
 
 
 def main():
@@ -198,6 +508,28 @@ def main():
                    help="also run the replica-kill phase")
     p.add_argument("--chaos-after", type=int, default=20,
                    help="site arrivals before the planned kill")
+    p.add_argument("--sample", action="store_true",
+                   help="also run the sampled-decode phase "
+                        "(SAMPLE-SUMMARY)")
+    p.add_argument("--spec", action="store_true",
+                   help="also run the speculative-decoding phase "
+                        "(SPEC-SUMMARY)")
+    p.add_argument("--spec-k", type=int, default=4,
+                   help="draft tokens per speculative tick")
+    p.add_argument("--spec-draft", choices=["ngram", "model"],
+                   default="ngram",
+                   help="proposer for --spec (ngram = prompt lookup, "
+                        "free; model = small draft LM, priced by "
+                        "param ratio)")
+    p.add_argument("--buckets", type=int, default=0,
+                   help="> 0: run the bucketed-prefill phase with this "
+                        "min bucket (BUCKET-SUMMARY)")
+    p.add_argument("--tp", type=int, default=0,
+                   help="> 0: run the TP-sharded replica phase on this "
+                        "many devices (TP-SERVING-SUMMARY)")
+    p.add_argument("--bank", action="store_true",
+                   help="persist every summary line to "
+                        "SUMMARY_BANK.json")
     args = p.parse_args()
 
     import numpy as np
@@ -261,28 +593,51 @@ def main():
     static_tps = n_tok / static_wall
     unit_ms = (cont_wall + static_wall) / (cont_work + static_work) * 1e3
 
-    chaos_ok, rerouted = (True, 0)
+    chaos_ok, rerouted, chaos_line = (True, 0, None)
     if args.chaos:
-        chaos_ok, rerouted = run_chaos(model, params, args, rng, vocab)
+        chaos_ok, rerouted, chaos_line = run_chaos(model, params, args,
+                                                   rng, vocab)
+
+    phases = []  # (bank kind, ok, summary line)
+    if args.sample:
+        ok, line = run_sample(model, params, args, rng, vocab)
+        phases.append(("serving_sample", ok, line))
+    if args.spec:
+        ok, line = run_spec(model, params, args, rng, vocab)
+        phases.append(("serving_spec", ok, line))
+    if args.buckets > 0:
+        ok, line = run_buckets(model, params, args, rng, vocab)
+        phases.append(("serving_bucket", ok, line))
+    if args.tp > 0:
+        ok, line = run_tp(args, rng, vocab)
+        phases.append(("serving_tp", ok, line))
 
     good = (bitwise and speedup >= args.min_speedup
-            and cont_ttft_u < static_ttft_u and chaos_ok)
-    print(f"SERVING-SUMMARY requests={len(reqs)} tokens={n_tok} "
-          f"cont_work={cont_work} static_work={static_work} "
-          f"speedup={speedup:.2f} "
-          f"cont_tok_s={cont_tps:.1f} static_tok_s={static_tps:.1f} "
-          f"unit_ms={unit_ms:.2f} "
-          f"cont_ttft_ms={cont_ttft_u * unit_ms:.1f} "
-          f"static_ttft_ms={static_ttft_u * unit_ms:.1f} "
-          f"bitwise={'ok' if bitwise else 'FAIL'} "
-          f"rerouted={rerouted} "
-          f"verdict="
-          f"{'continuous-beats-static' if good else 'FAIL'}")
+            and cont_ttft_u < static_ttft_u and chaos_ok
+            and all(ok for _, ok, _ in phases))
+    line = (f"SERVING-SUMMARY requests={len(reqs)} tokens={n_tok} "
+            f"cont_work={cont_work} static_work={static_work} "
+            f"speedup={speedup:.2f} "
+            f"cont_tok_s={cont_tps:.1f} static_tok_s={static_tps:.1f} "
+            f"unit_ms={unit_ms:.2f} "
+            f"cont_ttft_ms={cont_ttft_u * unit_ms:.1f} "
+            f"static_ttft_ms={static_ttft_u * unit_ms:.1f} "
+            f"bitwise={'ok' if bitwise else 'FAIL'} "
+            f"rerouted={rerouted} "
+            f"verdict="
+            f"{'continuous-beats-static' if good else 'FAIL'}")
+    print(line)
+    _maybe_bank(args, "serving", line)
+    _maybe_bank(args, "serving_chaos", chaos_line)
+    for kind, _, pline in phases:
+        _maybe_bank(args, kind, pline)
     if not good:
         print(f"FAIL: need speedup >= {args.min_speedup}, lower TTFT, "
               f"bitwise tokens"
               + (", and a drained+re-routed chaos phase"
-                 if args.chaos else ""), file=sys.stderr)
+                 if args.chaos else "")
+              + (", and every phase verdict"
+                 if phases else ""), file=sys.stderr)
         return 1
     return 0
 

@@ -249,10 +249,14 @@ def cmd_slo(args) -> int:
         for cname in ("tm_serving_requests_total",
                       "tm_serving_completed_total",
                       "tm_serving_rerouted_total",
-                      "tm_serving_rejected_total"):
+                      "tm_serving_rejected_total",
+                      "tm_serving_prefill_compiles_total",
+                      "tm_serving_spec_drafted_total",
+                      "tm_serving_spec_accepted_total"):
             v = counters.get((rep, cname))
             if v:
-                extras.append(f"{cname.split('_')[2]}={int(v)}")
+                label = cname[len("tm_serving_"):-len("_total")]
+                extras.append(f"{label}={int(v)}")
         rep_name = rep or "<all>"
         tail = f"  [{' '.join(extras)}]" if extras else ""
         print(f"  {rep_name}: " + " | ".join(parts) + tail)

@@ -594,3 +594,69 @@ def test_beam_parallel_ulysses_matches_dense_beam(hier_runtime):
         ulys, params, prompt, steps=6, beams=4, mesh=mesh, eos_id=2,
         length_penalty=1.0))
     np.testing.assert_array_equal(got, expect)
+
+
+# ---------------------------------------------------------------------------
+# _filter_logits edge cases (the contract every serving sampler builds on)
+# ---------------------------------------------------------------------------
+
+
+def test_filter_logits_edge_cases():
+    """top_k=1 == greedy support, top_p=1.0 keeps everything, temp -> 0
+    sampling == argmax, and the k-then-p composition order is pinned."""
+    from torchmpi_tpu.models.generate import _filter_logits, _sample
+
+    rng = np.random.RandomState(4)
+    logits = jnp.asarray(rng.randn(5, 23).astype(np.float32))
+
+    # top_k=1: exactly the argmax survives each row.
+    f = np.asarray(_filter_logits(logits, 1.0, 1, None))
+    assert (np.isfinite(f).sum(axis=-1) == 1).all()
+    np.testing.assert_array_equal(np.argmax(f, -1),
+                                  np.asarray(jnp.argmax(logits, -1)))
+
+    # top_p=1.0: the exclusive-cumsum nucleus rule (cum - p_i < 1)
+    # keeps every token — a bitwise no-op filter.
+    f = np.asarray(_filter_logits(logits, 1.0, None, 1.0))
+    np.testing.assert_array_equal(f, np.asarray(logits))
+
+    # temperature=0 through _sample: argmax, whatever the filters say
+    # (top-k keeps the max by construction; the temp->0 nucleus
+    # collapses to the top token — which IS the argmax).
+    toks = np.asarray(_sample(logits, jax.random.PRNGKey(0), 0.0, 5,
+                              0.9, jnp.int32))
+    np.testing.assert_array_equal(toks,
+                                  np.asarray(jnp.argmax(logits, -1)))
+
+    # Composition order is k FIRST, then p over the k-renormalized
+    # support — pinned by a row where the other order differs.  Top-2
+    # renormalization gives the max 0.525 mass, so p=0.5 drops the
+    # runner-up; p-first over the full row (max mass 0.335) would have
+    # kept it.
+    row = np.zeros((1, 10), np.float32)
+    row[0, 0], row[0, 1] = 2.0, 1.9
+    f = np.asarray(_filter_logits(jnp.asarray(row), 1.0, 2, 0.5))
+    assert np.isfinite(f[0, 0]) and not np.isfinite(f[0, 1:]).any()
+
+
+def test_filter_logits_rows_matches_static_and_sentinels():
+    """The per-row dynamic filter (one executable for a slot pool
+    mixing greedy and sampled rows) equals the static filter for
+    uniform knobs, and the sentinel row (top_k=0, top_p=2.0) is a
+    bitwise no-op — what keeps serving's greedy tokens identical to the
+    pre-sampling engine."""
+    from torchmpi_tpu.models.generate import _filter_logits, \
+        _filter_logits_rows
+
+    rng = np.random.RandomState(6)
+    logits = jnp.asarray(rng.randn(4, 19).astype(np.float32))
+    got = np.asarray(_filter_logits_rows(
+        logits, jnp.full((4,), 0.8, jnp.float32),
+        jnp.full((4,), 3, jnp.int32), jnp.full((4,), 0.7, jnp.float32)))
+    exp = np.asarray(_filter_logits(logits, 0.8, 3, 0.7))
+    np.testing.assert_array_equal(got, exp)
+
+    noop = np.asarray(_filter_logits_rows(
+        logits, jnp.zeros((4,), jnp.float32),
+        jnp.zeros((4,), jnp.int32), jnp.full((4,), 2.0, jnp.float32)))
+    np.testing.assert_array_equal(noop, np.asarray(logits))

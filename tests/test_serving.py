@@ -383,12 +383,355 @@ def test_slo_metrics_and_obs_tool_slo(lm, tmp_path, capsys):
         assert rc == 0
         assert "TTFT" in out and "inter-token" in out and "p99" in out
         assert "replica0" in out
+        # The prefill-compile counter surfaces in the SLO table too
+        # (admissions here span one distinct prompt length = 1 compile).
+        assert "prefill_compiles" in out
         # And a non-serving dump exits nonzero (CI greps depend on it).
         empty = tmp_path / "empty.jsonl"
         empty.write_text(json.dumps(
             {"kind": "meta", "stream": "metrics", "host": "x"}) + "\n")
         assert tool.main(["slo", str(empty)]) == 2
     finally:
+        mpi.stop()
+
+
+# ---------------------------------------------------------------------------
+# Sampled decode: reproducible, layout-independent, greedy untouched
+# ---------------------------------------------------------------------------
+
+
+def _sampled_reqs(prompts, max_new=6, seed0=100):
+    return [serving.Request(f"p{i}", prompts[i], max_new=max_new,
+                            temperature=0.9, top_k=12, top_p=0.9,
+                            seed=seed0 + i)
+            for i in range(len(prompts))]
+
+
+def test_sampled_decode_reproducible_across_layouts(lm):
+    """Sampling keys token i on fold_in(PRNGKey(seed), i) — never on
+    the slot, pool neighbors, or replica — so the same (seed, prompt)
+    emits the same stream under ANY replica layout."""
+    model, params = lm
+    prompts = _prompts(6, seed=21)
+    streams = []
+    for replicas in (1, 2, 1):
+        reqs = _sampled_reqs(prompts)
+        srv = serving.Server(model, params, replicas=replicas, slots=3,
+                             slot_tokens=32)
+        done = srv.run_trace(reqs, tick_seconds=0.001)
+        assert len(done) == 6
+        streams.append({r.rid: list(r.tokens) for r in reqs})
+    assert streams[0] == streams[1] == streams[2]
+    # And sampling is actually sampling: some stream differs from the
+    # greedy oracle.
+    greedy = {f"p{i}": _offline(model, params, prompts[i], 6).tolist()
+              for i in range(6)}
+    assert any(streams[0][k] != greedy[k] for k in greedy)
+
+
+def test_greedy_ignores_stray_filter_knobs(lm):
+    """temperature <= 0 forces the filter no-op sentinels: a greedy
+    request with leftover top_k/top_p still emits bitwise the
+    unfiltered argmax stream (pre-sampling engine behavior)."""
+    model, params = lm
+    prompts = _prompts(3, seed=23)
+    reqs = [serving.Request(f"g{i}", prompts[i], max_new=6,
+                            temperature=0.0, top_k=3, top_p=0.5,
+                            seed=9) for i in range(3)]
+    srv = serving.Server(model, params, replicas=1, slots=3,
+                         slot_tokens=32)
+    srv.run_trace(reqs, tick_seconds=0.001)
+    for i, req in enumerate(reqs):
+        assert req.tokens == _offline(model, params, prompts[i],
+                                      6).tolist()
+
+
+def test_invalid_sampling_rejected(lm):
+    model, params = lm
+    engine = serving.ReplicaEngine(model, params, slots=1,
+                                   slot_tokens=32)
+    with pytest.raises(serving.RequestRejected, match="top_p"):
+        engine.admit(serving.Request("bad", _prompts(1)[0], max_new=4,
+                                     temperature=0.5, top_p=0.0))
+    with pytest.raises(serving.RequestRejected, match="top_k"):
+        engine.admit(serving.Request("bad", _prompts(1)[0], max_new=4,
+                                     temperature=0.5, top_k=-2))
+    assert engine.pool.free_count == 1  # nothing leaked
+
+
+# ---------------------------------------------------------------------------
+# Speculative decoding: bitwise the plain stream, cheaper per token
+# ---------------------------------------------------------------------------
+
+
+def _run_server(model, params, reqs, **kw):
+    srv = serving.Server(model, params, replicas=1, slots=3,
+                         slot_tokens=32, **kw)
+    done = srv.run_trace(reqs, tick_seconds=0.001)
+    assert len(done) == len(reqs)
+    return srv.router.replicas[0]
+
+
+def test_spec_ngram_bitwise_and_cheaper(lm):
+    """Draft-K/verify-once with the ngram proposer: the stream is
+    bitwise the non-speculative one (greedy AND sampled), and the
+    work-unit bill is strictly lower whenever drafts land (the ngram
+    drafts are free)."""
+    model, params = lm
+    prompts = _prompts(6, seed=31)
+
+    def reqs():
+        out = [serving.Request(f"n{i}", prompts[i], max_new=12)
+               for i in range(4)]
+        out += [serving.Request(f"n{i}", prompts[i], max_new=12,
+                                temperature=0.8, top_k=10, seed=50 + i)
+                for i in range(4, 6)]
+        return out
+
+    plain_reqs, spec_reqs = reqs(), reqs()
+    plain_eng = _run_server(model, params, plain_reqs)
+    spec_eng = _run_server(model, params, spec_reqs, spec_k=4)
+    assert {r.rid: r.tokens for r in plain_reqs} == \
+        {r.rid: r.tokens for r in spec_reqs}
+    assert spec_eng.stats["spec_steps"] > 0
+    assert spec_eng.stats["spec_drafted"] > 0
+    assert 0 < spec_eng.stats["spec_accepted"] <= \
+        spec_eng.stats["spec_drafted"]
+    # Accepted drafts land extra tokens per forward: fewer units total.
+    assert spec_eng.units < plain_eng.units
+
+
+def test_spec_fills_slot_block_exactly(lm):
+    """Regression: the [S, K+1] verify must clamp K when a row is
+    within K positions of its slot block end — an out-of-range cache
+    write CLAMPS its start index and silently corrupts the row.  A
+    request whose prompt+max_new fills the block exactly walks decode
+    into that corner."""
+    model, params = lm
+    prompts = _prompts(2, seed=33)
+    reqs = [serving.Request(f"e{i}", prompts[i], max_new=11)
+            for i in range(2)]  # 5 + 11 == 16 == slot_tokens
+    srv = serving.Server(model, params, replicas=1, slots=2,
+                         slot_tokens=16, spec_k=4)
+    done = srv.run_trace(reqs, tick_seconds=0.001)
+    assert len(done) == 2
+    for i, req in enumerate(reqs):
+        assert req.tokens == _offline(model, params, prompts[i],
+                                      11).tolist()
+
+
+def test_spec_model_draft_bitwise(lm):
+    """A small draft LM proposes over its own pool cache (catch-up
+    protocol included); the stream stays bitwise plain decode, and the
+    per-slot draft state is freed with the sessions."""
+    model, params = lm
+    draft_model = TransformerLM(vocab=VOCAB, embed=16, depth=1,
+                                num_heads=2, head_dim=8, max_len=32,
+                                pos_emb="rope")
+    draft_params = draft_model.init(jax.random.PRNGKey(7),
+                                    jnp.zeros((1, 4),
+                                              jnp.int32))["params"]
+    draft = serving.ModelDraft(draft_model, draft_params)
+    prompts = _prompts(4, seed=37)
+
+    def reqs():
+        out = [serving.Request(f"m{i}", prompts[i], max_new=8)
+               for i in range(2)]
+        out += [serving.Request(f"m{i}", prompts[i], max_new=8,
+                                temperature=0.7, top_p=0.9, seed=60 + i)
+                for i in range(2, 4)]
+        return out
+
+    plain_reqs, spec_reqs = reqs(), reqs()
+    _run_server(model, params, plain_reqs)
+    eng = _run_server(model, params, spec_reqs, spec_k=3, draft=draft)
+    assert {r.rid: r.tokens for r in plain_reqs} == \
+        {r.rid: r.tokens for r in spec_reqs}
+    assert eng.stats["spec_steps"] > 0
+    # Draft forwards are priced by the param ratio, not free.
+    assert 0 < eng._draft.unit_weight < 1
+    assert eng.units > eng.stats["prefills"] + eng.stats["steps"]
+    # Every session retired -> every per-slot draft pointer freed.
+    assert eng._draft.active_slots() == []
+
+
+# ---------------------------------------------------------------------------
+# Bucketed prefill: O(buckets) compiles, streams unchanged
+# ---------------------------------------------------------------------------
+
+
+def test_bucketed_prefill_compile_count_and_bitwise(lm):
+    model, params = lm
+    rng = np.random.RandomState(41)
+    plens = [3, 5, 9, 3, 5, 9]
+    prompts = [rng.randint(0, VOCAB, size=(L,)).astype(np.int32)
+               for L in plens]
+
+    def reqs():
+        return [serving.Request(f"b{i}", prompts[i], max_new=4)
+                for i in range(6)]
+
+    plain_reqs, buck_reqs = reqs(), reqs()
+    plain_eng = _run_server(model, params, plain_reqs)
+    buck_eng = _run_server(model, params, buck_reqs, prefill_bucket=8)
+    # Pre-bucketing the counter already tracks one compile per DISTINCT
+    # prompt length (satellite: the recompile cost is visible before
+    # bucketing is on); bucketing collapses {3,5}->8 and {9}->16.
+    assert plain_eng.stats["prefill_compiles"] == 3
+    assert buck_eng.stats["prefill_compiles"] == 2
+    # Padding never changes tokens: causal attention + the true-length
+    # logit slice make the first token independent of the pad tail.
+    assert {r.rid: r.tokens for r in plain_reqs} == \
+        {r.rid: r.tokens for r in buck_reqs}
+    for i, req in enumerate(plain_reqs):
+        assert req.tokens == _offline(model, params, prompts[i],
+                                      4).tolist()
+
+
+# ---------------------------------------------------------------------------
+# TP-sharded replicas: a mesh slice behind the same serving API
+# ---------------------------------------------------------------------------
+
+
+def test_tp_sharded_server_matches_tp_oracle():
+    """``Server.sharded`` carves disjoint TP meshes per replica; every
+    stream must equal the offline ``tp_generate`` oracle — and spec +
+    bucketed prefill compose with the sharded backend bitwise."""
+    import importlib
+
+    tpg = importlib.import_module("torchmpi_tpu.models.tp_generate")
+    from jax.sharding import Mesh
+
+    V = 64  # divisible by the 2-way model axis
+    tparams = tpg.init_tp_lm(jax.random.PRNGKey(5), vocab=V, embed=32,
+                             depth=2, num_heads=4, head_dim=8)
+    rng = np.random.RandomState(13)
+    prompts = rng.randint(0, V, size=(6, 5)).astype(np.int32)
+    lens = [4, 8, 4, 8, 4, 8]
+    mesh = Mesh(np.asarray(jax.devices()[:2]), ("model",))
+    oracle = {}
+    for i in range(6):
+        out = np.asarray(tpg.tp_generate(
+            tparams, prompts[i].reshape(1, -1), steps=lens[i],
+            mesh=mesh, axis="model", num_heads=4))
+        oracle[f"t{i}"] = out[0, 5:].tolist()
+
+    reqs = [serving.Request(f"t{i}", prompts[i], max_new=lens[i],
+                            arrival_s=0.001 * i) for i in range(6)]
+    srv = serving.Server.sharded(tparams, tp=2, num_heads=4,
+                                 slot_tokens=32, replicas=2, slots=2)
+    done = srv.run_trace(reqs, tick_seconds=0.001)
+    assert len(done) == 6
+    assert {r.replica for r in reqs} == {"tp0", "tp1"}
+    for i, req in enumerate(reqs):
+        assert req.tokens == oracle[req.rid], i
+
+    # Speculation + bucketing over the SAME sharded stack: bitwise.
+    reqs2 = [serving.Request(f"t{i}", prompts[i], max_new=lens[i])
+             for i in range(6)]
+    srv2 = serving.Server.sharded(tparams, tp=2, num_heads=4,
+                                  slot_tokens=32, replicas=1, slots=2,
+                                  spec_k=3, prefill_bucket=8)
+    done2 = srv2.run_trace(reqs2, tick_seconds=0.001)
+    assert len(done2) == 6
+    for req in reqs2:
+        assert req.tokens == oracle[req.rid]
+    eng = srv2.router.replicas[0]
+    assert eng.stats["spec_steps"] > 0
+    assert eng.stats["prefill_compiles"] == 1  # one 8-bucket
+
+    # The planner keys one decision plan per (replica, mesh) topology.
+    from torchmpi_tpu import planner
+
+    p1 = planner.plan_serving_replica("tp0", mesh, ("model",))
+    if p1 is not None:  # planner may be disabled in this session
+        assert p1 is planner.plan_serving_replica("tp0", mesh,
+                                                  ("model",))
+        assert p1.extra["devices"] == 2
+        assert p1.extra["axes"] == ("model",)
+
+
+def test_tp_engine_requires_explicit_slot_tokens():
+    import importlib
+
+    tpg = importlib.import_module("torchmpi_tpu.models.tp_generate")
+    from jax.sharding import Mesh
+
+    from torchmpi_tpu.serving.tp_engine import TPReplicaEngine
+
+    tparams = tpg.init_tp_lm(jax.random.PRNGKey(5), vocab=64, embed=32,
+                             depth=2, num_heads=4, head_dim=8)
+    mesh = Mesh(np.asarray(jax.devices()[:2]), ("model",))
+    with pytest.raises(ValueError, match="slot_tokens"):
+        TPReplicaEngine(tparams, mesh=mesh, num_heads=4, slots=2,
+                        slot_tokens=0)
+
+
+# ---------------------------------------------------------------------------
+# Chaos: a replica killed MID-SPECULATION drains cleanly
+# ---------------------------------------------------------------------------
+
+
+def test_mid_speculation_kill_discards_draft_state(lm, tmp_path):
+    """Satellite: a hard replica kill mid-speculation must drain +
+    re-route with ALL draft state discarded — nothing speculative
+    survives the move, and the re-routed streams stay token-exact
+    because verify only ever emitted target-sampled tokens."""
+    model, params = lm
+    draft_model = TransformerLM(vocab=VOCAB, embed=16, depth=1,
+                                num_heads=2, head_dim=8, max_len=32,
+                                pos_emb="rope")
+    draft_params = draft_model.init(jax.random.PRNGKey(8),
+                                    jnp.zeros((1, 4),
+                                              jnp.int32))["params"]
+    mpi.stop()
+    mpi.init(mpi.Config(dcn_size=1,
+                        faults=_write_kill_plan(tmp_path / "plan.json",
+                                                after=4),
+                        obs="metrics", obs_dir=str(tmp_path / "obs")))
+    try:
+        from torchmpi_tpu import faults, obs
+
+        obs.reset()
+        faults.ledger().clear()
+        prompts = _prompts(8, seed=43)
+        lens = [8, 12, 8, 12, 8, 12, 8, 12]
+        reqs = [serving.Request(f"c{i}", prompts[i], max_new=lens[i],
+                                arrival_s=0.01 * i) for i in range(8)]
+        srv = serving.Server(
+            model, params, replicas=2, slots=3, slot_tokens=32,
+            spec_k=3,
+            draft=serving.ModelDraft(draft_model, draft_params))
+        done = srv.run_trace(reqs, tick_seconds=0.01)
+        assert len(done) == 8
+        dead = [e for e in srv.router.replicas if e.dead]
+        assert len(dead) == 1
+        reg = obs.registry()
+        rerouted = reg.counter_total("tm_serving_rerouted_total")
+        assert rerouted > 0
+        assert sum(r.reroutes for r in reqs) == rerouted
+        # The kill really interrupted speculation on the dead replica…
+        assert dead[0].stats["spec_steps"] > 0
+        # …and its draft state went with it: drained clean.
+        assert dead[0]._draft.active_slots() == []
+        assert dead[0].pool.in_use == 0
+        # The survivor's draft state also fully retired with the trace.
+        live = next(e for e in srv.router.replicas if not e.dead)
+        assert live._draft.active_slots() == []
+        # Token-exact across the re-route, same as the plain chaos path.
+        for i, req in enumerate(reqs):
+            exp = _offline(model, params, prompts[i], lens[i])
+            assert req.tokens == exp.tolist(), (i, req.reroutes)
+        # Speculation telemetry reached the registry.
+        drafted = reg.counter_total("tm_serving_spec_drafted_total")
+        accepted = reg.counter_total("tm_serving_spec_accepted_total")
+        assert drafted > 0 and 0 <= accepted <= drafted
+        assert reg.counter_total(
+            "tm_serving_prefill_compiles_total") > 0
+    finally:
+        from torchmpi_tpu import faults
+
+        faults.reset()
         mpi.stop()
 
 

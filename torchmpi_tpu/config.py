@@ -486,6 +486,21 @@ class Config:
     # replicas the router spreads sessions over).
     # Env: TORCHMPI_TPU_SERVING_REPLICAS.
     serving_replicas: int = 1
+    # Default sampling temperature for requests that don't set their
+    # own (<= 0 = greedy).  Per-request seeds make sampled streams
+    # bitwise-reproducible given (seed, prompt).
+    # Env: TORCHMPI_TPU_SERVING_SAMPLE.
+    serving_sample: float = 0.0
+    # Speculative decoding: draft K tokens per tick and verify them in
+    # one [S, K+1] target forward (0 = off).  Output is bitwise the
+    # non-speculative stream at the same seed; only speed changes.
+    # Env: TORCHMPI_TPU_SERVING_SPEC_K.
+    serving_spec_k: int = 0
+    # Bucketed prefill: right-pad prompts to pow-2 length buckets of at
+    # least this many tokens, so prefill compiles are O(buckets) not
+    # O(distinct lengths) (0 = off; emitted tokens are bitwise
+    # unchanged either way).  Env: TORCHMPI_TPU_SERVING_PREFILL_BUCKETS.
+    serving_prefill_buckets: int = 0
 
     # --- distributed bring-up ----------------------------------------------
     coordinator_address: Optional[str] = None
@@ -575,6 +590,10 @@ class Config:
             serving_slot_tokens=_env_int(
                 "TORCHMPI_TPU_SERVING_SLOT_TOKENS", 0),
             serving_replicas=_env_int("TORCHMPI_TPU_SERVING_REPLICAS", 1),
+            serving_sample=_env_float("TORCHMPI_TPU_SERVING_SAMPLE", 0.0),
+            serving_spec_k=_env_int("TORCHMPI_TPU_SERVING_SPEC_K", 0),
+            serving_prefill_buckets=_env_int(
+                "TORCHMPI_TPU_SERVING_PREFILL_BUCKETS", 0),
             ps_port=_env_int("TORCHMPI_TPU_PS_PORT", 52312),
             ps_host=_env_str("TORCHMPI_TPU_PS_HOST", "127.0.0.1"),
             ps_num_threads=_env_int("TORCHMPI_TPU_PS_THREADS", 2),

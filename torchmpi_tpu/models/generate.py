@@ -85,6 +85,64 @@ def _sample(logits, rng, temperature, top_k, top_p, dtype):
         jnp.argmax(logits, axis=-1)).astype(dtype)
 
 
+def _sample_keys(seeds, idxs):
+    """Per-row sampling keys for the serving path: row i's key is
+    ``fold_in(PRNGKey(seeds[i]), idxs[i])`` where ``idx`` counts the
+    tokens the request has emitted so far.  The key therefore depends
+    only on (request seed, token index) — NOT on the slot the session
+    landed in, the pool shape, or how many times it was re-routed — so
+    a sampled stream is bitwise-reproducible given (seed, prompt) and a
+    re-prefilled session continues exactly where the dead replica left
+    off."""
+    return jax.vmap(lambda s, i: jax.random.fold_in(
+        jax.random.PRNGKey(s), i))(seeds, idxs)
+
+
+def _filter_logits_rows(logits, temps, top_ks, top_ps):
+    """Per-ROW dynamic :func:`_filter_logits`: each row carries its own
+    (temperature, top_k, top_p) as array operands, so ONE compiled
+    executable serves a slot pool mixing greedy and sampled requests.
+
+    Sentinels make the knobs exact no-ops without branching:
+    ``top_k <= 0`` means k = V (the k-th highest logit is the minimum,
+    and the strict ``<`` mask drops nothing), and ``top_p >= 2.0``
+    keeps every sorted entry (cumulative mass never reaches 2), so the
+    nucleus threshold lands on the row minimum.  A greedy row filtered
+    through both sentinels is bitwise the unfiltered row — asserted in
+    tests — which is what keeps the serving path's greedy tokens
+    identical to the pre-sampling engine.  Composition order matches
+    the static filter: k first, then p over the k-filtered support."""
+    V = logits.shape[-1]
+    sorted_desc = jnp.sort(logits, axis=-1)[:, ::-1]
+    k = jnp.where(top_ks <= 0, V, jnp.clip(top_ks, 1, V))     # [R]
+    kth = jnp.take_along_axis(sorted_desc, (k - 1)[:, None], axis=-1)
+    logits = jnp.where(logits < kth, -jnp.inf, logits)
+    sorted_desc = jnp.where(jnp.arange(V)[None, :] < k[:, None],
+                            sorted_desc, -jnp.inf)
+    sp = jax.nn.softmax(
+        sorted_desc / jnp.maximum(temps, 1e-6)[:, None], axis=-1)
+    cum = jnp.cumsum(sp, axis=-1)
+    keep_sorted = (cum - sp) < top_ps[:, None]  # exclusive-cumsum rule
+    thresh = jnp.min(jnp.where(keep_sorted, sorted_desc, jnp.inf),
+                     axis=-1, keepdims=True)
+    return jnp.where(logits >= thresh, logits, -jnp.inf)
+
+
+def _sample_rows(logits, keys, temps, top_ks, top_ps, dtype):
+    """Per-row filtered sampling over [R, V] logits with [R] knob
+    arrays and [R] per-row keys (:func:`_sample_keys`): greedy rows
+    (temp <= 0) take the argmax of the (no-op-filtered) logits, sampled
+    rows a categorical draw at their own temperature.  The serving
+    engines route every emitted token — prefill first-token, [S, 1]
+    decode, [S, K+1] speculative verify — through this one function."""
+    logits = _filter_logits_rows(logits.astype(jnp.float32), temps,
+                                 top_ks, top_ps)
+    drawn = jax.vmap(jax.random.categorical)(
+        keys, logits / jnp.maximum(temps, 1e-6)[:, None])
+    return jnp.where(temps > 0.0, drawn,
+                     jnp.argmax(logits, axis=-1)).astype(dtype)
+
+
 def _generate_scan(model, params, prompt, steps, temperature, rng,
                    top_k=None, top_p=None, eos_id=None):
     """Single-forward prefill + scanned decode: traceable anywhere a
@@ -453,62 +511,148 @@ def generate_parallel(model, params, prompt, steps: int, *, mesh,
 
 # ---------------------------------------------------------------------------
 # Slot-indexed cache plumbing (the continuous-batching serving path,
-# torchmpi_tpu/serving/ — docs/SERVING.md).  Three primitives over a
+# torchmpi_tpu/serving/ — docs/SERVING.md).  Four primitives over a
 # POOL cache whose batch dimension is the slot dimension:
 #
 # - :func:`slot_prefill`    — one request's prompt onto a FRESH [1, L]
 #   cache (the same single-forward prefill + last-position sampling as
 #   :func:`_generate_scan`, so tokens can never diverge from ``generate``);
+#   with ``true_len`` the prompt may be right-PADDED to a length bucket
+#   — the logits are sliced at the true last position, so padded and
+#   unpadded prefill emit bitwise-identical tokens while the compile
+#   count drops from O(distinct lengths) to O(buckets);
 # - :func:`slot_write`      — copy that request's cache rows into pool
 #   row ``slot`` (admission);
 # - :func:`slot_decode_step` — ONE [S, 1] decode tick advancing every
 #   active slot at its own depth (per-row ``pos_offset`` — see
 #   ``SPAttention``); rows beyond a slot's filled prefix are masked, so
 #   REUSING a retired slot needs no zeroing to stay bit-identical to a
-#   fresh static-batch decode.
+#   fresh static-batch decode;
+# - :func:`slot_verify_step` — the speculative-decoding verify: ONE
+#   [S, K+1] forward scoring each slot's pending token plus its K draft
+#   tokens at per-row depths, returning what the model samples at EVERY
+#   position — the accept/reject scan over those samples is host-side
+#   (serving/engine.py) and distribution-exact by construction.
 #
-# Greedy only: iteration-level scheduling re-prefills a re-routed
-# request from its emitted prefix, which is only token-exact when
-# decoding is deterministic.
+# Sampling: each primitive takes a ``sampling`` operand tuple
+# ``(seeds, idxs, temps, top_ks, top_ps)`` ([R] arrays) routed through
+# :func:`_sample_rows` — greedy rows use the no-op sentinels (temp 0,
+# top_k 0, top_p 2.0) and stay bitwise-deterministic, which is what
+# keeps re-routing token-exact: a re-prefilled session re-derives the
+# same per-token keys from (seed, token index).
 # ---------------------------------------------------------------------------
 
 
+def _greedy_sampling(n):
+    """Sentinel sampling arrays for n rows: greedy, filter no-ops."""
+    return (jnp.zeros((n,), jnp.uint32), jnp.zeros((n,), jnp.int32),
+            jnp.zeros((n,), jnp.float32), jnp.zeros((n,), jnp.int32),
+            jnp.full((n,), 2.0, jnp.float32))
+
+
 @partial(jax.jit, static_argnums=(0,))
-def _slot_prefill_jit(dmodel, params, prompt):
+def _slot_prefill_jit(dmodel, params, prompt, true_len, seeds, idxs,
+                      temps, top_ks, top_ps):
     (xs, head), updated = dmodel.apply(
         {"params": params}, prompt, pos_offset=0, return_prehead=True,
         mutable=["cache"])
-    first = _sample(xs[:, -1] @ head, jax.random.PRNGKey(0),
-                    jnp.float32(0.0), None, None, prompt.dtype)
+    # The TRUE last position, not -1: with bucketed prefill the prompt
+    # is right-padded, and the pad positions' logits must never be
+    # sampled.  (Causality makes the real positions' activations
+    # independent of the padding, so the sliced logits are bitwise the
+    # unpadded ones; the pad positions' k/v land in the cache but every
+    # later query is depth-masked below them until the decode steps
+    # overwrite them in order.)
+    x_last = lax.dynamic_slice_in_dim(xs, true_len - 1, 1, axis=1)[:, 0]
+    first = _sample_rows(x_last @ head, _sample_keys(seeds, idxs),
+                         temps, top_ks, top_ps, prompt.dtype)
     return updated["cache"], first
 
 
-def slot_prefill(dmodel, params, prompt):
-    """Prefill one request ([1, Tp] prompt) on a fresh cache; returns
-    ``(cache, first_token [1])``.  ``dmodel`` is the ``decode=True``
-    clone (one jit specialization per prompt length)."""
-    return _slot_prefill_jit(dmodel, params, jnp.asarray(prompt))
+def slot_prefill(dmodel, params, prompt, *, true_len=None,
+                 sampling=None):
+    """Prefill one request ([1, Tp] prompt, possibly right-padded to a
+    length bucket) on a fresh cache; returns ``(cache, first_token
+    [1])``.  ``dmodel`` is the ``decode=True`` clone (one jit
+    specialization per PADDED prompt length — ``true_len`` is a traced
+    operand, so every length in a bucket shares the executable).
+    ``sampling`` is the 5-tuple of [1] arrays; None means greedy."""
+    prompt = jnp.asarray(prompt)
+    if true_len is None:
+        true_len = prompt.shape[1]
+    if sampling is None:
+        sampling = _greedy_sampling(prompt.shape[0])
+    return _slot_prefill_jit(dmodel, params, prompt,
+                             jnp.asarray(true_len, jnp.int32), *sampling)
 
 
 @partial(jax.jit, static_argnums=(0,))
-def _slot_step_jit(dmodel, params, cache, tokens, positions):
+def _slot_step_jit(dmodel, params, cache, tokens, positions, seeds,
+                   idxs, temps, top_ks, top_ps):
     logits, updated = dmodel.apply(
         {"params": params, "cache": cache}, tokens[:, None],
         pos_offset=positions, mutable=["cache"])
-    nxt = _sample(logits[:, 0], jax.random.PRNGKey(0), jnp.float32(0.0),
-                  None, None, tokens.dtype)
+    nxt = _sample_rows(logits[:, 0], _sample_keys(seeds, idxs), temps,
+                       top_ks, top_ps, tokens.dtype)
     return updated["cache"], nxt
 
 
-def slot_decode_step(dmodel, params, cache, tokens, positions):
+def slot_decode_step(dmodel, params, cache, tokens, positions,
+                     sampling=None):
     """One decode tick over the whole slot pool: ``tokens`` [S] are each
     slot's pending token, ``positions`` [S] its absolute write index
     (inactive slots pass any valid filler — their outputs are ignored
     and their cache rows are fully overwritten on the next admission).
     Returns ``(new_cache, next_tokens [S])``.  One compiled executable
-    serves the entire trace — admission and retirement never retrace."""
-    return _slot_step_jit(dmodel, params, cache,
-                          jnp.asarray(tokens), jnp.asarray(positions))
+    serves the entire trace — admission, retirement, and greedy/sampled
+    mixes never retrace (the sampling knobs are [S] operands)."""
+    tokens = jnp.asarray(tokens)
+    if sampling is None:
+        sampling = _greedy_sampling(tokens.shape[0])
+    return _slot_step_jit(dmodel, params, cache, tokens,
+                          jnp.asarray(positions), *sampling)
+
+
+@partial(jax.jit, static_argnums=(0,))
+def _slot_verify_jit(dmodel, params, cache, tokens, positions, seeds,
+                     idxs, temps, top_ks, top_ps):
+    logits, updated = dmodel.apply(
+        {"params": params, "cache": cache}, tokens,
+        pos_offset=positions, mutable=["cache"])
+    S, T, V = logits.shape
+    # Position j of row s samples with key (seed_s, idx_s + j): exactly
+    # the key the NON-speculative path would use for that token index,
+    # which is what makes accept-until-mismatch emit a bitwise-identical
+    # stream (each kept sample conditions on an accepted prefix, i.e.
+    # the same context the sequential path would have fed).
+    keys = _sample_keys(
+        jnp.repeat(seeds, T),
+        (idxs[:, None] + jnp.arange(T, dtype=jnp.int32)).reshape(-1))
+    flat = _sample_rows(logits.reshape(S * T, V), keys,
+                        jnp.repeat(temps, T), jnp.repeat(top_ks, T),
+                        jnp.repeat(top_ps, T), tokens.dtype)
+    return updated["cache"], flat.reshape(S, T)
+
+
+def slot_verify_step(dmodel, params, cache, tokens, positions,
+                     sampling=None):
+    """The speculative-decoding verify forward: ``tokens`` [S, K+1] is
+    each slot's pending token followed by its K draft tokens,
+    ``positions`` [S] each slot's write index.  One forward writes all
+    K+1 k/v entries at per-row depths and returns the model's sample at
+    EVERY position ([S, K+1]) — sample j is the token the sequential
+    decode would emit after the fed prefix ``tokens[:, :j+1]``, so the
+    host-side scan "accept while draft matches, then take the model's
+    corrected token" reproduces non-speculative decoding bit for bit.
+    Rejected positions leave stale k/v behind; the next forward for
+    that row starts at its accepted depth and re-writes them before any
+    query can attend (same-forward cache update precedes attention),
+    so no masking bookkeeping is needed."""
+    tokens = jnp.asarray(tokens)
+    if sampling is None:
+        sampling = _greedy_sampling(tokens.shape[0])
+    return _slot_verify_jit(dmodel, params, cache, tokens,
+                            jnp.asarray(positions), *sampling)
 
 
 @jax.jit

@@ -33,7 +33,7 @@ from jax import lax
 
 from ..parallel import tensor as tp
 from .generate import _beam_backtrack, _beam_expand, _check_sampling, \
-    _sample
+    _greedy_sampling, _sample, _sample_keys, _sample_rows
 from .transformer import apply_rope
 
 
@@ -170,6 +170,39 @@ def _block_decode(x, p, cache, pos, axis, num_heads):
     probs = jax.nn.softmax(scores.astype(jnp.float32),
                            axis=-1).astype(x.dtype)
     ctx = jnp.einsum("bhts,bshd->bthd", probs, cv).reshape(B, 1, width)
+    x = x + tp.row_parallel_dense(ctx, p["wo"], axis)
+    m = tp.tp_mlp(_ln(x, *p["ln2"]), p["w1"], p["w2"], axis,
+                  act=jax.nn.gelu)
+    return x + m, (ck, cv)
+
+
+def _block_decode_rows(x, p, cache, pos_rows, axis, num_heads):
+    """Per-ROW decode over the slot pool: x [S, T, D] — row ``s`` writes
+    its T tokens' head-local k/v at ``pos_rows[s] .. pos_rows[s]+T-1``
+    (each slot at its OWN cache depth) and attends its own causal
+    prefix.  T == 1 is the continuous-batching tick; T == K+1 is the
+    speculative verify.  The mirror of the dense per-row ``pos_offset``
+    path in ``transformer.SPAttention`` with head-local caches."""
+    ck, cv = cache
+    S, T, _ = x.shape
+    t_max = ck.shape[1]
+    h = _ln(x, *p["ln1"])
+    q_pos = pos_rows[:, None] + jnp.arange(T, dtype=jnp.int32)  # [S, T]
+    q, k1, v1, width, dh = _qkv_local(h, p, axis, num_heads, q_pos)
+    row_upd = jax.vmap(
+        lambda c, u, s: lax.dynamic_update_slice(c, u, (s, 0, 0)))
+    ck = row_upd(ck, k1, pos_rows)
+    cv = row_upd(cv, v1, pos_rows)
+    scores = jnp.einsum("bthd,bshd->bhts", q, ck) / np.sqrt(dh)
+    # [S, 1, T, t_max]: query t of row s sees cache entries <= its own
+    # absolute position — stale rows from retired slots mask out, so
+    # slot reuse needs no zeroing (same invariant as the dense pool).
+    valid = (jnp.arange(t_max)[None, None, :]
+             <= q_pos[:, :, None])[:, None]
+    scores = jnp.where(valid, scores, jnp.finfo(scores.dtype).min)
+    probs = jax.nn.softmax(scores.astype(jnp.float32),
+                           axis=-1).astype(x.dtype)
+    ctx = jnp.einsum("bhts,bshd->bthd", probs, cv).reshape(S, T, width)
     x = x + tp.row_parallel_dense(ctx, p["wo"], axis)
     m = tp.tp_mlp(_ln(x, *p["ln2"]), p["w1"], p["w2"], axis,
                   act=jax.nn.gelu)
@@ -353,6 +386,126 @@ def _tp_fn(mesh, axis, num_heads, steps, depth, top_k, top_p, eos_id):
         out_specs=P(), check_vma=False))
 
 
+# ---------------------------------------------------------------------------
+# Slot-pooled TP primitives — the tensor-parallel mirror of the
+# ``generate.slot_prefill`` / ``slot_decode_step`` / ``slot_verify_step``
+# trio, so a Router replica can be a whole TP mesh slice
+# (serving/tp_engine.py) instead of one device.  The pool cache is a
+# list (one per block) of head-local ``(k, v)`` pairs
+# ``[S, t_max, H, dh]`` sharded ``P(None, None, axis, None)``: slots
+# replicate, heads shard 1/n, so KV memory scales with the axis exactly
+# like static TP decode.  Admission reuses the dense
+# ``generate.slot_write`` — a batch-dim dynamic_update_slice GSPMD
+# keeps local.  Sampling flows through the SAME ``_sample_rows`` /
+# ``_sample_keys`` as the dense pool (replicated math inside shard_map,
+# identical keys), which is what makes a dense replica and a TP replica
+# emit bitwise-identical streams for the same (seed, prompt).
+# ---------------------------------------------------------------------------
+
+
+def _tp_slot_prefill_body(params, prompt, true_len, seeds, idxs, temps,
+                          top_ks, top_ps, *, axis, num_heads, t_max):
+    x = params["embed"][prompt]                  # [1, Tp, D] replicated
+    caches = []
+    for p in params["blocks"]:
+        x, cache = _block_prefill(x, p, axis, num_heads, t_max)
+        caches.append(cache)
+    # Slice at the TRUE last position (bucketed prefill right-pads the
+    # prompt; causality keeps real positions bitwise independent of the
+    # padding — see generate.slot_prefill).
+    x_true = lax.dynamic_slice_in_dim(x, true_len - 1, 1, axis=1)[:, 0]
+    first = _sample_rows(
+        _logits(_ln(x_true, *params["ln_f"]), params, axis),
+        _sample_keys(seeds, idxs), temps, top_ks, top_ps, prompt.dtype)
+    return caches, first
+
+
+def _tp_slot_step_body(params, caches, tokens, positions, seeds, idxs,
+                       temps, top_ks, top_ps, *, axis, num_heads):
+    S, T = tokens.shape
+    x = params["embed"][tokens]
+    new_caches = []
+    for p, cache in zip(params["blocks"], caches):
+        x, cache = _block_decode_rows(x, p, cache, positions, axis,
+                                      num_heads)
+        new_caches.append(cache)
+    logits = _logits(_ln(x, *params["ln_f"]).reshape(S * T, -1),
+                     params, axis)
+    # Position j of row s keys on idx_s + j — the verify-step key
+    # schedule (generate.slot_verify_step); T == 1 degenerates to the
+    # plain per-token key.
+    keys = _sample_keys(
+        jnp.repeat(seeds, T),
+        (idxs[:, None] + jnp.arange(T, dtype=jnp.int32)).reshape(-1))
+    flat = _sample_rows(logits, keys, jnp.repeat(temps, T),
+                        jnp.repeat(top_ks, T), jnp.repeat(top_ps, T),
+                        tokens.dtype)
+    return new_caches, flat.reshape(S, T)
+
+
+def _tp_cache_specs(depth, axis):
+    from jax.sharding import PartitionSpec as P
+
+    return [(P(None, None, axis, None),) * 2 for _ in range(depth)]
+
+
+@lru_cache(maxsize=None)
+def _tp_slot_prefill_fn(mesh, axis, num_heads, depth, t_max):
+    from jax.sharding import PartitionSpec as P
+
+    body = partial(_tp_slot_prefill_body, axis=axis,
+                   num_heads=num_heads, t_max=t_max)
+    return jax.jit(jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(_tp_specs(depth, axis),) + (P(),) * 7,
+        out_specs=(_tp_cache_specs(depth, axis), P()),
+        check_vma=False))
+
+
+@lru_cache(maxsize=None)
+def _tp_slot_step_fn(mesh, axis, num_heads, depth):
+    from jax.sharding import PartitionSpec as P
+
+    body = partial(_tp_slot_step_body, axis=axis, num_heads=num_heads)
+    cs = _tp_cache_specs(depth, axis)
+    return jax.jit(jax.shard_map(
+        body, mesh=mesh, in_specs=(_tp_specs(depth, axis), cs) +
+        (P(),) * 7,
+        out_specs=(cs, P()), check_vma=False))
+
+
+def tp_slot_prefill(params, prompt, *, mesh, axis, num_heads, t_max,
+                    true_len=None, sampling=None):
+    """Prefill one request on a fresh head-local cache padded to
+    ``t_max`` (the slot block).  ``params`` must already be placed by
+    :func:`shard_tp_lm` on ``mesh``.  Returns ``(cache, first [1])`` —
+    cache is the per-block list of sharded ``(k, v)`` pairs ready for
+    ``generate.slot_write`` into the pool."""
+    prompt = jnp.asarray(prompt)
+    if true_len is None:
+        true_len = prompt.shape[1]
+    if sampling is None:
+        sampling = _greedy_sampling(prompt.shape[0])
+    fn = _tp_slot_prefill_fn(mesh, axis, num_heads,
+                             len(params["blocks"]), int(t_max))
+    return fn(params, prompt, jnp.asarray(true_len, jnp.int32),
+              *sampling)
+
+
+def tp_slot_decode(params, cache, tokens, positions, *, mesh, axis,
+                   num_heads, sampling=None):
+    """One pooled decode/verify forward over the TP mesh: ``tokens``
+    [S, T] (T = 1 for the continuous-batching tick, K+1 for the
+    speculative verify), ``positions`` [S] per-slot write depths.
+    Returns ``(new_cache, samples [S, T])`` — one compiled executable
+    per T serves the whole trace."""
+    tokens = jnp.asarray(tokens)
+    if sampling is None:
+        sampling = _greedy_sampling(tokens.shape[0])
+    fn = _tp_slot_step_fn(mesh, axis, num_heads, len(params["blocks"]))
+    return fn(params, cache, tokens, jnp.asarray(positions), *sampling)
+
+
 def clear_serving_caches():
     """Drop every cached compiled serving executable across the serving
     modules (``_tp_fn``/``_tp_beam_fn`` here, ``pp_generate._pp_fn``,
@@ -372,6 +525,8 @@ def clear_serving_caches():
 
     _tp_fn.cache_clear()
     _tp_beam_fn.cache_clear()
+    _tp_slot_prefill_fn.cache_clear()
+    _tp_slot_step_fn.cache_clear()
     _pp._pp_fn.cache_clear()
     _g._parallel_fn.cache_clear()
     _g._beam_parallel_fn.cache_clear()

@@ -172,11 +172,6 @@ class SPAttention(nn.Module):
             # — the slot engine owns per-row positions.
             po = jnp.asarray(pos_offset)
             per_row = po.ndim == 1
-            if per_row and T != 1:
-                raise ValueError(
-                    "per-row pos_offset (slot-indexed decode) supports "
-                    "T == 1 steps only; prefill each request on its own "
-                    "fresh cache first")
             ck = self.variable("cache", "k", jnp.zeros,
                                (B, self.max_len, h_cache, D), jnp.float32)
             cv = self.variable("cache", "v", jnp.zeros,
@@ -205,14 +200,19 @@ class SPAttention(nn.Module):
                 cv.value = lax.dynamic_update_slice(cv.value, v,
                                                     (0, start, 0, 0))
                 idx.value = start + T
-            if T > 1:
+            if T > 1 and not per_row:
                 # Prefill block (generate's one full-prompt pass onto a
                 # FRESH cache): causal attention within the block —
                 # O(T^2), not O(T * max_len) against the mostly-empty
                 # cache (at max_len 8k and Tp 256 that's 32x wasted score
                 # FLOPs/memory).  Assumes start == 0, which is the only
-                # way the serving path produces T > 1; chunked prefill
-                # with history would need the cache-prefix form.
+                # way the scalar-offset serving path produces T > 1;
+                # chunked prefill with history would need the
+                # cache-prefix form.  Per-row T > 1 (the speculative
+                # verify step: [S, K+1] tokens at per-slot depths) takes
+                # the cache-masked branch below instead — its k/v were
+                # just written at rows' own offsets, and the per-row
+                # causal mask bounds each query at its own depth.
                 o = seqlib.reference_attention(q, k, v, causal=True,
                                                window=self.window)
             else:

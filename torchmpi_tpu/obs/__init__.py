@@ -576,7 +576,12 @@ def record_serving(event: str, n: int = 1, *, replica: str = "") -> None:
     ``requests`` (admitted) | ``completed`` | ``tokens`` (emitted) |
     ``rerouted`` (sessions moved off a dead replica) | ``rejected``
     (unservable request refused at admission) | ``readmitted`` (a
-    healed replica returned to the dispatch rotation) — counter
+    healed replica returned to the dispatch rotation) |
+    ``prefill_compiles`` (a prompt length the engine had not prefilled
+    before — one new XLA specialization; O(buckets) with bucketed
+    prefill, O(distinct lengths) without) | ``spec_drafted`` /
+    ``spec_accepted`` (speculative-decode draft tokens proposed /
+    accepted — the live acceptance rate) — counter
     ``tm_serving_<event>_total`` labeled by replica.  Re-routes also
     land in the flight ring, so a post-mortem sees the replica death
     next to the collectives (or faults) that preceded it."""

@@ -829,3 +829,40 @@ def flat_spec_for(tree, n_shards: int) -> fusion.FusedSpec:
                               extra={"n_shards": int(n_shards)})
 
     return _get_or_build(key, build).spec
+
+
+# ---------------------------------------------------------------------------
+# Mesh-parallel serving replicas (torchmpi_tpu/serving/tp_engine.py)
+# ---------------------------------------------------------------------------
+
+
+def plan_serving_replica(replica: str, mesh, axes: Tuple[str, ...],
+                         *, op: str = "tp_decode"
+                         ) -> Optional[CollectivePlan]:
+    """Decision-only plan row for one mesh-parallel serving replica:
+    keyed per replica MESH via the topology fingerprint, so two
+    replicas carved from different device slices — or the same replica
+    after an elastic resize — read as distinct per-topology decisions
+    in ``plan_tool.py dump-live`` instead of an opaque engine
+    attribute.  The row records the sharded-decode dispatch choice
+    (``shard_map`` over ``axes``); the engine's compiled executables
+    key on the same (mesh, axis) tuple, so plan row and executable can
+    never describe different topologies."""
+    if not _enabled:
+        return None
+    key = ("serving", replica, mesh, tuple(axes), op, _epoch())
+
+    def build():
+        eff = runtime.effective_config()
+        try:
+            sizes = tuple(int(mesh.shape[a]) for a in axes)
+        except Exception:  # noqa: BLE001 — a label must never fail a plan
+            sizes = None
+        return CollectivePlan(
+            key, "serving", op, backend="shard_map",
+            obs=eff.obs != "off",
+            topology=topology_of(mesh, sizes),
+            extra={"replica": replica, "axes": tuple(axes),
+                   "devices": int(np.prod(mesh.devices.shape))})
+
+    return _get_or_build(key, build)

@@ -6,11 +6,21 @@ requests into the running decode batch at token boundaries and retires
 finished sequences immediately; a paged KV slot pool (:mod:`.slots`)
 bounds cache memory at ``slots x block`` instead of ``batch x
 max_len``; a health-routed multi-replica router (:mod:`.router`)
-spreads sessions over data-parallel replicas and drains + re-routes a
-dead replica's in-flight sessions instead of crashing the server; and
-per-request SLO telemetry (TTFT / inter-token latency histograms,
-queue-depth and slot-occupancy gauges) rides the obs registry as
-``tm_serving_*`` when telemetry is on.
+spreads sessions over replicas — single-device dense engines
+(:mod:`.engine`) or whole TP mesh slices (:mod:`.tp_engine` /
+``Server.sharded``) — and drains + re-routes a dead replica's
+in-flight sessions instead of crashing the server; and per-request SLO
+telemetry (TTFT / inter-token latency histograms, queue-depth and
+slot-occupancy gauges) rides the obs registry as ``tm_serving_*`` when
+telemetry is on.
+
+Decode is per-request greedy OR sampled (temperature / top-k / top-p /
+seed on each :class:`Request`), bitwise-reproducible given (seed,
+prompt) — which is also what keeps re-routing token-exact.  Prefill
+optionally pads to pow-2 length buckets (compiles O(buckets), streams
+unchanged), and speculative decoding (:mod:`.spec`: ngram prompt-lookup
+or a small draft LM) lands up to K+1 tokens per target forward while
+staying bitwise-identical to the non-speculative stream.
 
 Off by default and **never imported unless used** — the analysis/obs/
 faults discipline: nothing in the library imports this package; a
@@ -21,14 +31,15 @@ session that never serves pays zero import cost
 
     server = serving.Server(model, params, replicas=2, slots=8)
     results = server.run_trace([
-        serving.Request("r0", prompt, max_new=32, arrival_s=0.0),
+        serving.Request("r0", prompt, max_new=32, arrival_s=0.0,
+                        temperature=0.8, top_k=40, seed=7),
         ...
     ])
 
-``benchmarks/serving_bench.py`` measures the continuous-vs-static win
-on a synthetic Poisson trace; the emitted tokens stay bit-identical per
-request to the offline ``models.generate.generate`` path (greedy-only,
-which is also what makes re-routing token-exact).
+``benchmarks/serving_bench.py`` measures the continuous-vs-static,
+TP-sharded, sampled, bucketed-prefill and speculative wins on a
+synthetic Poisson trace; greedy tokens stay bit-identical per request
+to the offline ``models.generate.generate`` path.
 """
 
 from __future__ import annotations
@@ -37,6 +48,9 @@ from .engine import ReplicaEngine, RequestRejected, Session  # noqa: F401
 from .router import Router  # noqa: F401
 from .scheduler import Request, Server  # noqa: F401
 from .slots import SlotPool  # noqa: F401
+from .spec import ModelDraft, NgramDraft  # noqa: F401
+from .tp_engine import TPReplicaEngine  # noqa: F401
 
-__all__ = ["ReplicaEngine", "Request", "RequestRejected", "Router",
-           "Server", "Session", "SlotPool"]
+__all__ = ["ModelDraft", "NgramDraft", "ReplicaEngine", "Request",
+           "RequestRejected", "Router", "Server", "Session", "SlotPool",
+           "TPReplicaEngine"]

@@ -7,24 +7,48 @@ iteration-level operations the scheduler composes:
 - :meth:`admit` — allocate a slot, prefill the request's prompt onto a
   fresh cache, write it into the pool row, emit the FIRST token (the
   TTFT event).  Admission happens at token boundaries: no batch
-  formation, no waiting for peers.
+  formation, no waiting for peers.  With ``prefill_bucket`` set the
+  prompt is right-padded to a pow-2 length bucket, so the prefill
+  executable count is O(buckets) instead of O(distinct lengths) — the
+  emitted token is bitwise the unpadded one (the logits are sliced at
+  the true last position; causality keeps it independent of padding).
+  New executables are counted (``stats["prefill_compiles"]`` +
+  ``tm_serving_prefill_compiles_total``) on the bucketed AND unbucketed
+  paths, so the recompile cost is visible either way.
 - :meth:`step` — ONE ``[S, 1]`` decode tick advancing every in-flight
   slot at its own cache depth (``models.generate.slot_decode_step``);
   sequences that emit EOS or reach their token budget retire
-  immediately and their slot frees for the next admission.
+  immediately and their slot frees for the next admission.  With
+  ``spec_k`` > 0 the tick becomes draft-then-verify: a
+  :mod:`.spec` proposer drafts K tokens per slot, ONE ``[S, K+1]``
+  target forward (``slot_verify_step``) scores them all, and the
+  accept loop emits tokens exactly while drafts match — the stream is
+  **bitwise-identical** to the non-speculative tick at the same seed,
+  it just lands up to K+1 tokens per forward.
 
-Greedy decoding only (see ``models/generate.py``: re-routing a session
-after a replica death re-prefills from its emitted prefix, which is
-only token-exact when decoding is deterministic).
+Sampling is per-request (temperature / top-k / top-p / seed, resolved
+against the Config defaults at admission) and bitwise-reproducible
+given (seed, prompt): token ``i`` of a request draws from
+``fold_in(PRNGKey(seed), i)`` regardless of slot, pool neighbors, or
+re-routes — which is also what keeps a drained session token-exact
+when it re-prefills elsewhere (greedy OR sampled).
 
-The engine is time-free and telemetry-free on purpose: the scheduler
-owns the clock, the SLO histograms, and the fault hooks, so the engine
-stays a pure slot/cache mechanism that tests can drive tick by tick.
+Work accounting: ``stats`` counts executable invocations and
+``units`` accumulates work units (prefill = 1, pooled forward = 1,
+draft forwards at the proposer's ``unit_weight``) — the noise-immune
+clock ``benchmarks/serving_bench.py`` compares schedules on.
+
+The engine is time-free and telemetry-free on purpose (the one
+exception: the prefill-compile counter above, which is a property of
+the engine's own jit keying): the scheduler owns the clock, the SLO
+histograms, and the fault hooks, so the engine stays a pure slot/cache
+mechanism that tests can drive tick by tick.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import sys
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -32,16 +56,27 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import runtime
-from ..models.generate import slot_decode_step, slot_prefill, slot_write
+from ..models.generate import slot_decode_step, slot_prefill, \
+    slot_verify_step, slot_write
 from .slots import SlotPool
 
 
 class RequestRejected(ValueError):
     """Raised by :meth:`ReplicaEngine.admit` for a request that can
-    NEVER be served (its ``prompt + max_new`` exceeds the slot block).
-    A dedicated type so the scheduler can reject exactly this case and
-    keep serving — any other exception out of admission is a real bug
-    and stays loud."""
+    NEVER be served (its ``prompt + max_new`` exceeds the slot block,
+    or its sampling knobs are invalid).  A dedicated type so the
+    scheduler can reject exactly this case and keep serving — any
+    other exception out of admission is a real bug and stays loud."""
+
+
+def _obs():
+    mod = sys.modules.get("torchmpi_tpu.obs")
+    try:
+        if mod is not None and mod.active():
+            return mod
+    except Exception:  # noqa: BLE001 — telemetry never fails a tick
+        pass
+    return None
 
 
 @dataclasses.dataclass
@@ -53,23 +88,35 @@ class Session:
     last_tok: int           # pending token (input of the next step)
     pos_next: int           # absolute cache index the next step writes
     emitted: List[int] = dataclasses.field(default_factory=list)
+    #: Resolved (temperature, top_k, top_p, seed); greedy rows carry
+    #: the filter no-op sentinels (0.0, 0, 2.0).
+    sampling: Tuple[float, int, float, int] = (0.0, 0, 2.0, 0)
+    #: Tokens emitted by the LAST tick that advanced this session (1
+    #: for admit/plain step, up to K+1 for a speculative tick) — the
+    #: scheduler's token/ITL accounting reads it.
+    last_emit: int = 1
 
 
 class ReplicaEngine:
     """Slot-pooled decode engine for one model replica.
 
-    ``slots``/``slot_tokens`` default from the active
-    :class:`~torchmpi_tpu.config.Config` (``serving_slots`` /
-    ``serving_slot_tokens``; 0 slot tokens = the model's ``max_len``).
-    With ``device`` set, params and the pool cache are committed to that
-    device, so replicas of one host spread over its chips exactly like
+    ``slots``/``slot_tokens``/``sample``/``prefill_bucket``/``spec_k``
+    default from the active :class:`~torchmpi_tpu.config.Config`
+    (``serving_slots`` / ``serving_slot_tokens`` / ``serving_sample`` /
+    ``serving_prefill_buckets`` / ``serving_spec_k``).  ``draft`` is a
+    :mod:`.spec` proposer template (bound per engine); ``spec_k`` > 0
+    with no draft binds an :class:`~.spec.NgramDraft`.  With ``device``
+    set, params and the pool cache are committed to that device, so
+    replicas of one host spread over its chips exactly like
     data-parallel shards.
     """
 
     def __init__(self, model, params, *, name: str = "replica0",
                  slots: Optional[int] = None,
                  slot_tokens: Optional[int] = None,
-                 device=None):
+                 device=None, sample: Optional[float] = None,
+                 prefill_bucket: Optional[int] = None,
+                 spec_k: Optional[int] = None, draft=None):
         cfg = runtime.effective_config()
         slots = int(slots if slots is not None else cfg.serving_slots)
         st = int(slot_tokens if slot_tokens is not None
@@ -86,22 +133,19 @@ class ReplicaEngine:
         if getattr(model, "moe_axis", None) is not None or \
                 getattr(model, "seq_axis", None) is not None:
             raise ValueError(
-                "ReplicaEngine serves dense single-device models; "
-                "mesh-parallel decode stays on generate_parallel/"
-                "tp_generate (static batch)")
-        self.name = name
-        self.pool = SlotPool(slots, st)
+                "ReplicaEngine serves dense single-device models; use "
+                "serving.TPReplicaEngine (or Server.sharded) for a "
+                "mesh-parallel replica")
         self.dmodel = model.clone(decode=True, max_len=st)
         self.params = (jax.device_put(params, device)
                        if device is not None else params)
         self._device = device
-        self.dead = False
-        self._sessions: Dict[int, Session] = {}
-        #: Executable-invocation counters (one prefill = one admit, one
-        #: step = one [S, 1] tick) — the work-unit accounting
-        #: benchmarks/serving_bench.py builds its noise-immune
-        #: continuous-vs-static comparison on.
-        self.stats = {"prefills": 0, "steps": 0}
+        self.vocab = int(model.vocab)
+        self.param_count = sum(int(np.prod(p.shape))
+                               for p in jax.tree.leaves(params))
+        self._init_serving(cfg, name, slots, st, sample=sample,
+                           prefill_bucket=prefill_bucket, spec_k=spec_k,
+                           draft=draft)
         # Zero pool cache from the decode model's cache spec — no
         # forward pass runs at construction.
         shapes = jax.eval_shape(
@@ -112,6 +156,44 @@ class ReplicaEngine:
                              shapes)
         self._cache = (jax.device_put(cache, device)
                        if device is not None else cache)
+
+    def _init_serving(self, cfg, name, slots, st, *, sample,
+                      prefill_bucket, spec_k, draft):
+        """Backend-independent serving state (shared with the
+        mesh-parallel subclass, which does NOT run the dense
+        ``__init__``)."""
+        self.name = name
+        self.pool = SlotPool(slots, st)
+        self.dead = False
+        self._sessions: Dict[int, Session] = {}
+        self._sample_default = float(
+            sample if sample is not None else cfg.serving_sample)
+        self._bucket = int(prefill_bucket if prefill_bucket is not None
+                           else cfg.serving_prefill_buckets)
+        self._spec_k = int(spec_k if spec_k is not None
+                           else cfg.serving_spec_k)
+        if self._spec_k > 0:
+            if draft is None:
+                from .spec import NgramDraft
+
+                draft = NgramDraft()
+            self._draft = draft.bind(self)
+        else:
+            self._draft = None
+        #: Padded prompt lengths this engine has prefilled — each new
+        #: one is one jit specialization, i.e. one XLA compile.
+        self._prefill_lens: set = set()
+        #: Executable-invocation counters — the work-unit accounting
+        #: benchmarks/serving_bench.py builds its noise-immune
+        #: continuous-vs-static comparison on.  ``spec_drafted`` /
+        #: ``spec_accepted`` give the live acceptance rate.
+        self.stats = {"prefills": 0, "steps": 0, "prefill_compiles": 0,
+                      "spec_steps": 0, "spec_drafted": 0,
+                      "spec_accepted": 0}
+        #: Work units spent (prefill/pooled forward = 1 each, draft
+        #: forwards at the proposer's weight) — the scheduler's
+        #: ``unit_seconds`` virtual clock advances by the delta.
+        self.units = 0.0
 
     # -- introspection -----------------------------------------------------
 
@@ -125,6 +207,104 @@ class ReplicaEngine:
     def has_capacity(self) -> bool:
         return not self.dead and self.pool.free_count > 0
 
+    # -- sampling / bucketing resolution -----------------------------------
+
+    def _resolve_sampling(self, request) -> Tuple[float, int, float, int]:
+        """Per-request knobs against the Config default, validated.
+        Greedy requests are FORCED to the filter no-op sentinels
+        (temp 0.0, top_k 0, top_p 2.0) so the greedy stream is bitwise
+        the unfiltered argmax regardless of stray k/p values."""
+        t = getattr(request, "temperature", None)
+        t = self._sample_default if t is None else float(t)
+        seed = int(getattr(request, "seed", 0) or 0)
+        if t <= 0.0:
+            return (0.0, 0, 2.0, seed)
+        k = getattr(request, "top_k", None)
+        k = 0 if k is None else int(k)
+        p = getattr(request, "top_p", None)
+        p = 2.0 if p is None else float(p)
+        if k < 0:
+            raise RequestRejected(
+                f"request {request.rid!r}: top_k must be >= 0 "
+                f"(0 = off), got {k}")
+        if p != 2.0 and not 0.0 < p <= 1.0:
+            raise RequestRejected(
+                f"request {request.rid!r}: top_p must be in (0, 1], "
+                f"got {p}")
+        return (t, k, p, seed)
+
+    def _pad_prompt(self, prompt: np.ndarray) -> Tuple[np.ndarray, int]:
+        """Right-pad to the pow-2 bucket (>= ``prefill_bucket``, capped
+        at the slot block).  Returns ``(padded, true_len)``."""
+        true_len = prompt.shape[1]
+        if self._bucket <= 0:
+            return prompt, true_len
+        bucket = max(self._bucket, 1 << max(0, true_len - 1).bit_length())
+        bucket = min(bucket, self.pool.slot_tokens)
+        if bucket <= true_len:
+            return prompt, true_len
+        padded = np.zeros((1, bucket), prompt.dtype)
+        padded[:, :true_len] = prompt
+        return padded, true_len
+
+    def _count_prefill_compile(self, padded_len: int) -> None:
+        """A prompt length this engine has not prefilled before is one
+        new jit specialization — one XLA compile.  Counted on the
+        bucketed and unbucketed paths alike, so the per-distinct-length
+        recompile cost is visible BEFORE bucketing is turned on."""
+        if padded_len in self._prefill_lens:
+            return
+        self._prefill_lens.add(padded_len)
+        self.stats["prefill_compiles"] += 1
+        mod = _obs()
+        if mod is not None:
+            mod.record_serving("prefill_compiles", replica=self.name)
+
+    def _sampling_arrays(self, sessions: Dict[int, Session]):
+        """[S] operand arrays for the pooled forwards.  ``idxs`` is
+        each session's global emitted-token index (pre-reroute tokens
+        included) — the fold_in schedule that makes sampling a pure
+        function of (seed, token index)."""
+        S = self.pool.n_slots
+        seeds = np.zeros((S,), np.uint32)
+        idxs = np.zeros((S,), np.int32)
+        temps = np.zeros((S,), np.float32)
+        tks = np.zeros((S,), np.int32)
+        tps = np.full((S,), 2.0, np.float32)
+        for slot, sess in sessions.items():
+            t, k, p, seed = sess.sampling
+            seeds[slot] = np.uint32(seed)
+            idxs[slot] = len(getattr(sess.request, "tokens", []) or []) \
+                + len(sess.emitted)
+            temps[slot] = t
+            tks[slot] = k
+            tps[slot] = p
+        return (jnp.asarray(seeds), jnp.asarray(idxs),
+                jnp.asarray(temps), jnp.asarray(tks), jnp.asarray(tps))
+
+    # -- backend hooks (overridden by the mesh-parallel subclass) ----------
+
+    def _backend_prefill(self, prompt: np.ndarray, true_len: int,
+                         sampling):
+        # Module-global lookup on purpose: tests monkeypatch
+        # ``engine.slot_prefill`` to inject prefill failures.
+        return slot_prefill(self.dmodel, self.params,
+                            jnp.asarray(prompt), true_len=true_len,
+                            sampling=sampling)
+
+    def _backend_step(self, toks: np.ndarray, pos: np.ndarray, sampling):
+        self._cache, nxt = slot_decode_step(
+            self.dmodel, self.params, self._cache, toks, pos,
+            sampling=sampling)
+        return np.asarray(nxt)
+
+    def _backend_verify(self, toks: np.ndarray, pos: np.ndarray,
+                        sampling):
+        self._cache, out = slot_verify_step(
+            self.dmodel, self.params, self._cache, toks, pos,
+            sampling=sampling)
+        return np.asarray(out)
+
     # -- iteration-level operations ----------------------------------------
 
     def admit(self, request) -> Optional[Tuple[Session, bool]]:
@@ -135,24 +315,35 @@ class ReplicaEngine:
         tick).  Raises on a request that can NEVER fit a slot block."""
         if self.dead:
             raise RuntimeError(f"{self.name} is dead")
+        sampling = self._resolve_sampling(request)
         base = np.asarray(request.prompt, np.int32).reshape(-1)
         prev = np.asarray(getattr(request, "tokens", []) or [], np.int32)
-        # A re-routed session re-prefills from its emitted prefix:
-        # greedy decode is deterministic, so the continuation equals
-        # what the dead replica would have produced.
+        # A re-routed session re-prefills from its emitted prefix: the
+        # continuation equals what the dead replica would have produced
+        # — greedy decode is deterministic, and sampled decode keys
+        # each token on (seed, token index), both independent of which
+        # replica/slot serves it.
         prompt = np.concatenate([base, prev]).reshape(1, -1)
         total = base.size + int(request.max_new)
         if not self.pool.fits(total):
             raise RequestRejected(
                 f"request {request.rid!r}: prompt+max_new = {total} "
                 f"exceeds the {self.pool.slot_tokens}-token slot block")
+        padded, true_len = self._pad_prompt(prompt)
         slot = self.pool.alloc()
         if slot is None:
             return None
         try:
             self.stats["prefills"] += 1
-            one_cache, first = slot_prefill(self.dmodel, self.params,
-                                            jnp.asarray(prompt))
+            self.units += 1.0
+            self._count_prefill_compile(padded.shape[1])
+            samp = tuple(jnp.asarray(np.asarray([v], d)) for v, d in
+                         zip((sampling[3], prev.size, sampling[0],
+                              sampling[1], sampling[2]),
+                             (np.uint32, np.int32, np.float32, np.int32,
+                              np.float32)))
+            one_cache, first = self._backend_prefill(padded, true_len,
+                                                     samp)
             self._cache = slot_write(self._cache, one_cache, slot)
             tok = int(np.asarray(first)[0])
         except BaseException:
@@ -161,37 +352,45 @@ class ReplicaEngine:
             self.pool.free(slot)
             raise
         sess = Session(request=request, slot=slot, last_tok=tok,
-                       pos_next=prompt.shape[1], emitted=[tok])
+                       pos_next=prompt.shape[1], emitted=[tok],
+                       sampling=sampling, last_emit=1)
         if self._finished(sess):
             self.pool.free(slot)
             return sess, True
         self._sessions[slot] = sess
+        if self._draft is not None:
+            self.units += self._draft.admit(slot, sess)
         return sess, False
 
     def step(self) -> Tuple[List[Session], List[Session]]:
         """One decode tick over every in-flight slot; returns
         ``(advanced, finished)``.  Finished sessions are already retired
-        (slot freed) — their blocks are reusable in the same tick."""
+        (slot freed) — their blocks are reusable in the same tick.
+        Speculative when a draft is bound (up to K+1 tokens per session
+        per tick, bitwise the plain stream)."""
         if self.dead:
             raise RuntimeError(f"{self.name} is dead")
         if not self._sessions:
             return [], []
+        if self._draft is not None:
+            return self._spec_step()
         self.stats["steps"] += 1
+        self.units += 1.0
         S = self.pool.n_slots
         toks = np.zeros((S,), np.int32)
         pos = np.zeros((S,), np.int32)
         for slot, sess in self._sessions.items():
             toks[slot] = sess.last_tok
             pos[slot] = sess.pos_next
-        self._cache, nxt = slot_decode_step(
-            self.dmodel, self.params, self._cache, toks, pos)
-        nxt = np.asarray(nxt)
+        nxt = self._backend_step(toks, pos,
+                                 self._sampling_arrays(self._sessions))
         advanced, finished = [], []
         for slot in list(self._sessions):
             sess = self._sessions[slot]
             sess.last_tok = int(nxt[slot])
             sess.pos_next += 1
             sess.emitted.append(sess.last_tok)
+            sess.last_emit = 1
             advanced.append(sess)
             if self._finished(sess):
                 del self._sessions[slot]
@@ -199,16 +398,95 @@ class ReplicaEngine:
                 finished.append(sess)
         return advanced, finished
 
+    def _spec_step(self) -> Tuple[List[Session], List[Session]]:
+        """Draft K, verify in ONE [S, K+1] forward, accept while the
+        drafts match what the target samples.  Every kept sample
+        conditions only on accepted tokens, so the emitted stream is
+        bitwise the non-speculative one at the same (seed, prompt) —
+        drafting moves SPEED, never content."""
+        sessions = dict(self._sessions)
+        # The [S, K+1] verify writes K+1 cache positions per row at its
+        # own offset; a row near the end of its slot block has less
+        # room than that, and an out-of-range dynamic_update_slice
+        # CLAMPS the start index — silent corruption.  Clamp K to the
+        # tick's tightest room instead (>= 0: an in-flight session
+        # always has 1 free position for its next token).
+        room = min(self.pool.slot_tokens - s.pos_next
+                   for s in sessions.values())
+        K = min(self._spec_k, max(0, room - 1))
+        # Sampling arrays BEFORE drafting: idxs must index the first
+        # token this tick emits.
+        samp = self._sampling_arrays(sessions)
+        drafts, draft_units = self._draft.propose(sessions, K)
+        S = self.pool.n_slots
+        toks = np.zeros((S, K + 1), np.int32)
+        pos = np.zeros((S,), np.int32)
+        for slot, sess in sessions.items():
+            d = list(drafts.get(slot, []))[:K]
+            toks[slot, 0] = sess.last_tok
+            if d:
+                toks[slot, 1:1 + len(d)] = d
+            pos[slot] = sess.pos_next
+        self.stats["steps"] += 1
+        self.stats["spec_steps"] += 1
+        self.units += 1.0 + float(draft_units)
+        out = self._backend_verify(toks, pos, samp)
+        advanced, finished = [], []
+        tick_drafted = tick_accepted = 0
+        for slot, sess in sessions.items():
+            d = list(drafts.get(slot, []))[:K]
+            row = out[slot]
+            m = 0
+            for j in range(len(d) + 1):
+                t = int(row[j])
+                sess.last_tok = t
+                sess.emitted.append(t)
+                m += 1
+                if self._finished(sess):
+                    break
+                if j < len(d) and t != d[j]:
+                    # Mismatch: t IS the corrected token (sampled from
+                    # the accepted prefix); the remaining samples
+                    # conditioned on the wrong draft and are dropped.
+                    break
+            sess.pos_next += m
+            sess.last_emit = m
+            tick_drafted += len(d)
+            tick_accepted += sum(1 for j in range(min(m, len(d)))
+                                 if int(row[j]) == d[j])
+            advanced.append(sess)
+            if self._finished(sess):
+                del self._sessions[slot]
+                self.pool.free(slot)
+                self._draft.free(slot)
+                finished.append(sess)
+            else:
+                self._draft.observe(slot, sess)
+        self.stats["spec_drafted"] += tick_drafted
+        self.stats["spec_accepted"] += tick_accepted
+        mod = _obs()
+        if mod is not None:
+            if tick_drafted:
+                mod.record_serving("spec_drafted", tick_drafted,
+                                   replica=self.name)
+            if tick_accepted:
+                mod.record_serving("spec_accepted", tick_accepted,
+                                   replica=self.name)
+        return advanced, finished
+
     def drain(self) -> List[Session]:
         """Mark this replica dead and hand its in-flight sessions back
         for re-routing (their cache state is presumed lost with the
         replica — the scheduler re-prefills each from its emitted
-        prefix on a healthy replica)."""
+        prefix on a healthy replica).  Draft state is discarded with
+        the replica: nothing speculative survives the move."""
         self.dead = True
         out = list(self._sessions.values())
         for sess in out:
             self.pool.free(sess.slot)
         self._sessions.clear()
+        if self._draft is not None:
+            self._draft.drain()
         return out
 
     # -- internals ---------------------------------------------------------

@@ -21,9 +21,10 @@ through a :class:`~.router.Router` over a shared FIFO admission queue:
   the health ledger (the replica's sessions stall a tick), and a hard
   failure — or a ledger verdict of ``raise`` — DRAINS the replica: its
   in-flight sessions re-enter the queue front and re-prefill from
-  their emitted prefix on a healthy replica (token-exact, because
-  decoding is greedy).  ``tm_serving_rerouted_total`` counts the moved
-  sessions.
+  their emitted prefix on a healthy replica (token-exact: greedy is
+  deterministic, and sampled decode keys token i on
+  ``fold_in(PRNGKey(seed), i)`` — replica- and slot-independent).
+  ``tm_serving_rerouted_total`` counts the moved sessions.
 
 SLO observability rides the obs registry when telemetry is active
 (``tm_serving_*`` — docs/OBSERVABILITY.md): TTFT and inter-token
@@ -59,6 +60,15 @@ class Request:
     max_new: int
     eos_id: Optional[int] = None
     arrival_s: float = 0.0
+    # -- decode diversity (None -> the Config default) --
+    # Sampling is bitwise-reproducible given (seed, prompt): token i
+    # draws from fold_in(PRNGKey(seed), i) regardless of slot, pool
+    # neighbors, replica, or re-routes.  temperature <= 0 is greedy;
+    # top_k 0 / top_p 1.0 disable that filter.
+    temperature: Optional[float] = None
+    top_k: Optional[int] = None
+    top_p: Optional[float] = None
+    seed: int = 0
     # -- results (server-owned) --
     tokens: List[int] = dataclasses.field(default_factory=list)
     ttft_s: Optional[float] = None
@@ -114,26 +124,76 @@ class Server:
                  slots: Optional[int] = None,
                  slot_tokens: Optional[int] = None,
                  devices: Optional[Sequence] = None,
-                 ledger=None):
+                 ledger=None, sample: Optional[float] = None,
+                 prefill_bucket: Optional[int] = None,
+                 spec_k: Optional[int] = None, draft=None,
+                 engines: Optional[Sequence] = None):
         cfg = runtime.effective_config()
-        n = int(replicas if replicas is not None else cfg.serving_replicas)
-        if n < 1:
-            raise ValueError(f"need >= 1 replica, got {n}")
-        if devices is not None and len(devices) < n:
-            raise ValueError(
-                f"{n} replicas but only {len(devices)} devices")
-        engines = [
-            ReplicaEngine(model, params, name=f"replica{i}", slots=slots,
-                          slot_tokens=slot_tokens,
-                          device=devices[i] if devices is not None
-                          else None)
-            for i in range(n)]
+        if engines is None:
+            n = int(replicas if replicas is not None
+                    else cfg.serving_replicas)
+            if n < 1:
+                raise ValueError(f"need >= 1 replica, got {n}")
+            if devices is not None and len(devices) < n:
+                raise ValueError(
+                    f"{n} replicas but only {len(devices)} devices")
+            engines = [
+                ReplicaEngine(model, params, name=f"replica{i}",
+                              slots=slots, slot_tokens=slot_tokens,
+                              device=devices[i] if devices is not None
+                              else None, sample=sample,
+                              prefill_bucket=prefill_bucket,
+                              spec_k=spec_k, draft=draft)
+                for i in range(n)]
+        else:
+            engines = list(engines)
         self.router = Router(engines, ledger=ledger)
         #: Filled by :meth:`run_trace`: ``ticks`` (work ticks run),
         #: ``busy_s`` (summed tick durations — the compute time
         #: throughput divides by), ``clock_s`` (final virtual clock,
         #: idle gaps included), ``tokens`` (total emitted).
         self.last_stats: dict = {}
+
+    @classmethod
+    def sharded(cls, params, *, tp: int, num_heads: int,
+                slot_tokens: int, axis: str = "model",
+                replicas: Optional[int] = None,
+                devices: Optional[Sequence] = None, **kw) -> "Server":
+        """A server whose every replica is a TP mesh slice: carve
+        ``replicas`` disjoint ``tp``-device meshes from ``devices``
+        (default ``jax.devices()``) and serve one
+        :class:`~.tp_engine.TPReplicaEngine` per slice.  ``params`` is
+        a full ``tp_generate.init_tp_lm`` tree (placed per mesh).
+        Defaults to as many replicas as the device pool can hold."""
+        import jax
+        from jax.sharding import Mesh
+
+        from .tp_engine import TPReplicaEngine
+
+        if tp < 1:
+            raise ValueError(f"tp must be >= 1, got {tp}")
+        devices = list(devices if devices is not None else jax.devices())
+        n = int(replicas) if replicas is not None else len(devices) // tp
+        if n < 1 or n * tp > len(devices):
+            raise ValueError(
+                f"{n} replicas x {tp} devices need {n * tp} devices, "
+                f"have {len(devices)}")
+        engines = [
+            TPReplicaEngine(
+                params,
+                mesh=Mesh(np.asarray(devices[i * tp:(i + 1) * tp]),
+                          (axis,)),
+                axis=axis, num_heads=num_heads, name=f"tp{i}",
+                slot_tokens=slot_tokens, **kw)
+            for i in range(n)]
+        return cls(None, None, engines=engines)
+
+    def _total_units(self) -> float:
+        """Summed work units across ALL replicas (dead included —
+        their spent work stays spent): prefills + pooled forwards at
+        1.0, draft forwards at the proposer's weight.  The
+        ``unit_seconds`` clock advances by the per-tick delta."""
+        return sum(e.units for e in self.router.replicas)
 
     # -- the serving loop --------------------------------------------------
 
@@ -168,6 +228,7 @@ class Server:
         completed: List[Request] = []
         clock = busy = 0.0
         n_ticks = n_tokens = 0
+        units_prev = self._total_units()
         for _tick in range(max_ticks):
             if not (arrivals or pending
                     or any(e.active for e in self.router.live())):
@@ -198,8 +259,14 @@ class Server:
                 raise RuntimeError(
                     "all replicas dead with requests still queued")
             if unit_seconds is not None:
-                n_units = len(newly_admitted) + steps_run
-                elapsed = max(1, n_units) * unit_seconds
+                # The delta of the engines' own unit ledgers, not a
+                # recount here: speculative ticks bill 1 verify +
+                # K x draft-weight, prefills 1 each — whatever the
+                # engines actually ran is what the clock charges.
+                units_now = self._total_units()
+                n_units = units_now - units_prev
+                units_prev = units_now
+                elapsed = max(1.0, n_units) * unit_seconds
             elif tick_seconds is not None:
                 elapsed = tick_seconds
             else:
@@ -207,7 +274,8 @@ class Server:
             clock += elapsed
             busy += elapsed
             n_ticks += 1
-            n_tokens += len(newly_admitted) + len(stepped)
+            n_tokens += len(newly_admitted) + \
+                sum(s.last_emit for s in stepped)
             self._record_tick(pending, newly_admitted, stepped,
                               finished, completed, clock, elapsed)
         raise RuntimeError(f"trace did not drain in {max_ticks} ticks")
@@ -349,18 +417,26 @@ class Server:
             # N ticks by transient replica faults — or re-admitted
             # after a drain this same tick (then the admission already
             # carried the stall and last_token_s is this clock) —
-            # reports its true inter-token latency.
+            # reports its true inter-token latency.  A speculative tick
+            # that landed m tokens records m observations of gap/m:
+            # the histogram keeps counting per TOKEN, and the spec win
+            # shows up as the smaller per-token gap it is.
             since = (req.last_token_s if req.last_token_s is not None
                      else clock - elapsed)
-            mod.record_serving_latency("itl", clock - since,
-                                       replica=req.replica)
+            m = max(1, sess.last_emit)
+            for _ in range(m):
+                mod.record_serving_latency("itl", (clock - since) / m,
+                                           replica=req.replica)
             req.last_token_s = clock
-        n_tok = len(admitted) + len(stepped)
+        n_tok = len(admitted) + sum(s.last_emit for s in stepped)
         if n_tok:
             by_rep: dict = {}
-            for sess in admitted + stepped:
+            for sess in admitted:
                 by_rep[sess.request.replica] = \
                     by_rep.get(sess.request.replica, 0) + 1
+            for sess in stepped:
+                by_rep[sess.request.replica] = \
+                    by_rep.get(sess.request.replica, 0) + sess.last_emit
             for rep, n in by_rep.items():
                 mod.record_serving("tokens", n, replica=rep)
         mod.record_serving_depth(len(pending))
