@@ -101,6 +101,24 @@ def _load_inject():
     return mod
 
 
+def _load_findings():
+    """Standalone copy of analysis/findings.py (the shared Finding
+    record the static-analysis CLIs emit) — file-loaded like
+    ``_load_inject`` so ``chaos_tool lint`` never imports jax."""
+    path = os.path.join(_REPO, "torchmpi_tpu", "analysis",
+                        "findings.py")
+    name = "_chaos_findings"
+    if name in sys.modules:
+        return sys.modules[name]
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    # Registered before exec: dataclass machinery needs the module
+    # resolvable through sys.modules.
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
 def parse_rule(inject, spec: str):
     """``site:kind[:prob[:max_hits[:delay_s[:after]]]]`` -> FaultRule.
     ``after`` skips the first N arrivals — how a plain --rule lands a
@@ -277,21 +295,37 @@ def cmd_gen(args) -> int:
 
 
 def cmd_lint(args) -> int:
+    """Plan problems surface as F1 error :class:`Finding`\\ s — the
+    same structured record (and ``--json`` wire format) as
+    ``scripts/lint_collectives.py``, so one consumer parses every
+    static-analysis stream in the repo."""
     inject = _load_inject()
+    fmod = _load_findings()
+    as_json = getattr(args, "json", False)
     rc = 0
+    findings = []
     for path in args.files:
         try:
             plan = inject.FaultPlan.load(path)
         except (OSError, ValueError) as e:
             print(f"error: {e}", file=sys.stderr)
             return 2
-        problems = inject.lint_plan(plan)
-        status = "OK" if not problems else f"{len(problems)} problem(s)"
-        print(f"{path}: version={inject.FAULT_PLAN_VERSION} "
-              f"seed={plan.seed} rules={len(plan.rules)} — {status}")
-        for p in problems:
-            print(f"  {p}")
+        found = [fmod.Finding(rule="F1", severity=fmod.ERROR,
+                              message=p, source=path)
+                 for p in inject.lint_plan(plan)]
+        findings.extend(found)
+        if found:
             rc = 1
+        if not as_json:
+            status = "OK" if not found else f"{len(found)} problem(s)"
+            print(f"{path}: version={inject.FAULT_PLAN_VERSION} "
+                  f"seed={plan.seed} rules={len(plan.rules)} — {status}")
+            for f in found:
+                print(f"  {f}")
+    if as_json:
+        print(json.dumps(
+            [f.to_json() for f in fmod.sort_findings(findings)],
+            indent=1))
     return rc
 
 
@@ -384,6 +418,9 @@ def main(argv=None) -> int:
 
     s = sub.add_parser("lint", help="validate plan files")
     s.add_argument("files", nargs="+")
+    s.add_argument("--json", action="store_true",
+                   help="emit problems as findings JSON (the "
+                        "lint_collectives.py wire format)")
     s.set_defaults(fn=cmd_lint)
 
     s = sub.add_parser("summarize",

@@ -1,5 +1,19 @@
-"""Lint step functions and example entry points for SPMD collective
-hazards (docs/ANALYSIS.md).
+"""Lint step functions, example entry points, and the host-side
+protocol surfaces for SPMD hazards (docs/ANALYSIS.md).
+
+Three passes:
+
+- **Trace-time** (the targets below): collective-consistency rules
+  plus the S1/S2 cache-slice rules, run over jaxprs.
+- **Host-side** (``--host``): the H1-H5 AST/doc-drift rule pack
+  (:mod:`torchmpi_tpu.analysis.hostcheck`) over the package tree —
+  import discipline, telemetry/config/fault-site drift, lock-order
+  cycles.  Pure AST: no jax import, so ``--host`` alone runs in
+  milliseconds; ``--host`` combined with targets runs both passes.
+- **Default sweep**: with no targets and no ``--host``, lints
+  ``tests/fixtures_analysis_clean.py`` + ``tests/fixtures_lint_sweep.py``
+  (the shipped decode/serving entry points) AND the host pass — the
+  one-command whole-stack check CI runs.
 
 Two target forms, auto-detected per file:
 
@@ -25,10 +39,12 @@ Exit codes: 0 clean (or warnings only), 1 error-severity findings,
 2 a target could not be loaded/analyzed at all.
 
 Usage:
+    python scripts/lint_collectives.py              # full default sweep
+    python scripts/lint_collectives.py --host       # host pass only
     python scripts/lint_collectives.py tests/fixtures_analysis.py
     python scripts/lint_collectives.py examples/mnist_allreduce.py \\
         --args "--devices 8 --steps 2"
-    python scripts/lint_collectives.py --json ...
+    python scripts/lint_collectives.py --json --bank ...
 """
 
 import argparse
@@ -45,10 +61,35 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
 
 
+#: Linted when the CLI is invoked with no targets: the clean near-miss
+#: fixtures plus the shipped decode/serving entry points.
+DEFAULT_SWEEP = (
+    os.path.join(_REPO, "tests", "fixtures_analysis_clean.py"),
+    os.path.join(_REPO, "tests", "fixtures_lint_sweep.py"),
+)
+
+
 def _load_module(path: str):
     name = os.path.splitext(os.path.basename(path))[0]
     spec = importlib.util.spec_from_file_location(f"_lint_{name}", path)
     mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _load_hostcheck():
+    """Load the host-side rule pack WITHOUT importing jax: hostcheck
+    is pure AST and loads its own findings module standalone, so
+    ``--host``-only invocations (CI's cheap gate) stay in the
+    millisecond range instead of paying a full jax import."""
+    path = os.path.join(_REPO, "torchmpi_tpu", "analysis",
+                        "hostcheck.py")
+    name = "_lint_hostcheck"
+    if name in sys.modules:
+        return sys.modules[name]
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod  # registered before exec: dataclasses
     spec.loader.exec_module(mod)
     return mod
 
@@ -134,9 +175,17 @@ def main(argv=None) -> int:
         description=__doc__.splitlines()[0],
         formatter_class=argparse.RawDescriptionHelpFormatter,
         epilog=__doc__)
-    p.add_argument("targets", nargs="+",
+    p.add_argument("targets", nargs="*",
                    help="python files: LINT_TARGETS declarations or "
-                        "example entry points")
+                        "example entry points (none = the default "
+                        "sweep + the host pass)")
+    p.add_argument("--host", action="store_true",
+                   help="run the host-side H1-H5 rule pack "
+                        "(docs/ANALYSIS.md); alone = host pass only "
+                        "(no jax import), with targets = both passes")
+    p.add_argument("--bank", action="store_true",
+                   help="append a LINT-SUMMARY record to "
+                        "benchmarks/SUMMARY_BANK.json")
     p.add_argument("--args", default="",
                    help="arguments passed to example subprocesses "
                         "(e.g. \"--devices 8 --steps 2\")")
@@ -152,24 +201,51 @@ def main(argv=None) -> int:
                         "nonzero")
     args = p.parse_args(argv)
 
-    from torchmpi_tpu import analysis
+    targets = list(args.targets)
+    run_host = args.host
+    if not targets and not args.host:
+        targets = list(DEFAULT_SWEEP)
+        run_host = True
+
+    if targets:
+        from torchmpi_tpu import analysis
+    else:
+        # --host alone: the pure-AST pack, no jax import.
+        analysis = _load_hostcheck()
 
     rules = args.rules.split(",") if args.rules else None
     all_findings = []
     load_failures = 0
     run_failures = 0
-    for path in args.targets:
+
+    if run_host:
+        hrules = ([r for r in rules if r.upper().startswith("H")]
+                  if rules else None)
+        if rules is None or hrules:
+            try:
+                found = analysis.run_hostcheck(rules=hrules)
+            except Exception as e:  # noqa: BLE001 — report, keep going
+                print(f"error: host pass failed: {e}", file=sys.stderr)
+                load_failures += 1
+                found = []
+            all_findings.extend(found)
+            if not args.json:
+                tag = analysis.max_severity(found) or "clean"
+                print(f"host pass (H1-H5): {len(found)} finding(s) "
+                      f"[{tag}]")
+
+    for path in targets:
         try:
-            targets = _declared_targets(path)
+            declared = _declared_targets(path)
         except Exception as e:  # noqa: BLE001 — report, keep linting
             print(f"error: cannot load {path}: {e}", file=sys.stderr)
             load_failures += 1
             continue
         try:
-            if targets is not None:
+            if declared is not None:
                 found = lint_declared(path, [
                     dict(t, rules=t.get("rules") or rules)
-                    for t in targets])
+                    for t in declared])
                 rc = 0
             else:
                 found, rc = lint_example(path, args.args, args.timeout)
@@ -192,6 +268,26 @@ def main(argv=None) -> int:
     else:
         for f in all_findings:
             print(f"  {f}")
+
+    if args.bank:
+        from benchmarks.banking import bank_summary
+
+        by_rule = {}
+        for f in all_findings:
+            by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+        bank_summary("LINT-SUMMARY", {
+            "targets": [os.path.relpath(t, _REPO) for t in targets],
+            "host_pass": bool(run_host),
+            "findings": len(all_findings),
+            "errors": sum(1 for f in all_findings
+                          if f.severity == "error"),
+            "warnings": sum(1 for f in all_findings
+                            if f.severity == "warning"),
+            "by_rule": dict(sorted(by_rule.items())),
+            "load_failures": load_failures,
+            "run_failures": run_failures,
+        }, argv=sys.argv[1:])
+
     if load_failures:
         return 2
     if analysis.has_errors(all_findings):

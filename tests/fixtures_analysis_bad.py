@@ -25,9 +25,48 @@ def bad_d2_unbound_axis(x):
     return lax.psum(x, "nonexistent_axis")
 
 
+_CACHE = jax.ShapeDtypeStruct((4, 16, 8), jnp.float32)
+_ROW = jax.ShapeDtypeStruct((1, 1, 8), jnp.float32)
+_I32 = jax.ShapeDtypeStruct((), jnp.int32)
+_POS_ROWS = jax.ShapeDtypeStruct((4,), jnp.int32)
+
+
+def bad_s1_unclamped_cache_write(cache, row, pos):
+    """The PR 17 corruption class verbatim: a data-dependent start
+    feeding a carried-cache ``dynamic_update_slice`` with NO bound —
+    an out-of-range ``pos`` clamps silently and overwrites the last
+    in-range row."""
+    def step(c, _):
+        c = lax.dynamic_update_slice(c, row, (0, pos, 0))
+        return c, ()
+    out, _ = lax.scan(step, cache, None, length=2)
+    return out
+
+
+def bad_s2_inline_clip_slot_write(cache, rows, pos_rows):
+    """Per-row (vmapped) slot write clamped with an inline ``jnp.clip``
+    instead of ``models.generate.clamp_slot_positions``: S1 is
+    satisfied, but no ``slot_clamp`` trace record exists, so the S2
+    chokepoint discipline flags it (warning severity)."""
+    pos_rows = jnp.clip(pos_rows, 0, cache.shape[1] - 1)
+    def step(c, _):
+        c = jax.vmap(
+            lambda cc, u, s: lax.dynamic_update_slice(cc, u, (s, 0))
+        )(c, rows, pos_rows)
+        return c, ()
+    out, _ = lax.scan(step, cache, None, length=2)
+    return out
+
+
 LINT_TARGETS = [
     dict(fn=bad_d1_rank_divergent_collective, args=(_VEC,),
          axis_env=[("i", 8)], label="bad_d1"),
     dict(fn=bad_d2_unbound_axis, args=(_VEC,),
          axis_env=[("i", 8)], label="bad_d2"),
+    dict(fn=bad_s1_unclamped_cache_write,
+         args=(_CACHE, _ROW, _I32), label="bad_s1"),
+    dict(fn=bad_s2_inline_clip_slot_write,
+         args=(_CACHE, jax.ShapeDtypeStruct((4, 1, 8), jnp.float32),
+               _POS_ROWS),
+         label="bad_s2"),
 ]

@@ -22,9 +22,55 @@ def clean_bound_axis(x):
     return lax.pmax(lax.psum(x, "i"), "i")
 
 
+_CACHE = jax.ShapeDtypeStruct((4, 16, 8), jnp.float32)
+_ROW = jax.ShapeDtypeStruct((1, 1, 8), jnp.float32)
+_I32 = jax.ShapeDtypeStruct((), jnp.int32)
+_POS_ROWS = jax.ShapeDtypeStruct((4,), jnp.int32)
+
+
+def clean_s1_clamped_cache_write(cache, row, pos):
+    """The PR 17 regression pair's good half: identical shape to
+    ``fixtures_analysis_bad.bad_s1_unclamped_cache_write`` but the
+    start is clipped to ``[0, size - width]`` before the slice, so S1
+    certifies the write."""
+    pos = jnp.clip(pos, 0, cache.shape[1] - 1)
+
+    def step(c, _):
+        c = lax.dynamic_update_slice(c, row, (0, pos, 0))
+        return c, ()
+
+    out, _ = lax.scan(step, cache, None, length=2)
+    return out
+
+
+def clean_s2_chokepoint_slot_write(cache, rows, pos_rows):
+    """Per-row slot write routed through the clamp chokepoint
+    (``models.generate.clamp_slot_positions``): the helper both bounds
+    the positions for S1 and leaves the ``slot_clamp`` trace record S2
+    looks for."""
+    from torchmpi_tpu.models.generate import clamp_slot_positions
+
+    pos_rows = clamp_slot_positions(pos_rows, cache.shape[1])
+
+    def step(c, _):
+        c = jax.vmap(
+            lambda cc, u, s: lax.dynamic_update_slice(cc, u, (s, 0))
+        )(c, rows, pos_rows)
+        return c, ()
+
+    out, _ = lax.scan(step, cache, None, length=2)
+    return out
+
+
 LINT_TARGETS = [
     dict(fn=clean_data_dependent_cond, args=(_VEC,),
          axis_env=[("i", 8)], label="clean_cond"),
     dict(fn=clean_bound_axis, args=(_VEC,),
          axis_env=[("i", 8)], label="clean_bound"),
+    dict(fn=clean_s1_clamped_cache_write,
+         args=(_CACHE, _ROW, _I32), label="clean_s1"),
+    dict(fn=clean_s2_chokepoint_slot_write,
+         args=(_CACHE, jax.ShapeDtypeStruct((4, 1, 8), jnp.float32),
+               _POS_ROWS),
+         label="clean_s2"),
 ]

@@ -677,11 +677,110 @@ def _run_cli(*args, timeout=240):
         capture_output=True, text=True, timeout=timeout, cwd=_REPO,
         env=env)
 
+def test_s1_unclamped_carried_cache_write_errors():
+    cache = jax.ShapeDtypeStruct((4, 16, 8), jnp.float32)
+    row = jax.ShapeDtypeStruct((1, 1, 8), jnp.float32)
+    pos = jax.ShapeDtypeStruct((), jnp.int32)
+
+    def step_loop(c, r, p):
+        def body(cc, _):
+            return lax.dynamic_update_slice(cc, r, (0, p, 0)), ()
+        out, _ = lax.scan(body, c, None, length=2)
+        return out
+
+    found = analysis.check(step_loop, cache, row, pos, rules=["S1"])
+    assert [f.rule for f in found] == ["S1"]
+    assert found[0].severity == analysis.ERROR
+    assert "carried cache buffer" in found[0].message
+
+
+def test_s1_near_miss_clamped_write_passes():
+    cache = jax.ShapeDtypeStruct((4, 16, 8), jnp.float32)
+    row = jax.ShapeDtypeStruct((1, 1, 8), jnp.float32)
+    pos = jax.ShapeDtypeStruct((), jnp.int32)
+
+    def step_loop(c, r, p):
+        p = jnp.clip(p, 0, c.shape[1] - 1)
+
+        def body(cc, _):
+            return lax.dynamic_update_slice(cc, r, (0, p, 0)), ()
+        out, _ = lax.scan(body, c, None, length=2)
+        return out
+
+    assert analysis.check(step_loop, cache, row, pos,
+                          rules=["S1"]) == []
+
+
+def test_s2_inline_clip_warns_chokepoint_clears():
+    """The vmapped per-row slot write: an ad-hoc ``jnp.clip`` satisfies
+    S1 but not the chokepoint discipline (S2 warning); routing through
+    ``clamp_slot_positions`` leaves the ``slot_clamp`` trace record and
+    clears both."""
+    from torchmpi_tpu.models.generate import clamp_slot_positions
+
+    cache = jax.ShapeDtypeStruct((4, 16, 8), jnp.float32)
+    rows = jax.ShapeDtypeStruct((4, 1, 8), jnp.float32)
+    pos = jax.ShapeDtypeStruct((4,), jnp.int32)
+
+    def write(c, u, s):
+        return jax.vmap(
+            lambda cc, uu, ss: lax.dynamic_update_slice(cc, uu, (ss, 0))
+        )(c, u, s)
+
+    def inline(c, u, s):
+        return write(c, u, jnp.clip(s, 0, c.shape[1] - 1))
+
+    def chokepoint(c, u, s):
+        return write(c, u, clamp_slot_positions(s, c.shape[1]))
+
+    found = analysis.check(inline, cache, rows, pos,
+                           rules=["S1", "S2"])
+    assert [f.rule for f in found] == ["S2"]
+    assert found[0].severity == analysis.WARNING
+    assert analysis.check(chokepoint, cache, rows, pos,
+                          rules=["S1", "S2"]) == []
+
+
+def test_s1_shipped_slot_decode_certifies():
+    """The real serving tick traces S1/S2-clean: every cache write in
+    the decode path is provably clamped (the PR 17 regression gate)."""
+    from torchmpi_tpu.models import TransformerLM
+    gen = __import__("importlib").import_module(
+        "torchmpi_tpu.models.generate")
+
+    model = TransformerLM(vocab=50, embed=32, depth=1, num_heads=4,
+                          head_dim=8, max_len=32, pos_emb="rope")
+    dmodel = model.clone(decode=True, max_len=16)
+    params = jax.tree.map(
+        lambda s: jnp.zeros(s.shape, s.dtype),
+        jax.eval_shape(lambda: model.init(
+            jax.random.PRNGKey(0),
+            jnp.zeros((1, 4), jnp.int32)))["params"])
+    cache = jax.tree.map(
+        lambda s: jnp.zeros(s.shape, s.dtype),
+        jax.eval_shape(lambda: dmodel.init(
+            jax.random.PRNGKey(0), jnp.zeros((2, 1), jnp.int32),
+            pos_offset=jnp.zeros((2,), jnp.int32)))["cache"])
+
+    def tick(c, toks, pos):
+        return gen.slot_decode_step(dmodel, params, c, toks, pos)
+
+    assert analysis.check(
+        tick, cache, jax.ShapeDtypeStruct((2,), jnp.int32),
+        jax.ShapeDtypeStruct((2,), jnp.int32),
+        rules=["S1", "S2"]) == []
+
+
+# ---------------------------------------------------------------------------
+# lint CLI over the fixture files
+# ---------------------------------------------------------------------------
+
+
 def test_cli_exits_nonzero_on_seeded_bad_fixtures():
     out = _run_cli("tests/fixtures_analysis_bad.py", "--json")
     assert out.returncode == 1, out.stderr
     findings = json.loads(out.stdout)
-    assert {"D1", "D2"} <= {f["rule"] for f in findings}
+    assert {"D1", "D2", "S1", "S2"} <= {f["rule"] for f in findings}
 
 
 def test_cli_exits_zero_on_clean_fixtures():

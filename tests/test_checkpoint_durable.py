@@ -605,50 +605,7 @@ def test_chaos_tool_ckpt_sites(tmp_path, capsys):
 # ---------------------------------------------------------------------------
 
 
-def test_ckpt_redundancy_off_never_imports():
-    """ckpt_redundancy="off" (the default) is zero-cost: the probe
-    drives save/save_async/restore/save_sharded/restore_sharded and a
-    full run_with_restarts crash-recovery cycle, then asserts
-    utils/durable.py (and the fault layer it would report through)
-    never entered the process — the one string compare at entry is the
-    whole cost."""
-    code = (
-        "import sys, tempfile\n"
-        "import numpy as np\n"
-        "import jax, jax.numpy as jnp\n"
-        "from jax.sharding import NamedSharding, PartitionSpec as P\n"
-        "import torchmpi_tpu as mpi\n"
-        "from torchmpi_tpu.utils import checkpoint, restart\n"
-        "mesh = mpi.init(mpi.Config(dcn_size=1))\n"
-        "d = tempfile.mkdtemp()\n"
-        "tree = {'w': np.arange(8, dtype=np.float32)}\n"
-        "checkpoint.save(d, tree, step=1)\n"
-        "checkpoint.save_async(d, tree, step=2).wait(timeout=60.0)\n"
-        "checkpoint.restore(d, tree)\n"
-        "x = jax.device_put(jnp.arange(16, dtype=jnp.float32),\n"
-        "                   NamedSharding(mesh, P(mesh.axis_names)))\n"
-        "checkpoint.save_sharded(d, {'x': x}, step=3)\n"
-        "checkpoint.restore_sharded(d, {'x': x})\n"
-        "hit = []\n"
-        "def flaky(s, i):\n"
-        "    if i == 3 and not hit:\n"
-        "        hit.append(i); raise RuntimeError('boom')\n"
-        "    return {'w': s['w'] + 1}\n"
-        "restart.run_with_restarts(lambda: tree, flaky, steps=5,\n"
-        "                          directory=d + '/rr', save_every=2)\n"
-        "mpi.stop()\n"
-        "assert 'torchmpi_tpu.utils.durable' not in sys.modules, 'durable!'\n"
-        "assert 'torchmpi_tpu.faults' not in sys.modules, 'faults!'\n"
-        "print('CKPT-OFF-OK')\n"
-    )
-    env = dict(os.environ)
-    for k in ("TORCHMPI_TPU_CKPT_REDUNDANCY", "TORCHMPI_TPU_FAULTS",
-              "TORCHMPI_TPU_OBS", "TORCHMPI_TPU_GUARD"):
-        env.pop(k, None)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
-    out = subprocess.run([sys.executable, "-c", code],
-                         capture_output=True, text=True, timeout=300,
-                         env=env, cwd=_REPO)
-    assert out.returncode == 0, out.stdout + out.stderr
-    assert "CKPT-OFF-OK" in out.stdout
+# (The off-mode never-imports subprocess probe formerly here is
+# superseded by the static H1 import-discipline rule —
+# torchmpi_tpu/analysis/hostcheck.py, tests/test_hostcheck.py;
+# runtime anchors live in test_obs.py / test_faults.py.)

@@ -50,6 +50,12 @@ from .rules import (  # noqa: F401
     run_rules,
 )
 from .checker import assert_clean, check, check_jaxpr, trace_fn  # noqa: F401
+from .hostcheck import (  # noqa: F401
+    GATED_MODULES,
+    HOST_RULES,
+    run_hostcheck,
+)
+from .slices import SliceEvent, trace_slice_events  # noqa: F401
 from .hook import (  # noqa: F401
     AnalysisError,
     ANALYSIS_OUT_ENV,
@@ -61,6 +67,21 @@ from .hook import (  # noqa: F401
     wrap_step,
 )
 
+def lint_full(package_root=None, docs_root=None, rules=None):
+    """Pytest/CI helper: run the host-side H rule pack
+    (:mod:`hostcheck` — pure AST, no tracing, fast) and raise
+    ``AssertionError`` on error-severity findings, mirroring
+    :func:`assert_clean` for the trace-time rules.  Returns the full
+    finding list."""
+    findings = run_hostcheck(package_root, docs_root, rules=rules)
+    bad = [f for f in findings if f.severity == ERROR]
+    if bad:
+        raise AssertionError(
+            f"host-side static analysis found {len(bad)} problem(s):\n"
+            f"{format_findings(bad)}")
+    return findings
+
+
 __all__ = [
     "ERROR", "WARNING", "INFO", "Finding", "format_findings",
     "has_errors", "max_severity", "sort_findings",
@@ -71,4 +92,6 @@ __all__ = [
     "AnalysisError", "ANALYSIS_OUT_ENV", "arm_runtime_capture",
     "captured_findings", "check_once", "report",
     "reset_captured", "wrap_step",
+    "GATED_MODULES", "HOST_RULES", "run_hostcheck", "lint_full",
+    "SliceEvent", "trace_slice_events",
 ]

@@ -135,9 +135,12 @@ def check_jaxpr(closed_jaxpr, *, records: Sequence[dict] = (),
                 rules: Optional[Sequence[str]] = None,
                 config=None, label: str = "") -> List[Finding]:
     """Run the rules over an already-traced ClosedJaxpr."""
+    from .slices import trace_slice_events
+
     events = trace_events(closed_jaxpr, bound_axes=bound_axes)
     ctx = RuleContext(events=events, records=list(records),
-                      config=_effective_config(config), label=label)
+                      config=_effective_config(config), label=label,
+                      slice_events=trace_slice_events(closed_jaxpr))
     return sort_findings(run_rules(ctx, rules))
 
 

@@ -53,6 +53,19 @@ Hazards / performance (warning or info severity):
   too small to ever route to a measured custom backend — the "tiny
   collective nobody measured" case.  Payloads under
   ``P2_MIN_NBYTES`` (scalar loss reductions etc.) are exempt.
+
+Decode / serving slice safety (:mod:`torchmpi_tpu.analysis.slices`):
+
+- **S1** — ``dynamic_update_slice``/``dynamic_slice`` (and the
+  ``mode=CLIP`` scatter ``vmap`` lowers per-row updates to) whose start
+  index is data-dependent and not provably clamped to leave room for
+  the update width — the PR 17 slot-cache silent-corruption class.
+  Error when the write target is a carried cache buffer, info
+  otherwise.
+- **S2** — per-row slot-cache writes whose ``pos_offset`` bypasses the
+  ``clamp_slot_positions`` helper (``models/generate.py`` /
+  ``tp_generate``) — the clamp may exist inline, but the chokepoint
+  discipline is what keeps the next width change safe.
 """
 
 from __future__ import annotations
@@ -83,6 +96,10 @@ class RuleContext:
     records: Sequence[dict]          # fusion/ZeRO trace-time records
     config: object                   # the effective Config
     label: str = ""                  # caller-supplied name of the fn
+    # Dynamic-slice event stream (analysis/slices.py) for the S rules;
+    # default () keeps record-only constructions (C2's partial-trace
+    # path) working unchanged.
+    slice_events: Sequence[object] = ()
 
 
 @dataclasses.dataclass
@@ -419,6 +436,65 @@ def _rule_c2(ctx: RuleContext) -> List[Finding]:
                          f"SAME template/n_buckets/max_bytes as the sync"),
                 source=src, axes=tuple(rec.get("axes", ()))))
     return out
+
+
+@register_rule("S1", ERROR,
+               "dynamic_update_slice/dynamic_slice start index not "
+               "provably clamped to leave room for the update width")
+def _rule_s1(ctx: RuleContext) -> List[Finding]:
+    """The PR 17 slot-cache corruption class, statically: an
+    out-of-range ``dynamic_update_slice`` start CLAMPS instead of
+    failing (so does the ``mode=CLIP`` scatter ``vmap`` lowers the
+    per-row form to), silently overwriting the last in-range rows.  A
+    data-dependent start feeding a cache write must be provably bounded
+    — ``jnp.clip``/``lax.clamp`` against ``size - width`` — before the
+    slice.  Error when the write target is a carried/input cache
+    buffer; info for reads and scratch intermediates."""
+    out: List[Finding] = []
+    for ev in ctx.slice_events:
+        if ev.safe:
+            continue
+        hot = ev.write and ev.on_buffer
+        kind = "write" if ev.write else "read"
+        target = ("carried cache buffer" if ev.on_buffer
+                  else "intermediate value")
+        out.append(Finding(
+            rule="S1", severity=ERROR if hot else INFO,
+            message=(f"{ev.op} {kind} into a {target} with an "
+                     f"unproven start index ({ev.detail}): an "
+                     f"out-of-range start CLAMPS silently — corrupt "
+                     f"last rows, no error.  Clamp the index to "
+                     f"[0, size - width] (models/generate.py:"
+                     f"clamp_slot_positions) before the slice"),
+            path=ev.path, source=ev.source, op=ev.op))
+    return out
+
+
+@register_rule("S2", WARNING,
+               "slot-indexed cache write whose positions bypass the "
+               "clamp helpers in models/generate.py/tp_generate.py")
+def _rule_s2(ctx: RuleContext) -> List[Finding]:
+    """Per-row (vmapped) slot-cache writes must derive their
+    ``pos_offset`` through :func:`models.generate.clamp_slot_positions`
+    — the helper both clamps AND leaves a ``slot_clamp`` trace record,
+    so the discipline is checkable here.  An inline ``jnp.clip`` may
+    satisfy S1 today, but the next edit to the width or the buffer
+    shape has no single chokepoint to keep it honest."""
+    batched = [ev for ev in ctx.slice_events
+               if ev.write and ev.batched and ev.data_dependent]
+    if not batched:
+        return []
+    if any(r.get("kind") == "slot_clamp" for r in ctx.records):
+        return []
+    ev = batched[0]
+    return [Finding(
+        rule="S2", severity=WARNING,
+        message=(f"{len(batched)} per-row slot-cache write(s) trace "
+                 f"without a clamp-helper record: route the positions "
+                 f"through models/generate.py:clamp_slot_positions "
+                 f"(or tp_generate's re-export) instead of deriving "
+                 f"pos_offset ad hoc"),
+        path=ev.path, source=ev.source, op=ev.op)]
 
 
 def rule_catalog() -> List[Tuple[str, str, str]]:

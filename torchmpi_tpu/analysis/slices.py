@@ -1,0 +1,414 @@
+"""Trace-time dynamic-slice safety analysis (the S rules' event stream).
+
+Walks a ClosedJaxpr — through ``pjit``/``shard_map``/``scan``/``cond``
+and the other higher-order primitives — and emits one
+:class:`SliceEvent` per ``dynamic_update_slice`` / ``dynamic_slice`` /
+batched-write ``scatter`` equation, carrying whether the start indices
+are *provably in bounds* for the update width.  This is the static
+form of the PR 17 slot-cache hazard: ``dynamic_update_slice`` CLAMPS an
+out-of-range start instead of failing, so an unclamped data-dependent
+write index silently corrupts the last cache rows (see the comment in
+``models/transformer.py``'s decode path).  ``jax.vmap`` lowers the
+per-row form to a ``scatter`` with ``mode=CLIP`` — the same silent
+clamp — so both spellings are covered.
+
+The proof is a forward interval analysis over the integer scalars that
+feed start operands: literals, ``iota``, ``clamp``/``min``/``max``
+(what ``jnp.clip`` lowers to, inside a ``pjit[name=clip]`` call),
+``add``/``sub``, ``rem``, and the ``select_n(lt(x, 0), x, x + dim)``
+negative-index normalization jax inserts around every dynamic slice.
+Anything the analysis cannot bound is treated as unbounded — a clamp
+the checker cannot see is a clamp a reviewer cannot see either.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+from .events import _subjaxprs, _user_source
+
+NEG = float("-inf")
+POS = float("inf")
+TOP = (NEG, POS)
+
+# Primitives whose output interval is the (elementwise) input interval.
+_PASSTHROUGH = (
+    "convert_element_type", "copy", "stop_gradient", "broadcast_in_dim",
+    "reshape", "squeeze", "expand_dims", "transpose", "rev",
+    "reduce_min", "reduce_max", "device_put", "optimization_barrier",
+)
+
+_SLICE_PRIMS = ("dynamic_update_slice", "dynamic_slice", "scatter")
+
+
+@dataclass(frozen=True)
+class SliceEvent:
+    """One dynamic-slice-family equation found in the trace."""
+
+    op: str            # dynamic_update_slice | dynamic_slice | scatter
+    path: str          # nesting path, e.g. "pjit/scan"
+    source: str        # user call site ("file.py:line (fn)"), best-effort
+    write: bool        # update/scatter (True) vs read (False)
+    batched: bool      # per-row (vmap-lowered scatter) form
+    on_buffer: bool    # operand is an outer input or a scan carry
+    data_dependent: bool  # some start index derives from traced data
+    safe: bool         # every start provably leaves room for the width
+    detail: str = ""   # first failing dim: interval vs room
+
+
+def _is_lit(a) -> bool:
+    return hasattr(a, "val")
+
+
+def _lit_iv(a) -> Tuple[float, float]:
+    """Interval of a literal (or concrete const) value, if integral."""
+    v = a.val if hasattr(a, "val") else a
+    try:
+        import numpy as np
+
+        arr = np.asarray(v)
+        if arr.dtype.kind in "iu" and arr.size:
+            return (float(arr.min()), float(arr.max()))
+    except Exception:  # noqa: BLE001 — unbounded is always sound
+        pass
+    return TOP
+
+
+class _SliceWalker:
+    """Single forward pass (SSA order) accumulating interval facts,
+    data-dependence bits, buffer-ness, and slice events."""
+
+    def __init__(self) -> None:
+        self.iv: Dict[int, Tuple[float, float]] = {}
+        self.data: Set[int] = set()
+        self.parts: Dict[int, List[Any]] = {}  # concatenate components
+        self.buffers: Set[int] = set()
+        self.events: List[SliceEvent] = []
+        # ``lt(x, 0)`` predicates seen so far (pred-var id -> x), for
+        # the select_n dead-branch refinement.
+        self._lt_pred: Dict[int, Any] = {}
+
+    # -- fact lookups -------------------------------------------------
+
+    def _aiv(self, a) -> Tuple[float, float]:
+        if _is_lit(a):
+            return _lit_iv(a)
+        return self.iv.get(id(a), TOP)
+
+    def _adata(self, a) -> bool:
+        return (not _is_lit(a)) and id(a) in self.data
+
+    def _abuf(self, a) -> bool:
+        return (not _is_lit(a)) and id(a) in self.buffers
+
+    def _set(self, v, iv: Tuple[float, float], data: bool) -> None:
+        self.iv[id(v)] = iv
+        if data:
+            self.data.add(id(v))
+
+    # -- entry --------------------------------------------------------
+
+    def walk_closed(self, closed) -> List[SliceEvent]:
+        jaxpr = closed.jaxpr
+        for cv, c in zip(jaxpr.constvars, closed.consts):
+            self._set(cv, _lit_iv(c), data=False)
+        for v in jaxpr.invars:
+            self._set(v, TOP, data=True)
+            self.buffers.add(id(v))
+        self._walk(jaxpr, path="")
+        return self.events
+
+    # -- recursion ----------------------------------------------------
+
+    def _unwrap(self, s):
+        """A sub-jaxpr as emitted (Jaxpr or ClosedJaxpr): return the
+        raw jaxpr, seeding constvar facts from closed-over consts —
+        dropping them would turn a folded clamp bound into unbounded."""
+        if hasattr(s, "jaxpr"):
+            for cv, c in zip(s.jaxpr.constvars, getattr(s, "consts", ())):
+                self._set(cv, _lit_iv(c), data=False)
+            return s.jaxpr
+        return s
+
+    def _map_into(self, outer_atoms, inner_vars, *,
+                  carry_buffers: Sequence[int] = ()) -> None:
+        """Seed a sub-jaxpr's invars from the caller's operands (when
+        the arities line up — conservative TOP otherwise)."""
+        if len(outer_atoms) == len(inner_vars):
+            for o, i in zip(outer_atoms, inner_vars):
+                self._set(i, self._aiv(o), self._adata(o))
+                if self._abuf(o):
+                    self.buffers.add(id(i))
+        else:
+            for i in inner_vars:
+                self._set(i, TOP, data=True)
+        for idx in carry_buffers:
+            if idx < len(inner_vars):
+                self.buffers.add(id(inner_vars[idx]))
+
+    def _map_out(self, inner_outs, outer_outs) -> None:
+        if len(inner_outs) == len(outer_outs):
+            for i, o in zip(inner_outs, outer_outs):
+                self._set(o, self._aiv(i), self._adata(i))
+
+    def _walk(self, jaxpr, path: str) -> None:
+        for eqn in jaxpr.eqns:
+            name = eqn.primitive.name
+            if name in _SLICE_PRIMS:
+                self._slice_eqn(eqn, name, path)
+                # The updated buffer stays a buffer: later writes to the
+                # result of this write are still cache writes.
+                if name != "dynamic_slice" and eqn.outvars \
+                        and self._abuf(eqn.invars[0]):
+                    self.buffers.add(id(eqn.outvars[0]))
+            subs = [s for v in eqn.params.values() for s in _subjaxprs(v)]
+            if subs:
+                self._call_eqn(eqn, name, subs, path)
+            elif name not in _SLICE_PRIMS:
+                self._transfer(eqn, name)
+
+    def _call_eqn(self, eqn, name: str, subs, path: str) -> None:
+        sub_path = f"{path}/{name}" if path else name
+        if name == "scan":
+            nc = int(eqn.params.get("num_consts", 0))
+            ncar = int(eqn.params.get("num_carry", 0))
+            sub = self._unwrap(subs[0])
+            # Consts map through; carries and xs are loop-varying, so
+            # their intervals are unbounded — but a carry IS a candidate
+            # cache buffer (the PR 17 shape: cache carried by the decode
+            # scan), and an xs/carry slot fed from a buffer stays one.
+            if len(sub.invars) == len(eqn.invars):
+                for k, (o, i) in enumerate(zip(eqn.invars, sub.invars)):
+                    loopy = k >= nc
+                    self._set(i, TOP if loopy else self._aiv(o),
+                              data=True if loopy else self._adata(o))
+                    if self._abuf(o) or nc <= k < nc + ncar:
+                        self.buffers.add(id(i))
+            else:
+                for i in sub.invars:
+                    self._set(i, TOP, data=True)
+            self._walk(sub, sub_path)
+            return
+        if name in ("cond", "switch"):
+            # Operands after the predicate map positionally into every
+            # branch (the events.py convention).
+            ops = eqn.invars[1:]
+            for s in subs:
+                sub = self._unwrap(s)
+                self._map_into(ops, sub.invars)
+                self._walk(sub, sub_path)
+            return
+        if name in ("while",):
+            for s in subs:
+                sub = self._unwrap(s)
+                for i in sub.invars:
+                    self._set(i, TOP, data=True)
+                self._walk(sub, sub_path)
+            return
+        # pjit / closed_call / shard_map / pmap / custom_* / remat:
+        # positional operand mapping, outvars mapped back.
+        for s in subs:
+            sub = self._unwrap(s)
+            self._map_into(eqn.invars, sub.invars)
+            self._walk(sub, sub_path)
+            self._map_out(sub.outvars, eqn.outvars)
+
+    # -- interval transfer --------------------------------------------
+
+    def _transfer(self, eqn, name: str) -> None:
+        out = eqn.outvars[0] if eqn.outvars else None
+        if out is None:
+            return
+        a = eqn.invars
+        if name == "lt" and len(a) == 2 and _is_lit(a[1]) \
+                and _lit_iv(a[1]) == (0.0, 0.0) and not _is_lit(a[0]):
+            self._lt_pred[id(out)] = a[0]
+        dd = any(self._adata(x) for x in a)
+        if name in _PASSTHROUGH:
+            self._set(out, self._aiv(a[0]), dd)
+        elif name == "iota":
+            dim = int(eqn.params.get("dimension", 0))
+            size = out.aval.shape[dim] if out.aval.shape else 1
+            self._set(out, (0.0, float(max(0, size - 1))), False)
+        elif name == "add":
+            (l1, h1), (l2, h2) = self._aiv(a[0]), self._aiv(a[1])
+            self._set(out, (l1 + l2, h1 + h2), dd)
+        elif name == "sub":
+            (l1, h1), (l2, h2) = self._aiv(a[0]), self._aiv(a[1])
+            self._set(out, (l1 - h2, h1 - l2), dd)
+        elif name == "max":
+            (l1, h1), (l2, h2) = self._aiv(a[0]), self._aiv(a[1])
+            self._set(out, (max(l1, l2), max(h1, h2)), dd)
+        elif name == "min":
+            (l1, h1), (l2, h2) = self._aiv(a[0]), self._aiv(a[1])
+            self._set(out, (min(l1, l2), min(h1, h2)), dd)
+        elif name == "clamp":  # clamp(lo, x, hi)
+            (ll, _lh), (xl, xh) = self._aiv(a[0]), self._aiv(a[1])
+            (_hl, hh) = self._aiv(a[2])
+            self._set(out, (max(xl, ll), min(xh, hh)), dd)
+        elif name == "mul":
+            ivs = [self._aiv(x) for x in a]
+            lits = [x for x in a if _is_lit(x)]
+            if lits and _lit_iv(lits[0])[0] >= 0:
+                k = _lit_iv(lits[0])[0]
+                other = ivs[1] if _is_lit(a[0]) else ivs[0]
+                self._set(out, (other[0] * k, other[1] * k), dd)
+            else:
+                self._set(out, TOP, dd)
+        elif name == "rem":
+            (xl, _xh), (dl, dh) = self._aiv(a[0]), self._aiv(a[1])
+            if dl == dh and dl > 0 and dl != POS:
+                lo = 0.0 if xl >= 0 else -(dl - 1)
+                self._set(out, (lo, dl - 1), dd)
+            else:
+                self._set(out, TOP, dd)
+        elif name in ("lt", "le", "gt", "ge"):
+            # Boolean interval; decidable comparisons fold to a constant
+            # so the select_n negative-index normalization over static
+            # indices (``xs[:, -1]`` → ``select_n(lt(-1, 0), ...)``)
+            # resolves instead of widening to the union.
+            (l1, h1), (l2, h2) = self._aiv(a[0]), self._aiv(a[1])
+            if name in ("gt", "ge"):  # a cmp b  ==  b cmp' a
+                (l1, h1), (l2, h2) = (l2, h2), (l1, h1)
+                name = "lt" if name == "gt" else "le"
+            strict = name == "lt"
+            if (h1 < l2) or (not strict and h1 == l2):
+                self._set(out, (1.0, 1.0), dd)
+            elif (l1 > h2) or (strict and l1 == h2):
+                self._set(out, (0.0, 0.0), dd)
+            else:
+                self._set(out, (0.0, 1.0), dd)
+        elif name == "select_n":
+            self._select_n(eqn, out, dd)
+        elif name == "concatenate":
+            self._concat(eqn, out, dd)
+        else:
+            self._set(out, TOP, dd)
+
+    def _select_n(self, eqn, out, dd: bool) -> None:
+        """Union of the branch intervals — refined twice: a literal
+        predicate selects its branch outright (jit emits unfolded
+        ``select_n`` over literals), and the
+        ``select_n(lt(x, 0), x, x + D)`` negative-index normalization
+        has a dead wrap branch when ``x`` is provably non-negative."""
+        pred, *branches = eqn.invars
+        if _is_lit(pred):
+            try:
+                import numpy as np
+
+                k = int(bool(np.asarray(pred.val).flat[0]))
+                self._set(out, self._aiv(branches[min(k,
+                          len(branches) - 1)]), dd)
+                return
+            except Exception:  # noqa: BLE001 — fall through to union
+                pass
+        ivs = [self._aiv(b) for b in branches]
+        plo, phi = self._aiv(pred)
+        if plo == phi and plo in (0.0, 1.0):
+            # Folded comparison predicate (see the cmp transfer above).
+            self._set(out, ivs[min(int(plo), len(branches) - 1)], dd)
+            return
+        lt = self._lt_pred.get(id(pred)) if not _is_lit(pred) else None
+        if (lt is not None and len(branches) == 2
+                and branches[0] is lt and self._aiv(lt)[0] >= 0):
+            self._set(out, self._aiv(branches[0]), dd)
+            return
+        self._set(out, (min(i[0] for i in ivs), max(i[1] for i in ivs)),
+                  dd)
+
+    def _concat(self, eqn, out, dd: bool) -> None:
+        ivs = [self._aiv(x) for x in eqn.invars]
+        self._set(out, (min(i[0] for i in ivs), max(i[1] for i in ivs)),
+                  dd)
+        # Component provenance for scatter index vectors: record each
+        # operand once per unit it contributes along the concat dim.
+        dim = int(eqn.params.get("dimension", 0))
+        comps: List[Any] = []
+        for x in eqn.invars:
+            shape = getattr(getattr(x, "aval", None), "shape", ())
+            n = int(shape[dim]) if dim < len(shape) else 1
+            comps.extend([x] * max(1, n))
+        self.parts[id(out)] = comps
+
+    # -- slice checks -------------------------------------------------
+
+    def _slice_eqn(self, eqn, name: str, path: str) -> None:
+        if name == "dynamic_update_slice":
+            operand, update = eqn.invars[0], eqn.invars[1]
+            starts = list(eqn.invars[2:])
+            widths = list(update.aval.shape) or [1] * len(starts)
+            self._emit(eqn, name, path, write=True, batched=False,
+                       operand=operand, starts=starts, widths=widths)
+        elif name == "dynamic_slice":
+            operand = eqn.invars[0]
+            starts = list(eqn.invars[1:])
+            widths = list(eqn.params.get("slice_sizes", ()))
+            self._emit(eqn, name, path, write=False, batched=False,
+                       operand=operand, starts=starts, widths=widths)
+        elif name == "scatter":
+            self._scatter_eqn(eqn, path)
+
+    def _scatter_eqn(self, eqn, path: str) -> None:
+        mode = str(eqn.params.get("mode", ""))
+        if "CLIP" not in mode.upper():
+            return  # FILL_OR_DROP drops OOB rows — a different contract
+        operand, indices, updates = eqn.invars[:3]
+        dn = eqn.params.get("dimension_numbers")
+        if dn is None:
+            return
+        inserted = set(getattr(dn, "inserted_window_dims", ()) or ())
+        obatch = set(getattr(dn, "operand_batching_dims", ()) or ())
+        op_window = [d for d in range(len(operand.aval.shape))
+                     if d not in inserted and d not in obatch]
+        win = {od: int(updates.aval.shape[uw]) for od, uw in
+               zip(op_window, getattr(dn, "update_window_dims", ()))}
+        comps = self.parts.get(id(indices))
+        starts: List[Any] = []
+        widths: List[int] = []
+        dims: List[int] = []
+        for k, od in enumerate(
+                getattr(dn, "scatter_dims_to_operand_dims", ())):
+            starts.append(comps[k] if comps and k < len(comps) else None)
+            widths.append(win.get(int(od), 1))
+            dims.append(int(od))
+        self._emit(eqn, "scatter", path, write=True,
+                   batched=bool(obatch), operand=operand, starts=starts,
+                   widths=widths, dims=dims)
+
+    def _emit(self, eqn, op: str, path: str, *, write: bool,
+              batched: bool, operand, starts, widths,
+              dims: Optional[List[int]] = None) -> None:
+        shape = list(operand.aval.shape)
+        dims = dims if dims is not None else list(range(len(starts)))
+        dd = False
+        detail = ""
+        safe = True
+        for s, w, d in zip(starts, widths, dims):
+            if s is None:
+                safe, detail = False, f"dim {d}: untracked index"
+                dd = True
+                break
+            lo, hi = self._aiv(s)
+            dd = dd or self._adata(s)
+            room = shape[d] - int(w)
+            if lo < 0 or hi > room:
+                safe = False
+                span = (f"[{int(lo) if lo > NEG else '-inf'}, "
+                        f"{int(hi) if hi < POS else 'inf'}]")
+                detail = (f"dim {d}: start in {span}, room "
+                          f"[0, {room}] for width {w} in {shape[d]}")
+                break
+        self.events.append(SliceEvent(
+            op=op, path=path, source=_user_source(eqn.source_info),
+            write=write, batched=batched, on_buffer=self._abuf(operand),
+            data_dependent=dd, safe=safe, detail=detail))
+
+
+def trace_slice_events(closed_jaxpr) -> List[SliceEvent]:
+    """All dynamic-slice-family events in a traced program, with the
+    interval-analysis safety verdict attached."""
+    return _SliceWalker().walk_closed(closed_jaxpr)
+
+
+__all__ = ["SliceEvent", "trace_slice_events"]

@@ -543,6 +543,28 @@ def generate_parallel(model, params, prompt, steps: int, *, mesh,
 # ---------------------------------------------------------------------------
 
 
+def clamp_slot_positions(positions, limit, width=1):
+    """THE cache-index clamp chokepoint: bound ``positions`` (scalar or
+    [S]) to ``[0, limit - width]`` so a width-``width``
+    ``dynamic_update_slice``/``dynamic_slice`` at each position provably
+    stays inside a ``limit``-deep buffer.  For valid inputs (the only
+    inputs correct callers produce — serving/engine.py clamps host-side)
+    this is bitwise the identity; what it buys is the PROOF: an
+    out-of-range start otherwise CLAMPS silently (corrupt last rows, no
+    error — the PR 17 bug class), and the static analyzer's S1 rule can
+    only certify a write whose index is visibly bounded.  Every cache
+    write in ``transformer.SPAttention`` decode and the TP decode blocks
+    routes through here; S2 flags per-row slot writes that don't (the
+    trace record below is its evidence).
+    """
+    from .. import fusion
+
+    limit, width = int(limit), int(width)
+    fusion._emit_trace_record(
+        {"kind": "slot_clamp", "limit": limit, "width": width})
+    return jnp.clip(jnp.asarray(positions), 0, max(0, limit - width))
+
+
 def _greedy_sampling(n):
     """Sentinel sampling arrays for n rows: greedy, filter no-ops."""
     return (jnp.zeros((n,), jnp.uint32), jnp.zeros((n,), jnp.int32),
@@ -563,7 +585,9 @@ def _slot_prefill_jit(dmodel, params, prompt, true_len, seeds, idxs,
     # unpadded ones; the pad positions' k/v land in the cache but every
     # later query is depth-masked below them until the decode steps
     # overwrite them in order.)
-    x_last = lax.dynamic_slice_in_dim(xs, true_len - 1, 1, axis=1)[:, 0]
+    x_last = lax.dynamic_slice_in_dim(
+        xs, clamp_slot_positions(true_len - 1, xs.shape[1]), 1,
+        axis=1)[:, 0]
     first = _sample_rows(x_last @ head, _sample_keys(seeds, idxs),
                          temps, top_ks, top_ps, prompt.dtype)
     return updated["cache"], first
@@ -657,6 +681,11 @@ def slot_verify_step(dmodel, params, cache, tokens, positions,
 
 @jax.jit
 def _slot_write_jit(pool_cache, one_cache, slot):
+    pooled = [p for p in jax.tree.leaves(pool_cache)
+              if getattr(p, "ndim", 0) >= 1]
+    if pooled:
+        slot = clamp_slot_positions(slot, pooled[0].shape[0])
+
     def put(p, o):
         if getattr(o, "ndim", 0) >= 1 and o.shape[0] == 1 \
                 and p.ndim == o.ndim:

@@ -33,7 +33,8 @@ from jax import lax
 
 from ..parallel import tensor as tp
 from .generate import _beam_backtrack, _beam_expand, _check_sampling, \
-    _greedy_sampling, _sample, _sample_keys, _sample_rows
+    _greedy_sampling, _sample, _sample_keys, _sample_rows, \
+    clamp_slot_positions
 from .transformer import apply_rope
 
 
@@ -160,6 +161,9 @@ def _block_decode(x, p, cache, pos, axis, num_heads):
     ck, cv = cache
     B = x.shape[0]
     t_max = ck.shape[1]
+    # The clamp chokepoint (generate.clamp_slot_positions): identity in
+    # the valid range, makes the writes below S1-certifiable.
+    pos = clamp_slot_positions(pos, t_max)
     h = _ln(x, *p["ln1"])
     q, k1, v1, width, dh = _qkv_local(h, p, axis, num_heads, pos[None])
     ck = lax.dynamic_update_slice(ck, k1, (0, pos, 0, 0))
@@ -186,6 +190,10 @@ def _block_decode_rows(x, p, cache, pos_rows, axis, num_heads):
     ck, cv = cache
     S, T, _ = x.shape
     t_max = ck.shape[1]
+    # Per-row clamp chokepoint: the vmapped update below lowers to a
+    # mode=CLIP scatter, which silently corrupts on an out-of-range
+    # row position — clamped positions are S1/S2-certifiable.
+    pos_rows = clamp_slot_positions(pos_rows, t_max, T)
     h = _ln(x, *p["ln1"])
     q_pos = pos_rows[:, None] + jnp.arange(T, dtype=jnp.int32)  # [S, T]
     q, k1, v1, width, dh = _qkv_local(h, p, axis, num_heads, q_pos)
@@ -413,7 +421,9 @@ def _tp_slot_prefill_body(params, prompt, true_len, seeds, idxs, temps,
     # Slice at the TRUE last position (bucketed prefill right-pads the
     # prompt; causality keeps real positions bitwise independent of the
     # padding — see generate.slot_prefill).
-    x_true = lax.dynamic_slice_in_dim(x, true_len - 1, 1, axis=1)[:, 0]
+    x_true = lax.dynamic_slice_in_dim(
+        x, clamp_slot_positions(true_len - 1, x.shape[1]), 1,
+        axis=1)[:, 0]
     first = _sample_rows(
         _logits(_ln(x_true, *params["ln_f"]), params, axis),
         _sample_keys(seeds, idxs), temps, top_ks, top_ps, prompt.dtype)

@@ -31,6 +31,7 @@ from jax import lax
 
 from ..parallel import expert as eplib
 from ..parallel import sequence as seqlib
+from .generate import clamp_slot_positions
 
 AxisNames = Union[str, Tuple[str, ...]]
 
@@ -178,8 +179,16 @@ class SPAttention(nn.Module):
                                (B, self.max_len, h_cache, D), jnp.float32)
             idx = self.variable("cache", "idx",
                                 lambda: jnp.zeros((), jnp.int32))
-            start = idx.value
-            starts = po.astype(jnp.int32) if per_row else None  # [B]
+            # Write indices route through THE clamp chokepoint
+            # (generate.clamp_slot_positions): identity for the valid
+            # range the callers guarantee, but it makes the cache writes
+            # below statically certifiable (analysis rules S1/S2) —
+            # without it an out-of-range index would CLAMP inside
+            # dynamic_update_slice and corrupt the last rows silently.
+            start = clamp_slot_positions(idx.value, self.max_len, T)
+            starts = (clamp_slot_positions(po.astype(jnp.int32),
+                                           self.max_len, T)
+                      if per_row else None)  # [B]
             if self.rope:
                 # Rotate by absolute cache positions, THEN cache: the
                 # cache holds rotated keys, so old entries never need

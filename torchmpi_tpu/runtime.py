@@ -600,6 +600,17 @@ def init(config: Optional[Config] = None, **overrides) -> Mesh:
                             "TORCHMPI_TPU_SERVING_SLOT_TOKENS", int)
         _env_default_pickup(cfg, "serving_replicas",
                             "TORCHMPI_TPU_SERVING_REPLICAS", int)
+        _env_default_pickup(cfg, "serving_sample",
+                            "TORCHMPI_TPU_SERVING_SAMPLE", float)
+        _env_default_pickup(cfg, "serving_spec_k",
+                            "TORCHMPI_TPU_SERVING_SPEC_K", int)
+        _env_default_pickup(cfg, "serving_prefill_buckets",
+                            "TORCHMPI_TPU_SERVING_PREFILL_BUCKETS", int)
+        if cfg.serving_spec_k < 0 or cfg.serving_prefill_buckets < 0:
+            raise ValueError(
+                f"config.serving_spec_k and serving_prefill_buckets "
+                f"must be >= 0 (0 = off), got {cfg.serving_spec_k}/"
+                f"{cfg.serving_prefill_buckets}")
         if cfg.serving_slots < 1 or cfg.serving_replicas < 1 \
                 or cfg.serving_slot_tokens < 0:
             raise ValueError(
@@ -870,6 +881,11 @@ def set_config(**kw) -> None:
             raise ValueError(f"unknown config field {k!r}")
         if k == "backend_per_op" and v is not None:
             v = _validate_backend_per_op(v)
+        if k == "analysis":
+            v = _normalize_analysis(v)
+            if v is None:
+                raise ValueError(
+                    "config.analysis must be off|warn|error")
         if k == "obs":
             v = _normalize_obs(v)
             if v is None:
@@ -945,6 +961,9 @@ def set_config(**kw) -> None:
             if v is None:
                 raise ValueError(
                     "config.elastic_quorum must be off|majority")
+        if k == "elastic_dir":
+            # Same one-home normalization as init: "" means unset.
+            v = v or None
         if k == "gradsync_overlap":
             v = _normalize_overlap(v)
             if v is None:
@@ -978,6 +997,13 @@ def set_config(**kw) -> None:
                 raise ValueError(
                     "config.serving_slot_tokens must be >= 0 "
                     "(0 = model max_len)")
+        if k == "serving_sample":
+            # <= 0 means greedy (config.py), so only the type is pinned.
+            v = float(v)
+        if k in ("serving_spec_k", "serving_prefill_buckets"):
+            v = int(v)
+            if v < 0:
+                raise ValueError(f"config.{k} must be >= 0 (0 = off)")
         if k == "fault_retries":
             v = int(v)
         if k in ("fault_backoff_s", "fault_deadline_s"):
@@ -1003,6 +1029,11 @@ def set_config(**kw) -> None:
             mod = sys.modules.get(__package__ + ".obs")
             if mod is not None:
                 mod.deactivate()
+    if "analysis" in kw and _state.config.analysis != "off":
+        # Same arming as init: capture + the ANALYSIS_OUT atexit report.
+        from . import analysis
+
+        analysis.arm_runtime_capture()
     if ("watchdog" in kw or "watchdog_deadline_s" in kw
             or "watchdog_poll_s" in kw or "watchdog_dir" in kw):
         if _state.config.watchdog != "off":
