@@ -10,21 +10,44 @@ same script measures the scaled throughput directly.
 Runs a staged resilience ladder: A matmul probe, B TransformerLM train
 step, C Pallas flash-attention kernel (real TPU only), C2 fused xent,
 B' the flagship modern-LM step, D the headline ResNet-50 train step.
-Wedge-proofing (VERDICT r4 #1):
+Wedge-proofing (VERDICT r4 #1, hardened to per-stage isolation):
 
 - the supervisor PROBES relay liveness in a bounded subprocess before
   spending the ladder budget — a dead relay costs ~2 min, not the full
   timeout, and falls straight to the banked path;
-- when stage D's compile marker shows a WARM cache, the headline runs
-  FIRST (warm replay is minutes), so a mid-ladder wedge can no longer
-  take the headline with it; a cold cache keeps cheapest-first order
-  (a cold D compile first could eat the whole budget banking nothing);
+- EACH LADDER STAGE runs in its OWN bounded subprocess
+  (``TORCHMPI_TPU_BENCH_STAGE=<key>``) with the collective watchdog
+  (docs/WATCHDOG.md) armed in ``break`` mode, so a wedge is confined
+  to the stage it struck: that stage falls to its banked record while
+  every other stage still runs live.  The wedge signature is a stage
+  timeout OR the watchdog's escalation exit (113); a stage child that
+  CRASHED any other way stays a loud partial note, never a banked
+  substitution.  After a wedge the relay is re-probed — a dead relay
+  sends the remaining stages straight to the bank instead of burning
+  their caps one timeout at a time.  (This supersedes the old
+  headline-first-when-warm ordering: isolation protects the headline,
+  so the supervisor always runs cheapest-first; the child keeps the
+  warm-first logic for the launcher/coordinator path, which has no
+  supervisor.)
 - each completed stage is appended to a durable per-stage stream
   (``docs/artifacts/bench_stream_<stamp>.jsonl``) the moment it
   finishes, so records survive even a SIGKILL of the supervisor;
 - the banked fallback is PER-STAGE: stages that completed live stay
   live, and only stages that never ran are substituted from the newest
-  config-matched banked artifact (marked ``*_banked``).
+  config-matched banked artifact (marked ``*_banked``).  Every
+  substitute carries a STALENESS stamp (``banked_age_rounds`` in
+  ``extra.stage_meta``, from ``docs/artifacts/round_ledger.json``); a
+  record older than TORCHMPI_TPU_BENCH_STALE_ROUNDS (default 3) rounds
+  is marked ``stale`` and, when it is the final headline, reports
+  ``vs_baseline: null`` — an ancient number must not masquerade as a
+  trajectory point.
+- each round also banks the CPU-sim micro-ladders
+  (``collectives_bench --plan/--dcn/--overlap/--obs/--guard/
+  --watchdog-compare``) into ``SUMMARY_BANK.json`` via
+  ``benchmarks/banking.py --bank --round N``, so subsystem-level
+  evidence accrues per round even when the TPU ladder wedges
+  (skipped for the tiny smoke preset; opt out with
+  TORCHMPI_TPU_BENCH_NO_MICRO=1).
 
 Each completed stage prints one JSON record; the supervisor re-emits the
 HIGHEST-PRIORITY stage (ResNet > transformer > flash > matmul, live
@@ -349,6 +372,234 @@ def latest_banked_for_metric(metric, want=None, art_dir=None):
     return None
 
 
+# ---------------------------------------------------------------------------
+# Per-stage isolation + round/staleness bookkeeping
+# ---------------------------------------------------------------------------
+
+# Supervisor-side stage table: (key, metric, needs).  ``needs`` is the
+# platform gate the SUPERVISOR applies before paying a child's startup
+# ("any" / "tpu" / "tpu_or_tiny", from the probe's reported platform);
+# the child applies the same gate internally (the launcher/coordinator
+# path has no supervisor), so the two can only agree to skip, never
+# disagree.  Order is cheapest-first — isolation, not ordering, now
+# protects the headline (module docstring).
+STAGE_DEFS = [
+    ("A", "matmul_bf16_tflops", "any"),
+    ("B", "transformer_lm_train_throughput", "any"),
+    ("C", "flash_attention_tflops", "tpu"),
+    ("C2", "fused_xent_tflops", "tpu"),
+    ("B2", "transformer_lm_large_train_throughput", "tpu_or_tiny"),
+    ("D", "resnet50_dp_train_throughput", "any"),
+    ("D2", "resnet50_dp_train_throughput_scanned", "tpu"),
+]
+
+# Per-stage wall caps (seconds), each further bounded by the remaining
+# ladder budget.  Sized from the measured cold-compile ceilings (stage
+# D >900 s cold is already excluded by its own budget gate; the cap
+# here is the backstop for a wedged warm replay).
+STAGE_CAPS = {"A": 240, "B": 420, "C": 300, "C2": 300, "B2": 600,
+              "D": 900, "D2": 420}
+
+# torchmpi_tpu.watchdog.ESCALATE_EXIT_CODE, duplicated as a literal so
+# the supervisor never imports the package (importing it would drag jax
+# into the watchdog-less parent).  test_bench_contract pins the two.
+WEDGE_EXIT_CODE = 113
+
+# A banked substitute older than this many rounds is marked stale and
+# loses its vs_baseline (module docstring).
+STALE_ROUNDS = int(os.environ.get("TORCHMPI_TPU_BENCH_STALE_ROUNDS", "3"))
+
+
+def current_round():
+    """This run's bench round number.  The driver's ``BENCH_r<NN>.json``
+    records carry no round field of their own, so the count of existing
+    records + 1 IS the round being measured; TORCHMPI_TPU_BENCH_ROUND
+    overrides (tests, re-runs of a past round)."""
+    env_round = os.environ.get("TORCHMPI_TPU_BENCH_ROUND")
+    if env_round:
+        return int(env_round)
+    import glob
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    return len(glob.glob(os.path.join(root, "BENCH_r*.json"))) + 1
+
+
+# Ledger seed: rounds whose first artifact stamps predate the ledger
+# itself, reconstructed from repo history (each round's window opens at
+# the previous BENCH_r<NN>.json commit date; r3 banked 0730 artifacts,
+# r4 banked 20260731 — docs/artifacts/).  Without the seed every
+# pre-ledger artifact would read as current-round fresh.  The committed
+# docs/artifacts/round_ledger.json supersedes this; the seed is the
+# fallback for bare checkouts/tests.
+_ROUND_LEDGER_SEED = [
+    {"round": 1, "first_stamp": "20260729_000000"},
+    {"round": 2, "first_stamp": "20260729_040000"},
+    {"round": 3, "first_stamp": "20260729_220000"},
+    {"round": 4, "first_stamp": "20260730_180000"},
+    {"round": 5, "first_stamp": "20260731_200000"},
+]
+
+
+def load_round_ledger(art_dir, rnd=None):
+    """``docs/artifacts/round_ledger.json``: a list of
+    ``{"round": N, "first_stamp": "%Y%m%d_%H%M%S"}`` entries mapping
+    each bench round to the stamp of its first run, so an artifact
+    filename's stamp resolves to the round that produced it
+    (``artifact_round``).  When ``rnd`` is given and absent from the
+    ledger, this run IS that round's first — its entry is appended and
+    persisted (best-effort: a read-only checkout still gets the
+    in-memory ledger)."""
+    path = os.path.join(art_dir, "round_ledger.json")
+    try:
+        with open(path) as f:
+            ledger = json.load(f)
+    except (OSError, ValueError):
+        ledger = None
+    if not isinstance(ledger, list) or not ledger:
+        ledger = [dict(e) for e in _ROUND_LEDGER_SEED]
+    if rnd is not None and all(e.get("round") != rnd for e in ledger):
+        ledger.append({"round": int(rnd),
+                       "first_stamp": time.strftime("%Y%m%d_%H%M%S")})
+        ledger.sort(key=lambda e: e.get("round", 0))
+        try:
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(ledger, f, indent=1)
+                f.write("\n")
+            os.replace(tmp, path)
+        except OSError as e:
+            log(f"round ledger not persisted: {e}")
+    return ledger
+
+
+def artifact_round(fname, ledger):
+    """The bench round a banked artifact belongs to: the newest ledger
+    entry whose first_stamp is <= the artifact's stamp, or the oldest
+    ledger round for pre-ledger artifacts (they are AT LEAST that old —
+    age can only be under-, never over-reported).  Legacy 4-digit
+    stamps are round-3-era (repo history: all predate 2026-07-31) and
+    normalize with the 2026 year for the comparison only —
+    _stamp_sort_key's cross-year ordering is unaffected.  None when the
+    filename carries no stamp at all."""
+    import re
+
+    m = re.match(r"bench_(?:stream_)?(\d{8}|\d{4})_(\d{6})",
+                 os.path.basename(fname))
+    if not m:
+        return None
+    date, clock = m.groups()
+    if len(date) == 4:
+        date = "2026" + date
+    stamp = f"{date}_{clock}"
+    rounds = None
+    for e in sorted(ledger, key=lambda e: str(e.get("first_stamp", ""))):
+        if str(e.get("first_stamp", "")) <= stamp:
+            rounds = e.get("round")
+    if rounds is None and ledger:
+        rounds = min(e.get("round", 0) for e in ledger)
+    return rounds
+
+
+def banked_age_rounds(fname, ledger, rnd):
+    """How many rounds old a banked artifact is relative to the current
+    round ``rnd`` (0 = banked this round), or None when unknowable."""
+    src_round = artifact_round(fname, ledger)
+    if src_round is None:
+        return None
+    return max(0, int(rnd) - int(src_round))
+
+
+# CPU-sim micro-ladders banked once per round (module docstring): each
+# is one bounded ``collectives_bench`` subprocess whose final
+# ``KIND-SUMMARY {json}`` line ``--bank`` persists to SUMMARY_BANK.json
+# with the round stamp.  Invocations mirror the tier-1 CI jobs so the
+# banked history and the CI assertions measure the same thing.
+MICRO_LADDERS = [
+    ("PLAN-SUMMARY", ["--plan-compare", "--iters", "20",
+                      "--steady", "100"]),
+    ("DCN-SUMMARY", ["--dcn", "2", "--dcn-compare", "--iters", "5",
+                     "--steady", "100"]),
+    ("OVERLAP-SUMMARY", ["--overlap-compare", "--iters", "5"]),
+    ("OBS-SUMMARY", ["--obs-compare", "--iters", "10"]),
+    ("GUARD-SUMMARY", ["--guard-compare", "--iters", "10"]),
+    ("WATCHDOG-SUMMARY", ["--watchdog-compare", "--iters", "10"]),
+]
+
+
+def run_micro_ladders(rnd, budget_end):
+    """Run + bank each micro-ladder on the forced-CPU sim (never the
+    relay: these measure library mechanisms, not silicon, and must not
+    queue compiles behind the TPU stages).  Returns {kind: outcome}."""
+    root = os.path.dirname(os.path.abspath(__file__))
+    cli = os.path.join(root, "benchmarks", "collectives_bench.py")
+    cap_each = float(os.environ.get(
+        "TORCHMPI_TPU_BENCH_MICRO_TIMEOUT", "240"))
+    results = {}
+    for kind, extra_args in MICRO_LADDERS:
+        remaining = budget_end - time.time()
+        if remaining < 45:
+            results[kind] = "skipped: ladder budget exhausted"
+            log(f"micro-ladder {kind}: {results[kind]}")
+            continue
+        menv = dict(os.environ)
+        menv["JAX_PLATFORMS"] = "cpu"
+        menv["TORCHMPI_TPU_BENCH_ROUND"] = str(rnd)
+        menv.pop("TORCHMPI_TPU_BENCH_STAGE", None)
+        menv.pop("XLA_FLAGS", None)  # sim sets its own device count
+        cmd = [sys.executable, cli, "--devices", "8", *extra_args,
+               "--bank", "--round", str(rnd)]
+        try:
+            out = subprocess.run(
+                cmd, capture_output=True, text=True,
+                timeout=min(cap_each, remaining), env=menv, cwd=root)
+            ok = out.returncode == 0 and any(
+                ln.startswith(kind + " ")
+                for ln in out.stdout.splitlines())
+            results[kind] = ("banked" if ok
+                             else f"failed: rc={out.returncode}")
+        except subprocess.TimeoutExpired:
+            results[kind] = f"wedged: timeout after {cap_each:.0f}s"
+        log(f"micro-ladder {kind}: {results[kind]}")
+    return results
+
+
+def bank_stage_counters(outcomes, n_banked):
+    """tm_bench_stage_{live,banked,wedged}_total: the supervisor's
+    per-stage outcome tally, written as a standard obs metrics dump
+    (meta line + counter records, the obs/__init__.dump shape) so
+    ``obs_tool agg`` / ``chaos_tool summarize`` read it like any host's.
+    Gated on TORCHMPI_TPU_OBS like every emitter; written by hand
+    because the supervisor must never import the package (jax).  The
+    counters live outside the package, so hostcheck lists them in
+    H2_DOC_IGNORE."""
+    mode = os.environ.get("TORCHMPI_TPU_OBS", "off")
+    if mode in ("", "off"):
+        return None
+    counts = {"live": 0, "banked": int(n_banked), "wedged": 0}
+    for o in outcomes.values():
+        if o["outcome"] in ("live", "wedged"):
+            counts[o["outcome"]] += 1
+    out_dir = os.environ.get("TORCHMPI_TPU_OBS_DIR",
+                             "/tmp/torchmpi_tpu_obs")
+    path = os.path.join(out_dir, f"metrics_host{os.getpid()}.jsonl")
+    try:
+        os.makedirs(out_dir, exist_ok=True)
+        with open(path, "w") as f:
+            f.write(json.dumps(
+                {"kind": "meta", "stream": "metrics",
+                 "host": str(os.getpid()), "pid": os.getpid(),
+                 "mode": mode, "time": time.time()}) + "\n")
+            for k in ("live", "banked", "wedged"):
+                f.write(json.dumps(
+                    {"kind": "counter",
+                     "name": f"tm_bench_stage_{k}_total",
+                     "labels": {}, "value": counts[k]}) + "\n")
+    except OSError as e:
+        log(f"stage-outcome counters not written: {e}")
+        return None
+    return path
+
+
 # Probe-path heartbeat-drain cap: long enough to outlast a signal-shadow
 # window around an in-flight compile's heartbeat refresh, SHORT enough
 # that a dead-relay verdict stays in probe territory (~minutes) instead
@@ -374,7 +625,12 @@ def relay_probe(env, timeout_s=150.0):
     delivers the verdict).  Termination is SIGTERM-then-bounded-KILL
     with the heartbeat drain before each signal, mirroring
     scripts/tpu_watch.run_bounded — a bare SIGKILL mid-device-claim is
-    the round-1 wedge class.  Returns ``(alive, seconds)``."""
+    the round-1 wedge class.  Returns ``(alive, seconds, platform)`` —
+    platform parsed from the probe's ``ALIVE <platform>`` line (None
+    when dead), which the per-stage supervisor uses to skip TPU-only
+    stage children without paying their startup."""
+    import re
+
     t0 = time.time()
     for attempt in (1, 2):
         proc = subprocess.Popen(
@@ -397,19 +653,26 @@ def relay_probe(env, timeout_s=150.0):
                 log("probe timed out behind a blessed compile in flight; "
                     "retrying once after the drain")
                 continue
-            return False, time.time() - t0
-        alive = proc.returncode == 0 and "ALIVE" in (out or "")
-        return alive, time.time() - t0
-    return False, time.time() - t0
+            return False, time.time() - t0, None
+        m = re.search(r"ALIVE (\w+)", out or "")
+        alive = proc.returncode == 0 and m is not None
+        return alive, time.time() - t0, m.group(1) if alive else None
+    return False, time.time() - t0, None
 
 
-def compose_final(forwarded, reason, wedge, art_dir=None):
+def compose_final(forwarded, reason, wedge, art_dir=None,
+                  round_info=None):
     """Build the final driver-visible record from the live stage records
     plus — on the wedge signature only — per-stage banked substitutes
     for stages that never ran (VERDICT r4 #1).  The final line is the
     highest-priority stage present from either source, live preferred
     over banked at the same stage; ``extra.stages`` carries every live
     value keyed by metric and every substitute keyed ``<metric>_banked``.
+    ``round_info`` = ``(current_round, ledger)`` stamps every banked
+    substitute's age in rounds (``extra.stage_meta``); a substitute
+    older than STALE_ROUNDS is marked stale, and a STALE FINAL record
+    reports ``vs_baseline: null`` + top-level ``stale: true`` — the
+    trajectory ratio is only meaningful against a recent denominator.
     Returns ``(record_or_None, rc)``; a crashed child with nothing
     measured stays a loud ``(None, 1)`` for the caller to report."""
     live_by = {r.get("metric"): r for r in forwarded
@@ -425,6 +688,18 @@ def compose_final(forwarded, reason, wedge, art_dir=None):
                 banked_subs[m] = got
     if not live_by and not banked_subs:
         return None, 1
+    stage_meta = {m: {"source": "live"} for m in live_by}
+    for m, (_brec, src) in banked_subs.items():
+        meta = {"source": f"banked:{src}"}
+        if round_info is not None:
+            rnd, ledger = round_info
+            age = banked_age_rounds(src, ledger, rnd)
+            meta["banked_age_rounds"] = age
+            meta["stale"] = bool(age is not None and age > STALE_ROUNDS)
+            if meta["stale"]:
+                log(f"banked substitute for {m} ({src}) is {age} rounds "
+                    f"old (> {STALE_ROUNDS}): marked stale")
+        stage_meta[m] = meta
     stages = {m: r.get("value") for m, r in live_by.items()}
     stages.update({f"{m}_banked": rec.get("value")
                    for m, (rec, _src) in banked_subs.items()})
@@ -442,6 +717,7 @@ def compose_final(forwarded, reason, wedge, art_dir=None):
         extra = dict(rec.get("extra") or {})
         extra.pop("stage", None)
         extra["stages"] = stages
+        extra["stage_meta"] = stage_meta
         rec["extra"] = extra
         notes = []
         if reason is not None:
@@ -460,7 +736,13 @@ def compose_final(forwarded, reason, wedge, art_dir=None):
     extra["banked_from"] = src
     extra["banked_fallback"] = True
     extra["stages"] = stages
+    extra["stage_meta"] = stage_meta
     rec["extra"] = extra
+    if stage_meta.get(final_metric, {}).get("stale"):
+        # The denominator would be older than the round window: report
+        # NO trajectory ratio rather than a stale-vs-stale one.
+        rec["vs_baseline"] = None
+        rec["stale"] = True
     # A banked re-emission must never read as a live number to a
     # consumer that only looks at metric/value (ADVICE r3, medium):
     # the metric name itself carries the provenance.
@@ -475,79 +757,40 @@ def compose_final(forwarded, reason, wedge, art_dir=None):
     return rec, 0
 
 
-def supervised() -> int:
-    """Run the real benchmark in a child with a hard timeout, so a wedged
-    device runtime (observed: the TPU relay can hang all device ops
-    indefinitely after an earlier client was killed mid-claim, and its
-    serial remote-compile service can queue every later compile behind an
-    abandoned large one) still produces a measured JSON record.
+def _run_stage_child(env, stage_key, cap_s, forwarded):
+    """One bounded ladder-stage subprocess (module docstring): the child
+    re-enters ``--run`` with TORCHMPI_TPU_BENCH_STAGE pinned to this
+    key and the collective watchdog armed via env, streams its records
+    (forwarded + printed as they arrive), and is classified on exit:
 
-    The child runs the stage ladder (module docstring), streaming one JSON
-    line per completed stage; the final stdout line is the
-    highest-priority completed record, annotated with all stage values —
-    on timeout that means a real measured number instead of a bare 0.0
-    (round-2 finding: single ops compiled in seconds while the ResNet-50
-    compile exceeded 900s on the relay, so cheap stages go first)."""
-    timeout = int(os.environ.get("TORCHMPI_TPU_BENCH_TIMEOUT", "900"))
-    env = dict(os.environ)
-    env["TORCHMPI_TPU_BENCH_STAGED"] = "1"
-    # Durable per-stage stream (VERDICT r4 #1): the child appends each
-    # completed tpu-platform record here the moment it finishes, so a
-    # wedge — or even a SIGKILL of THIS supervisor — still leaves the
-    # completed stages banked for future fallbacks.
-    art_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                           "docs", "artifacts")
-    try:
-        os.makedirs(art_dir, exist_ok=True)
-        env.setdefault("TORCHMPI_TPU_BENCH_STREAM", os.path.join(
-            art_dir, f"bench_stream_{time.strftime('%Y%m%d_%H%M%S')}.jsonl"))
-    except OSError:
-        pass
-    # Give the child a host CPU backend alongside the device platform so
-    # model/optimizer init runs host-side: one big remote compile (the train
-    # step) instead of two.  The device platform stays first = default.
-    plats = env.get("JAX_PLATFORMS", "")
-    if plats and "cpu" not in plats.split(","):
-        env["JAX_PLATFORMS"] = plats + ",cpu"
-    # Pre-flight probe: don't spend the ladder budget against a relay
-    # that cannot answer a 1024x1024 matmul.  Opt out with
-    # TORCHMPI_TPU_BENCH_NO_PROBE=1 (the probe subprocess uses the same
-    # env, so CPU smoke runs probe their forced-CPU mesh in seconds).
-    if os.environ.get("TORCHMPI_TPU_BENCH_NO_PROBE") != "1":
-        alive, probe_s = relay_probe(env)
-        if not alive:
-            log(f"pre-flight probe DEAD after {probe_s:.0f}s; skipping "
-                "the live ladder, composing per-stage banked fallback")
-            rec, rc = compose_final(
-                [], f"pre-flight probe dead after {probe_s:.0f}s",
-                wedge=True)
-            if rec is not None:
-                print(json.dumps(rec), flush=True)
-                return rc
-            print(json.dumps({
-                "metric": "resnet50_dp_train_throughput",
-                "value": 0.0, "unit": "img/s/chip", "vs_baseline": 0.0,
-                "error": f"pre-flight probe dead after {probe_s:.0f}s "
-                         "and no banked artifact exists",
-            }), flush=True)
-            return 1
-        log(f"pre-flight probe alive in {probe_s:.0f}s")
-    # Tell the child when the axe falls so it can SKIP the big ResNet-50
-    # compile when the remaining budget can't absorb it, instead of
-    # launching a compile it will abandon — an abandoned compile on the
-    # relay's serial queue wedges the service for every later client
-    # (round-2 postmortem).  Set AFTER the probe: the child's budget
-    # starts when the child does, so probe time must not be billed to
-    # the stage-D budget (code review r5).
-    env.setdefault("TORCHMPI_TPU_BENCH_DEADLINE",
-                   str(time.time() + timeout))
-    proc = subprocess.Popen([sys.executable, os.path.abspath(__file__),
-                             "--run"],
-                            stdout=subprocess.PIPE, text=True, env=env)
-    # Forward each completed stage's record the moment it arrives, so the
-    # last stdout line is always the best completed measurement even if THIS
-    # process is killed by an outer harness before the run finishes.
-    forwarded = []
+    - ``live``    — rc 0 with at least one record;
+    - ``skipped`` — rc 0 with none (the child's own platform/budget
+      gate declined; not a failure);
+    - ``wedged``  — stage cap timeout OR the watchdog's escalation exit
+      (WEDGE_EXIT_CODE): the hung-device signature, eligible for
+      banked substitution;
+    - ``crashed`` — any other nonzero exit: a code regression, kept
+      loud and never substituted.
+
+    Termination on timeout is SIGTERM-then-bounded-KILL with the
+    compilegate heartbeat drain before each signal (a bare SIGKILL
+    mid-device-claim is the round-1 wedge class)."""
+    stage_env = dict(env)
+    stage_env["TORCHMPI_TPU_BENCH_STAGE"] = stage_key
+    # Per-stage budget for the child's own compile gates (stage D/B2
+    # skip compiles the remaining cap cannot absorb).
+    stage_env["TORCHMPI_TPU_BENCH_DEADLINE"] = str(time.time() + cap_s)
+    if stage_key == stage_env.get("TORCHMPI_TPU_BENCH_STALL_STAGE"):
+        # Seeded-stall seam (tests/CI): give ONLY the stalled stage a
+        # fast escalation deadline, so the contrast lands in seconds —
+        # sibling stages keep the real deadline (a global short one
+        # false-trips their compile-time windows).
+        stage_env["TORCHMPI_TPU_WATCHDOG_DEADLINE"] = stage_env.get(
+            "TORCHMPI_TPU_BENCH_STALL_DEADLINE", "3")
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--run"],
+        stdout=subprocess.PIPE, text=True, env=stage_env)
+    got = []
 
     def drain():
         for line in proc.stdout:
@@ -560,19 +803,13 @@ def supervised() -> int:
                 continue
             if isinstance(rec, dict) and "metric" in rec:
                 print(line, flush=True)
-                forwarded.append(rec)
+                got.append(rec)
 
     reader = threading.Thread(target=drain, daemon=True)
     reader.start()
-    reader.join(timeout)
-    reason = None
+    reader.join(cap_s)
+    timed_out = False
     if reader.is_alive():
-        # SIGTERM first with a grace period: a hard SIGKILL mid-device-claim
-        # is precisely what wedges the relay runtime this wrapper exists to
-        # survive.  Escalate only if the child ignores the request — and
-        # never while the child reports a blessed compile in flight
-        # (compilegate heartbeat): SIGKILL cannot be deferred, so killing
-        # then would abandon the relay's serial compile queue.
         _wait_compile_heartbeat_drain()
         proc.terminate()
         reader.join(30)
@@ -580,11 +817,10 @@ def supervised() -> int:
             _wait_compile_heartbeat_drain()
             proc.kill()
             reader.join(10)
-        reason = f"timeout after {timeout}s (device runtime unreachable?)"
+        timed_out = True
     else:
-        # stdout EOF does not mean the child exited — it can still wedge in
-        # device teardown (the hang class this wrapper exists for).  Bound
-        # the reap and escalate like the timeout path.
+        # stdout EOF does not mean the child exited — it can still
+        # wedge in device teardown.  Bound the reap and escalate.
         try:
             proc.wait(timeout=60)
         except subprocess.TimeoutExpired:
@@ -596,16 +832,167 @@ def supervised() -> int:
                 _wait_compile_heartbeat_drain()
                 proc.kill()
                 proc.wait()
-            log("child wedged in teardown after final record; killed "
+            log(f"stage {stage_key} child wedged in teardown; killed "
                 "(records already forwarded)")
-        if reason is None and proc.returncode != 0:
-            reason = f"bench child exited {proc.returncode}"
-    # Banked substitution ONLY for the wedge signature (timeout — device
-    # ops hanging).  A child that CRASHED with nothing measured is a
-    # code regression and must stay a loud rc-1 zero record, not be
-    # papered over with yesterday's number.
-    wedge = reason is not None and reason.startswith("timeout")
-    rec, rc = compose_final(forwarded, reason, wedge)
+    forwarded.extend(got)
+    if timed_out:
+        return "wedged", f"timeout after {cap_s:.0f}s"
+    if proc.returncode == WEDGE_EXIT_CODE:
+        return "wedged", f"watchdog escalation (exit {WEDGE_EXIT_CODE})"
+    if proc.returncode != 0:
+        return "crashed", f"exit {proc.returncode}"
+    if not got:
+        return "skipped", "no record (stage gate declined)"
+    return "live", None
+
+
+def supervised() -> int:
+    """Run the benchmark ladder one bounded subprocess PER STAGE, so a
+    wedged device runtime (observed: the TPU relay can hang all device
+    ops indefinitely after an earlier client was killed mid-claim, and
+    its serial remote-compile service can queue every later compile
+    behind an abandoned large one) costs exactly the stage it struck:
+    that stage falls to its banked record (with a staleness stamp) and
+    every other stage still produces a live measured number.  The final
+    stdout line is the highest-priority completed record annotated with
+    all stage values and outcomes."""
+    timeout = int(os.environ.get("TORCHMPI_TPU_BENCH_TIMEOUT", "900"))
+    env = dict(os.environ)
+    env["TORCHMPI_TPU_BENCH_STAGED"] = "1"
+    # Durable per-stage stream (VERDICT r4 #1): each stage child appends
+    # its completed tpu-platform record here the moment it finishes, so
+    # a wedge — or even a SIGKILL of THIS supervisor — still leaves the
+    # completed stages banked for future fallbacks.
+    art_dir = (os.environ.get("TORCHMPI_TPU_BENCH_ART_DIR")
+               or os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "docs", "artifacts"))
+    try:
+        os.makedirs(art_dir, exist_ok=True)
+        env.setdefault("TORCHMPI_TPU_BENCH_STREAM", os.path.join(
+            art_dir, f"bench_stream_{time.strftime('%Y%m%d_%H%M%S')}.jsonl"))
+    except OSError:
+        pass
+    # Round bookkeeping (module docstring): resolve this run's round,
+    # record its first stamp in the ledger, and share the number with
+    # every child + micro-ladder so banked evidence is stamped
+    # consistently (banking.bank_summary reads the same env).
+    rnd = current_round()
+    ledger = load_round_ledger(art_dir, rnd)
+    env.setdefault("TORCHMPI_TPU_BENCH_ROUND", str(rnd))
+    # Arm the collective watchdog inside every stage child: a stage that
+    # hangs in an instrumented wait escalates to exit 113 (the wedge
+    # signature) well before the stage cap, instead of silently burning
+    # it.  break mode — the child is disposable, the measurement is not.
+    env.setdefault("TORCHMPI_TPU_WATCHDOG", "break")
+    env.setdefault("TORCHMPI_TPU_WATCHDOG_DEADLINE", "120")
+    # Give the children a host CPU backend alongside the device platform
+    # so model/optimizer init runs host-side: one big remote compile
+    # (the train step) instead of two.  Device platform stays default.
+    plats = env.get("JAX_PLATFORMS", "")
+    if plats and "cpu" not in plats.split(","):
+        env["JAX_PLATFORMS"] = plats + ",cpu"
+    # Pre-flight probe: don't spend the ladder budget against a relay
+    # that cannot answer a 1024x1024 matmul.  Opt out with
+    # TORCHMPI_TPU_BENCH_NO_PROBE=1 (the probe subprocess uses the same
+    # env, so CPU smoke runs probe their forced-CPU mesh in seconds).
+    platform = None
+    if os.environ.get("TORCHMPI_TPU_BENCH_NO_PROBE") != "1":
+        alive, probe_s, platform = relay_probe(env)
+        if not alive:
+            log(f"pre-flight probe DEAD after {probe_s:.0f}s; skipping "
+                "the live ladder, composing per-stage banked fallback")
+            rec, rc = compose_final(
+                [], f"pre-flight probe dead after {probe_s:.0f}s",
+                wedge=True, art_dir=art_dir, round_info=(rnd, ledger))
+            if rec is not None:
+                print(json.dumps(rec), flush=True)
+                return rc
+            print(json.dumps({
+                "metric": "resnet50_dp_train_throughput",
+                "value": 0.0, "unit": "img/s/chip", "vs_baseline": 0.0,
+                "error": f"pre-flight probe dead after {probe_s:.0f}s "
+                         "and no banked artifact exists",
+            }), flush=True)
+            return 1
+        log(f"pre-flight probe alive ({platform}) in {probe_s:.0f}s")
+    # Per-stage ladder.  The overall timeout is the shared budget; each
+    # stage gets min(its cap, what remains).  Probe time is not billed
+    # (the budget clock starts here — code review r5).
+    t_end = time.time() + timeout
+    tiny = os.environ.get("TORCHMPI_TPU_BENCH_PRESET") == "tiny"
+    forwarded = []
+    outcomes = {}
+    reasons = []
+    relay_dead = None
+    for key, metric, needs in STAGE_DEFS:
+        if needs == "tpu" and platform is not None and platform != "tpu":
+            outcomes[key] = {"outcome": "skipped",
+                             "detail": f"needs tpu (platform={platform})"}
+            continue
+        if (needs == "tpu_or_tiny" and not tiny
+                and platform is not None and platform != "tpu"):
+            outcomes[key] = {"outcome": "skipped",
+                             "detail": f"needs tpu or the tiny preset "
+                                       f"(platform={platform})"}
+            continue
+        if relay_dead:
+            outcomes[key] = {"outcome": "wedged", "detail": relay_dead}
+            continue
+        remaining = t_end - time.time()
+        if remaining < 30:
+            outcomes[key] = {"outcome": "skipped",
+                             "detail": "ladder budget exhausted"}
+            log(f"stage {key}: skipped (ladder budget exhausted)")
+            continue
+        cap = min(float(STAGE_CAPS.get(key, 300)), remaining)
+        log(f"stage {key} ({metric}): launching child, cap {cap:.0f}s")
+        outcome, detail = _run_stage_child(env, key, cap, forwarded)
+        outcomes[key] = {"outcome": outcome, "detail": detail}
+        log(f"stage {key}: {outcome}" + (f" ({detail})" if detail else ""))
+        if outcome in ("wedged", "crashed"):
+            reasons.append(f"stage {key} {outcome}: {detail}")
+        if (outcome == "wedged"
+                and os.environ.get("TORCHMPI_TPU_BENCH_NO_PROBE") != "1"):
+            # A wedge may have taken the relay with it: re-probe before
+            # burning the remaining stages' caps one timeout at a time.
+            alive, probe_s, _plat = relay_probe(env)
+            if not alive:
+                relay_dead = (f"relay dead after stage {key} wedge "
+                              f"(re-probe {probe_s:.0f}s)")
+                log(relay_dead + "; remaining stages fall to the bank")
+                reasons.append(relay_dead)
+            else:
+                log(f"relay still alive after stage {key} wedge "
+                    f"(re-probe {probe_s:.0f}s); ladder continues")
+    # Per-round micro-ladder banking (module docstring).  Skipped for
+    # the tiny smoke preset — the contract test measures the ladder
+    # path, not the subsystem benches.
+    micro = None
+    if (os.environ.get("TORCHMPI_TPU_BENCH_NO_MICRO") != "1"
+            and not tiny):
+        micro = run_micro_ladders(rnd, t_end)
+    # Banked substitution ONLY for the wedge signature (stage timeout /
+    # watchdog escalation — device ops hanging).  A stage child that
+    # CRASHED is a code regression: noted loudly, never papered over
+    # with yesterday's number.
+    wedge = any(o["outcome"] == "wedged" for o in outcomes.values())
+    reason = "; ".join(reasons) if reasons else None
+    rec, rc = compose_final(forwarded, reason, wedge, art_dir=art_dir,
+                            round_info=(rnd, ledger))
+    n_banked = 0
+    if rec is not None:
+        extra = dict(rec.get("extra") or {})
+        n_banked = sum(1 for m in (extra.get("stage_meta") or {}).values()
+                       if str(m.get("source", "")).startswith("banked:"))
+        extra["bench_round"] = rnd
+        extra["stage_outcomes"] = {
+            k: (v["outcome"] if not v.get("detail")
+                else f"{v['outcome']}: {v['detail']}")
+            for k, v in outcomes.items()}
+        if micro is not None:
+            extra["micro_ladders"] = micro
+        rec["extra"] = extra
+    bank_stage_counters(outcomes, n_banked)
     if rec is not None:
         if (rec.get("extra") or {}).get("banked_fallback"):
             log("live capture wedged; falling back to banked record "
@@ -657,6 +1044,14 @@ def main():
     STEPS = 3 if tiny else 20
     WARMUP = 1 if tiny else 3
     staged = os.environ.get("TORCHMPI_TPU_BENCH_STAGED") == "1"
+    # Per-stage isolation (supervisor): when TORCHMPI_TPU_BENCH_STAGE
+    # names stage keys (comma list of A,B,C,C2,B2,D,D2), run ONLY
+    # those; unset = the whole ladder (launcher/coordinator path).
+    _only = os.environ.get("TORCHMPI_TPU_BENCH_STAGE")
+    only_keys = ({k for k in _only.split(",") if k} if _only else None)
+
+    def stage_on(key):
+        return only_keys is None or key in only_keys
     # TPU v5e ("TPU v5 lite") peak is ~197 TFLOP/s in bf16 (394 is the
     # int8 number).  Override via env for other chip generations.
     peak = float(os.environ.get("TORCHMPI_TPU_PEAK_TFLOPS", "197"))
@@ -686,6 +1081,27 @@ def main():
                     os.fsync(f.fileno())
             except OSError as e:
                 log(f"stage stream append failed: {e}")
+
+    # Seeded-stall seam (tests/CI): TORCHMPI_TPU_BENCH_STALL_STAGE=<key>
+    # parks that stage forever inside an instrumented watchdog window,
+    # so the escalation ladder (docs/WATCHDOG.md — armed by the
+    # supervisor via TORCHMPI_TPU_WATCHDOG) classifies it wedged (exit
+    # 113) exactly like a real relay hang, with the supervisor's stage
+    # cap as backstop.  The contrast this enables: the stalled stage
+    # falls to its banked record while sibling stages complete live.
+    stall_key = os.environ.get("TORCHMPI_TPU_BENCH_STALL_STAGE")
+
+    def maybe_stall(key):
+        if stall_key != key:
+            return
+        log(f"stage {key}: seeded stall (TORCHMPI_TPU_BENCH_STALL_STAGE)"
+            " — parking inside an instrumented watchdog window")
+        if os.environ.get("TORCHMPI_TPU_WATCHDOG", "off") not in (
+                "", "off"):
+            from torchmpi_tpu import watchdog
+            watchdog.begin("bench.stage", op=key)
+        while True:
+            time.sleep(60)
 
     # Host CPU backend for model/optimizer init when available: keeps init
     # graphs off the device's remote-compile queue (the train steps below
@@ -739,6 +1155,7 @@ def main():
         return True
 
     def stage_d(kd=1):
+        maybe_stall("D" if kd <= 1 else "D2")
         model = ResNet50(dtype=jnp.bfloat16)
         log(f"init ResNet-50 on {init_dev or 'default device'}...")
         with jax.default_device(init_dev):
@@ -860,8 +1277,8 @@ def main():
     # consume the whole budget with nothing banked.
     d_done = False
     d_err = None
-    if (staged and platform0 == "tpu" and compilecache.was_compiled(d_key)
-            and stage_d_budget_ok()):
+    if (staged and stage_on("D") and platform0 == "tpu"
+            and compilecache.was_compiled(d_key) and stage_d_budget_ok()):
         log("stage D compile marker warm: running the headline FIRST")
         try:
             stage_d()
@@ -875,7 +1292,8 @@ def main():
     # Only under the supervising parent, which forwards exactly one line;
     # launcher/coordinator ranks skip it (the number would be discarded and
     # the probe would cost every rank a compile on the serial queue).
-    if staged:
+    if staged and stage_on("A"):
+        maybe_stall("A")
         N = 512 if tiny else 16384
         CHAIN = 4  # dependent matmuls per dispatch: amortizes the relay's
         # per-dispatch overhead, which dominates single-matmul timings
@@ -917,7 +1335,8 @@ def main():
     # Stage B: TransformerLM training throughput — a far lighter compile
     # than ResNet-50's conv stack, so even a slow serial compile service
     # usually returns a real MODEL-TRAINING number before the big one.
-    if staged:
+    if staged and stage_on("B"):
+        maybe_stall("B")
         try:
             Bt = (2 if tiny else 8) * n_dev
             T = 64 if tiny else 512
@@ -1071,7 +1490,8 @@ def main():
     # Stage C (real TPU only): the Pallas flash-attention kernel executing
     # on hardware — the round-1 verdict's "never executed outside the
     # interpreter" evidence gap, measured next to XLA's dense attention.
-    if staged and platform0 == "tpu":
+    if staged and stage_on("C") and platform0 == "tpu":
+        maybe_stall("C")
         try:
             from torchmpi_tpu.ops.flash import flash_attention
             from torchmpi_tpu.parallel.sequence import reference_attention
@@ -1152,7 +1572,8 @@ def main():
     # kernel on hardware, asserted against the straightforward XLA
     # logits-materializing oracle — the other Mosaic kernel with no
     # hardware-execution evidence.
-    if staged and platform0 == "tpu":
+    if staged and stage_on("C2") and platform0 == "tpu":
+        maybe_stall("C2")
         try:
             from torchmpi_tpu.ops.xent import fused_linear_cross_entropy
 
@@ -1230,7 +1651,8 @@ def main():
     # compile).  TPU-only at full dims; the tiny preset exercises the
     # composed code path on CPU with the dense loss (the Pallas kernels
     # would drop to the interpreter there).
-    if staged and (platform0 == "tpu" or tiny):
+    if staged and stage_on("B2") and (platform0 == "tpu" or tiny):
+        maybe_stall("B2")
         try:
             from torchmpi_tpu.models import TransformerLM
             from torchmpi_tpu.ops.xent import fused_linear_cross_entropy
@@ -1393,7 +1815,8 @@ def main():
     # stages above are already banked).  Crashes stay loud here — an
     # uncaught exception means rc != 0 and the supervisor notes the
     # partial run.
-    if not d_done and d_err is None and stage_d_budget_ok():
+    if (stage_on("D") and not d_done and d_err is None
+            and stage_d_budget_ok()):
         stage_d()
         d_done = True
     if d_err is not None:
@@ -1405,8 +1828,13 @@ def main():
     # headline — last in the ladder (its compile is the most expendable)
     # and budget-gated on its own marker; evidence stage, so failures
     # log and continue.
-    if (staged and platform0 == "tpu" and d_done and KD2 > 1
-            and stage_d_budget_ok(KD2)):
+    # ``d_done`` (the headline compiled first, so D2's compile is the
+    # expendable one) is waived when the supervisor isolates D2 into
+    # its own child without D: the ordering guarantee already held at
+    # the supervisor level, where D ran — and finished — earlier.
+    d_first = d_done or (only_keys is not None and "D" not in only_keys)
+    if (staged and stage_on("D2") and platform0 == "tpu" and d_first
+            and KD2 > 1 and stage_d_budget_ok(KD2)):
         try:
             stage_d(kd=KD2)
         except Exception as e:  # noqa: BLE001 — evidence stage, optional

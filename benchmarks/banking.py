@@ -1,9 +1,11 @@
 """Bank assertable ``*-SUMMARY`` benchmark lines with staleness stamps.
 
 The compare modes of ``collectives_bench.py`` (``--guard-compare``,
-``--plan-compare``, ``--dcn-compare``) and the recovery bench end in
-one machine-readable ``KIND-SUMMARY {json}`` line that CI greps and
-asserts — and then the evidence evaporates with the log.  This module
+``--plan-compare``, ``--dcn-compare``, ``--obs-compare``,
+``--faults-compare``, ``--watchdog-compare``, ``--overlap-compare``)
+and the recovery bench end in one machine-readable
+``KIND-SUMMARY {json}`` line that CI greps and asserts — and then the
+evidence evaporates with the log.  This module
 is the persistence half: ``--bank`` appends each summary to
 ``SUMMARY_BANK.json`` at the repo root, NEXT TO the ``BENCH_r*.json``
 round records it contextualizes, so a later session (or a reviewer)
@@ -31,6 +33,8 @@ import sys
 import time
 
 KEEP_PER_KIND = 20
+
+round_ = round  # bank_summary's ``round=`` kwarg shadows the builtin
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DEFAULT_PATH = os.path.join(_REPO, "SUMMARY_BANK.json")
@@ -71,18 +75,31 @@ def load_bank(path=None):
     return bank
 
 
-def bank_summary(kind, summary, *, path=None, argv=None):
+def bank_summary(kind, summary, *, path=None, argv=None, round=None):
     """Append one ``kind`` (e.g. ``"GUARD-SUMMARY"``) record to the
-    bank, newest first, atomically.  Returns the stamped record."""
+    bank, newest first, atomically.  Returns the stamped record.
+
+    ``round`` stamps the bench round the record belongs to (the
+    ``BENCH_r<N>`` numbering — ``collectives_bench --round N`` /
+    ``bench.py``'s per-round micro-ladder pass both set it); when
+    omitted it falls back to ``TORCHMPI_TPU_BENCH_ROUND`` so every
+    banking call inside one round agrees without threading the number
+    through each CLI.  Consumers (``latest`` callers, CI) read it to
+    tell this round's verdict from a stale one."""
     if not isinstance(summary, dict):
         raise TypeError(f"summary must be a dict, got {type(summary)}")
     path = path or DEFAULT_PATH
+    if round is None:
+        env_round = os.environ.get("TORCHMPI_TPU_BENCH_ROUND")
+        round = int(env_round) if env_round else None
     rec = {"stamp": time.strftime("%Y%m%d_%H%M%S"),
-           "time": round(time.time(), 3),
+           "time": round_(time.time(), 3),
            "commit": _git_commit(),
            "platform": _platform(),
            "argv": list(sys.argv[1:] if argv is None else argv),
            "summary": summary}
+    if round is not None:
+        rec["round"] = int(round)
     bank = load_bank(path)
     rows = bank.setdefault(kind, [])
     rows.insert(0, rec)

@@ -51,7 +51,8 @@ def _maybe_bank(args, kind, summary):
         return
     from benchmarks import banking
 
-    rec = banking.bank_summary(kind, summary)
+    rec = banking.bank_summary(kind, summary,
+                               round=getattr(args, "round", None))
     print(f"# banked {kind} stamp={rec['stamp']} "
           f"commit={rec['commit']} platform={rec['platform']} -> "
           f"{banking.DEFAULT_PATH}", file=sys.stderr)
@@ -152,6 +153,16 @@ def _obs_compare_mode(args, mpi, n):
     print(f"# metrics-vs-off delta {delta * 1e6:+.2f} us "
           f"(noise floor {floor * 1e6:.2f} us): {verdict}",
           file=sys.stderr)
+    summary = {
+        "off_us": round(base.median * 1e6, 2),
+        "metrics_us": round(m.median * 1e6, 2),
+        "trace_us": round(results["trace"].median * 1e6, 2),
+        "delta_us": round(delta * 1e6, 2),
+        "noise_floor_us": round(floor * 1e6, 2),
+        "within_noise": bool(abs(delta) <= floor),
+    }
+    print("OBS-SUMMARY " + json.dumps(summary))
+    _maybe_bank(args, "OBS-SUMMARY", summary)
 
 
 def _faults_compare_mode(args, mpi, n):
@@ -188,6 +199,15 @@ def _faults_compare_mode(args, mpi, n):
     print(f"# policy-vs-off delta {delta * 1e6:+.2f} us "
           f"(noise floor {floor * 1e6:.2f} us): {verdict}",
           file=sys.stderr)
+    summary = {
+        "off_us": round(base.median * 1e6, 2),
+        "policy_us": round(pol.median * 1e6, 2),
+        "delta_us": round(delta * 1e6, 2),
+        "noise_floor_us": round(floor * 1e6, 2),
+        "within_noise": bool(abs(delta) <= floor),
+    }
+    print("FAULTS-SUMMARY " + json.dumps(summary))
+    _maybe_bank(args, "FAULTS-SUMMARY", summary)
 
 
 def _watchdog_compare_mode(args, mpi, n):
@@ -241,6 +261,16 @@ def _watchdog_compare_mode(args, mpi, n):
     print(f"# break-vs-off delta {delta:+.2f} us "
           f"(noise floor {floor:.2f} us): {verdict}",
           file=sys.stderr)
+    summary = {
+        "off_us": round(results["off"][0], 2),
+        "warn_us": round(results["warn"][0], 2),
+        "break_us": round(results["break"][0], 2),
+        "delta_us": round(delta, 2),
+        "noise_floor_us": round(floor, 2),
+        "within_noise": bool(delta <= floor),
+    }
+    print("WATCHDOG-SUMMARY " + json.dumps(summary))
+    _maybe_bank(args, "WATCHDOG-SUMMARY", summary)
 
 
 def _guard_compare_mode(args, mpi, n):
@@ -496,6 +526,16 @@ def _overlap_compare_mode(args, mpi, mesh):
           f"{l0} -> {l1} launches; {t0_ / max(t1_, 1e-12):.2f}x wall-time "
           f"ratio (sync/overlapped — dispatch-structure evidence on "
           f"cpu-sim, wall-clock win is hardware-only)", file=sys.stderr)
+    summary = {
+        "layers": args.overlap_layers,
+        "sync_launches": l0,
+        "overlapped_launches": l1,
+        "sync_ms": round(t0_ * 1e3, 3),
+        "overlapped_ms": round(t1_ * 1e3, 3),
+        "grads_bitwise_equal": bool(bitwise),
+    }
+    print("OVERLAP-SUMMARY " + json.dumps(summary))
+    _maybe_bank(args, "OVERLAP-SUMMARY", summary)
     if not bitwise:
         raise SystemExit("overlap-compare: gradients diverged")
 
@@ -750,6 +790,11 @@ def main():
                         "git-pinned + platform-tagged; "
                         "benchmarks/banking.py) next to the "
                         "BENCH_r*.json round records")
+    p.add_argument("--round", type=int, default=None,
+                   help="bench round number stamped on banked records "
+                        "(the BENCH_r<N> numbering; bench.py's "
+                        "micro-ladder pass sets it — defaults to "
+                        "TORCHMPI_TPU_BENCH_ROUND when unset)")
     args = p.parse_args()
     if args.devices:
         from torchmpi_tpu.utils.simulation import force_cpu_devices

@@ -13,6 +13,8 @@ This tool is the operator surface over those files:
     python scripts/obs_tool.py blame FLIGHT [FLIGHT ...]
     python scripts/obs_tool.py blame --live LEASE_DIR
     python scripts/obs_tool.py slo   FILE [FILE ...]
+    python scripts/obs_tool.py attribute DIR_OR_FLIGHT [...] [--json]
+    python scripts/obs_tool.py attribute --diff BEFORE_DIR AFTER_DIR
 
 ``slo`` reads a serving session's metric dumps and prints per-replica
 p50/p95/p99 time-to-first-token and inter-token latency from the
@@ -29,6 +31,12 @@ collective — the runtime complement of the static analyzer's D1/D3
 deadlock rules: hosts of one SPMD gang must issue identical collective
 sequences, so the first seq where op/bytes differ (or where one host
 keeps launching past the others' last event) is where the hang began.
+``attribute`` turns a host's flight ring + histograms into a per-step
+time budget — dispatch_gap / collective_wait / host_staging / compile /
+guard_verify shares summing to the step wall time (the phase model
+lives in ``torchmpi_tpu/obs/attribution.py``; docs/OBSERVABILITY.md
+"Attribution workflow") — and ``attribute --diff`` names the phase
+whose share regressed between two dumps.
 Since the ring records BOTH edges of a collective (dispatch + the
 ``*_done`` completion events), the laggard's last event distinguishes
 "launched and stuck inside it" from "completed, never launched the
@@ -505,6 +513,102 @@ def cmd_blame_live(args) -> int:
     return 0
 
 
+def _load_attribution_module():
+    """Load obs/attribution.py by path — the ``registry.py`` pattern:
+    the phase model is stdlib-only, and a post-mortem must not need
+    jax."""
+    path = os.path.join(_REPO, "torchmpi_tpu", "obs", "attribution.py")
+    spec = importlib.util.spec_from_file_location("_obs_attribution",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _flight_files(paths: List[str]) -> List[str]:
+    """Expand dump directories to their flight_host*.jsonl files."""
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            found = sorted(os.path.join(p, f) for f in os.listdir(p)
+                           if f.startswith("flight_host")
+                           and f.endswith(".jsonl"))
+            if not found:
+                raise ValueError(f"{p}: no flight_host*.jsonl files")
+            out.extend(found)
+        else:
+            out.append(p)
+    return out
+
+
+def _attribute_paths(attr, paths: List[str]) -> List[dict]:
+    """Per-host budgets for a dump: each flight file paired with its
+    sibling metrics_host*.jsonl (same host suffix) when present."""
+    budgets: List[dict] = []
+    for fpath in _flight_files(paths):
+        meta, flight = load_jsonl(fpath)
+        mpath = os.path.join(
+            os.path.dirname(fpath),
+            os.path.basename(fpath).replace("flight_host",
+                                            "metrics_host", 1))
+        metrics: List[dict] = []
+        if mpath != fpath and os.path.exists(mpath):
+            _, metrics = load_jsonl(mpath)
+        host = str(meta.get("host", "")) or os.path.basename(fpath)
+        b = attr.attribute_host(flight, metrics, host=host)
+        if b is not None:
+            budgets.append(b)
+    return budgets
+
+
+def cmd_attribute(args) -> int:
+    attr = _load_attribution_module()
+    if args.diff:
+        if args.files:
+            raise ValueError("--diff takes its two dumps as the diff "
+                             "arguments; drop the positional files")
+        before = _attribute_paths(attr, [args.diff[0]])
+        after = _attribute_paths(attr, [args.diff[1]])
+        if not before or not after:
+            raise ValueError("no events to attribute in one of the "
+                             "dumps")
+        d = attr.diff_budgets(before, after)
+        if args.json:
+            print(json.dumps(d, indent=2, sort_keys=True))
+            return 0
+        for p in attr.PHASES:
+            print(f"{p:16s} {d['before']['shares'][p] * 100:6.1f}% -> "
+                  f"{d['after']['shares'][p] * 100:6.1f}%  "
+                  f"({d['deltas'][p] * +100:+.1f}pp)")
+        ratio = d["step_ratio"]
+        if ratio is not None:
+            print(f"step wall: {d['before']['step_s'] * 1e3:.2f}ms -> "
+                  f"{d['after']['step_s'] * 1e3:.2f}ms ({ratio:.2f}x)")
+        if d["regressed"]:
+            print(f"regressed phase: {d['regressed']} "
+                  f"(+{d['deltas'][d['regressed']] * 100:.1f}pp of "
+                  f"step time)")
+        else:
+            print("regressed phase: none (no share grew)")
+        return 0
+    if not args.files:
+        raise ValueError("give a dump directory or flight_host*.jsonl "
+                         "files (or --diff BEFORE AFTER)")
+    budgets = _attribute_paths(attr, args.files)
+    if not budgets:
+        raise ValueError("no events to attribute (empty flight rings)")
+    if args.json:
+        print(json.dumps({"hosts": budgets,
+                          "aggregate": attr.aggregate_shares(budgets)},
+                         indent=2, sort_keys=True))
+        return 0
+    print(attr.format_table(budgets))
+    agg = attr.aggregate_shares(budgets)
+    print("aggregate: " + "  ".join(
+        f"{p}={agg[p] * 100:.1f}%" for p in attr.PHASES))
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -545,6 +649,21 @@ def main(argv=None) -> int:
                                    "session's metric dumps")
     s.add_argument("files", nargs="+")
     s.set_defaults(fn=cmd_slo)
+
+    s = sub.add_parser("attribute",
+                       help="per-step time budget from a host's flight "
+                            "ring + histograms (dispatch_gap / "
+                            "collective_wait / host_staging / compile "
+                            "/ guard_verify); --diff names the phase "
+                            "whose share regressed between two dumps")
+    s.add_argument("files", nargs="*",
+                   help="dump directory or flight_host*.jsonl files "
+                        "(sibling metrics_host*.jsonl auto-paired)")
+    s.add_argument("--json", action="store_true")
+    s.add_argument("--diff", nargs=2, metavar=("BEFORE", "AFTER"),
+                   help="two dump directories (or flight files) to "
+                        "compare")
+    s.set_defaults(fn=cmd_attribute)
 
     args = p.parse_args(argv)
     try:

@@ -1,0 +1,351 @@
+"""Step-time attribution tests (torchmpi_tpu/obs/attribution.py +
+``scripts/obs_tool.py attribute`` — docs/OBSERVABILITY.md "Attribution
+workflow"): synthetic flight rings exercising the pairing/sweep rules
+(the sums-to-window invariant, host-vs-interconnect classification,
+wrapped-ring degradation, histogram clamping), the ``--diff`` regressed
+-phase verdict, the CLI round-trip, and one real CPU-sim training run
+whose dump must attribute cleanly end to end.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_by_path(name, *rel):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(_REPO, *rel))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _attr():
+    return _load_by_path("_attribution_under_test",
+                         "torchmpi_tpu", "obs", "attribution.py")
+
+
+def _ev(seq, ts, ev, op="", nbytes=0, backend="", detail=""):
+    """One flight-ring dump record (recorder.FIELDS order + framing)."""
+    return {"kind": "event", "seq": seq, "ts": ts, "ev": ev, "op": op,
+            "nbytes": nbytes, "backend": backend, "detail": detail}
+
+
+def _hist(name, total, count):
+    return {"kind": "hist", "name": name, "labels": {},
+            "buckets": {}, "count": count, "sum": total}
+
+
+def _phase_seconds(budget):
+    return {p: budget["phases"][p]["seconds"] for p in budget["phases"]}
+
+
+def _assert_sums_to_wall(budget):
+    """The module's core invariant: phase seconds sum to the window
+    wall time exactly (shares to 100%)."""
+    secs = sum(v["seconds"] for v in budget["phases"].values())
+    assert secs == pytest.approx(budget["wall_s"], rel=1e-9)
+    shares = sum(v["share"] for v in budget["phases"].values())
+    assert shares == pytest.approx(1.0, rel=1e-9)
+
+
+# ---------------------------------------------------------------------------
+# attribute_host on synthetic rings
+# ---------------------------------------------------------------------------
+
+
+def test_budget_sums_to_step_wall_time():
+    attr = _attr()
+    # Two 1s step windows; one paired interconnect collective (0.3s) and
+    # one paired host-staged collective (0.2s) in the first window.
+    flight = [
+        _ev(0, 0.0, "step", "data_parallel_step"),
+        _ev(1, 0.1, "eager", "allreduce", 4096, "direct"),
+        _ev(2, 0.4, "eager_done", "allreduce", 4096, "direct"),
+        _ev(3, 0.5, "eager", "allreduce", 1024, "host_ring"),
+        _ev(4, 0.7, "eager_done", "allreduce", 1024, "host_ring"),
+        _ev(5, 1.0, "step", "data_parallel_step"),
+        _ev(6, 2.0, "step", "data_parallel_step"),
+    ]
+    b = attr.attribute_host(flight, [], host="h0")
+    assert b["steps"] == 2
+    assert b["wall_s"] == pytest.approx(2.0)
+    assert b["step_ms"] == pytest.approx(1000.0)
+    secs = _phase_seconds(b)
+    assert secs["collective_wait"] == pytest.approx(0.3)
+    assert secs["host_staging"] == pytest.approx(0.2)
+    assert secs["compile"] == 0.0 and secs["guard_verify"] == 0.0
+    # Residual: 2.0 - 0.5 of covered time.
+    assert secs["dispatch_gap"] == pytest.approx(1.5)
+    _assert_sums_to_wall(b)
+
+
+def test_histogram_costed_phases_and_clamp():
+    attr = _attr()
+    flight = [
+        _ev(0, 0.0, "step", "g"),
+        _ev(1, 0.2, "plan", "allreduce", 0, "direct", "miss"),
+        _ev(2, 0.5, "guard", "allreduce", 0, "", "verified"),
+        _ev(3, 1.0, "step", "g"),
+    ]
+    metrics = [_hist("tm_plan_build_seconds", 0.4, 2),   # mean 0.2s
+               _hist("tm_guard_verify_us", 2e5, 2)]      # mean 0.1s
+    b = attr.attribute_host(flight, metrics, host="h0")
+    secs = _phase_seconds(b)
+    assert secs["compile"] == pytest.approx(0.2)
+    assert secs["guard_verify"] == pytest.approx(0.1)
+    assert secs["dispatch_gap"] == pytest.approx(0.7)
+    _assert_sums_to_wall(b)
+
+    # Means so large they exceed the window: clamped into the uncovered
+    # remainder, invariant holds, and the budget says so.
+    huge = [_hist("tm_plan_build_seconds", 30.0, 2),
+            _hist("tm_guard_verify_us", 2e6, 2)]
+    b2 = attr.attribute_host(flight, huge, host="h0")
+    _assert_sums_to_wall(b2)
+    assert b2["phases"]["dispatch_gap"]["seconds"] == pytest.approx(0.0)
+    assert any("clamped" in n for n in b2["notes"])
+    # Plan/guard events with NO histogram: under-counted, noted.
+    b3 = attr.attribute_host(flight, [], host="h0")
+    assert any("under-counted" in n for n in b3["notes"])
+    _assert_sums_to_wall(b3)
+
+
+def test_overlapping_intervals_not_double_counted():
+    attr = _attr()
+    # A host-staged span [0.1, 0.5] fully overlapping an interconnect
+    # span [0.2, 0.4]: the sweep hands the shared segment to
+    # host_staging (priority) and counts no second twice.
+    flight = [
+        _ev(0, 0.0, "step", "g"),
+        _ev(1, 0.1, "eager", "allgather", 512, "host_ring"),
+        _ev(2, 0.2, "eager", "allreduce", 4096, "direct"),
+        _ev(3, 0.4, "eager_done", "allreduce", 4096, "direct"),
+        _ev(4, 0.5, "eager_done", "allgather", 512, "host_ring"),
+        _ev(5, 1.0, "step", "g"),
+    ]
+    b = attr.attribute_host(flight, [], host="h0")
+    secs = _phase_seconds(b)
+    assert secs["host_staging"] == pytest.approx(0.4)
+    assert secs["collective_wait"] == pytest.approx(0.0)
+    assert secs["dispatch_gap"] == pytest.approx(0.6)
+    _assert_sums_to_wall(b)
+
+
+def test_wrapped_ring_and_missing_edges_degrade_gracefully():
+    attr = _attr()
+    # A completion edge whose dispatch fell off the ring: costed from
+    # the previous event's timestamp, counted in notes.  A dispatch
+    # with no completion contributes nothing (but is noted).
+    flight = [
+        _ev(10, 0.0, "step", "g"),
+        _ev(11, 0.3, "barrier_done", "sync"),          # orphan done
+        _ev(12, 0.5, "eager", "allreduce", 64, "direct"),  # in flight
+        _ev(13, 1.0, "step", "g"),
+    ]
+    b = attr.attribute_host(flight, [], host="h0")
+    assert b["phases"]["collective_wait"]["seconds"] == pytest.approx(0.3)
+    assert any("wrapped ring" in n for n in b["notes"])
+    assert any("never completed" in n for n in b["notes"])
+    _assert_sums_to_wall(b)
+
+
+def test_no_step_markers_whole_ring_window():
+    attr = _attr()
+    flight = [
+        _ev(0, 1.0, "eager", "allreduce", 64, "direct"),
+        _ev(1, 1.4, "eager_done", "allreduce", 64, "direct"),
+        _ev(2, 2.0, "eager", "allreduce", 64, "direct"),
+        _ev(3, 2.5, "eager_done", "allreduce", 64, "direct"),
+    ]
+    b = attr.attribute_host(flight, [], host="h0")
+    assert b["steps"] == 1
+    assert b["wall_s"] == pytest.approx(1.5)
+    assert any("whole-ring window" in n for n in b["notes"])
+    assert b["phases"]["collective_wait"]["seconds"] == pytest.approx(0.9)
+    _assert_sums_to_wall(b)
+
+
+def test_empty_ring_returns_none():
+    attr = _attr()
+    assert attr.attribute_host([], [], host="h0") is None
+    # meta-only / ev-less records count as empty too
+    assert attr.attribute_host([{"kind": "meta"}], [], host="h0") is None
+
+
+# ---------------------------------------------------------------------------
+# diff: naming the regressed phase
+# ---------------------------------------------------------------------------
+
+
+def _synthetic_budget(attr, wait_s, step="g"):
+    flight = [
+        _ev(0, 0.0, "step", step),
+        _ev(1, 0.1, "eager", "allreduce", 64, "direct"),
+        _ev(2, 0.1 + wait_s, "eager_done", "allreduce", 64, "direct"),
+        _ev(3, 1.0, "step", step),
+    ]
+    return attr.attribute_host(flight, [], host="h0")
+
+
+def test_diff_names_regressed_phase():
+    attr = _attr()
+    before = [_synthetic_budget(attr, 0.1)]
+    after = [_synthetic_budget(attr, 0.6)]
+    d = attr.diff_budgets(before, after)
+    assert d["regressed"] == "collective_wait"
+    assert d["deltas"]["collective_wait"] == pytest.approx(0.5)
+    assert d["deltas"]["dispatch_gap"] == pytest.approx(-0.5)
+    # Same dump twice: nothing regressed.
+    d2 = attr.diff_budgets(before, before)
+    assert d2["regressed"] is None
+    assert d2["step_ratio"] == pytest.approx(1.0)
+
+
+def test_aggregate_shares_weighted_by_wall_time():
+    attr = _attr()
+    # Host A: 1s wall, all dispatch_gap.  Host B: 3s wall, all wait.
+    a = attr.attribute_host([_ev(0, 0.0, "step", "g"),
+                             _ev(1, 1.0, "step", "g")], [], host="a")
+    b = attr.attribute_host(
+        [_ev(0, 0.0, "step", "g"),
+         _ev(1, 0.0, "eager", "allreduce", 64, "direct"),
+         _ev(2, 3.0, "eager_done", "allreduce", 64, "direct"),
+         _ev(3, 3.0, "step", "g")], [], host="b")
+    agg = attr.aggregate_shares([a, b])
+    assert agg["collective_wait"] == pytest.approx(0.75)
+    assert agg["dispatch_gap"] == pytest.approx(0.25)
+
+
+# ---------------------------------------------------------------------------
+# obs_tool attribute CLI round-trip
+# ---------------------------------------------------------------------------
+
+
+def _write_dump(dirpath, host, flight, metrics=()):
+    os.makedirs(dirpath, exist_ok=True)
+    fmeta = {"kind": "meta", "stream": "flight", "host": host,
+             "pid": 1, "mode": "metrics", "time": 0.0,
+             "ring": 1024, "total": len(flight), "dropped": 0}
+    with open(os.path.join(dirpath, f"flight_host{host}.jsonl"),
+              "w") as f:
+        for rec in [fmeta] + list(flight):
+            f.write(json.dumps(rec) + "\n")
+    mmeta = {"kind": "meta", "stream": "metrics", "host": host,
+             "pid": 1, "mode": "metrics", "time": 0.0}
+    with open(os.path.join(dirpath, f"metrics_host{host}.jsonl"),
+              "w") as f:
+        for rec in [mmeta] + list(metrics):
+            f.write(json.dumps(rec) + "\n")
+
+
+def _run_obs_tool(*argv):
+    return subprocess.run(
+        [sys.executable, os.path.join(_REPO, "scripts", "obs_tool.py")]
+        + list(argv), capture_output=True, text=True, timeout=120,
+        cwd=_REPO)
+
+
+def test_obs_tool_attribute_cli(tmp_path):
+    d = str(tmp_path / "dump")
+    _write_dump(d, "0", [
+        _ev(0, 0.0, "step", "g"),
+        _ev(1, 0.2, "eager", "allreduce", 64, "direct"),
+        _ev(2, 0.6, "eager_done", "allreduce", 64, "direct"),
+        _ev(3, 1.0, "step", "g"),
+    ], [_hist("tm_plan_build_seconds", 0.2, 1)])
+    out = _run_obs_tool("attribute", d, "--json")
+    assert out.returncode == 0, out.stderr[-2000:]
+    doc = json.loads(out.stdout)
+    assert [b["host"] for b in doc["hosts"]] == ["0"]
+    assert sum(doc["aggregate"].values()) == pytest.approx(1.0)
+    assert doc["aggregate"]["collective_wait"] == pytest.approx(0.4)
+    # Table mode renders every phase column.
+    out2 = _run_obs_tool("attribute", d)
+    assert out2.returncode == 0, out2.stderr[-2000:]
+    for phase in ("dispatch_gap", "collective_wait", "host_staging",
+                  "compile", "guard_verify"):
+        assert phase in out2.stdout
+    assert "aggregate:" in out2.stdout
+
+
+def test_obs_tool_attribute_diff_cli(tmp_path):
+    before = str(tmp_path / "before")
+    after = str(tmp_path / "after")
+    for d, wait in ((before, 0.1), (after, 0.7)):
+        _write_dump(d, "0", [
+            _ev(0, 0.0, "step", "g"),
+            _ev(1, 0.1, "eager", "allreduce", 64, "direct"),
+            _ev(2, 0.1 + wait, "eager_done", "allreduce", 64, "direct"),
+            _ev(3, 1.0, "step", "g"),
+        ])
+    out = _run_obs_tool("attribute", "--diff", before, after, "--json")
+    assert out.returncode == 0, out.stderr[-2000:]
+    doc = json.loads(out.stdout)
+    assert doc["regressed"] == "collective_wait"
+    out2 = _run_obs_tool("attribute", "--diff", before, after)
+    assert out2.returncode == 0
+    assert "regressed phase: collective_wait" in out2.stdout
+
+
+def test_obs_tool_attribute_empty_dir_is_loud(tmp_path):
+    out = _run_obs_tool("attribute", str(tmp_path))
+    assert out.returncode != 0
+    assert "no flight_host" in (out.stderr + out.stdout)
+
+
+# ---------------------------------------------------------------------------
+# Real run: a CPU-sim training loop's dump attributes cleanly
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_attribution_on_real_training_dump(tmp_path):
+    import numpy as np
+
+    import torchmpi_tpu as mpi
+    from torchmpi_tpu import obs
+
+    mpi.stop()
+    mesh = mpi.init(mpi.Config(dcn_size=1, obs="metrics",
+                               obs_dir=str(tmp_path)))
+    try:
+        import jax
+        import jax.numpy as jnp
+        from torchmpi_tpu.parallel import gradsync
+
+        obs.reset()
+        params = {"w": jnp.ones((4, 4), jnp.float32)}
+
+        axes = mesh.axis_names
+
+        def body(p, batch):
+            g = jax.tree.map(jnp.ones_like, p)
+            return mpi.nn.synchronize_gradients(g, axes)
+
+        dp = gradsync.data_parallel_step(body, mesh=mesh,
+                                         batch_argnums=(1,),
+                                         donate_argnums=())
+        for _ in range(4):
+            jax.block_until_ready(
+                dp(params, np.ones((8, 2), np.float32)))
+        obs.dump(str(tmp_path))
+    finally:
+        obs.deactivate()
+        obs.reset()
+        mpi.stop()
+    out = _run_obs_tool("attribute", str(tmp_path), "--json")
+    assert out.returncode == 0, out.stderr[-2000:]
+    doc = json.loads(out.stdout)
+    assert doc["hosts"], "real dump produced no budgets"
+    b = doc["hosts"][0]
+    # 4 recorded step boundaries -> 3 attribution windows.
+    assert b["steps"] == 3
+    assert b["wall_s"] > 0
+    assert sum(doc["aggregate"].values()) == pytest.approx(1.0)

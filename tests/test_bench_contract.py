@@ -15,12 +15,18 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 @pytest.mark.slow
-def test_bench_emits_one_json_line():
+def test_bench_emits_one_json_line(tmp_path):
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)
     env["TORCHMPI_TPU_BENCH_CPU"] = "4"
     env["TORCHMPI_TPU_BENCH_PRESET"] = "tiny"
     env["TORCHMPI_TPU_BENCH_TIMEOUT"] = "420"
+    # Keep the smoke run's stream/ledger out of docs/artifacts, and its
+    # compile cache out of the shared repo cache (a cache entry written
+    # by a CPU-sim child has crashed later readers with native heap
+    # corruption on this jaxlib — isolation keeps every run cold).
+    env["TORCHMPI_TPU_BENCH_ART_DIR"] = str(tmp_path)
+    env["TORCHMPI_TPU_COMPILE_CACHE"] = str(tmp_path / "jcc")
     out = subprocess.run(
         [sys.executable, os.path.join(_REPO, "bench.py")],
         capture_output=True, text=True, timeout=480, env=env, cwd=_REPO)
@@ -35,6 +41,16 @@ def test_bench_emits_one_json_line():
     assert rec["value"] > 0
     # the last line must be the headline stage, not the probe
     assert rec["metric"] == "resnet50_dp_train_throughput", rec
+    # per-stage isolation: the supervisor reports every stage's outcome
+    # (tpu-only stages skipped on the cpu sim, the rest live)
+    oc = rec["extra"]["stage_outcomes"]
+    assert set(oc) == {"A", "B", "C", "C2", "B2", "D", "D2"}, oc
+    for k in ("A", "B", "B2", "D"):
+        assert oc[k] == "live", oc
+    for k in ("C", "C2", "D2"):
+        assert oc[k].startswith("skipped"), oc
+    assert rec["extra"]["stage_meta"][
+        "resnet50_dp_train_throughput"] == {"source": "live"}
 
 
 @pytest.mark.slow
@@ -241,6 +257,138 @@ def test_compose_final_crash_stays_loud(tmp_path):
     rec, rc = bench.compose_final([], "bench child exited 1", wedge=False,
                                   art_dir=str(tmp_path))
     assert rec is None and rc == 1
+
+
+def test_wedge_exit_code_matches_watchdog():
+    # bench.py duplicates the escalation exit code as a literal so the
+    # supervisor never imports the package (jax); pin the two together.
+    import bench
+    from torchmpi_tpu import watchdog
+
+    assert bench.WEDGE_EXIT_CODE == watchdog.ESCALATE_EXIT_CODE
+
+
+def test_round_ledger_roundtrip(tmp_path):
+    # Missing ledger -> seeded from repo history; a new round appends
+    # its first stamp and persists; unstamped artifacts resolve to None.
+    import bench
+
+    led = bench.load_round_ledger(str(tmp_path), rnd=9)
+    assert any(e["round"] == 9 for e in led)
+    assert any(e["round"] == 3 for e in led)  # seed present
+    with open(tmp_path / "round_ledger.json") as f:
+        on_disk = json.load(f)
+    assert on_disk == led
+    # Re-loading does not duplicate the round-9 entry.
+    led2 = bench.load_round_ledger(str(tmp_path), rnd=9)
+    assert led2 == led
+    assert bench.artifact_round("bench_nodate.json", led) is None
+    assert bench.banked_age_rounds("bench_nodate.json", led, 9) is None
+    # Pre-ledger artifacts are AT LEAST as old as the oldest round.
+    assert bench.artifact_round("bench_0101_000000.json", led) == 1
+
+
+def test_compose_final_stale_banked_drops_vs_baseline(tmp_path):
+    # Satellite contract: a banked fallback older than the round window
+    # reports vs_baseline null + stale true, with the age stamped in
+    # extra.stage_meta; a fresh banked record keeps its ratio.
+    import bench
+
+    ledger = [{"round": 1, "first_stamp": "20260729_000000"},
+              {"round": 6, "first_stamp": "20260806_000000"}]
+    rec_body = {"metric": "resnet50_dp_train_throughput", "value": 2500.0,
+                "unit": "img/s/chip", "vs_baseline": 1.01,
+                "extra": {"platform": "tpu", "devices": 1,
+                          "global_batch": 128, "image": 224}}
+    (tmp_path / "bench_20260729_010000.json").write_text(
+        json.dumps({"records": [rec_body]}))
+    rec, rc = bench.compose_final(
+        [], "stage D wedged", wedge=True, art_dir=str(tmp_path),
+        round_info=(6, ledger))
+    assert rc == 0
+    meta = rec["extra"]["stage_meta"]["resnet50_dp_train_throughput"]
+    assert meta["banked_age_rounds"] == 5
+    assert meta["stale"] is True
+    assert rec["vs_baseline"] is None
+    assert rec["stale"] is True
+    # Same artifact, current round close enough: ratio survives.
+    rec, rc = bench.compose_final(
+        [], "stage D wedged", wedge=True, art_dir=str(tmp_path),
+        round_info=(2, [{"round": 1, "first_stamp": "20260729_000000"},
+                        {"round": 2, "first_stamp": "20260806_000000"}]))
+    assert rc == 0
+    meta = rec["extra"]["stage_meta"]["resnet50_dp_train_throughput"]
+    assert meta["banked_age_rounds"] == 1 and meta["stale"] is False
+    assert rec["vs_baseline"] == 1.01
+    assert "stale" not in rec
+
+
+@pytest.mark.slow
+def test_bench_stage_isolation_seeded_stall(tmp_path):
+    # The tentpole contrast: a seeded stall in stage B (parked inside an
+    # instrumented watchdog window) escalates to the wedge exit; stage B
+    # falls to its banked record WITH a staleness stamp while sibling
+    # stages complete live, and the supervisor's per-stage outcome
+    # counters land as an obs metrics dump.
+    art = tmp_path / "art"
+    obs = tmp_path / "obs"
+    art.mkdir()
+    # A banked stage-B record matching BANKED_WANT, stamped in round 1.
+    (art / "bench_20260729_010000.json").write_text(json.dumps({
+        "records": [
+            {"metric": "transformer_lm_train_throughput",
+             "value": 187000.0, "unit": "tokens/s/chip",
+             "vs_baseline": 1.0,
+             "extra": {"platform": "tpu", "devices": 1, "batch": 8,
+                       "seq": 512, "embed": 512,
+                       "scan_steps_per_dispatch": 32}}]}))
+    (art / "round_ledger.json").write_text(json.dumps(
+        [{"round": 1, "first_stamp": "20260729_000000"},
+         {"round": 9, "first_stamp": "20260806_000000"}]))
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["TORCHMPI_TPU_BENCH_CPU"] = "4"
+    env["TORCHMPI_TPU_BENCH_PRESET"] = "tiny"
+    env["TORCHMPI_TPU_BENCH_TIMEOUT"] = "420"
+    env["TORCHMPI_TPU_BENCH_ART_DIR"] = str(art)
+    env["TORCHMPI_TPU_COMPILE_CACHE"] = str(tmp_path / "jcc")
+    env["TORCHMPI_TPU_BENCH_ROUND"] = "9"
+    env["TORCHMPI_TPU_BENCH_STALL_STAGE"] = "B"  # escalates in ~8s
+    env["TORCHMPI_TPU_OBS"] = "metrics"
+    env["TORCHMPI_TPU_OBS_DIR"] = str(obs)
+    out = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "bench.py")],
+        capture_output=True, text=True, timeout=480, env=env, cwd=_REPO)
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [l for l in out.stdout.strip().splitlines() if l.strip()]
+    rec = json.loads(lines[-1])
+    # Sibling stages stayed LIVE; the headline is this run's number.
+    assert rec["metric"] == "resnet50_dp_train_throughput", rec
+    oc = rec["extra"]["stage_outcomes"]
+    assert oc["B"].startswith("wedged"), oc
+    assert oc["A"] == "live" and oc["D"] == "live", oc
+    # The stalled stage fell to its banked record, stamped stale.
+    assert rec["extra"]["stages"][
+        "transformer_lm_train_throughput_banked"] == 187000.0
+    meta = rec["extra"]["stage_meta"]["transformer_lm_train_throughput"]
+    assert meta["source"].startswith("banked:"), meta
+    assert meta["banked_age_rounds"] == 8 and meta["stale"] is True
+    # Supervisor outcome counters: a standard obs metrics dump.
+    import glob
+
+    dumps = glob.glob(str(obs / "metrics_host*.jsonl"))
+    assert dumps, list(obs.iterdir())
+    counters = {}
+    for p in dumps:
+        with open(p) as f:
+            for ln in f:
+                r = json.loads(ln)
+                if r.get("kind") == "counter" and \
+                        r["name"].startswith("tm_bench_stage_"):
+                    counters[r["name"]] = r["value"]
+    assert counters.get("tm_bench_stage_wedged_total", 0) >= 1, counters
+    assert counters.get("tm_bench_stage_live_total", 0) >= 3, counters
+    assert counters.get("tm_bench_stage_banked_total", 0) >= 1, counters
 
 
 def test_bench_probe_mode():

@@ -466,6 +466,9 @@ def run_guarded(init_fn: Callable[[], PyTree],
 
     while True:
         while i < steps:
+            # Step boundary for obs_tool attribute (ring-only; the
+            # telemetry shim makes it a no-op when obs is off).
+            telemetry.emit("record_step", "run_guarded", i)
             state, loss = step_fn(state, i)
             steps_run += 1
             raise_pending()  # the tripwire's raise-policy boundary

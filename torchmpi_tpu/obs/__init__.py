@@ -473,6 +473,17 @@ def record_step_build(label: str) -> None:
     _registry.counter_inc("tm_step_builds_total", label=label)
 
 
+def record_step(site: str, step: int = -1) -> None:
+    """One step boundary (ring only — one append per step, no counter):
+    ``data_parallel_step`` marks each dispatch, ``guard.run_guarded``
+    each guarded iteration, the serving scheduler each tick.
+    Consecutive ``step`` events delimit the attribution windows
+    ``obs_tool attribute`` budgets (docs/OBSERVABILITY.md "Attribution
+    workflow"); the step index rides the nbytes slot so blame's
+    cross-host alignment keys on it."""
+    _recorder.append("step", site, max(0, int(step)))
+
+
 def record_log(logger_name: str) -> None:
     """One ``utils.metrics.MetricsLogger`` record (the logger is a thin
     wrapper over this registry when obs is active)."""

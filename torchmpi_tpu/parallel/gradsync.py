@@ -911,11 +911,24 @@ def data_parallel_step(
 
         jitted = analysis.wrap_step(jitted, wrapped,
                                     label="data_parallel_step", mode=mode)
+    stepper = throttle_dispatch(jitted, mesh=m, max_inflight=max_inflight)
     if cfg is not None and cfg.obs != "off":
+        # Build-time gate (the never-imported-when-off discipline): the
+        # per-call cost when on is one ring append marking the step
+        # boundary BEFORE dispatch — the window edge obs_tool
+        # attribute budgets against.
         from .. import obs
 
         obs.record_step_build("data_parallel_step")
-    stepper = throttle_dispatch(jitted, mesh=m, max_inflight=max_inflight)
+        inner = stepper
+        counter = [0]
+
+        def stepper(*args):  # noqa: F811 — deliberate rebind
+            obs.record_step("data_parallel_step", counter[0])
+            counter[0] += 1
+            return inner(*args)
+
+        stepper.jitted = jitted
     if cfg is not None and cfg.guard in ("numeric", "full"):
         # The numeric tripwire's raise-policy boundary (docs/GUARD.md):
         # a tripped bucket is zeroed in-graph, and the deferred typed

@@ -443,3 +443,6 @@ class Server:
         for eng in self.router.live():
             mod.record_serving_occupancy(eng.pool.occupancy_pct(),
                                          replica=eng.name)
+        # Tick boundary: the serving-side attribution window edge
+        # (obs_tool attribute; docs/OBSERVABILITY.md).
+        mod.record_step("serving_tick")
