@@ -44,6 +44,18 @@ Further phases (each with its own asserted ``*-SUMMARY`` line):
 - ``--buckets B`` — pow-2 bucketed prefill on a mixed-prompt-length
   trace: compile count == bucket count (< distinct lengths), streams
   bitwise unchanged (``BUCKET-SUMMARY``);
+- ``--prefix`` — radix prefix-sharing KV cache over the slot pool: a
+  shared system prompt is prefilled ONCE, later arrivals assemble the
+  cached blocks and extend from the fork point.  Streams must be
+  bitwise-identical cache on vs off (greedy additionally vs the
+  offline oracle), hits > 0, and the prefilled-token count must drop
+  (``PREFIX-SUMMARY``);
+- ``--surge`` — deterministic Poisson rate-step trace (inter-arrival
+  divided by ``--surge-x`` mid-trace): the SLO admission gate must
+  SHED typed rejections instead of letting p99 TTFT collapse, the
+  autoscaler must add a replica under the sustained queue, and a
+  replica hard-kill DURING the surge must drain + re-route and stay
+  token-exact (``SURGE-SUMMARY``);
 - ``--bank`` — persist every emitted summary to ``SUMMARY_BANK.json``
   (stamped, git-pinned, keep-last-20 — ``benchmarks/banking.py``).
 
@@ -483,6 +495,178 @@ def run_chaos(model, params, args, rng, vocab):
     return ok, rerouted, line
 
 
+def run_prefix(model, params, args, rng, vocab):
+    """Radix prefix-sharing KV cache: every request opens with the same
+    24-token system prompt, so the cache-on server prefills the shared
+    blocks ONCE and later arrivals assemble them + extend from the fork
+    point.  Streams must be bitwise cache on == cache off (greedy
+    additionally == the offline ``generate`` oracle), hits > 0, the
+    prefilled-token count must drop, and the block ledger must come
+    back clean (every cached block at refcount 1, zero leaks)."""
+    import numpy as np
+
+    from torchmpi_tpu import serving
+
+    # Dedicated stream: the phase trace (and verdict) must not depend
+    # on which earlier phases consumed draws from the shared rng.
+    rng = np.random.RandomState(args.seed + 5)
+    n = max(16, args.requests // 4)
+    shared = rng.randint(0, vocab, size=(24,)).astype(np.int32)
+    arrivals = np.cumsum(rng.exponential(0.02, size=n))
+    reqs = []
+    for i in range(n):
+        tail = rng.randint(0, vocab, size=(3 + i % 6,)).astype(np.int32)
+        kw = (dict(temperature=0.8, top_k=20, seed=args.seed + 300 + i)
+              if i % 2 else {})
+        reqs.append(serving.Request(
+            f"p{i}", np.concatenate([shared, tail]),
+            max_new=int([4, 8][i % 2]), arrival_s=float(arrivals[i]),
+            **kw))
+    oracle = offline_oracle(model, params,
+                            [r for r in reqs if r.temperature is None])
+
+    def timed(cache):
+        def mk():
+            return serving.Server(model, params, replicas=1,
+                                  slots=args.slots,
+                                  slot_tokens=args.slot_tokens,
+                                  prefix_cache=cache, prefix_block=8)
+
+        mk().run_trace(clone_reqs(reqs), unit_seconds=1.0)  # warm
+        srv, out = mk(), clone_reqs(reqs)
+        wall0 = time.monotonic()
+        done = srv.run_trace(out, unit_seconds=1.0)
+        wall = time.monotonic() - wall0
+        assert len(done) == len(out)
+        return {r.rid: r.tokens for r in out}, \
+            srv.router.replicas[0], wall
+
+    off_toks, off_eng, off_wall = timed(0)
+    on_toks, on_eng, on_wall = timed(16)
+    bitwise = (on_toks == off_toks
+               and all(on_toks[rid] == oracle[rid] for rid in oracle))
+    hits = on_eng.stats["prefix_hits"]
+    pt_on = on_eng.stats["prefill_tokens"]
+    pt_off = off_eng.stats["prefill_tokens"]
+    leaks = sum(1 for node in on_eng._prefix._nodes
+                if on_eng.pool.block_refcount(node.bid) != 1)
+    leaks += on_eng.pool.blocks_in_use - on_eng._prefix.n_nodes
+    n_tok = sum(len(t) for t in off_toks.values())
+    ok = (bitwise and hits > 0 and pt_on < pt_off and leaks == 0)
+    line = (f"PREFIX-SUMMARY requests={n} shared_tokens=24 "
+            f"hits={hits} misses={on_eng.stats['prefix_misses']} "
+            f"prefill_tok_on={pt_on} prefill_tok_off={pt_off} "
+            f"saved_pct={100 * (1 - pt_on / pt_off):.0f} "
+            f"tok_s_on={n_tok / on_wall:.1f} "
+            f"tok_s_off={n_tok / off_wall:.1f} "
+            f"leaks={leaks} bitwise={'ok' if bitwise else 'FAIL'} "
+            f"verdict={'prefix-cache-wins' if ok else 'FAIL'}")
+    print(line)
+    return ok, line
+
+
+def run_surge(model, params, args, rng, vocab):
+    """10x admission-rate step: without the gate the queue (and p99
+    TTFT) grows without bound for the surge cohort; with the SLO gate
+    armed the server SHEDS typed rejections at the door and p99 of the
+    SERVED requests stays bounded.  The autoscaler must add a replica
+    under the sustained queue, and a replica hard-kill DURING the surge
+    must drain + re-route with every served greedy stream still equal
+    to the offline oracle."""
+    import numpy as np
+
+    import torchmpi_tpu as mpi
+    from torchmpi_tpu import serving
+
+    # Dedicated stream (see run_prefix): calibration p95 — and so the
+    # SLO target — must not move when other phases are toggled.
+    rng = np.random.RandomState(args.seed + 7)
+    mean_len = float(np.mean(args.lens))
+    base = mean_len / (args.load * args.slots)
+    # The surge cohort must OUTLAST the admission gate's observation
+    # lag: p95 TTFT only climbs as first tokens land (at the service
+    # rate), so arrivals have to still be flowing when the measured
+    # p95 crosses the target — otherwise there is nothing left to
+    # shed.  Sheds are free (no compute), so a long surge is cheap.
+    n_base = max(16, args.requests // 2)
+    n_surge = max(128, 3 * args.requests)
+    n = n_base + n_surge
+    gaps = np.concatenate([
+        rng.exponential(base, size=n_base),
+        rng.exponential(base / args.surge_x, size=n_surge)])
+    arrivals = np.cumsum(gaps)
+    max_news = [int(args.lens[i % len(args.lens)])
+                for i in rng.permutation(n)]
+    reqs = [serving.Request(
+        f"u{i}", rng.randint(0, vocab, size=(args.prompt_len,))
+        .astype(np.int32), max_new=max_news[i],
+        arrival_s=float(arrivals[i])) for i in range(n)]
+
+    def run(reqs_in, replicas=1, **kw):
+        srv = serving.Server(model, params, replicas=replicas,
+                             slots=args.slots,
+                             slot_tokens=args.slot_tokens, **kw)
+        out = clone_reqs(reqs_in)
+        done = srv.run_trace(out, unit_seconds=1.0)
+        return out, done, srv
+
+    # Calibrate the SLO from the base-rate cohort alone: the target is
+    # 2x its p95 TTFT — deterministic (work-unit clock), so the verdict
+    # never depends on container wall noise.
+    cal, _, _ = run(reqs[:n_base])
+    p95_base = float(np.percentile([r.ttft_s for r in cal], 95))
+    target_us = 2.0 * max(p95_base, 1.0) * 1e6
+
+    off, off_done, _ = run(reqs)
+    p99_off = float(np.percentile([r.ttft_s for r in off], 99))
+
+    on, on_done, srv_on = run(reqs, slo_ttft_us=target_us, autoscale=2)
+    served = [r for r in on if not r.shed]
+    shed = [r for r in on if r.shed]
+    p99_on = float(np.percentile([r.ttft_s for r in served], 99))
+    events = list(srv_on._fleet.events)
+    typed = all(isinstance(r.error, str) and "slo" in r.error
+                for r in shed)
+
+    # Replica hard-kill DURING the surge, gate still armed: the fleet
+    # must shed + re-route + finish, with every SERVED greedy stream
+    # still bitwise the offline oracle.
+    oracle = offline_oracle(model, params, reqs)
+    plan = {"version": 1, "seed": args.seed, "note": "surge kill",
+            "rules": [{"site": "serving.replica", "kind": "fail",
+                       "prob": 1.0, "after": args.chaos_after,
+                       "max_hits": 1}]}
+    path = os.path.join(tempfile.mkdtemp(prefix="serving_surge_"),
+                        "plan.json")
+    with open(path, "w") as f:
+        json.dump(plan, f)
+    mpi.set_config(faults=path)
+    try:
+        kill, kill_done, srv_k = run(reqs, replicas=2,
+                                     slo_ttft_us=target_us)
+    finally:
+        mpi.set_config(faults="off")
+    dead = [e.name for e in srv_k.router.replicas if e.dead]
+    rerouted = sum(r.reroutes for r in kill)
+    kill_ok = (len(kill_done) == n and len(dead) == 1 and rerouted > 0
+               and all(r.tokens == oracle[r.rid]
+                       for r in kill if not r.shed))
+
+    ok = (len(on_done) == n and len(off_done) == n
+          and len(served) + len(shed) == n and len(shed) > 0 and typed
+          and p99_on < p99_off and "scale_up" in events and kill_ok)
+    line = (f"SURGE-SUMMARY requests={n} surge_x={args.surge_x} "
+            f"slo_us={target_us:.0f} p99_off_u={p99_off:.1f} "
+            f"p99_on_u={p99_on:.1f} served={len(served)} "
+            f"shed={len(shed)} scale_events={len(events)} "
+            f"kill_dead={','.join(dead)} kill_rerouted={rerouted} "
+            f"kill_ok={'ok' if kill_ok else 'FAIL'} "
+            f"typed_shed={'ok' if typed else 'FAIL'} "
+            f"verdict={'shed-not-collapse' if ok else 'FAIL'}")
+    print(line)
+    return ok, line
+
+
 def main():
     p = argparse.ArgumentParser(
         description=__doc__.splitlines()[0])
@@ -527,6 +711,15 @@ def main():
     p.add_argument("--tp", type=int, default=0,
                    help="> 0: run the TP-sharded replica phase on this "
                         "many devices (TP-SERVING-SUMMARY)")
+    p.add_argument("--prefix", action="store_true",
+                   help="also run the radix prefix-cache phase "
+                        "(PREFIX-SUMMARY)")
+    p.add_argument("--surge", action="store_true",
+                   help="also run the rate-step admission/autoscale "
+                        "phase (SURGE-SUMMARY)")
+    p.add_argument("--surge-x", type=int, default=10,
+                   help="admission-rate multiplier for the surge "
+                        "cohort")
     p.add_argument("--bank", action="store_true",
                    help="persist every summary line to "
                         "SUMMARY_BANK.json")
@@ -611,6 +804,12 @@ def main():
     if args.tp > 0:
         ok, line = run_tp(args, rng, vocab)
         phases.append(("serving_tp", ok, line))
+    if args.prefix:
+        ok, line = run_prefix(model, params, args, rng, vocab)
+        phases.append(("serving_prefix", ok, line))
+    if args.surge:
+        ok, line = run_surge(model, params, args, rng, vocab)
+        phases.append(("serving_surge", ok, line))
 
     good = (bitwise and speedup >= args.min_speedup
             and cont_ttft_u < static_ttft_u and chaos_ok

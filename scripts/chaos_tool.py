@@ -63,8 +63,9 @@ chain self-heals at the next snapshot).  ``summarize`` reads
 per-host obs metric dumps (the files ``TORCHMPI_TPU_OBS=metrics``
 leaves behind) and prints the ``tm_fault_*``, ``tm_elastic_*``,
 ``tm_guard_*``, ``tm_ckpt_*``, ``tm_watchdog_*``, ``tm_hotstate_*``,
-and ``tm_bench_*`` (the bench supervisor's per-stage
-live/banked/wedged outcome counters) series — what
+``tm_serving_*`` (the serving fleet's shed/reroute/prefix-cache
+outcomes under chaos), and ``tm_bench_*`` (the bench supervisor's
+per-stage live/banked/wedged outcome counters) series — what
 was injected, what survived a retry, what hit a deadline, what
 shrink/rejoin the gang ran, what digests failed/healed, what updates
 the numeric tripwire skipped, what checkpoint copies failed
@@ -354,7 +355,7 @@ def cmd_summarize(args) -> int:
             if not name.startswith(("tm_fault_", "tm_elastic_",
                                     "tm_guard_", "tm_ckpt_",
                                     "tm_watchdog_", "tm_hotstate_",
-                                    "tm_bench_")):
+                                    "tm_bench_", "tm_serving_")):
                 continue
             key = (name, tuple(sorted(rec.get("labels", {}).items())))
             totals[key] = totals.get(key, 0) + rec.get("value", 0)

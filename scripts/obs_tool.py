@@ -260,7 +260,13 @@ def cmd_slo(args) -> int:
                       "tm_serving_rejected_total",
                       "tm_serving_prefill_compiles_total",
                       "tm_serving_spec_drafted_total",
-                      "tm_serving_spec_accepted_total"):
+                      "tm_serving_spec_accepted_total",
+                      "tm_serving_prefix_hits_total",
+                      "tm_serving_prefix_misses_total",
+                      "tm_serving_prefix_tokens_saved_total",
+                      "tm_serving_prefix_bytes_saved_total",
+                      "tm_serving_prefix_inserted_total",
+                      "tm_serving_prefix_evicted_total"):
             v = counters.get((rep, cname))
             if v:
                 label = cname[len("tm_serving_"):-len("_total")]
@@ -268,6 +274,29 @@ def cmd_slo(args) -> int:
         rep_name = rep or "<all>"
         tail = f"  [{' '.join(extras)}]" if extras else ""
         print(f"  {rep_name}: " + " | ".join(parts) + tail)
+    # Fleet/admission summary: the gate and the autoscaler are global
+    # (replica-unlabeled), so they print once — shed/admitted counts,
+    # scale events, and the queue-depth p95 the controller acts on.
+    fleet = []
+    for cname in ("tm_serving_admitted_total", "tm_serving_shed_total",
+                  "tm_serving_scale_up_total",
+                  "tm_serving_scale_down_total"):
+        v = sum(val for (rep, name), val in counters.items()
+                if name == cname)
+        if v:
+            fleet.append(f"{cname[len('tm_serving_'):-len('_total')]}="
+                         f"{int(v)}")
+    depth = next((rec for rec in snap
+                  if rec["kind"] == "hist"
+                  and rec["name"] == "tm_serving_queue_depth"), None)
+    if depth is not None and depth.get("count"):
+        p95 = _hist_percentile(depth.get("buckets", {}), depth["count"],
+                               0.95)
+        mean = depth["sum"] / depth["count"]
+        fleet.append(f"queue_depth p95<={p95:g} mean={mean:.3g} "
+                     f"ticks={depth['count']}")
+    if fleet:
+        print(f"  fleet: {' '.join(fleet)}")
     return 0
 
 

@@ -501,6 +501,21 @@ class Config:
     # O(distinct lengths) (0 = off; emitted tokens are bitwise
     # unchanged either way).  Env: TORCHMPI_TPU_SERVING_PREFILL_BUCKETS.
     serving_prefill_buckets: int = 0
+    # Radix prefix-sharing KV cache: capacity in shared prefix BLOCKS
+    # per replica (0 = off).  Shared prompt prefixes are prefilled once
+    # and reused copy-on-extend; emitted tokens stay bitwise the
+    # uncached stream.  Env: TORCHMPI_TPU_SERVING_PREFIX_CACHE.
+    serving_prefix_cache: int = 0
+    # SLO admission control: shed arrivals (typed AdmissionRejected)
+    # while live p95 TTFT exceeds this target in microseconds of the
+    # scheduler's active clock (0 = admit everything).
+    # Env: TORCHMPI_TPU_SERVING_SLO_TTFT_US.
+    serving_slo_ttft_us: float = 0.0
+    # Queue-depth autoscaling: maximum replica count the
+    # FleetController may scale up to (0 = fixed fleet).  Scale-downs
+    # drain through the readmit machinery — reroute without the kill.
+    # Env: TORCHMPI_TPU_SERVING_AUTOSCALE.
+    serving_autoscale: int = 0
 
     # --- distributed bring-up ----------------------------------------------
     coordinator_address: Optional[str] = None
@@ -594,6 +609,12 @@ class Config:
             serving_spec_k=_env_int("TORCHMPI_TPU_SERVING_SPEC_K", 0),
             serving_prefill_buckets=_env_int(
                 "TORCHMPI_TPU_SERVING_PREFILL_BUCKETS", 0),
+            serving_prefix_cache=_env_int(
+                "TORCHMPI_TPU_SERVING_PREFIX_CACHE", 0),
+            serving_slo_ttft_us=_env_float(
+                "TORCHMPI_TPU_SERVING_SLO_TTFT_US", 0.0),
+            serving_autoscale=_env_int(
+                "TORCHMPI_TPU_SERVING_AUTOSCALE", 0),
             ps_port=_env_int("TORCHMPI_TPU_PS_PORT", 52312),
             ps_host=_env_str("TORCHMPI_TPU_PS_HOST", "127.0.0.1"),
             ps_num_threads=_env_int("TORCHMPI_TPU_PS_THREADS", 2),

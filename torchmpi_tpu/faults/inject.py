@@ -106,6 +106,15 @@ SITES = (
     #                         drop = transient step failure (health
     #                         ledger counts it), fail = the replica dies
     #                         and its sessions drain + re-route
+    "serving.admit",        # one arrival at the serving admission gate
+    #                         (scheduler._gate, peer = the request id):
+    #                         ANY fault verdict at the door is a SHED —
+    #                         the request completes immediately with a
+    #                         typed rejection, exactly the SLO
+    #                         backpressure path (drop = a lost
+    #                         admission RPC, fail = the gate refusing).
+    #                         Payload-free: there is nothing to corrupt
+    #                         at the door
     "elastic.member",       # one member liveness check per step
     #                         boundary in the elastic gang driver
     #                         (torchmpi_tpu/elastic.py): arrival

@@ -703,6 +703,109 @@ def slot_write(pool_cache, one_cache, slot: int):
                            jnp.asarray(slot, jnp.int32))
 
 
+# ---------------------------------------------------------------------------
+# Prefix-cache fragment primitives (serving/prefix_cache.py).  A
+# "fragment" is a width-W token-axis slice of a single-row cache — the
+# k/v a shared prompt prefix produced.  Causality + absolute-position
+# rope make a prefix's k/v depend ONLY on the prefix tokens, so a
+# fragment sliced from one request's prefill is bitwise the fragment
+# every later request sharing that prefix would have computed; writing
+# it back and running :func:`slot_extend` over just the unshared suffix
+# reproduces the full prefill bit for bit (the per-row depth mask hides
+# everything beyond the assembled depth, exactly the argument that
+# already covers slot reuse and bucketed-prefill padding).
+#
+# Both helpers are layout-generic pytree maps: a leaf participates iff
+# it looks like a per-row cache plane — ``ndim >= 2`` with a leading
+# row dim of 1 (token axis 1).  That covers the dense flax cache dict
+# ([1, max_len, heads, dim] k/v) and the TP list-of-(k, v) pairs alike;
+# the dense cache's scalar ``idx`` counter falls through untouched.
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnums=(2,))
+def _slot_cache_slice_jit(row_cache, start, width):
+    def cut(p):
+        if getattr(p, "ndim", 0) >= 2 and p.shape[0] == 1:
+            return lax.dynamic_slice_in_dim(
+                p, clamp_slot_positions(start, p.shape[1], width),
+                width, axis=1)
+        return p
+    return jax.tree.map(cut, row_cache)
+
+
+def slot_cache_slice(row_cache, start: int, width: int):
+    """Slice ``width`` token positions starting at ``start`` out of a
+    single-row cache — the fragment a prefix-cache node stores."""
+    return _slot_cache_slice_jit(row_cache,
+                                 jnp.asarray(start, jnp.int32),
+                                 int(width))
+
+
+@jax.jit
+def _slot_cache_write_jit(row_cache, frag, start):
+    def put(p, f):
+        if getattr(f, "ndim", 0) >= 2 and f.shape[0] == 1 \
+                and p.ndim == f.ndim:
+            pos = clamp_slot_positions(start, p.shape[1], f.shape[1])
+            return lax.dynamic_update_slice(
+                p, f.astype(p.dtype),
+                (0, pos) + (0,) * (p.ndim - 2))
+        return p
+    return jax.tree.map(put, row_cache, frag)
+
+
+def slot_cache_write(row_cache, frag, start: int):
+    """Write a :func:`slot_cache_slice` fragment back into a single-row
+    cache at token position ``start`` (cache-hit row assembly)."""
+    return _slot_cache_write_jit(row_cache, frag,
+                                 jnp.asarray(start, jnp.int32))
+
+
+@partial(jax.jit, static_argnums=(0,))
+def _slot_extend_jit(dmodel, params, row_cache, suffix, pos_offset,
+                     true_len, seeds, idxs, temps, top_ks, top_ps):
+    (xs, head), updated = dmodel.apply(
+        {"params": params, "cache": row_cache}, suffix,
+        pos_offset=pos_offset, return_prehead=True, mutable=["cache"])
+    # true_len is SUFFIX-local: the true last position within the
+    # (possibly right-padded) suffix block, same bucketing contract as
+    # _slot_prefill_jit.
+    x_last = lax.dynamic_slice_in_dim(
+        xs, clamp_slot_positions(true_len - 1, xs.shape[1]), 1,
+        axis=1)[:, 0]
+    first = _sample_rows(x_last @ head, _sample_keys(seeds, idxs),
+                         temps, top_ks, top_ps, suffix.dtype)
+    return updated["cache"], first
+
+
+def slot_extend(dmodel, params, row_cache, suffix, *, pos_offset,
+                true_len=None, sampling=None):
+    """Prefill only the unshared SUFFIX of a prompt over a single-row
+    cache pre-assembled from prefix-cache fragments; returns
+    ``(cache, first_token [1])``.
+
+    ``suffix`` is [1, Ts] (right-padded to a bucket like
+    :func:`slot_prefill`; ``true_len`` is the suffix's true length),
+    ``pos_offset`` the [1] absolute depth of the assembled prefix.  The
+    1-D per-row offset with T > 1 takes the same cache-masked attention
+    branch the speculative verify forward uses: queries attend the
+    assembled fragments plus the in-flight suffix and nothing deeper —
+    exactly the positions a full prefill's causal mask admits — and the
+    sampling key is ``(seed, idx)`` with idx = the prompt's global
+    token count, so a cache hit leaves the ``fold_in`` schedule
+    untouched and the emitted stream bitwise-identical to a miss (and
+    to offline ``generate``)."""
+    suffix = jnp.asarray(suffix)
+    if true_len is None:
+        true_len = suffix.shape[1]
+    if sampling is None:
+        sampling = _greedy_sampling(suffix.shape[0])
+    return _slot_extend_jit(dmodel, params, row_cache, suffix,
+                            jnp.asarray(pos_offset, jnp.int32),
+                            jnp.asarray(true_len, jnp.int32), *sampling)
+
+
 @lru_cache(maxsize=None)
 def _parallel_fn(dmodel, steps, mesh, batch_axis, top_k=None, top_p=None,
                  eos_id=None):

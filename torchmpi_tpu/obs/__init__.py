@@ -592,7 +592,13 @@ def record_serving(event: str, n: int = 1, *, replica: str = "") -> None:
     before — one new XLA specialization; O(buckets) with bucketed
     prefill, O(distinct lengths) without) | ``spec_drafted`` /
     ``spec_accepted`` (speculative-decode draft tokens proposed /
-    accepted — the live acceptance rate) — counter
+    accepted — the live acceptance rate) | ``prefix_hits`` /
+    ``prefix_misses`` / ``prefix_tokens_saved`` /
+    ``prefix_bytes_saved`` / ``prefix_inserted`` / ``prefix_evicted``
+    (radix prefix-cache admissions: blocks reused, prefill tokens and
+    cache bytes not recomputed, tree churn) | ``admitted`` / ``shed``
+    (the SLO admission gate's verdict per arrival) | ``scale_up`` /
+    ``scale_down`` (FleetController replica-count changes) — counter
     ``tm_serving_<event>_total`` labeled by replica.  Re-routes also
     land in the flight ring, so a post-mortem sees the replica death
     next to the collectives (or faults) that preceded it."""

@@ -606,6 +606,19 @@ def init(config: Optional[Config] = None, **overrides) -> Mesh:
                             "TORCHMPI_TPU_SERVING_SPEC_K", int)
         _env_default_pickup(cfg, "serving_prefill_buckets",
                             "TORCHMPI_TPU_SERVING_PREFILL_BUCKETS", int)
+        _env_default_pickup(cfg, "serving_prefix_cache",
+                            "TORCHMPI_TPU_SERVING_PREFIX_CACHE", int)
+        _env_default_pickup(cfg, "serving_slo_ttft_us",
+                            "TORCHMPI_TPU_SERVING_SLO_TTFT_US", float)
+        _env_default_pickup(cfg, "serving_autoscale",
+                            "TORCHMPI_TPU_SERVING_AUTOSCALE", int)
+        if cfg.serving_prefix_cache < 0 or cfg.serving_autoscale < 0 \
+                or cfg.serving_slo_ttft_us < 0:
+            raise ValueError(
+                f"config.serving_prefix_cache / serving_autoscale / "
+                f"serving_slo_ttft_us must be >= 0 (0 = off), got "
+                f"{cfg.serving_prefix_cache}/{cfg.serving_autoscale}/"
+                f"{cfg.serving_slo_ttft_us}")
         if cfg.serving_spec_k < 0 or cfg.serving_prefill_buckets < 0:
             raise ValueError(
                 f"config.serving_spec_k and serving_prefill_buckets "
@@ -1004,6 +1017,16 @@ def set_config(**kw) -> None:
             v = int(v)
             if v < 0:
                 raise ValueError(f"config.{k} must be >= 0 (0 = off)")
+        if k in ("serving_prefix_cache", "serving_autoscale"):
+            v = int(v)
+            if v < 0:
+                raise ValueError(f"config.{k} must be >= 0 (0 = off)")
+        if k == "serving_slo_ttft_us":
+            v = float(v)
+            if v < 0:
+                raise ValueError(
+                    "config.serving_slo_ttft_us must be >= 0 "
+                    "(0 = admit everything)")
         if k == "fault_retries":
             v = int(v)
         if k in ("fault_backoff_s", "fault_deadline_s"):

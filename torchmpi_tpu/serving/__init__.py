@@ -45,12 +45,16 @@ to the offline ``models.generate.generate`` path.
 from __future__ import annotations
 
 from .engine import ReplicaEngine, RequestRejected, Session  # noqa: F401
+from .fleet import AdmissionController, AdmissionRejected, \
+    FleetController  # noqa: F401
+from .prefix_cache import PrefixCache  # noqa: F401
 from .router import Router  # noqa: F401
 from .scheduler import Request, Server  # noqa: F401
 from .slots import SlotPool  # noqa: F401
 from .spec import ModelDraft, NgramDraft  # noqa: F401
 from .tp_engine import TPReplicaEngine  # noqa: F401
 
-__all__ = ["ModelDraft", "NgramDraft", "ReplicaEngine", "Request",
-           "RequestRejected", "Router", "Server", "Session", "SlotPool",
-           "TPReplicaEngine"]
+__all__ = ["AdmissionController", "AdmissionRejected", "FleetController",
+           "ModelDraft", "NgramDraft", "PrefixCache", "ReplicaEngine",
+           "Request", "RequestRejected", "Router", "Server", "Session",
+           "SlotPool", "TPReplicaEngine"]

@@ -56,8 +56,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import runtime
-from ..models.generate import slot_decode_step, slot_prefill, \
-    slot_verify_step, slot_write
+from ..models.generate import slot_cache_slice, slot_cache_write, \
+    slot_decode_step, slot_extend, slot_prefill, slot_verify_step, \
+    slot_write
+from .prefix_cache import PrefixCache
 from .slots import SlotPool
 
 
@@ -95,6 +97,10 @@ class Session:
     #: for admit/plain step, up to K+1 for a speculative tick) — the
     #: scheduler's token/ITL accounting reads it.
     last_emit: int = 1
+    #: Prefix-cache nodes this session pinned at admission (empty when
+    #: the cache is off or missed) — released at retirement so idle
+    #: blocks become evictable again.
+    prefix_chain: List[Any] = dataclasses.field(default_factory=list)
 
 
 class ReplicaEngine:
@@ -116,7 +122,9 @@ class ReplicaEngine:
                  slot_tokens: Optional[int] = None,
                  device=None, sample: Optional[float] = None,
                  prefill_bucket: Optional[int] = None,
-                 spec_k: Optional[int] = None, draft=None):
+                 spec_k: Optional[int] = None, draft=None,
+                 prefix_cache: Optional[int] = None,
+                 prefix_block: int = 8):
         cfg = runtime.effective_config()
         slots = int(slots if slots is not None else cfg.serving_slots)
         st = int(slot_tokens if slot_tokens is not None
@@ -145,7 +153,8 @@ class ReplicaEngine:
                                for p in jax.tree.leaves(params))
         self._init_serving(cfg, name, slots, st, sample=sample,
                            prefill_bucket=prefill_bucket, spec_k=spec_k,
-                           draft=draft)
+                           draft=draft, prefix_cache=prefix_cache,
+                           prefix_block=prefix_block)
         # Zero pool cache from the decode model's cache spec — no
         # forward pass runs at construction.
         shapes = jax.eval_shape(
@@ -158,12 +167,24 @@ class ReplicaEngine:
                        if device is not None else cache)
 
     def _init_serving(self, cfg, name, slots, st, *, sample,
-                      prefill_bucket, spec_k, draft):
+                      prefill_bucket, spec_k, draft,
+                      prefix_cache=None, prefix_block=8):
         """Backend-independent serving state (shared with the
         mesh-parallel subclass, which does NOT run the dense
         ``__init__``)."""
         self.name = name
-        self.pool = SlotPool(slots, st)
+        cap = int(prefix_cache if prefix_cache is not None
+                  else cfg.serving_prefix_cache)
+        self.pool = SlotPool(slots, st, prefix_blocks=cap)
+        if cap > 0:
+            self._prefix = PrefixCache(
+                self.pool, block_tokens=min(int(prefix_block), st))
+        else:
+            self._prefix = None
+        #: Lazily built 1-row zero cache (the assembly canvas for
+        #: prefix-cache hits) — jax arrays are immutable, so one
+        #: template serves every admission.
+        self._row_zero = None
         self.dead = False
         self._sessions: Dict[int, Session] = {}
         self._sample_default = float(
@@ -189,7 +210,8 @@ class ReplicaEngine:
         #: ``spec_accepted`` give the live acceptance rate.
         self.stats = {"prefills": 0, "steps": 0, "prefill_compiles": 0,
                       "spec_steps": 0, "spec_drafted": 0,
-                      "spec_accepted": 0}
+                      "spec_accepted": 0, "prefill_tokens": 0,
+                      "prefix_hits": 0, "prefix_misses": 0}
         #: Work units spent (prefill/pooled forward = 1 each, draft
         #: forwards at the proposer's weight) — the scheduler's
         #: ``unit_seconds`` virtual clock advances by the delta.
@@ -233,28 +255,36 @@ class ReplicaEngine:
                 f"got {p}")
         return (t, k, p, seed)
 
-    def _pad_prompt(self, prompt: np.ndarray) -> Tuple[np.ndarray, int]:
+    def _pad_prompt(self, prompt: np.ndarray,
+                    cap: Optional[int] = None) -> Tuple[np.ndarray, int]:
         """Right-pad to the pow-2 bucket (>= ``prefill_bucket``, capped
-        at the slot block).  Returns ``(padded, true_len)``."""
+        at ``cap`` — default the slot block; a prefix-hit suffix caps
+        at the room REMAINING above the assembled depth so the padded
+        write provably stays inside the row).  Returns ``(padded,
+        true_len)``."""
         true_len = prompt.shape[1]
         if self._bucket <= 0:
             return prompt, true_len
         bucket = max(self._bucket, 1 << max(0, true_len - 1).bit_length())
-        bucket = min(bucket, self.pool.slot_tokens)
+        bucket = min(bucket,
+                     self.pool.slot_tokens if cap is None else cap)
         if bucket <= true_len:
             return prompt, true_len
         padded = np.zeros((1, bucket), prompt.dtype)
         padded[:, :true_len] = prompt
         return padded, true_len
 
-    def _count_prefill_compile(self, padded_len: int) -> None:
+    def _count_prefill_compile(self, key) -> None:
         """A prompt length this engine has not prefilled before is one
         new jit specialization — one XLA compile.  Counted on the
         bucketed and unbucketed paths alike, so the per-distinct-length
-        recompile cost is visible BEFORE bucketing is turned on."""
-        if padded_len in self._prefill_lens:
+        recompile cost is visible BEFORE bucketing is turned on.
+        ``key`` is the padded length for full prefill, or ``("ext",
+        padded_suffix_len)`` for the prefix-hit extend forward (its own
+        executable family)."""
+        if key in self._prefill_lens:
             return
-        self._prefill_lens.add(padded_len)
+        self._prefill_lens.add(key)
         self.stats["prefill_compiles"] += 1
         mod = _obs()
         if mod is not None:
@@ -305,6 +335,25 @@ class ReplicaEngine:
             sampling=sampling)
         return np.asarray(out)
 
+    def _row_template(self):
+        """Fresh single-row zero cache — the canvas prefix-cache
+        fragments are assembled onto before the extend forward."""
+        shapes = jax.eval_shape(
+            lambda: self.dmodel.init(
+                jax.random.PRNGKey(0), jnp.zeros((1, 1), jnp.int32),
+                pos_offset=jnp.zeros((1,), jnp.int32)))["cache"]
+        row = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
+                           shapes)
+        return (jax.device_put(row, self._device)
+                if self._device is not None else row)
+
+    def _backend_extend(self, row_cache, suffix: np.ndarray, depth: int,
+                        true_len: int, sampling):
+        return slot_extend(self.dmodel, self.params, row_cache,
+                           jnp.asarray(suffix),
+                           pos_offset=np.asarray([depth], np.int32),
+                           true_len=true_len, sampling=sampling)
+
     # -- iteration-level operations ----------------------------------------
 
     def admit(self, request) -> Optional[Tuple[Session, bool]]:
@@ -329,33 +378,100 @@ class ReplicaEngine:
             raise RequestRejected(
                 f"request {request.rid!r}: prompt+max_new = {total} "
                 f"exceeds the {self.pool.slot_tokens}-token slot block")
-        padded, true_len = self._pad_prompt(prompt)
         slot = self.pool.alloc()
         if slot is None:
             return None
         try:
             self.stats["prefills"] += 1
             self.units += 1.0
-            self._count_prefill_compile(padded.shape[1])
             samp = tuple(jnp.asarray(np.asarray([v], d)) for v, d in
                          zip((sampling[3], prev.size, sampling[0],
                               sampling[1], sampling[2]),
                              (np.uint32, np.int32, np.float32, np.int32,
                               np.float32)))
-            one_cache, first = self._backend_prefill(padded, true_len,
-                                                     samp)
+            chain = (self._prefix.match(prompt[0])
+                     if self._prefix is not None else [])
+            if chain:
+                # Cache hit: assemble the matched fragments onto a
+                # fresh row and run the forward over ONLY the unshared
+                # suffix.  The sampling operand (idx = the request's
+                # global token index) is untouched by the hit, so the
+                # fold_in schedule — and therefore every emitted token
+                # — is bitwise the miss path's.
+                B = self._prefix.block_tokens
+                depth = B * len(chain)
+                if self._row_zero is None:
+                    self._row_zero = self._row_template()
+                row = self._row_zero
+                for i, node in enumerate(chain):
+                    row = slot_cache_write(row, node.frag, i * B)
+                padded, true_len = self._pad_prompt(
+                    prompt[:, depth:],
+                    cap=self.pool.slot_tokens - depth)
+                self._count_prefill_compile(("ext", padded.shape[1]))
+                one_cache, first = self._backend_extend(
+                    row, padded, depth, true_len, samp)
+                self.stats["prefix_hits"] += 1
+            else:
+                depth = 0
+                padded, true_len = self._pad_prompt(prompt)
+                self._count_prefill_compile(padded.shape[1])
+                one_cache, first = self._backend_prefill(
+                    padded, true_len, samp)
+                if self._prefix is not None:
+                    self.stats["prefix_misses"] += 1
+            self.stats["prefill_tokens"] += int(padded.shape[1])
             self._cache = slot_write(self._cache, one_cache, slot)
             tok = int(np.asarray(first)[0])
+            full_chain: List[Any] = []
+            n_new = n_evicted = 0
+            if self._prefix is not None:
+                # Cache every full block of the TRUE prompt from the
+                # row we just computed (one_cache covers the assembled
+                # depth + the suffix, so slicing works for matched and
+                # new blocks alike; insert only materializes the new
+                # ones), then pin the whole chain for this session's
+                # lifetime — eviction can never touch a block a live
+                # slot was built from.
+                B = self._prefix.block_tokens
+                full_chain, n_new, n_evicted = self._prefix.insert(
+                    prompt[0], prompt.shape[1],
+                    lambda i: slot_cache_slice(one_cache, i * B, B))
+                self._prefix.pin(full_chain)
+                mod = _obs()
+                if mod is not None:
+                    if chain:
+                        mod.record_serving("prefix_hits",
+                                           replica=self.name)
+                        mod.record_serving("prefix_tokens_saved", depth,
+                                           replica=self.name)
+                        mod.record_serving(
+                            "prefix_bytes_saved",
+                            sum(n.nbytes for n in chain),
+                            replica=self.name)
+                    else:
+                        mod.record_serving("prefix_misses",
+                                           replica=self.name)
+                    if n_new:
+                        mod.record_serving("prefix_inserted", n_new,
+                                           replica=self.name)
+                    if n_evicted:
+                        mod.record_serving("prefix_evicted", n_evicted,
+                                           replica=self.name)
         except BaseException:
             # A failed prefill must not leak the block: after `slots`
-            # leaks the pool would be silently full forever.
+            # leaks the pool would be silently full forever.  (Prefix
+            # pins are taken LAST, after every fallible op, so there is
+            # never a pinned chain to unwind here.)
             self.pool.free(slot)
             raise
         sess = Session(request=request, slot=slot, last_tok=tok,
                        pos_next=prompt.shape[1], emitted=[tok],
-                       sampling=sampling, last_emit=1)
+                       sampling=sampling, last_emit=1,
+                       prefix_chain=full_chain)
         if self._finished(sess):
             self.pool.free(slot)
+            self._retire_prefix(sess)
             return sess, True
         self._sessions[slot] = sess
         if self._draft is not None:
@@ -395,6 +511,7 @@ class ReplicaEngine:
             if self._finished(sess):
                 del self._sessions[slot]
                 self.pool.free(slot)
+                self._retire_prefix(sess)
                 finished.append(sess)
         return advanced, finished
 
@@ -458,6 +575,7 @@ class ReplicaEngine:
             if self._finished(sess):
                 del self._sessions[slot]
                 self.pool.free(slot)
+                self._retire_prefix(sess)
                 self._draft.free(slot)
                 finished.append(sess)
             else:
@@ -484,12 +602,21 @@ class ReplicaEngine:
         out = list(self._sessions.values())
         for sess in out:
             self.pool.free(sess.slot)
+            self._retire_prefix(sess)
         self._sessions.clear()
         if self._draft is not None:
             self._draft.drain()
         return out
 
     # -- internals ---------------------------------------------------------
+
+    def _retire_prefix(self, sess: Session) -> None:
+        """Release the session's prefix-block pins (refcounts fall back
+        toward 1 = idle/evictable; exactly zero leaks by construction —
+        the ledger raises on a double release)."""
+        if sess.prefix_chain:
+            self._prefix.release(sess.prefix_chain)
+            sess.prefix_chain = []
 
     @staticmethod
     def _finished(sess: Session) -> bool:

@@ -79,8 +79,10 @@ class Router:
         dispatch rotation: clears its dead flag so ``pick()`` can
         select it again.  Its sessions were re-routed at the drain, so
         it rejoins empty; callers that cannot trust the old process
-        should rebuild the engine instead."""
-        if not replica.dead:
+        should rebuild the engine instead.  A RETIRED replica (scaled
+        down on purpose — :meth:`retire`) never comes back this way:
+        readmission is for healed failures, not cancelled decisions."""
+        if not replica.dead or getattr(replica, "retired", False):
             return
         replica.dead = False
         mod = sys.modules.get("torchmpi_tpu.obs")
@@ -102,6 +104,27 @@ class Router:
         of a replica that already told us it is dead."""
         for _ in range(max(1, getattr(self._ledger, "dead_after", 1))):
             self._ledger.record(replica.name, ok=False)
+
+    # -- fleet membership --------------------------------------------------
+
+    def add(self, replica: ReplicaEngine) -> None:
+        """Register a freshly built replica (autoscale scale-up) into
+        the dispatch rotation.  Name uniqueness is the same invariant
+        the constructor enforces — per-replica telemetry and ledger
+        rows key on it."""
+        if any(r.name == replica.name for r in self.replicas):
+            raise ValueError(
+                f"replica name {replica.name!r} already registered")
+        self.replicas.append(replica)
+
+    def retire(self, replica: ReplicaEngine) -> None:
+        """Take a replica out of the fleet FOR GOOD (autoscale
+        scale-down): dead so ``pick``/``live`` skip it, ``retired`` so
+        a later healthy ledger state can never auto-readmit a replica
+        the controller deliberately removed.  The caller drains it
+        first — retirement loses capacity, never work."""
+        replica.dead = True
+        replica.retired = True
 
     # -- selection ---------------------------------------------------------
 

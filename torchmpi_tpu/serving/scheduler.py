@@ -45,6 +45,8 @@ import numpy as np
 
 from .. import runtime
 from .engine import ReplicaEngine, RequestRejected, Session
+from .fleet import AdmissionController, AdmissionRejected, \
+    FleetController
 from .router import Router
 
 
@@ -79,6 +81,12 @@ class Request:
     # never fit a slot block): the server rejects IT and keeps serving
     # everyone else — one bad request must not abort the trace.
     error: Optional[str] = None
+    # True when the ADMISSION GATE shed this request (SLO backpressure
+    # or a serving.admit chaos drop) — ``error`` carries the typed
+    # AdmissionRejected text.  Distinct from an unservable rejection:
+    # a shed request is perfectly servable, the fleet just can't meet
+    # its TTFT budget right now.
+    shed: bool = False
     # Clock of the most recent emitted token — carries the inter-token
     # gap across a drain/re-admission so the re-route stall really
     # lands in the ITL histogram.
@@ -120,6 +128,12 @@ class Server:
     ``devices[i]`` (data-parallel spread on a multi-chip host).
     """
 
+    # Class-level defaults so a hand-assembled Server (tests build one
+    # via ``Server.__new__`` around a pre-wired Router) runs the trace
+    # loop with the gate and the autoscaler disarmed.
+    _admission = None
+    _fleet = None
+
     def __init__(self, model, params, *, replicas: Optional[int] = None,
                  slots: Optional[int] = None,
                  slot_tokens: Optional[int] = None,
@@ -127,7 +141,14 @@ class Server:
                  ledger=None, sample: Optional[float] = None,
                  prefill_bucket: Optional[int] = None,
                  spec_k: Optional[int] = None, draft=None,
-                 engines: Optional[Sequence] = None):
+                 engines: Optional[Sequence] = None,
+                 prefix_cache: Optional[int] = None,
+                 prefix_block: int = 8,
+                 slo_ttft_us: Optional[float] = None,
+                 autoscale: Optional[int] = None,
+                 engine_factory=None,
+                 scale_high_water: int = 4, scale_low_water: int = 0,
+                 scale_sustain: int = 3):
         cfg = runtime.effective_config()
         if engines is None:
             n = int(replicas if replicas is not None
@@ -143,11 +164,46 @@ class Server:
                               device=devices[i] if devices is not None
                               else None, sample=sample,
                               prefill_bucket=prefill_bucket,
-                              spec_k=spec_k, draft=draft)
+                              spec_k=spec_k, draft=draft,
+                              prefix_cache=prefix_cache,
+                              prefix_block=prefix_block)
                 for i in range(n)]
+            if engine_factory is None:
+                # Default scale-up factory: a fresh dense replica with
+                # the same knobs (no device pin — a scaled replica
+                # lands wherever jax defaults it).
+                def engine_factory(name, _m=model, _p=params):
+                    return ReplicaEngine(
+                        _m, _p, name=name, slots=slots,
+                        slot_tokens=slot_tokens, sample=sample,
+                        prefill_bucket=prefill_bucket, spec_k=spec_k,
+                        draft=draft, prefix_cache=prefix_cache,
+                        prefix_block=prefix_block)
         else:
             engines = list(engines)
         self.router = Router(engines, ledger=ledger)
+        # SLO admission gate: live p95 TTFT vs the target, typed
+        # AdmissionRejected shedding (fleet.py).  0 disarms.
+        slo = float(slo_ttft_us if slo_ttft_us is not None
+                    else cfg.serving_slo_ttft_us)
+        self._admission = (AdmissionController(slo) if slo > 0
+                           else None)
+        # Queue-depth autoscaler: value = max replicas (0 disarms).
+        amax = int(autoscale if autoscale is not None
+                   else cfg.serving_autoscale)
+        if amax > 0:
+            if engine_factory is None:
+                raise ValueError(
+                    "autoscale needs an engine_factory when the server "
+                    "is built from pre-made engines (it must be able "
+                    "to construct a replica on scale-up)")
+            self._fleet = FleetController(
+                self.router, engine_factory=engine_factory,
+                max_replicas=amax, min_replicas=len(engines),
+                high_water=scale_high_water, low_water=scale_low_water,
+                sustain=scale_sustain, drain=self._drain)
+        else:
+            self._fleet = None
         #: Filled by :meth:`run_trace`: ``ticks`` (work ticks run),
         #: ``busy_s`` (summed tick durations — the compute time
         #: throughput divides by), ``clock_s`` (final virtual clock,
@@ -238,7 +294,19 @@ class Server:
                 return completed
             t0 = time.monotonic()
             while arrivals and arrivals[0].arrival_s <= clock:
-                pending.append(arrivals.popleft())
+                req = arrivals.popleft()
+                shed = self._gate(req, len(pending))
+                if shed is not None:
+                    # Typed backpressure, not a timeout: the request
+                    # completes immediately as shed with the evidence
+                    # in .error, and the fleet's admitted latency
+                    # budget stays intact.
+                    req.error = shed
+                    req.shed = True
+                    req.finish_s = clock
+                    completed.append(req)
+                    continue
+                pending.append(req)
             newly_admitted, stepped, finished, steps_run, rejected = \
                 self._tick(pending)
             for req in rejected:
@@ -278,6 +346,12 @@ class Server:
                 sum(s.last_emit for s in stepped)
             self._record_tick(pending, newly_admitted, stepped,
                               finished, completed, clock, elapsed)
+            if self._fleet is not None:
+                event = self._fleet.tick(len(pending), pending)
+                if event is not None:
+                    mod = _obs()
+                    if mod is not None:
+                        mod.record_serving(event)
         raise RuntimeError(f"trace did not drain in {max_ticks} ticks")
 
     # -- one tick ----------------------------------------------------------
@@ -345,6 +419,38 @@ class Server:
 
         faults.fire("serving.replica", peer=name)
 
+    def _gate(self, req: Request, depth: int) -> Optional[str]:
+        """The admission gate, run once per arrival BEFORE it queues:
+        the ``serving.admit`` chaos site (any fault verdict at the door
+        is a shed — a dropped admission RPC and an SLO rejection look
+        identical to the client), then the SLO admission controller.
+        Returns the shed reason, or None to admit into the queue."""
+        if runtime.effective_config().faults != "off":
+            from .. import faults
+
+            try:
+                faults.fire("serving.admit", peer=req.rid)
+            except BaseException as e:  # noqa: BLE001 — shed, not crash
+                if not _is_fault(e):
+                    raise
+                mod = _obs()
+                if mod is not None:
+                    mod.record_serving("shed")
+                return (f"request {req.rid!r} shed (fault at "
+                        f"serving.admit): {e}")
+        if self._admission is not None:
+            try:
+                self._admission.check(req.rid, depth)
+            except AdmissionRejected as e:
+                mod = _obs()
+                if mod is not None:
+                    mod.record_serving("shed")
+                return str(e)
+            mod = _obs()
+            if mod is not None:
+                mod.record_serving("admitted")
+        return None
+
     def _handle_failure(self, eng: ReplicaEngine, e: BaseException,
                         pending: deque) -> bool:
         """Route a failed replica step; returns False to re-raise (not
@@ -385,6 +491,11 @@ class Server:
             req = sess.request
             if req.ttft_s is None:
                 req.ttft_s = clock - req.arrival_s
+                if self._admission is not None:
+                    # Feed the SLO gate's rolling window regardless of
+                    # telemetry — admission control must work with obs
+                    # off.
+                    self._admission.observe(req.ttft_s)
                 if mod is not None:
                     mod.record_serving("requests", replica=req.replica)
                     mod.record_serving_latency("ttft", req.ttft_s,

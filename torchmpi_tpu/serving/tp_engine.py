@@ -55,7 +55,9 @@ class TPReplicaEngine(ReplicaEngine):
                  slot_tokens: Optional[int] = None,
                  sample: Optional[float] = None,
                  prefill_bucket: Optional[int] = None,
-                 spec_k: Optional[int] = None, draft=None):
+                 spec_k: Optional[int] = None, draft=None,
+                 prefix_cache: Optional[int] = None,
+                 prefix_block: int = 8):
         from ..models.tp_generate import shard_tp_lm
 
         cfg = runtime.effective_config()
@@ -79,12 +81,15 @@ class TPReplicaEngine(ReplicaEngine):
         self._device = None
         self._init_serving(cfg, name, slots, st, sample=sample,
                            prefill_bucket=prefill_bucket, spec_k=spec_k,
-                           draft=draft)
+                           draft=draft, prefix_cache=prefix_cache,
+                           prefix_block=prefix_block)
         # Zero pool cache: per block a head-sharded (k, v) pair
         # [S, slot_tokens, H, dh] — slots replicated, heads 1/n.
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         hd = params["blocks"][0]["wq"].shape[-1] // self.num_heads
+        self._head_dim = int(hd)
+        self._cache_dtype = params["embed"].dtype
         sh = NamedSharding(mesh, P(None, None, axis, None))
         zero = jnp.zeros((slots, st, self.num_heads, hd),
                          params["embed"].dtype)
@@ -118,3 +123,32 @@ class TPReplicaEngine(ReplicaEngine):
             self.params, self._cache, toks, pos, mesh=self.mesh,
             axis=self.axis, num_heads=self.num_heads, sampling=sampling)
         return np.asarray(out)
+
+    def _row_template(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        sh = NamedSharding(self.mesh, P(None, None, self.axis, None))
+        zero = jnp.zeros((1, self.pool.slot_tokens, self.num_heads,
+                          self._head_dim), self._cache_dtype)
+        return [(jax.device_put(zero, sh), jax.device_put(zero, sh))
+                for _ in range(self.depth)]
+
+    def _backend_extend(self, row_cache, suffix, depth, true_len,
+                        sampling):
+        # The extend forward IS tp_slot_decode on a 1-row cache:
+        # [1, Ts] suffix tokens at per-row depth take the cache-masked
+        # branch the speculative verify already uses, which is
+        # shape-generic in both the row and token dims.  tp_slot_decode
+        # keys position j on (seed, idx + j), so shift the idx operand
+        # by -(true_len - 1): the TRUE last suffix position then
+        # samples with exactly the request's global token index, and
+        # the (discarded) earlier positions' keys don't matter.
+        seeds, idxs, temps, tks, tps = sampling
+        shifted = (seeds, idxs - jnp.int32(true_len - 1), temps, tks,
+                   tps)
+        row_cache, out = tp_slot_decode(
+            self.params, row_cache,
+            np.asarray(suffix, np.int32),
+            np.asarray([depth], np.int32), mesh=self.mesh,
+            axis=self.axis, num_heads=self.num_heads, sampling=shifted)
+        return row_cache, np.asarray(out)[:, true_len - 1]
